@@ -1,0 +1,361 @@
+"""Autograd: imperative tape with ``record()/pause()`` semantics.
+
+Reference: ``python/mxnet/autograd.py:?`` (user API) over
+``src/imperative/imperative.cc:?`` (``Imperative::RecordOp`` builds a tape of
+nnvm nodes; ``Imperative::Backward`` runs the nnvm ``Gradient`` pass over the
+tape and executes the grad graph imperatively).
+
+TPU-native redesign: there is no nnvm.  While recording, every invoked op is
+evaluated through ``jax.vjp`` so the tape stores a ready-made backward closure
+(residuals live on-device as jax arrays — the analog of the reference keeping
+forward outputs alive via engine vars).  ``backward()`` walks the tape in
+reverse-topological order, seeds head gradients, and accumulates cotangents
+into ``.grad`` buffers of arrays marked with ``attach_grad()``.  A hybridized
+block records ONE tape node for its whole cached graph (see
+gluon/block.py), which is the analog of CachedOp's cached backward graph
+(``src/imperative/cached_op.cc:?``) and is what makes the backward pass a
+single fused XLA computation.
+
+Semantics preserved from the reference:
+  * ``record/pause`` nest arbitrarily; ``train_mode/predict_mode`` are
+    orthogonal to recording.
+  * ops on arrays not reachable from any ``attach_grad`` variable are not
+    taped (reference prunes via the Gradient pass; we prune at record time).
+  * multiple gradient paths sum; ``grad_req='add'`` accumulates across
+    backward calls, ``'write'`` overwrites.
+  * ``retain_graph=False`` frees the tape (residuals) after one backward.
+
+Known departures (documented, revisit in later rounds):
+  * ``create_graph=True`` (higher-order grad) is not yet supported; the
+    reference supports it for a subset of ops only (tests
+    ``tests/python/unittest/test_higher_order_grad.py:?``).
+"""
+from __future__ import annotations
+
+import threading
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from .base import MXNetError
+
+
+class _AGState(threading.local):
+    def __init__(self):
+        self.recording = False
+        self.training = False
+
+
+_STATE = _AGState()
+
+
+def is_recording() -> bool:
+    return _STATE.recording
+
+
+def is_training() -> bool:
+    return _STATE.training
+
+
+def set_recording(is_record: bool) -> bool:
+    prev, _STATE.recording = _STATE.recording, bool(is_record)
+    return prev
+
+
+def set_training(train_mode: bool) -> bool:
+    prev, _STATE.training = _STATE.training, bool(train_mode)
+    return prev
+
+
+class _RecordingStateScope:
+    def __init__(self, is_record, train_mode):
+        self._rec, self._train = is_record, train_mode
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = (_STATE.recording, _STATE.training)
+        if self._rec is not None:
+            _STATE.recording = self._rec
+        if self._train is not None:
+            _STATE.training = self._train
+        return self
+
+    def __exit__(self, *exc):
+        _STATE.recording, _STATE.training = self._prev
+
+
+def record(train_mode: bool = True):
+    """``with autograd.record():`` — turn on recording (+training mode)."""
+    return _RecordingStateScope(True, train_mode)
+
+
+def pause(train_mode: bool = False):
+    """``with autograd.pause():`` — suspend recording (e.g. metric updates)."""
+    return _RecordingStateScope(False, train_mode)
+
+
+def train_mode():
+    return _RecordingStateScope(None, True)
+
+
+def predict_mode():
+    return _RecordingStateScope(None, False)
+
+
+# ---------------------------------------------------------------------------
+# Tape
+# ---------------------------------------------------------------------------
+
+class Node:
+    """One taped op: a vjp closure plus graph wiring.
+
+    ``inputs`` are the NDArray operands at call time (strong refs — the
+    reference equivalently keeps AGInfo entries alive on the tape).
+    ``out_avals`` records (shape, dtype) per output so backward can
+    synthesise zero cotangents for unused outputs.
+    """
+
+    __slots__ = ("vjp", "inputs", "out_avals", "name", "single")
+
+    def __init__(self, vjp, inputs, out_avals, name="", single=False):
+        self.vjp = vjp
+        self.inputs = inputs
+        self.out_avals = out_avals
+        self.name = name
+        # True when the differentiated callable returned a bare array (jax.vjp
+        # then expects a bare cotangent, not a 1-tuple)
+        self.single = single
+
+    def clear(self):
+        self.vjp = None
+        self.inputs = ()
+
+
+def _zero_cotangent(shape, dtype):
+    import jax
+    import jax.numpy as jnp
+
+    if np.issubdtype(np.dtype(dtype), np.floating) or np.dtype(dtype).name == "bfloat16":
+        return jnp.zeros(shape, dtype)
+    # non-differentiable outputs (int/bool) take float0 cotangents
+    return np.zeros(shape, jax.dtypes.float0)
+
+
+def _topo_order(head_nodes) -> List[Node]:
+    """Iterative DFS postorder over the tape from the head nodes."""
+    order, seen = [], set()
+    stack = [(n, False) for n in head_nodes]
+    while stack:
+        node, expanded = stack.pop()
+        if expanded:
+            order.append(node)
+            continue
+        if id(node) in seen:
+            continue
+        seen.add(id(node))
+        stack.append((node, True))
+        for inp in node.inputs:
+            pnode = getattr(inp, "_node", None)
+            if pnode is not None and id(pnode) not in seen:
+                stack.append((pnode, False))
+    return order  # postorder: producers before consumers
+
+
+def _is_float0(x) -> bool:
+    import jax
+
+    return getattr(x, "dtype", None) == jax.dtypes.float0
+
+
+def backward(heads, head_grads=None, retain_graph: bool = False,
+             train_mode: bool = True):
+    """Run backward from ``heads``; fill ``.grad`` of attached variables.
+
+    Reference: ``MXAutogradBackwardEx`` → ``Imperative::Backward``
+    (src/imperative/imperative.cc:?).
+    """
+    from .ndarray import NDArray  # late import to avoid cycle
+    import jax.numpy as jnp
+
+    if isinstance(heads, NDArray):
+        heads = [heads]
+    if head_grads is None:
+        head_grads = [None] * len(heads)
+    elif isinstance(head_grads, NDArray):
+        head_grads = [head_grads]
+    if len(heads) != len(head_grads):
+        raise MXNetError("heads and head_grads length mismatch")
+
+    # cotangent store: id(node) -> list per output slot
+    cots = {}
+    head_nodes = []
+    # variables directly used as heads
+    var_grads = {}  # id(NDArray) -> (ndarray, accumulated raw grad)
+
+    def seed(arr, g):
+        graw = g._data if isinstance(g, NDArray) else g
+        if graw is None:
+            graw = jnp.ones(arr.shape, arr.dtype)
+        node = getattr(arr, "_node", None)
+        if node is not None:
+            slot_list = cots.setdefault(id(node), [None] * len(node.out_avals))
+            idx = arr._oidx
+            slot_list[idx] = graw if slot_list[idx] is None else slot_list[idx] + graw
+            head_nodes.append(node)
+        elif getattr(arr, "_req_grad", False):
+            k = id(arr)
+            if k in var_grads:
+                var_grads[k] = (arr, var_grads[k][1] + graw)
+            else:
+                var_grads[k] = (arr, graw)
+        else:
+            raise MXNetError(
+                "cannot differentiate a head that is not attached to the "
+                "graph (call .attach_grad() or compute it inside "
+                "autograd.record())")
+
+    for h, hg in zip(heads, head_grads):
+        seed(h, hg)
+
+    order = _topo_order(head_nodes)
+    for node in reversed(order):
+        slot_list = cots.get(id(node))
+        if slot_list is None:
+            continue
+        full = tuple(
+            s if s is not None else _zero_cotangent(shape, dt)
+            for s, (shape, dt) in zip(slot_list, node.out_avals)
+        )
+        if node.vjp is None:
+            raise MXNetError(
+                "graph has already been freed; pass retain_graph=True to "
+                "backward() to backprop twice through the same graph")
+        in_cots = node.vjp(full[0] if node.single else full)
+        for inp, g in zip(node.inputs, in_cots):
+            if g is None or _is_float0(g):
+                continue
+            pnode = getattr(inp, "_node", None)
+            if pnode is not None:
+                pl = cots.setdefault(id(pnode), [None] * len(pnode.out_avals))
+                i = inp._oidx
+                pl[i] = g if pl[i] is None else pl[i] + g
+            if getattr(inp, "_req_grad", False):
+                k = id(inp)
+                if k in var_grads:
+                    var_grads[k] = (inp, var_grads[k][1] + g)
+                else:
+                    var_grads[k] = (inp, g)
+        if not retain_graph:
+            node.clear()
+
+    # write into .grad buffers honouring grad_req
+    for arr, g in var_grads.values():
+        if arr._grad_req == "add":
+            arr._grad._data = arr._grad._data + g
+        elif arr._grad_req == "write":
+            arr._grad._data = g.astype(arr.dtype) if g.dtype != arr._data.dtype else g
+        # 'null': drop
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None,
+         create_graph: bool = False, train_mode: bool = True):
+    """Functional gradient: return grads of ``heads`` w.r.t. ``variables``
+    without touching ``.grad`` buffers (reference: ``autograd.grad``,
+    python/mxnet/autograd.py:?)."""
+    from .ndarray import NDArray
+    import jax.numpy as jnp
+
+    if create_graph:
+        raise NotImplementedError(
+            "create_graph=True (higher-order autograd) lands in a later "
+            "round; the reference supports it for a subset of ops only")
+    if isinstance(variables, NDArray):
+        variables = [variables]
+    if retain_graph is None:
+        retain_graph = create_graph
+
+    # Temporarily mark variables, run backward into scratch buffers.
+    saved = []
+    for v in variables:
+        saved.append((getattr(v, "_req_grad", False), getattr(v, "_grad", None),
+                      getattr(v, "_grad_req", "null")))
+        v._req_grad = True
+        v._grad_req = "write"
+        v._grad = NDArray(jnp.zeros(v.shape, v.dtype))
+    try:
+        backward(heads, head_grads, retain_graph=retain_graph,
+                 train_mode=train_mode)
+        out = [v._grad for v in variables]
+    finally:
+        for v, (rq, g, req) in zip(variables, saved):
+            v._req_grad, v._grad, v._grad_req = rq, g, req
+    return out
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    """Reference: ``autograd.mark_variables`` — associate grad buffers."""
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for v, g, req in zip(variables, gradients, grad_reqs):
+        v._req_grad = req != "null"
+        v._grad = g
+        v._grad_req = req
+
+
+class Function:
+    """Custom differentiable function (reference ``autograd.Function``,
+    python/mxnet/autograd.py:? — the python analog of CustomOp).
+
+    Subclass and implement ``forward(self, *inputs)`` and
+    ``backward(self, *output_grads)`` with NDArray math.  Gradients computed
+    in ``backward`` are raw (not taped) in this round.
+    """
+
+    def __init__(self):
+        self._saved = None
+
+    def save_for_backward(self, *arrays):
+        self._saved = arrays
+
+    @property
+    def saved_tensors(self):
+        return self._saved
+
+    def __call__(self, *inputs):
+        from .ndarray import NDArray
+
+        with pause():
+            outputs = self.forward(*inputs)
+        single = isinstance(outputs, NDArray)
+        outs = (outputs,) if single else tuple(outputs)
+        if is_recording():
+            fn = self
+
+            def vjp(cotangents):
+                from .ndarray import NDArray as ND
+
+                with pause():
+                    gs = fn.backward(*[ND(c) for c in cotangents])
+                if isinstance(gs, ND):
+                    gs = (gs,)
+                return tuple(g._data if g is not None else None for g in gs)
+
+            node = Node(vjp, list(inputs),
+                        [(o.shape, o.dtype) for o in outs],
+                        name=type(self).__name__)
+            for i, o in enumerate(outs):
+                o._node = node
+                o._oidx = i
+        return outputs if single else outs
+
+    def forward(self, *inputs):
+        raise NotImplementedError
+
+    def backward(self, *output_grads):
+        raise NotImplementedError
+
+
+def get_symbol(x):  # pragma: no cover - compat stub
+    raise NotImplementedError(
+        "autograd.get_symbol (legacy symbolic extraction) is not supported; "
+        "use HybridBlock.export for graph capture")

@@ -1,0 +1,146 @@
+"""Device contexts: ``mx.cpu()``, ``mx.tpu()``, ``mx.gpu()``.
+
+Reference: ``python/mxnet/context.py:?`` — ``Context(device_type, device_id)``
+with a thread-local "current context" stack used as the default placement for
+every NDArray creation.
+
+TPU-native redesign: a Context resolves to a concrete ``jax.Device``.  The
+north star extends the reference's {cpu, gpu} pair with ``mx.tpu()``;
+``mx.gpu()`` is kept as a compatibility alias that maps to the accelerator
+backend when one exists (so reference scripts that say ``ctx=mx.gpu(0)`` run
+unchanged on a TPU host).  Multi-device placement for data-parallel training
+is a *list* of contexts, exactly like the reference's ``ctx=[mx.gpu(i) ...]``;
+the parallel layer (mxnet_tpu/parallel) turns such lists into a
+``jax.sharding.Mesh``.
+"""
+from __future__ import annotations
+
+import threading
+from typing import List, Optional
+
+from .base import MXNetError
+
+
+class Context:
+    """A device context.
+
+    Parameters
+    ----------
+    device_type : str
+        'cpu', 'tpu' or 'gpu' ('gpu' aliases the default jax accelerator).
+    device_id : int
+        Index into ``jax.devices(backend)``.
+    """
+
+    _local = threading.local()
+
+    def __init__(self, device_type: str, device_id: int = 0):
+        if device_type not in ("cpu", "tpu", "gpu"):
+            raise MXNetError(f"unknown device type {device_type!r}")
+        self.device_type = device_type
+        self.device_id = int(device_id)
+
+    # -- jax resolution ------------------------------------------------------
+    @property
+    def device(self):
+        """Resolve to the concrete jax.Device (lazy: jax initialises backends
+        on first use)."""
+        import jax
+
+        if self.device_type == "cpu":
+            devs = jax.devices("cpu")
+        else:
+            # 'tpu' and the 'gpu' compat alias both mean "the accelerator
+            # backend jax booted with" — under JAX_PLATFORMS=cpu that is the
+            # (virtual) CPU device list, which is exactly what the unit-test
+            # mesh wants.
+            devs = jax.devices()
+        if self.device_id >= len(devs):
+            raise MXNetError(
+                f"context {self} out of range: only {len(devs)} "
+                f"device(s) available"
+            )
+        return devs[self.device_id]
+
+    # -- identity ------------------------------------------------------------
+    def __eq__(self, other):
+        return (
+            isinstance(other, Context)
+            and self.device_type == other.device_type
+            and self.device_id == other.device_id
+        )
+
+    def __hash__(self):
+        return hash((self.device_type, self.device_id))
+
+    def __repr__(self):
+        return f"{self.device_type}({self.device_id})"
+
+    # -- default-context stack ----------------------------------------------
+    def __enter__(self):
+        stack = getattr(Context._local, "stack", None)
+        if stack is None:
+            stack = Context._local.stack = []
+        stack.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        Context._local.stack.pop()
+
+    @staticmethod
+    def default_ctx() -> "Context":
+        stack = getattr(Context._local, "stack", None)
+        if stack:
+            return stack[-1]
+        return _default_context()
+
+
+def _default_context() -> Context:
+    """The process default: the accelerator if jax has one, else cpu.
+
+    (Reference defaults to cpu(0); we default to the TPU when present because
+    that is the whole point of the port — override with ``with mx.cpu():``.)
+    """
+    import jax
+
+    platform = jax.default_backend()
+    if platform == "cpu":
+        return Context("cpu", 0)
+    return Context("tpu", 0)
+
+
+def cpu(device_id: int = 0) -> Context:
+    """CPU context (reference: python/mxnet/context.py:? ``mx.cpu``)."""
+    return Context("cpu", device_id)
+
+
+def tpu(device_id: int = 0) -> Context:
+    """TPU context — the capability the north star adds to the reference."""
+    return Context("tpu", device_id)
+
+
+def gpu(device_id: int = 0) -> Context:
+    """Compatibility alias so reference scripts run unchanged: resolves to the
+    jax accelerator backend (TPU here), not an actual CUDA device."""
+    return Context("gpu", device_id)
+
+
+def current_context() -> Context:
+    return Context.default_ctx()
+
+
+def num_devices(device_type: Optional[str] = None) -> int:
+    """Reference analog: ``mx.context.num_gpus()``."""
+    import jax
+
+    if device_type == "cpu":
+        return len(jax.devices("cpu"))
+    return len(jax.devices())
+
+
+def num_gpus() -> int:  # compat shim; counts accelerator devices
+    return num_devices()
+
+
+def num_tpus() -> int:
+    return num_devices()

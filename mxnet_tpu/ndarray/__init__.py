@@ -1,0 +1,171 @@
+"""The ``mx.nd`` namespace: NDArray + the generated-op surface.
+
+Reference: ``python/mxnet/ndarray/__init__.py:?`` — op wrappers are
+*generated at import time* from the C++ registry (``ndarray/register.py:?``).
+Here the ops are python functions registered in mxnet_tpu.ops; this module
+re-exports them plus the creation functions, so ``mx.nd.<op>`` resolves the
+same names as the reference.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ..base import resolve_dtype as _resolve_dtype
+from ..context import current_context
+from .ndarray import NDArray
+
+# op namespaces (import order matters only for readability)
+from ..ops.elemwise import *  # noqa: F401,F403
+from ..ops.tensor import *  # noqa: F401,F403
+from ..ops.nn_ops import *  # noqa: F401,F403
+from ..ops import registry as _registry
+
+# random sampling lives in mx.nd.random too (reference parity)
+from .. import random as random  # noqa: F401
+from ..random import uniform as random_uniform  # noqa: F401
+from ..random import normal as random_normal  # noqa: F401
+from ..random import shuffle, multinomial, sample_multinomial  # noqa: F401
+
+
+# --- creation (reference src/operator/tensor/init_op.cc:?) ------------------
+
+def array(source_array, ctx=None, dtype=None):
+    """Create an NDArray from any array-like (reference ``mx.nd.array``)."""
+    if isinstance(source_array, NDArray):
+        out = source_array.astype(dtype) if dtype else source_array.copy()
+        return out.as_in_context(ctx) if ctx else out
+    return NDArray(source_array, ctx=ctx or current_context(),
+                   dtype=_resolve_dtype(dtype))
+
+
+def zeros(shape, ctx=None, dtype=None, **kwargs):
+    import jax.numpy as jnp
+
+    shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    return NDArray(jnp.zeros(shape, _resolve_dtype(dtype) or _np.float32),
+                   ctx=ctx or current_context())
+
+
+def ones(shape, ctx=None, dtype=None, **kwargs):
+    import jax.numpy as jnp
+
+    shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    return NDArray(jnp.ones(shape, _resolve_dtype(dtype) or _np.float32),
+                   ctx=ctx or current_context())
+
+
+def full(shape, val, ctx=None, dtype=None, **kwargs):
+    import jax.numpy as jnp
+
+    shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    return NDArray(jnp.full(shape, val, _resolve_dtype(dtype) or _np.float32),
+                   ctx=ctx or current_context())
+
+
+def empty(shape, ctx=None, dtype=None):
+    # no uninitialised memory on an immutable-array runtime; zeros is the
+    # semantically safe stand-in
+    return zeros(shape, ctx=ctx, dtype=dtype)
+
+
+def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype=None,
+           **kwargs):
+    import jax.numpy as jnp
+
+    r = jnp.arange(start, stop, step, _resolve_dtype(dtype) or _np.float32)
+    if repeat > 1:
+        r = jnp.repeat(r, repeat)
+    return NDArray(r, ctx=ctx or current_context())
+
+
+def linspace(start, stop, num, endpoint=True, ctx=None, dtype=None):
+    import jax.numpy as jnp
+
+    return NDArray(jnp.linspace(start, stop, num, endpoint=endpoint,
+                                dtype=_resolve_dtype(dtype) or _np.float32),
+                   ctx=ctx or current_context())
+
+
+def eye(N, M=0, k=0, ctx=None, dtype=None, **kwargs):
+    import jax.numpy as jnp
+
+    return NDArray(jnp.eye(N, M or None, k,
+                           _resolve_dtype(dtype) or _np.float32),
+                   ctx=ctx or current_context())
+
+
+def zeros_like(data, **kwargs):
+    import jax.numpy as jnp
+
+    return _registry.apply_op(jnp.zeros_like, data, name="zeros_like")
+
+
+def ones_like(data, **kwargs):
+    import jax.numpy as jnp
+
+    return _registry.apply_op(jnp.ones_like, data, name="ones_like")
+
+
+def full_like(data, fill_value, **kwargs):
+    import jax.numpy as jnp
+
+    return _registry.apply_op(lambda a: jnp.full_like(a, fill_value), data,
+                              name="full_like")
+
+
+def stop_gradient(data, **kwargs):
+    """Reference ``stop_gradient``/``BlockGrad``."""
+    return data.detach()
+
+
+BlockGrad = stop_gradient
+
+
+def waitall():
+    """Block until all enqueued device work completes (reference
+    ``mx.nd.waitall`` → ``Engine::WaitForAll``)."""
+    import jax
+
+    try:
+        jax.block_until_ready(jax.numpy.zeros(()))
+        jax.effects_barrier()
+    except Exception:
+        pass
+
+
+def load(fname):
+    """Load NDArrays saved by :func:`save` (dict or list).
+
+    Format: ``.npz`` container — a documented departure from the reference's
+    dmlc::Stream binary (src/ndarray/ndarray.cc:? Save/Load); a reader for
+    legacy ``.params`` files ships with gluon parameter loading.
+    """
+    data = _np.load(fname, allow_pickle=False)
+    keys = list(data.keys())
+    if keys and all(k.startswith("arr_") for k in keys):
+        return [NDArray(data[k]) for k in sorted(
+            keys, key=lambda s: int(s[4:]))]
+    return {k: NDArray(data[k]) for k in keys}
+
+
+def save(fname, data):
+    """Save a list or dict of NDArrays (reference ``mx.nd.save``)."""
+    if isinstance(data, NDArray):
+        data = [data]
+    if isinstance(data, dict):
+        _np.savez(fname, **{k: v.asnumpy() for k, v in data.items()})
+    else:
+        _np.savez(fname, *[v.asnumpy() for v in data])
+    import os
+
+    if not fname.endswith(".npz") and os.path.exists(fname + ".npz"):
+        os.replace(fname + ".npz", fname)
+
+
+def concat_dim0(arrays):
+    return concat(*arrays, dim=0)  # noqa: F405  (from ops.tensor)
+
+
+# sparse lives in its own module (BCOO-backed); imported lazily to keep the
+# base import light
+from . import sparse  # noqa: E402,F401

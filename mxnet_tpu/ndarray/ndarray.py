@@ -1,0 +1,636 @@
+"""NDArray: the imperative tensor.
+
+Reference: ``include/mxnet/ndarray.h:?`` + ``src/ndarray/ndarray.cc:?`` — a
+chunk (storage handle) + shape/dtype/context/storage-type + a dependency-
+engine variable; every op on it is pushed async to the engine, and python
+blocks only at ``WaitToRead``/``asnumpy``.
+
+TPU-native redesign: an NDArray is a mutable *handle* to an immutable
+``jax.Array``.  Mutation (``x[:] = ...``, ``x += y``, optimizer updates)
+rebinds the handle to a new functional value — the version-bump analog of the
+reference engine's write-var sequencing.  Asynchrony comes from jax's own
+async dispatch (device work is enqueued, python continues;
+``wait_to_read`` == ``block_until_ready``), so the reference's threaded
+engine (``src/engine/threaded_engine_perdevice.cc:?``) has no separate
+replica here — XLA + the jax runtime play that role, as cuDNN/cuBLAS played
+the kernel role for the reference.
+
+Autograd wiring (``_node``/``_oidx``/``_req_grad``/``_grad``) is documented
+in mxnet_tpu/autograd.py.
+"""
+from __future__ import annotations
+
+from builtins import slice as builtins_slice
+
+import numpy as np
+
+from ..base import MXNetError, resolve_dtype
+from ..context import Context, current_context
+
+
+def _ctx_from_raw(raw) -> Context:
+    try:
+        dev = raw.device  # jax.Array
+    except Exception:
+        return current_context()
+    if dev is None or not hasattr(dev, "platform"):
+        return current_context()
+    if dev.platform == "cpu":
+        return Context("cpu", dev.id)
+    return Context("tpu", dev.id)
+
+
+def _to_raw(value, dtype=None, ctx=None):
+    """Coerce python/numpy input to a jax.Array (on ctx if given)."""
+    import jax
+    import jax.numpy as jnp
+
+    if isinstance(value, NDArray):
+        raw = value._data
+        if dtype is not None and np.dtype(dtype) != raw.dtype:
+            raw = raw.astype(dtype)
+    else:
+        if dtype is None and isinstance(value, (list, tuple, float, int)):
+            # MXNet semantics: python payloads always become float32
+            dtype = np.float32
+        raw = jnp.asarray(value, dtype=dtype)
+    if ctx is not None:
+        raw = jax.device_put(raw, ctx.device)
+    return raw
+
+
+class NDArray:
+    """A tensor handle with MXNet NDArray semantics over ``jax.Array``."""
+
+    __slots__ = ("_data", "_node", "_oidx", "_req_grad", "_grad", "_grad_req",
+                 "__weakref__")
+
+    # make numpy defer to us: NDArray.__radd__ etc. win over np.ndarray ops
+    __array_priority__ = 100.0
+
+    def __init__(self, data, ctx=None, dtype=None):
+        self._data = _to_raw(data, dtype=dtype, ctx=ctx)
+        self._node = None
+        self._oidx = 0
+        self._req_grad = False
+        self._grad = None
+        self._grad_req = "null"
+
+    # -- basic properties ----------------------------------------------------
+    @property
+    def shape(self):
+        return tuple(self._data.shape)
+
+    @property
+    def dtype(self):
+        return np.dtype(self._data.dtype)
+
+    @property
+    def size(self):
+        return int(np.prod(self.shape)) if self.shape else 1
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    @property
+    def context(self) -> Context:
+        return _ctx_from_raw(self._data)
+
+    ctx = context
+
+    @property
+    def stype(self):
+        return "default"
+
+    @property
+    def T(self):
+        return self.transpose()
+
+    # -- host sync -----------------------------------------------------------
+    def asnumpy(self) -> np.ndarray:
+        """Blocking device→host copy (reference: ``WaitToRead`` + copy,
+        src/ndarray/ndarray.cc:?)."""
+        return np.asarray(self._data)
+
+    def asscalar(self):
+        if self.size != 1:
+            raise MXNetError("The current array is not a scalar")
+        return self.asnumpy().reshape(())[()]
+
+    def item(self):
+        return self.asscalar()
+
+    def wait_to_read(self):
+        """Block until the value is computed (engine ``WaitForVar`` analog)."""
+        try:
+            self._data.block_until_ready()
+        except AttributeError:
+            pass
+        return self
+
+    wait_to_write = wait_to_read
+
+    # -- conversion / movement ----------------------------------------------
+    def astype(self, dtype, copy=True):
+        dt = resolve_dtype(dtype)
+        if not copy and self.dtype == dt:
+            return self
+        from ..ops.registry import apply_op
+
+        return apply_op(lambda a: a.astype(dt), self, name="cast")
+
+    def copy(self):
+        from ..ops.registry import apply_op
+
+        return apply_op(lambda a: a + 0 if a.dtype != np.bool_ else a.copy(),
+                        self, name="copy")
+
+    def copyto(self, other):
+        """Copy into another NDArray (shape must match) or to a Context."""
+        if isinstance(other, Context):
+            return self.as_in_context(other)
+        if not isinstance(other, NDArray):
+            raise MXNetError("copyto target must be NDArray or Context")
+        if other.shape != self.shape:
+            raise MXNetError(
+                f"copyto shape mismatch {self.shape} vs {other.shape}")
+        import jax
+
+        other._data = jax.device_put(
+            self._data.astype(other.dtype), other.context.device)
+        return other
+
+    def as_in_context(self, ctx: Context):
+        import jax
+
+        if ctx == self.context:
+            return self
+        out = NDArray.__new__(NDArray)
+        out._data = jax.device_put(self._data, ctx.device)
+        out._node, out._oidx = self._node, self._oidx
+        out._req_grad, out._grad, out._grad_req = False, None, "null"
+        return out
+
+    as_in_ctx = as_in_context
+
+    def as_nd_ndarray(self):
+        return self
+
+    def asnative(self):
+        """The raw jax.Array (TPU-native escape hatch; analog of DLPack
+        interop, reference src/ndarray/ndarray.cc:? ``ToDLPack``)."""
+        return self._data
+
+    # -- autograd ------------------------------------------------------------
+    def attach_grad(self, grad_req="write", stype=None):
+        """Allocate a gradient buffer; detaches from any recorded graph
+        (reference: python/mxnet/ndarray/ndarray.py:? ``attach_grad``)."""
+        import jax.numpy as jnp
+
+        self._node = None
+        self._oidx = 0
+        self._grad_req = grad_req
+        self._req_grad = grad_req != "null"
+        if self._req_grad:
+            g = NDArray.__new__(NDArray)
+            g._data = jnp.zeros(self.shape, self.dtype)
+            g._node, g._oidx = None, 0
+            g._req_grad, g._grad, g._grad_req = False, None, "null"
+            self._grad = g
+        else:
+            self._grad = None
+
+    @property
+    def grad(self):
+        return self._grad
+
+    def zero_grad(self):
+        if self._grad is not None:
+            import jax.numpy as jnp
+
+            self._grad._data = jnp.zeros(self.shape, self.dtype)
+
+    def detach(self):
+        out = NDArray.__new__(NDArray)
+        out._data = self._data
+        out._node, out._oidx = None, 0
+        out._req_grad, out._grad, out._grad_req = False, None, "null"
+        return out
+
+    def backward(self, out_grad=None, retain_graph=False, train_mode=True):
+        from .. import autograd
+
+        autograd.backward([self], [out_grad], retain_graph=retain_graph,
+                          train_mode=train_mode)
+
+    def _alias(self):
+        """Snapshot handle used to break self-reference when an in-place op
+        is recorded (the reference versions engine vars instead)."""
+        out = NDArray.__new__(NDArray)
+        out._data = self._data
+        out._node, out._oidx = self._node, self._oidx
+        out._req_grad, out._grad, out._grad_req = (
+            self._req_grad, self._grad, self._grad_req)
+        return out
+
+    # -- arithmetic ----------------------------------------------------------
+    def _binary(self, other, jf, name, reflected=False):
+        from ..ops.registry import apply_op
+
+        if isinstance(other, NDArray):
+            if reflected:
+                return apply_op(lambda a, b: jf(b, a), self, other, name=name)
+            return apply_op(lambda a, b: jf(a, b), self, other, name=name)
+        c = other
+
+        if reflected:
+            return apply_op(lambda a: jf(c, a), self, name=name)
+        return apply_op(lambda a: jf(a, c), self, name=name)
+
+    def _inplace(self, other, jf, name):
+        out = self._alias()._binary(other, jf, name)
+        self._data, self._node, self._oidx = out._data, out._node, out._oidx
+        return self
+
+    def __add__(self, o):
+        import jax.numpy as jnp
+
+        return self._binary(o, jnp.add, "add")
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        import jax.numpy as jnp
+
+        return self._binary(o, jnp.subtract, "sub")
+
+    def __rsub__(self, o):
+        import jax.numpy as jnp
+
+        return self._binary(o, jnp.subtract, "rsub", reflected=True)
+
+    def __mul__(self, o):
+        import jax.numpy as jnp
+
+        return self._binary(o, jnp.multiply, "mul")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        import jax.numpy as jnp
+
+        return self._binary(o, jnp.divide, "div")
+
+    def __rtruediv__(self, o):
+        import jax.numpy as jnp
+
+        return self._binary(o, jnp.divide, "rdiv", reflected=True)
+
+    def __floordiv__(self, o):
+        import jax.numpy as jnp
+
+        return self._binary(o, jnp.floor_divide, "floordiv")
+
+    def __mod__(self, o):
+        import jax.numpy as jnp
+
+        return self._binary(o, jnp.mod, "mod")
+
+    def __rmod__(self, o):
+        import jax.numpy as jnp
+
+        return self._binary(o, jnp.mod, "rmod", reflected=True)
+
+    def __pow__(self, o):
+        import jax.numpy as jnp
+
+        return self._binary(o, jnp.power, "pow")
+
+    def __rpow__(self, o):
+        import jax.numpy as jnp
+
+        return self._binary(o, jnp.power, "rpow", reflected=True)
+
+    def __matmul__(self, o):
+        from . import dot
+
+        return dot(self, o)
+
+    def __neg__(self):
+        from ..ops.registry import apply_op
+
+        return apply_op(lambda a: -a, self, name="neg")
+
+    def __abs__(self):
+        from ..ops.registry import apply_op
+        import jax.numpy as jnp
+
+        return apply_op(jnp.abs, self, name="abs")
+
+    def __iadd__(self, o):
+        import jax.numpy as jnp
+
+        return self._inplace(o, jnp.add, "iadd")
+
+    def __isub__(self, o):
+        import jax.numpy as jnp
+
+        return self._inplace(o, jnp.subtract, "isub")
+
+    def __imul__(self, o):
+        import jax.numpy as jnp
+
+        return self._inplace(o, jnp.multiply, "imul")
+
+    def __itruediv__(self, o):
+        import jax.numpy as jnp
+
+        return self._inplace(o, jnp.divide, "idiv")
+
+    # -- comparisons (elementwise 0/1 arrays in the operand dtype, matching
+    #    the reference's comparison ops) --------------------------------------
+    def _cmp(self, o, jf, name):
+        import jax.numpy as jnp
+
+        dt = self.dtype if self.dtype != np.bool_ else np.float32
+        return self._binary(o, lambda a, b: jf(a, b).astype(dt), name)
+
+    def __eq__(self, o):
+        import jax.numpy as jnp
+
+        return self._cmp(o, jnp.equal, "eq")
+
+    def __ne__(self, o):
+        import jax.numpy as jnp
+
+        return self._cmp(o, jnp.not_equal, "ne")
+
+    def __gt__(self, o):
+        import jax.numpy as jnp
+
+        return self._cmp(o, jnp.greater, "gt")
+
+    def __ge__(self, o):
+        import jax.numpy as jnp
+
+        return self._cmp(o, jnp.greater_equal, "ge")
+
+    def __lt__(self, o):
+        import jax.numpy as jnp
+
+        return self._cmp(o, jnp.less, "lt")
+
+    def __le__(self, o):
+        import jax.numpy as jnp
+
+        return self._cmp(o, jnp.less_equal, "le")
+
+    __hash__ = object.__hash__  # identity hash despite elementwise __eq__
+
+    # -- indexing ------------------------------------------------------------
+    @staticmethod
+    def _raw_key(key):
+        """Unwrap NDArray keys to raw arrays; float index arrays (the
+        reference's argmax/argsort/topk return float32 indices by design)
+        are cast to int so reference-style ``x[x.argmax()]`` works."""
+        def one(k):
+            if isinstance(k, NDArray):
+                r = k._data
+            elif isinstance(k, np.ndarray):
+                r = k
+            else:
+                return k
+            if np.issubdtype(np.dtype(r.dtype), np.floating) or \
+                    np.dtype(r.dtype).name == "bfloat16":
+                r = r.astype(np.int32)
+            return r
+
+        if isinstance(key, tuple):
+            return tuple(one(k) for k in key)
+        return one(key)
+
+    @staticmethod
+    def _is_full_key(key):
+        return key is None or key is Ellipsis or (
+            isinstance(key, builtins_slice) and key.start is None
+            and key.stop is None and key.step is None)
+
+    def __getitem__(self, key):
+        from ..ops.registry import apply_op
+
+        rkey = NDArray._raw_key(key)
+        return apply_op(lambda a: a[rkey], self, name="getitem")
+
+    def __setitem__(self, key, value):
+        """Functional in-place write (reference mutates the chunk under an
+        engine write-var; we rebind the handle).  Tape semantics: the write
+        is recorded as an op, so gradients flow into the assigned value and
+        stop flowing into the overwritten region."""
+        from ..ops.registry import apply_op
+        import jax.numpy as jnp
+
+        if NDArray._is_full_key(key):
+            # x[:] = v → full overwrite: the result depends only on v
+            shape, dt = self.shape, self.dtype
+            if isinstance(value, NDArray):
+                out = apply_op(
+                    lambda v: jnp.broadcast_to(v.astype(dt), shape),
+                    value, name="setitem_full")
+            else:
+                out = NDArray(jnp.full(shape, value, dt))
+            self._data, self._node, self._oidx = (
+                out._data, out._node, out._oidx)
+            return
+        rkey = NDArray._raw_key(key)
+        if isinstance(value, NDArray):
+            out = apply_op(
+                lambda a, v: a.at[rkey].set(v.astype(a.dtype)),
+                self._alias(), value, name="setitem")
+        else:
+            out = apply_op(
+                lambda a: a.at[rkey].set(jnp.asarray(value).astype(a.dtype)),
+                self._alias(), name="setitem")
+        self._data, self._node, self._oidx = out._data, out._node, out._oidx
+
+    def __len__(self):
+        if not self.shape:
+            raise TypeError("len() of unsized object")
+        return self.shape[0]
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __bool__(self):
+        if self.size == 1:
+            return bool(self.asnumpy())
+        raise MXNetError(
+            "The truth value of an NDArray with multiple elements is "
+            "ambiguous.")
+
+    def __float__(self):
+        return float(self.asscalar())
+
+    def __int__(self):
+        return int(self.asscalar())
+
+    def __repr__(self):
+        try:
+            payload = str(self.asnumpy())
+        except Exception as e:  # pragma: no cover
+            payload = f"<unevaluated: {e}>"
+        return (f"\n{payload}\n<NDArray {'x'.join(map(str, self.shape))} "
+                f"@{self.context}>")
+
+    def __array__(self, dtype=None):
+        a = self.asnumpy()
+        return a.astype(dtype) if dtype is not None else a
+
+    # -- method forms of common ops (delegate to the nd namespace) -----------
+    def _nd(self):
+        from .. import ndarray as nd
+
+        return nd
+
+    def reshape(self, *shape, **kwargs):
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        return self._nd().reshape(self, shape=shape)
+
+    def reshape_like(self, other):
+        return self._nd().reshape_like(self, other)
+
+    def transpose(self, axes=None):
+        return self._nd().transpose(self, axes=axes)
+
+    def swapaxes(self, dim1, dim2):
+        return self._nd().swapaxes(self, dim1, dim2)
+
+    def flatten(self):
+        return self._nd().flatten(self)
+
+    def expand_dims(self, axis):
+        return self._nd().expand_dims(self, axis=axis)
+
+    def squeeze(self, axis=None):
+        return self._nd().squeeze(self, axis=axis)
+
+    def broadcast_to(self, shape):
+        return self._nd().broadcast_to(self, shape=shape)
+
+    def broadcast_like(self, other):
+        return self._nd().broadcast_like(self, other)
+
+    def tile(self, reps):
+        return self._nd().tile(self, reps=reps)
+
+    def repeat(self, repeats, axis=None):
+        return self._nd().repeat(self, repeats=repeats, axis=axis)
+
+    def flip(self, axis):
+        return self._nd().flip(self, axis=axis)
+
+    def sum(self, axis=None, keepdims=False):
+        return self._nd().sum(self, axis=axis, keepdims=keepdims)
+
+    def nansum(self, axis=None, keepdims=False):
+        return self._nd().nansum(self, axis=axis, keepdims=keepdims)
+
+    def mean(self, axis=None, keepdims=False):
+        return self._nd().mean(self, axis=axis, keepdims=keepdims)
+
+    def prod(self, axis=None, keepdims=False):
+        return self._nd().prod(self, axis=axis, keepdims=keepdims)
+
+    def max(self, axis=None, keepdims=False):
+        return self._nd().max(self, axis=axis, keepdims=keepdims)
+
+    def min(self, axis=None, keepdims=False):
+        return self._nd().min(self, axis=axis, keepdims=keepdims)
+
+    def norm(self, ord=2, axis=None, keepdims=False):
+        return self._nd().norm(self, ord=ord, axis=axis, keepdims=keepdims)
+
+    def argmax(self, axis=None, keepdims=False):
+        return self._nd().argmax(self, axis=axis, keepdims=keepdims)
+
+    def argmin(self, axis=None, keepdims=False):
+        return self._nd().argmin(self, axis=axis, keepdims=keepdims)
+
+    def argsort(self, axis=-1, is_ascend=True):
+        return self._nd().argsort(self, axis=axis, is_ascend=is_ascend)
+
+    def sort(self, axis=-1, is_ascend=True):
+        return self._nd().sort(self, axis=axis, is_ascend=is_ascend)
+
+    def topk(self, axis=-1, k=1, ret_typ="indices", is_ascend=False):
+        return self._nd().topk(self, axis=axis, k=k, ret_typ=ret_typ,
+                               is_ascend=is_ascend)
+
+    def clip(self, a_min=None, a_max=None):
+        return self._nd().clip(self, a_min=a_min, a_max=a_max)
+
+    def abs(self):
+        return self.__abs__()
+
+    def sign(self):
+        return self._nd().sign(self)
+
+    def exp(self):
+        return self._nd().exp(self)
+
+    def log(self):
+        return self._nd().log(self)
+
+    def sqrt(self):
+        return self._nd().sqrt(self)
+
+    def square(self):
+        return self._nd().square(self)
+
+    def sigmoid(self):
+        return self._nd().sigmoid(self)
+
+    def tanh(self):
+        return self._nd().tanh(self)
+
+    def relu(self):
+        return self._nd().relu(self)
+
+    def softmax(self, axis=-1):
+        return self._nd().softmax(self, axis=axis)
+
+    def log_softmax(self, axis=-1):
+        return self._nd().log_softmax(self, axis=axis)
+
+    def dot(self, other, transpose_a=False, transpose_b=False):
+        return self._nd().dot(self, other, transpose_a=transpose_a,
+                              transpose_b=transpose_b)
+
+    def one_hot(self, depth, on_value=1.0, off_value=0.0):
+        return self._nd().one_hot(self, depth=depth, on_value=on_value,
+                                  off_value=off_value)
+
+    def take(self, indices, axis=0, mode="clip"):
+        return self._nd().take(self, indices, axis=axis, mode=mode)
+
+    def slice_axis(self, axis, begin, end):
+        return self._nd().slice_axis(self, axis=axis, begin=begin, end=end)
+
+    def split(self, num_outputs, axis=1, squeeze_axis=False):
+        return self._nd().split(self, num_outputs=num_outputs, axis=axis,
+                                squeeze_axis=squeeze_axis)
+
+    def zeros_like(self):
+        return self._nd().zeros_like(self)
+
+    def ones_like(self):
+        return self._nd().ones_like(self)
+
+    def tostype(self, stype):
+        if stype == "default":
+            return self
+        from . import sparse
+
+        return sparse.cast_storage(self, stype)

@@ -1,0 +1,248 @@
+"""Sparse NDArrays: ``row_sparse`` and ``csr`` storage types.
+
+Reference: ``include/mxnet/ndarray.h:?`` (kRowSparseStorage/kCSRStorage),
+``src/operator/tensor/cast_storage-inl.h:?``, sparse FComputeEx kernels in
+``src/operator/tensor/dot.cc:?`` / ``elemwise_binary_op_basic.cc:?``.
+
+TPU-native redesign: a RowSparseNDArray keeps ``(indices, values)`` as two
+dense jax arrays — the exact layout the reference uses — so gather/scatter
+ops lower to XLA dynamic-slice/scatter which TPU executes natively.  CSR
+keeps (indptr, indices, data).  Dense bridges use jnp scatter/gather; the
+BCOO interop (jax.experimental.sparse) is exposed via ``to_bcoo`` for ops
+that want XLA's sparse matmul path.  This module covers the storage types +
+conversion + the row_sparse paths the optimizer/kvstore need; the wider
+sparse op algebra grows in later rounds (SURVEY §7 stage 8).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..base import MXNetError
+from .ndarray import NDArray
+
+
+class BaseSparseNDArray:
+    @property
+    def stype(self):
+        raise NotImplementedError
+
+    def asnumpy(self):
+        return self.todense().asnumpy()
+
+    def wait_to_read(self):
+        return self
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    """(indices, values) pair: values[i] is the dense row at indices[i].
+
+    Reference: RowSparseNDArray (python/mxnet/ndarray/sparse.py:?).
+    """
+
+    def __init__(self, data, indices, shape):
+        self.data = data if isinstance(data, NDArray) else NDArray(data)
+        self.indices = (indices if isinstance(indices, NDArray)
+                        else NDArray(indices, dtype=np.int64))
+        self._shape = tuple(shape)
+
+    @property
+    def stype(self):
+        return "row_sparse"
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @property
+    def dtype(self):
+        return self.data.dtype
+
+    @property
+    def context(self):
+        return self.data.context
+
+    def todense(self) -> NDArray:
+        import jax.numpy as jnp
+
+        idx = self.indices._data.astype(np.int32)
+        out = jnp.zeros(self._shape, self.data.dtype)
+        out = out.at[idx].set(self.data._data)
+        return NDArray(out)
+
+    tostype_dense = todense
+
+    def tostype(self, stype):
+        if stype == "default":
+            return self.todense()
+        if stype == "row_sparse":
+            return self
+        raise MXNetError(f"cannot convert row_sparse to {stype}")
+
+    def copyto(self, other):
+        if isinstance(other, RowSparseNDArray):
+            other.data = self.data.copy()
+            other.indices = self.indices.copy()
+            other._shape = self._shape
+            return other
+        return self.todense().copyto(other)
+
+    def __repr__(self):
+        return (f"\n<RowSparseNDArray {'x'.join(map(str, self._shape))} "
+                f"nnz-rows={self.indices.shape[0]}>")
+
+    def retain(self, indices):
+        """Keep only the requested rows (reference ``sparse.retain``)."""
+        import jax.numpy as jnp
+
+        want = indices._data if isinstance(indices, NDArray) else \
+            jnp.asarray(indices)
+        mask = jnp.isin(self.indices._data, want)
+        keep = np.asarray(mask)
+        idx = np.asarray(self.indices._data)[keep]
+        vals = np.asarray(self.data._data)[keep]
+        return RowSparseNDArray(NDArray(vals), NDArray(idx, dtype=np.int64),
+                                self._shape)
+
+
+class CSRNDArray(BaseSparseNDArray):
+    """Compressed sparse row matrix (indptr, indices, data).
+
+    Reference: CSRNDArray (python/mxnet/ndarray/sparse.py:?).
+    """
+
+    def __init__(self, data, indices, indptr, shape):
+        self.data = data if isinstance(data, NDArray) else NDArray(data)
+        self.indices = (indices if isinstance(indices, NDArray)
+                        else NDArray(indices, dtype=np.int64))
+        self.indptr = (indptr if isinstance(indptr, NDArray)
+                       else NDArray(indptr, dtype=np.int64))
+        self._shape = tuple(shape)
+
+    @property
+    def stype(self):
+        return "csr"
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @property
+    def dtype(self):
+        return self.data.dtype
+
+    @property
+    def context(self):
+        return self.data.context
+
+    def todense(self) -> NDArray:
+        import jax.numpy as jnp
+
+        indptr = np.asarray(self.indptr._data)
+        cols = self.indices._data.astype(np.int32)
+        nnz = cols.shape[0]
+        # expand indptr to per-nnz row ids on host (indptr is host-small)
+        rows = np.repeat(np.arange(self._shape[0]), np.diff(indptr))
+        out = jnp.zeros(self._shape, self.data.dtype)
+        out = out.at[jnp.asarray(rows), cols].set(self.data._data)
+        return NDArray(out)
+
+    def to_bcoo(self):
+        """Bridge to jax.experimental.sparse BCOO for XLA sparse matmul."""
+        from jax.experimental import sparse as jsparse
+
+        return jsparse.BCOO.from_dense(self.todense()._data)
+
+    def tostype(self, stype):
+        if stype == "default":
+            return self.todense()
+        if stype == "csr":
+            return self
+        raise MXNetError(f"cannot convert csr to {stype}")
+
+    def __repr__(self):
+        return (f"\n<CSRNDArray {'x'.join(map(str, self._shape))} "
+                f"nnz={self.data.shape[0]}>")
+
+
+# --- constructors (reference mx.nd.sparse.*) --------------------------------
+
+def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
+    if isinstance(arg1, tuple) and len(arg1) == 2:
+        data, indices = arg1
+        return RowSparseNDArray(NDArray(data, dtype=dtype),
+                                NDArray(indices, dtype=np.int64), shape)
+    dense = arg1 if isinstance(arg1, NDArray) else NDArray(arg1, dtype=dtype)
+    return cast_storage(dense, "row_sparse")
+
+
+def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
+    if isinstance(arg1, tuple) and len(arg1) == 3:
+        data, indices, indptr = arg1
+        return CSRNDArray(NDArray(data, dtype=dtype),
+                          NDArray(indices, dtype=np.int64),
+                          NDArray(indptr, dtype=np.int64), shape)
+    dense = arg1 if isinstance(arg1, NDArray) else NDArray(arg1, dtype=dtype)
+    return cast_storage(dense, "csr")
+
+
+def cast_storage(data, stype):
+    """Reference ``cast_storage`` (cast_storage-inl.h:?)."""
+    if stype == "default":
+        if isinstance(data, BaseSparseNDArray):
+            return data.todense()
+        return data
+    dense = data.asnumpy() if not isinstance(data, np.ndarray) else data
+    if stype == "row_sparse":
+        nz_rows = np.where(np.any(dense != 0,
+                                  axis=tuple(range(1, dense.ndim))))[0]
+        return RowSparseNDArray(NDArray(dense[nz_rows]),
+                                NDArray(nz_rows.astype(np.int64)),
+                                dense.shape)
+    if stype == "csr":
+        if dense.ndim != 2:
+            raise MXNetError("csr requires 2D")
+        indptr = [0]
+        indices, vals = [], []
+        for r in range(dense.shape[0]):
+            cols = np.nonzero(dense[r])[0]
+            indices.extend(cols.tolist())
+            vals.extend(dense[r][cols].tolist())
+            indptr.append(len(indices))
+        return CSRNDArray(
+            NDArray(np.asarray(vals, dtype=dense.dtype)),
+            NDArray(np.asarray(indices, dtype=np.int64)),
+            NDArray(np.asarray(indptr, dtype=np.int64)), dense.shape)
+    raise MXNetError(f"unknown stype {stype}")
+
+
+def zeros(stype, shape, ctx=None, dtype=None):
+    dt = dtype or np.float32
+    if stype == "row_sparse":
+        return RowSparseNDArray(NDArray(np.zeros((0,) + tuple(shape[1:]), dt)),
+                                NDArray(np.zeros((0,), np.int64)), shape)
+    if stype == "csr":
+        return CSRNDArray(NDArray(np.zeros((0,), dt)),
+                          NDArray(np.zeros((0,), np.int64)),
+                          NDArray(np.zeros((shape[0] + 1,), np.int64)), shape)
+    from . import zeros as dense_zeros
+
+    return dense_zeros(shape, ctx=ctx, dtype=dtype)
+
+
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """Sparse-aware dot: csr × dense routes through BCOO (XLA sparse path);
+    row_sparse densifies (reference FComputeEx dispatch,
+    src/operator/tensor/dot.cc:?)."""
+    from . import dot as dense_dot
+
+    if isinstance(lhs, CSRNDArray) and not isinstance(rhs,
+                                                      BaseSparseNDArray):
+        bcoo = lhs.to_bcoo()
+        raw = rhs._data
+        if transpose_a:
+            bcoo = bcoo.T
+        out = bcoo @ (raw.T if transpose_b else raw)
+        return NDArray(out)
+    l = lhs.todense() if isinstance(lhs, BaseSparseNDArray) else lhs
+    r = rhs.todense() if isinstance(rhs, BaseSparseNDArray) else rhs
+    return dense_dot(l, r, transpose_a=transpose_a, transpose_b=transpose_b)

@@ -1,0 +1,15 @@
+"""Operator library.
+
+Reference: ``src/operator/**`` (~300k LoC of C++/CUDA kernels registered via
+nnvm with FInferShape/FInferType/FCompute/FGradient attributes,
+``include/mxnet/op_attr_types.h:?``).
+
+TPU-native redesign: operators are pure jnp/lax functions dispatched through
+:mod:`mxnet_tpu.ops.registry`.  XLA plays the role of mshadow + cuDNN + the
+pointwise-fusion NVRTC codegen (``src/operator/fusion/fused_op.cc:?`` is
+"free" on TPU — XLA fuses elementwise chains natively).  Gradients come from
+``jax.vjp`` instead of hand-registered FGradient passes.  Pallas kernels are
+used where XLA's fusion is not enough (attention; see models/ and parallel/).
+"""
+from . import registry
+from .registry import apply_op, defop

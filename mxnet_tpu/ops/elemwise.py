@@ -1,0 +1,182 @@
+"""Elementwise operators.
+
+Reference: ``src/operator/tensor/elemwise_unary_op_basic.cc:?``,
+``elemwise_binary_op_basic.cc:?``, ``elemwise_binary_broadcast_op_*.cc:?``
+and the mshadow expression kernels they launch.
+
+TPU-native: each op is one jnp call; XLA fuses chains of these into single
+VPU kernels (the reference needed NVRTC runtime fusion for that,
+``src/operator/fusion/fused_op.cc:?``).  Departure from the reference noted
+in SURVEY §2.2: the ``elemwise_*`` names broadcast here (numpy semantics)
+instead of requiring identical shapes — ``broadcast_*`` aliases map to the
+same implementations.
+"""
+from __future__ import annotations
+
+import sys
+
+import numpy as np
+import jax.numpy as jnp
+from jax import lax
+from jax.scipy import special as jsp_special
+
+from .registry import apply_op, commit_out, make_exporter
+
+_this = sys.modules[__name__]
+_export_fn = make_exporter(_this)
+
+
+def _export(name, fn, aliases=()):
+    _export_fn(fn, name=name, aliases=aliases)
+
+
+def _make_unary(name, jf, aliases=()):
+    def fn(data, out=None, **kwargs):
+        return commit_out(out, apply_op(jf, data, name=name))
+
+    _export(name, fn, aliases)
+
+
+def _make_binary(name, jf, aliases=()):
+    def fn(lhs, rhs, out=None, **kwargs):
+        from ..ndarray import NDArray
+
+        if isinstance(lhs, NDArray) and isinstance(rhs, NDArray):
+            r = apply_op(jf, lhs, rhs, name=name)
+        elif isinstance(lhs, NDArray):
+            c = rhs
+            r = apply_op(lambda a: jf(a, c), lhs, name=name)
+        elif isinstance(rhs, NDArray):
+            c = lhs
+            r = apply_op(lambda b: jf(c, b), rhs, name=name)
+        else:
+            return jf(lhs, rhs)
+        return commit_out(out, r)
+
+    _export(name, fn, aliases)
+
+
+def _gamma(x):
+    """Γ(x): gammaln on the positive domain, reflection formula
+    Γ(x) = π / (sin(πx)·Γ(1−x)) for the negative domain (keeps the sign
+    right, which |exp(gammaln)| alone would not)."""
+    pos = jnp.exp(jsp_special.gammaln(x))
+    neg = jnp.pi / (jnp.sin(jnp.pi * x) *
+                    jnp.exp(jsp_special.gammaln(1.0 - x)))
+    return jnp.where(x > 0, pos, neg)
+
+
+_UNARY = [
+    ("abs", jnp.abs),
+    ("sign", jnp.sign),
+    ("ceil", jnp.ceil),
+    ("floor", jnp.floor),
+    ("rint", jnp.rint),
+    ("round", jnp.round),
+    ("trunc", jnp.trunc),
+    ("fix", jnp.trunc),
+    ("exp", jnp.exp),
+    ("expm1", jnp.expm1),
+    ("log", jnp.log),
+    ("log10", jnp.log10),
+    ("log2", jnp.log2),
+    ("log1p", jnp.log1p),
+    ("sqrt", jnp.sqrt),
+    ("rsqrt", lax.rsqrt),
+    ("cbrt", jnp.cbrt),
+    ("rcbrt", lambda x: 1.0 / jnp.cbrt(x)),
+    ("square", jnp.square),
+    ("reciprocal", lambda x: 1.0 / x),
+    ("negative", jnp.negative),
+    ("relu", lambda x: jnp.maximum(x, 0)),
+    ("sigmoid", lambda x: 1.0 / (1.0 + jnp.exp(-x))),
+    ("softsign", lambda x: x / (1.0 + jnp.abs(x))),
+    ("softrelu", lambda x: jnp.logaddexp(x, 0.0)),
+    ("tanh", jnp.tanh),
+    ("sin", jnp.sin),
+    ("cos", jnp.cos),
+    ("tan", jnp.tan),
+    ("arcsin", jnp.arcsin),
+    ("arccos", jnp.arccos),
+    ("arctan", jnp.arctan),
+    ("sinh", jnp.sinh),
+    ("cosh", jnp.cosh),
+    ("arcsinh", jnp.arcsinh),
+    ("arccosh", jnp.arccosh),
+    ("arctanh", jnp.arctanh),
+    ("erf", jsp_special.erf),
+    ("erfinv", jsp_special.erfinv),
+    ("gamma", _gamma),
+    ("gammaln", jsp_special.gammaln),
+    ("logical_not", lambda x: (x == 0).astype(x.dtype)
+     if np.issubdtype(np.dtype(x.dtype), np.floating) else jnp.logical_not(x)),
+    ("degrees", jnp.degrees),
+    ("radians", jnp.radians),
+    ("identity", lambda x: x + 0, ("copy", "stop_gradient_off")),
+    ("isnan", jnp.isnan),
+    ("isinf", jnp.isinf),
+    ("isfinite", jnp.isfinite),
+]
+
+for row in _UNARY:
+    _make_unary(row[0], row[1], row[2] if len(row) > 2 else ())
+
+_BINARY = [
+    ("add", jnp.add, ("elemwise_add", "broadcast_add", "broadcast_plus")),
+    ("subtract", jnp.subtract,
+     ("elemwise_sub", "broadcast_sub", "broadcast_minus")),
+    ("multiply", jnp.multiply, ("elemwise_mul", "broadcast_mul")),
+    ("divide", jnp.divide, ("elemwise_div", "broadcast_div")),
+    ("mod", jnp.mod, ("broadcast_mod",)),
+    ("power", jnp.power, ("broadcast_power", "pow")),
+    ("maximum", jnp.maximum, ("broadcast_maximum",)),
+    ("minimum", jnp.minimum, ("broadcast_minimum",)),
+    ("hypot", jnp.hypot, ("broadcast_hypot",)),
+    ("arctan2", jnp.arctan2,),
+    ("equal", lambda a, b: (a == b).astype(_f32_like(a)),
+     ("broadcast_equal",)),
+    ("not_equal", lambda a, b: (a != b).astype(_f32_like(a)),
+     ("broadcast_not_equal",)),
+    ("greater", lambda a, b: (a > b).astype(_f32_like(a)),
+     ("broadcast_greater",)),
+    ("greater_equal", lambda a, b: (a >= b).astype(_f32_like(a)),
+     ("broadcast_greater_equal",)),
+    ("lesser", lambda a, b: (a < b).astype(_f32_like(a)),
+     ("broadcast_lesser",)),
+    ("lesser_equal", lambda a, b: (a <= b).astype(_f32_like(a)),
+     ("broadcast_lesser_equal",)),
+    ("logical_and", lambda a, b: jnp.logical_and(a != 0, b != 0).astype(
+        _f32_like(a)), ("broadcast_logical_and",)),
+    ("logical_or", lambda a, b: jnp.logical_or(a != 0, b != 0).astype(
+        _f32_like(a)), ("broadcast_logical_or",)),
+    ("logical_xor", lambda a, b: jnp.logical_xor(a != 0, b != 0).astype(
+        _f32_like(a)), ("broadcast_logical_xor",)),
+]
+
+
+def _f32_like(a):
+    """MXNet comparison ops return same-dtype 0/1 arrays, not bools."""
+    dt = np.dtype(a.dtype)
+    return dt if dt != np.bool_ else np.float32
+
+
+for row in _BINARY:
+    _make_binary(row[0], row[1], row[2] if len(row) > 2 else ())
+
+
+def add_n(*args, out=None, **kwargs):
+    """Sum of N arrays in one fused op (reference ``add_n``/``ElementWiseSum``,
+    src/operator/tensor/elemwise_sum.cc:?)."""
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = tuple(args[0])
+
+    def f(*raws):
+        acc = raws[0]
+        for r in raws[1:]:
+            acc = acc + r
+        return acc
+
+    return commit_out(out, apply_op(f, *args, name="add_n"))
+
+
+_export("add_n", add_n, aliases=("ElementWiseSum",))

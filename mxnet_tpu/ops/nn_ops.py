@@ -1,0 +1,451 @@
+"""Neural-network operators.
+
+Reference: ``src/operator/nn/`` — ``convolution.cc:?`` (+ cudnn/mkldnn
+forks), ``fully_connected.cc:?``, ``batch_norm.cc:?``, ``layer_norm.cc:?``,
+``pooling.cc:?``, ``activation.cc:?``, ``dropout.cc:?``, ``softmax.cc:?``;
+``src/operator/leaky_relu.cc:?``; Embedding in ``indexing_op.cc:?``.
+
+TPU-native: convs/matmuls go through ``lax.conv_general_dilated`` /
+``jnp.dot`` so XLA tiles them onto the MXU; bf16 inputs keep float32
+accumulation via ``preferred_element_type`` (the role cuDNN's pseudo-fp16
+math mode played).  Layouts: ops accept MXNet's NCHW/NCW/NCDHW and pass the
+dimension_numbers straight to XLA — on TPU, XLA canonicalises layout itself,
+so no NHWC rewrite is needed in the framework.
+"""
+from __future__ import annotations
+
+import sys
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import MXNetError
+from .registry import accum_dtype, apply_op, make_exporter
+
+_this = sys.modules[__name__]
+_export = make_exporter(_this)
+
+
+def _accum(x):
+    return accum_dtype(x.dtype) is not None
+
+
+# --- activations ------------------------------------------------------------
+
+_ACTS = {
+    "relu": lambda x: jnp.maximum(x, 0),
+    "sigmoid": lambda x: 1.0 / (1.0 + jnp.exp(-x)),
+    "tanh": jnp.tanh,
+    "softrelu": lambda x: jnp.logaddexp(x, 0.0),
+    "softsign": lambda x: x / (1.0 + jnp.abs(x)),
+}
+
+
+def activation(data, act_type="relu", **kwargs):
+    if act_type not in _ACTS:
+        raise MXNetError(f"unknown act_type {act_type!r}")
+    return apply_op(_ACTS[act_type], data, name=f"activation_{act_type}")
+
+
+_export(activation, aliases=("Activation",))
+
+
+def leaky_relu(data, gamma=None, act_type="leaky", slope=0.25,
+               lower_bound=0.125, upper_bound=0.334, **kwargs):
+    """Reference ``LeakyReLU`` op: leaky/prelu/elu/selu/gelu/rrelu."""
+    if act_type == "leaky":
+        return apply_op(lambda a: jnp.where(a > 0, a, slope * a), data,
+                        name="leaky_relu")
+    if act_type == "prelu":
+        return apply_op(
+            lambda a, g: jnp.where(a > 0, a, g * a), data, gamma,
+            name="prelu")
+    if act_type == "elu":
+        return apply_op(
+            lambda a: jnp.where(a > 0, a, slope * (jnp.exp(a) - 1)), data,
+            name="elu")
+    if act_type == "selu":
+        al, sc = 1.6732632423543772, 1.0507009873554805
+        return apply_op(
+            lambda a: sc * jnp.where(a > 0, a, al * (jnp.exp(a) - 1)), data,
+            name="selu")
+    if act_type == "gelu":
+        return apply_op(lambda a: jax.nn.gelu(a, approximate=False), data,
+                        name="gelu")
+    raise MXNetError(f"unknown LeakyReLU act_type {act_type!r}")
+
+
+_export(leaky_relu, aliases=("LeakyReLU",))
+
+
+def hard_sigmoid(data, alpha=0.2, beta=0.5, **kwargs):
+    return apply_op(lambda a: jnp.clip(alpha * a + beta, 0, 1), data,
+                    name="hard_sigmoid")
+
+
+_export(hard_sigmoid)
+
+
+def softmax(data, axis=-1, temperature=None, **kwargs):
+    t = temperature
+
+    def f(a):
+        x = a / t if t and t != 1.0 else a
+        return jax.nn.softmax(x, axis=axis)
+
+    return apply_op(f, data, name="softmax")
+
+
+_export(softmax)
+
+
+def log_softmax(data, axis=-1, temperature=None, **kwargs):
+    t = temperature
+
+    def f(a):
+        x = a / t if t and t != 1.0 else a
+        return jax.nn.log_softmax(x, axis=axis)
+
+    return apply_op(f, data, name="log_softmax")
+
+
+_export(log_softmax)
+
+
+def softmax_cross_entropy(data, label, **kwargs):
+    """Reference ``softmax_cross_entropy`` (fused logits+label CE, summed)."""
+    def f(logits, lab):
+        ls = jax.nn.log_softmax(logits, axis=-1)
+        picked = jnp.take_along_axis(
+            ls, lab.astype(np.int32)[..., None], axis=-1)
+        return -jnp.sum(picked)
+
+    return apply_op(f, data, label, name="softmax_cross_entropy")
+
+
+_export(softmax_cross_entropy)
+
+
+# --- linear / conv ----------------------------------------------------------
+
+def fully_connected(data, weight, bias=None, num_hidden=None, no_bias=False,
+                    flatten=True, **kwargs):
+    """Reference ``FullyConnected``: y = x·Wᵀ + b, weight stored (out, in).
+    The MXU path: jnp.dot with fp32 accumulation for bf16 operands."""
+    def matmul(x, w):
+        pet = np.float32 if _accum(x) else None
+        y = lax.dot_general(x, w, (((x.ndim - 1,), (1,)), ((), ())),
+                            preferred_element_type=pet)
+        return y.astype(x.dtype) if pet else y
+
+    if flatten:
+        def f(x, w, *b):
+            x2 = x.reshape((x.shape[0], -1))
+            y = matmul(x2, w)
+            return y + b[0] if b else y
+    else:
+        def f(x, w, *b):
+            y = matmul(x, w)
+            return y + b[0] if b else y
+
+    args = (data, weight) if (no_bias or bias is None) else (data, weight, bias)
+    return apply_op(f, *args, name="fully_connected")
+
+
+_export(fully_connected, aliases=("FullyConnected",))
+
+
+def _tup(v, n, name):
+    if v is None:
+        return (1,) * n if name != "pad" else (0,) * n
+    if isinstance(v, int):
+        return (v,) * n
+    v = tuple(int(x) for x in v)
+    if len(v) != n:
+        raise MXNetError(f"{name} must have {n} elements, got {v}")
+    return v
+
+
+def convolution(data, weight, bias=None, kernel=None, stride=None, dilate=None,
+                pad=None, num_filter=None, num_group=1, no_bias=False,
+                layout=None, **kwargs):
+    """Reference ``Convolution`` (1D/2D/3D, NCHW-family layouts, grouped).
+
+    Weight layout follows the reference: (num_filter, C/group, *kernel).
+    """
+    nsp = len(kernel) if kernel is not None else data.ndim - 2
+    strides = _tup(stride, nsp, "stride")
+    dil = _tup(dilate, nsp, "dilate")
+    padding = [(p, p) for p in _tup(pad, nsp, "pad")]
+    spatial = "".join("DHW"[3 - nsp + i] for i in range(nsp))
+    dn = lax.conv_dimension_numbers(
+        data.shape, weight.shape,
+        ("NC" + spatial, "OI" + spatial, "NC" + spatial))
+
+    def f(x, w, *b):
+        pet = np.float32 if _accum(x) else None
+        y = lax.conv_general_dilated(
+            x, w, window_strides=strides, padding=padding, rhs_dilation=dil,
+            dimension_numbers=dn, feature_group_count=num_group,
+            preferred_element_type=pet)
+        if pet:
+            y = y.astype(x.dtype)
+        if b:
+            y = y + b[0].reshape((1, -1) + (1,) * nsp)
+        return y
+
+    args = (data, weight) if (no_bias or bias is None) else (data, weight, bias)
+    return apply_op(f, *args, name="convolution")
+
+
+_export(convolution, aliases=("Convolution",))
+
+
+def deconvolution(data, weight, bias=None, kernel=None, stride=None,
+                  dilate=None, pad=None, adj=None, num_filter=None,
+                  num_group=1, no_bias=True, layout=None, target_shape=None,
+                  **kwargs):
+    """Reference ``Deconvolution`` (transposed conv): implemented as the
+    gradient of convolution, matching the reference's cuDNN bwd-data path."""
+    nsp = len(kernel)
+    strides = _tup(stride, nsp, "stride")
+    dil = _tup(dilate, nsp, "dilate")
+    pads = _tup(pad, nsp, "pad")
+    adjs = _tup(adj, nsp, "adj") if adj is not None else (0,) * nsp
+    spatial = "".join("DHW"[3 - nsp + i] for i in range(nsp))
+
+    def f(x, w, *b):
+        # transposed conv = lhs-dilated conv with flipped kernel
+        pad_t = [(dil[i] * (kernel[i] - 1) - pads[i],
+                  dil[i] * (kernel[i] - 1) - pads[i] + adjs[i])
+                 for i in range(nsp)]
+        wt = jnp.swapaxes(w, 0, 1)  # (C_in, C_out/g, *k) -> OI for bwd
+        wt = jnp.flip(wt, axis=tuple(range(2, 2 + nsp)))
+        dn = lax.conv_dimension_numbers(
+            x.shape, wt.shape, ("NC" + spatial, "OI" + spatial,
+                                "NC" + spatial))
+        y = lax.conv_general_dilated(
+            x, wt, window_strides=(1,) * nsp, padding=pad_t,
+            lhs_dilation=strides, rhs_dilation=dil, dimension_numbers=dn,
+            feature_group_count=num_group)
+        if b:
+            y = y + b[0].reshape((1, -1) + (1,) * nsp)
+        return y
+
+    args = (data, weight) if (no_bias or bias is None) else (data, weight, bias)
+    return apply_op(f, *args, name="deconvolution")
+
+
+_export(deconvolution, aliases=("Deconvolution",))
+
+
+def pooling(data, kernel=None, pool_type="max", global_pool=False,
+            stride=None, pad=None, pooling_convention="valid",
+            count_include_pad=True, **kwargs):
+    """Reference ``Pooling`` (max/avg/sum/lp; NCHW-family)."""
+    nsp = data.ndim - 2
+    if global_pool:
+        def f(a):
+            ax = tuple(range(2, 2 + nsp))
+            if pool_type == "max":
+                r = jnp.max(a, axis=ax, keepdims=True)
+            elif pool_type == "sum":
+                r = jnp.sum(a, axis=ax, keepdims=True)
+            else:
+                r = jnp.mean(a, axis=ax, keepdims=True)
+            return r
+
+        return apply_op(f, data, name="global_pool")
+
+    k = _tup(kernel, nsp, "kernel")
+    s = _tup(stride, nsp, "stride")
+    p = _tup(pad, nsp, "pad")
+    window = (1, 1) + k
+    strides = (1, 1) + s
+    padding = ((0, 0), (0, 0)) + tuple((pp, pp) for pp in p)
+    if pooling_convention == "full":
+        # ceil semantics: pad the upper edge enough to cover the last window
+        extra = []
+        for i in range(nsp):
+            size = data.shape[2 + i] + 2 * p[i]
+            rem = (size - k[i]) % s[i]
+            extra.append(0 if rem == 0 else s[i] - rem)
+        padding = ((0, 0), (0, 0)) + tuple(
+            (pp, pp + e) for pp, e in zip(p, extra))
+
+    def f(a):
+        if pool_type == "max":
+            init = -jnp.inf if np.issubdtype(np.dtype(a.dtype), np.floating) \
+                else np.iinfo(a.dtype).min
+            return lax.reduce_window(a, init, lax.max, window, strides,
+                                     padding)
+        ssum = lax.reduce_window(a, 0.0, lax.add, window, strides, padding)
+        if pool_type == "sum":
+            return ssum
+        if count_include_pad:
+            return ssum / np.prod(k)
+        ones = jnp.ones(a.shape, a.dtype)
+        cnt = lax.reduce_window(ones, 0.0, lax.add, window, strides, padding)
+        return ssum / cnt
+
+    return apply_op(f, data, name="pooling")
+
+
+_export(pooling, aliases=("Pooling",))
+
+
+# --- normalization ----------------------------------------------------------
+
+def batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-5,
+               momentum=0.9, fix_gamma=False, use_global_stats=False,
+               output_mean_var=False, axis=1, **kwargs):
+    """Reference ``BatchNorm`` (src/operator/nn/batch_norm.cc:?).
+
+    Returns (out, new_moving_mean, new_moving_var); the gluon layer commits
+    the aux updates (mirroring the reference mutating aux states in the op).
+    Statistics are computed in float32 even for bf16 activations.
+    """
+    from .. import autograd as ag
+
+    training = ag.is_training() and not use_global_stats
+
+    def f(x, g, b, mmean, mvar):
+        red = tuple(i for i in range(x.ndim) if i != axis)
+        shape = [1] * x.ndim
+        shape[axis] = x.shape[axis]
+        g_ = jnp.ones_like(g) if fix_gamma else g
+        xf = x.astype(np.float32)
+        if training:
+            mean = jnp.mean(xf, axis=red)
+            var = jnp.var(xf, axis=red)
+            new_mmean = momentum * mmean + (1 - momentum) * mean
+            new_mvar = momentum * mvar + (1 - momentum) * var
+        else:
+            mean, var = mmean, mvar
+            new_mmean, new_mvar = mmean, mvar
+        inv = lax.rsqrt(var + eps)
+        y = (xf - mean.reshape(shape)) * inv.reshape(shape)
+        y = y * g_.reshape(shape) + b.reshape(shape)
+        return (y.astype(x.dtype), lax.stop_gradient(new_mmean),
+                lax.stop_gradient(new_mvar))
+
+    return apply_op(f, data, gamma, beta, moving_mean, moving_var,
+                    name="batch_norm")
+
+
+_export(batch_norm, aliases=("BatchNorm",))
+
+
+def layer_norm(data, gamma, beta, axis=-1, eps=1e-5, **kwargs):
+    """Reference ``LayerNorm`` (src/operator/nn/layer_norm.cc:?)."""
+    def f(x, g, b):
+        xf = x.astype(np.float32)
+        mean = jnp.mean(xf, axis=axis, keepdims=True)
+        var = jnp.var(xf, axis=axis, keepdims=True)
+        y = (xf - mean) * lax.rsqrt(var + eps)
+        shape = [1] * x.ndim
+        ax = axis % x.ndim
+        shape[ax] = x.shape[ax]
+        return (y * g.reshape(shape) + b.reshape(shape)).astype(x.dtype)
+
+    return apply_op(f, data, gamma, beta, name="layer_norm")
+
+
+_export(layer_norm, aliases=("LayerNorm",))
+
+
+def group_norm(data, gamma, beta, num_groups=1, eps=1e-5, **kwargs):
+    def f(x, g, b):
+        n, c = x.shape[0], x.shape[1]
+        xr = x.reshape((n, num_groups, c // num_groups) + x.shape[2:])
+        xf = xr.astype(np.float32)
+        red = tuple(range(2, xf.ndim))
+        mean = jnp.mean(xf, axis=red, keepdims=True)
+        var = jnp.var(xf, axis=red, keepdims=True)
+        y = ((xf - mean) * lax.rsqrt(var + eps)).reshape(x.shape)
+        shape = (1, c) + (1,) * (x.ndim - 2)
+        return (y * g.reshape(shape) + b.reshape(shape)).astype(x.dtype)
+
+    return apply_op(f, data, gamma, beta, name="group_norm")
+
+
+_export(group_norm, aliases=("GroupNorm",))
+
+
+def instance_norm(data, gamma, beta, eps=1e-5, **kwargs):
+    def f(x, g, b):
+        red = tuple(range(2, x.ndim))
+        xf = x.astype(np.float32)
+        mean = jnp.mean(xf, axis=red, keepdims=True)
+        var = jnp.var(xf, axis=red, keepdims=True)
+        y = (xf - mean) * lax.rsqrt(var + eps)
+        shape = (1, x.shape[1]) + (1,) * (x.ndim - 2)
+        return (y * g.reshape(shape) + b.reshape(shape)).astype(x.dtype)
+
+    return apply_op(f, data, gamma, beta, name="instance_norm")
+
+
+_export(instance_norm, aliases=("InstanceNorm",))
+
+
+def l2_normalization(data, eps=1e-10, mode="instance", **kwargs):
+    def f(x):
+        if mode == "instance":
+            red = tuple(range(1, x.ndim))
+        elif mode == "channel":
+            red = (1,)
+        else:  # spatial
+            red = tuple(range(2, x.ndim))
+        n = jnp.sqrt(jnp.sum(jnp.square(x), axis=red, keepdims=True) + eps)
+        return x / n
+
+    return apply_op(f, data, name="l2_normalization")
+
+
+_export(l2_normalization, aliases=("L2Normalization",))
+
+
+# --- dropout ----------------------------------------------------------------
+
+def dropout(data, p=0.5, mode="training", axes=(), **kwargs):
+    """Reference ``Dropout``: scales kept units by 1/(1-p) in training; the
+    RNG key comes from mxnet_tpu.random (traced under CachedOp)."""
+    from .. import autograd as ag
+    from .. import random as mxrand
+
+    training = ag.is_training() or mode == "always"
+    if not training or p <= 0:
+        return apply_op(lambda a: a, data, name="dropout_identity")
+    key = mxrand.next_key()
+
+    def f(a):
+        shape = a.shape
+        if axes:
+            shape = tuple(1 if i in axes else s for i, s in enumerate(shape))
+        keep = jax.random.bernoulli(key, 1.0 - p, shape)
+        return jnp.where(keep, a / (1.0 - p), jnp.zeros((), a.dtype))
+
+    return apply_op(f, data, name="dropout")
+
+
+_export(dropout, aliases=("Dropout",))
+
+
+# --- embedding --------------------------------------------------------------
+
+def embedding(data, weight, input_dim=None, output_dim=None, dtype=None,
+              sparse_grad=False, **kwargs):
+    """Reference ``Embedding`` (indexing_op.cc:?): weight rows gathered by
+    integer ids.  ``sparse_grad=True`` produces a row_sparse gradient in the
+    reference; here the dense vjp scatter-add is already efficient on TPU —
+    the sparse path is wired through mxnet_tpu/ndarray/sparse.py."""
+    def f(idx, w):
+        ii = jnp.clip(idx.astype(np.int32), 0, w.shape[0] - 1)
+        return jnp.take(w, ii, axis=0)
+
+    return apply_op(f, data, weight, name="embedding")
+
+
+_export(embedding, aliases=("Embedding",))

@@ -1,0 +1,136 @@
+"""Imperative op dispatch: the analog of ``Imperative::Invoke``.
+
+Reference call stack (SURVEY §3.1): python wrapper → ``MXImperativeInvokeEx``
+→ ``Imperative::Invoke`` (infer shape/type → alloc outputs → push FCompute to
+the dependency engine; returns to python immediately, engine worker threads
+execute async) — ``src/c_api/c_api_ndarray.cc:?``,
+``src/imperative/imperative.cc:?``, ``src/engine/threaded_engine.cc:?``.
+
+TPU-native redesign: jax dispatch IS the dependency engine — every jnp call
+is enqueued asynchronously on the device stream and jax tracks buffer
+dependencies, so the reference's read/write-var scheduling falls out for
+free.  ``apply_op`` therefore just:
+
+  1. unwraps NDArray operands to raw ``jax.Array``s,
+  2. runs the pure function (under ``jax.vjp`` if the autograd tape is
+     recording and any operand is attached to the graph),
+  3. wraps outputs back into NDArrays and wires tape nodes.
+
+Blocking happens only at ``wait_to_read``/``asnumpy`` — same contract as the
+reference engine's ``WaitForVar`` (``include/mxnet/engine.h:?``).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict
+
+from .. import autograd as ag
+
+# Global op registry: name -> python callable operating on NDArrays.
+# (Reference: nnvm's dmlc::Registry of Op objects; here ops are plain
+# functions and the registry exists for introspection, custom-op loading and
+# the symbol/json export path.)
+_OPS: Dict[str, Callable] = {}
+
+
+def defop(name: str = None, aliases=()):
+    """Decorator: register an NDArray-level op under ``name`` (+aliases)."""
+
+    def deco(fn):
+        opname = name or fn.__name__
+        _OPS[opname] = fn
+        for a in aliases:
+            _OPS[a] = fn
+        return fn
+
+    return deco
+
+
+def get_op(name: str):
+    return _OPS.get(name)
+
+
+def list_ops():
+    return sorted(_OPS)
+
+
+def _in_graph(x) -> bool:
+    return getattr(x, "_req_grad", False) or getattr(x, "_node", None) is not None
+
+
+def apply_op(fun: Callable, *nd_args, name: str = ""):
+    """Apply pure raw-array function ``fun`` to NDArray operands.
+
+    ``fun`` must be traceable jax code closed over any non-array attributes
+    (the analog of the reference's dmlc ``Parameter`` struct being bound at
+    op-construction time).  Returns NDArray or tuple of NDArrays.
+    """
+    import jax
+
+    from ..ndarray import NDArray
+
+    raws = [a._data for a in nd_args]
+    recording = ag.is_recording() and any(_in_graph(a) for a in nd_args)
+    if recording:
+        outs, vjp = jax.vjp(fun, *raws)
+    else:
+        outs = fun(*raws)
+    single = not isinstance(outs, (tuple, list))
+    outs_t = (outs,) if single else tuple(outs)
+    nd_outs = [NDArray(o) for o in outs_t]
+    if recording:
+        node = ag.Node(vjp, list(nd_args),
+                       [(o.shape, o.dtype) for o in outs_t], name=name,
+                       single=single)
+        for i, o in enumerate(nd_outs):
+            o._node = node
+            o._oidx = i
+    return nd_outs[0] if single else tuple(nd_outs)
+
+
+def wrap_raw(x):
+    """Wrap a raw array without tape wiring (for op-free paths)."""
+    from ..ndarray import NDArray
+
+    return NDArray(x)
+
+
+def commit_out(out, result):
+    """Honour an ``out=`` kwarg: rebind the handle AND carry the tape node so
+    the result stays attached to the autograd graph."""
+    if out is None:
+        return result
+    out._data = result._data
+    out._node = result._node
+    out._oidx = result._oidx
+    return out
+
+
+def accum_dtype(dt):
+    """fp32 accumulation dtype for reduced-precision matmul/reduce inputs
+    (the TPU analog of cuDNN's pseudo-fp16 math mode); None if the dtype
+    already accumulates natively."""
+    import numpy as np
+
+    return np.float32 if np.dtype(dt).name in ("bfloat16", "float16") else None
+
+
+def make_exporter(module):
+    """Create the per-opmodule ``_export`` helper: registers the op under its
+    name + aliases and exposes it as a module attribute (the analog of the
+    reference generating python wrappers from the C++ registry at import,
+    python/mxnet/ndarray/register.py:?)."""
+    module.__all__ = getattr(module, "__all__", [])
+
+    def _export(fn, name=None, aliases=()):
+        name = name or fn.__name__
+        fn.__name__ = name
+        _OPS[name] = fn
+        setattr(module, name, fn)
+        module.__all__.append(name)
+        for a in aliases:
+            _OPS[a] = fn
+            setattr(module, a, fn)
+            module.__all__.append(a)
+        return fn
+
+    return _export

@@ -1,0 +1,683 @@
+"""Tensor structure / reduction / indexing / linalg operators.
+
+Reference: ``src/operator/tensor/`` — ``matrix_op.cc:?`` (reshape/transpose/
+slice/concat/...), ``broadcast_reduce_op_value.cc:?`` (sum/mean/...),
+``indexing_op.cc:?`` (take/one_hot/gather_nd/scatter_nd/Embedding),
+``ordering_op.cc:?`` (topk/sort/argsort), ``dot.cc:?``, ``la_op.cc:?``.
+
+TPU-native: jnp/lax implementations; matmuls route to the MXU via
+``jnp.dot``/``lax.dot_general`` with float32 accumulation
+(``preferred_element_type``) so bf16 inputs keep fp32 accumulators, which is
+the TPU analog of the reference's pseudo-fp16 accumulation switches.
+"""
+from __future__ import annotations
+
+import builtins
+import sys
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import MXNetError
+from .registry import (apply_op, accum_dtype as _accum_dtype, commit_out,
+                       make_exporter)
+
+_this = sys.modules[__name__]
+_export = make_exporter(_this)
+
+
+# --- shape manipulation -----------------------------------------------------
+
+def reshape(data, shape=None, reverse=False, **kwargs):
+    """Reshape with MXNet's special codes (0 = keep dim, -1 = infer,
+    reference src/operator/tensor/matrix_op.cc:? ReshapeShape).  Codes
+    -2/-3/-4 are not yet supported (rarely used; raise clearly)."""
+    if shape is None:
+        raise MXNetError("reshape needs target shape")
+    in_shape = data.shape
+    out = []
+    for i, s in enumerate(shape):
+        if s == 0:
+            out.append(in_shape[i])
+        elif s in (-2, -3, -4):
+            raise NotImplementedError(
+                f"reshape special code {s} not yet supported")
+        else:
+            out.append(int(s))
+    tgt = tuple(out)
+    return apply_op(lambda a: jnp.reshape(a, tgt), data, name="reshape")
+
+
+_export(reshape, aliases=("Reshape",))
+
+
+def reshape_like(lhs, rhs, **kwargs):
+    tgt = rhs.shape
+    return apply_op(lambda a: jnp.reshape(a, tgt), lhs, name="reshape_like")
+
+
+_export(reshape_like)
+
+
+def flatten(data, **kwargs):
+    """Batch-flatten to 2D (reference ``Flatten``: keeps axis 0)."""
+    n = data.shape[0] if data.ndim > 0 else 1
+    return apply_op(lambda a: jnp.reshape(a, (n, -1)), data, name="flatten")
+
+
+_export(flatten, aliases=("Flatten",))
+
+
+def transpose(data, axes=None, **kwargs):
+    if axes is not None and len(axes) == 0:
+        axes = None
+    return apply_op(lambda a: jnp.transpose(a, axes), data, name="transpose")
+
+
+_export(transpose)
+
+
+def swapaxes(data, dim1=0, dim2=1, **kwargs):
+    return apply_op(lambda a: jnp.swapaxes(a, dim1, dim2), data,
+                    name="swapaxes")
+
+
+_export(swapaxes, aliases=("SwapAxis",))
+
+
+def expand_dims(data, axis, **kwargs):
+    return apply_op(lambda a: jnp.expand_dims(a, axis), data,
+                    name="expand_dims")
+
+
+_export(expand_dims)
+
+
+def squeeze(data, axis=None, **kwargs):
+    return apply_op(lambda a: jnp.squeeze(a, axis), data, name="squeeze")
+
+
+_export(squeeze)
+
+
+def broadcast_to(data, shape=None, **kwargs):
+    in_shape = data.shape
+    tgt = tuple(i if s == 0 else int(s) for i, s in zip(in_shape, shape)) \
+        if len(shape) == len(in_shape) else tuple(shape)
+    return apply_op(lambda a: jnp.broadcast_to(a, tgt), data,
+                    name="broadcast_to")
+
+
+_export(broadcast_to)
+
+
+def broadcast_like(lhs, rhs, **kwargs):
+    tgt = rhs.shape
+    return apply_op(lambda a: jnp.broadcast_to(a, tgt), lhs,
+                    name="broadcast_like")
+
+
+_export(broadcast_like)
+
+
+def broadcast_axis(data, axis=None, size=None, **kwargs):
+    axes = (axis,) if isinstance(axis, int) else tuple(axis)
+    sizes = (size,) if isinstance(size, int) else tuple(size)
+    tgt = list(data.shape)
+    for ax, s in zip(axes, sizes):
+        tgt[ax] = s
+    tgt = tuple(tgt)
+    return apply_op(lambda a: jnp.broadcast_to(a, tgt), data,
+                    name="broadcast_axis")
+
+
+_export(broadcast_axis, aliases=("broadcast_axes",))
+
+
+def tile(data, reps, **kwargs):
+    return apply_op(lambda a: jnp.tile(a, reps), data, name="tile")
+
+
+_export(tile)
+
+
+def repeat(data, repeats, axis=None, **kwargs):
+    return apply_op(lambda a: jnp.repeat(a, repeats, axis=axis), data,
+                    name="repeat")
+
+
+_export(repeat)
+
+
+def flip(data, axis, **kwargs):
+    return apply_op(lambda a: jnp.flip(a, axis), data, name="flip")
+
+
+_export(flip, aliases=("reverse",))
+
+
+def pad(data, mode="constant", pad_width=None, constant_value=0, **kwargs):
+    """Reference ``Pad`` op (4D/5D, pad_width as flat begin/end pairs)."""
+    pw = [(int(pad_width[2 * i]), int(pad_width[2 * i + 1]))
+          for i in range(len(pad_width) // 2)]
+    jmode = {"constant": "constant", "edge": "edge",
+             "reflect": "reflect"}[mode]
+    if jmode == "constant":
+        return apply_op(
+            lambda a: jnp.pad(a, pw, mode="constant",
+                              constant_values=constant_value),
+            data, name="pad")
+    return apply_op(lambda a: jnp.pad(a, pw, mode=jmode), data, name="pad")
+
+
+_export(pad, aliases=("Pad",))
+
+
+def concat(*args, dim=1, out=None, **kwargs):
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = tuple(args[0])
+    return commit_out(out, apply_op(
+        lambda *raws: jnp.concatenate(raws, axis=dim), *args, name="concat"))
+
+
+_export(concat, aliases=("Concat", "concatenate"))
+
+
+def stack(*args, axis=0, out=None, **kwargs):
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = tuple(args[0])
+    return commit_out(out, apply_op(
+        lambda *raws: jnp.stack(raws, axis=axis), *args, name="stack"))
+
+
+_export(stack)
+
+
+def split(data, num_outputs=None, axis=1, squeeze_axis=False, **kwargs):
+    n = int(num_outputs)
+
+    def f(a):
+        parts = jnp.split(a, n, axis=axis)
+        if squeeze_axis:
+            parts = [jnp.squeeze(p, axis=axis) for p in parts]
+        return tuple(parts)
+
+    outs = apply_op(f, data, name="split")
+    return list(outs) if isinstance(outs, tuple) else [outs]
+
+
+_export(split, aliases=("SliceChannel",))
+
+
+def slice(data, begin, end, step=None, **kwargs):  # noqa: A001
+    """Reference ``slice`` op: begin/end may contain None."""
+    nd = data.ndim
+    begin = tuple(begin) + (None,) * (nd - len(begin))
+    end = tuple(end) + (None,) * (nd - len(end))
+    step = tuple(step) + (None,) * (nd - len(step)) if step else (None,) * nd
+    key = tuple(builtins.slice(b, e, s) for b, e, s in zip(begin, end, step))
+    return apply_op(lambda a: a[key], data, name="slice")
+
+
+_export(slice, name="slice", aliases=("crop",))
+
+
+def slice_axis(data, axis=0, begin=0, end=None, **kwargs):
+    key = [builtins.slice(None)] * data.ndim
+    key[axis] = builtins.slice(begin, end)
+    key = tuple(key)
+    return apply_op(lambda a: a[key], data, name="slice_axis")
+
+
+_export(slice_axis)
+
+
+def slice_like(data, shape_like, axes=None, **kwargs):
+    tgt = shape_like.shape
+    key = [builtins.slice(None)] * data.ndim
+    axes = axes if axes is not None else range(min(data.ndim, len(tgt)))
+    for ax in axes:
+        key[ax] = builtins.slice(0, tgt[ax])
+    key = tuple(key)
+    return apply_op(lambda a: a[key], data, name="slice_like")
+
+
+_export(slice_like)
+
+
+def where(condition, x, y, **kwargs):
+    return apply_op(lambda c, a, b: jnp.where(c != 0, a, b), condition, x, y,
+                    name="where")
+
+
+_export(where)
+
+
+def clip(data, a_min=None, a_max=None, **kwargs):
+    return apply_op(lambda a: jnp.clip(a, a_min, a_max), data, name="clip")
+
+
+_export(clip)
+
+
+def cast(data, dtype, **kwargs):
+    from ..base import resolve_dtype
+
+    dt = resolve_dtype(dtype)
+    return apply_op(lambda a: a.astype(dt), data, name="cast")
+
+
+_export(cast, aliases=("Cast",))
+
+
+def diag(data, k=0, **kwargs):
+    return apply_op(lambda a: jnp.diag(a, k) if a.ndim <= 2
+                    else jnp.diagonal(a, k, -2, -1), data, name="diag")
+
+
+_export(diag)
+
+
+# --- reductions -------------------------------------------------------------
+
+def _norm_axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def _make_reduce(name, jf, aliases=()):
+    def fn(data, axis=None, keepdims=False, exclude=False, out=None, **kw):
+        ax = _norm_axis(axis)
+        if exclude and ax is not None:
+            axt = (ax,) if isinstance(ax, int) else ax
+            ax = tuple(i for i in range(data.ndim) if i not in axt)
+        return commit_out(out, apply_op(
+            lambda a: jf(a, axis=ax, keepdims=keepdims), data, name=name))
+
+    _export(fn, name=name, aliases=aliases)
+
+
+_make_reduce("sum", jnp.sum, aliases=("sum_axis",))
+_make_reduce("nansum", jnp.nansum)
+_make_reduce("mean", jnp.mean)
+_make_reduce("prod", jnp.prod)
+_make_reduce("nanprod", jnp.nanprod)
+_make_reduce("max", jnp.max, aliases=("max_axis",))
+_make_reduce("min", jnp.min, aliases=("min_axis",))
+
+
+def norm(data, ord=2, axis=None, keepdims=False, out=None, **kwargs):
+    ax = _norm_axis(axis)
+
+    def f(a):
+        acc = _accum_dtype(a.dtype)
+        af = a.astype(acc) if acc else a
+        if ord == 1:
+            r = jnp.sum(jnp.abs(af), axis=ax, keepdims=keepdims)
+        else:
+            r = jnp.sqrt(jnp.sum(jnp.square(af), axis=ax, keepdims=keepdims))
+        return r.astype(a.dtype) if acc else r
+
+    return commit_out(out, apply_op(f, data, name="norm"))
+
+
+_export(norm)
+
+
+def argmax(data, axis=None, keepdims=False, **kwargs):
+    return apply_op(
+        lambda a: jnp.argmax(a, axis=axis, keepdims=keepdims).astype(
+            np.float32), data, name="argmax")
+
+
+_export(argmax)
+
+
+def argmin(data, axis=None, keepdims=False, **kwargs):
+    return apply_op(
+        lambda a: jnp.argmin(a, axis=axis, keepdims=keepdims).astype(
+            np.float32), data, name="argmin")
+
+
+_export(argmin)
+
+
+def argsort(data, axis=-1, is_ascend=True, dtype=np.float32, **kwargs):
+    def f(a):
+        idx = jnp.argsort(a if is_ascend else -a, axis=axis)
+        return idx.astype(dtype)
+
+    return apply_op(f, data, name="argsort")
+
+
+_export(argsort)
+
+
+def sort(data, axis=-1, is_ascend=True, **kwargs):
+    def f(a):
+        s = jnp.sort(a, axis=axis)
+        return s if is_ascend else jnp.flip(s, axis=axis)
+
+    return apply_op(f, data, name="sort")
+
+
+_export(sort)
+
+
+def topk(data, axis=-1, k=1, ret_typ="indices", is_ascend=False,
+         dtype=np.float32, **kwargs):
+    """Reference ``topk`` (src/operator/tensor/ordering_op.cc:?)."""
+    def f(a):
+        am = jnp.moveaxis(a, axis, -1)
+        vals, idx = lax.top_k(jnp.negative(am) if is_ascend else am, k)
+        if is_ascend:
+            vals = -vals
+        vals = jnp.moveaxis(vals, -1, axis)
+        idx = jnp.moveaxis(idx, -1, axis)
+        if ret_typ == "value":
+            return vals
+        if ret_typ == "indices":
+            return idx.astype(dtype)
+        if ret_typ == "both":
+            return vals, idx.astype(dtype)
+        if ret_typ == "mask":
+            oh = jax.nn.one_hot(jnp.moveaxis(idx, axis, -1),
+                                a.shape[axis], dtype=a.dtype)
+            return jnp.moveaxis(oh.sum(-2), -1, axis)
+        raise MXNetError(f"unknown ret_typ {ret_typ}")
+
+    return apply_op(f, data, name="topk")
+
+
+_export(topk)
+
+
+def cumsum(data, axis=None, dtype=None, **kwargs):
+    return apply_op(lambda a: jnp.cumsum(a, axis=axis, dtype=dtype), data,
+                    name="cumsum")
+
+
+_export(cumsum)
+
+
+# --- indexing ---------------------------------------------------------------
+
+def take(a, indices, axis=0, mode="clip", **kwargs):
+    """Reference ``take`` (indexing_op.cc:?): gathers slices along axis.
+    mode: 'clip' (default) or 'wrap'."""
+    def f(arr, idx):
+        n = arr.shape[axis]
+        ii = idx.astype(np.int32)
+        if mode == "wrap":
+            ii = jnp.mod(ii, n)
+        else:
+            ii = jnp.clip(ii, 0, n - 1)
+        return jnp.take(arr, ii, axis=axis)
+
+    return apply_op(f, a, indices, name="take")
+
+
+_export(take)
+
+
+def pick(data, index, axis=-1, keepdims=False, mode="clip", **kwargs):
+    """Pick one element per row along axis using an index array
+    (reference ``pick``: the op SoftmaxCE losses are built from)."""
+    def f(a, idx):
+        n = a.shape[axis]
+        ii = jnp.clip(idx.astype(np.int32), 0, n - 1)
+        ii = jnp.expand_dims(ii, axis=axis)
+        out = jnp.take_along_axis(a, ii, axis=axis)
+        return out if keepdims else jnp.squeeze(out, axis=axis)
+
+    return apply_op(f, data, index, name="pick")
+
+
+_export(pick)
+
+
+def one_hot(indices, depth, on_value=1.0, off_value=0.0, dtype=np.float32,
+            **kwargs):
+    def f(idx):
+        oh = jax.nn.one_hot(idx.astype(np.int32), depth, dtype=np.dtype(dtype))
+        return oh * (on_value - off_value) + off_value
+
+    return apply_op(f, indices, name="one_hot")
+
+
+_export(one_hot)
+
+
+def gather_nd(data, indices, **kwargs):
+    """Reference ``gather_nd``: indices shape (M, ...) indexes the first M
+    dims of data."""
+    def f(a, idx):
+        idx = idx.astype(np.int32)
+        m = idx.shape[0]
+        return a[tuple(idx[i] for i in range(m))]
+
+    return apply_op(f, data, indices, name="gather_nd")
+
+
+_export(gather_nd)
+
+
+def scatter_nd(data, indices, shape, **kwargs):
+    tgt = tuple(shape)
+
+    def f(vals, idx):
+        idx = idx.astype(np.int32)
+        m = idx.shape[0]
+        z = jnp.zeros(tgt, vals.dtype)
+        return z.at[tuple(idx[i] for i in range(m))].add(vals)
+
+    return apply_op(f, data, indices, name="scatter_nd")
+
+
+_export(scatter_nd)
+
+
+def boolean_mask(data, index, axis=0, **kwargs):  # pragma: no cover
+    """Reference contrib ``boolean_mask``.  Dynamic output shape cannot live
+    under jit on TPU; eager-only (documented departure — SURVEY §7 hard
+    parts: dynamic shapes)."""
+    mask = np.asarray(index.asnumpy()).astype(bool)
+    key = [builtins.slice(None)] * data.ndim
+    key[axis] = np.nonzero(mask)[0]
+    return apply_op(lambda a: a[tuple(key)], data, name="boolean_mask")
+
+
+_export(boolean_mask)
+
+
+def shape_array(data, **kwargs):
+    from ..ndarray import NDArray
+
+    return NDArray(np.array(data.shape, dtype=np.int64))
+
+
+_export(shape_array)
+
+
+def size_array(data, **kwargs):
+    from ..ndarray import NDArray
+
+    return NDArray(np.array([data.size], dtype=np.int64))
+
+
+_export(size_array)
+
+
+# --- sequence ops (reference src/operator/sequence_*.cc:?) ------------------
+
+def sequence_mask(data, sequence_length=None, use_sequence_length=False,
+                  value=0.0, axis=0, **kwargs):
+    if not use_sequence_length or sequence_length is None:
+        return data
+
+    def f(a, sl):
+        T = a.shape[axis]
+        pos = jnp.arange(T)
+        pos = pos.reshape((-1, 1) if axis == 0 else (1, -1))
+        slb = sl.reshape((1, -1) if axis == 0 else (-1, 1))
+        mask = pos < slb  # (T, B) or (B, T)
+        mask = mask.reshape(mask.shape + (1,) * (a.ndim - 2))
+        return jnp.where(mask, a, jnp.asarray(value, a.dtype))
+
+    return apply_op(f, data, sequence_length, name="sequence_mask")
+
+
+_export(sequence_mask, aliases=("SequenceMask",))
+
+
+def sequence_last(data, sequence_length=None, use_sequence_length=False,
+                  axis=0, **kwargs):
+    if not use_sequence_length or sequence_length is None:
+        return slice_axis(data, axis=axis, begin=-1, end=None).squeeze(axis)
+
+    def f(a, sl):
+        idx = (sl.astype(np.int32) - 1)
+        am = jnp.moveaxis(a, axis, 0)  # (T, B, ...)
+        return jnp.take_along_axis(
+            am, idx.reshape((1, -1) + (1,) * (am.ndim - 2)), axis=0)[0]
+
+    return apply_op(f, data, sequence_length, name="sequence_last")
+
+
+_export(sequence_last, aliases=("SequenceLast",))
+
+
+def sequence_reverse(data, sequence_length=None, use_sequence_length=False,
+                     axis=0, **kwargs):
+    if not use_sequence_length or sequence_length is None:
+        return flip(data, axis=axis)
+
+    def f(a, sl):
+        T = a.shape[axis]
+        am = jnp.moveaxis(a, axis, 0)
+        pos = jnp.arange(T).reshape((-1, 1))
+        slb = sl.astype(np.int32).reshape((1, -1))
+        rev = jnp.where(pos < slb, slb - 1 - pos, pos)
+        out = jnp.take_along_axis(
+            am, rev.reshape(rev.shape + (1,) * (am.ndim - 2)), axis=0)
+        return jnp.moveaxis(out, 0, axis)
+
+    return apply_op(f, data, sequence_length, name="sequence_reverse")
+
+
+_export(sequence_reverse, aliases=("SequenceReverse",))
+
+
+# --- matmul family ----------------------------------------------------------
+
+def dot(lhs, rhs, transpose_a=False, transpose_b=False, **kwargs):
+    """Reference ``dot`` (src/operator/tensor/dot.cc:?): contracts the last
+    axis of lhs with the first axis of rhs (after optional transposes)."""
+    def f(a, b):
+        if transpose_a:
+            a = jnp.transpose(a)
+        if transpose_b:
+            b = jnp.transpose(b)
+        return jnp.tensordot(a, b, axes=1)
+
+    def f_acc(a, b):
+        if transpose_a:
+            a = jnp.transpose(a)
+        if transpose_b:
+            b = jnp.transpose(b)
+        return lax.dot_general(
+            a.reshape((-1, a.shape[-1])), b.reshape((b.shape[0], -1)),
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=np.float32).astype(a.dtype).reshape(
+                a.shape[:-1] + b.shape[1:])
+
+    use_acc = _accum_dtype(lhs.dtype) is not None
+    return apply_op(f_acc if use_acc else f, lhs, rhs, name="dot")
+
+
+_export(dot)
+
+
+def batch_dot(lhs, rhs, transpose_a=False, transpose_b=False, **kwargs):
+    """Reference ``batch_dot``: (B..., M, K) x (B..., K, N)."""
+    def f(a, b):
+        if transpose_a:
+            a = jnp.swapaxes(a, -1, -2)
+        if transpose_b:
+            b = jnp.swapaxes(b, -1, -2)
+        return jnp.matmul(a, b, preferred_element_type=np.float32).astype(
+            a.dtype) if np.dtype(a.dtype).name in ("bfloat16", "float16") \
+            else jnp.matmul(a, b)
+
+    return apply_op(f, lhs, rhs, name="batch_dot")
+
+
+_export(batch_dot)
+
+
+def linalg_gemm2(A, B, transpose_a=False, transpose_b=False, alpha=1.0,
+                 **kwargs):
+    """Reference linalg ``gemm2`` (src/operator/tensor/la_op.cc:?)."""
+    def f(a, b):
+        if transpose_a:
+            a = jnp.swapaxes(a, -1, -2)
+        if transpose_b:
+            b = jnp.swapaxes(b, -1, -2)
+        return alpha * jnp.matmul(a, b)
+
+    return apply_op(f, A, B, name="linalg_gemm2")
+
+
+_export(linalg_gemm2)
+
+
+def linalg_potrf(A, **kwargs):
+    return apply_op(lambda a: jnp.linalg.cholesky(a), A, name="linalg_potrf")
+
+
+_export(linalg_potrf)
+
+
+def linalg_trsm(A, B, transpose=False, rightside=False, lower=True,
+                alpha=1.0, **kwargs):
+    def f(a, b):
+        return alpha * jax.scipy.linalg.solve_triangular(
+            a, b, trans=1 if transpose else 0, lower=lower)
+
+    if rightside:
+        raise NotImplementedError("rightside trsm lands with the full linalg "
+                                  "family in a later round")
+    return apply_op(f, A, B, name="linalg_trsm")
+
+
+_export(linalg_trsm)
+
+
+def linalg_syrk(A, transpose=False, alpha=1.0, **kwargs):
+    def f(a):
+        at = jnp.swapaxes(a, -1, -2)
+        return alpha * (jnp.matmul(at, a) if transpose else jnp.matmul(a, at))
+
+    return apply_op(f, A, name="linalg_syrk")
+
+
+_export(linalg_syrk)
+
+
+def smooth_l1(data, scalar=1.0, **kwargs):
+    s2 = float(scalar) ** 2
+
+    def f(a):
+        aa = jnp.abs(a)
+        return jnp.where(aa < 1.0 / s2, 0.5 * s2 * jnp.square(a),
+                         aa - 0.5 / s2)
+
+    return apply_op(f, data, name="smooth_l1")
+
+
+_export(smooth_l1)

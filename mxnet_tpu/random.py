@@ -1,0 +1,214 @@
+"""Random number handling.
+
+Reference: ``src/resource.cc:?`` — ops request RNG state via
+``ResourceRequest::kRandom/kParallelRandom``; python seeds it through
+``mx.random.seed`` (python/mxnet/random.py:?).
+
+TPU-native redesign: jax PRNG keys.  A process-global key plays the role of
+the reference's per-device random resource; every sampling call splits it.
+Inside a CachedOp trace (hybridized block) keys must be *traced values*, not
+Python-time constants — otherwise every call of the compiled graph would
+replay the same dropout mask.  So sampling goes through ``next_key()``, which
+consults a provider stack: the CachedOp installs a counter-based provider
+folding indices into a base key that is an argument of the jitted function
+(fresh per call), giving a deterministic number of splits per trace.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import List
+
+import numpy as np
+
+
+class _KeyProvider:
+    def __init__(self, base_key):
+        self.base = base_key
+        self.n = 0
+
+    def next(self):
+        import jax
+
+        k = jax.random.fold_in(self.base, self.n)
+        self.n += 1
+        return k
+
+
+class _RandState(threading.local):
+    def __init__(self):
+        self.key = None
+        self.providers: List[_KeyProvider] = []
+
+
+_STATE = _RandState()
+
+
+def _global_key():
+    import jax
+
+    if _STATE.key is None:
+        _STATE.key = jax.random.PRNGKey(
+            int(os.environ.get("MXNET_SEED", np.random.randint(0, 2**31))))
+    return _STATE.key
+
+
+def seed(seed_state: int, ctx="all"):
+    """Reference: ``mx.random.seed`` — also reseeds numpy-side shuffling."""
+    import jax
+
+    _STATE.key = jax.random.PRNGKey(int(seed_state))
+    np.random.seed(int(seed_state) % (2**32))
+
+
+def next_key():
+    import jax
+
+    if _STATE.providers:
+        return _STATE.providers[-1].next()
+    key, sub = jax.random.split(_global_key())
+    _STATE.key = key
+    return sub
+
+
+class key_provider:
+    """Install a counter-based key provider (used by CachedOp tracing)."""
+
+    def __init__(self, base_key):
+        self._p = _KeyProvider(base_key)
+
+    def __enter__(self):
+        _STATE.providers.append(self._p)
+        return self._p
+
+    def __exit__(self, *exc):
+        _STATE.providers.pop()
+
+
+# --- sampling ops (reference src/operator/random/sample_op.cc:?) ------------
+
+def _sample(fn, shape, dtype, ctx):
+    from .ndarray import NDArray
+
+    shape = (shape,) if isinstance(shape, int) else tuple(shape or ())
+    raw = fn(next_key(), shape, np.dtype(dtype or np.float32))
+    out = NDArray(raw, ctx=ctx)
+    return out
+
+
+def uniform(low=0.0, high=1.0, shape=(1,), dtype=None, ctx=None, out=None,
+            **kwargs):
+    import jax
+
+    def f(k, s, dt):
+        return jax.random.uniform(k, s, dt, minval=low, maxval=high)
+
+    r = _sample(f, shape, dtype, ctx)
+    if out is not None:
+        out._data = r._data
+        return out
+    return r
+
+
+def normal(loc=0.0, scale=1.0, shape=(1,), dtype=None, ctx=None, out=None,
+           **kwargs):
+    import jax
+
+    def f(k, s, dt):
+        return loc + scale * jax.random.normal(k, s, dt)
+
+    r = _sample(f, shape, dtype, ctx)
+    if out is not None:
+        out._data = r._data
+        return out
+    return r
+
+
+randn = normal
+
+
+def randint(low, high, shape=(1,), dtype=None, ctx=None, out=None, **kwargs):
+    import jax
+
+    def f(k, s, dt):
+        return jax.random.randint(k, s, low, high,
+                                  np.dtype(dtype or np.int32))
+
+    r = _sample(f, shape, dtype or np.int32, ctx)
+    if out is not None:
+        out._data = r._data
+        return out
+    return r
+
+
+def exponential(scale=1.0, shape=(1,), dtype=None, ctx=None, out=None,
+                **kwargs):
+    import jax
+
+    def f(k, s, dt):
+        return scale * jax.random.exponential(k, s, dt)
+
+    return _sample(f, shape, dtype, ctx)
+
+
+def gamma(alpha=1.0, beta=1.0, shape=(1,), dtype=None, ctx=None, out=None,
+          **kwargs):
+    import jax
+
+    def f(k, s, dt):
+        return beta * jax.random.gamma(k, alpha, s, dt)
+
+    return _sample(f, shape, dtype, ctx)
+
+
+def poisson(lam=1.0, shape=(1,), dtype=None, ctx=None, out=None, **kwargs):
+    import jax
+
+    def f(k, s, dt):
+        return jax.random.poisson(k, lam, s).astype(dt)
+
+    return _sample(f, shape, dtype, ctx)
+
+
+def multinomial(data, shape=1, get_prob=False, dtype=np.int32, **kwargs):
+    """Sample category indices from probability rows (reference
+    ``sample_multinomial``)."""
+    import jax
+    from .ndarray import NDArray
+
+    n = shape if isinstance(shape, int) else int(np.prod(shape))
+    logits = np.log(np.clip(data.asnumpy(), 1e-30, None))
+    k = next_key()
+    idx = jax.random.categorical(k, logits, axis=-1,
+                                 shape=(n,) + logits.shape[:-1])
+    idx = np.moveaxis(np.asarray(idx), 0, -1)
+    if n == 1:
+        idx = idx[..., 0]
+    out = NDArray(idx.astype(dtype))
+    if get_prob:
+        from . import ndarray as nd
+
+        return out, nd.log(nd.pick(data, out.astype(np.float32), axis=-1))
+    return out
+
+
+sample_multinomial = multinomial
+
+
+def shuffle(data, **kwargs):
+    import jax
+
+    from .ops.registry import apply_op
+
+    k = next_key()
+    return apply_op(lambda a: jax.random.permutation(k, a, axis=0), data,
+                    name="shuffle")
+
+
+def bernoulli(prob=0.5, shape=(1,), dtype=None, ctx=None, **kwargs):
+    import jax
+
+    def f(k, s, dt):
+        return jax.random.bernoulli(k, prob, s).astype(dt)
+
+    return _sample(f, shape, dtype, ctx)

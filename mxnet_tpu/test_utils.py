@@ -1,0 +1,103 @@
+"""Test utilities.
+
+Reference: ``python/mxnet/test_utils.py:?`` — the reference's single most
+important correctness gate is ``check_numeric_gradient`` (finite differences
+vs the registered FGradient); plus dtype-aware ``assert_almost_equal`` and
+random array generators.  Reproduced here against the tape/vjp gradients.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .ndarray import NDArray
+from . import ndarray as nd
+from . import autograd
+
+
+def default_context():
+    from .context import current_context
+
+    return current_context()
+
+
+def assert_almost_equal(a, b, rtol=None, atol=None, names=("a", "b")):
+    a = a.asnumpy() if isinstance(a, NDArray) else np.asarray(a)
+    b = b.asnumpy() if isinstance(b, NDArray) else np.asarray(b)
+    dt = np.result_type(a.dtype, b.dtype)
+    if rtol is None:
+        rtol = {np.dtype(np.float64): 1e-7, np.dtype(np.float32): 1e-4,
+                np.dtype(np.float16): 1e-2}.get(np.dtype(dt), 1e-3)
+    if atol is None:
+        atol = {np.dtype(np.float64): 1e-9, np.dtype(np.float32): 1e-5,
+                np.dtype(np.float16): 1e-3}.get(np.dtype(dt), 1e-4)
+    np.testing.assert_allclose(a.astype(np.float64), b.astype(np.float64),
+                               rtol=rtol, atol=atol,
+                               err_msg=f"{names[0]} vs {names[1]}")
+
+
+def rand_ndarray(shape, dtype=np.float32, scale=1.0, ctx=None):
+    return nd.array(np.random.uniform(-scale, scale, size=shape)
+                    .astype(dtype), ctx=ctx)
+
+
+def rand_shape_2d(dim0=10, dim1=10):
+    return (np.random.randint(1, dim0 + 1), np.random.randint(1, dim1 + 1))
+
+
+def rand_shape_3d(dim0=10, dim1=10, dim2=10):
+    return (np.random.randint(1, dim0 + 1), np.random.randint(1, dim1 + 1),
+            np.random.randint(1, dim2 + 1))
+
+
+def check_numeric_gradient(fn, inputs, eps=1e-3, rtol=1e-2, atol=1e-3):
+    """Finite-difference check of tape gradients.
+
+    ``fn``: callable NDArray... -> scalar-able NDArray (summed internally).
+    ``inputs``: list of numpy arrays (float64 recommended for stability).
+
+    Reference technique: test_utils.check_numeric_gradient — central
+    differences against the autograd gradient of sum(fn).
+    """
+    inputs = [np.asarray(x, dtype=np.float64) for x in inputs]
+    nds = [nd.array(x, dtype=np.float64) for x in inputs]
+    for x in nds:
+        x.attach_grad()
+    with autograd.record():
+        loss = fn(*nds).sum()
+    loss.backward()
+    analytic = [x.grad.asnumpy() for x in nds]
+
+    def eval_at(vals):
+        with autograd.pause():
+            return float(
+                fn(*[nd.array(v, dtype=np.float64) for v in vals])
+                .sum().asscalar())
+
+    for i, base in enumerate(inputs):
+        num = np.zeros_like(base)
+        it = np.nditer(base, flags=["multi_index"])
+        for _ in it:
+            idx = it.multi_index
+            vp = [v.copy() for v in inputs]
+            vp[i][idx] += eps
+            vm = [v.copy() for v in inputs]
+            vm[i][idx] -= eps
+            num[idx] = (eval_at(vp) - eval_at(vm)) / (2 * eps)
+        np.testing.assert_allclose(analytic[i], num, rtol=rtol, atol=atol,
+                                   err_msg=f"gradient mismatch on input {i}")
+
+
+def check_consistency(fn, inputs, ctxs=None, rtol=1e-4, atol=1e-5):
+    """Run ``fn`` under each context and cross-check outputs (reference
+    ``check_consistency`` runs one symbol across [cpu, gpu, ...])."""
+    from .context import cpu
+
+    ctxs = ctxs or [cpu(0)]
+    outs = []
+    for ctx in ctxs:
+        with ctx:
+            nds = [nd.array(x, ctx=ctx) for x in inputs]
+            outs.append(fn(*nds).asnumpy())
+    for o in outs[1:]:
+        np.testing.assert_allclose(outs[0], o, rtol=rtol, atol=atol)
+    return outs[0]

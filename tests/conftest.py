@@ -1,0 +1,33 @@
+"""Test fixture: run the whole suite on a virtual 8-device CPU mesh.
+
+This is the TPU build's analog of the reference's import-under-new-context
+trick (tests/python/gpu/test_operator_gpu.py:? imports the unittest modules
+with ctx=gpu): XLA's CPU backend is the "fake device" the reference never
+had, and --xla_force_host_platform_device_count=8 gives every test a
+multi-device mesh without hardware.  Must run before jax initialises a
+backend; the axon sitecustomize pins JAX_PLATFORMS=axon so we override via
+jax.config, which takes effect because no backend has been created yet at
+conftest-import time.
+"""
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") +
+    " --xla_force_host_platform_device_count=8")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+# float64 needed by finite-difference gradient checks (CPU-only; the TPU
+# bench path stays in x32/bf16)
+jax.config.update("jax_enable_x64", True)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    import mxnet_tpu as mx
+
+    mx.random.seed(42)
+    yield

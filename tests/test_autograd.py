@@ -1,0 +1,181 @@
+"""Autograd tape tests (reference: tests/python/unittest/test_autograd.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_record_pause_nesting():
+    assert not autograd.is_recording()
+    with autograd.record():
+        assert autograd.is_recording() and autograd.is_training()
+        with autograd.pause():
+            assert not autograd.is_recording()
+        assert autograd.is_recording()
+    assert not autograd.is_recording()
+    with autograd.record(train_mode=False):
+        assert not autograd.is_training()
+    with autograd.train_mode():
+        assert autograd.is_training()
+
+
+def test_attach_grad_backward():
+    x = nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x + 2 * x
+    y.backward()
+    assert_almost_equal(x.grad, 2 * x.asnumpy() + 2)
+
+
+def test_head_grad():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 3
+    y.backward(nd.array([10.0, 20.0]))
+    assert_almost_equal(x.grad, np.array([30.0, 60.0]))
+
+
+def test_grad_req_add():
+    x = nd.array([1.0])
+    x.attach_grad(grad_req="add")
+    for _ in range(3):
+        with autograd.record():
+            y = x * 2
+        y.backward(retain_graph=False)
+    assert_almost_equal(x.grad, np.array([6.0]))
+
+
+def test_grad_req_write_overwrites():
+    x = nd.array([1.0])
+    x.attach_grad()
+    for _ in range(2):
+        with autograd.record():
+            y = x * 5
+        y.backward()
+    assert_almost_equal(x.grad, np.array([5.0]))
+
+
+def test_retain_graph():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+    y.backward(retain_graph=True)
+    assert_almost_equal(x.grad, np.array([4.0]))
+    y.backward()
+    assert_almost_equal(x.grad, np.array([4.0]))
+    with pytest.raises(MXNetError):
+        y.backward()  # graph freed now
+
+
+def test_detach_blocks_gradient():
+    x = nd.array([3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+        z = y.detach() * x
+    z.backward()
+    assert_almost_equal(x.grad, np.array([9.0]))  # only d(cx)/dx = c = x^2
+
+
+def test_stop_gradient_op():
+    x = nd.array([3.0])
+    x.attach_grad()
+    with autograd.record():
+        z = nd.BlockGrad(x * x) * x
+    z.backward()
+    assert_almost_equal(x.grad, np.array([9.0]))
+
+
+def test_multiple_heads_sum():
+    x = nd.array([1.0, 1.0])
+    x.attach_grad()
+    with autograd.record():
+        y1 = x * 2
+        y2 = x * 3
+    autograd.backward([y1, y2])
+    assert_almost_equal(x.grad, np.array([5.0, 5.0]))
+
+
+def test_shared_subexpression():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        h = x * x          # used twice
+        y = h * h          # y = x^4, dy/dx = 4x^3 = 32
+    y.backward()
+    assert_almost_equal(x.grad, np.array([32.0]))
+
+
+def test_grad_function():
+    x = nd.array([1.0, 2.0])
+    with autograd.record():
+        y = nd.exp(x.detach())  # not attached → no tape
+    assert y._node is None
+
+    x.attach_grad()
+    g = autograd.grad(
+        _rec(lambda: nd.exp(x)), [x], retain_graph=True)
+    assert_almost_equal(g[0], np.exp(x.asnumpy()))
+    # .grad untouched by autograd.grad
+    assert_almost_equal(x.grad, np.zeros(2))
+
+
+def _rec(fn):
+    with autograd.record():
+        return fn()
+
+
+def test_mark_variables():
+    x = nd.array([1.0])
+    g = nd.zeros((1,))
+    autograd.mark_variables([x], [g])
+    with autograd.record():
+        y = x * 7
+    y.backward()
+    assert_almost_equal(x.grad, np.array([7.0]))
+
+
+def test_custom_function():
+    class Sigmoid(autograd.Function):
+        def forward(self, x):
+            y = 1.0 / (1.0 + nd.exp(-x))
+            self.save_for_backward(y)
+            return y
+
+        def backward(self, dy):
+            (y,) = self.saved_tensors
+            return dy * y * (1 - y)
+
+    x = nd.array([0.0, 1.0])
+    x.attach_grad()
+    f = Sigmoid()
+    with autograd.record():
+        y = f(x)
+    y.backward()
+    sig = 1 / (1 + np.exp(-x.asnumpy()))
+    assert_almost_equal(x.grad, sig * (1 - sig))
+
+
+def test_training_flag_drives_dropout():
+    x = nd.ones((10, 10))
+    with autograd.record(train_mode=True):
+        y = nd.Dropout(x, p=0.5)
+    assert set(np.unique(y.asnumpy())).issubset({0.0, 2.0})
+    with autograd.record(train_mode=False):
+        y = nd.Dropout(x, p=0.5)
+    assert_almost_equal(y, x.asnumpy())
+
+
+def test_getitem_grad():
+    x = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    x.attach_grad()
+    with autograd.record():
+        y = x[0] * 2
+    y.backward()
+    assert_almost_equal(x.grad, np.array([[2.0, 2.0], [0.0, 0.0]]))

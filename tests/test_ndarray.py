@@ -1,0 +1,226 @@
+"""NDArray op tests (reference model: tests/python/unittest/test_ndarray.py
+and test_operator.py — numpy cross-checks + finite-difference gradients)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.test_utils import (assert_almost_equal,
+                                  check_numeric_gradient, rand_ndarray)
+
+
+def test_creation():
+    a = nd.zeros((2, 3))
+    assert a.shape == (2, 3) and a.dtype == np.float32
+    b = nd.ones((4,), dtype="int32")
+    assert b.dtype == np.int32
+    c = nd.array([[1, 2], [3, 4]])
+    assert c.dtype == np.float32  # python payloads default to f32
+    assert_almost_equal(c, np.array([[1, 2], [3, 4]]))
+    d = nd.full((2, 2), 7.0)
+    assert float(d[0, 0].asscalar()) == 7.0
+    e = nd.arange(0, 10, 2)
+    assert_almost_equal(e, np.arange(0, 10, 2, dtype=np.float32))
+
+
+def test_arithmetic_broadcast():
+    a = rand_ndarray((3, 4))
+    b = rand_ndarray((1, 4))
+    for op in ["+", "-", "*", "/"]:
+        got = eval(f"a {op} b").asnumpy()
+        want = eval(f"a.asnumpy() {op} b.asnumpy()")
+        assert_almost_equal(got, want)
+    assert_almost_equal((a + 1.5), a.asnumpy() + 1.5)
+    assert_almost_equal((2.0 - a), 2.0 - a.asnumpy())
+    assert_almost_equal((a ** 2), a.asnumpy() ** 2)
+
+
+def test_inplace():
+    a = nd.ones((2, 2))
+    a += 2
+    assert_almost_equal(a, np.full((2, 2), 3.0))
+    a *= 2
+    assert_almost_equal(a, np.full((2, 2), 6.0))
+    a[:] = 1.0
+    assert_almost_equal(a, np.ones((2, 2)))
+
+
+def test_indexing():
+    a = nd.array(np.arange(24).reshape(2, 3, 4))
+    assert_almost_equal(a[1], np.arange(24).reshape(2, 3, 4)[1])
+    assert_almost_equal(a[0, 1:3], np.arange(24).reshape(2, 3, 4)[0, 1:3])
+    a[0, 0, 0] = 100.0
+    assert a[0, 0, 0].asscalar() == 100.0
+    idx = nd.array([0, 1], dtype="int32")
+    taken = nd.take(a, idx, axis=0)
+    assert taken.shape == (2, 3, 4)
+
+
+def test_reshape_family():
+    a = nd.array(np.arange(24).reshape(2, 3, 4))
+    assert nd.reshape(a, shape=(0, -1)).shape == (2, 12)
+    assert a.reshape((4, 6)).shape == (4, 6)
+    assert nd.flatten(a).shape == (2, 12)
+    assert nd.transpose(a).shape == (4, 3, 2)
+    assert nd.expand_dims(a, axis=1).shape == (2, 1, 3, 4)
+    assert nd.squeeze(nd.expand_dims(a, axis=0), axis=0).shape == (2, 3, 4)
+    assert nd.swapaxes(a, 0, 2).shape == (4, 3, 2)
+    assert nd.tile(a, (2, 1, 1)).shape == (4, 3, 4)
+    assert nd.slice_axis(a, axis=2, begin=1, end=3).shape == (2, 3, 2)
+    assert nd.slice(a, begin=(0, 1), end=(2, 3)).shape == (2, 2, 4)
+
+
+def test_concat_stack_split():
+    a, b = nd.ones((2, 3)), nd.zeros((2, 3))
+    assert nd.concat(a, b, dim=0).shape == (4, 3)
+    assert nd.concat(a, b, dim=1).shape == (2, 6)
+    assert nd.stack(a, b, axis=0).shape == (2, 2, 3)
+    parts = nd.split(nd.ones((4, 6)), num_outputs=3, axis=1)
+    assert len(parts) == 3 and parts[0].shape == (4, 2)
+
+
+def test_reductions():
+    x = rand_ndarray((3, 4, 5))
+    xn = x.asnumpy()
+    assert_almost_equal(nd.sum(x), xn.sum())
+    assert_almost_equal(nd.sum(x, axis=1), xn.sum(1))
+    assert_almost_equal(nd.mean(x, axis=(0, 2)), xn.mean((0, 2)))
+    assert_almost_equal(nd.max(x, axis=1, keepdims=True),
+                        xn.max(1, keepdims=True))
+    assert_almost_equal(nd.norm(x), np.sqrt((xn ** 2).sum()))
+    assert_almost_equal(nd.sum(x, axis=1, exclude=True), xn.sum((0, 2)))
+
+
+def test_dot():
+    a = rand_ndarray((3, 4))
+    b = rand_ndarray((4, 5))
+    assert_almost_equal(nd.dot(a, b), a.asnumpy() @ b.asnumpy())
+    assert_almost_equal(nd.dot(a, b.T, transpose_b=True),
+                        a.asnumpy() @ b.asnumpy())
+    c = rand_ndarray((2, 3, 4))
+    d = rand_ndarray((2, 4, 5))
+    assert_almost_equal(nd.batch_dot(c, d),
+                        np.matmul(c.asnumpy(), d.asnumpy()))
+
+
+def test_ordering():
+    x = nd.array([[3.0, 1.0, 2.0], [0.5, 2.5, 1.5]])
+    assert_almost_equal(nd.sort(x, axis=1), np.sort(x.asnumpy(), 1))
+    assert_almost_equal(nd.argsort(x, axis=1),
+                        np.argsort(x.asnumpy(), 1).astype(np.float32))
+    v = nd.topk(x, k=2, ret_typ="value")
+    assert_almost_equal(v, np.array([[3.0, 2.0], [2.5, 1.5]]))
+    assert_almost_equal(nd.argmax(x, axis=1), np.array([0.0, 1.0]))
+
+
+def test_one_hot_pick_gather():
+    idx = nd.array([0, 2], dtype="int32")
+    oh = nd.one_hot(idx, 3)
+    assert_almost_equal(oh, np.eye(3, dtype=np.float32)[[0, 2]])
+    x = nd.array([[1.0, 2.0, 3.0], [4.0, 5.0, 6.0]])
+    assert_almost_equal(nd.pick(x, nd.array([1, 2])), np.array([2.0, 6.0]))
+    data = nd.array(np.arange(9).reshape(3, 3))
+    indices = nd.array([[0, 1], [1, 2]])
+    assert_almost_equal(nd.gather_nd(data, indices), np.array([1.0, 5.0]))
+
+
+def test_where_clip():
+    c = nd.array([1.0, 0.0, 1.0])
+    x, y = nd.ones((3,)), nd.zeros((3,))
+    assert_almost_equal(nd.where(c, x, y), np.array([1.0, 0.0, 1.0]))
+    assert_almost_equal(nd.clip(nd.array([-2.0, 0.5, 9.0]), 0.0, 1.0),
+                        np.array([0.0, 0.5, 1.0]))
+
+
+def test_unary_ops_numpy_parity():
+    x = rand_ndarray((3, 3), scale=0.9)
+    xn = x.asnumpy()
+    for name, ref in [("exp", np.exp), ("log1p", np.log1p),
+                      ("sqrt", lambda v: np.sqrt(np.abs(v))),
+                      ("abs", np.abs), ("tanh", np.tanh),
+                      ("floor", np.floor), ("ceil", np.ceil),
+                      ("square", np.square), ("sign", np.sign)]:
+        arg = nd.abs(x) if name == "sqrt" else x
+        argn = np.abs(xn) if name == "sqrt" else xn
+        assert_almost_equal(getattr(nd, name)(arg), ref(argn), names=(name,) * 2)
+
+
+def test_comparison_dtype():
+    a = nd.array([1.0, 2.0])
+    b = nd.array([2.0, 2.0])
+    eq = (a == b)
+    assert eq.dtype == np.float32  # MXNet returns 0/1 floats, not bools
+    assert_almost_equal(eq, np.array([0.0, 1.0]))
+
+
+def test_context_and_copy():
+    a = nd.ones((2, 2), ctx=mx.cpu())
+    assert a.context == mx.cpu(0)
+    b = a.copyto(nd.zeros((2, 2)))
+    assert_almost_equal(b, np.ones((2, 2)))
+    c = a.as_in_context(mx.cpu(0))
+    assert c.context == mx.cpu(0)
+    d = a.astype("float16")
+    assert d.dtype == np.float16
+
+
+def test_save_load(tmp_path):
+    f = str(tmp_path / "arrs.npz")
+    nd.save(f, {"w": nd.ones((2, 2)), "b": nd.zeros((3,))})
+    loaded = nd.load(f)
+    assert set(loaded) == {"w", "b"}
+    assert_almost_equal(loaded["w"], np.ones((2, 2)))
+
+
+def test_wait_and_async():
+    a = nd.ones((64, 64))
+    b = nd.dot(a, a)
+    b.wait_to_read()  # engine WaitForVar analog
+    nd.waitall()
+    assert_almost_equal(b[0, 0], np.array(64.0))
+
+
+def test_grad_elemwise():
+    check_numeric_gradient(lambda x: nd.tanh(x) * x,
+                           [np.random.rand(3, 3) - 0.5])
+
+
+def test_grad_dot():
+    check_numeric_gradient(
+        lambda a, b: nd.dot(a, b),
+        [np.random.rand(3, 4), np.random.rand(4, 2)])
+
+
+def test_grad_reduce_broadcast():
+    check_numeric_gradient(
+        lambda x: nd.sum(x * 2.0, axis=1) ** 2,
+        [np.random.rand(3, 4)])
+
+
+def test_grad_softmax():
+    w = nd.array(np.random.rand(2, 5), dtype=np.float64)
+    check_numeric_gradient(
+        lambda x: nd.softmax(x, axis=-1) * w,
+        [np.random.rand(2, 5)], rtol=2e-2, atol=2e-3)
+
+
+def test_sequence_mask():
+    x = nd.ones((4, 2, 3))
+    sl = nd.array([2, 4])
+    m = nd.SequenceMask(x, sl, use_sequence_length=True, value=0.0)
+    mn = m.asnumpy()
+    assert mn[:2, 0].sum() == 6.0 and mn[2:, 0].sum() == 0.0
+    assert mn[:, 1].sum() == 12.0
+
+
+def test_random_ops():
+    mx.random.seed(0)
+    u = mx.random.uniform(0, 1, shape=(100,))
+    assert 0.0 <= float(u.min().asscalar()) and float(u.max().asscalar()) <= 1.0
+    n1 = mx.random.normal(0, 1, shape=(5,)).asnumpy()
+    mx.random.seed(0)
+    _ = mx.random.uniform(0, 1, shape=(100,))
+    n2 = mx.random.normal(0, 1, shape=(5,)).asnumpy()
+    np.testing.assert_allclose(n1, n2)  # seeded reproducibility
+    r = mx.random.randint(0, 10, shape=(50,))
+    assert r.dtype == np.int32

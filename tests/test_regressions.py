@@ -1,0 +1,55 @@
+"""Regression tests for review findings (kept permanently, reference model:
+the reference's targeted regression tests inside test_operator.py)."""
+import math
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+
+
+def test_setitem_ndarray_integer_key():
+    # MXNet 1.x semantics: float/int NDArray keys index (take-style)
+    x = nd.array([1.0, 2.0, 3.0])
+    x[nd.array([2, 0], dtype="int32")] = 0
+    np.testing.assert_allclose(x.asnumpy(), [0.0, 2.0, 0.0])
+
+
+def test_setitem_bool_mask_key():
+    y = nd.array([1.0, 2.0, 3.0])
+    y[np.array([False, True, True])] = 9
+    np.testing.assert_allclose(y.asnumpy(), [1.0, 9.0, 9.0])
+
+
+def test_full_overwrite_retapes():
+    a = nd.array([1.0, 1.0])
+    a.attach_grad()
+    b = nd.array([5.0, 5.0])
+    b.attach_grad()
+    with autograd.record():
+        y = a * 2
+        y[:] = b
+        (y * 1).sum().backward()
+    np.testing.assert_allclose(a.grad.asnumpy(), [0.0, 0.0])
+    np.testing.assert_allclose(b.grad.asnumpy(), [1.0, 1.0])
+
+
+def test_float_index_from_argmax():
+    x = nd.array([3.0, 1.0, 2.0])
+    assert float(x[x.argmax()].asscalar()) == 3.0
+
+
+def test_out_kwarg_keeps_tape():
+    a = nd.array([1.0, 2.0])
+    a.attach_grad()
+    o = nd.zeros((2,))
+    with autograd.record():
+        y = nd.exp(a, out=o)
+        (y * 1).sum().backward()
+    np.testing.assert_allclose(a.grad.asnumpy(), np.exp([1.0, 2.0]),
+                               rtol=1e-5)
+
+
+def test_gamma_negative_sign():
+    g = float(nd.gamma(nd.array([-0.5], dtype=np.float64)).asscalar())
+    assert abs(g - math.gamma(-0.5)) < 1e-5
