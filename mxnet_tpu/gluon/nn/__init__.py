@@ -1,0 +1,10 @@
+"""gluon.nn — neural-network layers (reference:
+``python/mxnet/gluon/nn/__init__.py:?``)."""
+from .activations import *
+from .basic_layers import *
+from .conv_layers import *
+
+from . import activations, basic_layers, conv_layers
+
+__all__ = (activations.__all__ + basic_layers.__all__ +
+           conv_layers.__all__)

@@ -1,0 +1,388 @@
+"""Gluon basic layers.
+
+Reference: ``python/mxnet/gluon/nn/basic_layers.py:?`` — Sequential,
+Dense, Dropout, BatchNorm, Embedding, Flatten, LayerNorm, InstanceNorm,
+Lambda/HybridLambda.  Layer math dispatches to the op library
+(mxnet_tpu/ops/nn_ops.py), which lowers to MXU-friendly XLA ops.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ...base import MXNetError
+from ... import autograd
+from ..block import Block, HybridBlock
+
+__all__ = ["Sequential", "HybridSequential", "Dense", "Dropout", "BatchNorm",
+           "SyncBatchNorm", "Embedding", "Flatten", "LayerNorm",
+           "InstanceNorm", "GroupNorm", "Lambda", "HybridLambda",
+           "HybridConcatenate", "Identity"]
+
+
+class Sequential(Block):
+    """Stack of blocks executed sequentially (reference: ``nn.Sequential``)."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+
+    def add(self, *blocks):
+        for block in blocks:
+            self.register_child(block)
+
+    def forward(self, x, *args):
+        for block in self._children.values():
+            x = block(x)
+        return x
+
+    def __iter__(self):
+        return iter(self._children.values())
+
+    def __len__(self):
+        return len(self._children)
+
+    def __getitem__(self, key):
+        layers = list(self._children.values())
+        if isinstance(key, slice):
+            net = type(self)(prefix=self._prefix)
+            with net.name_scope():
+                net.add(*layers[key])
+            return net
+        return layers[key]
+
+
+class HybridSequential(HybridBlock):
+    """Hybridizable Sequential (reference: ``nn.HybridSequential``)."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+
+    def add(self, *blocks):
+        for block in blocks:
+            self.register_child(block)
+
+    def hybrid_forward(self, F, x):
+        for block in self._children.values():
+            x = block(x)
+        return x
+
+    def __iter__(self):
+        return iter(self._children.values())
+
+    def __len__(self):
+        return len(self._children)
+
+    def __getitem__(self, key):
+        layers = list(self._children.values())
+        if isinstance(key, slice):
+            net = type(self)(prefix=self._prefix)
+            with net.name_scope():
+                net.add(*layers[key])
+            return net
+        return layers[key]
+
+
+class Dense(HybridBlock):
+    """Fully-connected layer, weight stored (units, in_units) as the
+    reference does (``nn.Dense`` → FullyConnected op)."""
+
+    def __init__(self, units, activation=None, use_bias=True, flatten=True,
+                 dtype=np.float32, weight_initializer=None,
+                 bias_initializer="zeros", in_units=0, prefix=None,
+                 params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._units = units
+        self._in_units = in_units
+        self._flatten = flatten
+        with self.name_scope():
+            self.weight = self.params.get(
+                "weight", shape=(units, in_units), dtype=dtype,
+                init=weight_initializer, allow_deferred_init=True)
+            if use_bias:
+                self.bias = self.params.get(
+                    "bias", shape=(units,), dtype=dtype,
+                    init=bias_initializer, allow_deferred_init=True)
+            else:
+                self.bias = None
+            if activation is not None:
+                self.act = Activation(activation, prefix=activation + "_")
+            else:
+                self.act = None
+
+    def infer_shape(self, x):
+        in_units = int(np.prod(x.shape[1:])) if self._flatten \
+            else int(x.shape[-1])
+        self.weight._finish_deferred_init((self._units, in_units))
+        if self.bias is not None:
+            self.bias._finish_deferred_init((self._units,))
+
+    def hybrid_forward(self, F, x, weight, bias=None):
+        out = F.fully_connected(x, weight, bias, num_hidden=self._units,
+                                no_bias=bias is None, flatten=self._flatten)
+        if self.act is not None:
+            out = self.act(out)
+        return out
+
+
+class Dropout(HybridBlock):
+    def __init__(self, rate, axes=(), prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._rate = rate
+        self._axes = axes
+
+    def hybrid_forward(self, F, x):
+        if self._rate > 0:
+            return F.dropout(x, p=self._rate, axes=self._axes)
+        return F.identity(x)
+
+
+class BatchNorm(HybridBlock):
+    """Batch normalization with moving-average aux state (reference:
+    ``nn.BatchNorm`` → BatchNorm op, src/operator/nn/batch_norm.cc:?).
+
+    The op returns updated moving stats; the layer commits them into the aux
+    parameters — the handle-rebind analog of the reference op mutating aux
+    NDArrays in place.  Under a hybridized trace the commit is detected by
+    CachedOp and threaded through the jit as an extra output."""
+
+    def __init__(self, axis=1, momentum=0.9, epsilon=1e-5, center=True,
+                 scale=True, use_global_stats=False,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 running_mean_initializer="zeros",
+                 running_variance_initializer="ones", in_channels=0,
+                 prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._axis = axis
+        self._momentum = momentum
+        self._epsilon = epsilon
+        self._center = center
+        self._scale = scale
+        self._use_global_stats = use_global_stats
+        self._in_channels = in_channels
+        with self.name_scope():
+            self.gamma = self.params.get(
+                "gamma", shape=(in_channels,),
+                init=gamma_initializer, allow_deferred_init=True,
+                differentiable=scale)
+            self.beta = self.params.get(
+                "beta", shape=(in_channels,),
+                init=beta_initializer, allow_deferred_init=True,
+                differentiable=center)
+            self.running_mean = self.params.get(
+                "running_mean", shape=(in_channels,), grad_req="null",
+                init=running_mean_initializer, allow_deferred_init=True,
+                differentiable=False)
+            self.running_var = self.params.get(
+                "running_var", shape=(in_channels,), grad_req="null",
+                init=running_variance_initializer, allow_deferred_init=True,
+                differentiable=False)
+
+    def infer_shape(self, x):
+        c = int(x.shape[self._axis])
+        for p in (self.gamma, self.beta, self.running_mean,
+                  self.running_var):
+            p._finish_deferred_init((c,))
+
+    def cast(self, dtype):
+        if np.dtype(dtype).name in ("float16", "bfloat16"):
+            dtype = np.float32  # norm stats stay fp32 (reference behaviour)
+        super().cast(dtype)
+
+    def hybrid_forward(self, F, x, gamma, beta, running_mean, running_var):
+        y, new_mean, new_var = F.batch_norm(
+            x, gamma, beta, running_mean, running_var,
+            eps=self._epsilon, momentum=self._momentum,
+            fix_gamma=not self._scale,
+            use_global_stats=self._use_global_stats, axis=self._axis)
+        if autograd.is_training() and not self._use_global_stats:
+            running_mean._data = new_mean._data
+            running_var._data = new_var._data
+        return y
+
+
+class SyncBatchNorm(BatchNorm):
+    """Cross-device BatchNorm (reference: ``contrib.nn.SyncBatchNorm``,
+    src/operator/contrib/sync_batch_norm.cc:?).
+
+    TPU-native: under pjit/shard_map the batch axis is sharded and XLA's
+    batch-norm statistics become per-shard; the parallel layer runs the whole
+    step inside one jit where means/vars are psum-reduced over the data-axis
+    mesh by the `sync_batch_norm` op.  Single-process semantics equal
+    BatchNorm."""
+
+    def __init__(self, in_channels=0, num_devices=None, momentum=0.9,
+                 epsilon=1e-5, center=True, scale=True,
+                 use_global_stats=False, beta_initializer="zeros",
+                 gamma_initializer="ones",
+                 running_mean_initializer="zeros",
+                 running_variance_initializer="ones", prefix=None,
+                 params=None, **kwargs):
+        super().__init__(
+            axis=1, momentum=momentum, epsilon=epsilon, center=center,
+            scale=scale, use_global_stats=use_global_stats,
+            beta_initializer=beta_initializer,
+            gamma_initializer=gamma_initializer,
+            running_mean_initializer=running_mean_initializer,
+            running_variance_initializer=running_variance_initializer,
+            in_channels=in_channels, prefix=prefix, params=params)
+
+
+class Embedding(HybridBlock):
+    def __init__(self, input_dim, output_dim, dtype=np.float32,
+                 weight_initializer=None, sparse_grad=False, prefix=None,
+                 params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._input_dim = input_dim
+        self._output_dim = output_dim
+        self._sparse_grad = sparse_grad
+        grad_stype = "row_sparse" if sparse_grad else "default"
+        with self.name_scope():
+            self.weight = self.params.get(
+                "weight", shape=(input_dim, output_dim), dtype=dtype,
+                init=weight_initializer, grad_stype=grad_stype)
+
+    def hybrid_forward(self, F, x, weight):
+        return F.embedding(x, weight, input_dim=self._input_dim,
+                           output_dim=self._output_dim,
+                           sparse_grad=self._sparse_grad)
+
+
+class Flatten(HybridBlock):
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+
+    def hybrid_forward(self, F, x):
+        return F.flatten(x)
+
+
+class LayerNorm(HybridBlock):
+    def __init__(self, axis=-1, epsilon=1e-5, center=True, scale=True,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 in_channels=0, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._axis = axis
+        self._epsilon = epsilon
+        with self.name_scope():
+            self.gamma = self.params.get(
+                "gamma", shape=(in_channels,), init=gamma_initializer,
+                allow_deferred_init=True, differentiable=scale)
+            self.beta = self.params.get(
+                "beta", shape=(in_channels,), init=beta_initializer,
+                allow_deferred_init=True, differentiable=center)
+
+    def infer_shape(self, x):
+        c = int(x.shape[self._axis])
+        self.gamma._finish_deferred_init((c,))
+        self.beta._finish_deferred_init((c,))
+
+    def hybrid_forward(self, F, x, gamma, beta):
+        return F.layer_norm(x, gamma, beta, axis=self._axis,
+                            eps=self._epsilon)
+
+
+class InstanceNorm(HybridBlock):
+    def __init__(self, axis=1, epsilon=1e-5, center=True, scale=False,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 in_channels=0, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._epsilon = epsilon
+        with self.name_scope():
+            self.gamma = self.params.get(
+                "gamma", shape=(in_channels,), init=gamma_initializer,
+                allow_deferred_init=True, differentiable=scale)
+            self.beta = self.params.get(
+                "beta", shape=(in_channels,), init=beta_initializer,
+                allow_deferred_init=True, differentiable=center)
+
+    def infer_shape(self, x):
+        c = int(x.shape[1])
+        self.gamma._finish_deferred_init((c,))
+        self.beta._finish_deferred_init((c,))
+
+    def hybrid_forward(self, F, x, gamma, beta):
+        return F.instance_norm(x, gamma, beta, eps=self._epsilon)
+
+
+class GroupNorm(HybridBlock):
+    def __init__(self, num_groups=1, epsilon=1e-5, center=True, scale=True,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 in_channels=0, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._num_groups = num_groups
+        self._epsilon = epsilon
+        with self.name_scope():
+            self.gamma = self.params.get(
+                "gamma", shape=(in_channels,), init=gamma_initializer,
+                allow_deferred_init=True, differentiable=scale)
+            self.beta = self.params.get(
+                "beta", shape=(in_channels,), init=beta_initializer,
+                allow_deferred_init=True, differentiable=center)
+
+    def infer_shape(self, x):
+        c = int(x.shape[1])
+        self.gamma._finish_deferred_init((c,))
+        self.beta._finish_deferred_init((c,))
+
+    def hybrid_forward(self, F, x, gamma, beta):
+        return F.group_norm(x, gamma, beta, num_groups=self._num_groups,
+                            eps=self._epsilon)
+
+
+class Lambda(Block):
+    """Wrap an arbitrary NDArray function as a Block (reference:
+    ``nn.Lambda``)."""
+
+    def __init__(self, function, prefix=None):
+        super().__init__(prefix=prefix)
+        if isinstance(function, str):
+            from ... import ndarray as nd
+
+            function = getattr(nd, function)
+        self._func = function
+
+    def forward(self, *args):
+        return self._func(*args)
+
+
+class HybridLambda(HybridBlock):
+    def __init__(self, function, prefix=None):
+        super().__init__(prefix=prefix)
+        if isinstance(function, str):
+            self._func_name = function
+            self._func = None
+        else:
+            self._func = function
+            self._func_name = getattr(function, "__name__", "lambda")
+
+    def hybrid_forward(self, F, *args):
+        fn = self._func or getattr(F, self._func_name)
+        return fn(*args)
+
+
+class HybridConcatenate(HybridBlock):
+    """Run children on the same input and concat outputs (reference:
+    ``contrib.nn.HybridConcurrent``)."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def add(self, *blocks):
+        for block in blocks:
+            self.register_child(block)
+
+    def hybrid_forward(self, F, x):
+        out = [block(x) for block in self._children.values()]
+        return F.concat(*out, dim=self.axis)
+
+
+class Identity(HybridBlock):
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+
+    def hybrid_forward(self, F, x):
+        return F.identity(x)
+
+
+# imported at tail to avoid a cycle (Activation lives with the other
+# activation layers)
+from .activations import Activation  # noqa: E402

@@ -1,0 +1,303 @@
+"""Convolution and pooling layers.
+
+Reference: ``python/mxnet/gluon/nn/conv_layers.py:?`` — _Conv base,
+Conv1D/2D/3D (+Transpose), Max/Avg/GlobalMax/GlobalAvg pools, ReflectionPad.
+Math lowers to ``lax.conv_general_dilated``/``lax.reduce_window`` so XLA
+tiles it onto the MXU (mxnet_tpu/ops/nn_ops.py).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ...base import MXNetError
+from ..block import HybridBlock
+from .activations import Activation
+
+__all__ = ["Conv1D", "Conv2D", "Conv3D", "Conv1DTranspose", "Conv2DTranspose",
+           "Conv3DTranspose", "MaxPool1D", "MaxPool2D", "MaxPool3D",
+           "AvgPool1D", "AvgPool2D", "AvgPool3D", "GlobalMaxPool1D",
+           "GlobalMaxPool2D", "GlobalMaxPool3D", "GlobalAvgPool1D",
+           "GlobalAvgPool2D", "GlobalAvgPool3D", "ReflectionPad2D"]
+
+
+def _ntuple(val, n):
+    if isinstance(val, (list, tuple)):
+        return tuple(int(v) for v in val)
+    return (int(val),) * n
+
+
+class _Conv(HybridBlock):
+    def __init__(self, channels, kernel_size, strides, padding, dilation,
+                 groups, layout, in_channels=0, activation=None,
+                 use_bias=True, weight_initializer=None,
+                 bias_initializer="zeros", transposed=False,
+                 output_padding=None, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        ndim = len(kernel_size)
+        if not layout.startswith("NC"):
+            raise MXNetError(
+                f"layout {layout!r}: this build keeps the reference's "
+                "channel-first layouts; XLA re-lays-out for TPU internally")
+        self._channels = channels
+        self._in_channels = in_channels
+        self._kernel = kernel_size
+        self._strides = strides
+        self._padding = padding
+        self._dilation = dilation
+        self._groups = groups
+        self._layout = layout
+        self._transposed = transposed
+        self._output_padding = output_padding
+        with self.name_scope():
+            if transposed:
+                wshape = (in_channels, channels // groups) + kernel_size
+                infer_axis = 0
+            else:
+                wshape = (channels, in_channels // groups if in_channels
+                          else 0) + kernel_size
+                infer_axis = 1
+            self._infer_axis = infer_axis
+            self.weight = self.params.get(
+                "weight", shape=wshape, init=weight_initializer,
+                allow_deferred_init=True)
+            if use_bias:
+                self.bias = self.params.get(
+                    "bias", shape=(channels,), init=bias_initializer,
+                    allow_deferred_init=True)
+            else:
+                self.bias = None
+            if activation is not None:
+                self.act = Activation(activation, prefix=activation + "_")
+            else:
+                self.act = None
+
+    def infer_shape(self, x):
+        c = int(x.shape[1])
+        self._in_channels = c
+        shape = list(self.weight.shape)
+        if self._transposed:
+            shape[0] = c
+        else:
+            shape[1] = c // self._groups
+        self.weight._finish_deferred_init(tuple(shape))
+        if self.bias is not None:
+            self.bias._finish_deferred_init((self._channels,))
+
+    def hybrid_forward(self, F, x, weight, bias=None):
+        if self._transposed:
+            out = F.deconvolution(
+                x, weight, bias, kernel=self._kernel, stride=self._strides,
+                dilate=self._dilation, pad=self._padding,
+                adj=self._output_padding, num_filter=self._channels,
+                num_group=self._groups, no_bias=bias is None)
+        else:
+            out = F.convolution(
+                x, weight, bias, kernel=self._kernel, stride=self._strides,
+                dilate=self._dilation, pad=self._padding,
+                num_filter=self._channels, num_group=self._groups,
+                no_bias=bias is None)
+        if self.act is not None:
+            out = self.act(out)
+        return out
+
+
+class Conv1D(_Conv):
+    def __init__(self, channels, kernel_size, strides=1, padding=0,
+                 dilation=1, groups=1, layout="NCW", activation=None,
+                 use_bias=True, weight_initializer=None,
+                 bias_initializer="zeros", in_channels=0, **kwargs):
+        super().__init__(channels, _ntuple(kernel_size, 1),
+                         _ntuple(strides, 1), _ntuple(padding, 1),
+                         _ntuple(dilation, 1), groups, layout, in_channels,
+                         activation, use_bias, weight_initializer,
+                         bias_initializer, **kwargs)
+
+
+class Conv2D(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1), padding=(0, 0),
+                 dilation=(1, 1), groups=1, layout="NCHW", activation=None,
+                 use_bias=True, weight_initializer=None,
+                 bias_initializer="zeros", in_channels=0, **kwargs):
+        super().__init__(channels, _ntuple(kernel_size, 2),
+                         _ntuple(strides, 2), _ntuple(padding, 2),
+                         _ntuple(dilation, 2), groups, layout, in_channels,
+                         activation, use_bias, weight_initializer,
+                         bias_initializer, **kwargs)
+
+
+class Conv3D(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1, 1),
+                 padding=(0, 0, 0), dilation=(1, 1, 1), groups=1,
+                 layout="NCDHW", activation=None, use_bias=True,
+                 weight_initializer=None, bias_initializer="zeros",
+                 in_channels=0, **kwargs):
+        super().__init__(channels, _ntuple(kernel_size, 3),
+                         _ntuple(strides, 3), _ntuple(padding, 3),
+                         _ntuple(dilation, 3), groups, layout, in_channels,
+                         activation, use_bias, weight_initializer,
+                         bias_initializer, **kwargs)
+
+
+class Conv1DTranspose(_Conv):
+    def __init__(self, channels, kernel_size, strides=1, padding=0,
+                 output_padding=0, dilation=1, groups=1, layout="NCW",
+                 activation=None, use_bias=True, weight_initializer=None,
+                 bias_initializer="zeros", in_channels=0, **kwargs):
+        super().__init__(channels, _ntuple(kernel_size, 1),
+                         _ntuple(strides, 1), _ntuple(padding, 1),
+                         _ntuple(dilation, 1), groups, layout, in_channels,
+                         activation, use_bias, weight_initializer,
+                         bias_initializer, transposed=True,
+                         output_padding=_ntuple(output_padding, 1), **kwargs)
+
+
+class Conv2DTranspose(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1), padding=(0, 0),
+                 output_padding=(0, 0), dilation=(1, 1), groups=1,
+                 layout="NCHW", activation=None, use_bias=True,
+                 weight_initializer=None, bias_initializer="zeros",
+                 in_channels=0, **kwargs):
+        super().__init__(channels, _ntuple(kernel_size, 2),
+                         _ntuple(strides, 2), _ntuple(padding, 2),
+                         _ntuple(dilation, 2), groups, layout, in_channels,
+                         activation, use_bias, weight_initializer,
+                         bias_initializer, transposed=True,
+                         output_padding=_ntuple(output_padding, 2), **kwargs)
+
+
+class Conv3DTranspose(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1, 1),
+                 padding=(0, 0, 0), output_padding=(0, 0, 0),
+                 dilation=(1, 1, 1), groups=1, layout="NCDHW",
+                 activation=None, use_bias=True, weight_initializer=None,
+                 bias_initializer="zeros", in_channels=0, **kwargs):
+        super().__init__(channels, _ntuple(kernel_size, 3),
+                         _ntuple(strides, 3), _ntuple(padding, 3),
+                         _ntuple(dilation, 3), groups, layout, in_channels,
+                         activation, use_bias, weight_initializer,
+                         bias_initializer, transposed=True,
+                         output_padding=_ntuple(output_padding, 3), **kwargs)
+
+
+class _Pooling(HybridBlock):
+    def __init__(self, pool_size, strides, padding, ceil_mode, global_pool,
+                 pool_type, count_include_pad=True, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._pool_size = pool_size
+        self._strides = strides if strides is not None else pool_size
+        self._padding = padding
+        self._ceil_mode = ceil_mode
+        self._global_pool = global_pool
+        self._pool_type = pool_type
+        self._count_include_pad = count_include_pad
+
+    def _alias(self):
+        return "pool"
+
+    def hybrid_forward(self, F, x):
+        return F.pooling(
+            x, kernel=self._pool_size, pool_type=self._pool_type,
+            global_pool=self._global_pool, stride=self._strides,
+            pad=self._padding,
+            pooling_convention="full" if self._ceil_mode else "valid",
+            count_include_pad=self._count_include_pad)
+
+
+class MaxPool1D(_Pooling):
+    def __init__(self, pool_size=2, strides=None, padding=0, layout="NCW",
+                 ceil_mode=False, **kwargs):
+        super().__init__(_ntuple(pool_size, 1),
+                         _ntuple(strides, 1) if strides is not None else None,
+                         _ntuple(padding, 1), ceil_mode, False, "max",
+                         **kwargs)
+
+
+class MaxPool2D(_Pooling):
+    def __init__(self, pool_size=(2, 2), strides=None, padding=0,
+                 layout="NCHW", ceil_mode=False, **kwargs):
+        super().__init__(_ntuple(pool_size, 2),
+                         _ntuple(strides, 2) if strides is not None else None,
+                         _ntuple(padding, 2), ceil_mode, False, "max",
+                         **kwargs)
+
+
+class MaxPool3D(_Pooling):
+    def __init__(self, pool_size=(2, 2, 2), strides=None, padding=0,
+                 layout="NCDHW", ceil_mode=False, **kwargs):
+        super().__init__(_ntuple(pool_size, 3),
+                         _ntuple(strides, 3) if strides is not None else None,
+                         _ntuple(padding, 3), ceil_mode, False, "max",
+                         **kwargs)
+
+
+class AvgPool1D(_Pooling):
+    def __init__(self, pool_size=2, strides=None, padding=0, layout="NCW",
+                 ceil_mode=False, count_include_pad=True, **kwargs):
+        super().__init__(_ntuple(pool_size, 1),
+                         _ntuple(strides, 1) if strides is not None else None,
+                         _ntuple(padding, 1), ceil_mode, False, "avg",
+                         count_include_pad, **kwargs)
+
+
+class AvgPool2D(_Pooling):
+    def __init__(self, pool_size=(2, 2), strides=None, padding=0,
+                 layout="NCHW", ceil_mode=False, count_include_pad=True,
+                 **kwargs):
+        super().__init__(_ntuple(pool_size, 2),
+                         _ntuple(strides, 2) if strides is not None else None,
+                         _ntuple(padding, 2), ceil_mode, False, "avg",
+                         count_include_pad, **kwargs)
+
+
+class AvgPool3D(_Pooling):
+    def __init__(self, pool_size=(2, 2, 2), strides=None, padding=0,
+                 layout="NCDHW", ceil_mode=False, count_include_pad=True,
+                 **kwargs):
+        super().__init__(_ntuple(pool_size, 3),
+                         _ntuple(strides, 3) if strides is not None else None,
+                         _ntuple(padding, 3), ceil_mode, False, "avg",
+                         count_include_pad, **kwargs)
+
+
+class GlobalMaxPool1D(_Pooling):
+    def __init__(self, layout="NCW", **kwargs):
+        super().__init__((1,), None, (0,), False, True, "max", **kwargs)
+
+
+class GlobalMaxPool2D(_Pooling):
+    def __init__(self, layout="NCHW", **kwargs):
+        super().__init__((1, 1), None, (0, 0), False, True, "max", **kwargs)
+
+
+class GlobalMaxPool3D(_Pooling):
+    def __init__(self, layout="NCDHW", **kwargs):
+        super().__init__((1, 1, 1), None, (0, 0, 0), False, True, "max",
+                         **kwargs)
+
+
+class GlobalAvgPool1D(_Pooling):
+    def __init__(self, layout="NCW", **kwargs):
+        super().__init__((1,), None, (0,), False, True, "avg", **kwargs)
+
+
+class GlobalAvgPool2D(_Pooling):
+    def __init__(self, layout="NCHW", **kwargs):
+        super().__init__((1, 1), None, (0, 0), False, True, "avg", **kwargs)
+
+
+class GlobalAvgPool3D(_Pooling):
+    def __init__(self, layout="NCDHW", **kwargs):
+        super().__init__((1, 1, 1), None, (0, 0, 0), False, True, "avg",
+                         **kwargs)
+
+
+class ReflectionPad2D(HybridBlock):
+    def __init__(self, padding=0, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._padding = _ntuple(padding, 2) if not isinstance(padding, int) \
+            else (padding,) * 2
+
+    def hybrid_forward(self, F, x):
+        ph, pw = self._padding
+        return F.pad(x, mode="reflect",
+                     pad_width=(0, 0, 0, 0, ph, ph, pw, pw))

@@ -1,0 +1,94 @@
+"""Gluon utilities.
+
+Reference: ``python/mxnet/gluon/utils.py:?`` — ``split_data``/
+``split_and_load`` (slice a batch across a ctx list for data parallelism),
+``clip_global_norm``, ``check_sha1``/``download`` (stubbed: no network).
+
+TPU-native: ``split_and_load`` with a ctx list produces *one sharded array*
+over the mesh data axis when the parallel layer is active (SURVEY §2.3 D1 —
+the jax.device_put-sharded analog of per-GPU slices); with plain contexts it
+returns per-ctx slices exactly like the reference.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..base import MXNetError
+from ..context import Context
+from ..ndarray import NDArray
+
+
+def split_data(data, num_slice, batch_axis=0, even_split=True):
+    """Reference: ``gluon.utils.split_data``."""
+    size = data.shape[batch_axis]
+    if even_split and size % num_slice != 0:
+        raise MXNetError(
+            f"batch size {size} not divisible by {num_slice} slices; set "
+            "even_split=False")
+    if num_slice == 1:
+        return [data]
+    step = size // num_slice
+    slices = []
+    for i in range(num_slice):
+        lo = i * step
+        hi = (i + 1) * step if i < num_slice - 1 else size
+        slices.append(data.slice_axis(batch_axis, lo, hi))
+    return slices
+
+
+def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
+    """Slice a batch across contexts (reference:
+    ``gluon.utils.split_and_load``)."""
+    if not isinstance(data, NDArray):
+        data = NDArray(np.asarray(data))
+    if isinstance(ctx_list, Context):
+        ctx_list = [ctx_list]
+    if len(ctx_list) == 1:
+        return [data.as_in_context(ctx_list[0])]
+    slices = split_data(data, len(ctx_list), batch_axis, even_split)
+    return [s.as_in_context(ctx) for s, ctx in zip(slices, ctx_list)]
+
+
+def clip_global_norm(arrays, max_norm, check_isfinite=True):
+    """Rescale arrays so the joint L2 norm ≤ max_norm (reference:
+    ``gluon.utils.clip_global_norm``)."""
+    import jax.numpy as jnp
+
+    if not arrays:
+        raise MXNetError("no arrays to clip")
+    total = jnp.sqrt(sum(jnp.sum(jnp.square(
+        a._data.astype(np.float32))) for a in arrays))
+    total_f = float(total) if check_isfinite else None
+    if check_isfinite and not np.isfinite(total_f):
+        import warnings
+
+        warnings.warn("nan or inf found in clip_global_norm")
+        return total_f
+    scale = jnp.minimum(max_norm / (total + 1e-12), 1.0)
+    for a in arrays:
+        a._data = (a._data * scale).astype(a.dtype)
+    return total_f if check_isfinite else NDArray(total)
+
+
+def check_sha1(filename, sha1_hash):
+    import hashlib
+
+    sha1 = hashlib.sha1()
+    with open(filename, "rb") as f:
+        while True:
+            data = f.read(1048576)
+            if not data:
+                break
+            sha1.update(data)
+    return sha1.hexdigest() == sha1_hash
+
+
+def download(url, path=None, overwrite=False, sha1_hash=None,
+             retries=5, verify_ssl=True):  # pragma: no cover
+    raise MXNetError(
+        "download() requires network access, which this environment does "
+        "not have; place files locally and pass their path instead")
+
+
+def shape_is_known(shape):
+    return shape is not None and all(s > 0 for s in shape)

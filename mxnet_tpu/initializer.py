@@ -1,0 +1,320 @@
+"""Weight initializers.
+
+Reference: ``python/mxnet/initializer.py:?`` — an ``Initializer`` registry
+(``@register``, ``create()``), pattern-dispatch on parameter names
+(``InitDesc``), and the standard family: Zero/One/Constant/Uniform/Normal/
+Orthogonal/Xavier/MSRAPrelu/Bilinear/LSTMBias/Mixed.
+
+TPU-native: initializers produce values through jax PRNG sampling (keys from
+mxnet_tpu.random) directly into device arrays; the name-pattern dispatch
+(weight→init, bias→zero, gamma→one, ...) is preserved because the Gluon
+Parameter machinery relies on it.
+"""
+from __future__ import annotations
+
+import re
+
+import numpy as np
+
+from .base import MXNetError
+
+_INIT_REGISTRY = {}
+
+
+def register(klass):
+    """Register an initializer under its lowercased class name
+    (reference: ``mx.init.register``)."""
+    _INIT_REGISTRY[klass.__name__.lower()] = klass
+    return klass
+
+
+def create(init, **kwargs):
+    if init is None:
+        return None
+    if isinstance(init, Initializer):
+        return init
+    if isinstance(init, str):
+        name = init.lower()
+        if name not in _INIT_REGISTRY:
+            raise MXNetError(f"unknown initializer {init!r}; registered: "
+                             f"{sorted(_INIT_REGISTRY)}")
+        return _INIT_REGISTRY[name](**kwargs)
+    raise MXNetError(f"cannot create initializer from {init!r}")
+
+
+class InitDesc(str):
+    """Parameter-name descriptor carrying init attrs
+    (reference: python/mxnet/initializer.py:? ``InitDesc``)."""
+
+    def __new__(cls, name, attrs=None, global_init=None):
+        ret = super().__new__(cls, name)
+        ret.attrs = attrs or {}
+        ret.global_init = global_init
+        return ret
+
+
+class Initializer:
+    """Base initializer: dispatches on the parameter name suffix the same way
+    the reference does (weight/bias/gamma/beta/mean/var and the special
+    *_init attr override)."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def set_verbosity(self, verbose=False, print_func=None):
+        return self
+
+    def __call__(self, desc, arr):
+        if not isinstance(desc, InitDesc):
+            desc = InitDesc(str(desc))
+        init_attr = desc.attrs.get("__init__", "")
+        if init_attr:
+            create(init_attr)._init_weight(desc, arr)
+            return
+        name = desc.lower()
+        if name.endswith("weight"):
+            self._init_weight(desc, arr)
+        elif name.endswith("bias"):
+            self._init_bias(desc, arr)
+        elif name.endswith("gamma"):
+            self._init_gamma(desc, arr)
+        elif name.endswith("beta"):
+            self._init_beta(desc, arr)
+        elif name.endswith("running_mean") or name.endswith("moving_mean"):
+            self._init_zero(desc, arr)
+        elif name.endswith("running_var") or name.endswith("moving_var"):
+            self._init_one(desc, arr)
+        else:
+            self._init_default(desc, arr)
+
+    # -- family hooks --------------------------------------------------------
+    def _init_weight(self, name, arr):
+        raise NotImplementedError
+
+    def _init_bias(self, name, arr):
+        self._set(arr, np.zeros(arr.shape, dtype=arr.dtype))
+
+    def _init_gamma(self, name, arr):
+        self._set(arr, np.ones(arr.shape, dtype=arr.dtype))
+
+    def _init_beta(self, name, arr):
+        self._set(arr, np.zeros(arr.shape, dtype=arr.dtype))
+
+    def _init_zero(self, name, arr):
+        self._set(arr, np.zeros(arr.shape, dtype=arr.dtype))
+
+    def _init_one(self, name, arr):
+        self._set(arr, np.ones(arr.shape, dtype=arr.dtype))
+
+    def _init_default(self, name, arr):
+        self._init_weight(name, arr)
+
+    @staticmethod
+    def _set(arr, value):
+        import jax.numpy as jnp
+
+        dt = arr.dtype
+        arr._data = jnp.asarray(value).astype(dt)
+
+    @staticmethod
+    def _key():
+        from . import random as mxrand
+
+        return mxrand.next_key()
+
+    def __repr__(self):
+        kw = ", ".join(f"{k}={v}" for k, v in self._kwargs.items())
+        return f"{type(self).__name__}({kw})"
+
+    def dumps(self):
+        import json
+
+        return json.dumps([type(self).__name__.lower(), self._kwargs])
+
+
+@register
+class Zero(Initializer):
+    def _init_weight(self, name, arr):
+        self._set(arr, np.zeros(arr.shape))
+
+
+Zeros = Zero
+_INIT_REGISTRY["zeros"] = Zero
+
+
+@register
+class One(Initializer):
+    def _init_weight(self, name, arr):
+        self._set(arr, np.ones(arr.shape))
+
+
+Ones = One
+_INIT_REGISTRY["ones"] = One
+
+
+@register
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        super().__init__(value=value)
+        self.value = value
+
+    def _init_weight(self, name, arr):
+        self._set(arr, np.full(arr.shape, self.value))
+
+
+@register
+class Uniform(Initializer):
+    """U(-scale, scale) — reference default scale 0.07."""
+
+    def __init__(self, scale=0.07):
+        super().__init__(scale=scale)
+        self.scale = scale
+
+    def _init_weight(self, name, arr):
+        import jax
+
+        arr._data = jax.random.uniform(
+            self._key(), arr.shape, np.float32, minval=-self.scale,
+            maxval=self.scale).astype(arr.dtype)
+
+
+@register
+class Normal(Initializer):
+    """N(0, sigma) — reference default sigma 0.01."""
+
+    def __init__(self, sigma=0.01):
+        super().__init__(sigma=sigma)
+        self.sigma = sigma
+
+    def _init_weight(self, name, arr):
+        import jax
+
+        arr._data = (self.sigma * jax.random.normal(
+            self._key(), arr.shape, np.float32)).astype(arr.dtype)
+
+
+@register
+class Orthogonal(Initializer):
+    def __init__(self, scale=1.414, rand_type="uniform"):
+        super().__init__(scale=scale, rand_type=rand_type)
+        self.scale = scale
+        self.rand_type = rand_type
+
+    def _init_weight(self, name, arr):
+        import jax
+
+        nout = arr.shape[0]
+        nin = int(np.prod(arr.shape[1:]))
+        if self.rand_type == "uniform":
+            tmp = jax.random.uniform(self._key(), (nout, nin), np.float32,
+                                     minval=-1.0, maxval=1.0)
+        else:
+            tmp = jax.random.normal(self._key(), (nout, nin), np.float32)
+        u, _, v = np.linalg.svd(np.asarray(tmp), full_matrices=False)
+        q = u if u.shape == (nout, nin) else v
+        self._set(arr, self.scale * q.reshape(arr.shape))
+
+
+@register
+class Xavier(Initializer):
+    """Glorot init (reference: ``mx.init.Xavier`` — gluon's default for
+    weights is Uniform, model zoos use Xavier/MSRA explicitly)."""
+
+    def __init__(self, rnd_type="uniform", factor_type="avg", magnitude=3):
+        super().__init__(rnd_type=rnd_type, factor_type=factor_type,
+                         magnitude=magnitude)
+        self.rnd_type = rnd_type
+        self.factor_type = factor_type
+        self.magnitude = float(magnitude)
+
+    def _init_weight(self, name, arr):
+        import jax
+
+        shape = arr.shape
+        if len(shape) < 2:
+            hw_scale = 1.0
+            fan_in = fan_out = float(shape[0]) if shape else 1.0
+        else:
+            hw_scale = float(np.prod(shape[2:])) if len(shape) > 2 else 1.0
+            fan_in, fan_out = shape[1] * hw_scale, shape[0] * hw_scale
+        if self.factor_type == "avg":
+            factor = (fan_in + fan_out) / 2.0
+        elif self.factor_type == "in":
+            factor = fan_in
+        elif self.factor_type == "out":
+            factor = fan_out
+        else:
+            raise MXNetError(f"bad factor_type {self.factor_type!r}")
+        scale = np.sqrt(self.magnitude / max(factor, 1.0))
+        if self.rnd_type == "uniform":
+            raw = jax.random.uniform(self._key(), shape, np.float32,
+                                     minval=-scale, maxval=scale)
+        elif self.rnd_type == "gaussian":
+            raw = scale * jax.random.normal(self._key(), shape, np.float32)
+        else:
+            raise MXNetError(f"bad rnd_type {self.rnd_type!r}")
+        arr._data = raw.astype(arr.dtype)
+
+
+@register
+class MSRAPrelu(Xavier):
+    """He init (reference: ``mx.init.MSRAPrelu``)."""
+
+    def __init__(self, factor_type="avg", slope=0.25):
+        magnitude = 2.0 / (1 + slope ** 2)
+        super().__init__("gaussian", factor_type, magnitude)
+        self._kwargs = {"factor_type": factor_type, "slope": slope}
+
+
+@register
+class Bilinear(Initializer):
+    """Bilinear upsampling kernel (reference: deconv upsampling layers)."""
+
+    def _init_weight(self, name, arr):
+        weight = np.zeros(int(np.prod(arr.shape)), dtype=np.float32)
+        shape = arr.shape
+        f = np.ceil(shape[3] / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        for i in range(int(np.prod(shape))):
+            x = i % shape[3]
+            y = (i // shape[3]) % shape[2]
+            weight[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+        self._set(arr, weight.reshape(shape))
+
+
+@register
+class LSTMBias(Initializer):
+    """Forget-gate bias init (reference: ``mx.init.LSTMBias``)."""
+
+    def __init__(self, forget_bias=1.0):
+        super().__init__(forget_bias=forget_bias)
+        self.forget_bias = forget_bias
+
+    def _init_weight(self, name, arr):
+        b = np.zeros(arr.shape, dtype=np.float32)
+        num_hidden = arr.shape[0] // 4
+        b[num_hidden:2 * num_hidden] = self.forget_bias
+        self._set(arr, b)
+
+    _init_bias = _init_weight
+
+
+@register
+class Mixed(Initializer):
+    """Per-name-pattern initializer list (reference: ``mx.init.Mixed``)."""
+
+    def __init__(self, patterns, initializers):
+        super().__init__()
+        if len(patterns) != len(initializers):
+            raise MXNetError("patterns and initializers length mismatch")
+        self.map = [(re.compile(p), init) for p, init in
+                    zip(patterns, initializers)]
+
+    def __call__(self, desc, arr):
+        for prog, init in self.map:
+            if prog.match(str(desc)):
+                init(desc, arr)
+                return
+        raise MXNetError(
+            f"parameter {desc} did not match any pattern; add '.*' as the "
+            "last pattern")

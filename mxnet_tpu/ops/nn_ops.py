@@ -312,9 +312,10 @@ def batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-5,
     training = ag.is_training() and not use_global_stats
 
     def f(x, g, b, mmean, mvar):
-        red = tuple(i for i in range(x.ndim) if i != axis)
+        ax = axis % x.ndim
+        red = tuple(i for i in range(x.ndim) if i != ax)
         shape = [1] * x.ndim
-        shape[axis] = x.shape[axis]
+        shape[ax] = x.shape[ax]
         g_ = jnp.ones_like(g) if fix_gamma else g
         xf = x.astype(np.float32)
         if training:

@@ -1,0 +1,311 @@
+"""Gluon Block/HybridBlock/Parameter tests.
+
+Modeled on the reference's tests/python/unittest/test_gluon.py:? — layer
+shape/output checks, deferred init, hybridize parity with imperative
+execution, save/load roundtrips.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.gluon import nn
+
+
+def test_parameter_basic():
+    p = gluon.Parameter("weight", shape=(10, 10))
+    p.initialize(init=mx.init.One())
+    assert p.data().shape == (10, 10)
+    assert float(p.data().sum().asscalar()) == 100.0
+    assert p.grad().shape == (10, 10)
+
+
+def test_parameter_deferred_init():
+    dense = nn.Dense(5)
+    dense.initialize()
+    with pytest.raises(Exception):
+        dense.weight.data()
+    out = dense(nd.ones((2, 3)))
+    assert out.shape == (2, 5)
+    assert dense.weight.shape == (5, 3)
+
+
+def test_parameter_sharing():
+    d1 = nn.Dense(5, in_units=5, prefix="dense_")
+    d2 = nn.Dense(5, in_units=5, params=d1.collect_params())
+    d1.initialize()
+    x = mx.random.uniform(shape=(2, 5))
+    assert np.allclose(d1(x).asnumpy(), d2(x).asnumpy())
+
+
+def test_name_scope_prefixes():
+    net = nn.HybridSequential(prefix="model_")
+    with net.name_scope():
+        net.add(nn.Dense(4))
+        net.add(nn.Dense(4))
+    names = list(net.collect_params().keys())
+    assert all(n.startswith("model_dense") for n in names)
+    assert len(set(names)) == 4
+
+
+def test_dense_forward_values():
+    layer = nn.Dense(3, in_units=2, use_bias=True)
+    layer.initialize(init=mx.init.One())
+    out = layer(nd.array([[2.0, 3.0]]))
+    # weight all ones, bias zeros: each output = 5
+    assert np.allclose(out.asnumpy(), [[5.0, 5.0, 5.0]])
+
+
+def test_hybridize_matches_imperative():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(16, activation="relu"), nn.Dense(4))
+    net.initialize()
+    x = mx.random.uniform(shape=(5, 8))
+    imp = net(x).asnumpy()
+    net.hybridize()
+    hyb = net(x).asnumpy()
+    assert np.allclose(imp, hyb, atol=1e-5)
+
+
+def test_hybridize_gradients_match():
+    def build():
+        net = nn.HybridSequential()
+        with net.name_scope():
+            net.add(nn.Dense(8, activation="tanh", in_units=4),
+                    nn.Dense(2, in_units=8))
+        return net
+
+    mx.random.seed(7)
+    net1 = build()
+    net1.initialize(mx.init.Xavier())
+    mx.random.seed(7)
+    net2 = build()
+    net2.initialize(mx.init.Xavier())
+    net2.hybridize()
+    x = mx.random.uniform(shape=(3, 4))
+    for net in (net1, net2):
+        with autograd.record():
+            loss = net(x).sum()
+        loss.backward()
+    for (k1, p1), (k2, p2) in zip(net1.collect_params().items(),
+                                  net2.collect_params().items()):
+        assert np.allclose(p1.grad().asnumpy(), p2.grad().asnumpy(),
+                           atol=1e-5), k1
+
+
+def test_hybridize_batchnorm_aux_update():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(4, in_units=4), nn.BatchNorm(axis=-1))
+    net.initialize()
+    net.hybridize()
+    bn = net[1]
+    x = mx.random.uniform(shape=(8, 4))
+    with autograd.record():
+        net(x)
+    m1 = bn.running_mean.data().asnumpy().copy()
+    with autograd.record():
+        net(x)
+    m2 = bn.running_mean.data().asnumpy()
+    assert not np.allclose(m1, 0)
+    assert not np.allclose(m1, m2)
+
+
+def test_batchnorm_train_vs_eval():
+    bn = nn.BatchNorm(in_channels=3)
+    bn.initialize()
+    x = mx.random.normal(shape=(4, 3, 2, 2), scale=5.0)
+    with autograd.record():
+        y_train = bn(x)
+    y_eval = bn(x)
+    # training normalizes batch stats; eval uses (barely moved) moving stats
+    assert abs(y_train.asnumpy().mean()) < 1e-3
+    assert not np.allclose(y_train.asnumpy(), y_eval.asnumpy())
+
+
+def test_conv2d_shapes():
+    layer = nn.Conv2D(16, kernel_size=3, strides=2, padding=1)
+    layer.initialize()
+    out = layer(nd.ones((2, 3, 32, 32)))
+    assert out.shape == (2, 16, 16, 16)
+    assert layer.weight.shape == (16, 3, 3, 3)
+
+
+def test_conv_transpose_roundtrip_shape():
+    layer = nn.Conv2DTranspose(8, kernel_size=4, strides=2, padding=1,
+                               in_channels=3)
+    layer.initialize()
+    out = layer(nd.ones((1, 3, 16, 16)))
+    assert out.shape == (1, 8, 32, 32)
+
+
+def test_pooling_shapes():
+    x = nd.ones((2, 3, 8, 8))
+    assert nn.MaxPool2D()(x).shape == (2, 3, 4, 4)
+    assert nn.AvgPool2D(pool_size=3, strides=1, padding=1)(x).shape == \
+        (2, 3, 8, 8)
+    assert nn.GlobalAvgPool2D()(x).shape == (2, 3, 1, 1)
+    assert nn.MaxPool2D(pool_size=3, strides=2, ceil_mode=True)(x).shape == \
+        (2, 3, 4, 4)
+
+
+def test_embedding():
+    emb = nn.Embedding(10, 4)
+    emb.initialize()
+    out = emb(nd.array([[1, 2], [3, 4]]))
+    assert out.shape == (2, 2, 4)
+    with autograd.record():
+        loss = emb(nd.array([0, 1])).sum()
+    loss.backward()
+    g = emb.weight.grad().asnumpy()
+    assert np.allclose(g[0], 1) and np.allclose(g[2], 0)
+
+
+def test_layernorm_values():
+    ln = nn.LayerNorm(in_channels=6)
+    ln.initialize()
+    x = mx.random.normal(shape=(3, 6), scale=4.0)
+    y = ln(x).asnumpy()
+    assert np.allclose(y.mean(-1), 0, atol=1e-5)
+    assert np.allclose(y.std(-1), 1, atol=2e-2)
+
+
+def test_activations():
+    x = nd.array([-2.0, -0.5, 0.0, 0.5, 2.0])
+    assert np.allclose(nn.Activation("relu")(x).asnumpy(),
+                       [0, 0, 0, 0.5, 2.0])
+    assert np.allclose(nn.LeakyReLU(0.1)(x).asnumpy(),
+                       [-0.2, -0.05, 0, 0.5, 2.0], atol=1e-6)
+    y = nn.SELU()(x).asnumpy()
+    assert y[3] > 0.5 and y[0] < 0
+    sw = nn.Swish()(x).asnumpy()
+    assert np.allclose(sw, x.asnumpy() / (1 + np.exp(-x.asnumpy())),
+                       atol=1e-5)
+
+
+def test_sequential_slicing():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(4), nn.Dense(5), nn.Dense(6))
+    assert len(net) == 3
+    assert isinstance(net[1], nn.Dense)
+    assert len(net[1:]) == 2
+
+
+def test_save_load_parameters(tmp_path):
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(8, in_units=4), nn.Dense(2, in_units=8))
+    net.initialize()
+    f = str(tmp_path / "net.params")
+    net.save_parameters(f)
+
+    net2 = nn.HybridSequential()
+    with net2.name_scope():
+        net2.add(nn.Dense(8, in_units=4), nn.Dense(2, in_units=8))
+    net2.load_parameters(f)
+    x = mx.random.uniform(shape=(2, 4))
+    assert np.allclose(net(x).asnumpy(), net2(x).asnumpy())
+
+
+def test_save_load_deferred(tmp_path):
+    net = nn.Dense(3)
+    net.initialize()
+    net(nd.ones((1, 5)))
+    f = str(tmp_path / "d.params")
+    net.save_parameters(f)
+    net2 = nn.Dense(3)
+    net2.load_parameters(f)
+    assert net2.weight.shape == (3, 5)
+
+
+def test_losses():
+    L = gluon.loss
+    pred = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    label = nd.array([[1.5, 2.0], [3.0, 3.0]])
+    l2 = L.L2Loss()(pred, label).asnumpy()
+    assert np.allclose(l2, [0.0625, 0.25])
+    l1 = L.L1Loss()(pred, label).asnumpy()
+    assert np.allclose(l1, [0.25, 0.5])
+    h = L.HuberLoss(rho=0.3)(pred, label).asnumpy()
+    assert h.shape == (2,)
+
+    logits = nd.array([[10.0, 0.0, 0.0], [0.0, 10.0, 0.0]])
+    ce = L.SoftmaxCrossEntropyLoss()(logits, nd.array([0, 1])).asnumpy()
+    assert np.all(ce < 1e-3)
+    ce_dense = L.SoftmaxCrossEntropyLoss(sparse_label=False)(
+        logits, nd.array([[1.0, 0, 0], [0, 1.0, 0]])).asnumpy()
+    assert np.allclose(ce, ce_dense, atol=1e-5)
+
+    bce = L.SigmoidBinaryCrossEntropyLoss()
+    p = nd.array([[100.0], [-100.0]])
+    y = nd.array([[1.0], [0.0]])
+    assert np.all(bce(p, y).asnumpy() < 1e-3)
+
+
+def test_loss_backward_through_net():
+    net = nn.Dense(3, in_units=4)
+    net.initialize()
+    lossfn = gluon.loss.SoftmaxCrossEntropyLoss()
+    x = mx.random.uniform(shape=(2, 4))
+    with autograd.record():
+        l = lossfn(net(x), nd.array([0, 2]))
+    l.backward()
+    assert net.weight.grad().asnumpy().shape == (3, 4)
+    assert np.abs(net.weight.grad().asnumpy()).sum() > 0
+
+
+def test_split_and_load():
+    data = nd.arange(0, 12).reshape((6, 2))
+    parts = gluon.utils.split_data(data, 3)
+    assert [p.shape for p in parts] == [(2, 2)] * 3
+    loaded = gluon.utils.split_and_load(data, [mx.cpu(0), mx.cpu(1)])
+    assert len(loaded) == 2
+    assert np.allclose(
+        np.concatenate([p.asnumpy() for p in loaded]), data.asnumpy())
+
+
+def test_clip_global_norm():
+    arrays = [nd.ones((2, 2)) * 3, nd.ones((3,)) * 4]
+    total = gluon.utils.clip_global_norm(arrays, 1.0)
+    new_norm = np.sqrt(sum((a.asnumpy() ** 2).sum() for a in arrays))
+    assert np.isclose(new_norm, 1.0, atol=1e-5)
+    assert total > 1.0
+
+
+def test_block_cast():
+    import jax.numpy as jnp
+
+    net = nn.Dense(4, in_units=3)
+    net.initialize()
+    net.cast("float16")
+    assert net.weight.data().dtype == np.float16
+    out = net(nd.ones((1, 3)).astype(np.float16))
+    assert out.dtype == np.float16
+
+
+def test_summary_runs(capsys):
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(4, in_units=2))
+    net.initialize()
+    net.summary()
+    assert "Total params" in capsys.readouterr().out
+
+
+def test_constant_parameter():
+    const = gluon.Constant("c", nd.array([1.0, 2.0]))
+    const.initialize()
+    assert np.allclose(const.data().asnumpy(), [1.0, 2.0])
+    assert const.grad_req == "null"
+
+
+def test_hybridize_retrace_on_new_shape():
+    net = nn.Dense(4, in_units=3)
+    net.initialize()
+    net.hybridize()
+    a = net(nd.ones((2, 3)))
+    b = net(nd.ones((5, 3)))
+    assert a.shape == (2, 4) and b.shape == (5, 4)
+    assert len(net._cached_op._graphs) == 2
