@@ -1,0 +1,221 @@
+"""gluon.Trainer — applies an optimizer to a set of Parameters.
+
+Reference: ``python/mxnet/gluon/trainer.py:?`` — wires a ParameterDict to an
+optimizer and a KVStore: ``step(batch_size)`` = allreduce grads (kvstore
+push/pull) + fused optimizer update ops; ``update_on_kvstore`` moves the
+update into the (possibly remote) store; saves/loads optimizer states.
+
+TPU-native: with the single-logical-array parameter design, the
+``local``/``device`` allreduce is a no-op (XLA already aggregated across the
+mesh inside the backward jit).  ``dist_tpu_sync`` installs a psum-based
+fused (allreduce + update) path (mxnet_tpu/parallel) — the north star's key
+trick: the Trainer API is unchanged while the whole step compiles into one
+XLA program with collectives on ICI.
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+from .parameter import Parameter, ParameterDict
+from .. import optimizer as opt
+
+__all__ = ["Trainer"]
+
+
+class Trainer:
+    def __init__(self, params, optimizer, optimizer_params=None,
+                 kvstore="device", compression_params=None,
+                 update_on_kvstore=None):
+        if isinstance(params, (dict, ParameterDict)):
+            params = list(params.values())
+        if not isinstance(params, (list, tuple)):
+            raise MXNetError(
+                "params must be a ParameterDict, dict, or list of Parameters")
+        self._params = []
+        self._param2idx = {}
+        for i, param in enumerate(params):
+            if not isinstance(param, Parameter):
+                raise MXNetError(f"element {i} is not a Parameter")
+            self._param2idx[param.name] = i
+            self._params.append(param)
+        self._compression_params = compression_params
+        self._contexts = self._check_contexts()
+        optimizer_params = optimizer_params or {}
+        self._init_optimizer(optimizer, optimizer_params)
+        self._scale = self._optimizer.rescale_grad
+        self._kvstore_params = {
+            "kvstore": kvstore, "update_on_kvstore": update_on_kvstore}
+        self._kv_initialized = False
+        self._kvstore = None
+        self._update_on_kvstore = None
+
+    def _check_contexts(self):
+        contexts = None
+        for param in self._params:
+            ctx = param.list_ctx()
+            if contexts is not None and contexts != ctx:
+                raise MXNetError(
+                    f"all Parameters must share contexts; {param.name} has "
+                    f"{ctx} vs {contexts}")
+            contexts = ctx
+        return contexts or []
+
+    def _init_optimizer(self, optimizer, optimizer_params):
+        param_dict = {i: param for i, param in enumerate(self._params)}
+        if isinstance(optimizer, opt.Optimizer):
+            if optimizer_params:
+                raise MXNetError(
+                    "optimizer_params must be None when optimizer is an "
+                    "Optimizer instance")
+            self._optimizer = optimizer
+            self._optimizer.param_dict = param_dict
+        else:
+            self._optimizer = opt.create(optimizer, param_dict=param_dict,
+                                         **optimizer_params)
+        self._states = [None] * len(self._params)
+        self._states_initialized = [False] * len(self._params)
+
+    def _init_states(self, i):
+        if not self._states_initialized[i]:
+            param = self._params[i]
+            self._states[i] = \
+                self._optimizer.create_state_multi_precision(
+                    i, param.data())
+            self._states_initialized[i] = True
+
+    def _init_kvstore(self):
+        config = self._kvstore_params
+        kvstore = config["kvstore"]
+        update_on_kvstore = config["update_on_kvstore"]
+        if kvstore is None:
+            self._kvstore = None
+            self._update_on_kvstore = False
+        else:
+            from .. import kvstore as kvs
+
+            kv = kvs.create(kvstore) if isinstance(kvstore, str) else kvstore
+            self._kvstore = kv
+            if self._compression_params:
+                kv.set_gradient_compression(self._compression_params)
+            if update_on_kvstore is None:
+                # single logical array: updating locally is strictly better
+                # (fused jit update); dist PS-style configs opt in explicitly
+                update_on_kvstore = False
+            self._update_on_kvstore = update_on_kvstore
+            if update_on_kvstore:
+                for i, param in enumerate(self._params):
+                    if param.grad_req != "null":
+                        self._kvstore.init(i, param.data())
+                self._kvstore.set_optimizer(self._optimizer)
+        self._kv_initialized = True
+
+    # -- public properties ---------------------------------------------------
+    @property
+    def learning_rate(self):
+        return self._optimizer.learning_rate
+
+    @property
+    def optimizer(self):
+        return self._optimizer
+
+    def set_learning_rate(self, lr):
+        self._optimizer.set_learning_rate(lr)
+
+    # -- the step ------------------------------------------------------------
+    def step(self, batch_size, ignore_stale_grad=False):
+        """Allreduce gradients and apply one optimizer update, scaling
+        gradients by 1/batch_size (reference: ``Trainer.step``)."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._allreduce_grads()
+        self._update(ignore_stale_grad)
+
+    def allreduce_grads(self):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._update_on_kvstore:
+            raise MXNetError(
+                "allreduce_grads() is not supported when update_on_kvstore "
+                "is True (the store owns the update)")
+        self._allreduce_grads()
+
+    def _allreduce_grads(self):
+        if self._kvstore is None:
+            return
+        reducer = getattr(self._kvstore, "allreduce_grads", None)
+        if reducer is not None:
+            # dist_tpu_sync: psum over the mesh (mxnet_tpu/parallel)
+            reducer([p for p in self._params if p.grad_req != "null"])
+            return
+        if self._update_on_kvstore:
+            return  # push happens in _update
+        for i, param in enumerate(self._params):
+            if param.grad_req != "null":
+                self._kvstore.init(i, param.grad())
+                self._kvstore.push(i, param.grad())
+                self._kvstore.pull(i, param.grad())
+
+    def update(self, batch_size, ignore_stale_grad=False):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._update_on_kvstore:
+            raise MXNetError(
+                "update() is not supported when update_on_kvstore is True")
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._update(ignore_stale_grad)
+
+    def _update(self, ignore_stale_grad=False):
+        for i, param in enumerate(self._params):
+            if param.grad_req == "null":
+                continue
+            if param._data is None:
+                if param._deferred_init is not None:
+                    continue  # untouched deferred param: nothing to update
+                raise MXNetError(
+                    f"parameter {param.name} was not initialized")
+            if self._update_on_kvstore:
+                self._kvstore.push(i, param.grad())
+                self._kvstore.pull(i, param.data())
+                continue
+            self._init_states(i)
+            self._optimizer.update_multi_precision(
+                i, param.data(), param.grad(), self._states[i])
+
+    # -- state persistence (reference: Trainer.save_states/load_states) ------
+    def save_states(self, fname):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._update_on_kvstore:
+            self._kvstore.save_optimizer_states(fname, dump_optimizer=True)
+            return
+        import pickle
+
+        for i, param in enumerate(self._params):
+            if param.grad_req != "null":
+                self._init_states(i)
+        payload = {
+            "states": {i: opt._states_to_numpy(s)
+                       for i, s in enumerate(self._states)},
+            "num_update": self._optimizer.num_update,
+            "index_update_count": dict(
+                self._optimizer._index_update_count),
+        }
+        with open(fname, "wb") as f:
+            pickle.dump(payload, f)
+
+    def load_states(self, fname):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._update_on_kvstore:
+            self._kvstore.load_optimizer_states(fname)
+            return
+        import pickle
+
+        with open(fname, "rb") as f:
+            payload = pickle.load(f)
+        self._states = [opt._states_from_numpy(s)
+                        for _, s in sorted(payload["states"].items())]
+        self._states_initialized = [True] * len(self._states)
+        self._optimizer.num_update = payload["num_update"]
+        self._optimizer._index_update_count.update(
+            payload["index_update_count"])

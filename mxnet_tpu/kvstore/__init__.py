@@ -1,0 +1,232 @@
+"""KVStore: the gradient-aggregation / parameter-distribution layer.
+
+Reference: ``include/mxnet/kvstore.h:?`` + ``src/kvstore/`` —
+``KVStore::Create("local"/"device"/"dist_sync"/"dist_async"/"nccl")``;
+``init/push/pull/row_sparse_pull/set_updater``; ``local``/``device`` reduce
+gradients across local GPUs (comm.h), ``dist_*`` go through ps-lite to
+parameter servers, ``nccl`` allreduces (SURVEY §2.3 D1–D3, §3.4).
+
+TPU-native redesign: a parameter is ONE logical jax.Array (replicated or
+sharded over the mesh by GSPMD), so single-process "aggregation across
+devices" is already done by XLA collectives inside the jitted step — the
+``local``/``device``/``nccl`` modes therefore share one implementation whose
+push/pull are explicit about updater semantics but move no data.  The new
+``dist_tpu_sync`` mode (the north-star capability) runs psum over the ICI
+mesh inside the compiled training step; across hosts it rides
+``jax.distributed`` process groups (see mxnet_tpu/parallel).  ``dist_sync``/
+``dist_async`` names map onto it with a warning, so reference scripts run
+unchanged.
+"""
+from __future__ import annotations
+
+import pickle
+import warnings
+
+import numpy as np
+
+from ..base import MXNetError
+from ..ndarray import NDArray
+
+__all__ = ["KVStore", "create"]
+
+
+class KVStore:
+    """Single-process store: ``local``/``device``/``nccl`` (reference:
+    ``KVStoreLocal``, src/kvstore/kvstore_local.h:?)."""
+
+    def __init__(self, name="local"):
+        self.type = name
+        self._store = {}
+        self._updater = None
+        self._optimizer = None
+        self._compression = None
+        self._str_keys = None
+
+    # -- identity ------------------------------------------------------------
+    @property
+    def rank(self):
+        return 0
+
+    @property
+    def num_workers(self):
+        return 1
+
+    # -- core ops ------------------------------------------------------------
+    @staticmethod
+    def _key(key):
+        return str(key)
+
+    def init(self, key, value):
+        keys, values = _pairs(key, value)
+        for k, v in zip(keys, values):
+            k = self._key(k)
+            if k in self._store:
+                continue
+            self._store[k] = _copy_value(v)
+
+    def push(self, key, value, priority=0):
+        """Aggregate value(s) into the store; with an updater installed the
+        stored weight is updated in place (reference ``update_on_kvstore``
+        server-side optimizer, SURVEY §3.4)."""
+        keys, values = _pairs(key, value)
+        for k, v in zip(keys, values):
+            k = self._key(k)
+            if k not in self._store:
+                raise MXNetError(f"key {k!r} not initialized")
+            merged = _merge(v)
+            if self._updater is not None:
+                self._updater(int(k) if k.isdigit() else k, merged,
+                              self._store[k])
+            else:
+                # reference KVStoreLocal::PushImpl without updater: the
+                # device-reduced value replaces the stored one
+                self._store[k] = merged
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        keys, outs = _pairs(key, out)
+        for k, o in zip(keys, outs):
+            k = self._key(k)
+            if k not in self._store:
+                raise MXNetError(f"key {k!r} not initialized")
+            stored = self._store[k]
+            for target in (o if isinstance(o, (list, tuple)) else [o]):
+                _assign(target, stored)
+
+    def pushpull(self, key, value, out=None, priority=0):
+        self.push(key, value, priority)
+        if out is not None:
+            self.pull(key, out, priority)
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        """Pull only the requested rows (reference ``PullRowSparse`` —
+        the embedding-table path, src/kvstore/kvstore_local.h:?)."""
+        from ..ndarray import sparse as sp
+
+        if row_ids is None:
+            raise MXNetError("row_sparse_pull requires row_ids")
+        keys, outs = _pairs(key, out)
+        rids = row_ids if isinstance(row_ids, (list, tuple)) else \
+            [row_ids] * len(keys)
+        for k, o, r in zip(keys, outs, rids):
+            k = self._key(k)
+            stored = self._store[k]
+            dense = stored.tostype("default") \
+                if isinstance(stored, sp.BaseSparseNDArray) else stored
+            import jax.numpy as jnp
+
+            idx = r._data.astype(np.int64) if isinstance(r, NDArray) else \
+                jnp.asarray(r, np.int64)
+            rows = dense._data[idx.astype(np.int32)]
+            result = sp.RowSparseNDArray(NDArray(rows),
+                                         NDArray(idx), dense.shape)
+            for target in (o if isinstance(o, (list, tuple)) else [o]):
+                if isinstance(target, sp.RowSparseNDArray):
+                    result.copyto(target)
+                else:
+                    _assign(target, result.todense())
+
+    def broadcast(self, key, value, out=None, priority=0):
+        self.init(key, value)
+        if out is not None:
+            self.pull(key, out, priority)
+
+    # -- optimizer wiring ----------------------------------------------------
+    def set_optimizer(self, optimizer):
+        from .. import optimizer as opt_mod
+
+        self._optimizer = optimizer
+        self._updater = opt_mod.get_updater(optimizer)
+
+    def set_updater(self, updater):
+        self._updater = updater
+
+    def set_gradient_compression(self, compression_params):
+        """Reference: 2-bit gradient compression w/ error feedback
+        (src/kvstore/gradient_compression.cc:?).  Stored and applied on the
+        dist path; single-process modes don't compress (same as reference)."""
+        self._compression = dict(compression_params or {})
+
+    # -- state persistence ---------------------------------------------------
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        if self._updater is None:
+            raise MXNetError("no optimizer installed on this kvstore")
+        with open(fname, "wb") as f:
+            f.write(self._updater.get_states(dump_optimizer))
+
+    def load_optimizer_states(self, fname):
+        if self._updater is None:
+            raise MXNetError("no optimizer installed on this kvstore")
+        with open(fname, "rb") as f:
+            self._updater.set_states(f.read())
+
+
+def _pairs(key, value):
+    if isinstance(key, (list, tuple)):
+        return list(key), list(value)
+    return [key], [value]
+
+
+def _merge(v):
+    if isinstance(v, (list, tuple)):
+        out = v[0]
+        for x in v[1:]:
+            out = _add(out, x)
+        return out
+    return v
+
+
+def _add(a, b):
+    from ..ndarray import sparse as sp
+
+    if isinstance(a, sp.BaseSparseNDArray) or \
+            isinstance(b, sp.BaseSparseNDArray):
+        da = a.todense() if isinstance(a, sp.BaseSparseNDArray) else a
+        db = b.todense() if isinstance(b, sp.BaseSparseNDArray) else b
+        return da + db
+    return a + b
+
+
+def _assign(target, value):
+    from ..ndarray import sparse as sp
+
+    if isinstance(value, sp.BaseSparseNDArray):
+        value = value.todense()
+    if isinstance(target, sp.RowSparseNDArray):
+        cast = sp.cast_storage(value, "row_sparse")
+        cast.copyto(target)
+    else:
+        target._data = value._data.astype(target.dtype)
+
+
+def _copy_value(v):
+    from ..ndarray import sparse as sp
+
+    if isinstance(v, sp.BaseSparseNDArray):
+        out = sp.RowSparseNDArray(v.data.copy(), v.indices.copy(), v.shape) \
+            if isinstance(v, sp.RowSparseNDArray) else \
+            sp.CSRNDArray(v.data.copy(), v.indices.copy(), v.indptr.copy(),
+                          v.shape)
+        return out
+    return v.copy()
+
+
+def create(name="local"):
+    """Reference: ``mx.kv.create`` — factory by mode name."""
+    if isinstance(name, KVStore):
+        return name
+    if not isinstance(name, str):
+        raise MXNetError("kvstore name must be a string")
+    lname = name.lower()
+    if lname in ("local", "local_update_cpu", "local_allreduce_cpu",
+                 "local_allreduce_device", "device", "nccl"):
+        return KVStore(lname)
+    if lname in ("dist_tpu_sync", "dist_sync", "dist_device_sync",
+                 "dist_async", "horovod"):
+        from ..parallel import TPUSyncKVStore
+
+        if lname != "dist_tpu_sync":
+            warnings.warn(
+                f"kvstore {name!r} maps to 'dist_tpu_sync' on this backend "
+                "(XLA collectives over the ICI/DCN mesh replace ps-lite)")
+        return TPUSyncKVStore()
+    raise MXNetError(f"unknown kvstore type {name!r}")

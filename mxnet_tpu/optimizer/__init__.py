@@ -1,0 +1,716 @@
+"""Optimizers.
+
+Reference: ``python/mxnet/optimizer/optimizer.py:?`` (Optimizer registry,
+lr/wd multipliers, update-count tracking, multi-precision) over the fused
+update ops in ``src/operator/optimizer_op.cc:?`` (``sgd_update``,
+``sgd_mom_update``, ``mp_sgd_*``, ``adam_update``, ``lamb_*``, ...).  The
+key reference invariant: optimizer math runs *as engine ops on device*, not
+in python.
+
+TPU-native redesign: each optimizer's update is a pure function jitted once
+per (shape, dtype) — the XLA analog of the fused update kernels.  Learning
+rate / weight decay enter as traced scalars so per-step schedule changes do
+NOT recompile.  Multi-precision keeps an fp32 master weight in the state,
+exactly like ``mp_sgd_mom_update``.  Sparse (row_sparse) lazy updates are
+routed through ``_sparse_step`` where defined (SURVEY §2.2 optimizer-ops
+row; sparse path in mxnet_tpu/ndarray/sparse.py).
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from ..base import MXNetError
+from ..ndarray import NDArray
+
+__all__ = ["Optimizer", "SGD", "NAG", "Adam", "AdamW", "LAMB", "RMSProp",
+           "AdaGrad", "AdaDelta", "Ftrl", "Signum", "SignSGD", "LARS",
+           "create", "register", "Test", "Updater", "get_updater"]
+
+
+def _f32(x):
+    return x.astype(np.float32) if x.dtype != np.float32 else x
+
+
+class Optimizer:
+    """Base optimizer (reference: ``mx.optimizer.Optimizer``)."""
+
+    opt_registry = {}
+
+    def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
+                 clip_gradient=None, learning_rate=None, lr_scheduler=None,
+                 sym=None, begin_num_update=0, multi_precision=False,
+                 param_dict=None, aggregate_num=0, **kwargs):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate if learning_rate is not None else 0.01
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None and learning_rate is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.clip_gradient = clip_gradient
+        self.begin_num_update = begin_num_update
+        self.num_update = begin_num_update
+        self._all_index_update_counts = {0: {}}
+        self._index_update_count = self._all_index_update_counts[0]
+        self.multi_precision = multi_precision
+        self.aggregate_num = aggregate_num
+        if param_idx2name is None:
+            param_idx2name = {}
+        self.idx2name = dict(param_idx2name)
+        self.param_dict = param_dict if param_dict else {}
+        self.lr_mult = {}
+        self.wd_mult = {}
+        self._jit_cache = {}
+
+    # -- registry ------------------------------------------------------------
+    @staticmethod
+    def register(klass):
+        name = klass.__name__.lower()
+        Optimizer.opt_registry[name] = klass
+        return klass
+
+    @staticmethod
+    def create_optimizer(name, **kwargs):
+        if name.lower() not in Optimizer.opt_registry:
+            raise MXNetError(f"unknown optimizer {name!r}; registered: "
+                             f"{sorted(Optimizer.opt_registry)}")
+        return Optimizer.opt_registry[name.lower()](**kwargs)
+
+    # -- lr/wd ---------------------------------------------------------------
+    @property
+    def learning_rate(self):
+        if self.lr_scheduler is not None:
+            return self.lr_scheduler(self.num_update)
+        return self.lr
+
+    def set_learning_rate(self, lr):
+        if self.lr_scheduler is not None:
+            raise MXNetError(
+                "cannot set learning rate: an LRScheduler is active")
+        self.lr = lr
+
+    def set_lr_mult(self, args_lr_mult):
+        self.lr_mult = dict(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        self.wd_mult = {}
+        for n in self.idx2name.values():
+            is_weight = n.endswith("_weight")
+            if not is_weight:
+                self.wd_mult[n] = 0.0
+        self.wd_mult.update(args_wd_mult)
+
+    def _get_lr(self, index):
+        lr = self.lr_scheduler(self.num_update) if self.lr_scheduler else \
+            self.lr
+        if index in self.param_dict:
+            lr *= self.param_dict[index].lr_mult
+        elif index in self.lr_mult:
+            lr *= self.lr_mult[index]
+        elif index in self.idx2name:
+            lr *= self.lr_mult.get(self.idx2name[index], 1.0)
+        return lr
+
+    def _get_wd(self, index):
+        wd = self.wd
+        if index in self.param_dict:
+            wd *= self.param_dict[index].wd_mult
+        elif index in self.wd_mult:
+            wd *= self.wd_mult[index]
+        elif index in self.idx2name:
+            wd *= self.wd_mult.get(self.idx2name[index], 1.0)
+        return wd
+
+    def _update_count(self, index):
+        if index not in self._index_update_count:
+            self._index_update_count[index] = self.begin_num_update
+        self._index_update_count[index] += 1
+        self.num_update = max(self._index_update_count[index],
+                              self.num_update)
+
+    def _set_current_context(self, device_id):
+        if device_id not in self._all_index_update_counts:
+            self._all_index_update_counts[device_id] = {}
+        self._index_update_count = self._all_index_update_counts[device_id]
+
+    # -- state ---------------------------------------------------------------
+    def create_state(self, index, weight):
+        return None
+
+    def create_state_multi_precision(self, index, weight):
+        if self.multi_precision and np.dtype(weight.dtype).name in (
+                "float16", "bfloat16"):
+            master = NDArray(_f32(weight._data))
+            return (master, self.create_state(index, master))
+        if np.dtype(weight.dtype).name in ("float16", "bfloat16") and \
+                not self.multi_precision:
+            import warnings
+
+            warnings.warn(
+                "reduced-precision weights without multi_precision=True may "
+                "be poorly conditioned; consider multi_precision=True")
+        return self.create_state(index, weight)
+
+    # -- update --------------------------------------------------------------
+    def _step(self, w, g, states, lr, wd, t):
+        """Pure update math: raw arrays in → (new_w, new_states).  Subclasses
+        implement; traced once per shape (the fused-kernel analog)."""
+        raise NotImplementedError
+
+    def _prep_grad(self, g, w, wd, include_wd=True):
+        import jax.numpy as jnp
+
+        g = g * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        if include_wd:
+            g = g + wd * w
+        return g
+
+    def _jitted(self, key, fn):
+        import jax
+
+        if key not in self._jit_cache:
+            self._jit_cache[key] = jax.jit(fn)
+        return self._jit_cache[key]
+
+    def update(self, index, weight, grad, state):
+        self._update_impl(index, weight, grad, state, multi_precision=False)
+
+    def update_multi_precision(self, index, weight, grad, state):
+        use_mp = self.multi_precision and np.dtype(weight.dtype).name in (
+            "float16", "bfloat16")
+        self._update_impl(index, weight, grad, state, multi_precision=use_mp)
+
+    def _update_impl(self, index, weight, grad, state, multi_precision):
+        if isinstance(index, (list, tuple)):
+            for i, w, g, s in zip(index, weight, grad, state):
+                self._update_impl(i, w, g, s, multi_precision)
+            return
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        t = self._index_update_count[index]
+
+        # sparse lazy update path (row_sparse grads touch only live rows —
+        # reference: lazy_update in optimizer ops)
+        from ..ndarray import sparse as sp
+
+        if isinstance(grad, sp.RowSparseNDArray) and \
+                hasattr(self, "_sparse_step"):
+            self._sparse_step(index, weight, grad, state, lr, wd, t)
+            return
+        if isinstance(grad, sp.BaseSparseNDArray):
+            grad = grad.tostype("default")
+
+        if multi_precision:
+            master, sub_state = state
+            step = self._jitted(
+                ("mp", weight.shape, str(weight.dtype)),
+                lambda mw, g, ss, lr_, wd_, t_: self._step(
+                    mw, _f32(g), ss, lr_, wd_, t_))
+            states = tuple(s._data for s in _flatten_state(sub_state))
+            new_w, new_states = step(master._data, grad._data, states,
+                                     lr, wd, t)
+            master._data = new_w
+            weight._data = new_w.astype(weight.dtype)
+            _commit_state(sub_state, new_states)
+        else:
+            step = self._jitted(
+                ("sp", weight.shape, str(weight.dtype)),
+                lambda w, g, ss, lr_, wd_, t_: self._step(
+                    w, g, ss, lr_, wd_, t_))
+            states = tuple(s._data for s in _flatten_state(state))
+            new_w, new_states = step(weight._data, grad._data, states,
+                                     lr, wd, t)
+            weight._data = new_w
+            _commit_state(state, new_states)
+
+
+def _flatten_state(state):
+    if state is None:
+        return ()
+    if isinstance(state, NDArray):
+        return (state,)
+    out = []
+    for s in state:
+        out.extend(_flatten_state(s))
+    return tuple(out)
+
+
+def _commit_state(state, new_raws):
+    holders = _flatten_state(state)
+    for h, r in zip(holders, new_raws):
+        h._data = r
+
+
+register = Optimizer.register
+create = Optimizer.create_optimizer
+
+
+@register
+class SGD(Optimizer):
+    """SGD with momentum + multi-precision (reference ``sgd_update`` /
+    ``sgd_mom_update`` / ``mp_sgd_*``, src/operator/optimizer_op.cc:?)."""
+
+    def __init__(self, momentum=0.0, lazy_update=True, learning_rate=None,
+                 **kwargs):
+        super().__init__(learning_rate=learning_rate
+                         if learning_rate is not None else 0.01, **kwargs)
+        self.momentum = momentum
+        self.lazy_update = lazy_update
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        from .. import ndarray as nd
+
+        return nd.zeros(weight.shape, dtype=np.float32
+                        if np.dtype(weight.dtype).name in
+                        ("float16", "bfloat16") else weight.dtype)
+
+    def _step(self, w, g, states, lr, wd, t):
+        g = self._prep_grad(g.astype(w.dtype), w, wd)
+        if self.momentum == 0.0:
+            return w - lr * g, ()
+        (mom,) = states
+        mom = self.momentum * mom - lr * g.astype(mom.dtype)
+        return w + mom.astype(w.dtype), (mom,)
+
+    def _sparse_step(self, index, weight, grad, state, lr, wd, t):
+        """Lazy row_sparse update: only rows present in the gradient are
+        touched (reference: ``sgd_update(lazy_update=True)``)."""
+        import jax.numpy as jnp
+
+        idx, vals = grad.indices._data, grad.data._data
+        w = weight._data
+        g = vals * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        rows = w[idx]
+        g = g + wd * rows
+        if self.momentum == 0.0:
+            weight._data = w.at[idx].add((-lr * g).astype(w.dtype))
+        else:
+            mom = state._data
+            new_rows_mom = self.momentum * mom[idx] - lr * g
+            state._data = mom.at[idx].set(new_rows_mom)
+            weight._data = w.at[idx].add(new_rows_mom.astype(w.dtype))
+
+
+@register
+class NAG(Optimizer):
+    """Nesterov accelerated SGD (reference ``nag_mom_update``)."""
+
+    def __init__(self, momentum=0.0, learning_rate=None, **kwargs):
+        super().__init__(learning_rate=learning_rate
+                         if learning_rate is not None else 0.01, **kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        from .. import ndarray as nd
+
+        return nd.zeros(weight.shape, dtype=weight.dtype)
+
+    def _step(self, w, g, states, lr, wd, t):
+        g = self._prep_grad(g.astype(w.dtype), w, wd)
+        if self.momentum == 0.0:
+            return w - lr * g, ()
+        (mom,) = states
+        mom = self.momentum * mom + g
+        return w - lr * (g + self.momentum * mom), (mom,)
+
+
+@register
+class Adam(Optimizer):
+    """Adam (reference ``adam_update``; default lr 0.001)."""
+
+    def __init__(self, learning_rate=None, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, lazy_update=True, **kwargs):
+        super().__init__(learning_rate=learning_rate
+                         if learning_rate is not None else 0.001, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self.lazy_update = lazy_update
+
+    def create_state(self, index, weight):
+        from .. import ndarray as nd
+
+        dt = np.float32 if np.dtype(weight.dtype).name in (
+            "float16", "bfloat16") else weight.dtype
+        return (nd.zeros(weight.shape, dtype=dt),
+                nd.zeros(weight.shape, dtype=dt))
+
+    def _step(self, w, g, states, lr, wd, t):
+        import jax.numpy as jnp
+
+        m, v = states
+        g = self._prep_grad(g.astype(m.dtype), w.astype(m.dtype), wd)
+        m = self.beta1 * m + (1 - self.beta1) * g
+        v = self.beta2 * v + (1 - self.beta2) * jnp.square(g)
+        coef1 = 1.0 - self.beta1 ** t
+        coef2 = 1.0 - self.beta2 ** t
+        lr_t = lr * jnp.sqrt(coef2) / coef1
+        new_w = w - (lr_t * m / (jnp.sqrt(v) + self.epsilon)).astype(w.dtype)
+        return new_w, (m, v)
+
+    def _sparse_step(self, index, weight, grad, state, lr, wd, t):
+        import jax.numpy as jnp
+
+        m, v = state
+        idx, vals = grad.indices._data, grad.data._data
+        w = weight._data
+        g = vals * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        g = g + wd * w[idx]
+        m_rows = self.beta1 * m._data[idx] + (1 - self.beta1) * g
+        v_rows = self.beta2 * v._data[idx] + (1 - self.beta2) * jnp.square(g)
+        coef1 = 1.0 - self.beta1 ** t
+        coef2 = 1.0 - self.beta2 ** t
+        lr_t = lr * jnp.sqrt(coef2) / coef1
+        m._data = m._data.at[idx].set(m_rows)
+        v._data = v._data.at[idx].set(v_rows)
+        weight._data = w.at[idx].add(
+            (-lr_t * m_rows / (jnp.sqrt(v_rows) + self.epsilon)
+             ).astype(w.dtype))
+
+
+@register
+class AdamW(Adam):
+    """Adam with decoupled weight decay (reference contrib ``adamw_update``,
+    src/operator/contrib/adamw.cc:?)."""
+
+    def _step(self, w, g, states, lr, wd, t):
+        import jax.numpy as jnp
+
+        m, v = states
+        g = self._prep_grad(g.astype(m.dtype), w.astype(m.dtype), 0.0,
+                            include_wd=False)
+        m = self.beta1 * m + (1 - self.beta1) * g
+        v = self.beta2 * v + (1 - self.beta2) * jnp.square(g)
+        coef1 = 1.0 - self.beta1 ** t
+        coef2 = 1.0 - self.beta2 ** t
+        lr_t = lr * jnp.sqrt(coef2) / coef1
+        upd = lr_t * m / (jnp.sqrt(v) + self.epsilon) + lr * wd * w.astype(
+            m.dtype)
+        return w - upd.astype(w.dtype), (m, v)
+
+
+@register
+class LAMB(Optimizer):
+    """Layer-wise adaptive large-batch optimizer (reference ``lamb_update_
+    phase1/2``, src/operator/optimizer_op.cc:? — the BERT-large optimizer)."""
+
+    def __init__(self, learning_rate=None, beta1=0.9, beta2=0.999,
+                 epsilon=1e-6, lower_bound=None, upper_bound=None,
+                 bias_correction=True, **kwargs):
+        super().__init__(learning_rate=learning_rate
+                         if learning_rate is not None else 0.001, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self.lower_bound = lower_bound
+        self.upper_bound = upper_bound
+        self.bias_correction = bias_correction
+
+    def create_state(self, index, weight):
+        from .. import ndarray as nd
+
+        dt = np.float32 if np.dtype(weight.dtype).name in (
+            "float16", "bfloat16") else weight.dtype
+        return (nd.zeros(weight.shape, dtype=dt),
+                nd.zeros(weight.shape, dtype=dt))
+
+    def _step(self, w, g, states, lr, wd, t):
+        import jax.numpy as jnp
+
+        m, v = states
+        wf = w.astype(m.dtype)
+        g = self._prep_grad(g.astype(m.dtype), wf, 0.0, include_wd=False)
+        m = self.beta1 * m + (1 - self.beta1) * g
+        v = self.beta2 * v + (1 - self.beta2) * jnp.square(g)
+        if self.bias_correction:
+            mhat = m / (1 - self.beta1 ** t)
+            vhat = v / (1 - self.beta2 ** t)
+        else:
+            mhat, vhat = m, v
+        gprime = mhat / (jnp.sqrt(vhat) + self.epsilon) + wd * wf
+        r1 = jnp.linalg.norm(wf)
+        if self.lower_bound is not None:
+            r1 = jnp.maximum(r1, self.lower_bound)
+        if self.upper_bound is not None:
+            r1 = jnp.minimum(r1, self.upper_bound)
+        r2 = jnp.linalg.norm(gprime)
+        ratio = jnp.where((r1 > 0) & (r2 > 0), r1 / r2, 1.0)
+        return w - (lr * ratio * gprime).astype(w.dtype), (m, v)
+
+
+@register
+class RMSProp(Optimizer):
+    """RMSProp, centered and plain (reference ``rmsprop_update`` /
+    ``rmspropalex_update``)."""
+
+    def __init__(self, learning_rate=None, gamma1=0.9, gamma2=0.9,
+                 epsilon=1e-8, centered=False, clip_weights=None, **kwargs):
+        super().__init__(learning_rate=learning_rate
+                         if learning_rate is not None else 0.001, **kwargs)
+        self.gamma1 = gamma1
+        self.gamma2 = gamma2
+        self.centered = centered
+        self.epsilon = epsilon
+        self.clip_weights = clip_weights
+
+    def create_state(self, index, weight):
+        from .. import ndarray as nd
+
+        if self.centered:
+            return (nd.zeros(weight.shape, dtype=weight.dtype),  # n
+                    nd.zeros(weight.shape, dtype=weight.dtype),  # g
+                    nd.zeros(weight.shape, dtype=weight.dtype))  # delta
+        return (nd.zeros(weight.shape, dtype=weight.dtype),)
+
+    def _step(self, w, g, states, lr, wd, t):
+        import jax.numpy as jnp
+
+        g = self._prep_grad(g.astype(w.dtype), w, wd)
+        if not self.centered:
+            (n,) = states
+            n = (1 - self.gamma1) * jnp.square(g) + self.gamma1 * n
+            new_w = w - lr * g / jnp.sqrt(n + self.epsilon)
+        else:
+            n, gbar, delta = states
+            n = (1 - self.gamma1) * jnp.square(g) + self.gamma1 * n
+            gbar = (1 - self.gamma1) * g + self.gamma1 * gbar
+            delta = self.gamma2 * delta - lr * g / jnp.sqrt(
+                n - jnp.square(gbar) + self.epsilon)
+            new_w = w + delta
+        if self.clip_weights:
+            new_w = jnp.clip(new_w, -self.clip_weights, self.clip_weights)
+        return new_w, (n,) if not self.centered else (n, gbar, delta)
+
+
+@register
+class AdaGrad(Optimizer):
+    def __init__(self, learning_rate=None, eps=1e-7, **kwargs):
+        super().__init__(learning_rate=learning_rate
+                         if learning_rate is not None else 0.01, **kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        from .. import ndarray as nd
+
+        return nd.zeros(weight.shape, dtype=weight.dtype)
+
+    def _step(self, w, g, states, lr, wd, t):
+        import jax.numpy as jnp
+
+        (hist,) = states
+        g = self._prep_grad(g.astype(w.dtype), w, wd)
+        hist = hist + jnp.square(g)
+        return w - lr * g / (jnp.sqrt(hist) + self.float_stable_eps), (hist,)
+
+
+@register
+class AdaDelta(Optimizer):
+    def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.rho = rho
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        from .. import ndarray as nd
+
+        return (nd.zeros(weight.shape, dtype=weight.dtype),
+                nd.zeros(weight.shape, dtype=weight.dtype))
+
+    def _step(self, w, g, states, lr, wd, t):
+        import jax.numpy as jnp
+
+        acc_g, acc_delta = states
+        g = self._prep_grad(g.astype(w.dtype), w, wd)
+        acc_g = self.rho * acc_g + (1 - self.rho) * jnp.square(g)
+        delta = jnp.sqrt(acc_delta + self.epsilon) / jnp.sqrt(
+            acc_g + self.epsilon) * g
+        acc_delta = self.rho * acc_delta + (1 - self.rho) * jnp.square(delta)
+        return w - delta, (acc_g, acc_delta)
+
+
+@register
+class Ftrl(Optimizer):
+    """FTRL-proximal (reference ``ftrl_update`` — the sparse-friendly
+    L1-regularized optimizer for the factorization-machine config)."""
+
+    def __init__(self, lamda1=0.01, learning_rate=None, beta=1.0, **kwargs):
+        super().__init__(learning_rate=learning_rate
+                         if learning_rate is not None else 0.1, **kwargs)
+        self.lamda1 = lamda1
+        self.beta = beta
+
+    def create_state(self, index, weight):
+        from .. import ndarray as nd
+
+        return (nd.zeros(weight.shape, dtype=weight.dtype),  # z
+                nd.zeros(weight.shape, dtype=weight.dtype))  # n
+
+    def _step(self, w, g, states, lr, wd, t):
+        import jax.numpy as jnp
+
+        z, n = states
+        g = self._prep_grad(g.astype(w.dtype), w, 0.0, include_wd=False)
+        sq = jnp.square(g)
+        sigma = (jnp.sqrt(n + sq) - jnp.sqrt(n)) / lr
+        z = z + g - sigma * w
+        n = n + sq
+        new_w = jnp.where(
+            jnp.abs(z) > self.lamda1,
+            -(z - jnp.sign(z) * self.lamda1) /
+            ((self.beta + jnp.sqrt(n)) / lr + wd),
+            jnp.zeros_like(w))
+        return new_w, (z, n)
+
+
+@register
+class Signum(Optimizer):
+    """signSGD with momentum (reference ``signum_update``)."""
+
+    def __init__(self, learning_rate=None, momentum=0.9, wd_lh=0.0,
+                 **kwargs):
+        super().__init__(learning_rate=learning_rate
+                         if learning_rate is not None else 0.01, **kwargs)
+        self.momentum = momentum
+        self.wd_lh = wd_lh
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        from .. import ndarray as nd
+
+        return nd.zeros(weight.shape, dtype=weight.dtype)
+
+    def _step(self, w, g, states, lr, wd, t):
+        import jax.numpy as jnp
+
+        g = self._prep_grad(g.astype(w.dtype), w, wd)
+        if self.momentum == 0.0:
+            return w - lr * jnp.sign(g), ()
+        (mom,) = states
+        mom = self.momentum * mom - (1 - self.momentum) * g
+        new_w = (1 - lr * self.wd_lh) * w + lr * jnp.sign(mom)
+        return new_w, (mom,)
+
+
+@register
+class SignSGD(Signum):
+    def __init__(self, learning_rate=None, **kwargs):
+        super().__init__(learning_rate=learning_rate, momentum=0.0, **kwargs)
+
+
+@register
+class LARS(Optimizer):
+    """Layer-wise adaptive rate scaling (reference contrib ``lars``-flavoured
+    multi_sgd path; large-batch ResNet optimizer)."""
+
+    def __init__(self, learning_rate=None, momentum=0.9, eta=0.001,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate=learning_rate
+                         if learning_rate is not None else 0.1, **kwargs)
+        self.momentum = momentum
+        self.eta = eta
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        from .. import ndarray as nd
+
+        return nd.zeros(weight.shape, dtype=weight.dtype)
+
+    def _step(self, w, g, states, lr, wd, t):
+        import jax.numpy as jnp
+
+        (mom,) = states
+        g = self._prep_grad(g.astype(w.dtype), w, 0.0, include_wd=False)
+        w_norm = jnp.linalg.norm(w)
+        g_norm = jnp.linalg.norm(g)
+        trust = jnp.where(
+            (w_norm > 0) & (g_norm > 0),
+            self.eta * w_norm / (g_norm + wd * w_norm + self.epsilon), 1.0)
+        g = g + wd * w
+        mom = self.momentum * mom + lr * trust * g
+        return w - mom, (mom,)
+
+
+@register
+class Test(Optimizer):
+    """Reference test optimizer: w -= lr * grad, no frills."""
+
+    def create_state(self, index, weight):
+        return None
+
+    def _step(self, w, g, states, lr, wd, t):
+        return w - lr * (g * self.rescale_grad).astype(w.dtype), ()
+
+
+class Updater:
+    """Applies an optimizer imperatively per (index, grad, weight) triple —
+    the reference's kvstore-side updater closure (``mx.optimizer.
+    get_updater``, used by ``update_on_kvstore=True``)."""
+
+    def __init__(self, optimizer):
+        self.optimizer = optimizer
+        self.states = {}
+        self.states_synced = {}
+        self.aggregate_updates = optimizer.aggregate_num > 0
+
+    def __call__(self, index, grad, weight):
+        if index not in self.states:
+            self.states[index] = \
+                self.optimizer.create_state_multi_precision(index, weight)
+            self.states_synced[index] = True
+        self.optimizer.update_multi_precision(index, weight, grad,
+                                              self.states[index])
+
+    def get_states(self, dump_optimizer=False):
+        import pickle
+
+        states = {k: _states_to_numpy(v) for k, v in self.states.items()}
+        if dump_optimizer:
+            return pickle.dumps((states, _OptimizerConfig(self.optimizer)))
+        return pickle.dumps(states)
+
+    def set_states(self, states):
+        import pickle
+
+        loaded = pickle.loads(states)
+        if isinstance(loaded, tuple):
+            loaded = loaded[0]
+        self.states = {k: _states_from_numpy(v) for k, v in loaded.items()}
+        self.states_synced = {k: True for k in self.states}
+
+
+class _OptimizerConfig:
+    def __init__(self, opt):
+        self.name = type(opt).__name__.lower()
+
+
+def _states_to_numpy(state):
+    if state is None:
+        return None
+    if isinstance(state, NDArray):
+        return state.asnumpy()
+    return tuple(_states_to_numpy(s) for s in state)
+
+
+def _states_from_numpy(state):
+    if state is None:
+        return None
+    if isinstance(state, np.ndarray):
+        return NDArray(state)
+    return tuple(_states_from_numpy(s) for s in state)
+
+
+def get_updater(optimizer):
+    return Updater(optimizer)

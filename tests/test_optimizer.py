@@ -1,0 +1,289 @@
+"""Optimizer + Trainer + lr_scheduler tests (modeled on the reference's
+tests/python/unittest/test_optimizer.py:? — update math vs numpy
+references, multi-precision, trainer integration)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.gluon import nn
+
+
+def _nd(x):
+    return nd.array(np.asarray(x, np.float32))
+
+
+def test_sgd_matches_numpy():
+    w = _nd([1.0, 2.0, 3.0])
+    g = _nd([0.1, 0.2, 0.3])
+    o = mx.optimizer.SGD(learning_rate=0.5, wd=0.01)
+    state = o.create_state(0, w)
+    o.update(0, w, g, state)
+    expect = np.array([1, 2, 3]) - 0.5 * (
+        np.array([0.1, 0.2, 0.3]) + 0.01 * np.array([1, 2, 3]))
+    assert np.allclose(w.asnumpy(), expect, atol=1e-6)
+
+
+def test_sgd_momentum():
+    w = _nd([1.0])
+    g = _nd([1.0])
+    o = mx.optimizer.SGD(learning_rate=0.1, momentum=0.9)
+    state = o.create_state(0, w)
+    o.update(0, w, g, state)
+    assert np.allclose(w.asnumpy(), [0.9])
+    o.update(0, w, g, state)
+    # mom = 0.9*(-0.1) - 0.1*1 = -0.19 → w = 0.9 - 0.19 = 0.71
+    assert np.allclose(w.asnumpy(), [0.71], atol=1e-6)
+
+
+def test_sgd_clip_gradient():
+    w = _nd([0.0])
+    g = _nd([100.0])
+    o = mx.optimizer.SGD(learning_rate=1.0, clip_gradient=1.0)
+    o.update(0, w, g, o.create_state(0, w))
+    assert np.allclose(w.asnumpy(), [-1.0])
+
+
+def test_sgd_multi_precision():
+    w16 = nd.array(np.array([1.0, 2.0]), dtype=np.float16)
+    g16 = nd.array(np.array([0.5, 0.5]), dtype=np.float16)
+    o = mx.optimizer.SGD(learning_rate=0.1, momentum=0.9,
+                         multi_precision=True)
+    state = o.create_state_multi_precision(0, w16)
+    master, _ = state
+    assert master.dtype == np.float32
+    o.update_multi_precision(0, w16, g16, state)
+    assert w16.dtype == np.float16
+    assert np.allclose(master.asnumpy(), [0.95, 1.95], atol=1e-3)
+
+
+def test_adam_matches_numpy():
+    w = _nd([1.0, -1.0])
+    g = _nd([0.3, -0.7])
+    o = mx.optimizer.Adam(learning_rate=0.01, beta1=0.9, beta2=0.999,
+                          epsilon=1e-8)
+    state = o.create_state(0, w)
+    o.update(0, w, g, state)
+    m = 0.1 * np.array([0.3, -0.7])
+    v = 0.001 * np.array([0.3, -0.7]) ** 2
+    lr_t = 0.01 * np.sqrt(1 - 0.999) / (1 - 0.9)
+    expect = np.array([1.0, -1.0]) - lr_t * m / (np.sqrt(v) + 1e-8)
+    assert np.allclose(w.asnumpy(), expect, atol=1e-6)
+
+
+def test_adamw_decoupled_wd():
+    w = _nd([1.0])
+    g = _nd([0.0])
+    o = mx.optimizer.AdamW(learning_rate=0.1, wd=0.1)
+    state = o.create_state(0, w)
+    o.update(0, w, g, state)
+    # zero grad → update is pure decoupled decay: w -= lr*wd*w
+    assert np.allclose(w.asnumpy(), [1.0 - 0.1 * 0.1 * 1.0], atol=1e-6)
+
+
+def test_lamb_runs_and_descends():
+    w = _nd(np.ones(10))
+    o = mx.optimizer.LAMB(learning_rate=0.01)
+    state = o.create_state(0, w)
+    for _ in range(3):
+        g = w * 2.0  # grad of sum(w^2)
+        o.update(0, w, g, state)
+    assert (w.asnumpy() < 1.0).all()
+
+
+@pytest.mark.parametrize("name", ["rmsprop", "adagrad", "adadelta", "ftrl",
+                                  "signum", "nag", "lars", "signsgd"])
+def test_optimizers_descend_quadratic(name):
+    o = mx.optimizer.create(name)
+    w = _nd(np.linspace(-2, 2, 8))
+    state = o.create_state_multi_precision(0, w)
+    f0 = float((w * w).sum().asscalar())
+    for _ in range(20):
+        g = 2.0 * w
+        o.update_multi_precision(0, w, g, state)
+    f1 = float((w * w).sum().asscalar())
+    assert f1 < f0, f"{name}: {f0} -> {f1}"
+
+
+def test_optimizer_registry_and_create():
+    o = mx.optimizer.create("sgd", learning_rate=0.25)
+    assert isinstance(o, mx.optimizer.SGD)
+    assert o.learning_rate == 0.25
+    with pytest.raises(Exception):
+        mx.optimizer.create("nope")
+
+
+def test_lr_scheduler_factor():
+    s = mx.lr_scheduler.FactorScheduler(step=10, factor=0.5, base_lr=1.0)
+    assert s(1) == 1.0
+    assert s(11) == 0.5
+    assert s(21) == 0.25
+
+
+def test_lr_scheduler_multifactor():
+    s = mx.lr_scheduler.MultiFactorScheduler(step=[5, 15], factor=0.1,
+                                             base_lr=1.0)
+    assert s(2) == 1.0
+    assert np.isclose(s(6), 0.1)
+    assert np.isclose(s(16), 0.01)
+
+
+def test_lr_scheduler_warmup_cosine():
+    s = mx.lr_scheduler.CosineScheduler(max_update=100, base_lr=1.0,
+                                        final_lr=0.0, warmup_steps=10,
+                                        warmup_begin_lr=0.0)
+    assert s(0) == 0.0
+    assert s(5) == 0.5
+    assert np.isclose(s(10), 1.0, atol=1e-6)
+    assert np.isclose(s(100), 0.0, atol=1e-6)
+    mid = s(55)
+    assert 0.4 < mid < 0.6
+
+
+def test_optimizer_lr_scheduler_integration():
+    sched = mx.lr_scheduler.FactorScheduler(step=2, factor=0.1, base_lr=1.0)
+    o = mx.optimizer.SGD(learning_rate=1.0, lr_scheduler=sched)
+    w = _nd([10.0])
+    state = o.create_state(0, w)
+    for _ in range(5):
+        o.update(0, w, _nd([0.0]), state)
+    assert o.learning_rate < 1.0
+
+
+def test_trainer_converges_linear_regression():
+    mx.random.seed(3)
+    true_w = np.array([[2.0], [-3.4]])
+    x = np.random.randn(64, 2).astype(np.float32)
+    y = (x @ true_w + 4.2).astype(np.float32)
+
+    net = nn.Dense(1, in_units=2)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    loss_fn = gluon.loss.L2Loss()
+    for _ in range(60):
+        with autograd.record():
+            loss = loss_fn(net(nd.array(x)), nd.array(y))
+        loss.backward()
+        trainer.step(64)
+    got_w = net.weight.data().asnumpy().ravel()
+    got_b = net.bias.data().asnumpy().ravel()
+    assert np.allclose(got_w, true_w.ravel(), atol=0.1)
+    assert np.allclose(got_b, [4.2], atol=0.1)
+
+
+def test_trainer_hybridized_training_step():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(8, activation="relu", in_units=4),
+                nn.Dense(1, in_units=8))
+    net.initialize()
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.01})
+    loss_fn = gluon.loss.L2Loss()
+    x = mx.random.uniform(shape=(16, 4))
+    y = x.sum(axis=1, keepdims=True)
+    losses = []
+    for _ in range(100):
+        with autograd.record():
+            loss = loss_fn(net(x), y)
+        loss.backward()
+        trainer.step(16)
+        losses.append(float(loss.mean().asscalar()))
+    assert losses[-1] < losses[0] * 0.3
+
+
+def test_trainer_save_load_states(tmp_path):
+    net = nn.Dense(2, in_units=2)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.1})
+    x = nd.ones((4, 2))
+    with autograd.record():
+        loss = gluon.loss.L2Loss()(net(x), nd.zeros((4, 2)))
+    loss.backward()
+    trainer.step(4)
+    f = str(tmp_path / "trainer.states")
+    trainer.save_states(f)
+    n_update = trainer.optimizer.num_update
+
+    trainer2 = gluon.Trainer(net.collect_params(), "adam",
+                             {"learning_rate": 0.1})
+    trainer2.load_states(f)
+    assert trainer2.optimizer.num_update == n_update
+
+
+def test_trainer_kvstore_none():
+    net = nn.Dense(1, in_units=2)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1}, kvstore=None)
+    with autograd.record():
+        loss = net(nd.ones((2, 2))).sum()
+    loss.backward()
+    w0 = net.weight.data().asnumpy().copy()
+    trainer.step(2)
+    assert not np.allclose(w0, net.weight.data().asnumpy())
+
+
+def test_trainer_lr_mult():
+    net = nn.Dense(1, in_units=1, use_bias=False)
+    net.initialize(mx.init.One())
+    net.weight.lr_mult = 0.0
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 1.0})
+    with autograd.record():
+        loss = net(nd.ones((1, 1))).sum()
+    loss.backward()
+    trainer.step(1)
+    assert np.allclose(net.weight.data().asnumpy(), 1.0)
+
+
+def test_kvstore_push_pull():
+    kv = mx.kv.create("local")
+    kv.init(3, nd.ones((2, 3)))
+    out = nd.zeros((2, 3))
+    kv.pull(3, out=out)
+    assert np.allclose(out.asnumpy(), 1)
+    kv.push(3, [nd.ones((2, 3)), nd.ones((2, 3)) * 2])
+    kv.pull(3, out=out)
+    assert np.allclose(out.asnumpy(), 3)
+
+
+def test_kvstore_updater():
+    kv = mx.kv.create("device")
+    kv.init("w", nd.ones((2,)))
+
+    def updater(key, grad, weight):
+        weight -= 0.1 * grad
+
+    kv.set_updater(updater)
+    kv.push("w", nd.ones((2,)))
+    out = nd.zeros((2,))
+    kv.pull("w", out=out)
+    assert np.allclose(out.asnumpy(), 0.9)
+
+
+def test_kvstore_row_sparse_pull():
+    from mxnet_tpu.ndarray import sparse as sp
+
+    kv = mx.kv.create("local")
+    kv.init(0, nd.arange(0, 12).reshape((4, 3)))
+    out = nd.zeros((4, 3))
+    kv.row_sparse_pull(0, out=out, row_ids=nd.array([1, 3]))
+    got = out.asnumpy()
+    assert np.allclose(got[1], [3, 4, 5])
+    assert np.allclose(got[0], 0)
+
+
+def test_sparse_sgd_lazy_update():
+    from mxnet_tpu.ndarray import sparse as sp
+
+    w = nd.ones((4, 2))
+    grad = sp.RowSparseNDArray(nd.ones((1, 2)), nd.array([2]), (4, 2))
+    o = mx.optimizer.SGD(learning_rate=0.5)
+    o.update(0, w, grad, o.create_state(0, w))
+    got = w.asnumpy()
+    assert np.allclose(got[2], 0.5 - 0.0)  # 1 - 0.5*1
+    assert np.allclose(got[0], 1.0)
