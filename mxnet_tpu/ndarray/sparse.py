@@ -21,6 +21,16 @@ from ..base import MXNetError
 from .ndarray import NDArray
 
 
+def _as_index_array(indices):
+    """Coerce indices to an int64 NDArray (the reference stores aux indices
+    as int64; float inputs — e.g. ``nd.array([...])`` defaults — are cast)."""
+    if isinstance(indices, NDArray):
+        if np.issubdtype(indices.dtype, np.integer):
+            return indices
+        return NDArray(indices._data.astype(np.int64))
+    return NDArray(np.asarray(indices).astype(np.int64))
+
+
 class BaseSparseNDArray:
     @property
     def stype(self):
@@ -41,8 +51,7 @@ class RowSparseNDArray(BaseSparseNDArray):
 
     def __init__(self, data, indices, shape):
         self.data = data if isinstance(data, NDArray) else NDArray(data)
-        self.indices = (indices if isinstance(indices, NDArray)
-                        else NDArray(indices, dtype=np.int64))
+        self.indices = _as_index_array(indices)
         self._shape = tuple(shape)
 
     @property
@@ -112,10 +121,8 @@ class CSRNDArray(BaseSparseNDArray):
 
     def __init__(self, data, indices, indptr, shape):
         self.data = data if isinstance(data, NDArray) else NDArray(data)
-        self.indices = (indices if isinstance(indices, NDArray)
-                        else NDArray(indices, dtype=np.int64))
-        self.indptr = (indptr if isinstance(indptr, NDArray)
-                       else NDArray(indptr, dtype=np.int64))
+        self.indices = _as_index_array(indices)
+        self.indptr = _as_index_array(indptr)
         self._shape = tuple(shape)
 
     @property
