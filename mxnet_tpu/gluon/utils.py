@@ -38,11 +38,23 @@ def split_data(data, num_slice, batch_axis=0, even_split=True):
 
 def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
     """Slice a batch across contexts (reference:
-    ``gluon.utils.split_and_load``)."""
+    ``gluon.utils.split_and_load``).
+
+    TPU-native: when a device mesh is active and more than one context is
+    requested, the batch becomes ONE mesh-sharded array returned as a
+    single-element list — reference training loops (``for x in
+    split_and_load(...)``) run unchanged, executing once over the whole
+    mesh with XLA inserting the collectives."""
     if not isinstance(data, NDArray):
         data = NDArray(np.asarray(data))
     if isinstance(ctx_list, Context):
         ctx_list = [ctx_list]
+    if len(ctx_list) > 1:
+        from .. import parallel
+
+        mesh = parallel.current_mesh()
+        if mesh is not None:
+            return [parallel.shard_batch(data, mesh, axis=batch_axis)]
     if len(ctx_list) == 1:
         return [data.as_in_context(ctx_list[0])]
     slices = split_data(data, len(ctx_list), batch_axis, even_split)
