@@ -1,0 +1,108 @@
+"""Metric + callback tests (reference: tests/python/unittest/test_metric.py:?)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_accuracy():
+    m = mx.metric.Accuracy()
+    m.update(nd.array([0, 1, 2]), nd.array([[1, 0, 0], [0, 1, 0],
+                                            [0, 0, 1]]))
+    assert m.get() == ("accuracy", 1.0)
+    m.update(nd.array([0, 0]), nd.array([[0, 1], [0, 1]]))
+    assert np.isclose(m.get()[1], 3 / 5)
+    m.reset()
+    assert np.isnan(m.get()[1])
+
+
+def test_topk_accuracy():
+    m = mx.metric.TopKAccuracy(top_k=2)
+    pred = nd.array([[0.1, 0.5, 0.4], [0.6, 0.3, 0.1]])
+    m.update(nd.array([2, 1]), pred)  # both in top-2
+    assert np.isclose(m.get()[1], 1.0)
+    m.update(nd.array([0]), nd.array([[0.1, 0.5, 0.4]]))
+    assert np.isclose(m.get()[1], 2 / 3)
+
+
+def test_f1_and_mcc():
+    f1 = mx.metric.F1()
+    mcc = mx.metric.MCC()
+    label = nd.array([1, 0, 1, 1])
+    pred = nd.array([[0.2, 0.8], [0.9, 0.1], [0.4, 0.6], [0.6, 0.4]])
+    f1.update(label, pred)
+    mcc.update(label, pred)
+    # tp=2 fp=0 fn=1 tn=1 → precision 1, recall 2/3, f1 = 0.8
+    assert np.isclose(f1.get()[1], 0.8)
+    assert -1 <= mcc.get()[1] <= 1
+
+
+def test_mae_mse_rmse():
+    label = nd.array([1.0, 2.0])
+    pred = nd.array([1.5, 1.0])
+    mae = mx.metric.MAE()
+    mae.update(label, pred)
+    assert np.isclose(mae.get()[1], 0.75)
+    mse = mx.metric.MSE()
+    mse.update(label, pred)
+    assert np.isclose(mse.get()[1], (0.25 + 1.0) / 2)
+    rmse = mx.metric.RMSE()
+    rmse.update(label, pred)
+    assert np.isclose(rmse.get()[1], np.sqrt(0.625))
+
+
+def test_perplexity_and_crossentropy():
+    probs = nd.array([[0.5, 0.5], [0.9, 0.1]])
+    label = nd.array([0, 0])
+    p = mx.metric.Perplexity()
+    p.update(label, probs)
+    expect = np.exp(-(np.log(0.5) + np.log(0.9)) / 2)
+    assert np.isclose(p.get()[1], expect, atol=1e-5)
+    ce = mx.metric.CrossEntropy()
+    ce.update(label, probs)
+    assert np.isclose(ce.get()[1], -(np.log(0.5) + np.log(0.9)) / 2,
+                      atol=1e-5)
+
+
+def test_composite_and_create():
+    m = mx.metric.create(["acc", "ce"])
+    m.update([nd.array([1])], [nd.array([[0.1, 0.9]])])
+    names, values = m.get()
+    assert names == ["accuracy", "cross-entropy"]
+    m2 = mx.metric.create("top_k_accuracy", top_k=3)
+    assert m2.top_k == 3
+
+
+def test_custom_metric():
+    def feval(label, pred):
+        return float(np.abs(label - pred).sum())
+
+    m = mx.metric.CustomMetric(feval, name="absdiff")
+    m.update(nd.array([1.0]), nd.array([0.5]))
+    assert np.isclose(m.get()[1], 0.5)
+
+
+def test_pearson():
+    m = mx.metric.PearsonCorrelation()
+    m.update(nd.array([1.0, 2, 3, 4]), nd.array([1.1, 2.2, 2.9, 4.3]))
+    assert m.get()[1] > 0.99
+
+
+def test_loss_metric():
+    m = mx.metric.Loss()
+    m.update(None, nd.array([1.0, 3.0]))
+    assert np.isclose(m.get()[1], 2.0)
+
+
+def test_speedometer_runs(caplog):
+    import logging
+
+    from mxnet_tpu.callback import Speedometer, BatchEndParam
+
+    sp = Speedometer(batch_size=4, frequent=1)
+    metric = mx.metric.Accuracy()
+    metric.update(nd.array([0]), nd.array([[1.0, 0.0]]))
+    with caplog.at_level(logging.INFO):
+        sp(BatchEndParam(epoch=0, nbatch=0, eval_metric=metric))
+        sp(BatchEndParam(epoch=0, nbatch=1, eval_metric=metric))
+    assert any("samples/sec" in r.message for r in caplog.records)

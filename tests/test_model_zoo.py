@@ -1,0 +1,85 @@
+"""Model-zoo tests (reference: tests/python/unittest/test_gluon_model_zoo.py:?
+— construct every model, forward-check representative ones)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.gluon.model_zoo import vision
+
+
+@pytest.mark.parametrize("name", [
+    "resnet18_v1", "resnet50_v1", "resnet18_v2", "resnet50_v2",
+    "vgg11", "vgg11_bn", "alexnet", "densenet121", "squeezenet1.0",
+    "squeezenet1.1", "mobilenet1.0", "mobilenet0.25", "mobilenetv2_1.0",
+    "inceptionv3",
+])
+def test_models_construct(name):
+    net = vision.get_model(name, classes=10)
+    params = net.collect_params()
+    assert len(params) > 0
+
+
+def test_get_model_unknown():
+    with pytest.raises(Exception):
+        vision.get_model("resnet9999")
+
+
+def test_resnet18_forward_and_backward():
+    net = vision.resnet18_v1(classes=10)
+    net.initialize()
+    x = mx.random.uniform(shape=(2, 3, 32, 32))
+    with autograd.record():
+        out = net(x)
+        loss = out.sum()
+    loss.backward()
+    assert out.shape == (2, 10)
+    g = net.features[0].weight.grad()
+    assert np.abs(g.asnumpy()).sum() > 0
+
+
+def test_resnet18_v2_forward():
+    net = vision.resnet18_v2(classes=7)
+    net.initialize()
+    out = net(nd.ones((1, 3, 32, 32)))
+    assert out.shape == (1, 7)
+
+
+def test_resnet_thumbnail():
+    net = vision.get_model("resnet18_v1", classes=10, thumbnail=True)
+    net.initialize()
+    out = net(nd.ones((2, 3, 32, 32)))
+    assert out.shape == (2, 10)
+
+
+def test_mobilenet_forward():
+    net = vision.mobilenet0_25(classes=5)
+    net.initialize()
+    out = net(nd.ones((1, 3, 64, 64)))
+    assert out.shape == (1, 5)
+
+
+def test_squeezenet_forward():
+    net = vision.squeezenet1_1(classes=4)
+    net.initialize()
+    out = net(nd.ones((1, 3, 64, 64)))
+    assert out.shape == (1, 4)
+
+
+def test_resnet_hybridized_training_step():
+    net = vision.resnet18_v1(classes=10, thumbnail=True)
+    net.initialize(mx.init.Xavier())
+    net.hybridize(static_alloc=True)
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1, "momentum": 0.9})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    x = mx.random.uniform(shape=(4, 3, 32, 32))
+    y = nd.array([0, 1, 2, 3])
+    losses = []
+    for _ in range(5):
+        with autograd.record():
+            loss = loss_fn(net(x), y)
+        loss.backward()
+        trainer.step(4)
+        losses.append(float(loss.mean().asscalar()))
+    assert losses[-1] < losses[0]
