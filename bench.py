@@ -1,0 +1,81 @@
+"""Benchmark: ResNet-50 v1 training throughput, images/sec/chip.
+
+Measurement protocol (BASELINE.md): synthetic data, hybridized net under
+``gluon.Trainer`` (sgd+momentum), steady state after warmup (compile)
+steps; images/sec = batch x steps / wall.  ``vs_baseline`` is measured
+against the reference's published number, which was unrecoverable (empty
+reference mount — BASELINE.md); reported as 0.0 meaning "no baseline
+available", NOT parity.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+
+
+def main():
+    # BENCH_PLATFORM=cpu forces the XLA CPU backend for local sanity runs
+    # (the env-var route is pinned by the host sitecustomize; only the
+    # pre-init config update wins)
+    plat = os.environ.get("BENCH_PLATFORM")
+    if plat:
+        import jax
+
+        jax.config.update("jax_platforms", plat)
+
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon, nd
+
+    batch = int(os.environ.get("BENCH_BATCH", "32"))
+    steps = int(os.environ.get("BENCH_STEPS", "20"))
+    warmup = int(os.environ.get("BENCH_WARMUP", "3"))
+    image = int(os.environ.get("BENCH_IMAGE", "224"))
+    model = os.environ.get("BENCH_MODEL", "resnet50_v1")
+
+    mx.random.seed(0)
+    net = gluon.model_zoo.vision.get_model(model, classes=1000)
+    net.initialize(mx.init.Xavier())
+    net.hybridize(static_alloc=True, static_shape=True)
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1, "momentum": 0.9})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    x = mx.random.uniform(shape=(batch, 3, image, image))
+    y = nd.array(np.random.randint(0, 1000, (batch,)))
+
+    def step():
+        with autograd.record():
+            loss = loss_fn(net(x), y)
+        loss.backward()
+        trainer.step(batch)
+        return loss
+
+    for _ in range(warmup):
+        step().wait_to_read()
+    nd.waitall()
+
+    tic = time.time()
+    last = None
+    for _ in range(steps):
+        last = step()
+    last.wait_to_read()
+    nd.waitall()
+    wall = time.time() - tic
+
+    ips = batch * steps / wall
+    print(json.dumps({
+        "metric": f"{model}_train_images_per_sec_per_chip",
+        "value": round(ips, 2),
+        "unit": "images/sec/chip",
+        # reference baseline unrecoverable (BASELINE.md): 0.0 = no baseline
+        "vs_baseline": 0.0,
+    }))
+
+
+if __name__ == "__main__":
+    main()

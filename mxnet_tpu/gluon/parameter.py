@@ -125,6 +125,12 @@ class Parameter:
         if initializer is None:
             initializer = init_mod.Uniform()
         initializer(desc, arr)
+        # under an active device mesh, parameters are born replicated so
+        # GSPMD derives the gradient all-reduce (mxnet_tpu/parallel)
+        from .. import parallel
+
+        if parallel.current_mesh() is not None:
+            parallel.replicate(arr)
         self._data = arr
         self._deferred_init = None
         if self._grad_req != "null":

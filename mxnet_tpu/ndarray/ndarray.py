@@ -40,8 +40,41 @@ def _ctx_from_raw(raw) -> Context:
     return Context("tpu", dev.id)
 
 
+def _is_tracer(x):
+    import jax.core
+
+    return isinstance(x, jax.core.Tracer)
+
+
+def creation_place(raw, ctx=None):
+    """Placement for newly-created arrays.
+
+    Under an active device mesh (mxnet_tpu/parallel) the mesh IS the
+    context: creations land replicated over it so eager math against
+    mesh-placed parameters stays consistent — the TPU analog of the
+    reference's default-ctx placement.  Otherwise place on ``ctx`` when
+    given.  Tracers (inside a CachedOp jit) pass through untouched."""
+    import jax
+
+    if _is_tracer(raw):
+        return raw
+    from .. import parallel
+
+    mesh = parallel.current_mesh()
+    if mesh is not None:
+        return jax.device_put(raw, jax.sharding.NamedSharding(
+            mesh, jax.sharding.PartitionSpec()))
+    if ctx is not None:
+        return jax.device_put(raw, ctx.device)
+    return raw
+
+
 def _to_raw(value, dtype=None, ctx=None):
-    """Coerce python/numpy input to a jax.Array (on ctx if given)."""
+    """Coerce python/numpy input to a jax.Array (on ctx if given).
+
+    Placement rule: host payloads and explicit-ctx requests go through
+    ``creation_place`` (mesh-aware); device arrays with no ctx — op
+    outputs — keep their propagated sharding untouched."""
     import jax
     import jax.numpy as jnp
 
@@ -49,13 +82,16 @@ def _to_raw(value, dtype=None, ctx=None):
         raw = value._data
         if dtype is not None and np.dtype(dtype) != raw.dtype:
             raw = raw.astype(dtype)
-    else:
-        if dtype is None and isinstance(value, (list, tuple, float, int)):
-            # MXNet semantics: python payloads always become float32
-            dtype = np.float32
-        raw = jnp.asarray(value, dtype=dtype)
-    if ctx is not None:
-        raw = jax.device_put(raw, ctx.device)
+        if ctx is not None:
+            raw = creation_place(raw, ctx)
+        return raw
+    is_device = isinstance(value, jax.Array)
+    if dtype is None and isinstance(value, (list, tuple, float, int)):
+        # MXNet semantics: python payloads always become float32
+        dtype = np.float32
+    raw = jnp.asarray(value, dtype=dtype)
+    if not is_device or ctx is not None:
+        raw = creation_place(raw, ctx)
     return raw
 
 

@@ -33,6 +33,21 @@ def _f32(x):
     return x.astype(np.float32) if x.dtype != np.float32 else x
 
 
+def _state_zeros(weight, dtype=None):
+    """Zeros matching the weight's shape AND device/mesh placement, so
+    optimizer state lives wherever the parameter lives (replicated or
+    sharded over the mesh)."""
+    import jax
+    import jax.numpy as jnp
+
+    raw = jnp.zeros(weight.shape, dtype or weight.dtype)
+    try:
+        raw = jax.device_put(raw, weight._data.sharding)
+    except Exception:
+        pass
+    return NDArray(raw)
+
+
 class Optimizer:
     """Base optimizer (reference: ``mx.optimizer.Optimizer``)."""
 
@@ -264,11 +279,9 @@ class SGD(Optimizer):
     def create_state(self, index, weight):
         if self.momentum == 0.0:
             return None
-        from .. import ndarray as nd
-
-        return nd.zeros(weight.shape, dtype=np.float32
-                        if np.dtype(weight.dtype).name in
-                        ("float16", "bfloat16") else weight.dtype)
+        return _state_zeros(
+            weight, np.float32 if np.dtype(weight.dtype).name in
+            ("float16", "bfloat16") else weight.dtype)
 
     def _step(self, w, g, states, lr, wd, t):
         g = self._prep_grad(g.astype(w.dtype), w, wd)
@@ -311,9 +324,7 @@ class NAG(Optimizer):
     def create_state(self, index, weight):
         if self.momentum == 0.0:
             return None
-        from .. import ndarray as nd
-
-        return nd.zeros(weight.shape, dtype=weight.dtype)
+        return _state_zeros(weight)
 
     def _step(self, w, g, states, lr, wd, t):
         g = self._prep_grad(g.astype(w.dtype), w, wd)
@@ -338,12 +349,9 @@ class Adam(Optimizer):
         self.lazy_update = lazy_update
 
     def create_state(self, index, weight):
-        from .. import ndarray as nd
-
         dt = np.float32 if np.dtype(weight.dtype).name in (
             "float16", "bfloat16") else weight.dtype
-        return (nd.zeros(weight.shape, dtype=dt),
-                nd.zeros(weight.shape, dtype=dt))
+        return (_state_zeros(weight, dt), _state_zeros(weight, dt))
 
     def _step(self, w, g, states, lr, wd, t):
         import jax.numpy as jnp
@@ -419,12 +427,9 @@ class LAMB(Optimizer):
         self.bias_correction = bias_correction
 
     def create_state(self, index, weight):
-        from .. import ndarray as nd
-
         dt = np.float32 if np.dtype(weight.dtype).name in (
             "float16", "bfloat16") else weight.dtype
-        return (nd.zeros(weight.shape, dtype=dt),
-                nd.zeros(weight.shape, dtype=dt))
+        return (_state_zeros(weight, dt), _state_zeros(weight, dt))
 
     def _step(self, w, g, states, lr, wd, t):
         import jax.numpy as jnp
@@ -466,13 +471,10 @@ class RMSProp(Optimizer):
         self.clip_weights = clip_weights
 
     def create_state(self, index, weight):
-        from .. import ndarray as nd
-
         if self.centered:
-            return (nd.zeros(weight.shape, dtype=weight.dtype),  # n
-                    nd.zeros(weight.shape, dtype=weight.dtype),  # g
-                    nd.zeros(weight.shape, dtype=weight.dtype))  # delta
-        return (nd.zeros(weight.shape, dtype=weight.dtype),)
+            return (_state_zeros(weight), _state_zeros(weight),
+                    _state_zeros(weight))
+        return (_state_zeros(weight),)
 
     def _step(self, w, g, states, lr, wd, t):
         import jax.numpy as jnp
@@ -502,9 +504,7 @@ class AdaGrad(Optimizer):
         self.float_stable_eps = eps
 
     def create_state(self, index, weight):
-        from .. import ndarray as nd
-
-        return nd.zeros(weight.shape, dtype=weight.dtype)
+        return _state_zeros(weight)
 
     def _step(self, w, g, states, lr, wd, t):
         import jax.numpy as jnp
@@ -523,10 +523,7 @@ class AdaDelta(Optimizer):
         self.epsilon = epsilon
 
     def create_state(self, index, weight):
-        from .. import ndarray as nd
-
-        return (nd.zeros(weight.shape, dtype=weight.dtype),
-                nd.zeros(weight.shape, dtype=weight.dtype))
+        return (_state_zeros(weight), _state_zeros(weight))
 
     def _step(self, w, g, states, lr, wd, t):
         import jax.numpy as jnp
@@ -552,10 +549,8 @@ class Ftrl(Optimizer):
         self.beta = beta
 
     def create_state(self, index, weight):
-        from .. import ndarray as nd
-
-        return (nd.zeros(weight.shape, dtype=weight.dtype),  # z
-                nd.zeros(weight.shape, dtype=weight.dtype))  # n
+        return (_state_zeros(weight),  # z
+                _state_zeros(weight))  # n
 
     def _step(self, w, g, states, lr, wd, t):
         import jax.numpy as jnp
@@ -588,9 +583,7 @@ class Signum(Optimizer):
     def create_state(self, index, weight):
         if self.momentum == 0.0:
             return None
-        from .. import ndarray as nd
-
-        return nd.zeros(weight.shape, dtype=weight.dtype)
+        return _state_zeros(weight)
 
     def _step(self, w, g, states, lr, wd, t):
         import jax.numpy as jnp
@@ -624,9 +617,7 @@ class LARS(Optimizer):
         self.epsilon = epsilon
 
     def create_state(self, index, weight):
-        from .. import ndarray as nd
-
-        return nd.zeros(weight.shape, dtype=weight.dtype)
+        return _state_zeros(weight)
 
     def _step(self, w, g, states, lr, wd, t):
         import jax.numpy as jnp
