@@ -1,0 +1,5 @@
+"""gluon.data (reference: ``python/mxnet/gluon/data/__init__.py:?``)."""
+from .dataset import *
+from .sampler import *
+from .dataloader import *
+from . import vision

@@ -1,0 +1,272 @@
+"""Image decode + augmentation.
+
+Reference: ``python/mxnet/image/image.py:?`` (``imdecode``/``imresize``/
+augmenter classes/``ImageIter``) over OpenCV; ``src/operator/image/`` for
+the on-device resize/normalize ops.
+
+TPU-native split: byte decode + geometric augmentation stay on host (cv2),
+photometric normalize can run either host-side (numpy, prefetch thread) or
+on device via the image ops in gluon transforms.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..base import MXNetError
+from ..ndarray import NDArray
+
+__all__ = ["imdecode", "imresize", "imread", "resize_short", "fixed_crop",
+           "center_crop", "random_crop", "color_normalize", "ImageIter",
+           "CreateAugmenter", "Augmenter", "ResizeAug", "ForceResizeAug",
+           "RandomCropAug", "CenterCropAug", "HorizontalFlipAug", "CastAug"]
+
+
+def imdecode_raw(buf, flag=1):
+    """bytes → HWC BGR→RGB uint8 array (host)."""
+    import cv2
+
+    img = cv2.imdecode(np.frombuffer(buf, dtype=np.uint8), flag)
+    if img is None:
+        raise MXNetError("failed to decode image bytes")
+    if img.ndim == 3 and img.shape[2] == 3:
+        img = cv2.cvtColor(img, cv2.COLOR_BGR2RGB)
+    return img
+
+
+def imdecode(buf, flag=1, to_rgb=True, out=None):
+    """Reference ``mx.image.imdecode`` → NDArray HWC."""
+    import cv2
+
+    img = cv2.imdecode(np.frombuffer(
+        buf if isinstance(buf, bytes) else bytes(buf), dtype=np.uint8), flag)
+    if img is None:
+        raise MXNetError("failed to decode image")
+    if to_rgb and img.ndim == 3 and img.shape[2] == 3:
+        img = cv2.cvtColor(img, cv2.COLOR_BGR2RGB)
+    return NDArray(img)
+
+
+def imread(filename, flag=1, to_rgb=True):
+    with open(filename, "rb") as f:
+        return imdecode(f.read(), flag=flag, to_rgb=to_rgb)
+
+
+def imresize(src, w, h, interp=1):
+    import cv2
+
+    arr = src.asnumpy() if isinstance(src, NDArray) else src
+    out = cv2.resize(arr, (w, h), interpolation=interp)
+    return NDArray(out) if isinstance(src, NDArray) else out
+
+
+def resize_short(src, size, interp=2):
+    """Resize shorter edge to ``size`` (reference ``resize_short``)."""
+    arr = src.asnumpy() if isinstance(src, NDArray) else src
+    h, w = arr.shape[:2]
+    if h > w:
+        new_w, new_h = size, size * h // w
+    else:
+        new_w, new_h = size * w // h, size
+    return imresize(src, new_w, new_h, interp)
+
+
+def fixed_crop(src, x0, y0, w, h, size=None, interp=2):
+    arr = src.asnumpy() if isinstance(src, NDArray) else src
+    out = arr[y0:y0 + h, x0:x0 + w]
+    if size is not None and (w, h) != size:
+        out = imresize(out, size[0], size[1], interp)
+    return NDArray(out) if isinstance(src, NDArray) else out
+
+
+def center_crop(src, size, interp=2):
+    arr = src.asnumpy() if isinstance(src, NDArray) else src
+    h, w = arr.shape[:2]
+    new_w, new_h = size
+    x0 = max(0, (w - new_w) // 2)
+    y0 = max(0, (h - new_h) // 2)
+    out = fixed_crop(arr, x0, y0, min(new_w, w), min(new_h, h), size, interp)
+    return (NDArray(out) if isinstance(src, NDArray) else out,
+            (x0, y0, new_w, new_h))
+
+
+def random_crop(src, size, interp=2, rng=None):
+    rng = rng or np.random
+    arr = src.asnumpy() if isinstance(src, NDArray) else src
+    h, w = arr.shape[:2]
+    new_w, new_h = size
+    x0 = rng.randint(0, max(w - new_w, 0) + 1)
+    y0 = rng.randint(0, max(h - new_h, 0) + 1)
+    out = fixed_crop(arr, x0, y0, min(new_w, w), min(new_h, h), size, interp)
+    return (NDArray(out) if isinstance(src, NDArray) else out,
+            (x0, y0, new_w, new_h))
+
+
+def color_normalize(src, mean, std=None):
+    arr = src.asnumpy().astype(np.float32) if isinstance(src, NDArray) \
+        else np.asarray(src, np.float32)
+    arr = arr - np.asarray(mean, np.float32)
+    if std is not None:
+        arr = arr / np.asarray(std, np.float32)
+    return NDArray(arr) if isinstance(src, NDArray) else arr
+
+
+def augment_basic(img, data_shape, rng, mean=(0, 0, 0), std=(1, 1, 1),
+                  scale=1.0, rand_crop=False, rand_mirror=False, resize=-1):
+    """The ImageRecordIter augmentation chain (reference
+    src/io/image_aug_default.cc:?): resize-short → crop → mirror →
+    normalize → CHW."""
+    import cv2
+
+    if resize > 0:
+        img = resize_short(img, resize)
+    c, h, w = data_shape
+    if img.shape[0] != h or img.shape[1] != w:
+        if rand_crop and img.shape[0] >= h and img.shape[1] >= w:
+            img, _ = random_crop(img, (w, h), rng=rng)
+        else:
+            ih, iw = img.shape[:2]
+            if ih < h or iw < w:
+                img = cv2.resize(img, (max(w, iw), max(h, ih)))
+            img, _ = center_crop(img, (w, h))
+    if rand_mirror and rng.rand() < 0.5:
+        img = img[:, ::-1]
+    img = img.astype(np.float32) * scale
+    mean = np.asarray(mean, np.float32)
+    std = np.asarray(std, np.float32)
+    if mean.any():
+        img = img - mean
+    if (std != 1).any():
+        img = img / std
+    return np.transpose(img, (2, 0, 1))  # HWC → CHW
+
+
+# --- augmenter classes (reference image.py Augmenter family) ----------------
+
+class Augmenter:
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def __call__(self, src):
+        raise NotImplementedError
+
+    def dumps(self):
+        import json
+
+        return json.dumps([type(self).__name__, self._kwargs])
+
+
+class ResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return resize_short(src, self.size, self.interp)
+
+
+class ForceResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return imresize(src, self.size[0], self.size[1], self.interp)
+
+
+class RandomCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return random_crop(src, self.size, self.interp)[0]
+
+
+class CenterCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return center_crop(src, self.size, self.interp)[0]
+
+
+class HorizontalFlipAug(Augmenter):
+    def __init__(self, p=0.5):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if np.random.rand() < self.p:
+            arr = src.asnumpy() if isinstance(src, NDArray) else src
+            out = arr[:, ::-1].copy()
+            return NDArray(out) if isinstance(src, NDArray) else out
+        return src
+
+
+class CastAug(Augmenter):
+    def __init__(self, typ=np.float32):
+        super().__init__(typ=str(typ))
+        self.typ = typ
+
+    def __call__(self, src):
+        if isinstance(src, NDArray):
+            return src.astype(self.typ)
+        return src.astype(self.typ)
+
+
+def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
+                    rand_mirror=False, mean=None, std=None, brightness=0,
+                    contrast=0, saturation=0, hue=0, pca_noise=0,
+                    rand_gray=0, inter_method=2):
+    """Build the standard augmenter list (reference ``CreateAugmenter``)."""
+    auglist = []
+    if resize > 0:
+        auglist.append(ResizeAug(resize, inter_method))
+    crop_size = (data_shape[2], data_shape[1])
+    if rand_crop:
+        auglist.append(RandomCropAug(crop_size, inter_method))
+    else:
+        auglist.append(CenterCropAug(crop_size, inter_method))
+    if rand_mirror:
+        auglist.append(HorizontalFlipAug(0.5))
+    auglist.append(CastAug())
+    return auglist
+
+
+class ImageIter:
+    """Python image iterator over record files or file lists (reference
+    ``mx.image.ImageIter``) — thin wrapper over io.ImageRecordIter."""
+
+    def __init__(self, batch_size, data_shape, path_imgrec=None,
+                 shuffle=False, aug_list=None, **kwargs):
+        from .. import io as mxio
+
+        if path_imgrec is None:
+            raise MXNetError("ImageIter requires path_imgrec in this build")
+        self._inner = mxio.ImageRecordIter(
+            path_imgrec=path_imgrec, data_shape=data_shape,
+            batch_size=batch_size, shuffle=shuffle, **kwargs)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        return self._inner.next()
+
+    next = __next__
+
+    def reset(self):
+        self._inner.reset()
+
+    @property
+    def provide_data(self):
+        return self._inner.provide_data
+
+    @property
+    def provide_label(self):
+        return self._inner.provide_label

@@ -1,0 +1,444 @@
+"""Data iterators (the legacy ``mx.io`` surface).
+
+Reference: ``python/mxnet/io/io.py:?`` (``DataIter``/``DataBatch``/
+``DataDesc``, ``NDArrayIter``, ``ResizeIter``, ``PrefetchingIter``) and the
+C++ iterators in ``src/io/`` (``ImageRecordIter`` —
+iter_image_recordio_2.cc:?, ``CSVIter``, ``LibSVMIter``, MNISTIter).
+
+TPU-native: iterators produce host-side numpy batches; device transfer is a
+single (optionally mesh-sharded) device_put at NDArray creation — the
+replacement for the reference's prefetch-to-pinned-memory path.  Threaded
+prefetch replicates dmlc ThreadedIter's overlap of decode with compute.
+"""
+from __future__ import annotations
+
+import threading
+import queue as _queue
+from collections import namedtuple
+
+import numpy as np
+
+from ..base import MXNetError
+from ..ndarray import NDArray
+
+__all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "CSVIter",
+           "ResizeIter", "PrefetchingIter", "ImageRecordIter", "MNISTIter"]
+
+
+class DataDesc(namedtuple("DataDesc", ["name", "shape"])):
+    """Shape/type descriptor (reference ``mx.io.DataDesc``)."""
+
+    def __new__(cls, name, shape, dtype=np.float32, layout="NCHW"):
+        ret = super().__new__(cls, name, shape)
+        ret.dtype = dtype
+        ret.layout = layout
+        return ret
+
+    @staticmethod
+    def get_batch_axis(layout):
+        if layout is None:
+            return 0
+        return layout.find("N")
+
+
+class DataBatch:
+    """One batch: data list + label list + pad/index bookkeeping."""
+
+    def __init__(self, data, label=None, pad=None, index=None,
+                 bucket_key=None, provide_data=None, provide_label=None):
+        if data is not None and not isinstance(data, (list, tuple)):
+            data = [data]
+        if label is not None and not isinstance(label, (list, tuple)):
+            label = [label]
+        self.data = data
+        self.label = label
+        self.pad = pad
+        self.index = index
+        self.bucket_key = bucket_key
+        self.provide_data = provide_data
+        self.provide_label = provide_label
+
+
+class DataIter:
+    """Iterator base (reference ``mx.io.DataIter``): next/reset/iter_next +
+    provide_data/provide_label descriptors."""
+
+    def __init__(self, batch_size=0):
+        self.batch_size = batch_size
+
+    def __iter__(self):
+        return self
+
+    def reset(self):
+        pass
+
+    def next(self):
+        if self.iter_next():
+            return DataBatch(data=self.getdata(), label=self.getlabel(),
+                             pad=self.getpad(), index=self.getindex())
+        raise StopIteration
+
+    def __next__(self):
+        return self.next()
+
+    def iter_next(self):
+        raise NotImplementedError
+
+    def getdata(self):
+        raise NotImplementedError
+
+    def getlabel(self):
+        raise NotImplementedError
+
+    def getindex(self):
+        return None
+
+    def getpad(self):
+        raise NotImplementedError
+
+
+def _init_data(data, allow_empty, default_name):
+    if data is None:
+        if not allow_empty:
+            raise MXNetError("data cannot be None")
+        return []
+    if isinstance(data, (np.ndarray, NDArray)):
+        data = [data]
+    if isinstance(data, (list, tuple)):
+        if not allow_empty:
+            assert len(data) > 0
+        if len(data) == 1:
+            data = {default_name: data[0]}
+        else:
+            data = {f"_{i}_{default_name}": d for i, d in enumerate(data)}
+    if not isinstance(data, dict):
+        raise MXNetError(
+            "data must be NDArray, numpy.ndarray, list or dict of them")
+    return [(k, np.asarray(v.asnumpy() if isinstance(v, NDArray) else v))
+            for k, v in data.items()]
+
+
+class NDArrayIter(DataIter):
+    """Batches over in-memory arrays with shuffle/pad/discard last-batch
+    handling (reference ``mx.io.NDArrayIter``)."""
+
+    def __init__(self, data, label=None, batch_size=1, shuffle=False,
+                 last_batch_handle="pad", data_name="data",
+                 label_name="softmax_label"):
+        super().__init__(batch_size)
+        self.data = _init_data(data, allow_empty=False,
+                               default_name=data_name)
+        self.label = _init_data(label, allow_empty=True,
+                                default_name=label_name)
+        self.num_data = self.data[0][1].shape[0]
+        self.shuffle = shuffle
+        self.last_batch_handle = last_batch_handle
+        if last_batch_handle == "discard":
+            self.num_batches = self.num_data // batch_size
+        else:
+            self.num_batches = (self.num_data + batch_size - 1) // batch_size
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [DataDesc(k, (self.batch_size,) + v.shape[1:],
+                         dtype=v.dtype) for k, v in self.data]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(k, (self.batch_size,) + v.shape[1:],
+                         dtype=v.dtype) for k, v in self.label]
+
+    def reset(self):
+        self.cursor = 0
+        if self.shuffle:
+            self.order = np.random.permutation(self.num_data)
+        else:
+            self.order = np.arange(self.num_data)
+
+    def iter_next(self):
+        return self.cursor < self.num_batches * self.batch_size and \
+            self.cursor < self.num_data if \
+            self.last_batch_handle != "discard" else \
+            self.cursor + self.batch_size <= self.num_data
+
+    def next(self):
+        if not self.iter_next():
+            raise StopIteration
+        lo = self.cursor
+        hi = min(lo + self.batch_size, self.num_data)
+        idx = self.order[lo:hi]
+        pad = self.batch_size - len(idx)
+        if pad and self.last_batch_handle == "pad":
+            idx = np.concatenate([idx, self.order[:pad]])
+        self.cursor += self.batch_size
+        data = [NDArray(arr[idx]) for _, arr in self.data]
+        label = [NDArray(arr[idx]) for _, arr in self.label]
+        return DataBatch(data=data, label=label or None, pad=pad,
+                         index=idx,
+                         provide_data=self.provide_data,
+                         provide_label=self.provide_label)
+
+    def getpad(self):
+        return 0
+
+
+class CSVIter(DataIter):
+    """CSV reader (reference C++ ``CSVIter``, src/io/iter_csv.cc:?)."""
+
+    def __init__(self, data_csv, data_shape, label_csv=None, label_shape=(1,),
+                 batch_size=1, round_batch=True, **kwargs):
+        super().__init__(batch_size)
+        data = np.loadtxt(data_csv, delimiter=",", dtype=np.float32,
+                          ndmin=2)
+        data = data.reshape((-1,) + tuple(data_shape))
+        label = None
+        if label_csv is not None:
+            label = np.loadtxt(label_csv, delimiter=",", dtype=np.float32,
+                               ndmin=2).reshape((-1,) + tuple(label_shape))
+        self._inner = NDArrayIter(
+            data, label, batch_size,
+            last_batch_handle="pad" if round_batch else "discard")
+
+    def reset(self):
+        self._inner.reset()
+
+    def next(self):
+        return self._inner.next()
+
+    @property
+    def provide_data(self):
+        return self._inner.provide_data
+
+    @property
+    def provide_label(self):
+        return self._inner.provide_label
+
+
+class ResizeIter(DataIter):
+    """Resize an iterator to a fixed number of batches per epoch
+    (reference ``mx.io.ResizeIter``)."""
+
+    def __init__(self, data_iter, size, reset_internal=True):
+        super().__init__(data_iter.batch_size)
+        self.data_iter = data_iter
+        self.size = size
+        self.reset_internal = reset_internal
+        self.cur = 0
+        self.current_batch = None
+
+    def reset(self):
+        self.cur = 0
+        if self.reset_internal:
+            self.data_iter.reset()
+
+    def iter_next(self):
+        if self.cur == self.size:
+            return False
+        try:
+            self.current_batch = self.data_iter.next()
+        except StopIteration:
+            self.data_iter.reset()
+            self.current_batch = self.data_iter.next()
+        self.cur += 1
+        return True
+
+    def next(self):
+        if self.iter_next():
+            return self.current_batch
+        raise StopIteration
+
+    @property
+    def provide_data(self):
+        return self.data_iter.provide_data
+
+    @property
+    def provide_label(self):
+        return self.data_iter.provide_label
+
+
+class PrefetchingIter(DataIter):
+    """Threaded prefetch decorator (reference ``mx.io.PrefetchingIter`` /
+    dmlc ThreadedIter — overlaps host decode with device compute)."""
+
+    def __init__(self, iters, rename_data=None, rename_label=None,
+                 prefetch_depth=2):
+        if not isinstance(iters, (list, tuple)):
+            iters = [iters]
+        assert len(iters) == 1, "single-iter prefetch (reference parity)"
+        self.iter = iters[0]
+        super().__init__(self.iter.batch_size)
+        self._depth = prefetch_depth
+        self._queue = None
+        self._thread = None
+        self._start()
+
+    def _start(self):
+        self._queue = _queue.Queue(maxsize=self._depth)
+        self._stop = threading.Event()
+
+        def worker():
+            while not self._stop.is_set():
+                try:
+                    batch = self.iter.next()
+                except StopIteration:
+                    self._queue.put(None)
+                    return
+                except Exception as e:  # propagate errors to consumer
+                    self._queue.put(e)
+                    return
+                self._queue.put(batch)
+
+        self._thread = threading.Thread(target=worker, daemon=True)
+        self._thread.start()
+
+    def reset(self):
+        self._stop.set()
+        try:
+            while True:
+                self._queue.get_nowait()
+        except _queue.Empty:
+            pass
+        self._thread.join(timeout=5)
+        self.iter.reset()
+        self._start()
+
+    def next(self):
+        item = self._queue.get()
+        if item is None:
+            raise StopIteration
+        if isinstance(item, Exception):
+            raise item
+        return item
+
+    @property
+    def provide_data(self):
+        return self.iter.provide_data
+
+    @property
+    def provide_label(self):
+        return self.iter.provide_label
+
+
+class ImageRecordIter(DataIter):
+    """RecordIO image pipeline: shard-read → decode → augment → batch →
+    prefetch (reference C++ ``ImageRecordIter``,
+    src/io/iter_image_recordio_2.cc:? — here a python pipeline over the
+    byte-compatible recordio reader with cv2 decode)."""
+
+    def __init__(self, path_imgrec, data_shape, batch_size, label_width=1,
+                 shuffle=False, mean_r=0.0, mean_g=0.0, mean_b=0.0,
+                 std_r=1.0, std_g=1.0, std_b=1.0, scale=1.0,
+                 rand_crop=False, rand_mirror=False, resize=-1,
+                 path_imgidx=None, num_parts=1, part_index=0,
+                 preprocess_threads=2, prefetch_buffer=2,
+                 round_batch=True, seed=0, **kwargs):
+        super().__init__(batch_size)
+        from .. import recordio
+        from ..image import imdecode_raw, augment_basic
+
+        self.data_shape = tuple(data_shape)
+        self.label_width = label_width
+        self._rng = np.random.RandomState(seed)
+        self._aug = dict(mean=(mean_r, mean_g, mean_b),
+                         std=(std_r, std_g, std_b), scale=scale,
+                         rand_crop=rand_crop, rand_mirror=rand_mirror,
+                         resize=resize)
+        if path_imgidx:
+            rec = recordio.MXIndexedRecordIO(path_imgidx, path_imgrec, "r")
+            keys = rec.keys
+        else:
+            rec = recordio.MXRecordIO(path_imgrec, "r")
+            keys = None
+        # load offsets once; shard for distributed reads (num_parts)
+        self._records = []
+        if keys is not None:
+            use = keys[part_index::num_parts]
+            for k in use:
+                self._records.append(rec.read_idx(k))
+        else:
+            i = 0
+            while True:
+                payload = rec.read()
+                if payload is None:
+                    break
+                if i % num_parts == part_index:
+                    self._records.append(payload)
+                i += 1
+        rec.close()
+        self.shuffle = shuffle
+        self.round_batch = round_batch
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [DataDesc("data", (self.batch_size,) + self.data_shape)]
+
+    @property
+    def provide_label(self):
+        shape = (self.batch_size,) if self.label_width == 1 else \
+            (self.batch_size, self.label_width)
+        return [DataDesc("softmax_label", shape)]
+
+    def reset(self):
+        self._order = np.arange(len(self._records))
+        if self.shuffle:
+            self._rng.shuffle(self._order)
+        self._cursor = 0
+
+    def next(self):
+        from .. import recordio
+        from ..image import imdecode_raw, augment_basic
+
+        n = len(self._records)
+        if self._cursor >= n:
+            raise StopIteration
+        idx = self._order[self._cursor:self._cursor + self.batch_size]
+        pad = self.batch_size - len(idx)
+        if pad:
+            if not self.round_batch:
+                raise StopIteration
+            idx = np.concatenate([idx, self._order[:pad]])
+        self._cursor += self.batch_size
+        datas, labels = [], []
+        for i in idx:
+            header, img_bytes = recordio.unpack(self._records[i])
+            img = imdecode_raw(img_bytes)
+            img = augment_basic(img, self.data_shape, self._rng,
+                                **self._aug)
+            datas.append(img)
+            label = header.label
+            if isinstance(label, np.ndarray) and self.label_width == 1:
+                label = label[0] if label.size else 0.0
+            labels.append(label)
+        data = NDArray(np.stack(datas).astype(np.float32))
+        label = NDArray(np.asarray(labels, dtype=np.float32))
+        return DataBatch(data=[data], label=[label], pad=pad,
+                         provide_data=self.provide_data,
+                         provide_label=self.provide_label)
+
+
+class MNISTIter(NDArrayIter):
+    """MNIST idx-format reader (reference src/io/iter_mnist.cc:?)."""
+
+    def __init__(self, image, label, batch_size=128, shuffle=True,
+                 flat=False, **kwargs):
+        import gzip
+        import struct
+
+        def read_idx(path):
+            opener = gzip.open if path.endswith(".gz") else open
+            with opener(path, "rb") as f:
+                magic = struct.unpack(">I", f.read(4))[0]
+                ndim = magic & 0xFF
+                dims = struct.unpack(">" + "I" * ndim, f.read(4 * ndim))
+                return np.frombuffer(f.read(), dtype=np.uint8).reshape(dims)
+
+        images = read_idx(image).astype(np.float32) / 255.0
+        labels = read_idx(label).astype(np.float32)
+        if flat:
+            images = images.reshape(len(images), -1)
+        else:
+            images = images.reshape(len(images), 1, *images.shape[1:])
+        super().__init__(images, labels, batch_size, shuffle=shuffle,
+                         last_batch_handle="discard")

@@ -1,0 +1,231 @@
+"""Data-plane tests: recordio format, io iterators, gluon.data, image
+(reference: tests/python/unittest/test_recordio.py:?, test_io.py:?,
+test_gluon_data.py:?)."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, nd, recordio
+from mxnet_tpu.gluon.data import (ArrayDataset, BatchSampler, DataLoader,
+                                  RandomSampler, SequentialSampler,
+                                  SimpleDataset)
+
+
+# --- recordio ---------------------------------------------------------------
+
+def test_recordio_roundtrip(tmp_path):
+    path = str(tmp_path / "test.rec")
+    writer = recordio.MXRecordIO(path, "w")
+    for i in range(5):
+        writer.write(f"record-{i}".encode() * (i + 1))
+    writer.close()
+    reader = recordio.MXRecordIO(path, "r")
+    for i in range(5):
+        assert reader.read() == f"record-{i}".encode() * (i + 1)
+    assert reader.read() is None
+    reader.close()
+
+
+def test_indexed_recordio(tmp_path):
+    rec_path = str(tmp_path / "test.rec")
+    idx_path = str(tmp_path / "test.idx")
+    writer = recordio.MXIndexedRecordIO(idx_path, rec_path, "w")
+    for i in range(10):
+        writer.write_idx(i, f"data{i}".encode())
+    writer.close()
+    reader = recordio.MXIndexedRecordIO(idx_path, rec_path, "r")
+    assert reader.read_idx(7) == b"data7"
+    assert reader.read_idx(2) == b"data2"
+    assert reader.keys == list(range(10))
+    reader.close()
+
+
+def test_irheader_pack_unpack():
+    header = recordio.IRHeader(0, 3.5, 42, 0)
+    packed = recordio.pack(header, b"payload")
+    got, payload = recordio.unpack(packed)
+    assert payload == b"payload"
+    assert got.label == 3.5
+    assert got.id == 42
+    # array label
+    header2 = recordio.IRHeader(0, np.array([1.0, 2.0], np.float32), 1, 0)
+    got2, _ = recordio.unpack(recordio.pack(header2, b"x"))
+    assert np.allclose(got2.label, [1.0, 2.0])
+
+
+def test_pack_img_roundtrip(tmp_path):
+    img = np.random.RandomState(0).randint(0, 255, (16, 16, 3), np.uint8)
+    packed = recordio.pack_img(recordio.IRHeader(0, 1.0, 0, 0), img,
+                               img_fmt=".png")
+    header, decoded = recordio.unpack_img(packed)
+    assert header.label == 1.0
+    assert np.array_equal(decoded, img)  # png is lossless
+
+
+# --- io iterators -----------------------------------------------------------
+
+def test_ndarray_iter():
+    data = np.arange(40, dtype=np.float32).reshape(10, 4)
+    label = np.arange(10, dtype=np.float32)
+    it = mx.io.NDArrayIter(data, label, batch_size=4,
+                           last_batch_handle="pad")
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[0].data[0].shape == (4, 4)
+    assert batches[2].pad == 2
+    it.reset()
+    assert len(list(it)) == 3
+
+
+def test_ndarray_iter_discard():
+    it = mx.io.NDArrayIter(np.zeros((10, 2)), np.zeros(10), batch_size=4,
+                           last_batch_handle="discard")
+    assert len(list(it)) == 2
+
+
+def test_ndarray_iter_shuffle():
+    data = np.arange(10, dtype=np.float32).reshape(10, 1)
+    it = mx.io.NDArrayIter(data, data[:, 0], batch_size=10, shuffle=True)
+    batch = next(iter(it))
+    assert not np.array_equal(batch.data[0].asnumpy().ravel(),
+                              np.arange(10))
+    assert np.array_equal(np.sort(batch.data[0].asnumpy().ravel()),
+                          np.arange(10))
+
+
+def test_image_record_iter(tmp_path):
+    rec_path = str(tmp_path / "imgs.rec")
+    idx_path = str(tmp_path / "imgs.idx")
+    writer = recordio.MXIndexedRecordIO(idx_path, rec_path, "w")
+    rng = np.random.RandomState(0)
+    for i in range(8):
+        img = rng.randint(0, 255, (20, 20, 3), np.uint8)
+        writer.write_idx(i, recordio.pack_img(
+            recordio.IRHeader(0, float(i % 3), i, 0), img, img_fmt=".png"))
+    writer.close()
+    it = mx.io.ImageRecordIter(path_imgrec=rec_path, path_imgidx=idx_path,
+                               data_shape=(3, 16, 16), batch_size=4,
+                               shuffle=True, rand_crop=True,
+                               rand_mirror=True)
+    batches = list(iter(it))
+    assert len(batches) == 2
+    assert batches[0].data[0].shape == (4, 3, 16, 16)
+    assert batches[0].label[0].shape == (4,)
+
+
+def test_prefetching_iter():
+    base = mx.io.NDArrayIter(np.arange(24).reshape(12, 2).astype(np.float32),
+                             np.arange(12), batch_size=4)
+    it = mx.io.PrefetchingIter(base)
+    batches = list(it)
+    assert len(batches) == 3
+    it.reset()
+    assert len(list(it)) == 3
+
+
+# --- gluon.data -------------------------------------------------------------
+
+def test_array_dataset_and_loader():
+    x = np.random.rand(20, 5).astype(np.float32)
+    y = np.arange(20, dtype=np.float32)
+    ds = ArrayDataset(x, y)
+    assert len(ds) == 20
+    sample_x, sample_y = ds[3]
+    assert np.allclose(sample_x, x[3])
+    loader = DataLoader(ds, batch_size=6, last_batch="keep")
+    batches = list(loader)
+    assert len(batches) == 4
+    assert batches[0][0].shape == (6, 5)
+    assert batches[-1][0].shape == (2, 5)
+
+
+def test_dataloader_shuffle_and_discard():
+    ds = ArrayDataset(np.arange(10, dtype=np.float32))
+    loader = DataLoader(ds, batch_size=3, shuffle=True, last_batch="discard")
+    batches = list(loader)
+    assert len(batches) == 3
+    seen = np.sort(np.concatenate([b.asnumpy() for b in batches]))
+    assert len(seen) == 9
+
+
+def test_dataloader_workers():
+    ds = ArrayDataset(np.arange(32, dtype=np.float32).reshape(16, 2),
+                      np.arange(16, dtype=np.float32))
+    loader = DataLoader(ds, batch_size=4, num_workers=3)
+    batches = list(loader)
+    assert len(batches) == 4
+    # order preserved despite parallel fetch
+    assert np.allclose(batches[0][1].asnumpy(), [0, 1, 2, 3])
+
+
+def test_dataset_transform():
+    ds = SimpleDataset(list(range(10))).transform(lambda x: x * 2)
+    assert ds[4] == 8
+    ds2 = ArrayDataset(np.ones((4, 2), np.float32),
+                       np.zeros(4, np.float32)).transform_first(
+        lambda x: x + 1)
+    x, y = ds2[0]
+    assert np.allclose(x, 2)
+
+
+def test_samplers():
+    assert list(SequentialSampler(4)) == [0, 1, 2, 3]
+    assert sorted(RandomSampler(5)) == list(range(5))
+    bs = BatchSampler(SequentialSampler(7), 3, "keep")
+    assert [len(b) for b in bs] == [3, 3, 1]
+    bs2 = BatchSampler(SequentialSampler(7), 3, "discard")
+    assert [len(b) for b in bs2] == [3, 3]
+
+
+def test_transforms_pipeline():
+    from mxnet_tpu.gluon.data.vision import transforms
+
+    img = nd.array(np.random.randint(0, 255, (20, 24, 3)).astype(np.uint8))
+    t = transforms.ToTensor()(img)
+    assert t.shape == (3, 20, 24)
+    assert float(t.max().asscalar()) <= 1.0
+    norm = transforms.Normalize(mean=(0.5, 0.5, 0.5),
+                                std=(0.5, 0.5, 0.5))(t)
+    assert norm.shape == (3, 20, 24)
+    composed = transforms.Compose([
+        transforms.Resize(16),
+        transforms.CenterCrop(12),
+        transforms.ToTensor(),
+    ])
+    out = composed(img)
+    assert out.shape == (3, 12, 12)
+
+
+def test_random_resized_crop():
+    from mxnet_tpu.gluon.data.vision import transforms
+
+    img = nd.array(np.random.randint(0, 255, (32, 32, 3)).astype(np.uint8))
+    out = transforms.RandomResizedCrop(16)(img)
+    assert out.shape[:2] == (16, 16)
+
+
+def test_synthetic_dataset_with_loader_end_to_end():
+    from mxnet_tpu.gluon.data.vision import SyntheticImageDataset
+    from mxnet_tpu.gluon.data.vision import transforms
+
+    tfm = transforms.Compose([transforms.ToTensor()])
+    ds = SyntheticImageDataset(length=16, shape=(8, 8, 3), classes=4) \
+        .transform_first(lambda x: tfm(x))
+    loader = DataLoader(ds, batch_size=8)
+    x, y = next(iter(loader))
+    assert x.shape == (8, 3, 8, 8)
+    assert y.shape == (8,)
+
+
+def test_record_file_dataset(tmp_path):
+    rec_path = str(tmp_path / "ds.rec")
+    idx_path = str(tmp_path / "ds.idx")
+    writer = recordio.MXIndexedRecordIO(idx_path, rec_path, "w")
+    for i in range(4):
+        writer.write_idx(i, f"item{i}".encode())
+    writer.close()
+    ds = gluon.data.RecordFileDataset(rec_path)
+    assert len(ds) == 4
+    assert ds[2] == b"item2"
