@@ -453,9 +453,15 @@ class CachedOp:
     def __call__(self, *args):
         params = self._param_list()
         if any(p._data is None for p in params):
-            # deferred init pending → one imperative pass resolves shapes
-            # (reference: CachedOp creation happens after shape inference)
-            return self.block._imperative_forward(*args)
+            # deferred init pending → one shape-resolution pass, then build
+            # the compiled graph (reference: CachedOp creation happens after
+            # shape inference; export works after a single forward)
+            with ag.pause():
+                self.block._imperative_forward(*args)
+            params = self._param_list()
+            if any(p._data is None for p in params):
+                # params not touched by this input signature stay deferred
+                return self.block._imperative_forward(*args)
         for a in args:
             if not isinstance(a, NDArray):
                 raise MXNetError(

@@ -134,32 +134,21 @@ def waitall():
 
 
 def load(fname):
-    """Load NDArrays saved by :func:`save` (dict or list).
+    """Load NDArrays (dict or list) from an MXNet-format ``.params`` file
+    (reference ``mx.nd.load`` → ``NDArray::Load``, src/ndarray/ndarray.cc:?;
+    binary layout in mxnet_tpu/serialization.py — files interchange with
+    the reference)."""
+    from .. import serialization
 
-    Format: ``.npz`` container — a documented departure from the reference's
-    dmlc::Stream binary (src/ndarray/ndarray.cc:? Save/Load); a reader for
-    legacy ``.params`` files ships with gluon parameter loading.
-    """
-    data = _np.load(fname, allow_pickle=False)
-    keys = list(data.keys())
-    if keys and all(k.startswith("arr_") for k in keys):
-        return [NDArray(data[k]) for k in sorted(
-            keys, key=lambda s: int(s[4:]))]
-    return {k: NDArray(data[k]) for k in keys}
+    return serialization.load_ndarrays(fname)
 
 
 def save(fname, data):
-    """Save a list or dict of NDArrays (reference ``mx.nd.save``)."""
-    if isinstance(data, NDArray):
-        data = [data]
-    if isinstance(data, dict):
-        _np.savez(fname, **{k: v.asnumpy() for k, v in data.items()})
-    else:
-        _np.savez(fname, *[v.asnumpy() for v in data])
-    import os
+    """Save a list or dict of NDArrays in the MXNet binary container
+    (reference ``mx.nd.save``)."""
+    from .. import serialization
 
-    if not fname.endswith(".npz") and os.path.exists(fname + ".npz"):
-        os.replace(fname + ".npz", fname)
+    serialization.save_ndarrays(fname, data)
 
 
 def concat_dim0(arrays):

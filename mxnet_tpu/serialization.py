@@ -1,0 +1,221 @@
+"""Binary serialization: MXNet ``.params`` files + checkpoints.
+
+Reference: ``src/ndarray/ndarray.cc:?`` ``NDArray::Save/Load`` over
+dmlc::Stream.  The container layout (``mx.nd.save``):
+
+    uint64 kMXAPINDListMagic (0x112)
+    uint64 reserved (0)
+    uint64 n_arrays; n_arrays x NDArray payload
+    uint64 n_names;  n_names x (uint64 len + bytes) names
+
+Per-array payload (dense V2):
+
+    uint32 magic (0xF993FAC9 = V2; V1 = 0xF993FAC8)
+    int32  stype (V2 only; 0 = default/dense, 1 = row_sparse, 2 = csr)
+    uint32 ndim; ndim x int64 dims          (V1: uint32 dims)
+    int32 dev_type; int32 dev_id
+    int32 type_flag (mshadow: 0=f32 1=f64 2=f16 3=u8 4=i32 5=i8 6=i64)
+    raw little-endian payload
+
+This module writes V2-dense and reads V1/V2 (dense + row_sparse), so
+``.params`` files interchange with the reference's C++ loader — the
+"read MXNet .params" requirement of SURVEY §5 checkpoint/resume.
+"""
+from __future__ import annotations
+
+import struct
+
+import numpy as np
+
+from .base import MXNetError
+from .ndarray import NDArray
+
+_LIST_MAGIC = 0x112
+_V1_MAGIC = 0xF993FAC8
+_V2_MAGIC = 0xF993FAC9
+
+# mshadow type flags (reference mshadow/base.h:?)
+_TYPE_FLAG = {np.dtype(np.float32): 0, np.dtype(np.float64): 1,
+              np.dtype(np.float16): 2, np.dtype(np.uint8): 3,
+              np.dtype(np.int32): 4, np.dtype(np.int8): 5,
+              np.dtype(np.int64): 6}
+_FLAG_TYPE = {v: k for k, v in _TYPE_FLAG.items()}
+# bfloat16 used flag 7 in onednn-era forks [med] — written as f32 instead
+# for portability.
+
+
+def _write_ndarray(out, arr: np.ndarray):
+    if arr.dtype.name == "bfloat16":
+        arr = arr.astype(np.float32)
+    if arr.dtype not in _TYPE_FLAG:
+        raise MXNetError(f"cannot save dtype {arr.dtype} to .params")
+    out += struct.pack("<I", _V2_MAGIC)
+    out += struct.pack("<i", 0)  # dense stype
+    out += struct.pack("<I", arr.ndim)
+    out += struct.pack(f"<{arr.ndim}q", *arr.shape)
+    out += struct.pack("<ii", 1, 0)  # ctx: cpu(0)
+    out += struct.pack("<i", _TYPE_FLAG[arr.dtype])
+    out += arr.astype(arr.dtype, copy=False).tobytes()
+    return out
+
+
+class _Cursor:
+    def __init__(self, buf):
+        self.buf = buf
+        self.pos = 0
+
+    def take(self, n):
+        if self.pos + n > len(self.buf):
+            raise MXNetError("truncated .params file")
+        out = self.buf[self.pos:self.pos + n]
+        self.pos += n
+        return out
+
+    def u32(self):
+        return struct.unpack("<I", self.take(4))[0]
+
+    def i32(self):
+        return struct.unpack("<i", self.take(4))[0]
+
+    def u64(self):
+        return struct.unpack("<Q", self.take(8))[0]
+
+
+def _read_shape(cur, magic):
+    ndim = cur.u32()
+    if magic == _V2_MAGIC:
+        dims = struct.unpack(f"<{ndim}q", cur.take(8 * ndim))
+    else:
+        dims = struct.unpack(f"<{ndim}I", cur.take(4 * ndim))
+    return tuple(int(d) for d in dims)
+
+
+def _read_dense_body(cur, shape):
+    cur.i32()  # dev_type
+    cur.i32()  # dev_id
+    type_flag = cur.i32()
+    if type_flag not in _FLAG_TYPE:
+        raise MXNetError(f"unknown dtype flag {type_flag} in .params")
+    dtype = _FLAG_TYPE[type_flag]
+    count = int(np.prod(shape)) if shape else 1
+    data = np.frombuffer(cur.take(count * dtype.itemsize), dtype=dtype)
+    return data.reshape(shape)
+
+
+def _read_ndarray(cur):
+    magic = cur.u32()
+    if magic not in (_V1_MAGIC, _V2_MAGIC):
+        raise MXNetError(f"bad NDArray magic 0x{magic:X} in .params")
+    if magic == _V2_MAGIC:
+        stype = cur.i32()
+    else:
+        stype = 0
+    if stype == 0:
+        shape = _read_shape(cur, magic)
+        return NDArray(_read_dense_body(cur, shape))
+    if stype == 1:  # row_sparse: aux shapes + aux (idx) + data [med layout]
+        from .ndarray import sparse as sp
+
+        shape = _read_shape(cur, magic)
+        num_aux = cur.u32()
+        aux_shapes = [_read_shape(cur, _V2_MAGIC) for _ in range(num_aux)]
+        idx = _read_dense_body(cur, aux_shapes[0])
+        vals = _read_dense_body(cur, (aux_shapes[0][0],) + shape[1:])
+        return sp.RowSparseNDArray(NDArray(vals),
+                                   NDArray(idx.astype(np.int64)), shape)
+    raise MXNetError(f"unsupported storage type {stype} in .params")
+
+
+def save_ndarrays(fname, data):
+    """Write the MXNet .params container (dict or list of NDArrays)."""
+    if isinstance(data, NDArray):
+        data = [data]
+    if isinstance(data, dict):
+        names = list(data.keys())
+        arrays = [data[k] for k in names]
+    else:
+        names = []
+        arrays = list(data)
+    out = bytearray()
+    out += struct.pack("<QQ", _LIST_MAGIC, 0)
+    out += struct.pack("<Q", len(arrays))
+    for arr in arrays:
+        if not isinstance(arr, NDArray):
+            arr = NDArray(arr)
+        _write_ndarray(out, arr.asnumpy())
+    out += struct.pack("<Q", len(names))
+    for name in names:
+        encoded = name.encode("utf-8")
+        out += struct.pack("<Q", len(encoded))
+        out += encoded
+    with open(fname, "wb") as f:
+        f.write(bytes(out))
+
+
+def load_ndarrays(fname):
+    """Read a .params container → dict (named) or list (unnamed).  Also
+    accepts this repo's earlier .npz containers for back-compat."""
+    with open(fname, "rb") as f:
+        head = f.read(8)
+        rest = f.read()
+    if head[:4] == b"PK\x03\x04":  # npz zip container
+        data = np.load(fname, allow_pickle=False)
+        keys = list(data.keys())
+        if keys and all(k.startswith("arr_") for k in keys):
+            return [NDArray(data[k]) for k in
+                    sorted(keys, key=lambda s: int(s[4:]))]
+        return {k: NDArray(data[k]) for k in keys}
+    magic = struct.unpack("<Q", head)[0]
+    if magic != _LIST_MAGIC:
+        raise MXNetError(
+            f"{fname!r} is not an MXNet .params file (magic 0x{magic:X})")
+    cur = _Cursor(rest)
+    cur.u64()  # reserved
+    n = cur.u64()
+    arrays = [_read_ndarray(cur) for _ in range(n)]
+    n_names = cur.u64()
+    if n_names == 0:
+        return arrays
+    names = []
+    for _ in range(n_names):
+        ln = cur.u64()
+        names.append(cur.take(ln).decode("utf-8"))
+    return dict(zip(names, arrays))
+
+
+def save_checkpoint(prefix, epoch, symbol=None, arg_params=None,
+                    aux_params=None):
+    """module-style checkpoint: ``prefix-symbol.json`` +
+    ``prefix-%04d.params`` with arg:/aux: key prefixes (reference
+    ``mx.model.save_checkpoint``)."""
+    if symbol is not None and hasattr(symbol, "export"):
+        symbol.export(prefix, epoch)
+        return
+    payload = {}
+    for k, v in (arg_params or {}).items():
+        payload[f"arg:{k}"] = v
+    for k, v in (aux_params or {}).items():
+        payload[f"aux:{k}"] = v
+    save_ndarrays(f"{prefix}-{epoch:04d}.params", payload)
+
+
+def load_checkpoint(prefix, epoch):
+    """→ (symbol_or_None, arg_params, aux_params) (reference
+    ``mx.model.load_checkpoint``)."""
+    loaded = load_ndarrays(f"{prefix}-{epoch:04d}.params")
+    arg_params, aux_params = {}, {}
+    for k, v in loaded.items():
+        if k.startswith("arg:"):
+            arg_params[k[4:]] = v
+        elif k.startswith("aux:"):
+            aux_params[k[4:]] = v
+        else:
+            arg_params[k] = v
+    sym = None
+    import os
+
+    if os.path.isfile(f"{prefix}-symbol.json"):
+        from .gluon import symbol_block
+
+        sym = symbol_block.load_symbol_json(f"{prefix}-symbol.json")
+    return sym, arg_params, aux_params

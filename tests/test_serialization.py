@@ -1,0 +1,209 @@
+"""Serialization tests: MXNet binary .params, StableHLO export/import,
+nnvm symbol-json execution (reference: tests/python/unittest/test_ndarray.py
+save/load cases + test_gluon.py SymbolBlock cases)."""
+import json
+import struct
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, nd, serialization
+from mxnet_tpu.gluon import nn
+
+
+def test_params_binary_roundtrip(tmp_path):
+    f = str(tmp_path / "x.params")
+    data = {"w": nd.array(np.random.rand(3, 4).astype(np.float32)),
+            "b": nd.array(np.arange(5, dtype=np.float32)),
+            "i": nd.array(np.arange(6).reshape(2, 3), dtype=np.int32)}
+    nd.save(f, data)
+    loaded = nd.load(f)
+    assert set(loaded) == {"w", "b", "i"}
+    for k in data:
+        assert np.array_equal(loaded[k].asnumpy(), data[k].asnumpy()), k
+        assert loaded[k].dtype == data[k].dtype
+
+
+def test_params_binary_list_roundtrip(tmp_path):
+    f = str(tmp_path / "l.params")
+    nd.save(f, [nd.ones((2, 2)), nd.zeros((3,))])
+    loaded = nd.load(f)
+    assert isinstance(loaded, list) and len(loaded) == 2
+    assert np.allclose(loaded[0].asnumpy(), 1)
+
+
+def test_params_binary_layout_is_mxnet_compatible(tmp_path):
+    """Byte-level check of the container header the reference C++ reader
+    expects (kMXAPINDListMagic, V2 array magic, int64 dims)."""
+    f = str(tmp_path / "h.params")
+    nd.save(f, {"x": nd.ones((2, 3))})
+    raw = open(f, "rb").read()
+    magic, reserved, n = struct.unpack("<QQQ", raw[:24])
+    assert magic == 0x112 and reserved == 0 and n == 1
+    arr_magic, stype, ndim = struct.unpack("<IiI", raw[24:36])
+    assert arr_magic == 0xF993FAC9 and stype == 0 and ndim == 2
+    dims = struct.unpack("<2q", raw[36:52])
+    assert dims == (2, 3)
+    dev_type, dev_id, type_flag = struct.unpack("<iii", raw[52:64])
+    assert type_flag == 0  # float32
+    payload = np.frombuffer(raw[64:64 + 24], dtype=np.float32)
+    assert np.allclose(payload, 1.0)
+
+
+def test_params_v1_read(tmp_path):
+    """Hand-write a V1 (uint32 dims) file; reader must accept it."""
+    f = str(tmp_path / "v1.params")
+    arr = np.arange(6, dtype=np.float32).reshape(2, 3)
+    out = struct.pack("<QQQ", 0x112, 0, 1)
+    out += struct.pack("<I", 0xF993FAC8)  # V1: no stype
+    out += struct.pack("<I", 2) + struct.pack("<2I", 2, 3)
+    out += struct.pack("<ii", 1, 0) + struct.pack("<i", 0)
+    out += arr.tobytes()
+    out += struct.pack("<Q", 1) + struct.pack("<Q", 3) + b"old"
+    open(f, "wb").write(out)
+    loaded = nd.load(f)
+    assert np.array_equal(loaded["old"].asnumpy(), arr)
+
+
+def test_gluon_save_load_through_binary(tmp_path):
+    net = nn.Dense(4, in_units=3)
+    net.initialize()
+    f = str(tmp_path / "net.params")
+    net.save_parameters(f)
+    net2 = nn.Dense(4, in_units=3)
+    net2.load_parameters(f)
+    x = mx.random.uniform(shape=(2, 3))
+    assert np.allclose(net(x).asnumpy(), net2(x).asnumpy())
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    prefix = str(tmp_path / "model")
+    serialization.save_checkpoint(
+        prefix, 3, None,
+        {"fc_weight": nd.ones((2, 2))}, {"bn_mean": nd.zeros((2,))})
+    sym, args, aux = serialization.load_checkpoint(prefix, 3)
+    assert sym is None
+    assert np.allclose(args["fc_weight"].asnumpy(), 1)
+    assert "bn_mean" in aux
+
+
+def test_export_import_stablehlo(tmp_path):
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(8, activation="relu", in_units=5),
+                nn.Dense(3, in_units=8))
+    net.initialize()
+    net.hybridize()
+    x = mx.random.uniform(shape=(2, 5))
+    expect = net(x).asnumpy()
+
+    prefix = str(tmp_path / "model")
+    net.export(prefix, epoch=0)
+    assert (tmp_path / "model-symbol.json").exists()
+    assert (tmp_path / "model-0000.params").exists()
+    assert (tmp_path / "model-0000.stablehlo").exists()
+
+    sb = gluon.SymbolBlock.imports(prefix + "-symbol.json", ["data"],
+                                   prefix + "-0000.params")
+    got = sb(x).asnumpy()
+    assert np.allclose(got, expect, atol=1e-5)
+
+
+def test_import_reference_nnvm_json(tmp_path):
+    """Execute a hand-built reference-style symbol.json (the format real
+    MXNet exports) against the op registry."""
+    graph = {
+        "nodes": [
+            {"op": "null", "name": "data", "inputs": []},
+            {"op": "null", "name": "fc0_weight", "inputs": []},
+            {"op": "null", "name": "fc0_bias", "inputs": []},
+            {"op": "FullyConnected", "name": "fc0",
+             "attrs": {"num_hidden": "4", "no_bias": "False"},
+             "inputs": [[0, 0, 0], [1, 0, 0], [2, 0, 0]]},
+            {"op": "Activation", "name": "relu0",
+             "attrs": {"act_type": "relu"}, "inputs": [[3, 0, 0]]},
+            {"op": "null", "name": "sm_label", "inputs": []},
+            {"op": "SoftmaxOutput", "name": "softmax", "attrs": {},
+             "inputs": [[4, 0, 0], [5, 0, 0]]},
+        ],
+        "arg_nodes": [0, 1, 2, 5],
+        "node_row_ptr": list(range(8)),
+        "heads": [[6, 0, 0]],
+        "attrs": {"mxnet_version": ["int", 10700]},
+    }
+    sym_path = str(tmp_path / "ref-symbol.json")
+    with open(sym_path, "w") as f:
+        json.dump(graph, f)
+    w = np.random.rand(4, 3).astype(np.float32)
+    b = np.zeros(4, np.float32)
+    nd.save(str(tmp_path / "ref-0000.params"),
+            {"arg:fc0_weight": nd.array(w), "arg:fc0_bias": nd.array(b)})
+
+    sb = gluon.SymbolBlock.imports(sym_path, ["data"],
+                                   str(tmp_path / "ref-0000.params"))
+    x = np.random.rand(2, 3).astype(np.float32)
+    got = sb(nd.array(x)).asnumpy()
+    logits = x @ w.T
+    relu = np.maximum(logits, 0)
+    expect = np.exp(relu) / np.exp(relu).sum(-1, keepdims=True)
+    assert np.allclose(got, expect, atol=1e-5)
+
+
+def test_import_nnvm_conv_bn_graph(tmp_path):
+    """Conv + BatchNorm + Pooling graph — the serving shape of a real CNN
+    export (BatchNorm uses aux moving stats at inference)."""
+    graph = {
+        "nodes": [
+            {"op": "null", "name": "data", "inputs": []},
+            {"op": "null", "name": "conv_weight", "inputs": []},
+            {"op": "Convolution", "name": "conv",
+             "attrs": {"kernel": "(3, 3)", "num_filter": "2",
+                       "pad": "(1, 1)", "no_bias": "True"},
+             "inputs": [[0, 0, 0], [1, 0, 0]]},
+            {"op": "null", "name": "bn_gamma", "inputs": []},
+            {"op": "null", "name": "bn_beta", "inputs": []},
+            {"op": "null", "name": "bn_moving_mean", "inputs": []},
+            {"op": "null", "name": "bn_moving_var", "inputs": []},
+            {"op": "BatchNorm", "name": "bn",
+             "attrs": {"eps": "0.001", "fix_gamma": "False"},
+             "inputs": [[2, 0, 0], [3, 0, 0], [4, 0, 0], [5, 0, 0],
+                        [6, 0, 0]]},
+            {"op": "Pooling", "name": "pool",
+             "attrs": {"kernel": "(2, 2)", "pool_type": "max",
+                       "stride": "(2, 2)"},
+             "inputs": [[7, 0, 0]]},
+        ],
+        "arg_nodes": [0, 1, 3, 4, 5, 6],
+        "heads": [[8, 0, 0]],
+    }
+    sym_path = str(tmp_path / "cnn-symbol.json")
+    with open(sym_path, "w") as f:
+        json.dump(graph, f)
+    rng = np.random.RandomState(0)
+    params = {
+        "arg:conv_weight": nd.array(rng.rand(2, 3, 3, 3).astype(np.float32)),
+        "arg:bn_gamma": nd.ones((2,)),
+        "arg:bn_beta": nd.zeros((2,)),
+        "aux:bn_moving_mean": nd.zeros((2,)),
+        "aux:bn_moving_var": nd.ones((2,)),
+    }
+    nd.save(str(tmp_path / "cnn-0000.params"), params)
+    sb = gluon.SymbolBlock.imports(sym_path, ["data"],
+                                   str(tmp_path / "cnn-0000.params"))
+    out = sb(nd.array(rng.rand(1, 3, 8, 8).astype(np.float32)))
+    assert out.shape == (1, 2, 4, 4)
+
+
+def test_resnet_export_import_roundtrip(tmp_path):
+    net = gluon.model_zoo.vision.get_model("resnet18_v1", classes=10,
+                                           thumbnail=True)
+    net.initialize()
+    net.hybridize()
+    x = mx.random.uniform(shape=(1, 3, 16, 16))
+    expect = net(x).asnumpy()
+    prefix = str(tmp_path / "resnet")
+    net.export(prefix)
+    sb = gluon.SymbolBlock.imports(prefix + "-symbol.json", ["data"],
+                                   prefix + "-0000.params")
+    assert np.allclose(sb(x).asnumpy(), expect, atol=1e-4)
