@@ -1,0 +1,3 @@
+"""gluon.rnn (reference: ``python/mxnet/gluon/rnn/__init__.py:?``)."""
+from .rnn_cell import *
+from .rnn_layer import *

@@ -1,0 +1,344 @@
+"""Recurrent cells (reference: ``python/mxnet/gluon/rnn/rnn_cell.py:?`` —
+RecurrentCell base with begin_state/unroll, RNN/LSTM/GRU cells, Sequential/
+Bidirectional/Residual/Dropout modifiers).  Gate orders match the
+reference: LSTM [i, f, g, o], GRU [r, z, n]."""
+from __future__ import annotations
+
+import numpy as np
+
+from ...base import MXNetError
+from ..block import HybridBlock
+from ... import ndarray as nd_mod
+
+__all__ = ["RecurrentCell", "HybridRecurrentCell", "RNNCell", "LSTMCell",
+           "GRUCell", "SequentialRNNCell", "DropoutCell", "ResidualCell",
+           "BidirectionalCell", "ZoneoutCell"]
+
+
+class RecurrentCell(HybridBlock):
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._modified = False
+        self.reset()
+
+    def reset(self):
+        self._init_counter = -1
+        self._counter = -1
+        for cell in self._children.values():
+            if isinstance(cell, RecurrentCell):
+                cell.reset()
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        """Initial states (reference ``RecurrentCell.begin_state``)."""
+        if func is None:
+            func = nd_mod.zeros
+        states = []
+        for info in self.state_info(batch_size):
+            self._init_counter += 1
+            info = dict(info)
+            shape = info.pop("shape")
+            info.pop("__layout__", None)
+            states.append(func(shape=shape, **info, **kwargs))
+        return states
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        """Unroll over ``length`` steps (reference ``unroll``)."""
+        self.reset()
+        axis = layout.find("T")
+        if isinstance(inputs, (list, tuple)):
+            steps = list(inputs)
+            batch_size = steps[0].shape[0]
+        else:
+            batch_size = inputs.shape[layout.find("N")]
+            steps = [x.squeeze(axis=axis) for x in
+                     inputs.split(num_outputs=length, axis=axis,
+                                  squeeze_axis=False)]
+        if begin_state is None:
+            begin_state = self.begin_state(batch_size)
+        states = begin_state
+        outputs = []
+        for i in range(length):
+            output, states = self(steps[i], states)
+            outputs.append(output)
+        if merge_outputs:
+            outputs = nd_mod.stack(*outputs, axis=axis)
+        return outputs, states
+
+    def forward(self, inputs, states):
+        self._counter += 1
+        return super().forward(inputs, states)
+
+
+HybridRecurrentCell = RecurrentCell
+
+
+class _BaseRNNCell(RecurrentCell):
+    def __init__(self, hidden_size, num_gates, activation=None,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 input_size=0, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._hidden_size = hidden_size
+        self._input_size = input_size
+        ng = num_gates
+        with self.name_scope():
+            self.i2h_weight = self.params.get(
+                "i2h_weight", shape=(ng * hidden_size, input_size),
+                init=i2h_weight_initializer, allow_deferred_init=True)
+            self.h2h_weight = self.params.get(
+                "h2h_weight", shape=(ng * hidden_size, hidden_size),
+                init=h2h_weight_initializer)
+            self.i2h_bias = self.params.get(
+                "i2h_bias", shape=(ng * hidden_size,),
+                init=i2h_bias_initializer)
+            self.h2h_bias = self.params.get(
+                "h2h_bias", shape=(ng * hidden_size,),
+                init=h2h_bias_initializer)
+        self._ng = ng
+
+    def infer_shape(self, x, *args):
+        self.i2h_weight._finish_deferred_init(
+            (self._ng * self._hidden_size, int(x.shape[-1])))
+
+
+class RNNCell(_BaseRNNCell):
+    def __init__(self, hidden_size, activation="tanh", **kwargs):
+        super().__init__(hidden_size, 1, **kwargs)
+        self._activation = activation
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size),
+                 "__layout__": "NC"}]
+
+    def _alias(self):
+        return "rnn"
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        i2h = F.fully_connected(inputs, i2h_weight, i2h_bias,
+                                num_hidden=self._hidden_size, flatten=False)
+        h2h = F.fully_connected(states[0], h2h_weight, h2h_bias,
+                                num_hidden=self._hidden_size, flatten=False)
+        output = F.activation(i2h + h2h, act_type=self._activation)
+        return output, [output]
+
+
+class LSTMCell(_BaseRNNCell):
+    def __init__(self, hidden_size, **kwargs):
+        super().__init__(hidden_size, 4, **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size),
+                 "__layout__": "NC"},
+                {"shape": (batch_size, self._hidden_size),
+                 "__layout__": "NC"}]
+
+    def _alias(self):
+        return "lstm"
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        nh = self._hidden_size
+        i2h = F.fully_connected(inputs, i2h_weight, i2h_bias,
+                                num_hidden=4 * nh, flatten=False)
+        h2h = F.fully_connected(states[0], h2h_weight, h2h_bias,
+                                num_hidden=4 * nh, flatten=False)
+        gates = i2h + h2h
+        slices = F.split(gates, num_outputs=4, axis=-1)
+        in_gate = F.sigmoid(slices[0])
+        forget_gate = F.sigmoid(slices[1])
+        in_transform = F.tanh(slices[2])
+        out_gate = F.sigmoid(slices[3])
+        next_c = forget_gate * states[1] + in_gate * in_transform
+        next_h = out_gate * F.tanh(next_c)
+        return next_h, [next_h, next_c]
+
+
+class GRUCell(_BaseRNNCell):
+    def __init__(self, hidden_size, **kwargs):
+        super().__init__(hidden_size, 3, **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size),
+                 "__layout__": "NC"}]
+
+    def _alias(self):
+        return "gru"
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        nh = self._hidden_size
+        prev_h = states[0]
+        i2h = F.fully_connected(inputs, i2h_weight, i2h_bias,
+                                num_hidden=3 * nh, flatten=False)
+        h2h = F.fully_connected(prev_h, h2h_weight, h2h_bias,
+                                num_hidden=3 * nh, flatten=False)
+        i2h_r, i2h_z, i2h_n = F.split(i2h, num_outputs=3, axis=-1)
+        h2h_r, h2h_z, h2h_n = F.split(h2h, num_outputs=3, axis=-1)
+        reset = F.sigmoid(i2h_r + h2h_r)
+        update = F.sigmoid(i2h_z + h2h_z)
+        new = F.tanh(i2h_n + reset * h2h_n)
+        next_h = (1.0 - update) * new + update * prev_h
+        return next_h, [next_h]
+
+
+class SequentialRNNCell(RecurrentCell):
+    """Stack cells (reference ``SequentialRNNCell``)."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+
+    def add(self, cell):
+        self.register_child(cell)
+
+    def state_info(self, batch_size=0):
+        infos = []
+        for cell in self._children.values():
+            infos.extend(cell.state_info(batch_size))
+        return infos
+
+    def begin_state(self, batch_size=0, **kwargs):
+        states = []
+        for cell in self._children.values():
+            states.append(cell.begin_state(batch_size, **kwargs))
+        return [s for group in states for s in group]
+
+    def __len__(self):
+        return len(self._children)
+
+    def __getitem__(self, i):
+        return list(self._children.values())[i]
+
+    def forward(self, inputs, states):
+        next_states = []
+        pos = 0
+        for cell in self._children.values():
+            n = len(cell.state_info())
+            inputs, cell_states = cell(inputs, states[pos:pos + n])
+            next_states.extend(cell_states)
+            pos += n
+        return inputs, next_states
+
+
+class DropoutCell(RecurrentCell):
+    def __init__(self, rate, axes=(), prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._rate = rate
+        self._axes = axes
+
+    def state_info(self, batch_size=0):
+        return []
+
+    def hybrid_forward(self, F, inputs, states):
+        if self._rate > 0:
+            inputs = F.dropout(inputs, p=self._rate, axes=self._axes)
+        return inputs, states
+
+
+class _ModifierCell(RecurrentCell):
+    def __init__(self, base_cell):
+        super().__init__(prefix=base_cell.prefix + self._alias() + "_")
+        self.base_cell = base_cell
+
+    def state_info(self, batch_size=0):
+        return self.base_cell.state_info(batch_size)
+
+    def begin_state(self, batch_size=0, **kwargs):
+        return self.base_cell.begin_state(batch_size, **kwargs)
+
+
+class ResidualCell(_ModifierCell):
+    def _alias(self):
+        return "residual"
+
+    def hybrid_forward(self, F, inputs, states):
+        output, states = self.base_cell(inputs, states)
+        return output + inputs, states
+
+
+class ZoneoutCell(_ModifierCell):
+    def __init__(self, base_cell, zoneout_outputs=0.0, zoneout_states=0.0):
+        self._zo = zoneout_outputs
+        self._zs = zoneout_states
+        super().__init__(base_cell)
+        self._prev_output = None
+
+    def _alias(self):
+        return "zoneout"
+
+    def hybrid_forward(self, F, inputs, states):
+        from ... import autograd as ag
+
+        output, next_states = self.base_cell(inputs, states)
+        if ag.is_training():
+            if self._zo > 0:
+                prev = self._prev_output if self._prev_output is not None \
+                    else F.zeros_like(output)
+                from ... import random as mxrand
+
+                mask = mxrand.bernoulli(1 - self._zo, shape=output.shape,
+                                        dtype=output.dtype)
+                output = mask * output + (1 - mask) * prev
+            if self._zs > 0:
+                mixed = []
+                for new, old in zip(next_states, states):
+                    mask = __import__(
+                        "mxnet_tpu.random", fromlist=["bernoulli"]
+                    ).bernoulli(1 - self._zs, shape=new.shape,
+                                dtype=new.dtype)
+                    mixed.append(mask * new + (1 - mask) * old)
+                next_states = mixed
+        self._prev_output = output
+        return output, next_states
+
+
+class BidirectionalCell(RecurrentCell):
+    """Run two cells over opposite directions inside ``unroll`` (reference
+    ``BidirectionalCell`` — unroll-only, like the reference)."""
+
+    def __init__(self, l_cell, r_cell, output_prefix="bi_"):
+        super().__init__(prefix="", params=None)
+        self.register_child(l_cell, "l_cell")
+        self.register_child(r_cell, "r_cell")
+
+    def state_info(self, batch_size=0):
+        return (self._children["l_cell"].state_info(batch_size) +
+                self._children["r_cell"].state_info(batch_size))
+
+    def begin_state(self, batch_size=0, **kwargs):
+        return (self._children["l_cell"].begin_state(batch_size, **kwargs) +
+                self._children["r_cell"].begin_state(batch_size, **kwargs))
+
+    def __call__(self, inputs, states):
+        raise MXNetError(
+            "BidirectionalCell cannot be stepped; use unroll()")
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        self.reset()
+        l_cell = self._children["l_cell"]
+        r_cell = self._children["r_cell"]
+        n_l = len(l_cell.state_info())
+        begin = begin_state
+        l_out, l_states = l_cell.unroll(
+            length, inputs, begin[:n_l] if begin else None, layout, False)
+        if not isinstance(inputs, (list, tuple)):
+            axis = layout.find("T")
+            steps = [x.squeeze(axis=axis) for x in
+                     inputs.split(num_outputs=length, axis=axis,
+                                  squeeze_axis=False)]
+        else:
+            steps = list(inputs)
+        r_out, r_states = r_cell.unroll(
+            length, list(reversed(steps)),
+            begin[n_l:] if begin else None, layout, False)
+        r_out = list(reversed(r_out))
+        outputs = [nd_mod.concat(l, r, dim=-1)
+                   for l, r in zip(l_out, r_out)]
+        if merge_outputs:
+            outputs = nd_mod.stack(*outputs, axis=layout.find("T"))
+        return outputs, l_states + r_states

@@ -1,0 +1,150 @@
+"""Fused RNN layers (reference: ``python/mxnet/gluon/rnn/rnn_layer.py:?`` —
+``_RNNLayer`` calling the fused RNN op; layouts TNC/NTC; bidirectional;
+per-layer i2h/h2h parameters named ``{l,r}{i}_{i2h,h2h}_{weight,bias}``)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ...base import MXNetError
+from ..block import HybridBlock
+from ... import ndarray as nd_mod
+
+__all__ = ["RNN", "LSTM", "GRU"]
+
+_GATES = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}
+
+
+class _RNNLayer(HybridBlock):
+    def __init__(self, mode, hidden_size, num_layers, layout, dropout,
+                 bidirectional, input_size,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        if layout not in ("TNC", "NTC"):
+            raise MXNetError(f"layout must be TNC or NTC, got {layout!r}")
+        self._mode = mode
+        self._hidden_size = hidden_size
+        self._num_layers = num_layers
+        self._layout = layout
+        self._dropout = dropout
+        self._dir = 2 if bidirectional else 1
+        self._input_size = input_size
+        self._gates = _GATES[mode]
+        ng, ni, nh = self._gates, input_size, hidden_size
+        with self.name_scope():
+            for i in range(num_layers):
+                for j in ("l", "r")[:self._dir]:
+                    self._register_param(
+                        f"{j}{i}_i2h_weight", (ng * nh, ni),
+                        i2h_weight_initializer)
+                    self._register_param(
+                        f"{j}{i}_h2h_weight", (ng * nh, nh),
+                        h2h_weight_initializer)
+                    self._register_param(
+                        f"{j}{i}_i2h_bias", (ng * nh,),
+                        i2h_bias_initializer)
+                    self._register_param(
+                        f"{j}{i}_h2h_bias", (ng * nh,),
+                        h2h_bias_initializer)
+                ni = nh * self._dir
+
+    def _register_param(self, name, shape, init):
+        p = self.params.get(name, shape=shape, init=init,
+                            allow_deferred_init=True)
+        setattr(self, name, p)
+
+    def infer_shape(self, x, *args):
+        ni = int(x.shape[-1])
+        ng, nh = self._gates, self._hidden_size
+        for i in range(self._num_layers):
+            for j in ("l", "r")[:self._dir]:
+                getattr(self, f"{j}{i}_i2h_weight")._finish_deferred_init(
+                    (ng * nh, ni))
+            ni = nh * self._dir
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        if func is None:
+            func = nd_mod.zeros
+        states = []
+        for info in self.state_info(batch_size):
+            info = dict(info)
+            shape = info.pop("shape")
+            info.pop("__layout__", None)
+            states.append(func(shape=shape, **info, **kwargs))
+        return states
+
+    def hybrid_forward(self, F, inputs, states=None, **params):
+        if self._layout == "NTC":
+            inputs = F.swapaxes(inputs, 0, 1)
+        batch_size = inputs.shape[1]
+        skip_states = states is None
+        if skip_states:
+            states = self.begin_state(batch_size,
+                                      dtype=inputs.dtype)
+        if not isinstance(states, (list, tuple)):
+            states = [states]
+        plist = []
+        for i in range(self._num_layers):
+            for j in ("l", "r")[:self._dir]:
+                plist += [params[f"{j}{i}_i2h_weight"],
+                          params[f"{j}{i}_h2h_weight"],
+                          params[f"{j}{i}_i2h_bias"],
+                          params[f"{j}{i}_h2h_bias"]]
+        outs = F.rnn(inputs, list(states), plist, mode=self._mode,
+                     state_size=self._hidden_size,
+                     num_layers=self._num_layers,
+                     bidirectional=self._dir == 2, p=self._dropout)
+        output = outs[0]
+        out_states = list(outs[1:])
+        if self._layout == "NTC":
+            output = F.swapaxes(output, 0, 1)
+        if skip_states:
+            return output
+        return output, out_states
+
+    def __call__(self, inputs, states=None, **kwargs):
+        return super().__call__(inputs, *(
+            [states] if states is not None else []), **kwargs)
+
+
+class RNN(_RNNLayer):
+    """Vanilla multi-layer RNN (reference ``gluon.rnn.RNN``)."""
+
+    def __init__(self, hidden_size, num_layers=1, activation="relu",
+                 layout="TNC", dropout=0, bidirectional=False,
+                 input_size=0, **kwargs):
+        super().__init__(f"rnn_{activation}", hidden_size, num_layers,
+                         layout, dropout, bidirectional, input_size,
+                         **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"}]
+
+
+class LSTM(_RNNLayer):
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0, **kwargs):
+        super().__init__("lstm", hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, **kwargs)
+
+    def state_info(self, batch_size=0):
+        shape = (self._num_layers * self._dir, batch_size,
+                 self._hidden_size)
+        return [{"shape": shape, "__layout__": "LNC"},
+                {"shape": shape, "__layout__": "LNC"}]
+
+
+class GRU(_RNNLayer):
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0, **kwargs):
+        super().__init__("gru", hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"}]

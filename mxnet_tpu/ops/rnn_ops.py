@@ -1,0 +1,139 @@
+"""Fused recurrent ops.
+
+Reference: ``src/operator/rnn.cc:?`` / ``rnn-inl.h:?`` — the fused ``RNN``
+op (vanilla/LSTM/GRU, multi-layer, bidirectional) that gluon's rnn_layer.py
+calls instead of unrolling cells (cuDNN fused path on GPU).
+
+TPU-native: one ``lax.scan`` over time per (layer, direction); the per-step
+matmuls batch onto the MXU, and scan keeps the graph size O(1) in sequence
+length (XLA compiles the loop once) — the property the reference got from
+cuDNN's fused kernels.  Gate orders match the reference cells:
+LSTM [i, f, g, o]; GRU [r, z, n] (``n`` uses the reference's
+``r * (h2h_n)`` formulation).  Layout is TNC like the fused reference op.
+"""
+from __future__ import annotations
+
+import sys
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import MXNetError
+from .registry import apply_op, make_exporter
+
+_this = sys.modules[__name__]
+_export = make_exporter(_this)
+
+_GATES = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}
+
+
+def _cell_step(mode):
+    if mode == "rnn_relu":
+        def step(carry, gates_x, h2h_w, h2h_b):
+            h = carry[0]
+            h_new = jnp.maximum(
+                gates_x + h @ h2h_w.T + h2h_b, 0)
+            return (h_new,), h_new
+    elif mode == "rnn_tanh":
+        def step(carry, gates_x, h2h_w, h2h_b):
+            h = carry[0]
+            h_new = jnp.tanh(gates_x + h @ h2h_w.T + h2h_b)
+            return (h_new,), h_new
+    elif mode == "lstm":
+        def step(carry, gates_x, h2h_w, h2h_b):
+            h, c = carry
+            gates = gates_x + h @ h2h_w.T + h2h_b
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            i = jax.nn.sigmoid(i)
+            f = jax.nn.sigmoid(f)
+            g = jnp.tanh(g)
+            o = jax.nn.sigmoid(o)
+            c_new = f * c + i * g
+            h_new = o * jnp.tanh(c_new)
+            return (h_new, c_new), h_new
+    elif mode == "gru":
+        def step(carry, gates_x, h2h_w, h2h_b):
+            h = carry[0]
+            gh = h @ h2h_w.T + h2h_b
+            xr, xz, xn = jnp.split(gates_x, 3, axis=-1)
+            hr, hz, hn = jnp.split(gh, 3, axis=-1)
+            r = jax.nn.sigmoid(xr + hr)
+            z = jax.nn.sigmoid(xz + hz)
+            n = jnp.tanh(xn + r * hn)
+            h_new = (1 - z) * n + z * h
+            return (h_new,), h_new
+    else:
+        raise MXNetError(f"unknown RNN mode {mode!r}")
+    return step
+
+
+def _run_direction(x, carry, i2h_w, i2h_b, h2h_w, h2h_b, mode, reverse):
+    """One scan over time for one (layer, direction).  The input projection
+    for ALL timesteps is one big batched matmul (MXU-friendly); only the
+    recurrent h2h matmul lives inside the scan."""
+    gates_x = jnp.einsum("tnc,gc->tng", x, i2h_w) + i2h_b
+    step = _cell_step(mode)
+
+    def body(c, gx):
+        return step(c, gx, h2h_w, h2h_b)
+
+    carry, ys = lax.scan(body, carry, gates_x, reverse=reverse)
+    return carry, ys
+
+
+def rnn(data, states, params, mode="lstm", state_size=None, num_layers=1,
+        bidirectional=False, p=0.0, **kwargs):
+    """Fused multi-layer RNN (reference fused ``RNN`` op).
+
+    data: (T, N, C); states: list of (L*D, N, H) arrays (h, and c for
+    lstm); params: flat list per layer*direction:
+    [i2h_w, h2h_w, i2h_b, h2h_b] * L * D.
+    Returns (output (T,N,H*D), *out_states).
+    """
+    if mode not in _GATES:
+        raise MXNetError(f"unknown RNN mode {mode!r}")
+    D = 2 if bidirectional else 1
+    n_states = 2 if mode == "lstm" else 1
+
+    def f(x, *flat):
+        st = flat[:n_states]
+        ps = flat[n_states:]
+        out = x
+        new_h, new_c = [], []
+        for layer in range(num_layers):
+            outs_dir = []
+            for d in range(D):
+                li = layer * D + d
+                i2h_w, h2h_w, i2h_b, h2h_b = ps[4 * li:4 * li + 4]
+                if mode == "lstm":
+                    carry = (st[0][li], st[1][li])
+                else:
+                    carry = (st[0][li],)
+                carry, ys = _run_direction(
+                    out, carry, i2h_w, i2h_b, h2h_w, h2h_b, mode, d == 1)
+                outs_dir.append(ys)
+                new_h.append(carry[0])
+                if mode == "lstm":
+                    new_c.append(carry[1])
+            out = outs_dir[0] if D == 1 else jnp.concatenate(outs_dir,
+                                                            axis=-1)
+            if p > 0 and layer < num_layers - 1:
+                from .. import autograd as ag
+                from .. import random as mxrand
+
+                if ag.is_training():
+                    key = mxrand.next_key()
+                    keep = jax.random.bernoulli(key, 1.0 - p, out.shape)
+                    out = jnp.where(keep, out / (1.0 - p),
+                                    jnp.zeros((), out.dtype))
+        outs = (out, jnp.stack(new_h))
+        if mode == "lstm":
+            outs = outs + (jnp.stack(new_c),)
+        return outs
+
+    return apply_op(f, data, *states, *params, name=f"rnn_{mode}")
+
+
+_export(rnn, aliases=("RNN",))

@@ -1,0 +1,145 @@
+"""RNN tests (reference: tests/python/unittest/test_gluon_rnn.py:? —
+cell-vs-fused-layer consistency is the core check)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.gluon import rnn
+
+
+def test_rnn_cell_step():
+    cell = rnn.RNNCell(8, input_size=4)
+    cell.initialize()
+    x = mx.random.uniform(shape=(3, 4))
+    states = cell.begin_state(3)
+    out, new_states = cell(x, states)
+    assert out.shape == (3, 8)
+    assert new_states[0].shape == (3, 8)
+
+
+def test_lstm_cell_unroll():
+    cell = rnn.LSTMCell(6, input_size=5)
+    cell.initialize()
+    x = mx.random.uniform(shape=(2, 7, 5))  # NTC
+    outputs, states = cell.unroll(7, x, layout="NTC")
+    assert len(outputs) == 7
+    assert outputs[0].shape == (2, 6)
+    assert len(states) == 2
+
+
+def test_gru_cell_deferred_input():
+    cell = rnn.GRUCell(4)
+    cell.initialize()
+    out, states = cell(nd.ones((2, 3)), cell.begin_state(2))
+    assert out.shape == (2, 4)
+    assert cell.i2h_weight.shape == (12, 3)
+
+
+def test_sequential_cell():
+    stack = rnn.SequentialRNNCell()
+    stack.add(rnn.LSTMCell(4, input_size=3))
+    stack.add(rnn.LSTMCell(5, input_size=4))
+    stack.initialize()
+    states = stack.begin_state(2)
+    out, new_states = stack(nd.ones((2, 3)), states)
+    assert out.shape == (2, 5)
+    assert len(new_states) == 4
+
+
+def test_residual_cell():
+    cell = rnn.ResidualCell(rnn.RNNCell(4, input_size=4))
+    cell.initialize()
+    out, _ = cell(nd.ones((2, 4)), cell.begin_state(2))
+    assert out.shape == (2, 4)
+
+
+def test_lstm_layer_matches_cell():
+    """Fused LSTM layer must agree with stepping the cell (the reference's
+    fused-op-vs-cell consistency test)."""
+    layer = rnn.LSTM(6, input_size=5)
+    layer.initialize()
+    x = mx.random.uniform(shape=(4, 2, 5))  # TNC
+    out, states = layer(x, layer.begin_state(2))
+    assert out.shape == (4, 2, 6)
+    assert states[0].shape == (1, 2, 6)
+
+    cell = rnn.LSTMCell(6, input_size=5)
+    cell.initialize()
+    cell.i2h_weight.set_data(layer.l0_i2h_weight.data())
+    cell.h2h_weight.set_data(layer.l0_h2h_weight.data())
+    cell.i2h_bias.set_data(layer.l0_i2h_bias.data())
+    cell.h2h_bias.set_data(layer.l0_h2h_bias.data())
+    h = [nd.zeros((2, 6)), nd.zeros((2, 6))]
+    outs = []
+    for t in range(4):
+        o, h = cell(x[t], h)
+        outs.append(o.asnumpy())
+    assert np.allclose(out.asnumpy(), np.stack(outs), atol=1e-5)
+    assert np.allclose(states[0].asnumpy()[0], outs[-1], atol=1e-5)
+
+
+def test_gru_layer_matches_cell():
+    layer = rnn.GRU(4, input_size=3)
+    layer.initialize()
+    x = mx.random.uniform(shape=(3, 2, 3))
+    out, _ = layer(x, layer.begin_state(2))
+
+    cell = rnn.GRUCell(4, input_size=3)
+    cell.initialize()
+    cell.i2h_weight.set_data(layer.l0_i2h_weight.data())
+    cell.h2h_weight.set_data(layer.l0_h2h_weight.data())
+    cell.i2h_bias.set_data(layer.l0_i2h_bias.data())
+    cell.h2h_bias.set_data(layer.l0_h2h_bias.data())
+    h = [nd.zeros((2, 4))]
+    outs = []
+    for t in range(3):
+        o, h = cell(x[t], h)
+        outs.append(o.asnumpy())
+    assert np.allclose(out.asnumpy(), np.stack(outs), atol=1e-5)
+
+
+def test_lstm_layer_ntc_and_no_states():
+    layer = rnn.LSTM(8, num_layers=2, layout="NTC", input_size=4)
+    layer.initialize()
+    out = layer(nd.ones((3, 5, 4)))
+    assert out.shape == (3, 5, 8)
+
+
+def test_bidirectional_lstm_layer():
+    layer = rnn.LSTM(4, bidirectional=True, input_size=3)
+    layer.initialize()
+    out, states = layer(mx.random.uniform(shape=(5, 2, 3)),
+                        layer.begin_state(2))
+    assert out.shape == (5, 2, 8)
+    assert states[0].shape == (2, 2, 4)
+
+
+def test_rnn_layer_backward():
+    layer = rnn.LSTM(6, input_size=5)
+    layer.initialize()
+    x = mx.random.uniform(shape=(4, 2, 5))
+    with autograd.record():
+        out = layer(x)
+        loss = out.sum()
+    loss.backward()
+    g = layer.l0_i2h_weight.grad()
+    assert np.abs(g.asnumpy()).sum() > 0
+
+
+def test_rnn_layer_hybridize():
+    layer = rnn.GRU(5, num_layers=2, input_size=4)
+    layer.initialize()
+    x = mx.random.uniform(shape=(3, 2, 4))
+    imp = layer(x).asnumpy()
+    layer.hybridize()
+    hyb = layer(x).asnumpy()
+    assert np.allclose(imp, hyb, atol=1e-5)
+
+
+def test_rnn_relu_layer():
+    layer = rnn.RNN(4, activation="relu", input_size=3)
+    layer.initialize()
+    out = layer(nd.ones((2, 2, 3)))
+    assert out.shape == (2, 2, 4)
+    assert (out.asnumpy() >= 0).all()
