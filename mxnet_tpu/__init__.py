@@ -56,6 +56,9 @@ _LAZY = {
     "parallel": ".parallel",
     "profiler": ".profiler",
     "recordio": ".recordio",
+    "serialization": ".serialization",
+    "amp": ".amp",
+    "contrib": ".contrib",
     "test_utils": ".test_utils",
     "util": ".util",
     "runtime": ".runtime",

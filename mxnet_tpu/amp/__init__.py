@@ -1,0 +1,174 @@
+"""Automatic mixed precision.
+
+Reference: ``python/mxnet/contrib/amp/amp.py:?`` + ``lists/symbol_fp16.py:?``
+— op allow/deny lists drive ``amp_cast``/``amp_multicast`` insertion via the
+``low_precision_pass``; a dynamic loss scaler guards fp16 gradients.
+
+TPU-native redesign: the natural low-precision dtype is **bfloat16** (MXU
+native, fp32-range exponent → loss scaling optional).  Casting happens at
+the op-dispatch choke point (``ops.registry.apply_op`` consults this
+module), so it applies to eager AND hybridized execution with no graph
+pass.  The fp16 path keeps the reference's dynamic loss scaler semantics.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..base import MXNetError
+
+__all__ = ["init", "init_trainer", "scale_loss", "unscale",
+           "convert_hybrid_block", "LossScaler", "TARGET_OPS", "FP32_OPS"]
+
+# ops that run in the low-precision dtype (matmul/conv heavy — the MXU set;
+# reference list: lists/symbol_fp16.py FP16_FUNCS)
+TARGET_OPS = {
+    "fully_connected", "convolution", "deconvolution", "dot", "batch_dot",
+    "matmul", "linalg_gemm2", "dot_product_attention", "embedding",
+    "interleaved_selfatt_qk", "interleaved_selfatt_valatt",
+}
+
+# ops pinned to fp32 for numerics (reference FP32_FUNCS)
+FP32_OPS = {
+    "softmax", "log_softmax", "softmax_cross_entropy", "norm", "sum",
+    "mean", "batch_norm", "layer_norm", "group_norm", "instance_norm",
+    "l2_normalization", "exp", "log", "rnn_lstm", "rnn_gru",
+}
+
+_STATE = {"active": False, "dtype": None, "scaler": None}
+
+
+def _target_dtype():
+    return _STATE["dtype"] if _STATE["active"] else None
+
+
+def maybe_cast_args(name, raws):
+    """Called from apply_op: cast float args per the op lists."""
+    dt = _target_dtype()
+    if dt is None:
+        return raws
+    base = name.split("_<")[0]
+    def is_f(r):
+        return np.issubdtype(np.dtype(r.dtype), np.floating) or \
+            np.dtype(r.dtype).name == "bfloat16"
+
+    if base in TARGET_OPS:
+        return [r.astype(dt) if is_f(r) and np.dtype(r.dtype) != dt
+                else r for r in raws]
+    if base in FP32_OPS:
+        return [r.astype(np.float32)
+                if is_f(r) and np.dtype(r.dtype).name in
+                ("float16", "bfloat16") else r for r in raws]
+    return raws
+
+
+def init(target_dtype="bfloat16"):
+    """Enable AMP (reference ``amp.init()``; default dtype is bfloat16 on
+    TPU rather than float16)."""
+    import jax.numpy as jnp
+
+    if str(target_dtype) in ("bfloat16", "bf16"):
+        dt = jnp.bfloat16
+    elif str(target_dtype) in ("float16", "fp16"):
+        dt = np.float16
+    else:
+        raise MXNetError(f"unsupported AMP dtype {target_dtype!r}")
+    _STATE["active"] = True
+    _STATE["dtype"] = np.dtype(dt)
+
+
+def is_active():
+    return _STATE["active"]
+
+
+def turn_off():
+    _STATE["active"] = False
+    _STATE["dtype"] = None
+
+
+class LossScaler:
+    """Dynamic loss scaling (reference amp.py DynamicLossScaler): double
+    every ``scale_window`` clean steps, halve on overflow."""
+
+    def __init__(self, init_scale=2 ** 16, scale_factor=2.0,
+                 scale_window=2000):
+        self.loss_scale = init_scale
+        self._factor = scale_factor
+        self._window = scale_window
+        self._unskipped = 0
+
+    def has_overflow(self, params):
+        for p in params:
+            if p.grad_req == "null" or p._data is None:
+                continue
+            g = p.grad()
+            if g is None:
+                continue
+            s = float(g.abs().sum().asscalar())
+            if not np.isfinite(s):
+                return True
+        return False
+
+    def update_scale(self, overflow):
+        if overflow:
+            self.loss_scale = max(1.0, self.loss_scale / self._factor)
+            self._unskipped = 0
+        else:
+            self._unskipped += 1
+            if self._unskipped >= self._window:
+                self.loss_scale *= self._factor
+                self._unskipped = 0
+
+
+def init_trainer(trainer):
+    """Attach a loss scaler to a Trainer (reference ``amp.init_trainer``).
+    bf16 needs no scaling; attaching one is still permitted."""
+    _STATE["scaler"] = LossScaler()
+    trainer._amp_loss_scaler = _STATE["scaler"]
+    trainer._amp_original_scale = trainer._scale
+
+
+class scale_loss:
+    """``with amp.scale_loss(loss, trainer) as scaled: scaled.backward()``
+    — scales the loss up and the Trainer's rescale down (reference
+    ``amp.scale_loss``)."""
+
+    def __init__(self, loss, trainer):
+        self._trainer = trainer
+        scaler = getattr(trainer, "_amp_loss_scaler", None)
+        if scaler is None:
+            raise MXNetError("call amp.init_trainer(trainer) first")
+        self._scaler = scaler
+        if isinstance(loss, (list, tuple)):
+            self._scaled = [l * scaler.loss_scale for l in loss]
+        else:
+            self._scaled = loss * scaler.loss_scale
+
+    def __enter__(self):
+        self._trainer._scale = self._trainer._amp_original_scale / \
+            self._scaler.loss_scale
+        return self._scaled
+
+    def __exit__(self, *exc):
+        pass
+
+
+def unscale(trainer):
+    """Check grads for overflow and update the dynamic scale; returns True
+    when the step should be skipped (reference overflow handling)."""
+    scaler = getattr(trainer, "_amp_loss_scaler", None)
+    if scaler is None:
+        return False
+    overflow = scaler.has_overflow(trainer._params)
+    scaler.update_scale(overflow)
+    return overflow
+
+
+def convert_hybrid_block(block, target_dtype="bfloat16", ctx=None):
+    """Cast a block's parameters for inference in low precision (reference
+    ``amp.convert_hybrid_block``); norm layers keep fp32 stats via the
+    layer's own cast override."""
+    from ..base import resolve_dtype
+
+    block.cast(resolve_dtype("bfloat16") if str(target_dtype) in
+               ("bfloat16", "bf16") else np.float16)
+    return block

@@ -223,7 +223,9 @@ def backward(heads, head_grads=None, retain_graph: bool = False,
         if slot_list is None:
             continue
         full = tuple(
-            s if s is not None else _zero_cotangent(shape, dt)
+            (s.astype(dt) if getattr(s, "dtype", None) is not None
+             and not _is_float0(s) and np.dtype(s.dtype) != np.dtype(dt)
+             else s) if s is not None else _zero_cotangent(shape, dt)
             for s, (shape, dt) in zip(slot_list, node.out_avals)
         )
         if node.vjp is None:

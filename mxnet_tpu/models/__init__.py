@@ -1,0 +1,21 @@
+"""Model families beyond the vision zoo (BASELINE configs 3 and 5).
+
+``transformer``/``bert`` mirror GluonNLP's model surface; ``llama`` is the
+stretch config (modern LLM under mx.tpu() — NEW capability vs the
+reference).
+"""
+from . import transformer
+from .transformer import Transformer
+from . import bert
+from .bert import BERTModel, BERTClassifier, bert_base, bert_large, \
+    bert_tiny
+
+
+def __getattr__(name):
+    if name == "llama":
+        import importlib
+
+        mod = importlib.import_module(".llama", __name__)
+        globals()["llama"] = mod
+        return mod
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,116 @@
+"""Attention operators.
+
+Reference: ``src/operator/contrib/transformer.cc:?`` — the
+``interleaved_matmul_selfatt_qk/valatt`` + ``div_sqrt_dim`` ops GluonNLP's
+BERT uses for fused self-attention.
+
+TPU-native: one fused ``dot_product_attention`` op (jax.nn's flash-style
+kernel path on TPU; falls back to the XLA softmax(QKᵀ)V fusion elsewhere),
+plus reference-compatible wrappers for the interleaved contrib ops.  bf16
+inputs accumulate in fp32 on the MXU.
+"""
+from __future__ import annotations
+
+import sys
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from .registry import apply_op, make_exporter
+
+_this = sys.modules[__name__]
+_export = make_exporter(_this)
+
+
+def dot_product_attention(query, key, value, mask=None, scale=None,
+                          dropout=0.0, causal=False, **kwargs):
+    """Fused scaled-dot-product attention.
+
+    query/key/value: (B, T, N, H) [batch, seq, heads, head_dim].
+    mask: optional (B, 1|N, Tq, Tk) additive-compatible boolean mask
+    (True = attend).  The TPU build's analog of the reference's
+    interleaved_matmul attention pair.
+    """
+    def f(*args):
+        q, k, v = args[:3]
+        m = args[3] if len(args) > 3 else None
+        if m is not None and m.dtype != jnp.bool_:
+            m = m.astype(jnp.bool_)
+        try:
+            return jax.nn.dot_product_attention(
+                q, k, v, mask=m, scale=scale, is_causal=causal)
+        except Exception:
+            d = q.shape[-1]
+            s = scale if scale is not None else 1.0 / np.sqrt(d)
+            logits = jnp.einsum("btnh,bsnh->bnts", q, k,
+                                preferred_element_type=np.float32) * s
+            if causal:
+                tq, tk = logits.shape[-2:]
+                cm = jnp.tril(jnp.ones((tq, tk), bool))
+                logits = jnp.where(cm, logits, -1e30)
+            if m is not None:
+                logits = jnp.where(m, logits, -1e30)
+            probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+            return jnp.einsum("bnts,bsnh->btnh", probs, v)
+
+    args = (query, key, value) + ((mask,) if mask is not None else ())
+    return apply_op(f, *args, name="dot_product_attention")
+
+
+_export(dot_product_attention)
+
+
+def div_sqrt_dim(data, **kwargs):
+    """Reference contrib ``_contrib_div_sqrt_dim``: x / sqrt(last_dim)."""
+    return apply_op(lambda a: a / np.sqrt(a.shape[-1]), data,
+                    name="div_sqrt_dim")
+
+
+_export(div_sqrt_dim, aliases=("_contrib_div_sqrt_dim",))
+
+
+def interleaved_matmul_selfatt_qk(queries_keys_values, heads=1, **kwargs):
+    """Reference contrib op: projected interleaved QKV (T, B, 3*E) →
+    attention scores (B*heads, T, T) — kept for GluonNLP-script parity;
+    new code should use dot_product_attention."""
+    def f(qkv):
+        t, b, e3 = qkv.shape
+        e = e3 // 3
+        h = e // heads
+        qkv = qkv.reshape(t, b, heads, 3, h)
+        q = qkv[:, :, :, 0]
+        k = qkv[:, :, :, 1]
+        q = q / np.sqrt(h)
+        scores = jnp.einsum("tbnh,sbnh->bnts", q, k,
+                            preferred_element_type=np.float32)
+        return scores.reshape(b * heads, t, t).astype(qkv.dtype)
+
+    return apply_op(f, queries_keys_values, name="interleaved_selfatt_qk")
+
+
+_export(interleaved_matmul_selfatt_qk,
+        aliases=("_contrib_interleaved_matmul_selfatt_qk",))
+
+
+def interleaved_matmul_selfatt_valatt(queries_keys_values, attention,
+                                      heads=1, **kwargs):
+    """Reference contrib op: attention (B*heads, T, T) x interleaved V →
+    (T, B, E)."""
+    def f(qkv, att):
+        t, b, e3 = qkv.shape
+        e = e3 // 3
+        h = e // heads
+        v = qkv.reshape(t, b, heads, 3, h)[:, :, :, 2]
+        att = att.reshape(b, heads, t, t)
+        out = jnp.einsum("bnts,sbnh->tbnh", att, v,
+                         preferred_element_type=np.float32)
+        return out.reshape(t, b, e).astype(qkv.dtype)
+
+    return apply_op(f, queries_keys_values, attention,
+                    name="interleaved_selfatt_valatt")
+
+
+_export(interleaved_matmul_selfatt_valatt,
+        aliases=("_contrib_interleaved_matmul_selfatt_valatt",))

@@ -69,6 +69,10 @@ def apply_op(fun: Callable, *nd_args, name: str = ""):
     from ..ndarray import NDArray
 
     raws = [a._data for a in nd_args]
+    from .. import amp as _amp
+
+    if _amp.is_active():
+        raws = _amp.maybe_cast_args(name, raws)
     recording = ag.is_recording() and any(_in_graph(a) for a in nd_args)
     if recording:
         outs, vjp = jax.vjp(fun, *raws)

@@ -1,0 +1,98 @@
+"""AMP tests (reference: tests/python/gpu/test_contrib_amp.py:? — cast-list
+behaviour, loss scaling, converted-model inference)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import amp, autograd, gluon, nd
+from mxnet_tpu.gluon import nn
+
+
+@pytest.fixture(autouse=True)
+def _amp_off():
+    yield
+    amp.turn_off()
+
+
+def test_amp_init_casts_matmul_ops():
+    amp.init("bfloat16")
+    a = nd.ones((4, 8))
+    w = nd.ones((3, 8))
+    out = nd.fully_connected(a, w, no_bias=True, num_hidden=3)
+    assert out.dtype.name == "bfloat16"
+    # fp32-pinned op keeps fp32
+    s = nd.softmax(nd.ones((2, 3)))
+    assert s.dtype == np.float32
+
+
+def test_amp_training_step_bf16():
+    amp.init("bfloat16")
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(16, activation="relu", in_units=8),
+                nn.Dense(4, in_units=16))
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    x = mx.random.uniform(shape=(8, 8))
+    y = nd.array(np.arange(8) % 4)
+    losses = []
+    for _ in range(10):
+        with autograd.record():
+            loss = loss_fn(net(x), y)
+        loss.backward()
+        trainer.step(8)
+        losses.append(float(loss.mean().asscalar()))
+    assert losses[-1] < losses[0]
+
+
+def test_loss_scaler_dynamics():
+    s = amp.LossScaler(init_scale=16, scale_factor=2, scale_window=2)
+    s.update_scale(False)
+    s.update_scale(False)
+    assert s.loss_scale == 32
+    s.update_scale(True)
+    assert s.loss_scale == 16
+
+
+def test_scale_loss_context():
+    net = nn.Dense(2, in_units=3)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    amp.init_trainer(trainer)
+    x = nd.ones((2, 3))
+    with autograd.record():
+        loss = net(x).sum()
+        with amp.scale_loss(loss, trainer) as scaled:
+            pass
+    assert float(scaled.asscalar()) == pytest.approx(
+        float(loss.asscalar()) * trainer._amp_loss_scaler.loss_scale)
+    overflow = amp.unscale(trainer)
+    assert overflow is False
+
+
+def test_convert_hybrid_block():
+    net = nn.Dense(4, in_units=3)
+    net.initialize()
+    amp.convert_hybrid_block(net)
+    assert net.weight.data().dtype.name == "bfloat16"
+    out = net(nd.ones((2, 3)).astype("bfloat16"))
+    assert out.dtype.name == "bfloat16"
+
+
+def test_multi_precision_with_bf16_params():
+    net = nn.Dense(4, in_units=3)
+    net.initialize()
+    net.cast("bfloat16")
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1, "multi_precision": True})
+    with autograd.record():
+        loss = net(nd.ones((2, 3)).astype("bfloat16")).sum()
+    loss.backward()
+    trainer.step(2)
+    # master weight is fp32
+    master, _ = trainer._states[0]
+    assert master.dtype == np.float32
+    assert net.weight.data().dtype.name == "bfloat16"

@@ -1,0 +1,148 @@
+"""Transformer/BERT model tests (reference: GluonNLP model tests —
+forward shapes, masking semantics, gradient flow, hybridize parity)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.models import bert, transformer
+
+
+def test_attention_op_matches_manual():
+    b, t, h, d = 2, 5, 2, 4
+    rng = np.random.RandomState(0)
+    q = rng.rand(b, t, h, d).astype(np.float32)
+    k = rng.rand(b, t, h, d).astype(np.float32)
+    v = rng.rand(b, t, h, d).astype(np.float32)
+    out = nd.dot_product_attention(nd.array(q), nd.array(k),
+                                   nd.array(v)).asnumpy()
+    logits = np.einsum("btnh,bsnh->bnts", q, k) / np.sqrt(d)
+    probs = np.exp(logits - logits.max(-1, keepdims=True))
+    probs = probs / probs.sum(-1, keepdims=True)
+    expect = np.einsum("bnts,bsnh->btnh", probs, v)
+    assert np.allclose(out, expect, atol=1e-4)
+
+
+def test_attention_causal():
+    b, t, h, d = 1, 4, 1, 2
+    q = nd.ones((b, t, h, d))
+    k = mx.random.uniform(shape=(b, t, h, d))
+    v_np = np.arange(t, dtype=np.float32).reshape(1, t, 1, 1) * \
+        np.ones((b, t, h, d), np.float32)
+    out = nd.dot_product_attention(q, k, nd.array(v_np),
+                                   causal=True).asnumpy()
+    # first position can only attend to itself → output == v[0]
+    assert np.allclose(out[0, 0], v_np[0, 0], atol=1e-5)
+
+
+def test_interleaved_selfatt_ops():
+    t, b, e, heads = 3, 2, 8, 2
+    qkv = mx.random.uniform(shape=(t, b, 3 * e))
+    scores = nd.interleaved_matmul_selfatt_qk(qkv, heads=heads)
+    assert scores.shape == (b * heads, t, t)
+    att = nd.softmax(scores, axis=-1)
+    out = nd.interleaved_matmul_selfatt_valatt(qkv, att, heads=heads)
+    assert out.shape == (t, b, e)
+
+
+def test_multi_head_attention_block():
+    mha = transformer.MultiHeadAttention(units=16, num_heads=4)
+    mha.initialize()
+    x = mx.random.uniform(shape=(2, 6, 16))
+    out = mha(x, x, x)
+    assert out.shape == (2, 6, 16)
+
+
+def test_transformer_encoder():
+    enc = transformer.TransformerEncoder(num_layers=2, units=16,
+                                         hidden_size=32, num_heads=2,
+                                         max_length=32, dropout=0.0)
+    enc.initialize()
+    out = enc(mx.random.uniform(shape=(2, 7, 16)))
+    assert out.shape == (2, 7, 16)
+
+
+def test_transformer_mt_forward_backward():
+    net = transformer.Transformer(src_vocab_size=50, tgt_vocab_size=60,
+                                  num_layers=2, units=16, hidden_size=32,
+                                  num_heads=2, max_length=32, dropout=0.0)
+    net.initialize()
+    src = nd.array(np.random.randint(0, 50, (2, 6)))
+    tgt = nd.array(np.random.randint(0, 60, (2, 5)))
+    with autograd.record():
+        out = net(src, tgt)
+        loss = out.sum()
+    loss.backward()
+    assert out.shape == (2, 5, 60)
+    g = net.src_embed.weight.grad()
+    assert np.abs(g.asnumpy()).sum() > 0
+
+
+def test_transformer_causal_decode():
+    """Changing a future target token must not change earlier logits."""
+    net = transformer.Transformer(src_vocab_size=20, tgt_vocab_size=20,
+                                  num_layers=1, units=8, hidden_size=16,
+                                  num_heads=2, max_length=16, dropout=0.0)
+    net.initialize()
+    src = nd.array([[1, 2, 3]])
+    tgt1 = nd.array([[4, 5, 6]])
+    tgt2 = nd.array([[4, 5, 9]])
+    o1 = net(src, tgt1).asnumpy()
+    o2 = net(src, tgt2).asnumpy()
+    assert np.allclose(o1[0, :2], o2[0, :2], atol=1e-5)
+    assert not np.allclose(o1[0, 2], o2[0, 2])
+
+
+def test_bert_tiny_forward():
+    net = bert.bert_tiny(vocab_size=100)
+    net.initialize()
+    tokens = nd.array(np.random.randint(0, 100, (2, 12)))
+    segments = nd.array(np.zeros((2, 12)))
+    seq, pooled, nsp, mlm = net(tokens, segments)
+    assert seq.shape == (2, 12, 128)
+    assert pooled.shape == (2, 128)
+    assert nsp.shape == (2, 2)
+    assert mlm.shape == (2, 12, 100)
+
+
+def test_bert_valid_length_masking():
+    """Padding tokens beyond valid_length must not affect real positions."""
+    net = bert.bert_tiny(vocab_size=50, dropout=0.0)
+    net.initialize()
+    t1 = np.random.randint(1, 50, (1, 8))
+    t2 = t1.copy()
+    t2[0, 6:] = 3  # change padding region
+    vl = nd.array([6.0])
+    s1 = net(nd.array(t1), None, vl)[0].asnumpy()
+    s2 = net(nd.array(t2), None, vl)[0].asnumpy()
+    assert np.allclose(s1[0, :6], s2[0, :6], atol=1e-4)
+
+
+def test_bert_classifier_train_step():
+    base = bert.bert_tiny(vocab_size=60, dropout=0.0)
+    net = bert.BERTClassifier(base, num_classes=3)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 1e-3})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    tokens = nd.array(np.random.randint(0, 60, (4, 10)))
+    labels = nd.array([0, 1, 2, 0])
+    losses = []
+    for _ in range(5):
+        with autograd.record():
+            loss = loss_fn(net(tokens), labels)
+        loss.backward()
+        trainer.step(4)
+        losses.append(float(loss.mean().asscalar()))
+    assert losses[-1] < losses[0]
+
+
+def test_bert_hybridize_parity():
+    net = bert.bert_tiny(vocab_size=40, dropout=0.0, use_decoder=False,
+                         use_classifier=False)
+    net.initialize()
+    tokens = nd.array(np.random.randint(0, 40, (2, 6)))
+    imp = net(tokens)[0].asnumpy()
+    net.hybridize()
+    hyb = net(tokens)[0].asnumpy()
+    assert np.allclose(imp, hyb, atol=1e-4)
