@@ -42,6 +42,12 @@ from . import ops
 # fast (the reference generates op wrappers at import; we defer heavyweight
 # subpackages instead)
 _LAZY = {
+    "symbol": ".symbol",
+    "sym": ".symbol",
+    "module": ".module",
+    "mod": ".module",
+    "executor": ".executor",
+    "name": ".name",
     "gluon": ".gluon",
     "optimizer": ".optimizer",
     "kvstore": ".kvstore",

@@ -1,0 +1,229 @@
+"""Executor: bound evaluation of a symbolic graph.
+
+Reference: ``python/mxnet/executor.py:?`` over ``src/executor/
+graph_executor.cc:?`` — ``Bind`` compiles a Symbol against concrete arrays
+(infer passes → memory plan → op executors), ``Forward``/``Backward`` walk
+the cached op list, pushing to the dependency engine (SURVEY §3.3).
+
+TPU-native redesign: the "bind-time compilation" the reference hand-rolled
+(PlanMemory, inplace/addto detection, op bulking) is XLA's job — the
+executor evaluates the DAG through the registry's jnp ops, so every forward
+is a traced XLA program under the caller's jit scope, and the autograd tape
+supplies Backward (the nnvm Gradient pass equivalent).  Aux-state mutation
+(BatchNorm moving stats) is committed after each training forward exactly
+where the reference's op mutated its aux inputs in place.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from . import autograd as ag
+from .base import MXNetError
+from .context import current_context
+from .ndarray import NDArray
+from .ops import registry as _op_registry
+
+__all__ = ["Executor"]
+
+# ops that return (out, new_moving_mean, new_moving_var): outputs 1,2 are
+# commits into aux inputs 3,4 during training
+_BN_OPS = {"BatchNorm", "batch_norm"}
+
+
+class Executor:
+    """Holds bound arg/grad/aux arrays and runs forward/backward."""
+
+    def __init__(self, symbol, ctx, arg_dict, grad_dict, aux_dict,
+                 grad_req):
+        self._symbol = symbol
+        self._ctx = ctx or current_context()
+        self.arg_dict = arg_dict
+        self.grad_dict = grad_dict
+        self.aux_dict = aux_dict
+        self._grad_req = grad_req          # name -> req string
+        self.outputs = []
+        self._arg_names = symbol.list_arguments()
+        self._aux_names = symbol.list_auxiliary_states()
+        for name, arr in arg_dict.items():
+            req = grad_req.get(name, "null")
+            if req != "null":
+                arr.attach_grad(grad_req=req)
+                self.grad_dict[name] = arr._grad
+
+    # --- array views --------------------------------------------------------
+
+    @property
+    def arg_arrays(self):
+        return [self.arg_dict[n] for n in self._arg_names]
+
+    @property
+    def grad_arrays(self):
+        return [self.grad_dict.get(n) for n in self._arg_names]
+
+    @property
+    def aux_arrays(self):
+        return [self.aux_dict[n] for n in self._aux_names]
+
+    # --- binding ------------------------------------------------------------
+
+    @staticmethod
+    def _simple_bind(symbol, ctx, grad_req, type_dict, shape_kwargs):
+        arg_shapes, _out, aux_shapes = symbol.infer_shape(**shape_kwargs)
+        arg_names = symbol.list_arguments()
+        aux_names = symbol.list_auxiliary_states()
+        type_dict = type_dict or {}
+        arg_dict, aux_dict = {}, {}
+        for name, shape in zip(arg_names, arg_shapes):
+            dt = np.dtype(type_dict.get(name, np.float32))
+            arg_dict[name] = NDArray(np.zeros(shape, dt), ctx=ctx)
+        for name, shape in zip(aux_names, aux_shapes):
+            dt = np.dtype(type_dict.get(name, np.float32))
+            init = np.ones(shape, dt) if name.endswith("var") \
+                else np.zeros(shape, dt)
+            aux_dict[name] = NDArray(init, ctx=ctx)
+        reqs = Executor._norm_grad_req(grad_req, arg_names)
+        return Executor(symbol, ctx, arg_dict, {}, aux_dict, reqs)
+
+    @staticmethod
+    def _bind(symbol, ctx, args, args_grad, grad_req, aux_states):
+        arg_names = symbol.list_arguments()
+        aux_names = symbol.list_auxiliary_states()
+        arg_dict = Executor._to_dict(args, arg_names, "args")
+        aux_dict = Executor._to_dict(aux_states, aux_names, "aux_states") \
+            if aux_states is not None else {
+                n: None for n in aux_names}
+        if aux_names and any(v is None for v in aux_dict.values()):
+            raise MXNetError("aux_states required for symbols with "
+                             f"auxiliary states {aux_names}")
+        reqs = Executor._norm_grad_req(grad_req, arg_names)
+        exe = Executor(symbol, ctx, arg_dict, {}, aux_dict, reqs)
+        if args_grad:
+            # caller-provided gradient buffers: redirect commits
+            gd = Executor._to_dict(args_grad, arg_names, "args_grad",
+                                   allow_missing=True)
+            for name, buf in gd.items():
+                if buf is None:
+                    continue
+                arr = arg_dict[name]
+                if arr._grad is not None:
+                    arr._grad = buf
+                    exe.grad_dict[name] = buf
+        return exe
+
+    @staticmethod
+    def _to_dict(arrays, names, what, allow_missing=False):
+        if arrays is None:
+            raise MXNetError(f"{what} is required for bind")
+        if isinstance(arrays, dict):
+            out = {}
+            for n in names:
+                if n not in arrays and not allow_missing:
+                    raise MXNetError(f"{what} missing entry for {n!r}")
+                out[n] = arrays.get(n)
+            return out
+        arrays = list(arrays)
+        if len(arrays) != len(names):
+            raise MXNetError(
+                f"{what} has {len(arrays)} entries, expected {len(names)}")
+        return dict(zip(names, arrays))
+
+    @staticmethod
+    def _norm_grad_req(grad_req, arg_names):
+        if isinstance(grad_req, str):
+            return {n: grad_req for n in arg_names}
+        if isinstance(grad_req, (list, tuple)):
+            return dict(zip(arg_names, grad_req))
+        return {n: grad_req.get(n, "null") for n in arg_names}
+
+    # --- execution ----------------------------------------------------------
+
+    def _run_graph(self, is_train):
+        values = {}
+        bn_commits = []
+        for node in self._symbol._topo():
+            if node.is_var():
+                name = node.name
+                if name in self.arg_dict:
+                    values[id(node)] = (self.arg_dict[name],)
+                elif name in self.aux_dict:
+                    values[id(node)] = (self.aux_dict[name],)
+                else:
+                    raise MXNetError(f"unbound variable {name!r}")
+                continue
+            fn = _op_registry.get_op(node.op)
+            if fn is None:
+                raise MXNetError(f"op {node.op!r} not in registry")
+            attrs = {k: v for k, v in node.attrs.items()
+                     if not k.startswith("__")}
+            ins = [values[id(s)][oi] for s, oi in node.inputs]
+            out = fn(*ins, **attrs)
+            outs = out if isinstance(out, (tuple, list)) else (out,)
+            values[id(node)] = tuple(outs)
+            if node.op in _BN_OPS and is_train and len(outs) >= 3 and \
+                    len(node.inputs) >= 5:
+                bn_commits.append((node, outs))
+        if is_train:
+            for node, outs in bn_commits:
+                for slot, new in ((3, outs[1]), (4, outs[2])):
+                    src, _ = node.inputs[slot]
+                    aux = self.aux_dict.get(src.name)
+                    if aux is None:
+                        aux = self.arg_dict.get(src.name)
+                    if aux is not None:
+                        aux._data = new._data.astype(aux.dtype) \
+                            if new.dtype != aux.dtype else new._data
+        return [values[id(n)][oi] for n, oi in self._symbol._heads]
+
+    def forward(self, is_train=False, **kwargs):
+        for name, value in kwargs.items():
+            if name not in self.arg_dict:
+                raise MXNetError(f"unknown input {name!r}")
+            arr = self.arg_dict[name]
+            v = value._data if isinstance(value, NDArray) else \
+                NDArray(value)._data
+            arr._data = v.astype(arr.dtype) if v.dtype != arr.dtype else v
+            arr._node = None  # fresh leaf for this pass
+        if is_train:
+            with ag.record():
+                self.outputs = self._run_graph(True)
+        else:
+            with ag.pause():
+                self.outputs = self._run_graph(False)
+        return self.outputs
+
+    def backward(self, out_grads=None):
+        if not self.outputs:
+            raise MXNetError("call forward(is_train=True) before backward")
+        if out_grads is None:
+            heads, grads = self.outputs, None
+        else:
+            if isinstance(out_grads, NDArray):
+                out_grads = [out_grads]
+            heads, grads = self.outputs, list(out_grads)
+        ag.backward(heads, grads)
+
+    # --- misc ---------------------------------------------------------------
+
+    def copy_params_from(self, arg_params, aux_params=None):
+        for name, arr in (arg_params or {}).items():
+            if name in self.arg_dict:
+                dst = self.arg_dict[name]
+                src = arr._data if isinstance(arr, NDArray) else \
+                    NDArray(arr)._data
+                dst._data = src.astype(dst.dtype)
+        for name, arr in (aux_params or {}).items():
+            if name in self.aux_dict:
+                dst = self.aux_dict[name]
+                src = arr._data if isinstance(arr, NDArray) else \
+                    NDArray(arr)._data
+                dst._data = src.astype(dst.dtype)
+
+    def reshape(self, partial_shaping=False, allow_up_sizing=False,
+                **kwargs):
+        """Re-bind with new input shapes (parameters are carried over)."""
+        shapes = {k: v for k, v in kwargs.items() if k in self.arg_dict}
+        exe = Executor._simple_bind(
+            self._symbol, self._ctx,
+            {n: r for n, r in self._grad_req.items()}, None, shapes)
+        exe.copy_params_from(self.arg_dict, self.aux_dict)
+        return exe

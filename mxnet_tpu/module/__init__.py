@@ -1,0 +1,9 @@
+"""The ``mx.mod`` namespace: legacy symbolic training API.
+
+Reference: ``python/mxnet/module/__init__.py:?``.
+"""
+from .base_module import BaseModule
+from .module import Module
+from .bucketing_module import BucketingModule
+
+__all__ = ["BaseModule", "Module", "BucketingModule"]

@@ -20,6 +20,7 @@ from ..ops.tensor import *  # noqa: F401,F403
 from ..ops.nn_ops import *  # noqa: F401,F403
 from ..ops.rnn_ops import *  # noqa: F401,F403
 from ..ops.attention import *  # noqa: F401,F403
+from ..ops.output_ops import *  # noqa: F401,F403
 from ..ops import registry as _registry
 
 # random sampling lives in mx.nd.random too (reference parity)

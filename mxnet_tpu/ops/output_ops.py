@@ -1,0 +1,210 @@
+"""Symbol-layer output (loss) ops and scalar elemwise ops.
+
+Reference: ``src/operator/regression_output{-inl.h,.cc}:?`` and
+``src/operator/softmax_output{-inl.h,.cc}:?`` — the legacy symbolic API's
+loss heads.  Forward is the plain transform (softmax / sigmoid / identity);
+backward IGNORES the incoming head gradient and emits the loss gradient
+directly (``out - label`` style), which is what made ``Module.fit`` work
+without an explicit loss term.  ``MakeLoss`` / ``BlockGrad`` follow
+``src/operator/make_loss{-inl.h}.cc:?`` and ``src/operator/tensor/
+elemwise_unary_op_basic.cc:?`` (stop_gradient).
+
+Scalar ops (``_plus_scalar``...) mirror the reference's
+``src/operator/tensor/elemwise_binary_scalar_op_basic.cc:?`` registry names
+so nnvm symbol graphs that embed scalar arithmetic execute unchanged.
+
+TPU-native: the custom backward rules are ``jax.custom_vjp`` functions, so
+they compose with jit/vjp exactly like FGradient composed with the
+reference's autograd pass.
+"""
+from __future__ import annotations
+
+import sys
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .registry import apply_op, make_exporter
+
+_this = sys.modules[__name__]
+_export = make_exporter(_this)
+
+
+def _norm_den(label, normalization, use_ignore, valid):
+    """Gradient denominator per the reference's ``normalization`` enum."""
+    if normalization == "batch":
+        return float(label.shape[0])
+    if normalization == "valid":
+        if use_ignore:
+            return jnp.maximum(valid.sum(), 1).astype(np.float32)
+        return float(np.prod(label.shape))
+    return 1.0
+
+
+def softmax_output(data, label, grad_scale=1.0, ignore_label=-1.0,
+                   multi_output=False, use_ignore=False,
+                   preserve_shape=False, normalization="null",
+                   out_grad=False, smooth_alpha=0.0, **kwargs):
+    """Reference ``SoftmaxOutput`` (src/operator/softmax_output.cc:?)."""
+    axis = 1 if multi_output else -1
+
+    @jax.custom_vjp
+    def f(d, l):
+        return jax.nn.softmax(d.astype(np.float32), axis=axis).astype(d.dtype)
+
+    def fwd(d, l):
+        out = f(d, l)
+        return out, (out, l)
+
+    def bwd(res, g):
+        out, l = res
+        c = out.shape[axis]
+        oh = jax.nn.one_hot(l.astype(jnp.int32), c, axis=axis,
+                            dtype=np.float32)
+        if smooth_alpha:
+            oh = oh * (1.0 - smooth_alpha) + (smooth_alpha / (c - 1)) * (1 - oh)
+        grad = out.astype(np.float32) - oh
+        valid = None
+        if use_ignore:
+            valid = (l != ignore_label)
+            grad = grad * jnp.expand_dims(valid, axis if multi_output else -1
+                                          ).astype(grad.dtype)
+        grad = grad * (grad_scale /
+                       _norm_den(l, normalization, use_ignore, valid))
+        return grad.astype(out.dtype), jnp.zeros_like(l)
+
+    f.defvjp(fwd, bwd)
+    return apply_op(f, data, label, name="SoftmaxOutput")
+
+
+_export(softmax_output, aliases=("SoftmaxOutput",))
+
+
+def _regression_output(transform, grad_fn, opname):
+    def op(data, label, grad_scale=1.0, **kwargs):
+        @jax.custom_vjp
+        def f(d, l):
+            return transform(d)
+
+        def fwd(d, l):
+            out = transform(d)
+            return out, (out, l)
+
+        def bwd(res, g):
+            out, l = res
+            num_output = max(int(np.prod(out.shape[1:])), 1)
+            grad = grad_fn(out, l.reshape(out.shape)) * (grad_scale / num_output)
+            return grad.astype(out.dtype), jnp.zeros_like(l)
+
+        f.defvjp(fwd, bwd)
+        return apply_op(f, data, label, name=opname)
+
+    op.__name__ = opname
+    return op
+
+
+linear_regression_output = _regression_output(
+    lambda d: d, lambda o, l: o - l, "LinearRegressionOutput")
+logistic_regression_output = _regression_output(
+    lambda d: jax.nn.sigmoid(d), lambda o, l: o - l,
+    "LogisticRegressionOutput")
+mae_regression_output = _regression_output(
+    lambda d: d, lambda o, l: jnp.sign(o - l), "MAERegressionOutput")
+
+_export(linear_regression_output, aliases=("LinearRegressionOutput",))
+_export(logistic_regression_output, aliases=("LogisticRegressionOutput",))
+_export(mae_regression_output, aliases=("MAERegressionOutput",))
+
+
+def make_loss(data, grad_scale=1.0, valid_thresh=0.0,
+              normalization="null", **kwargs):
+    """Reference ``MakeLoss`` (src/operator/make_loss.cc:?): identity
+    forward, constant ``grad_scale`` backward."""
+
+    @jax.custom_vjp
+    def f(d):
+        return d
+
+    def fwd(d):
+        return d, d.shape
+
+    def bwd(shape, g):
+        den = float(shape[0]) if normalization == "batch" else (
+            float(np.prod(shape)) if normalization == "valid" else 1.0)
+        return (jnp.full(shape, grad_scale / den, dtype=g.dtype),)
+
+    f.defvjp(fwd, bwd)
+    return apply_op(f, data, name="MakeLoss")
+
+
+_export(make_loss, aliases=("MakeLoss", "make_loss_"))
+
+
+def stop_gradient(data, **kwargs):
+    """Reference ``BlockGrad``/``stop_gradient``."""
+    return apply_op(jax.lax.stop_gradient, data, name="BlockGrad")
+
+
+_export(stop_gradient, name="BlockGrad", aliases=("stop_gradient",))
+
+
+# --- scalar elemwise ops ----------------------------------------------------
+# Reference: src/operator/tensor/elemwise_binary_scalar_op_basic.cc:? and
+# elemwise_binary_scalar_op_extended.cc:? — the registry names embedded in
+# nnvm symbol json whenever users write ``sym + 2``.
+
+def _scalar_op(opname, fn):
+    def op(data, scalar=1.0, **kwargs):
+        s = float(scalar)
+        return apply_op(lambda x: fn(x, s), data, name=opname)
+
+    op.__name__ = opname
+    return op
+
+
+_SCALAR_OPS = {
+    "_plus_scalar": lambda x, s: x + s,
+    "_minus_scalar": lambda x, s: x - s,
+    "_rminus_scalar": lambda x, s: s - x,
+    "_mul_scalar": lambda x, s: x * s,
+    "_div_scalar": lambda x, s: x / s,
+    "_rdiv_scalar": lambda x, s: s / x,
+    "_mod_scalar": lambda x, s: jnp.mod(x, s),
+    "_rmod_scalar": lambda x, s: jnp.mod(s, x),
+    "_power_scalar": lambda x, s: jnp.power(x, s),
+    "_rpower_scalar": lambda x, s: jnp.power(s, x),
+    "_maximum_scalar": lambda x, s: jnp.maximum(x, s),
+    "_minimum_scalar": lambda x, s: jnp.minimum(x, s),
+    "_hypot_scalar": lambda x, s: jnp.hypot(x, s),
+    "_equal_scalar": lambda x, s: (x == s).astype(x.dtype),
+    "_not_equal_scalar": lambda x, s: (x != s).astype(x.dtype),
+    "_greater_scalar": lambda x, s: (x > s).astype(x.dtype),
+    "_greater_equal_scalar": lambda x, s: (x >= s).astype(x.dtype),
+    "_lesser_scalar": lambda x, s: (x < s).astype(x.dtype),
+    "_lesser_equal_scalar": lambda x, s: (x <= s).astype(x.dtype),
+    "_logical_and_scalar": lambda x, s: jnp.logical_and(x, s).astype(x.dtype),
+    "_logical_or_scalar": lambda x, s: jnp.logical_or(x, s).astype(x.dtype),
+    "_logical_xor_scalar": lambda x, s: jnp.logical_xor(x, s).astype(x.dtype),
+}
+
+for _name, _fn in _SCALAR_OPS.items():
+    _export(_scalar_op(_name, _fn), name=_name)
+
+
+# --- creation ops (registry-addressable for symbolic graphs) ---------------
+# Reference: src/operator/tensor/init_op.cc:? (_zeros/_ones appear as nodes
+# in nnvm json when users call mx.sym.zeros)
+
+def _zeros(shape=(), dtype="float32", **kwargs):
+    return apply_op(lambda: jnp.zeros(tuple(shape), np.dtype(dtype)),
+                    name="_zeros")
+
+
+def _ones(shape=(), dtype="float32", **kwargs):
+    return apply_op(lambda: jnp.ones(tuple(shape), np.dtype(dtype)),
+                    name="_ones")
+
+
+_export(_zeros, name="_zeros")
+_export(_ones, name="_ones")

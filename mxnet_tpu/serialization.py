@@ -191,6 +191,8 @@ def save_checkpoint(prefix, epoch, symbol=None, arg_params=None,
     if symbol is not None and hasattr(symbol, "export"):
         symbol.export(prefix, epoch)
         return
+    if symbol is not None and hasattr(symbol, "tojson"):
+        symbol.save(f"{prefix}-symbol.json")
     payload = {}
     for k, v in (arg_params or {}).items():
         payload[f"arg:{k}"] = v
@@ -215,7 +217,21 @@ def load_checkpoint(prefix, epoch):
     import os
 
     if os.path.isfile(f"{prefix}-symbol.json"):
-        from .gluon import symbol_block
+        with open(f"{prefix}-symbol.json") as f:
+            text = f.read()
+        import json as _json
 
-        sym = symbol_block.load_symbol_json(f"{prefix}-symbol.json")
+        meta = _json.loads(text)
+        if "nodes" in meta:
+            from . import symbol as _sym
+
+            sym = _sym.load_json(text)
+        else:
+            from .base import MXNetError
+
+            raise MXNetError(
+                f"{prefix}-symbol.json is a "
+                f"{meta.get('mxnet_tpu_format', 'unknown')}-format export, "
+                "not an nnvm symbol graph; load it with "
+                "gluon.SymbolBlock.imports instead of load_checkpoint")
     return sym, arg_params, aux_params

@@ -1,0 +1,33 @@
+"""The ``mx.sym`` namespace.
+
+Reference: ``python/mxnet/symbol/__init__.py:?`` — op wrappers generated at
+import time from the C++ registry (``symbol/register.py:?``).  Here every
+op in the python registry gets a symbol-level builder that appends graph
+nodes instead of executing.
+"""
+from __future__ import annotations
+
+from ..ops import registry as _registry
+from .symbol import (Symbol, Variable, var, Group, load, load_json,
+                     zeros, ones, _sym_op)
+
+__all__ = ["Symbol", "Variable", "var", "Group", "load", "load_json",
+           "zeros", "ones"]
+
+# generate mx.sym.<op> for every registered op; ops land as module attrs so
+# tab-completion and getattr both work (the reference generates these from
+# the C++ registry at import)
+for _opname in _registry.list_ops():
+    if _opname in globals():
+        continue
+    globals()[_opname] = _sym_op(_opname)
+    __all__.append(_opname)
+
+
+def __getattr__(name):
+    # ops registered after import (custom ops, plugins) resolve lazily
+    if _registry.get_op(name) is not None:
+        fn = _sym_op(name)
+        globals()[name] = fn
+        return fn
+    raise AttributeError(f"module 'symbol' has no attribute {name!r}")

@@ -1,0 +1,157 @@
+"""Legacy Module API tests.
+
+Modeled on the reference's tests/python/unittest/test_module.py:? — fit
+convergence, score/predict, checkpointing, bucketing, input grads.
+"""
+import logging
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym as S
+
+logging.disable(logging.INFO)
+
+
+def _blobs(n=128, dim=6, seed=0):
+    rng = np.random.RandomState(seed)
+    x = np.concatenate([rng.randn(n, dim) + 1.2,
+                        rng.randn(n, dim) - 1.2]).astype(np.float32)
+    y = np.concatenate([np.zeros(n), np.ones(n)]).astype(np.float32)
+    perm = rng.permutation(2 * n)
+    return x[perm], y[perm]
+
+
+def _mlp_sym(hidden=8, classes=2):
+    data = S.Variable("data")
+    net = S.FullyConnected(data, num_hidden=hidden, name="fc1")
+    net = S.Activation(net, act_type="relu")
+    net = S.FullyConnected(net, num_hidden=classes, name="fc2")
+    return S.SoftmaxOutput(net, S.Variable("softmax_label"), name="softmax")
+
+
+def test_module_fit_and_score():
+    x, y = _blobs()
+    it = mx.io.NDArrayIter(x, y, batch_size=32, shuffle=True,
+                           label_name="softmax_label")
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.fit(it, num_epoch=3, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5},
+            initializer=mx.init.Xavier())
+    it.reset()
+    name, acc = mod.score(it, "acc")[0]
+    assert acc > 0.9, acc
+    pred = mod.predict(it)
+    assert pred.shape == (256, 2)
+
+
+def test_module_checkpoint(tmp_path):
+    x, y = _blobs(n=32)
+    it = mx.io.NDArrayIter(x, y, batch_size=16, label_name="softmax_label")
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.fit(it, num_epoch=1, initializer=mx.init.Xavier())
+    prefix = str(tmp_path / "mod")
+    mod.save_checkpoint(prefix, 1)
+    sym, args, aux = mx.serialization.load_checkpoint(prefix, 1)
+    m2 = mx.mod.Module(sym, context=mx.cpu())
+    m2.bind([("data", (16, 6))], [("softmax_label", (16,))],
+            for_training=False)
+    m2.set_params(args, aux)
+    batch = mx.io.DataBatch(data=[mx.nd.array(x[:16])])
+    mod.forward(batch, is_train=False)
+    m2.forward(batch, is_train=False)
+    np.testing.assert_allclose(m2.get_outputs()[0].asnumpy(),
+                               mod.get_outputs()[0].asnumpy(), rtol=1e-5)
+
+
+def test_module_batchnorm_aux_updates():
+    data = S.Variable("data")
+    net = S.Convolution(data, num_filter=4, kernel=(3, 3), name="conv")
+    net = S.BatchNorm(net, name="bn", momentum=0.5)
+    net = S.Pooling(net, global_pool=True, pool_type="avg")
+    net = S.Flatten(net)
+    net = S.SoftmaxOutput(net, S.Variable("softmax_label"), name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind([("data", (8, 3, 8, 8))], [("softmax_label", (8,))])
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer()
+    before = mod._exec.aux_dict["bn_moving_mean"].asnumpy().copy()
+    rng = np.random.RandomState(0)
+    batch = mx.io.DataBatch(
+        data=[mx.nd.array(rng.randn(8, 3, 8, 8).astype(np.float32) + 3.0)],
+        label=[mx.nd.array(rng.randint(0, 4, (8,)).astype(np.float32))])
+    mod.forward_backward(batch)
+    mod.update()
+    after = mod._exec.aux_dict["bn_moving_mean"].asnumpy()
+    assert not np.allclose(before, after), "moving stats must update"
+
+
+def test_module_input_grads():
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.bind([("data", (4, 6))], [("softmax_label", (4,))],
+             inputs_need_grad=True)
+    mod.init_params(mx.init.Xavier())
+    batch = mx.io.DataBatch(
+        data=[mx.nd.ones((4, 6))],
+        label=[mx.nd.array(np.array([0, 1, 0, 1], np.float32))])
+    mod.forward(batch, is_train=True)
+    mod.backward()
+    g = mod.get_input_grads()[0]
+    assert g.shape == (4, 6)
+    assert np.abs(g.asnumpy()).sum() > 0
+
+
+def test_bucketing_module():
+    # variable-length averaging task: same params across two buckets
+    def sym_gen(seq_len):
+        data = S.Variable("data")
+        net = S.mean(data, axis=1, keepdims=False)
+        net = S.FullyConnected(net, num_hidden=2, name="fc")
+        net = S.SoftmaxOutput(net, S.Variable("softmax_label"),
+                              name="softmax")
+        return net, ("data",), ("softmax_label",)
+
+    mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=8,
+                                 context=mx.cpu())
+    mod.bind([("data", (4, 8, 3))], [("softmax_label", (4,))])
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer()
+    rng = np.random.RandomState(0)
+
+    class _B:
+        def __init__(self, key, t):
+            self.bucket_key = key
+            self.data = [mx.nd.array(rng.randn(4, t, 3).astype(np.float32))]
+            self.label = [mx.nd.array(np.array([0, 1, 0, 1], np.float32))]
+            self.provide_data = [("data", (4, t, 3))]
+            self.provide_label = [("softmax_label", (4,))]
+
+    mod.forward(_B(8, 8), is_train=True)
+    mod.backward()
+    mod.update()
+    out8 = mod.get_outputs()[0]
+    assert out8.shape == (4, 2)
+    mod.forward(_B(4, 4), is_train=True)  # new bucket, shared params
+    mod.backward()
+    mod.update()
+    assert mod.get_outputs()[0].shape == (4, 2)
+    # params are shared by handle between buckets
+    m8 = mod._buckets[8]._exec.arg_dict["fc_weight"]
+    m4 = mod._buckets[4]._exec.arg_dict["fc_weight"]
+    assert m8 is m4
+
+
+def test_module_fixed_params():
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu(),
+                        fixed_param_names=["fc1_weight"])
+    mod.bind([("data", (4, 6))], [("softmax_label", (4,))])
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer()
+    w_before = mod._exec.arg_dict["fc1_weight"].asnumpy().copy()
+    batch = mx.io.DataBatch(
+        data=[mx.nd.ones((4, 6))],
+        label=[mx.nd.array(np.array([0, 1, 0, 1], np.float32))])
+    mod.forward_backward(batch)
+    mod.update()
+    np.testing.assert_array_equal(
+        w_before, mod._exec.arg_dict["fc1_weight"].asnumpy())

@@ -1,0 +1,178 @@
+"""Symbol API tests.
+
+Modeled on the reference's tests/python/unittest/test_symbol.py:? —
+composition, introspection, shape inference, json round-trip, bind and
+executor forward/backward.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym as S
+
+
+def _mlp():
+    data = S.Variable("data")
+    fc1 = S.FullyConnected(data, num_hidden=16, name="fc1")
+    act = S.Activation(fc1, act_type="relu", name="relu1")
+    fc2 = S.FullyConnected(act, num_hidden=4, name="fc2")
+    return S.SoftmaxOutput(fc2, S.Variable("softmax_label"), name="softmax")
+
+
+def test_compose_and_introspection():
+    out = _mlp()
+    assert out.list_arguments() == [
+        "data", "fc1_weight", "fc1_bias", "fc2_weight", "fc2_bias",
+        "softmax_label"]
+    assert out.list_outputs() == ["softmax_output"]
+    assert out.list_auxiliary_states() == []
+    assert out.name == "softmax"
+
+
+def test_infer_shape_mlp():
+    out = _mlp()
+    args, outs, aux = out.infer_shape(data=(8, 20), softmax_label=(8,))
+    assert args == [(8, 20), (16, 20), (16,), (4, 16), (4,), (8,)]
+    assert outs == [(8, 4)]
+    assert aux == []
+
+
+def test_infer_shape_conv_batchnorm():
+    data = S.Variable("data")
+    c = S.Convolution(data, num_filter=8, kernel=(3, 3), pad=(1, 1),
+                      name="conv0")
+    b = S.BatchNorm(c, name="bn0")
+    p = S.Pooling(b, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    args, outs, aux = p.infer_shape(data=(2, 3, 8, 8))
+    d = dict(zip(p.list_arguments(), args))
+    assert d["conv0_weight"] == (8, 3, 3, 3)
+    assert d["conv0_bias"] == (8,)
+    assert d["bn0_gamma"] == (8,)
+    assert dict(zip(p.list_auxiliary_states(), aux)) == {
+        "bn0_moving_mean": (8,), "bn0_moving_var": (8,)}
+    assert outs == [(2, 8, 4, 4)]
+    assert p.list_auxiliary_states() == ["bn0_moving_mean", "bn0_moving_var"]
+
+
+def test_infer_shape_partial():
+    data = S.Variable("data")
+    fc = S.FullyConnected(data, num_hidden=4)
+    args, outs, aux = fc.infer_shape_partial()
+    assert all(a is None for a in args)
+    with pytest.raises(mx.MXNetError):
+        fc.infer_shape()  # nothing known
+
+
+def test_variable_shape_attr():
+    data = S.Variable("data", shape=(4, 6))
+    fc = S.FullyConnected(data, num_hidden=3)
+    args, outs, _ = fc.infer_shape()
+    assert outs == [(4, 3)]
+
+
+def test_json_roundtrip(tmp_path):
+    out = _mlp()
+    js = out.tojson()
+    back = S.load_json(js)
+    assert back.list_arguments() == out.list_arguments()
+    assert back.list_outputs() == out.list_outputs()
+    f = tmp_path / "m-symbol.json"
+    out.save(str(f))
+    again = S.load(str(f))
+    a1, o1, _ = again.infer_shape(data=(2, 10), softmax_label=(2,))
+    a2, o2, _ = out.infer_shape(data=(2, 10), softmax_label=(2,))
+    assert a1 == a2 and o1 == o2
+
+
+def test_get_internals_and_lookup():
+    out = _mlp()
+    internals = out.get_internals()
+    names = internals.list_outputs()
+    assert "fc1_output" in names
+    fc1 = internals["fc1_output"]
+    args, outs, _ = fc1.infer_shape(data=(8, 20))
+    assert outs == [(8, 16)]
+
+
+def test_arithmetic_and_scalar_ops():
+    a = S.Variable("a")
+    b = S.Variable("b")
+    expr = (a * 2.0 + b) / 4.0 - 1.0
+    exe = expr.bind(args={"a": mx.nd.ones((3,)) * 2,
+                          "b": mx.nd.ones((3,)) * 4})
+    out = exe.forward()[0]
+    np.testing.assert_allclose(out.asnumpy(), np.full(3, 1.0), rtol=1e-6)
+
+
+def test_eval():
+    a = S.Variable("a")
+    out = (a + 1.0).eval(a=mx.nd.zeros((2, 2)))
+    np.testing.assert_allclose(out[0].asnumpy(), np.ones((2, 2)))
+
+
+def test_group_and_multi_output():
+    x = S.Variable("x")
+    parts = S.split(x, num_outputs=2, axis=1, name="sp")
+    assert parts.num_outputs == 2
+    g = S.Group([parts[0], parts[1]])
+    exe = g.bind(args={"x": mx.nd.array(np.arange(8).reshape(2, 4))})
+    o0, o1 = exe.forward()
+    assert o0.shape == (2, 2) and o1.shape == (2, 2)
+
+
+def test_simple_bind_forward_backward():
+    out = _mlp()
+    exe = out.simple_bind(grad_req="write", data=(8, 20),
+                          softmax_label=(8,))
+    rng = np.random.RandomState(0)
+    for name in ("fc1_weight", "fc2_weight"):
+        arr = exe.arg_dict[name]
+        arr._data = mx.nd.array(
+            rng.randn(*arr.shape).astype(np.float32) * 0.1)._data
+    x = rng.randn(8, 20).astype(np.float32)
+    y = rng.randint(0, 4, (8,)).astype(np.float32)
+    outs = exe.forward(is_train=True, data=x, softmax_label=y)
+    np.testing.assert_allclose(outs[0].asnumpy().sum(axis=1),
+                               np.ones(8), rtol=1e-5)
+    exe.backward()
+    # SoftmaxOutput gradient: softmax - onehot
+    p = outs[0].asnumpy()
+    oh = np.eye(4)[y.astype(int)]
+    # fc2 bias grad equals column sums of (p - onehot)
+    np.testing.assert_allclose(exe.grad_dict["fc2_bias"].asnumpy(),
+                               (p - oh).sum(axis=0), rtol=1e-4, atol=1e-5)
+
+
+def test_fluent_methods():
+    x = S.Variable("x")
+    y = x.reshape(shape=(2, 6)).sum(axis=1)
+    exe = y.bind(args={"x": mx.nd.ones((3, 4))})
+    np.testing.assert_allclose(exe.forward()[0].asnumpy(), [6.0, 6.0])
+
+
+def test_regression_outputs():
+    x = S.Variable("data")
+    lbl = S.Variable("label")
+    out = S.LinearRegressionOutput(S.FullyConnected(x, num_hidden=1,
+                                                    name="fc"), lbl)
+    exe = out.simple_bind(grad_req="write", data=(4, 3), label=(4, 1))
+    rng = np.random.RandomState(1)
+    exe.arg_dict["fc_weight"]._data = mx.nd.array(
+        rng.randn(1, 3).astype(np.float32))._data
+    xs = rng.randn(4, 3).astype(np.float32)
+    ys = rng.randn(4, 1).astype(np.float32)
+    outs = exe.forward(is_train=True, data=xs, label=ys)
+    exe.backward()
+    pred = outs[0].asnumpy()
+    expected = pred - ys  # grad wrt fc output
+    np.testing.assert_allclose(exe.grad_dict["fc_bias"].asnumpy(),
+                               expected.sum(axis=0), rtol=1e-4, atol=1e-5)
+
+
+def test_blockgrad_and_makeloss():
+    x = S.Variable("x")
+    blocked = S.BlockGrad(x * 3.0)
+    exe = blocked.simple_bind(grad_req="write", x=(2,))
+    exe.forward(is_train=True, x=np.ones(2, np.float32))
+    exe.backward()
+    np.testing.assert_allclose(exe.grad_dict["x"].asnumpy(), np.zeros(2))
