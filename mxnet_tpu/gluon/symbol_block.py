@@ -242,8 +242,13 @@ class _NNVMGraphRunner:
             if op_name in _DROP_LABEL_OPS and len(entries) > 1:
                 entries = entries[:1]
             args = [values[e[0]][e[1]] for e in entries]
-            fn = op_registry.get_op(op_name) or \
-                op_registry.get_op(_OP_RENAMES.get(op_name, ""))
+            # output/loss heads run their inference-mode rename (label was
+            # dropped above), never the training op from the registry
+            if op_name in _DROP_LABEL_OPS:
+                fn = op_registry.get_op(_OP_RENAMES[op_name])
+            else:
+                fn = op_registry.get_op(op_name) or \
+                    op_registry.get_op(_OP_RENAMES.get(op_name, ""))
             if fn is None:
                 raise MXNetError(
                     f"op {op_name!r} (node {name!r}) is not implemented in "
