@@ -246,6 +246,10 @@ class _NNVMGraphRunner:
             # dropped above), never the training op from the registry
             if op_name in _DROP_LABEL_OPS:
                 fn = op_registry.get_op(_OP_RENAMES[op_name])
+                if op_name == "SoftmaxOutput":
+                    # multi_output softmaxes the class axis 1 (reference
+                    # src/operator/softmax_output.cc:? enum), not the last
+                    attrs = {"axis": 1 if attrs.get("multi_output") else -1}
             else:
                 fn = op_registry.get_op(op_name) or \
                     op_registry.get_op(_OP_RENAMES.get(op_name, ""))

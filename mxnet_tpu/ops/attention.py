@@ -24,6 +24,30 @@ _this = sys.modules[__name__]
 _export = make_exporter(_this)
 
 
+def sdpa_raw(q, k, v, m=None, scale=None, causal=False):
+    """Raw-array fused attention: jax.nn's flash-style kernel path on TPU
+    with an explicit einsum/softmax fallback.  Shared by the NDArray op
+    below and the sequence-parallel bodies (parallel/ring.py)."""
+    if m is not None and m.dtype != jnp.bool_:
+        m = m.astype(jnp.bool_)
+    try:
+        return jax.nn.dot_product_attention(
+            q, k, v, mask=m, scale=scale, is_causal=causal)
+    except Exception:
+        d = q.shape[-1]
+        s = float(scale) if scale is not None else float(1.0 / np.sqrt(d))
+        logits = jnp.einsum("btnh,bsnh->bnts", q, k,
+                            preferred_element_type=np.float32) * s
+        if causal:
+            tq, tk = logits.shape[-2:]
+            cm = jnp.tril(jnp.ones((tq, tk), bool))
+            logits = jnp.where(cm, logits, -1e30)
+        if m is not None:
+            logits = jnp.where(m, logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+        return jnp.einsum("bnts,bsnh->btnh", probs, v)
+
+
 def dot_product_attention(query, key, value, mask=None, scale=None,
                           dropout=0.0, causal=False, **kwargs):
     """Fused scaled-dot-product attention.
@@ -36,24 +60,7 @@ def dot_product_attention(query, key, value, mask=None, scale=None,
     def f(*args):
         q, k, v = args[:3]
         m = args[3] if len(args) > 3 else None
-        if m is not None and m.dtype != jnp.bool_:
-            m = m.astype(jnp.bool_)
-        try:
-            return jax.nn.dot_product_attention(
-                q, k, v, mask=m, scale=scale, is_causal=causal)
-        except Exception:
-            d = q.shape[-1]
-            s = scale if scale is not None else 1.0 / np.sqrt(d)
-            logits = jnp.einsum("btnh,bsnh->bnts", q, k,
-                                preferred_element_type=np.float32) * s
-            if causal:
-                tq, tk = logits.shape[-2:]
-                cm = jnp.tril(jnp.ones((tq, tk), bool))
-                logits = jnp.where(cm, logits, -1e30)
-            if m is not None:
-                logits = jnp.where(m, logits, -1e30)
-            probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
-            return jnp.einsum("bnts,bsnh->btnh", probs, v)
+        return sdpa_raw(q, k, v, m, scale=scale, causal=causal)
 
     args = (query, key, value) + ((mask,) if mask is not None else ())
     return apply_op(f, *args, name="dot_product_attention")

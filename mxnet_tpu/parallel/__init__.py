@@ -45,7 +45,8 @@ from ..ndarray import NDArray
 
 __all__ = ["initialize", "make_mesh", "set_mesh", "current_mesh",
            "mesh_scope", "shard_batch", "replicate", "shard_param",
-           "with_sharding", "TPUSyncKVStore", "all_sum"]
+           "with_sharding", "TPUSyncKVStore", "all_sum",
+           "ring_attention", "ulysses_attention", "pipeline_apply"]
 
 
 _STATE = threading.local()
@@ -293,3 +294,7 @@ class TPUSyncKVStore:
 
     def load_optimizer_states(self, fname):
         self._local.load_optimizer_states(fname)
+
+
+from .ring import ring_attention, ulysses_attention  # noqa: E402
+from .pipeline import pipeline_apply  # noqa: E402

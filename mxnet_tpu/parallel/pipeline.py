@@ -1,0 +1,108 @@
+"""Pipeline parallelism: GPipe microbatch schedule over the ``pp`` axis.
+
+Reference status (SURVEY §2.3 D7): **ABSENT** — the closest MXNet gets is
+manual ``group2ctx`` device placement in the symbol API with no schedule.
+This is NEW capability, TPU-first: one stage per device along the ``pp``
+mesh axis, activations hop stage→stage over the ICI ring via
+``lax.ppermute``, and the whole schedule is a ``lax.scan`` inside
+``shard_map`` — a single compiled program, reverse-mode differentiable
+(backward runs the reverse schedule XLA derives from the scan transpose).
+
+Schedule: M microbatches, S stages → M + S - 1 ticks.  At tick t stage s
+works on microbatch t - s (idle ticks compute on garbage and mask the
+result — the usual trade for a static, jittable schedule).
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as np
+
+from ..base import MXNetError
+
+__all__ = ["pipeline_apply"]
+
+
+def _pipeline_sharded(params, xs, *, stage_fn, axis_name, n):
+    import jax
+    import jax.numpy as jnp
+
+    stage = jax.lax.axis_index(axis_name)
+    m = xs.shape[0]
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def tick(carry, t):
+        recv, outs = carry
+        mb = jnp.clip(t, 0, m - 1)
+        inp = jnp.where(stage == 0, xs[mb], recv)
+        act = stage_fn(params, inp)
+        nxt = jax.lax.ppermute(act, axis_name, perm)
+        oidx = jnp.clip(t - (n - 1), 0, m - 1)
+        valid = (stage == n - 1) & (t >= n - 1) & (t - (n - 1) < m)
+        prev = jax.lax.dynamic_index_in_dim(outs, oidx, 0, keepdims=False)
+        outs = jax.lax.dynamic_update_index_in_dim(
+            outs, jnp.where(valid, act, prev), oidx, 0)
+        return (nxt, outs), None
+
+    vary = partial(jax.lax.pcast, axis_name=(axis_name,), to="varying")
+    (_, outs), _ = jax.lax.scan(
+        tick, (vary(jnp.zeros_like(xs[0])), vary(jnp.zeros_like(xs))),
+        jnp.arange(m + n - 1))
+    # only the last stage holds real outputs; psum broadcasts them
+    return jax.lax.psum(
+        jnp.where(stage == n - 1, outs, jnp.zeros_like(outs)), axis_name)
+
+
+def pipeline_apply(stage_fn, stage_params, microbatches, mesh=None,
+                   axis_name="pp"):
+    """Run a GPipe pipeline: ``stage_fn(stage_local_params, x) -> y``.
+
+    ``stage_params``: pytree whose leaves are stacked along a leading
+    stage axis of size == mesh['pp'] (stage s's slice lives on device s);
+    ``microbatches``: (M, B, ...) NDArray/array of M microbatches.
+    Activations must keep the microbatch shape through every stage (pad
+    feature dims to a common width — same constraint as GPipe).
+    Returns (M, B, ...) outputs, replicated over the pp axis.
+    Differentiable end to end.
+    """
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from . import current_mesh
+    from ..ndarray import NDArray
+    from ..ops.registry import apply_op
+
+    mesh = mesh or current_mesh()
+    if mesh is None:
+        raise MXNetError("no active mesh; call parallel.set_mesh first")
+    if axis_name not in mesh.shape:
+        raise MXNetError(f"mesh has no '{axis_name}' axis: {mesh.shape}")
+    n = mesh.shape[axis_name]
+
+    treedef = jax.tree_util.tree_structure(stage_params)
+    leaves = jax.tree_util.tree_leaves(stage_params)
+    for lf in leaves:
+        if tuple(getattr(lf, "shape", ()))[:1] != (n,):
+            raise MXNetError(
+                f"stage_params leaves must be stacked to leading dim {n} "
+                f"(got {getattr(lf, 'shape', None)})")
+
+    def local_fn(p, x):
+        # inside shard_map each leaf has leading dim 1: drop it
+        return stage_fn(jax.tree_util.tree_map(lambda a: a[0], p), x)
+
+    def g(xs_raw, *praws):
+        ptree = jax.tree_util.tree_unflatten(treedef, list(praws))
+        return jax.shard_map(
+            partial(_pipeline_sharded, stage_fn=local_fn,
+                    axis_name=axis_name, n=n),
+            mesh=mesh,
+            in_specs=(jax.tree_util.tree_map(lambda a: P(axis_name), ptree),
+                      P()),
+            out_specs=P())(ptree, xs_raw)
+
+    xs_nd = (microbatches if isinstance(microbatches, NDArray)
+             else NDArray(np.asarray(microbatches)))
+    nd_leaves = [lf if isinstance(lf, NDArray) else NDArray(lf)
+                 for lf in leaves]
+    return apply_op(g, xs_nd, *nd_leaves, name="pipeline_apply")

@@ -1,0 +1,157 @@
+"""Sequence/context parallelism: ring attention + Ulysses all-to-all.
+
+Reference status (SURVEY §2.3 D8): **ABSENT** — MXNet predates long
+context; its only "sequence scaling" is bucketing
+(``module/bucketing_module.py:?``) and the contrib interleaved-attention
+matmuls (``src/operator/contrib/transformer.cc:?``).  This module is NEW
+capability, built TPU-first:
+
+  * **Ring attention**: Q/K/V are sharded over the ``sp`` mesh axis along
+    the sequence dim.  Each device keeps its Q chunk resident and the K/V
+    chunks rotate around the ICI ring via ``lax.ppermute`` while a
+    flash-style online softmax (running max / running normalizer) folds in
+    one K/V block per step.  Peak memory per device is O(T/n) and the
+    rotation overlaps with the block matmuls, so sequence length scales
+    linearly with the number of devices.
+  * **Ulysses attention**: ``lax.all_to_all`` swaps the sequence shard for
+    a head shard, computes full-sequence attention on N/n heads locally,
+    then swaps back.  Cheaper for moderate T when heads divide the axis.
+
+Both are ``lax.scan``/collective based (no python loops over devices), are
+reverse-mode differentiable, and run under ``shard_map`` on any mesh — the
+unit tests exercise them on the virtual 8-device CPU mesh.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as np
+
+from ..base import MXNetError
+
+__all__ = ["ring_attention", "ulysses_attention"]
+
+_NEG = -1.0e30   # mask value for disallowed logits
+_FLOOR = -1.0e9  # running-max floor: keeps exp(_NEG - m) == 0 exactly
+
+
+def _block_attn(q, k, v, m, l, acc, qpos, kpos, causal, scale):
+    """Fold one K/V block into the online-softmax state.
+
+    q: (B, Tq, N, H); k/v: (B, Tk, N, H); m/l: (B, N, Tq); acc: (B, N, Tq, H)
+    qpos/kpos: global position vectors for masking.
+    """
+    import jax.numpy as jnp
+
+    logits = jnp.einsum("btnh,bsnh->bnts", q, k,
+                        preferred_element_type=np.float32) * scale
+    if causal:
+        keep = qpos[:, None] >= kpos[None, :]          # (Tq, Tk)
+        logits = jnp.where(keep[None, None], logits, _NEG)
+    m_new = jnp.maximum(m, jnp.maximum(logits.max(axis=-1), _FLOOR))
+    corr = jnp.exp(m - m_new)
+    p = jnp.exp(logits - m_new[..., None])             # (B, N, Tq, Tk)
+    l_new = l * corr + p.sum(axis=-1)
+    acc_new = acc * corr[..., None] + jnp.einsum(
+        "bnts,bsnh->bnth", p, v.astype(np.float32))
+    return m_new, l_new, acc_new
+
+
+def _ring_sharded(q, k, v, *, axis_name, n, causal, scale):
+    """Per-shard body (inside shard_map): local Q stays, K/V rotate."""
+    import jax
+    import jax.numpy as jnp
+
+    b, tq, nh, hd = q.shape
+    idx = jax.lax.axis_index(axis_name)
+    scale = float(scale) if scale is not None else float(1.0 / np.sqrt(hd))
+    qpos = idx * tq + jnp.arange(tq)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    vary = partial(jax.lax.pcast, axis_name=(axis_name,), to="varying")
+    m0 = vary(jnp.full((b, nh, tq), _FLOOR, np.float32))
+    l0 = vary(jnp.zeros((b, nh, tq), np.float32))
+    a0 = vary(jnp.zeros((b, nh, tq, hd), np.float32))
+
+    def step(carry, r):
+        k_c, v_c, m, l, acc = carry
+        # after r rotations along the +1 ring, we hold chunk (idx - r) mod n
+        kidx = jnp.mod(idx - r, n)
+        kpos = kidx * k_c.shape[1] + jnp.arange(k_c.shape[1])
+        m, l, acc = _block_attn(q, k_c, v_c, m, l, acc, qpos, kpos,
+                                causal, scale)
+        k_n = jax.lax.ppermute(k_c, axis_name, perm)
+        v_n = jax.lax.ppermute(v_c, axis_name, perm)
+        return (k_n, v_n, m, l, acc), None
+
+    (k, v, m, l, acc), _ = jax.lax.scan(
+        step, (k, v, m0, l0, a0), jnp.arange(n))
+    l = jnp.where(l == 0.0, 1.0, l)
+    out = (acc / l[..., None]).astype(q.dtype)         # (B, N, Tq, H)
+    return jnp.transpose(out, (0, 2, 1, 3))            # (B, Tq, N, H)
+
+
+def _ulysses_sharded(q, k, v, *, axis_name, n, causal, scale):
+    """All-to-all: trade the seq shard for a head shard, attend, trade back."""
+    import jax
+
+    from ..ops.attention import sdpa_raw
+
+    a2a = partial(jax.lax.all_to_all, axis_name=axis_name, tiled=True)
+    # (B, T/n, N, H) -> (B, T, N/n, H)
+    q, k, v = (a2a(x, split_axis=2, concat_axis=1) for x in (q, k, v))
+    out = sdpa_raw(q, k, v, scale=scale, causal=causal)
+    # (B, T, N/n, H) -> (B, T/n, N, H)
+    return a2a(out, split_axis=1, concat_axis=2)
+
+
+def _sp_apply(body, query, key, value, causal, scale, mesh, axis_name):
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from . import current_mesh
+    from ..ops.registry import apply_op
+
+    mesh = mesh or current_mesh()
+    if mesh is None:
+        raise MXNetError("no active mesh; call parallel.set_mesh first")
+    if axis_name not in mesh.shape:
+        raise MXNetError(f"mesh has no '{axis_name}' axis: {mesh.shape}")
+    n = mesh.shape[axis_name]
+    spec = P(None, axis_name, None, None)
+
+    def f(q, k, v):
+        for name, a in (("query", q), ("key", k), ("value", v)):
+            if a.shape[1] % n:
+                raise MXNetError(
+                    f"{name} sequence length {a.shape[1]} not divisible "
+                    f"by {axis_name}={n}")
+        if body is _ulysses_sharded and q.shape[2] % n:
+            raise MXNetError(
+                f"ulysses_attention needs heads ({q.shape[2]}) divisible "
+                f"by {axis_name}={n}")
+        return jax.shard_map(
+            partial(body, axis_name=axis_name, n=n, causal=causal,
+                    scale=scale),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)(q, k, v)
+
+    return apply_op(f, query, key, value, name=body.__name__)
+
+
+def ring_attention(query, key, value, causal=False, scale=None, mesh=None,
+                   axis_name="sp"):
+    """Ring attention over the ``axis_name`` mesh axis.
+
+    query/key/value: (B, T, N, H) NDArrays with T sharded over the ring.
+    Differentiable; exact (not approximate) — matches dense attention.
+    """
+    return _sp_apply(_ring_sharded, query, key, value, causal, scale,
+                     mesh, axis_name)
+
+
+def ulysses_attention(query, key, value, causal=False, scale=None, mesh=None,
+                      axis_name="sp"):
+    """Ulysses (all-to-all head-sharded) attention; heads must divide the
+    ``axis_name`` mesh axis size."""
+    return _sp_apply(_ulysses_sharded, query, key, value, causal, scale,
+                     mesh, axis_name)
