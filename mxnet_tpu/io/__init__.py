@@ -12,6 +12,7 @@ prefetch replicates dmlc ThreadedIter's overlap of decode with compute.
 """
 from __future__ import annotations
 
+import os
 import threading
 import queue as _queue
 from collections import namedtuple
@@ -344,28 +345,63 @@ class ImageRecordIter(DataIter):
                          std=(std_r, std_g, std_b), scale=scale,
                          rand_crop=rand_crop, rand_mirror=rand_mirror,
                          resize=resize)
-        if path_imgidx:
-            rec = recordio.MXIndexedRecordIO(path_imgidx, path_imgrec, "r")
-            keys = rec.keys
+        from .. import _native
+
+        self._pf = None
+        self._records = None
+        if _native.available() and not kwargs.get("no_native"):
+            # native streaming path: C++ indexed reader + engine-scheduled
+            # batch prefetch (src/cpp/mxt_recordio.cc); records stay on
+            # disk, batches are read by worker threads ahead of consumption.
+            # One prefetcher lives for the iterator's lifetime (the index
+            # scan + thread pool happen once, not per epoch).
+            self._cap = max(int(prefetch_buffer), 1)
+            self._pf = _native.Prefetcher(path_imgrec,
+                                          nthreads=preprocess_threads,
+                                          capacity=self._cap)
+            self._sched = self._consumed = 0
+            self._batches = []
+            if path_imgidx and os.path.isfile(path_imgidx):
+                # honour the .idx: shard by KEY order (which may be a
+                # pre-shuffle or a subset), mapping byte offsets to the
+                # reader's scan-order indices
+                off2pos = {self._pf._reader.offset(i): i
+                           for i in range(len(self._pf))}
+                positions = []
+                with open(path_imgidx) as fin:
+                    for line in fin:
+                        parts = line.strip().split("\t")
+                        if len(parts) >= 2:
+                            positions.append(off2pos[int(parts[1])])
+                self._indices = np.asarray(
+                    positions[part_index::num_parts], dtype=np.int64)
+            else:
+                self._indices = np.arange(
+                    len(self._pf))[part_index::num_parts]
         else:
-            rec = recordio.MXRecordIO(path_imgrec, "r")
-            keys = None
-        # load offsets once; shard for distributed reads (num_parts)
-        self._records = []
-        if keys is not None:
-            use = keys[part_index::num_parts]
-            for k in use:
-                self._records.append(rec.read_idx(k))
-        else:
-            i = 0
-            while True:
-                payload = rec.read()
-                if payload is None:
-                    break
-                if i % num_parts == part_index:
-                    self._records.append(payload)
-                i += 1
-        rec.close()
+            # pure-python fallback: load the shard's records into memory
+            if path_imgidx:
+                rec = recordio.MXIndexedRecordIO(path_imgidx, path_imgrec,
+                                                 "r")
+                keys = rec.keys
+            else:
+                rec = recordio.MXRecordIO(path_imgrec, "r")
+                keys = None
+            self._records = []
+            if keys is not None:
+                use = keys[part_index::num_parts]
+                for k in use:
+                    self._records.append(rec.read_idx(k))
+            else:
+                i = 0
+                while True:
+                    payload = rec.read()
+                    if payload is None:
+                        break
+                    if i % num_parts == part_index:
+                        self._records.append(payload)
+                    i += 1
+            rec.close()
         self.shuffle = shuffle
         self.round_batch = round_batch
         self.reset()
@@ -380,29 +416,50 @@ class ImageRecordIter(DataIter):
             (self.batch_size, self.label_width)
         return [DataDesc("softmax_label", shape)]
 
-    def reset(self):
-        self._order = np.arange(len(self._records))
-        if self.shuffle:
-            self._rng.shuffle(self._order)
-        self._cursor = 0
+    def _plan_batches(self, order):
+        """Split an epoch order into (index_array, pad) batch plans;
+        wrap-around padding tiles the order (shards smaller than one batch
+        still fill it)."""
+        plans = []
+        n = len(order)
+        for s in range(0, n, self.batch_size):
+            idx = order[s:s + self.batch_size]
+            pad = self.batch_size - len(idx)
+            if pad:
+                if not self.round_batch:
+                    break
+                idx = np.concatenate([idx, np.resize(order, pad)])
+            plans.append((idx, pad))
+        return plans
 
-    def next(self):
+    def reset(self):
+        if self._pf is not None:
+            # drain batches scheduled but unconsumed (early reset)
+            while self._consumed < self._sched:
+                self._pf.next()
+                self._consumed += 1
+            order = self._indices.copy()
+            if self.shuffle:
+                self._rng.shuffle(order)
+            self._batches = self._plan_batches(order)
+            self._sched = self._consumed = 0
+            while self._sched < min(len(self._batches), self._cap + 1):
+                self._pf.schedule(self._batches[self._sched][0])
+                self._sched += 1
+        else:
+            order = np.arange(len(self._records))
+            if self.shuffle:
+                self._rng.shuffle(order)
+            self._batches = self._plan_batches(order)
+            self._consumed = 0
+
+    def _make_batch(self, payloads, pad):
         from .. import recordio
         from ..image import imdecode_raw, augment_basic
 
-        n = len(self._records)
-        if self._cursor >= n:
-            raise StopIteration
-        idx = self._order[self._cursor:self._cursor + self.batch_size]
-        pad = self.batch_size - len(idx)
-        if pad:
-            if not self.round_batch:
-                raise StopIteration
-            idx = np.concatenate([idx, self._order[:pad]])
-        self._cursor += self.batch_size
         datas, labels = [], []
-        for i in idx:
-            header, img_bytes = recordio.unpack(self._records[i])
+        for payload in payloads:
+            header, img_bytes = recordio.unpack(payload)
             img = imdecode_raw(img_bytes)
             img = augment_basic(img, self.data_shape, self._rng,
                                 **self._aug)
@@ -416,6 +473,20 @@ class ImageRecordIter(DataIter):
         return DataBatch(data=[data], label=[label], pad=pad,
                          provide_data=self.provide_data,
                          provide_label=self.provide_label)
+
+    def next(self):
+        if self._consumed >= len(self._batches):
+            raise StopIteration
+        idx, pad = self._batches[self._consumed]
+        self._consumed += 1
+        if self._pf is not None:
+            payloads = self._pf.next()
+            if self._sched < len(self._batches):
+                self._pf.schedule(self._batches[self._sched][0])
+                self._sched += 1
+        else:
+            payloads = [self._records[i] for i in idx]
+        return self._make_batch(payloads, pad)
 
 
 class MNISTIter(NDArrayIter):
