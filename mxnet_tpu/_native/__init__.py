@@ -176,6 +176,8 @@ class RecordReader:
 
     def read(self, i):
         size = self._so.MXTRecordReaderSize(self._h, i)
+        if size < 0:
+            raise IndexError(f"record index {i} out of range")
         buf = np.empty(size, dtype=np.uint8)
         rc = self._so.MXTRecordReaderRead(
             self._h, i, buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))

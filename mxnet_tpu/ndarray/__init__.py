@@ -21,6 +21,8 @@ from ..ops.nn_ops import *  # noqa: F401,F403
 from ..ops.rnn_ops import *  # noqa: F401,F403
 from ..ops.attention import *  # noqa: F401,F403
 from ..ops.output_ops import *  # noqa: F401,F403
+from ..ops.contrib import *  # noqa: F401,F403  (legacy top-level names)
+from . import contrib  # noqa: F401  (mx.nd.contrib namespace)
 from ..ops import registry as _registry
 
 # random sampling lives in mx.nd.random too (reference parity)

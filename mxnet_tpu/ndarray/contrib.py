@@ -1,0 +1,20 @@
+"""``mx.nd.contrib`` namespace.
+
+Reference: ``python/mxnet/ndarray/contrib.py:?`` — generated wrappers for
+``_contrib_*`` registered ops plus hand-written helpers (foreach,
+while_loop, cond live here too).  Ops are defined in
+``mxnet_tpu/ops/contrib.py``; this module re-exports them under the names
+reference scripts use (``mx.nd.contrib.box_nms`` etc.).
+"""
+from __future__ import annotations
+
+from ..ops.contrib import *  # noqa: F401,F403
+from ..ops.contrib import __all__ as _contrib_all
+from ..ops.tensor import boolean_mask  # noqa: F401
+from ..ops.attention import (  # noqa: F401
+    div_sqrt_dim, interleaved_matmul_selfatt_qk,
+    interleaved_matmul_selfatt_valatt)
+
+__all__ = list(_contrib_all) + [
+    "boolean_mask", "div_sqrt_dim", "interleaved_matmul_selfatt_qk",
+    "interleaved_matmul_selfatt_valatt"]

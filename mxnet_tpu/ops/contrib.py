@@ -1,0 +1,801 @@
+"""Contrib operators: detection boxes, ROI ops, proposals, misc.
+
+Reference: ``src/operator/contrib/`` — ``bounding_box.cc:?`` (box_nms,
+box_iou, bipartite_matching), ``multibox_prior.cc:?``,
+``multibox_target.cc:?``, ``multibox_detection.cc:?``, ``roi_align.cc:?``,
+``proposal.cc:?``, ``index_array.cc:?``, ``allclose_op.cc:?``,
+``quadratic_op.cc:?``, ``gradient_multiplier_op.cc:?``,
+``bilinear_resize.cc:?``, ``adaptive_avg_pooling.cc:?``; legacy
+``src/operator/roi_pooling.cc:?``; AMP casts ``src/operator/tensor/
+amp_cast.cc:?``.  (Paths per SURVEY §2.2 [med] — reference mount empty.)
+
+TPU-native redesign: every op here is a FIXED-SHAPE masked jnp/lax program
+(dynamic result counts become -1-padded slots), so the whole detection head
+traces under ``jit`` with static shapes and XLA can fuse it.  The reference
+instead uses dynamic-length CUDA kernels (thrust sort + variable compaction)
+— that style cannot compile for the MXU.  Sequential dependency in NMS /
+greedy matching is expressed with ``lax.fori_loop`` which XLA keeps
+on-device.
+"""
+from __future__ import annotations
+
+import sys
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import MXNetError, resolve_dtype
+from .registry import apply_op, make_exporter
+
+_this = sys.modules[__name__]
+_export = make_exporter(_this)
+
+
+# --- box geometry helpers ---------------------------------------------------
+
+def _to_corner(b, fmt):
+    """(..., 4) boxes → corner (x1, y1, x2, y2)."""
+    if fmt == "corner":
+        return b
+    cx, cy, w, h = jnp.split(b, 4, axis=-1)
+    return jnp.concatenate(
+        [cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], axis=-1)
+
+
+def _from_corner(b, fmt):
+    if fmt == "corner":
+        return b
+    x1, y1, x2, y2 = jnp.split(b, 4, axis=-1)
+    return jnp.concatenate(
+        [(x1 + x2) / 2, (y1 + y2) / 2, x2 - x1, y2 - y1], axis=-1)
+
+
+def _pair_iou(lhs, rhs):
+    """IoU matrix: lhs (M, 4) corner × rhs (N, 4) corner → (M, N)."""
+    lx1, ly1, lx2, ly2 = [lhs[:, i, None] for i in range(4)]
+    rx1, ry1, rx2, ry2 = [rhs[None, :, i] for i in range(4)]
+    iw = jnp.maximum(jnp.minimum(lx2, rx2) - jnp.maximum(lx1, rx1), 0.0)
+    ih = jnp.maximum(jnp.minimum(ly2, ry2) - jnp.maximum(ly1, ry1), 0.0)
+    inter = iw * ih
+    la = jnp.maximum(lx2 - lx1, 0.0) * jnp.maximum(ly2 - ly1, 0.0)
+    ra = jnp.maximum(rx2 - rx1, 0.0) * jnp.maximum(ry2 - ry1, 0.0)
+    union = la + ra - inter
+    return jnp.where(union > 0, inter / jnp.maximum(union, 1e-12), 0.0)
+
+
+def box_iou(lhs, rhs, format="corner", **kwargs):
+    """Reference ``_contrib_box_iou``: lhs (..., 4) × rhs (..., 4) →
+    IoU of every lhs box against every rhs box."""
+
+    def _f(l, r):
+        lsh, rsh = l.shape[:-1], r.shape[:-1]
+        out = _pair_iou(_to_corner(l.reshape(-1, 4), format),
+                        _to_corner(r.reshape(-1, 4), format))
+        return out.reshape(lsh + rsh)
+
+    return apply_op(_f, lhs, rhs, name="box_iou")
+
+
+_export(box_iou, aliases=("_contrib_box_iou",))
+
+
+def _nms_keep(boxes, scores, valid, cls_ids, overlap_thresh, force_suppress):
+    """Greedy NMS over pre-sorted (descending score) boxes. Returns keep
+    mask.  Sequential semantics via fori_loop: a box suppressed by an
+    earlier kept box cannot itself suppress."""
+    n = boxes.shape[0]
+    iou = _pair_iou(boxes, boxes)
+    later = jnp.arange(n)[None, :] > jnp.arange(n)[:, None]
+    same = (jnp.ones((n, n), bool) if force_suppress
+            else cls_ids[:, None] == cls_ids[None, :])
+    sup_mat = (iou > overlap_thresh) & later & same
+
+    def body(i, keep):
+        return keep & ~(sup_mat[i] & keep[i])
+
+    return lax.fori_loop(0, n, body, valid)
+
+
+def box_nms(data, overlap_thresh=0.5, valid_thresh=0, topk=-1,
+            coord_start=2, score_index=1, id_index=-1, background_id=-1,
+            force_suppress=False, in_format="corner", out_format="corner",
+            **kwargs):
+    """Reference ``_contrib_box_nms`` (``bounding_box.cc:?``): greedy NMS.
+
+    data (..., N, K): suppressed/invalid slots become all -1; survivors are
+    compacted to the front in descending-score order (reference contract).
+    """
+
+    def _one(d):
+        n = d.shape[0]
+        scores = d[:, score_index]
+        cls = (d[:, id_index] if id_index >= 0
+               else jnp.zeros((n,), d.dtype))
+        valid = scores > valid_thresh
+        if id_index >= 0 and background_id >= 0:
+            valid &= cls != background_id
+        order = jnp.argsort(jnp.where(valid, -scores, jnp.inf))
+        ds = d[order]
+        vs = valid[order]
+        if topk > 0:
+            vs &= jnp.arange(n) < topk
+        boxes = _to_corner(ds[:, coord_start:coord_start + 4], in_format)
+        keep = _nms_keep(boxes, ds[:, score_index], vs, cls[order],
+                         overlap_thresh, force_suppress or id_index < 0)
+        out = ds
+        if out_format != in_format:
+            conv = _from_corner(boxes, out_format)
+            out = out.at[:, coord_start:coord_start + 4].set(conv)
+        out = jnp.where(keep[:, None], out, -jnp.ones_like(out))
+        # compact survivors to the front (stable: preserves score order)
+        comp = jnp.argsort(~keep, stable=True)
+        return out[comp]
+
+    def _f(d):
+        flat = d.reshape((-1,) + d.shape[-2:])
+        return jax.vmap(_one)(flat).reshape(d.shape)
+
+    return apply_op(_f, data, name="box_nms")
+
+
+_export(box_nms, aliases=("_contrib_box_nms", "box_non_maximum_suppression"))
+
+
+def bipartite_matching(data, threshold=0.5, is_ascend=False, topk=-1,
+                       **kwargs):
+    """Reference ``_contrib_bipartite_matching``: greedy bipartite matching
+    on a (..., M, N) weight matrix.  Returns (row→col matches (..., M),
+    col→row matches (..., N)), -1 for unmatched."""
+
+    def _one(w):
+        m, n = w.shape
+        sign = 1.0 if is_ascend else -1.0
+        big = jnp.inf
+
+        def body(_, st):
+            wm, rmatch, cmatch = st
+            idx = jnp.argmin(sign * wm)
+            i, j = idx // n, idx % n
+            ok = ((wm[i, j] < threshold) if is_ascend
+                  else (wm[i, j] >= threshold))
+            rmatch = jnp.where(ok, rmatch.at[i].set(j), rmatch)
+            cmatch = jnp.where(ok, cmatch.at[j].set(i), cmatch)
+            wm = jnp.where(ok, wm.at[i, :].set(sign * big), wm)
+            wm = jnp.where(ok, wm.at[:, j].set(sign * big), wm)
+            return wm, rmatch, cmatch
+
+        k = min(m, n) if topk <= 0 else min(topk, m, n)
+        _, rmatch, cmatch = lax.fori_loop(
+            0, k, body,
+            (w, -jnp.ones((m,), jnp.float32), -jnp.ones((n,), jnp.float32)))
+        return rmatch, cmatch
+
+    def _f(w):
+        lead = w.shape[:-2]
+        flat = w.reshape((-1,) + w.shape[-2:])
+        r, c = jax.vmap(_one)(flat)
+        return r.reshape(lead + r.shape[-1:]), c.reshape(lead + c.shape[-1:])
+
+    return apply_op(_f, data, name="bipartite_matching")
+
+
+_export(bipartite_matching, aliases=("_contrib_bipartite_matching",))
+
+
+# --- MultiBox (SSD) family --------------------------------------------------
+
+def multibox_prior(data, sizes=(1.0,), ratios=(1.0,), clip=False,
+                   steps=(-1.0, -1.0), offsets=(0.5, 0.5), **kwargs):
+    """Reference ``_contrib_MultiBoxPrior`` (``multibox_prior.cc:?``):
+    anchor boxes for feature map data (B, C, H, W) → (1, H*W*A, 4)
+    normalized corner boxes, A = len(sizes) + len(ratios) - 1."""
+    sizes = [float(s) for s in np.atleast_1d(sizes)]
+    ratios = [float(r) for r in np.atleast_1d(ratios)]
+
+    def _f(d):
+        h, w = d.shape[2], d.shape[3]
+        step_y = steps[0] if steps[0] > 0 else 1.0 / h
+        step_x = steps[1] if steps[1] > 0 else 1.0 / w
+        cy = (jnp.arange(h, dtype=jnp.float32) + offsets[0]) * step_y
+        cx = (jnp.arange(w, dtype=jnp.float32) + offsets[1]) * step_x
+        # anchor shapes: (size_k, ratios[0]) for all k, then (sizes[0],
+        # ratio_j) for j >= 1 — reference enumeration order
+        ws, hs = [], []
+        for s in sizes:
+            r = np.sqrt(ratios[0])
+            ws.append(s * r * h / w / 2)
+            hs.append(s / r / 2)
+        for r in ratios[1:]:
+            rr = np.sqrt(r)
+            ws.append(sizes[0] * rr * h / w / 2)
+            hs.append(sizes[0] / rr / 2)
+        ws = jnp.asarray(ws, jnp.float32)
+        hs = jnp.asarray(hs, jnp.float32)
+        cyg, cxg = jnp.meshgrid(cy, cx, indexing="ij")  # (H, W)
+        cxg = cxg[..., None]
+        cyg = cyg[..., None]
+        out = jnp.stack([cxg - ws, cyg - hs, cxg + ws, cyg + hs],
+                        axis=-1)  # (H, W, A, 4)
+        out = out.reshape(1, -1, 4)
+        return jnp.clip(out, 0.0, 1.0) if clip else out
+
+    return apply_op(_f, data, name="multibox_prior")
+
+
+_export(multibox_prior,
+        aliases=("MultiBoxPrior", "_contrib_MultiBoxPrior"))
+
+
+def multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
+                    ignore_label=-1.0, negative_mining_ratio=-1.0,
+                    negative_mining_thresh=0.5, minimum_negative_samples=0,
+                    variances=(0.1, 0.1, 0.2, 0.2), **kwargs):
+    """Reference ``_contrib_MultiBoxTarget`` (``multibox_target.cc:?``):
+    anchor (1, N, 4), label (B, M, 5) [cls x1 y1 x2 y2, -1 padded],
+    cls_pred (B, num_cls+1, N) → (loc_target (B, N*4), loc_mask (B, N*4),
+    cls_target (B, N))."""
+    var = np.asarray(variances, np.float32)
+
+    def _one(anc, lab, cp):
+        n = anc.shape[0]
+        m = lab.shape[0]
+        gt_valid = lab[:, 0] >= 0
+        iou = _pair_iou(anc, lab[:, 1:5])  # (N, M)
+        iou = jnp.where(gt_valid[None, :], iou, -1.0)
+        # stage 1: each gt greedily claims its best anchor (bipartite)
+        def claim(j, st):
+            mat, best = st
+            idx = jnp.argmax(mat)
+            a = (idx // m).astype(jnp.int32)
+            g = (idx % m).astype(jnp.int32)
+            ok = mat[a, g] > 1e-12
+            best = jnp.where(ok, best.at[a].set(g), best)
+            mat = jnp.where(ok, mat.at[a, :].set(-1.0), mat)
+            mat = jnp.where(ok, mat.at[:, g].set(-1.0), mat)
+            return mat, best
+
+        _, matched = lax.fori_loop(
+            0, m, claim, (iou, -jnp.ones((n,), jnp.int32)))
+        # stage 2: remaining anchors match best gt if IoU >= threshold
+        best_gt = jnp.argmax(iou, axis=1).astype(jnp.int32)
+        best_iou = jnp.max(iou, axis=1)
+        matched = jnp.where(
+            (matched < 0) & (best_iou >= overlap_threshold), best_gt,
+            matched)
+        pos = matched >= 0
+        g = lab[jnp.maximum(matched, 0), 1:5]
+        # encode center-form offsets
+        ax, ay = (anc[:, 0] + anc[:, 2]) / 2, (anc[:, 1] + anc[:, 3]) / 2
+        aw = jnp.maximum(anc[:, 2] - anc[:, 0], 1e-12)
+        ah = jnp.maximum(anc[:, 3] - anc[:, 1], 1e-12)
+        gx, gy = (g[:, 0] + g[:, 2]) / 2, (g[:, 1] + g[:, 3]) / 2
+        gw = jnp.maximum(g[:, 2] - g[:, 0], 1e-12)
+        gh = jnp.maximum(g[:, 3] - g[:, 1], 1e-12)
+        loc = jnp.stack([(gx - ax) / aw / var[0], (gy - ay) / ah / var[1],
+                         jnp.log(gw / aw) / var[2],
+                         jnp.log(gh / ah) / var[3]], axis=-1)
+        loc = jnp.where(pos[:, None], loc, 0.0).reshape(-1)
+        mask = jnp.where(pos[:, None], 1.0,
+                         jnp.zeros((n, 4))).reshape(-1)
+        cls_t = jnp.where(pos, lab[jnp.maximum(matched, 0), 0] + 1.0, 0.0)
+        if negative_mining_ratio > 0:
+            # rank negatives by background-class confidence deficit
+            bg_prob = jax.nn.softmax(cp, axis=0)[0]  # (N,)
+            neg_score = jnp.where(pos | (best_iou >= negative_mining_thresh),
+                                  jnp.inf, bg_prob)
+            num_pos = jnp.sum(pos)
+            quota = jnp.maximum(num_pos * negative_mining_ratio,
+                                float(minimum_negative_samples))
+            rank = jnp.argsort(jnp.argsort(neg_score))
+            keep_neg = rank < quota
+            cls_t = jnp.where(pos, cls_t,
+                              jnp.where(keep_neg, 0.0, float(ignore_label)))
+        return loc, mask, cls_t
+
+    def _f(anc, lab, cp):
+        a = anc[0]
+        loc, mask, cls_t = jax.vmap(lambda l, c: _one(a, l, c))(lab, cp)
+        return loc, mask, cls_t
+
+    return apply_op(_f, anchor, label, cls_pred, name="multibox_target")
+
+
+_export(multibox_target,
+        aliases=("MultiBoxTarget", "_contrib_MultiBoxTarget"))
+
+
+def multibox_detection(cls_prob, loc_pred, anchor, clip=True, threshold=0.01,
+                       background_id=0, nms_threshold=0.5,
+                       force_suppress=False,
+                       variances=(0.1, 0.1, 0.2, 0.2), nms_topk=-1,
+                       **kwargs):
+    """Reference ``_contrib_MultiBoxDetection`` (``multibox_detection.cc:?``):
+    cls_prob (B, num_cls+1, N), loc_pred (B, N*4), anchor (1, N, 4) →
+    (B, N, 6) rows [cls_id, score, x1, y1, x2, y2], -1 for invalid."""
+    var = np.asarray(variances, np.float32)
+
+    def _one(cp, lp, anc):
+        n = anc.shape[0]
+        lp = lp.reshape(n, 4)
+        ax, ay = (anc[:, 0] + anc[:, 2]) / 2, (anc[:, 1] + anc[:, 3]) / 2
+        aw = anc[:, 2] - anc[:, 0]
+        ah = anc[:, 3] - anc[:, 1]
+        cx = lp[:, 0] * var[0] * aw + ax
+        cy = lp[:, 1] * var[1] * ah + ay
+        w = jnp.exp(lp[:, 2] * var[2]) * aw / 2
+        h = jnp.exp(lp[:, 3] * var[3]) * ah / 2
+        boxes = jnp.stack([cx - w, cy - h, cx + w, cy + h], axis=-1)
+        if clip:
+            boxes = jnp.clip(boxes, 0.0, 1.0)
+        # best foreground class per anchor (reference picks argmax != bg)
+        fg = jnp.concatenate([cp[:background_id], cp[background_id + 1:]],
+                             axis=0)
+        cls_id = jnp.argmax(fg, axis=0).astype(jnp.float32)
+        score = jnp.max(fg, axis=0)
+        keep = score > threshold
+        det = jnp.concatenate(
+            [jnp.where(keep, cls_id, -1.0)[:, None],
+             jnp.where(keep, score, -1.0)[:, None],
+             jnp.where(keep[:, None], boxes, -1.0)], axis=-1)
+        return det
+
+    def _f(cp, lp, anc):
+        det = jax.vmap(lambda c, l: _one(c, l, anc[0]))(cp, lp)
+        return det
+
+    dets = apply_op(_f, cls_prob, loc_pred, anchor,
+                    name="multibox_detection")
+    return box_nms(dets, overlap_thresh=nms_threshold, valid_thresh=0.0,
+                   topk=nms_topk, coord_start=2, score_index=1, id_index=0,
+                   force_suppress=force_suppress)
+
+
+_export(multibox_detection,
+        aliases=("MultiBoxDetection", "_contrib_MultiBoxDetection"))
+
+
+# --- ROI ops ----------------------------------------------------------------
+
+def _bilinear(img, ys, xs):
+    """img (C, H, W); ys/xs (P,) fractional coords → (C, P).  Out-of-range
+    samples contribute 0 (reference ROIAlign zero-padding contract)."""
+    h, w = img.shape[1], img.shape[2]
+    y0 = jnp.floor(ys)
+    x0 = jnp.floor(xs)
+    wy1 = ys - y0
+    wx1 = xs - x0
+    out = 0.0
+    for dy, wy in ((0, 1.0 - wy1), (1, wy1)):
+        for dx, wx in ((0, 1.0 - wx1), (1, wx1)):
+            yy = y0.astype(jnp.int32) + dy
+            xx = x0.astype(jnp.int32) + dx
+            inside = (yy >= 0) & (yy < h) & (xx >= 0) & (xx < w)
+            v = img[:, jnp.clip(yy, 0, h - 1), jnp.clip(xx, 0, w - 1)]
+            out = out + v * (wy * wx * inside)[None, :]
+    return out
+
+
+def roi_align(data, rois, pooled_size, spatial_scale=1.0, sample_ratio=-1,
+              position_sensitive=False, aligned=False, **kwargs):
+    """Reference ``_contrib_ROIAlign`` (``roi_align.cc:?``): data
+    (B, C, H, W), rois (R, 5) [batch_idx x1 y1 x2 y2] → (R, C, PH, PW).
+    Average of bilinear samples per bin (Mask-RCNN ROIAlign)."""
+    ph, pw = ((pooled_size, pooled_size) if isinstance(pooled_size, int)
+              else tuple(pooled_size))
+    sr = sample_ratio if sample_ratio > 0 else 2
+
+    def _one(feat_all, roi):
+        b = roi[0].astype(jnp.int32)
+        img = feat_all[b]  # (C, H, W)
+        off = 0.5 if aligned else 0.0
+        x1 = roi[1] * spatial_scale - off
+        y1 = roi[2] * spatial_scale - off
+        x2 = roi[3] * spatial_scale - off
+        y2 = roi[4] * spatial_scale - off
+        rw = jnp.maximum(x2 - x1, 1.0 if not aligned else 1e-6)
+        rh = jnp.maximum(y2 - y1, 1.0 if not aligned else 1e-6)
+        bh, bw = rh / ph, rw / pw
+        # sample grid: for bin (i,j), samples at y1 + (i + (k+.5)/sr)*bh
+        gy = y1 + (jnp.arange(ph)[:, None] + (jnp.arange(sr)[None, :] + 0.5)
+                   / sr).reshape(-1) * bh
+        gx = x1 + (jnp.arange(pw)[:, None] + (jnp.arange(sr)[None, :] + 0.5)
+                   / sr).reshape(-1) * bw
+        yy, xx = jnp.meshgrid(gy, gx, indexing="ij")
+        vals = _bilinear(img, yy.reshape(-1), xx.reshape(-1))
+        c = img.shape[0]
+        vals = vals.reshape(c, ph, sr, pw, sr).mean(axis=(2, 4))
+        return vals
+
+    def _f(d, r):
+        return jax.vmap(lambda roi: _one(d, roi))(r)
+
+    return apply_op(_f, data, rois, name="roi_align")
+
+
+_export(roi_align, aliases=("ROIAlign", "_contrib_ROIAlign"))
+
+
+def roi_pooling(data, rois, pooled_size, spatial_scale=1.0, **kwargs):
+    """Reference legacy ``ROIPooling`` (``src/operator/roi_pooling.cc:?``):
+    max-pool quantized ROI bins.  data (B, C, H, W), rois (R, 5) →
+    (R, C, PH, PW)."""
+    ph, pw = ((pooled_size, pooled_size) if isinstance(pooled_size, int)
+              else tuple(pooled_size))
+
+    def _one(feat_all, roi):
+        b = roi[0].astype(jnp.int32)
+        img = feat_all[b]
+        h, w = img.shape[1], img.shape[2]
+        x1 = jnp.round(roi[1] * spatial_scale)
+        y1 = jnp.round(roi[2] * spatial_scale)
+        x2 = jnp.round(roi[3] * spatial_scale)
+        y2 = jnp.round(roi[4] * spatial_scale)
+        rh = jnp.maximum(y2 - y1 + 1, 1.0)
+        rw = jnp.maximum(x2 - x1 + 1, 1.0)
+        # bin membership masks (static shapes: (PH, H), (PW, W))
+        ys = jnp.arange(h, dtype=jnp.float32)
+        xs = jnp.arange(w, dtype=jnp.float32)
+        i = jnp.arange(ph, dtype=jnp.float32)[:, None]
+        j = jnp.arange(pw, dtype=jnp.float32)[:, None]
+        hstart = jnp.floor(i * rh / ph) + y1
+        hend = jnp.ceil((i + 1) * rh / ph) + y1
+        wstart = jnp.floor(j * rw / pw) + x1
+        wend = jnp.ceil((j + 1) * rw / pw) + x1
+        my = (ys[None, :] >= hstart) & (ys[None, :] < hend)  # (PH, H)
+        mx = (xs[None, :] >= wstart) & (xs[None, :] < wend)  # (PW, W)
+        neg = jnp.finfo(img.dtype).min
+        t = jnp.where(my[None, :, :, None], img[:, None, :, :], neg)
+        t = t.max(axis=2)  # (C, PH, W)
+        t = jnp.where(mx[None, None, :, :], t[:, :, None, :], neg)
+        out = t.max(axis=3)  # (C, PH, PW)
+        return jnp.where(out == neg, 0.0, out)
+
+    def _f(d, r):
+        return jax.vmap(lambda roi: _one(d, roi))(r)
+
+    return apply_op(_f, data, rois, name="roi_pooling")
+
+
+_export(roi_pooling, aliases=("ROIPooling",))
+
+
+def proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
+             rpn_post_nms_top_n=300, threshold=0.7, rpn_min_size=16,
+             scales=(4, 8, 16, 32), ratios=(0.5, 1, 2), feature_stride=16,
+             output_score=False, iou_loss=False, **kwargs):
+    """Reference ``_contrib_Proposal`` (``proposal.cc:?``): RPN proposal
+    generation.  cls_prob (B, 2A, H, W), bbox_pred (B, 4A, H, W),
+    im_info (B, 3) [h, w, scale] → rois (B*post_n, 5) [batch_idx x1 y1 x2
+    y2] (+ scores (B*post_n, 1) when output_score)."""
+    scales = [float(s) for s in np.atleast_1d(scales)]
+    ratios = [float(r) for r in np.atleast_1d(ratios)]
+    a = len(scales) * len(ratios)
+    base = float(feature_stride)
+
+    # base anchors centered on (stride-1)/2 — standard RPN enumeration
+    banchors = []
+    cx = cy = (base - 1) / 2
+    for r in ratios:
+        size = base * base
+        ws = np.round(np.sqrt(size / r))
+        hs = np.round(ws * r)
+        for s in scales:
+            w, h = ws * s, hs * s
+            banchors.append([cx - (w - 1) / 2, cy - (h - 1) / 2,
+                             cx + (w - 1) / 2, cy + (h - 1) / 2])
+    banchors = jnp.asarray(banchors, jnp.float32)  # (A, 4)
+
+    def _one(cp, bp, info):
+        h, w = cp.shape[1], cp.shape[2]
+        shift_x = jnp.arange(w, dtype=jnp.float32) * base
+        shift_y = jnp.arange(h, dtype=jnp.float32) * base
+        sy, sx = jnp.meshgrid(shift_y, shift_x, indexing="ij")
+        shifts = jnp.stack([sx, sy, sx, sy], axis=-1)  # (H, W, 4)
+        anchors = (shifts[:, :, None, :] + banchors[None, None]
+                   ).reshape(-1, 4)  # (H*W*A, 4)
+        scores = cp[a:].transpose(1, 2, 0).reshape(-1)  # fg scores
+        deltas = bp.transpose(1, 2, 0).reshape(-1, 4)
+        ax = (anchors[:, 0] + anchors[:, 2]) / 2
+        ay = (anchors[:, 1] + anchors[:, 3]) / 2
+        aw = anchors[:, 2] - anchors[:, 0] + 1
+        ah = anchors[:, 3] - anchors[:, 1] + 1
+        cx_ = deltas[:, 0] * aw + ax
+        cy_ = deltas[:, 1] * ah + ay
+        pw_ = jnp.exp(jnp.clip(deltas[:, 2], -10, 10)) * aw
+        ph_ = jnp.exp(jnp.clip(deltas[:, 3], -10, 10)) * ah
+        x1 = jnp.clip(cx_ - (pw_ - 1) / 2, 0, info[1] - 1)
+        y1 = jnp.clip(cy_ - (ph_ - 1) / 2, 0, info[0] - 1)
+        x2 = jnp.clip(cx_ + (pw_ - 1) / 2, 0, info[1] - 1)
+        y2 = jnp.clip(cy_ + (ph_ - 1) / 2, 0, info[0] - 1)
+        msz = rpn_min_size * info[2]
+        valid = ((x2 - x1 + 1 >= msz) & (y2 - y1 + 1 >= msz))
+        n = scores.shape[0]
+        pre = min(rpn_pre_nms_top_n, n) if rpn_pre_nms_top_n > 0 else n
+        order = jnp.argsort(jnp.where(valid, -scores, jnp.inf))[:pre]
+        boxes = jnp.stack([x1, y1, x2, y2], axis=-1)[order]
+        sc = scores[order]
+        vs = valid[order]
+        keep = _nms_keep(boxes, sc, vs, jnp.zeros((pre,)), threshold, True)
+        comp = jnp.argsort(~keep, stable=True)[:rpn_post_nms_top_n]
+        out_boxes = jnp.where(keep[comp][:, None], boxes[comp], 0.0)
+        out_sc = jnp.where(keep[comp], sc[comp], 0.0)
+        return out_boxes, out_sc
+
+    def _f(cp, bp, info):
+        boxes, sc = jax.vmap(_one)(cp, bp, info)
+        b = cp.shape[0]
+        bidx = jnp.repeat(jnp.arange(b, dtype=jnp.float32),
+                          boxes.shape[1])[:, None]
+        rois = jnp.concatenate([bidx, boxes.reshape(-1, 4)], axis=-1)
+        if output_score:
+            return rois, sc.reshape(-1, 1)
+        return rois
+
+    return apply_op(_f, cls_prob, bbox_pred, im_info, name="proposal")
+
+
+_export(proposal, aliases=("Proposal", "_contrib_Proposal"))
+
+
+def box_decode(data, anchors, std0=1.0, std1=1.0, std2=1.0, std3=1.0,
+               clip=-1.0, format="center", **kwargs):
+    """Reference ``_contrib_box_decode``: decode (B, N, 4) deltas with
+    (1, N, 4) center-format anchors → corner boxes."""
+
+    def _f(d, anc):
+        if format == "corner":
+            anc = _from_corner(anc, "center")
+        ax, ay, aw, ah = [anc[..., i] for i in range(4)]
+        cx = d[..., 0] * std0 * aw + ax
+        cy = d[..., 1] * std1 * ah + ay
+        dw = d[..., 2] * std2
+        dh = d[..., 3] * std3
+        if clip > 0:
+            dw = jnp.minimum(dw, clip)
+            dh = jnp.minimum(dh, clip)
+        w = jnp.exp(dw) * aw / 2
+        h = jnp.exp(dh) * ah / 2
+        return jnp.stack([cx - w, cy - h, cx + w, cy + h], axis=-1)
+
+    return apply_op(_f, data, anchors, name="box_decode")
+
+
+_export(box_decode, aliases=("_contrib_box_decode",))
+
+
+def box_encode(samples, matches, anchors, refs, means=(0., 0., 0., 0.),
+               stds=(0.1, 0.1, 0.2, 0.2), **kwargs):
+    """Reference ``_contrib_box_encode``: encode matched gt boxes against
+    anchors → (targets (B, N, 4), masks (B, N, 4))."""
+    mn = np.asarray(means, np.float32)
+    sd = np.asarray(stds, np.float32)
+
+    def _f(s, m, anc, ref):
+        g = jnp.take_along_axis(
+            ref, jnp.maximum(m, 0)[..., None].astype(jnp.int32), axis=1)
+        ac = _from_corner(anc, "center")
+        gc = _from_corner(g, "center")
+        t = jnp.stack([
+            (gc[..., 0] - ac[..., 0]) / jnp.maximum(ac[..., 2], 1e-12),
+            (gc[..., 1] - ac[..., 1]) / jnp.maximum(ac[..., 3], 1e-12),
+            jnp.log(jnp.maximum(gc[..., 2], 1e-12)
+                    / jnp.maximum(ac[..., 2], 1e-12)),
+            jnp.log(jnp.maximum(gc[..., 3], 1e-12)
+                    / jnp.maximum(ac[..., 3], 1e-12))], axis=-1)
+        t = (t - mn) / sd
+        mask = ((s > 0.5) & (m >= 0))[..., None] * jnp.ones_like(t)
+        return jnp.where(mask > 0, t, 0.0), mask
+
+    return apply_op(_f, samples, matches, anchors, refs, name="box_encode")
+
+
+_export(box_encode, aliases=("_contrib_box_encode",))
+
+
+# --- resize / adaptive pooling ---------------------------------------------
+
+def bilinear_resize_2d(data, height=None, width=None, scale_height=None,
+                       scale_width=None, mode="size", **kwargs):
+    """Reference ``_contrib_BilinearResize2D`` (``bilinear_resize.cc:?``):
+    NCHW bilinear resize, align_corners=True semantics (reference uses the
+    PyTorch-1.x-era convention)."""
+
+    def _f(d):
+        h, w = d.shape[2], d.shape[3]
+        oh = int(height) if height else int(round(h * (scale_height or 1)))
+        ow = int(width) if width else int(round(w * (scale_width or 1)))
+        ys = (jnp.arange(oh, dtype=jnp.float32)
+              * ((h - 1) / max(oh - 1, 1)))
+        xs = (jnp.arange(ow, dtype=jnp.float32)
+              * ((w - 1) / max(ow - 1, 1)))
+        yy, xx = jnp.meshgrid(ys, xs, indexing="ij")
+
+        def per_img(img):  # (C, H, W)
+            return _bilinear(img, yy.reshape(-1),
+                             xx.reshape(-1)).reshape(-1, oh, ow)
+
+        return jax.vmap(per_img)(d)
+
+    return apply_op(_f, data, name="bilinear_resize_2d")
+
+
+_export(bilinear_resize_2d,
+        aliases=("BilinearResize2D", "_contrib_BilinearResize2D"))
+
+
+def adaptive_avg_pooling_2d(data, output_size=1, **kwargs):
+    """Reference ``_contrib_AdaptiveAvgPooling2D``: NCHW adaptive average
+    pool.  TPU-native: expressed as two small matmuls (averaging matrices)
+    so it rides the MXU instead of a gather loop."""
+    osz = ((output_size, output_size) if isinstance(output_size, int)
+           else tuple(output_size))
+
+    def _avg_mat(n_in, n_out):
+        m = np.zeros((n_out, n_in), np.float32)
+        for i in range(n_out):
+            s = int(np.floor(i * n_in / n_out))
+            e = int(np.ceil((i + 1) * n_in / n_out))
+            m[i, s:e] = 1.0 / (e - s)
+        return jnp.asarray(m)
+
+    def _f(d):
+        h, w = d.shape[2], d.shape[3]
+        ah = _avg_mat(h, osz[0])
+        aw = _avg_mat(w, osz[1])
+        return jnp.einsum("bchw,ph,qw->bcpq", d, ah, aw)
+
+    return apply_op(_f, data, name="adaptive_avg_pooling_2d")
+
+
+_export(adaptive_avg_pooling_2d,
+        aliases=("AdaptiveAvgPooling2D", "_contrib_AdaptiveAvgPooling2D"))
+
+
+# --- misc contrib ------------------------------------------------------------
+
+def quadratic(data, a=0.0, b=0.0, c=0.0, **kwargs):
+    """Reference tutorial op ``_contrib_quadratic`` (``quadratic_op.cc:?``):
+    a*x^2 + b*x + c."""
+    return apply_op(lambda x: a * x * x + b * x + c, data, name="quadratic")
+
+
+_export(quadratic, aliases=("_contrib_quadratic",))
+
+
+def index_array(data, axes=None, **kwargs):
+    """Reference ``_contrib_index_array`` (``index_array.cc:?``): for each
+    element its coordinate vector → shape data.shape + (len(axes),)."""
+
+    def _f(d):
+        nd = d.ndim
+        ax = list(range(nd)) if axes is None else [x % nd for x in axes]
+        grids = jnp.meshgrid(*[jnp.arange(s) for s in d.shape],
+                             indexing="ij")
+        return jnp.stack([grids[x] for x in ax], axis=-1).astype(jnp.int64)
+
+    return apply_op(_f, data, name="index_array")
+
+
+_export(index_array, aliases=("_contrib_index_array",))
+
+
+def allclose(a, b, rtol=1e-05, atol=1e-08, equal_nan=True, **kwargs):
+    """Reference ``_contrib_allclose`` (``allclose_op.cc:?``): scalar 1/0."""
+    return apply_op(
+        lambda x, y: jnp.allclose(x, y, rtol=rtol, atol=atol,
+                                  equal_nan=equal_nan).astype(jnp.float32),
+        a, b, name="allclose")
+
+
+_export(allclose, aliases=("_contrib_allclose",))
+
+
+def arange_like(data, start=0.0, step=1.0, repeat=1, ctx=None, axis=None,
+                **kwargs):
+    """Reference ``_contrib_arange_like``: arange shaped like data (or its
+    ``axis`` dim)."""
+
+    def _f(d):
+        n = d.size if axis is None else d.shape[axis]
+        # reference semantics: values repeat `repeat` times within the SAME
+        # total length ([0,0,1,1,...] for repeat=2)
+        out = start + step * jnp.arange(n // repeat, dtype=jnp.float32)
+        out = jnp.repeat(out, repeat) if repeat != 1 else out
+        return out.reshape(d.shape) if axis is None else out
+
+    return apply_op(_f, data, name="arange_like")
+
+
+_export(arange_like, aliases=("_contrib_arange_like",))
+
+
+def index_copy(old_tensor, index_vector, new_tensor, **kwargs):
+    """Reference ``_contrib_index_copy``: copy rows of new_tensor into
+    old_tensor at index_vector positions."""
+    return apply_op(
+        lambda o, i, n: o.at[i.astype(jnp.int32)].set(n),
+        old_tensor, index_vector, new_tensor, name="index_copy")
+
+
+_export(index_copy, aliases=("_contrib_index_copy",))
+
+
+def gradientmultiplier(data, scalar=1.0, **kwargs):
+    """Reference ``_contrib_gradientmultiplier``
+    (``gradient_multiplier_op.cc:?``): identity forward, grad × scalar."""
+
+    @jax.custom_vjp
+    def _f(x):
+        return x
+
+    def _fwd(x):
+        return x, None
+
+    def _bwd(_, g):
+        return (g * scalar,)
+
+    _f.defvjp(_fwd, _bwd)
+    return apply_op(_f, data, name="gradientmultiplier")
+
+
+_export(gradientmultiplier, aliases=("_contrib_gradientmultiplier",))
+
+
+def fft(data, compute_size=128, **kwargs):
+    """Reference ``_contrib_fft`` (``src/operator/contrib/fft.cc:?``, cuFFT
+    backed): real input (..., d) → interleaved re/im (..., 2d).  On TPU XLA
+    lowers jnp.fft directly."""
+
+    def _f(x):
+        out = jnp.fft.fft(x.astype(jnp.complex64), axis=-1)
+        return jnp.stack([out.real, out.imag],
+                         axis=-1).reshape(x.shape[:-1] + (-1,))
+
+    return apply_op(_f, data, name="fft")
+
+
+_export(fft, aliases=("_contrib_fft",))
+
+
+def ifft(data, compute_size=128, **kwargs):
+    """Reference ``_contrib_ifft``: interleaved re/im (..., 2d) → real
+    (..., d)."""
+
+    def _f(x):
+        z = x.reshape(x.shape[:-1] + (-1, 2))
+        out = jnp.fft.ifft(lax.complex(z[..., 0], z[..., 1]), axis=-1)
+        return out.real * out.shape[-1]  # reference scales by n (no 1/n)
+
+    return apply_op(_f, data, name="ifft")
+
+
+_export(ifft, aliases=("_contrib_ifft",))
+
+
+# --- AMP casts (reference src/operator/tensor/amp_cast.cc:?) ----------------
+
+def amp_cast(data, dtype="float16", **kwargs):
+    """Cast for AMP; identity for dtypes that must stay wide."""
+    dt = resolve_dtype(dtype)
+    return apply_op(lambda x: x.astype(dt), data, name="amp_cast")
+
+
+_export(amp_cast, aliases=("_amp_cast",))
+
+
+def amp_multicast(*data, num_outputs=None, cast_narrow=False, **kwargs):
+    """Cast all inputs to a common dtype (widest, or narrowest when
+    ``cast_narrow``)."""
+    dts = [np.dtype(d.dtype) for d in data]
+    pick = min(dts, key=lambda d: d.itemsize) if cast_narrow else \
+        max(dts, key=lambda d: d.itemsize)
+
+    def _f(*xs):
+        return tuple(x.astype(pick) for x in xs)
+
+    return apply_op(_f, *data, name="amp_multicast")
+
+
+_export(amp_multicast, aliases=("_amp_multicast",))

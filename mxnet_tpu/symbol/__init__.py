@@ -10,6 +10,7 @@ from __future__ import annotations
 from ..ops import registry as _registry
 from .symbol import (Symbol, Variable, var, Group, load, load_json,
                      zeros, ones, _sym_op)
+from . import contrib  # noqa: F401  (mx.sym.contrib namespace)
 
 __all__ = ["Symbol", "Variable", "var", "Group", "load", "load_json",
            "zeros", "ones"]

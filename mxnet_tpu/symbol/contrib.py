@@ -1,0 +1,18 @@
+"""``mx.sym.contrib`` namespace.
+
+Reference: ``python/mxnet/symbol/contrib.py:?``.  Symbol-level builders for
+every contrib op: same lazy-graph treatment as the main ``mx.sym``
+namespace (see ``symbol/__init__.py``).
+"""
+from __future__ import annotations
+
+from ..ndarray import contrib as _nd_contrib
+from ..ops import registry as _registry
+from .symbol import _sym_op
+
+__all__ = []
+for _name in _nd_contrib.__all__:
+    if _registry.get_op(_name) is not None:
+        globals()[_name] = _sym_op(_name)
+        __all__.append(_name)
+del _name

@@ -118,12 +118,21 @@ class RecordReader {
 
   int64_t Count() const { return int64_t(recs_.size()); }
 
-  int64_t Size(int64_t i) const { return recs_[size_t(i)].size; }
+  bool InRange(int64_t i) const {
+    return i >= 0 && size_t(i) < recs_.size();
+  }
 
-  int64_t Offset(int64_t i) const { return recs_[size_t(i)].offset; }
+  int64_t Size(int64_t i) const {
+    return InRange(i) ? recs_[size_t(i)].size : -1;
+  }
+
+  int64_t Offset(int64_t i) const {
+    return InRange(i) ? recs_[size_t(i)].offset : -1;
+  }
 
   // read record i into out (caller sizes it via Size); true on success
   bool Read(int64_t i, uint8_t *out) const {
+    if (!InRange(i)) return false;
     const Rec &rec = recs_[size_t(i)];
     int64_t off = rec.offset;
     uint8_t *dst = out;

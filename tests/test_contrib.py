@@ -1,0 +1,262 @@
+"""Contrib op tests (reference model: tests/python/unittest/test_contrib_*
+— numpy cross-checks for box/ROI/misc ops, SURVEY §4)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _iou_np(a, b):
+    iw = max(0.0, min(a[2], b[2]) - max(a[0], b[0]))
+    ih = max(0.0, min(a[3], b[3]) - max(a[1], b[1]))
+    inter = iw * ih
+    ua = (a[2] - a[0]) * (a[3] - a[1]) + (b[2] - b[0]) * (b[3] - b[1]) - inter
+    return inter / ua if ua > 0 else 0.0
+
+
+def test_box_iou():
+    lhs = nd.array([[0, 0, 2, 2], [1, 1, 3, 3]])
+    rhs = nd.array([[0, 0, 2, 2], [10, 10, 11, 11], [1, 1, 2, 2]])
+    out = nd.contrib.box_iou(lhs, rhs).asnumpy()
+    assert out.shape == (2, 3)
+    l, r = lhs.asnumpy(), rhs.asnumpy()
+    for i in range(2):
+        for j in range(3):
+            assert abs(out[i, j] - _iou_np(l[i], r[j])) < 1e-5
+
+
+def test_box_nms_basic():
+    # rows: [cls, score, x1, y1, x2, y2]
+    data = nd.array([[
+        [0, 0.9, 0, 0, 10, 10],
+        [0, 0.8, 1, 1, 11, 11],     # heavy overlap with row 0 → suppressed
+        [0, 0.7, 20, 20, 30, 30],   # far away → kept
+        [0, 0.05, 0, 0, 1, 1],      # below valid_thresh → dropped
+    ]])
+    out = nd.contrib.box_nms(data, overlap_thresh=0.5, valid_thresh=0.1,
+                             coord_start=2, score_index=1,
+                             id_index=0).asnumpy()[0]
+    # survivors compacted to front, descending score
+    assert out[0, 1] == pytest.approx(0.9)
+    assert out[1, 1] == pytest.approx(0.7)
+    assert np.all(out[2] == -1) and np.all(out[3] == -1)
+
+
+def test_box_nms_class_aware():
+    # same boxes, different classes: not suppressed unless force_suppress
+    data = nd.array([[
+        [0, 0.9, 0, 0, 10, 10],
+        [1, 0.8, 1, 1, 11, 11],
+    ]])
+    out = nd.contrib.box_nms(data, overlap_thresh=0.5, id_index=0,
+                             force_suppress=False).asnumpy()[0]
+    assert (out[:, 1] > 0).sum() == 2
+    out2 = nd.contrib.box_nms(data, overlap_thresh=0.5, id_index=0,
+                              force_suppress=True).asnumpy()[0]
+    assert (out2[:, 1] > 0).sum() == 1
+
+
+def test_bipartite_matching():
+    w = nd.array([[[0.9, 0.1], [0.8, 0.85], [0.1, 0.2]]])  # (1, 3, 2)
+    row, col = nd.contrib.bipartite_matching(w, threshold=0.5)
+    row, col = row.asnumpy()[0], col.asnumpy()[0]
+    # greedy: (0,0)=0.9 first, then (1,1)=0.85; row 2 unmatched
+    assert row.tolist() == [0, 1, -1]
+    assert col.tolist() == [0, 1]
+
+
+def test_multibox_prior():
+    x = nd.zeros((1, 3, 4, 4))
+    anchors = nd.contrib.MultiBoxPrior(x, sizes=[0.5, 0.25],
+                                       ratios=[1, 2]).asnumpy()
+    assert anchors.shape == (1, 4 * 4 * 3, 4)
+    a = anchors[0].reshape(4, 4, 3, 4)
+    # first anchor at cell (0,0): size .5, ratio 1 → centered at (.125,.125)
+    c = a[0, 0, 0]
+    assert np.allclose((c[0] + c[2]) / 2, 0.125, atol=1e-6)
+    assert np.allclose((c[1] + c[3]) / 2, 0.125, atol=1e-6)
+    assert np.allclose(c[3] - c[1], 0.5, atol=1e-6)  # height = size
+
+
+def test_multibox_target_and_detection():
+    anchors = nd.array([[[0.0, 0.0, 0.4, 0.4],
+                         [0.5, 0.5, 1.0, 1.0],
+                         [0.0, 0.6, 0.3, 0.9]]])  # (1, 3, 4)
+    # one gt box overlapping anchor 1
+    label = nd.array([[[1.0, 0.52, 0.52, 0.98, 0.98]]])  # (B=1, M=1, 5)
+    cls_pred = nd.zeros((1, 3, 3))  # (B, num_cls+1, N)
+    loc_t, loc_m, cls_t = nd.contrib.MultiBoxTarget(
+        anchors, label, cls_pred, overlap_threshold=0.5)
+    assert loc_t.shape == (1, 12) and loc_m.shape == (1, 12)
+    ct = cls_t.asnumpy()[0]
+    assert ct[1] == 2.0  # gt class 1 → target 2 (bg is 0)
+    assert ct[0] == 0.0 and ct[2] == 0.0
+    lm = loc_m.asnumpy()[0].reshape(3, 4)
+    assert np.all(lm[1] == 1.0) and np.all(lm[0] == 0.0)
+
+    # detection: perfect loc_pred of zeros decodes anchors themselves
+    cls_prob = nd.array([[[0.1, 0.8, 0.2], [0.1, 0.1, 0.7],
+                          [0.8, 0.1, 0.1]]])  # (B, 3 cls, N)
+    loc_pred = nd.zeros((1, 12))
+    out = nd.contrib.MultiBoxDetection(cls_prob, loc_pred, anchors,
+                                       nms_threshold=0.5).asnumpy()[0]
+    assert out.shape == (3, 6)
+    kept = out[out[:, 0] >= 0]
+    assert len(kept) >= 1
+
+
+def test_roi_align_shapes_and_values():
+    # ramp image: value = y → averaging across a roi gives its center y
+    h = w = 8
+    img = np.tile(np.arange(h, dtype=np.float32)[:, None], (1, w))
+    data = nd.array(img[None, None])  # (1, 1, 8, 8)
+    rois = nd.array([[0, 0, 0, 7, 7]])  # whole image
+    out = nd.contrib.ROIAlign(data, rois, pooled_size=(2, 2),
+                              spatial_scale=1.0, sample_ratio=2)
+    o = out.asnumpy()
+    assert o.shape == (1, 1, 2, 2)
+    # rows of the 2x2 pool: lower/upper half mean of the ramp
+    assert o[0, 0, 0, 0] < o[0, 0, 1, 0]
+    assert np.allclose(o[0, 0, 0, 0], o[0, 0, 0, 1], atol=1e-5)
+
+
+def test_roi_align_grad_flows():
+    from mxnet_tpu import autograd
+    data = nd.random.uniform(shape=(1, 2, 6, 6))
+    data.attach_grad()
+    rois = nd.array([[0, 1, 1, 4, 4]])
+    with autograd.record():
+        out = nd.contrib.ROIAlign(data, rois, pooled_size=(2, 2),
+                                  spatial_scale=1.0)
+        loss = out.sum()
+    loss.backward()
+    assert float(nd.abs(data.grad).sum().asscalar()) > 0
+
+
+def test_roi_pooling():
+    img = np.arange(16, dtype=np.float32).reshape(4, 4)
+    data = nd.array(img[None, None])
+    rois = nd.array([[0, 0, 0, 3, 3]])
+    out = nd.ROIPooling(data, rois, pooled_size=(2, 2), spatial_scale=1.0)
+    o = out.asnumpy()[0, 0]
+    # max of each quadrant
+    assert o.tolist() == [[5.0, 7.0], [13.0, 15.0]]
+
+
+def test_proposal():
+    b, a, h, w = 1, 6, 4, 4  # a = len(scales) * len(ratios)
+    rng = np.random.RandomState(0)
+    cls_prob = nd.array(rng.uniform(size=(b, 2 * a, h, w)))
+    bbox_pred = nd.array(rng.uniform(-0.1, 0.1, size=(b, 4 * a, h, w)))
+    im_info = nd.array([[64, 64, 1.0]])
+    rois = nd.contrib.Proposal(cls_prob, bbox_pred, im_info,
+                               rpn_pre_nms_top_n=12, rpn_post_nms_top_n=5,
+                               feature_stride=16, scales=(2, 4),
+                               ratios=(0.5, 1, 2))
+    o = rois.asnumpy()
+    assert o.shape == (5, 5)
+    assert np.all(o[:, 0] == 0)  # batch index
+    assert np.all(o[:, 1:] >= 0) and np.all(o[:, 1:] <= 63)
+
+
+def test_bilinear_resize_2d():
+    x = nd.array(np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+    out = nd.contrib.BilinearResize2D(x, height=7, width=7)
+    o = out.asnumpy()[0, 0]
+    assert o.shape == (7, 7)
+    # align_corners=True keeps the corners exact
+    assert o[0, 0] == pytest.approx(0.0)
+    assert o[6, 6] == pytest.approx(15.0)
+
+
+def test_adaptive_avg_pooling_2d():
+    x = nd.random.uniform(shape=(2, 3, 7, 5))
+    out = nd.contrib.AdaptiveAvgPooling2D(x, output_size=(2, 2))
+    assert out.shape == (2, 3, 2, 2)
+    one = nd.contrib.AdaptiveAvgPooling2D(x, output_size=1).asnumpy()
+    assert_almost_equal(one[..., 0, 0], x.asnumpy().mean(axis=(2, 3)),
+                        rtol=1e-5, atol=1e-6)
+
+
+def test_quadratic_and_grad():
+    from mxnet_tpu import autograd
+    x = nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = nd.contrib.quadratic(x, a=1.0, b=2.0, c=3.0)
+    y.backward()
+    assert_almost_equal(y, np.array([6.0, 11.0, 18.0]))
+    assert_almost_equal(x.grad, 2 * x.asnumpy() + 2)
+
+
+def test_index_array_allclose_arange_like():
+    x = nd.zeros((2, 3))
+    ia = nd.contrib.index_array(x).asnumpy()
+    assert ia.shape == (2, 3, 2)
+    assert ia[1, 2].tolist() == [1, 2]
+    a = nd.array([1.0, 2.0])
+    assert nd.contrib.allclose(a, a).asscalar() == 1.0
+    assert nd.contrib.allclose(a, a + 1).asscalar() == 0.0
+    al = nd.contrib.arange_like(nd.zeros((3, 4)), start=1, axis=1).asnumpy()
+    assert al.tolist() == [1, 2, 3, 4]
+
+
+def test_index_copy():
+    old = nd.zeros((4, 2))
+    new = nd.array([[1.0, 1], [2, 2]])
+    idx = nd.array([3, 0])
+    out = nd.contrib.index_copy(old, idx, new).asnumpy()
+    assert out[3].tolist() == [1, 1] and out[0].tolist() == [2, 2]
+    assert np.all(out[[1, 2]] == 0)
+
+
+def test_gradientmultiplier():
+    from mxnet_tpu import autograd
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = nd.contrib.gradientmultiplier(x, scalar=0.5).sum()
+    y.backward()
+    assert_almost_equal(x.grad, np.array([0.5, 0.5]))
+
+
+def test_fft_ifft_roundtrip():
+    x = nd.random.uniform(shape=(2, 8))
+    f = nd.contrib.fft(x)
+    assert f.shape == (2, 16)
+    back = nd.contrib.ifft(f) / 8
+    assert_almost_equal(back, x.asnumpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_amp_cast_multicast():
+    x = nd.array([1.0, 2.0])
+    y = nd.amp_cast(x, dtype="float16")
+    assert y.dtype == np.float16
+    a16 = nd.array([1.0], dtype="float16")
+    b32 = nd.array([2.0], dtype="float32")
+    oa, ob = nd.amp_multicast(a16, b32, num_outputs=2)
+    assert oa.dtype == np.float32 and ob.dtype == np.float32
+
+
+def test_contrib_symbol_path():
+    import mxnet_tpu.symbol as sym
+    x = sym.var("x")
+    y = sym.contrib.quadratic(x, a=1.0, b=0.0, c=1.0)
+    ex = y.bind(mx.cpu(), {"x": nd.array([2.0])})
+    out = ex.forward()[0].asnumpy()
+    assert out.tolist() == [5.0]
+
+
+def test_box_decode_encode():
+    anchors = nd.array([[[0.2, 0.2, 0.4, 0.4]]])  # corner (1, 1, 4)
+    zeros = nd.zeros((1, 1, 4))
+    out = nd.contrib.box_decode(zeros, anchors, format="corner").asnumpy()
+    assert np.allclose(out[0, 0], [0.2, 0.2, 0.4, 0.4], atol=1e-6)
+    samples = nd.ones((1, 1))
+    matches = nd.zeros((1, 1))
+    refs = nd.array([[[0.2, 0.2, 0.4, 0.4]]])
+    t, m = nd.contrib.box_encode(samples, matches, anchors, refs)
+    assert np.allclose(t.asnumpy(), 0.0, atol=1e-5)
+    assert np.all(m.asnumpy() == 1.0)
