@@ -1,4 +1,6 @@
 """gluon.model_zoo (reference:
-``python/mxnet/gluon/model_zoo/__init__.py:?``)."""
+``python/mxnet/gluon/model_zoo/__init__.py:?``; ``detection`` mirrors the
+GluonCV sibling-repo zoo)."""
 from . import vision
+from . import detection
 from .vision import get_model

@@ -1,0 +1,27 @@
+"""gluon.model_zoo.detection — GluonCV-parity detectors.
+
+Reference: GluonCV model zoo (sibling repo per SURVEY §2.6); the native
+ops these models drive are the reference's ``src/operator/contrib``
+detection kernels, rebuilt TPU-first in ``mxnet_tpu/ops/contrib.py``.
+"""
+from .ssd import *
+from .yolo import *
+from .faster_rcnn import *
+
+from ....base import MXNetError
+from . import ssd as _ssd, yolo as _yolo, faster_rcnn as _frcnn
+
+
+def get_model(name, **kwargs):
+    models = {
+        "ssd_300_resnet18_v1": ssd_300_resnet18_v1,
+        "ssd_512_resnet50_v1": ssd_512_resnet50_v1,
+        "yolo3_darknet53": yolo3_darknet53,
+        "darknet53": darknet53,
+        "faster_rcnn_resnet50_v1": faster_rcnn_resnet50_v1,
+    }
+    name = name.lower()
+    if name not in models:
+        raise MXNetError(
+            f"model {name!r} not found; available: {sorted(models)}")
+    return models[name](**kwargs)

@@ -521,6 +521,13 @@ def proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
         comp = jnp.argsort(~keep, stable=True)[:rpn_post_nms_top_n]
         out_boxes = jnp.where(keep[comp][:, None], boxes[comp], 0.0)
         out_sc = jnp.where(keep[comp], sc[comp], 0.0)
+        # fixed-shape contract: always exactly post_n rows per image
+        deficit = rpn_post_nms_top_n - out_boxes.shape[0]
+        if deficit > 0:
+            out_boxes = jnp.concatenate(
+                [out_boxes, jnp.zeros((deficit, 4), out_boxes.dtype)])
+            out_sc = jnp.concatenate(
+                [out_sc, jnp.zeros((deficit,), out_sc.dtype)])
         return out_boxes, out_sc
 
     def _f(cp, bp, info):
@@ -673,7 +680,8 @@ def index_array(data, axes=None, **kwargs):
         ax = list(range(nd)) if axes is None else [x % nd for x in axes]
         grids = jnp.meshgrid(*[jnp.arange(s) for s in d.shape],
                              indexing="ij")
-        return jnp.stack([grids[x] for x in ax], axis=-1).astype(jnp.int64)
+        # canonical index dtype (int32 in x32 mode; reference emits int64)
+        return jnp.stack([grids[x] for x in ax], axis=-1).astype(jnp.int_)
 
     return apply_op(_f, data, name="index_array")
 
@@ -699,10 +707,8 @@ def arange_like(data, start=0.0, step=1.0, repeat=1, ctx=None, axis=None,
 
     def _f(d):
         n = d.size if axis is None else d.shape[axis]
-        # reference semantics: values repeat `repeat` times within the SAME
-        # total length ([0,0,1,1,...] for repeat=2)
-        out = start + step * jnp.arange(n // repeat, dtype=jnp.float32)
-        out = jnp.repeat(out, repeat) if repeat != 1 else out
+        # reference kernel: out[i] = start + step * (i // repeat), any n
+        out = start + step * (jnp.arange(n, dtype=jnp.float32) // repeat)
         return out.reshape(d.shape) if axis is None else out
 
     return apply_op(_f, data, name="arange_like")

@@ -241,6 +241,32 @@ def deconvolution(data, weight, bias=None, kernel=None, stride=None,
 _export(deconvolution, aliases=("Deconvolution",))
 
 
+def upsampling(*data, scale=2, sample_type="nearest", num_args=1,
+               num_filter=0, **kwargs):
+    """Reference ``UpSampling`` (``src/operator/nn/upsampling.cc:?``):
+    NCHW nearest (repeat) or bilinear upscaling by integer ``scale``.
+
+    Bilinear mode in the reference takes a learnable deconv weight as a
+    second input (``num_args=2``); here XLA's resize plays that kernel's
+    role, so a provided weight operand is accepted and ignored."""
+    scale = int(scale)
+    x = data[0]
+
+    def _f(x):
+        if sample_type == "nearest":
+            return jnp.repeat(jnp.repeat(x, scale, axis=2), scale, axis=3)
+        import jax
+
+        b, c, h, w = x.shape
+        return jax.image.resize(x, (b, c, h * scale, w * scale),
+                                method="bilinear")
+
+    return apply_op(_f, x, name="upsampling")
+
+
+_export(upsampling, aliases=("UpSampling",))
+
+
 def pooling(data, kernel=None, pool_type="max", global_pool=False,
             stride=None, pad=None, pooling_convention="valid",
             count_include_pad=True, **kwargs):

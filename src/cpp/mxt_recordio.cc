@@ -234,7 +234,10 @@ class Prefetcher {
       Batch b;
       b.n = int64_t(idx.size());
       int64_t total = 0;
-      for (int64_t i : idx) total += reader_->Size(i);
+      for (int64_t i : idx) {
+        if (!reader_->InRange(i)) { b.ok = false; continue; }
+        total += reader_->Size(i);
+      }
       b.bytes = total;
       b.data = static_cast<uint8_t *>(BufferPool::Get().Alloc(
           size_t(total) ? size_t(total) : 1));
@@ -243,6 +246,7 @@ class Prefetcher {
       int64_t off = 0;
       for (int64_t j = 0; j < b.n; ++j) {
         b.offsets[j] = off;
+        if (!reader_->InRange(idx[size_t(j)])) { b.ok = false; continue; }
         if (!reader_->Read(idx[size_t(j)], b.data + off)) b.ok = false;
         off += reader_->Size(idx[size_t(j)]);
       }

@@ -1,0 +1,103 @@
+"""Detection model zoo tests (reference model: GluonCV model unit tests —
+forward shape checks in train + inference modes, hybridized and not).
+Small input sizes keep CPU-mesh compile times down."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+from mxnet_tpu.gluon.model_zoo import detection
+
+
+def _init(net):
+    net.initialize(mx.init.Xavier())
+    return net
+
+
+def test_ssd_train_and_infer_shapes():
+    net = _init(detection.ssd_300_resnet18_v1(classes=4))
+    x = nd.random.uniform(shape=(2, 3, 96, 96))
+    with autograd.record():
+        cls_p, box_p, anchors = net(x)
+    n = anchors.shape[1]
+    assert cls_p.shape == (2, n, 5)
+    assert box_p.shape == (2, n, 4)
+    assert anchors.shape == (1, n, 4)
+    ids, scores, bboxes = net(x)
+    assert ids.shape[0] == 2 and ids.shape[2] == 1
+    assert bboxes.shape[2] == 4
+
+
+def test_ssd_end_to_end_loss_step():
+    from mxnet_tpu import gluon
+    net = _init(detection.ssd_300_resnet18_v1(classes=2))
+    x = nd.random.uniform(shape=(1, 3, 96, 96))
+    label = nd.array([[[0.0, 0.2, 0.2, 0.7, 0.7]]])
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.01})
+    with autograd.record():
+        cls_p, box_p, anchors = net(x)
+        loc_t, loc_m, cls_t = nd.contrib.MultiBoxTarget(
+            anchors, label, nd.transpose(cls_p, axes=(0, 2, 1)))
+        cls_loss = nd.softmax_cross_entropy(
+            cls_p.reshape((-1, cls_p.shape[-1])), cls_t.reshape((-1,)))
+        loc_loss = (nd.abs(box_p.reshape((1, -1)) - loc_t) * loc_m).sum()
+        loss = cls_loss.sum() + loc_loss
+    loss.backward()
+    trainer.step(1)
+    g = list(net.collect_params().values())[0].grad()
+    assert np.isfinite(loss.asscalar())
+    assert np.all(np.isfinite(g.asnumpy()))
+
+
+def test_darknet53_classifier():
+    net = _init(detection.darknet53(classes=10))
+    out = net(nd.random.uniform(shape=(2, 3, 64, 64)))
+    assert out.shape == (2, 10)
+
+
+def test_yolo3_train_and_infer():
+    net = _init(detection.yolo3_darknet53(classes=3))
+    x = nd.random.uniform(shape=(1, 3, 64, 64))
+    with autograd.record():
+        preds, boxes, scores = net(x)
+    n = preds.shape[1]
+    assert preds.shape == (1, n, 8)  # 5 + 3 classes
+    assert boxes.shape == (1, n, 4)
+    assert scores.shape == (1, n, 3)
+    # anchors cover /8 /16 /32 scales: 64px → 8²+4²+2² cells × 3 anchors
+    assert n == (64 + 16 + 4) * 3
+    ids, sc, bb = net(x)
+    assert ids.shape[2] == 1 and bb.shape[2] == 4
+    # decoded inference boxes are pixel-space within a loose image bound
+    kept = sc.asnumpy() > 0
+    assert np.isfinite(bb.asnumpy()).all()
+
+
+def test_yolo3_hybridize_consistent():
+    net = _init(detection.yolo3_darknet53(classes=3))
+    x = nd.random.uniform(shape=(1, 3, 64, 64))
+    eager = net(x)
+    net.hybridize()
+    hybrid = net(x)
+    for e, h in zip(eager, hybrid):
+        np.testing.assert_allclose(e.asnumpy(), h.asnumpy(), rtol=1e-4,
+                                   atol=1e-5)
+
+
+def test_faster_rcnn_train_and_infer():
+    net = _init(detection.faster_rcnn_resnet50_v1(classes=3,
+                                                  rpn_post_nms=8))
+    x = nd.random.uniform(shape=(1, 3, 96, 96))
+    with autograd.record():
+        rois, cls_pred, box_pred, rpn_s, rpn_l = net(x)
+    assert rois.shape == (8, 5)
+    assert cls_pred.shape == (8, 4)  # 3 classes + bg
+    assert box_pred.shape == (8, 4)
+    ids, sc, bb = net(x)
+    assert ids.shape == (1, 8, 1)
+    assert bb.shape == (1, 8, 4)
+
+
+def test_detection_get_model():
+    net = detection.get_model("ssd_300_resnet18_v1", classes=2)
+    assert isinstance(net, detection.SSD)
