@@ -61,6 +61,9 @@ _LAZY = {
     "image": ".image",
     "parallel": ".parallel",
     "profiler": ".profiler",
+    "monitor": ".monitor",
+    "visualization": ".visualization",
+    "viz": ".visualization",
     "recordio": ".recordio",
     "serialization": ".serialization",
     "amp": ".amp",

@@ -1,0 +1,8 @@
+"""Top-level ``mx.contrib`` namespace.
+
+Reference: ``python/mxnet/contrib/__init__.py:?`` — amp, quantization,
+onnx, ndarray/symbol contrib re-exports (SURVEY §2.4).
+"""
+from .. import amp  # noqa: F401
+from ..ndarray import contrib as ndarray  # noqa: F401
+from ..symbol import contrib as symbol  # noqa: F401

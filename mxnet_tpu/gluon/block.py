@@ -42,6 +42,7 @@ from ..context import Context, current_context
 from ..ndarray import NDArray
 from .parameter import (Parameter, ParameterDict,
                         DeferredInitializationError)
+from .utils import HookHandle
 
 
 # ---------------------------------------------------------------------------
@@ -144,6 +145,8 @@ class Block:
         self._scope = _BlockScope(self)
         self._children = OrderedDict()
         self._reg_params = {}
+        self._forward_hooks = OrderedDict()
+        self._forward_pre_hooks = OrderedDict()
 
     def _alias(self):
         return self.__class__.__name__.lower()
@@ -303,8 +306,36 @@ class Block:
             child._clear_cached_op()
 
     # -- execution -----------------------------------------------------------
+    def register_forward_pre_hook(self, hook):
+        """Register ``hook(block, inputs)`` to run before ``forward``
+        (reference ``Block.register_forward_pre_hook``); returns a
+        ``HookHandle``."""
+        handle = HookHandle()
+        handle.attach(self._forward_pre_hooks, hook)
+        return handle
+
+    def register_forward_hook(self, hook):
+        """Register ``hook(block, inputs, outputs)`` to run after
+        ``forward`` (reference ``Block.register_forward_hook``)."""
+        handle = HookHandle()
+        handle.attach(self._forward_hooks, hook)
+        return handle
+
+    def apply(self, fn):
+        """Apply ``fn`` recursively to this block and all children
+        (reference ``Block.apply``)."""
+        for child in self._children.values():
+            child.apply(fn)
+        fn(self)
+        return self
+
     def __call__(self, *args):
-        return self.forward(*args)
+        for hook in self._forward_pre_hooks.values():
+            hook(self, args)
+        out = self.forward(*args)
+        for hook in self._forward_hooks.values():
+            hook(self, args, out)
+        return out
 
     def forward(self, *args):
         raise NotImplementedError(

@@ -9,7 +9,6 @@ from .yolo import *
 from .faster_rcnn import *
 
 from ....base import MXNetError
-from . import ssd as _ssd, yolo as _yolo, faster_rcnn as _frcnn
 
 
 def get_model(name, **kwargs):

@@ -128,6 +128,12 @@ class FasterRCNN(HybridBlock):
         cls_prob = F.slice_axis(prob, axis=-1, begin=1, end=None)
         cid = F.argmax(cls_prob, axis=-1, keepdims=True)
         score = F.max(cls_prob, axis=-1, keepdims=True)
+        # mask the RPN's zero-padded slots (degenerate zero-area rois)
+        rw = (F.slice_axis(roi_boxes, axis=-1, begin=2, end=3)
+              - F.slice_axis(roi_boxes, axis=-1, begin=0, end=1))
+        rh = (F.slice_axis(roi_boxes, axis=-1, begin=3, end=4)
+              - F.slice_axis(roi_boxes, axis=-1, begin=1, end=2))
+        score = score * ((rw > 0) * (rh > 0))
         dets = F.concat(cid, score, decoded, dim=-1)
         dets = F.contrib.box_nms(
             dets, overlap_thresh=self._nms, valid_thresh=0.001,

@@ -143,10 +143,11 @@ class SSD(HybridBlock):
 
 
 def get_ssd(base_name, size, classes=20, **kwargs):
-    """Build an SSD over a vision-zoo backbone (GluonCV ``get_ssd``)."""
-    num_layers = 4
-    # scale progression per the SSD paper (smin=.2 → smax=.9, 4 pyramids)
-    s = np.linspace(0.15, 0.9, num_layers + 1)
+    """Build an SSD over a vision-zoo backbone (GluonCV ``get_ssd``):
+    larger input sizes get a deeper pyramid with finer anchor scales."""
+    num_layers = 4 if size < 450 else 5
+    # scale progression per the SSD paper (smin → smax across the pyramid)
+    s = np.linspace(0.15 if size < 450 else 0.1, 0.9, num_layers + 1)
     sizes = [[s[i], float(np.sqrt(s[i] * s[i + 1]))]
              for i in range(num_layers)]
     ratios = [[1, 2, 0.5]] * num_layers

@@ -99,9 +99,28 @@ class YOLOOutputV3(HybridBlock):
         self._classes = num_classes
         self._anchors = np.asarray(anchors, np.float32).reshape(-1, 2)
         self._stride = stride
+        self._grid_cache = {}  # (h, w) -> (grid, anchors) NDArrays
         a = len(self._anchors)
         with self.name_scope():
             self.prediction = nn.Conv2D(a * (num_classes + 5), 1, 1, 0)
+
+    def _grids(self, h, w):
+        """Constant grid/anchor tensors, cached per feature size (the
+        analog of GluonCV's precomputed offsets)."""
+        key = (h, w)
+        if key not in self._grid_cache:
+            from ....ndarray import array as _nd_array
+
+            a = len(self._anchors)
+            gy, gx = np.meshgrid(np.arange(h, dtype=np.float32),
+                                 np.arange(w, dtype=np.float32),
+                                 indexing="ij")
+            grid = np.stack([gx, gy], axis=-1).reshape(-1, 1, 2)
+            grid = np.tile(grid, (1, a, 1)).reshape(1, -1, 2)
+            anc = np.tile(self._anchors[None],
+                          (h * w, 1, 1)).reshape(1, -1, 2)
+            self._grid_cache[key] = (_nd_array(grid), _nd_array(anc))
+        return self._grid_cache[key]
 
     def hybrid_forward(self, F, x):
         a = len(self._anchors)
@@ -110,15 +129,8 @@ class YOLOOutputV3(HybridBlock):
         h, w = pred.shape[2], pred.shape[3]
         pred = F.transpose(pred, axes=(0, 2, 3, 1))
         pred = F.reshape(pred, shape=(0, -1, c + 5))  # (B, H*W*A, 5+C)
-        # constant grid/anchor tensors baked at trace time
-        gy, gx = np.meshgrid(np.arange(h, dtype=np.float32),
-                             np.arange(w, dtype=np.float32), indexing="ij")
-        grid = np.stack([gx, gy], axis=-1).reshape(-1, 1, 2)
-        grid = np.tile(grid, (1, a, 1)).reshape(1, -1, 2)
-        anc = np.tile(self._anchors[None], (h * w, 1, 1)).reshape(1, -1, 2)
-        from ....ndarray import array as _nd_array
-        grid = _nd_array(grid)
-        anc = _nd_array(anc)
+        # constant grid/anchor tensors (cached; baked in at trace time)
+        grid, anc = self._grids(h, w)
         xy = (F.sigmoid(F.slice_axis(pred, axis=-1, begin=0, end=2))
               + grid) * self._stride
         wh = F.exp(F.slice_axis(pred, axis=-1, begin=2, end=4)) * anc

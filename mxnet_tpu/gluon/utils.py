@@ -104,3 +104,30 @@ def download(url, path=None, overwrite=False, sha1_hash=None,
 
 def shape_is_known(shape):
     return shape is not None and all(s > 0 for s in shape)
+
+
+class HookHandle:
+    """Removable handle for a registered hook (reference:
+    ``python/mxnet/gluon/utils.py:? HookHandle``)."""
+
+    _next_id = 0
+
+    def __init__(self):
+        self._hooks_dict = None
+        self._id = None
+
+    def attach(self, hooks_dict, hook):
+        self._id = HookHandle._next_id
+        HookHandle._next_id += 1
+        hooks_dict[self._id] = hook
+        self._hooks_dict = hooks_dict
+
+    def detach(self):
+        if self._hooks_dict is not None and self._id in self._hooks_dict:
+            del self._hooks_dict[self._id]
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.detach()

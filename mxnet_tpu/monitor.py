@@ -1,0 +1,107 @@
+"""Training monitor: per-layer output/parameter statistics.
+
+Reference: ``python/mxnet/monitor.py:?`` — ``Monitor(interval, stat_func,
+pattern, sort)`` installs an output callback on executors and prints
+name→stat rows every ``interval`` batches (SURVEY §5).
+
+TPU-native: works over Gluon blocks via the forward-hook mechanism
+(``Block.register_forward_hook``) instead of the C++ executor's monitor
+callback; the legacy ``Executor.set_monitor_callback`` path is also
+supported via ``install_executor``.
+"""
+from __future__ import annotations
+
+import re
+
+from .base import MXNetError
+
+
+def _default_stat(x):
+    from . import ndarray as nd
+
+    return nd.norm(x) / (x.size ** 0.5)
+
+
+class Monitor:
+    """Reference ``mx.monitor.Monitor``: ``tic()`` before forward,
+    ``toc()`` after — returns ``[(step, name, stat_str), ...]`` for
+    blocks/arrays whose name matches ``pattern``."""
+
+    def __init__(self, interval, stat_func=None, pattern=".*", sort=False):
+        self.interval = interval
+        self.stat_func = stat_func or _default_stat
+        self.re_pattern = re.compile(pattern)
+        self.sort = sort
+        self.step = 0
+        self.activated = False
+        self.queue = []
+        self._handles = []
+
+    # -- gluon path ----------------------------------------------------------
+    def install(self, block, monitor_all=False):
+        """Attach to every child block's forward output; with
+        ``monitor_all`` also record inputs (reference
+        ``monitor_all`` on executor attaches input arrays too)."""
+
+        def make_hook(name):
+            def hook(blk, inputs, outputs):
+                if not self.activated:
+                    return
+                outs = outputs if isinstance(outputs, (list, tuple)) \
+                    else (outputs,)
+                for i, o in enumerate(outs):
+                    suffix = f"_output{i}" if len(outs) > 1 else "_output"
+                    self._stat(name + suffix, o)
+                if monitor_all:
+                    for i, o in enumerate(inputs):
+                        self._stat(f"{name}_input{i}", o)
+            return hook
+
+        for name, child in block._children.items():
+            full = child.name or name
+            self._handles.append(
+                child.register_forward_hook(make_hook(full)))
+            self.install(child, monitor_all)
+        return self
+
+    def uninstall(self):
+        for h in self._handles:
+            h.detach()
+        self._handles = []
+
+    # -- legacy executor path ------------------------------------------------
+    def install_executor(self, executor):
+        executor.set_monitor_callback(self._stat)
+
+    def _stat(self, name, arr):
+        if not self.activated or not self.re_pattern.match(name):
+            return
+        self.queue.append((self.step, name, self.stat_func(arr)))
+
+    def tic(self):
+        if self.step % self.interval == 0:
+            self.queue = []
+            self.activated = True
+        self.step += 1
+
+    def toc(self):
+        if not self.activated:
+            return []
+        self.activated = False
+        # also record matching parameters/grads queued by stat hooks
+        res = []
+        for step, name, arr in self.queue:
+            try:
+                s = str(arr.asnumpy().ravel()[:1][0]) \
+                    if hasattr(arr, "asnumpy") else str(arr)
+            except Exception as e:  # stat on in-graph array mid-trace
+                s = f"<unreadable: {e}>"
+            res.append((step, name, s))
+        if self.sort:
+            res.sort(key=lambda t: t[1])
+        self.queue = []
+        return res
+
+    def toc_print(self):
+        for step, name, stat in self.toc():
+            print(f"Batch: {step:7d} {name:30s} {stat}")

@@ -57,6 +57,16 @@ def _in_graph(x) -> bool:
     return getattr(x, "_req_grad", False) or getattr(x, "_node", None) is not None
 
 
+def _profiler_mod():
+    """The profiler module iff it is loaded AND running (dispatch stays
+    hook-free otherwise — same contract as the reference engine checking
+    ``profiler_->IsProfiling()`` per opr)."""
+    import sys
+
+    prof = sys.modules.get("mxnet_tpu.profiler")
+    return prof if prof is not None and prof.is_running() else None
+
+
 def apply_op(fun: Callable, *nd_args, name: str = ""):
     """Apply pure raw-array function ``fun`` to NDArray operands.
 
@@ -74,10 +84,18 @@ def apply_op(fun: Callable, *nd_args, name: str = ""):
     if _amp.is_active():
         raws = _amp.maybe_cast_args(name, raws)
     recording = ag.is_recording() and any(_in_graph(a) for a in nd_args)
+    prof = _profiler_mod()
+    if prof is not None:
+        import time
+
+        t0 = time.perf_counter()
     if recording:
         outs, vjp = jax.vjp(fun, *raws)
     else:
         outs = fun(*raws)
+    if prof is not None:
+        prof.record_op_event(prof.current_scope_prefix() + (name or "op"),
+                             time.perf_counter() - t0)
     single = not isinstance(outs, (tuple, list))
     outs_t = (outs,) if single else tuple(outs)
     nd_outs = [NDArray(o) for o in outs_t]

@@ -1,0 +1,185 @@
+"""Profiler façade.
+
+Reference: ``src/profiler/profiler.{h,cc}:?`` + ``python/mxnet/profiler.py:?``
+— engine workers wrap each operation with profiler events when enabled;
+output is chrome://tracing JSON plus aggregate per-op tables
+(``mx.profiler.dumps()``); env autostart ``MXNET_PROFILER_AUTOSTART``
+(SURVEY §5).
+
+TPU-native redesign: two layers of instrumentation.
+(1) Host-side op-dispatch events recorded by ``ops.registry.apply_op`` via
+    the ``record_op_event`` hook here — the analog of engine opr events —
+    written as chrome://tracing JSON by ``dump()`` and aggregated by
+    ``dumps()``.  Dispatch wall-time is what the host controls; device-side
+    timing belongs to XLA, hence:
+(2) ``jax.profiler`` (TensorBoard/XPlane trace) started/stopped with the
+    profiler state when ``profile_device_trace`` is set — this is where
+    MXU/HBM utilisation actually shows up.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import defaultdict
+
+from .base import MXNetError
+
+_lock = threading.Lock()
+_config = {
+    "profile_all": False,
+    "profile_symbolic": True,
+    "profile_imperative": True,
+    "profile_memory": False,
+    "profile_api": False,
+    "profile_device_trace": False,
+    "filename": "profile.json",
+    "aggregate_stats": False,
+}
+_state = "stop"
+_events = []          # chrome trace events
+_agg = defaultdict(lambda: [0, 0.0, float("inf"), 0.0])  # n, tot, min, max
+_t0 = None
+_jax_trace_dir = None
+
+
+def set_config(**kwargs):
+    """Configure (reference ``profiler.set_config``): accepts the reference
+    kwargs (``profile_all``, ``profile_symbolic``, ``profile_imperative``,
+    ``profile_memory``, ``profile_api``, ``filename``,
+    ``aggregate_stats``) plus ``profile_device_trace`` for the XLA/
+    TensorBoard trace."""
+    unknown = set(kwargs) - set(_config)
+    if unknown:
+        raise MXNetError(f"unknown profiler config keys {sorted(unknown)}")
+    _config.update(kwargs)
+
+
+def set_state(state="stop"):
+    """'run' starts event collection; 'stop' ends it (reference
+    ``profiler.set_state``)."""
+    global _state, _t0, _jax_trace_dir
+    if state not in ("run", "stop"):
+        raise MXNetError("profiler state must be 'run' or 'stop'")
+    if state == "run" and _state != "run":
+        _t0 = time.perf_counter()
+        if _config["profile_device_trace"]:
+            import jax
+
+            _jax_trace_dir = os.path.splitext(_config["filename"])[0] \
+                + "_xla_trace"
+            jax.profiler.start_trace(_jax_trace_dir)
+    if state == "stop" and _state == "run":
+        if _jax_trace_dir is not None:
+            import jax
+
+            jax.profiler.stop_trace()
+            _jax_trace_dir = None
+    _state = state
+
+
+def is_running():
+    return _state == "run"
+
+
+def pause(profile_process="worker"):
+    set_state("stop")
+
+
+def resume(profile_process="worker"):
+    set_state("run")
+
+
+def record_op_event(name, dur_s, cat="operator"):
+    """Called from the op dispatch path (ops/registry.apply_op) — the
+    analog of engine workers wrapping opr execution with profiler events."""
+    if _state != "run":
+        return
+    with _lock:
+        ts = (time.perf_counter() - _t0) * 1e6
+        _events.append({
+            "name": name, "cat": cat, "ph": "X",
+            "ts": ts - dur_s * 1e6, "dur": dur_s * 1e6,
+            "pid": os.getpid(), "tid": threading.get_ident() % 100000,
+        })
+        a = _agg[name]
+        a[0] += 1
+        a[1] += dur_s * 1e3
+        a[2] = min(a[2], dur_s * 1e3)
+        a[3] = max(a[3], dur_s * 1e3)
+
+
+def dump(finished=True, profile_process="worker"):
+    """Write chrome://tracing JSON to ``filename`` (reference
+    ``profiler.dump``)."""
+    if finished:
+        set_state("stop")
+    with _lock:
+        payload = {"traceEvents": list(_events), "displayTimeUnit": "ms"}
+    with open(_config["filename"], "w") as f:
+        json.dump(payload, f)
+
+
+def dumps(reset=False, format="table"):
+    """Aggregate per-op stats as a text table (reference
+    ``profiler.dumps`` with ``aggregate_stats=True``)."""
+    with _lock:
+        rows = sorted(_agg.items(), key=lambda kv: -kv[1][1])
+        out = [f"{'Name':<40}{'Total Count':>12}{'Total(ms)':>12}"
+               f"{'Min(ms)':>10}{'Max(ms)':>10}{'Avg(ms)':>10}"]
+        for name, (n, tot, mn, mx) in rows:
+            out.append(f"{name[:39]:<40}{n:>12}{tot:>12.3f}{mn:>10.3f}"
+                       f"{mx:>10.3f}{tot / max(n, 1):>10.3f}")
+        if reset:
+            _agg.clear()  # aggregate stats only; dump() still sees events
+    return "\n".join(out)
+
+
+class Scope:
+    """Named profiling scope (reference ``profiler.Scope`` context
+    manager): ops dispatched inside are prefixed ``name:op``."""
+
+    _current = threading.local()
+
+    def __init__(self, name="<unk>:"):
+        self._name = name if name.endswith(":") else name + ":"
+        self._old = None
+
+    def __enter__(self):
+        self._old = getattr(Scope._current, "value", None)
+        Scope._current.value = self._name
+        return self
+
+    def __exit__(self, *exc):
+        Scope._current.value = self._old
+
+
+def current_scope_prefix():
+    return getattr(Scope._current, "value", None) or ""
+
+
+class Marker:
+    """Instant marker event (reference ``profiler.Marker``)."""
+
+    def __init__(self, name, scope="process"):
+        self._name = name
+        self._scope = scope
+
+    def mark(self, scope=None):
+        if _state != "run":
+            return
+        with _lock:
+            _events.append({
+                "name": self._name, "ph": "i",
+                "ts": (time.perf_counter() - _t0) * 1e6,
+                "s": {"process": "p", "thread": "t",
+                      "global": "g"}.get(scope or self._scope, "p"),
+                "pid": os.getpid(),
+                "tid": threading.get_ident() % 100000,
+            })
+
+
+if os.environ.get("MXNET_PROFILER_AUTOSTART", "0") == "1":
+    set_config(profile_all=True)
+    set_state("run")

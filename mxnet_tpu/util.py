@@ -1,0 +1,169 @@
+"""Utility flags & helpers.
+
+Reference: ``python/mxnet/util.py:?`` — the numpy-semantics switches
+(``set_np``/``is_np_array``/``is_np_shape`` and the ``use_np*``
+decorators, ≥1.6), ``getenv``/``setenv``, ``makedirs`` (SURVEY §2.4 misc
+row).  These flags gate the ``mx.np`` front end exactly as in the
+reference: classic mode keeps MXNet 1.x semantics (no zero-dim/zero-size
+arrays), np mode enables NumPy-compatible shapes and array types.
+"""
+from __future__ import annotations
+
+import functools
+import os
+import threading
+
+from .base import MXNetError
+
+_np_state = threading.local()
+
+
+def _flags():
+    if not hasattr(_np_state, "shape"):
+        _np_state.shape = False
+        _np_state.array = False
+        _np_state.default_dtype = False
+    return _np_state
+
+
+def set_np_shape(active):
+    """Enable zero-dim/zero-size shape semantics (reference
+    ``mx.util.set_np_shape``).  Returns the previous state."""
+    st = _flags()
+    prev = st.shape
+    st.shape = bool(active)
+    return prev
+
+
+def is_np_shape():
+    return _flags().shape
+
+
+def set_np_array(active):
+    st = _flags()
+    prev = st.array
+    st.array = bool(active)
+    return prev
+
+
+def is_np_array():
+    return _flags().array
+
+
+def set_np(shape=True, array=True, dtype=False):
+    """Activate NumPy semantics for shapes + arrays (reference
+    ``mx.util.set_np`` / ``mx.npx.set_np``)."""
+    if array and not shape:
+        raise MXNetError("np array semantics require np shape semantics")
+    set_np_shape(shape)
+    set_np_array(array)
+    _flags().default_dtype = bool(dtype)
+
+
+def reset_np():
+    """Back to classic MXNet semantics (reference ``mx.util.reset_np``)."""
+    set_np(shape=False, array=False, dtype=False)
+
+
+def set_np_default_dtype(is_np_default_dtype=True):
+    st = _flags()
+    prev = st.default_dtype
+    st.default_dtype = bool(is_np_default_dtype)
+    return prev
+
+
+def is_np_default_dtype():
+    return _flags().default_dtype
+
+
+class np_shape:
+    """Context manager/decorator scoping np-shape semantics (reference
+    ``mx.util.np_shape``)."""
+
+    def __init__(self, active=True):
+        self._active = active
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = set_np_shape(self._active)
+        return self
+
+    def __exit__(self, *exc):
+        set_np_shape(self._prev)
+
+    def __call__(self, func):
+        @functools.wraps(func)
+        def wrapped(*args, **kwargs):
+            with np_shape(self._active):
+                return func(*args, **kwargs)
+        return wrapped
+
+
+class np_array:
+    """Context manager/decorator scoping np-array semantics (reference
+    ``mx.util.np_array``)."""
+
+    def __init__(self, active=True):
+        self._active = active
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = set_np_array(self._active)
+        return self
+
+    def __exit__(self, *exc):
+        set_np_array(self._prev)
+
+    def __call__(self, func):
+        @functools.wraps(func)
+        def wrapped(*args, **kwargs):
+            with np_array(self._active):
+                return func(*args, **kwargs)
+        return wrapped
+
+
+def use_np_shape(func):
+    return np_shape(True)(func)
+
+
+def use_np_array(func):
+    return np_array(True)(func)
+
+
+def use_np(func):
+    """Decorator activating full np semantics inside ``func`` (reference
+    ``mx.util.use_np``)."""
+    return use_np_shape(use_np_array(func))
+
+
+def getenv(name):
+    """Reference ``mx.util.getenv`` (dmlc GetEnv surface)."""
+    return os.environ.get(name)
+
+
+def setenv(name, value):
+    os.environ[name] = str(value)
+
+
+def makedirs(d):
+    os.makedirs(os.path.expanduser(d), exist_ok=True)
+
+
+def get_gpu_count():
+    return 0
+
+
+def get_gpu_memory(dev_id=0):
+    raise MXNetError("no CUDA GPUs in a TPU build")
+
+
+def default_array(source_array, ctx=None, dtype=None):
+    """Create an ndarray in the currently-active semantics (np or classic;
+    reference ``mx.util.default_array``)."""
+    if is_np_array():
+        from . import numpy as _mx_np
+
+        return _mx_np.array(source_array, ctx=ctx, dtype=dtype)
+    from . import ndarray as nd
+
+    return nd.array(source_array, ctx=ctx, dtype=dtype)

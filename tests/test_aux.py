@@ -1,0 +1,152 @@
+"""Aux-subsystem tests: profiler, monitor, visualization, runtime features,
+util flags (reference model: tests/python/unittest/test_profiler.py and
+the misc util tests, SURVEY §4/§5)."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_profiler_events_and_dump(tmp_path):
+    from mxnet_tpu import profiler
+
+    fname = str(tmp_path / "profile.json")
+    profiler.set_config(profile_all=True, filename=fname,
+                        aggregate_stats=True)
+    profiler.set_state("run")
+    a = nd.random.uniform(shape=(8, 8))
+    b = nd.dot(a, a)
+    with profiler.Scope("myscope"):
+        c = nd.relu(b)
+    c.wait_to_read()
+    profiler.set_state("stop")
+    profiler.dump()
+    with open(fname) as f:
+        trace = json.load(f)
+    names = [e["name"] for e in trace["traceEvents"]]
+    assert any("dot" in n for n in names)
+    assert any(n.startswith("myscope:") for n in names)
+    table = profiler.dumps(reset=True)
+    assert "Total Count" in table and "dot" in table
+
+
+def test_profiler_marker():
+    from mxnet_tpu import profiler
+
+    profiler.set_state("run")
+    profiler.Marker("hello").mark()
+    profiler.set_state("stop")
+
+
+def test_profiler_rejects_bad_config():
+    from mxnet_tpu import profiler
+
+    with pytest.raises(mx.MXNetError):
+        profiler.set_config(bogus_key=1)
+
+
+def test_monitor_gluon():
+    from mxnet_tpu import monitor
+    from mxnet_tpu.gluon import nn
+
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4, in_units=3), nn.Dense(2, in_units=4))
+    net.initialize()
+    mon = monitor.Monitor(interval=1, pattern=".*dense.*")
+    mon.install(net)
+    mon.tic()
+    net(nd.ones((2, 3)))
+    rows = mon.toc()
+    assert len(rows) >= 2
+    assert all(r[0] == 1 for r in rows)  # step is 1-based after tic()
+    mon.uninstall()
+    mon.tic()
+    net(nd.ones((2, 3)))
+    assert mon.toc() == []
+
+
+def test_forward_hooks():
+    from mxnet_tpu.gluon import nn
+
+    layer = nn.Dense(2, in_units=3)
+    layer.initialize()
+    calls = []
+    h1 = layer.register_forward_pre_hook(
+        lambda blk, inp: calls.append("pre"))
+    h2 = layer.register_forward_hook(
+        lambda blk, inp, out: calls.append("post"))
+    layer(nd.ones((1, 3)))
+    assert calls == ["pre", "post"]
+    h1.detach()
+    h2.detach()
+    layer(nd.ones((1, 3)))
+    assert calls == ["pre", "post"]
+
+
+def test_block_apply():
+    from mxnet_tpu.gluon import nn
+
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4, in_units=3), nn.Dense(2, in_units=4))
+    seen = []
+    net.apply(lambda b: seen.append(type(b).__name__))
+    assert seen.count("Dense") == 2
+
+
+def test_visualization_print_summary(capsys):
+    import mxnet_tpu.symbol as sym
+
+    data = sym.var("data")
+    net = sym.FullyConnected(data, num_hidden=8, name="fc1")
+    net = sym.Activation(net, act_type="relu", name="relu1")
+    net = sym.FullyConnected(net, num_hidden=2, name="fc2")
+    total = mx.visualization.print_summary(net, shape={"data": (1, 4)})
+    out = capsys.readouterr().out
+    assert "fc1" in out and "fc2" in out
+    # fc1: 4*8+8, fc2: 8*2+2
+    assert total == (4 * 8 + 8) + (8 * 2 + 2)
+
+
+def test_visualization_plot_network():
+    import mxnet_tpu.symbol as sym
+
+    data = sym.var("data")
+    net = sym.FullyConnected(data, num_hidden=8, name="fc1")
+    dot = mx.viz.plot_network(net, title="net")
+    assert dot.startswith("digraph")
+    assert '"fc1"' in dot and '"data" -> "fc1"' in dot
+    assert "fc1_weight" not in dot  # hidden weights
+    dot2 = mx.viz.plot_network(net, hide_weights=False)
+    assert "fc1_weight" in dot2
+
+
+def test_runtime_features():
+    feats = mx.runtime.Features()
+    assert feats.is_enabled("XLA")
+    assert not feats.is_enabled("CUDA")
+    assert isinstance(mx.runtime.feature_list(), list)
+    with pytest.raises(RuntimeError):
+        feats.is_enabled("NOT_A_FEATURE")
+
+
+def test_util_np_flags():
+    assert not mx.util.is_np_shape()
+    prev = mx.util.set_np_shape(True)
+    assert prev is False and mx.util.is_np_shape()
+    mx.util.reset_np()
+    assert not mx.util.is_np_shape() and not mx.util.is_np_array()
+
+    @mx.util.use_np
+    def inner():
+        return mx.util.is_np_shape(), mx.util.is_np_array()
+
+    assert inner() == (True, True)
+    assert not mx.util.is_np_shape()
+
+    with mx.util.np_shape(True):
+        assert mx.util.is_np_shape()
+    assert not mx.util.is_np_shape()
