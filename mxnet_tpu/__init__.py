@@ -69,6 +69,10 @@ _LAZY = {
     "amp": ".amp",
     "contrib": ".contrib",
     "test_utils": ".test_utils",
+    "numpy": ".numpy",
+    "np": ".numpy",
+    "numpy_extension": ".numpy_extension",
+    "npx": ".numpy_extension",
     "util": ".util",
     "runtime": ".runtime",
     "models": ".models",
@@ -83,3 +87,11 @@ def __getattr__(name):
         globals()[name] = mod
         return mod
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+# profiler autostart must defeat the lazy import (reference profiles from
+# process start when MXNET_PROFILER_AUTOSTART=1, SURVEY §5)
+import os as _os
+
+if _os.environ.get("MXNET_PROFILER_AUTOSTART", "0") == "1":
+    from . import profiler  # noqa: F401  (its import-time hook starts it)

@@ -41,6 +41,7 @@ class Executor:
         self.grad_dict = grad_dict
         self.aux_dict = aux_dict
         self._grad_req = grad_req          # name -> req string
+        self._monitor_callback = None
         self.outputs = []
         self._arg_names = symbol.list_arguments()
         self._aux_names = symbol.list_auxiliary_states()
@@ -159,6 +160,10 @@ class Executor:
             out = fn(*ins, **attrs)
             outs = out if isinstance(out, (tuple, list)) else (out,)
             values[id(node)] = tuple(outs)
+            if self._monitor_callback is not None:
+                for oi, o in enumerate(outs):
+                    suffix = f"_output{oi}" if len(outs) > 1 else "_output"
+                    self._monitor_callback(node.name + suffix, o)
             if node.op in _BN_OPS and is_train and len(outs) >= 3 and \
                     len(node.inputs) >= 5:
                 bn_commits.append((node, outs))
@@ -173,6 +178,12 @@ class Executor:
                         aux._data = new._data.astype(aux.dtype) \
                             if new.dtype != aux.dtype else new._data
         return [values[id(n)][oi] for n, oi in self._symbol._heads]
+
+    def set_monitor_callback(self, callback, monitor_all=False):
+        """Install a per-op-output callback ``cb(name, array)`` invoked
+        during ``forward`` (reference ``MXExecutorSetMonitorCallback*``,
+        src/c_api/c_api_executor.cc:?)."""
+        self._monitor_callback = callback
 
     def forward(self, is_train=False, **kwargs):
         for name, value in kwargs.items():

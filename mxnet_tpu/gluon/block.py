@@ -330,10 +330,11 @@ class Block:
         return self
 
     def __call__(self, *args):
-        for hook in self._forward_pre_hooks.values():
+        # tuple() so a hook may detach itself mid-iteration (one-shot hooks)
+        for hook in tuple(self._forward_pre_hooks.values()):
             hook(self, args)
         out = self.forward(*args)
-        for hook in self._forward_hooks.values():
+        for hook in tuple(self._forward_hooks.values()):
             hook(self, args, out)
         return out
 

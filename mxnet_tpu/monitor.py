@@ -41,7 +41,17 @@ class Monitor:
     def install(self, block, monitor_all=False):
         """Attach to every child block's forward output; with
         ``monitor_all`` also record inputs (reference
-        ``monitor_all`` on executor attaches input arrays too)."""
+        ``monitor_all`` on executor attaches input arrays too).
+
+        Hybridized blocks replay a compiled graph — child forwards (and so
+        these hooks) only run at trace time; monitor imperatively."""
+        if getattr(block, "_active", False):
+            import warnings
+
+            warnings.warn(
+                "Monitor.install on a hybridized block records nothing "
+                "after the first trace; call hybridize(False) while "
+                "monitoring", stacklevel=2)
 
         def make_hook(name):
             def hook(blk, inputs, outputs):

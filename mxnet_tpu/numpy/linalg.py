@@ -1,0 +1,27 @@
+"""``mx.np.linalg`` (reference ``python/mxnet/numpy/linalg.py:?``): dense
+linear algebra over XLA — the role the reference's ``la_op*`` mshadow/
+cuSOLVER kernels played (``src/operator/tensor/la_op.cc:?``)."""
+from __future__ import annotations
+
+from . import _wrap
+
+
+def _install():
+    import jax.numpy.linalg as jla
+
+    g = globals()
+    names = """norm inv pinv det slogdet eig eigh eigvals eigvalsh svd
+        cholesky qr solve lstsq matrix_rank matrix_power multi_dot
+        tensorinv tensorsolve cond""".split()
+    all_ = []
+    for nm in names:
+        jfn = getattr(jla, nm, None)
+        if jfn is None:
+            continue
+        g[nm] = _wrap(jfn, f"linalg_{nm}")
+        all_.append(nm)
+    g["__all__"] = all_
+
+
+_install()
+del _install

@@ -1,0 +1,136 @@
+"""``mx.np.random`` (reference ``python/mxnet/numpy/random.py:?``):
+numpy-style sampling over the framework's key-splitting RNG (see
+``mxnet_tpu/random.py`` — per-call key splits outside jit, fixed key
+provider inside a trace)."""
+from __future__ import annotations
+
+import numpy as _onp
+
+from .. import random as _random
+from ..ndarray import NDArray
+from . import _np
+
+__all__ = ["uniform", "normal", "randint", "rand", "randn", "choice",
+           "shuffle", "exponential", "gamma", "beta", "chisquare",
+           "multinomial", "seed"]
+
+
+def seed(seed_state):
+    _random.seed(seed_state)
+
+
+def _size_to_shape(size):
+    if size is None:
+        return ()
+    return (size,) if isinstance(size, int) else tuple(size)
+
+
+def uniform(low=0.0, high=1.0, size=None, dtype=None, ctx=None, device=None,
+            out=None):
+    return _np(_random.uniform(low, high, shape=_size_to_shape(size) or (),
+                               dtype=dtype, ctx=ctx or device, out=out))
+
+
+def normal(loc=0.0, scale=1.0, size=None, dtype=None, ctx=None, device=None,
+           out=None):
+    return _np(_random.normal(loc, scale, shape=_size_to_shape(size) or (),
+                              dtype=dtype, ctx=ctx or device, out=out))
+
+
+def randint(low, high=None, size=None, dtype=None, ctx=None, device=None,
+            out=None):
+    if high is None:
+        low, high = 0, low
+    return _np(_random.randint(low, high, shape=_size_to_shape(size) or (),
+                               dtype=dtype or _onp.int64,
+                               ctx=ctx or device, out=out))
+
+
+def rand(*size):
+    return uniform(0.0, 1.0, size=size or None)
+
+
+def randn(*size):
+    return normal(0.0, 1.0, size=size or None)
+
+
+def exponential(scale=1.0, size=None, ctx=None, device=None, out=None):
+    return _np(_random.exponential(scale, shape=_size_to_shape(size) or (),
+                                   ctx=ctx or device, out=out))
+
+
+def gamma(shape, scale=1.0, size=None, dtype=None, ctx=None, device=None,
+          out=None):
+    return _gamma_impl(shape, scale, size, dtype, ctx or device)
+
+
+def _gamma_impl(alpha, scale, size, dtype, ctx):
+    import jax
+
+    from ..ops.registry import wrap_raw
+
+    k = _random.next_key()
+    shp = _size_to_shape(size) or ()
+    raw = jax.random.gamma(k, alpha, shape=shp) * scale
+    return _np(wrap_raw(raw.astype(dtype or _onp.float32)))
+
+
+def beta(a, b, size=None, dtype=None, ctx=None, device=None):
+    import jax
+
+    from ..ops.registry import wrap_raw
+
+    k1, k2 = (_random.next_key(), _random.next_key())
+    shp = _size_to_shape(size) or ()
+    ga = jax.random.gamma(k1, a, shape=shp)
+    gb = jax.random.gamma(k2, b, shape=shp)
+    return _np(wrap_raw((ga / (ga + gb)).astype(dtype or _onp.float32)))
+
+
+def chisquare(df, size=None, dtype=None, ctx=None, device=None):
+    return _gamma_impl(df / 2.0, 2.0, size, dtype, ctx or device)
+
+
+def choice(a, size=None, replace=True, p=None, ctx=None, device=None,
+           out=None):
+    import jax
+
+    from ..ops.registry import wrap_raw
+
+    k = _random.next_key()
+    shp = _size_to_shape(size) or ()
+    if isinstance(a, NDArray):
+        raw = jax.random.choice(k, a._data, shape=shp, replace=replace,
+                                p=None if p is None else
+                                (p._data if isinstance(p, NDArray) else p))
+    else:
+        raw = jax.random.choice(k, int(a), shape=shp, replace=replace,
+                                p=None if p is None else
+                                (p._data if isinstance(p, NDArray) else p))
+    return _np(wrap_raw(raw))
+
+
+def shuffle(x):
+    """In-place permutation along the first axis (numpy contract)."""
+    shuffled = _random.shuffle(x)
+    x._data = shuffled._data
+    return None
+
+
+def multinomial(n, pvals, size=None):
+    import jax
+
+    from ..ops.registry import wrap_raw
+
+    k = _random.next_key()
+    pv = pvals._data if isinstance(pvals, NDArray) else _onp.asarray(pvals)
+    shp = _size_to_shape(size) or ()
+    out = jax.random.multinomial(k, n, _np_asarray(pv), shape=shp + (len(pv),)
+                                 if shp else None)
+    return _np(wrap_raw(out.astype(_onp.int64)))
+
+
+def _np_asarray(x):
+    import jax.numpy as jnp
+
+    return jnp.asarray(x)

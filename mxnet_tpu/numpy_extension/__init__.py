@@ -1,0 +1,76 @@
+"""``mx.npx`` — numpy-extension namespace.
+
+Reference: ``python/mxnet/numpy_extension/__init__.py:?`` (≥1.6, SURVEY
+§2.4): the MXNet-specific ops that have no numpy equivalent (nn
+activations, softmax family, batch_dot, pick, topk, sequence ops,
+embedding, special reshape) exposed to np-mode code, plus
+``set_np``/``reset_np`` and save/load/waitall.
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+from ..ndarray import NDArray
+from ..numpy import _np
+from ..util import (set_np, reset_np, is_np_array, is_np_shape,  # noqa:F401
+                    set_np_shape, use_np, use_np_array, use_np_shape)
+
+__all__ = ["set_np", "reset_np", "is_np_array", "is_np_shape", "waitall",
+           "seed", "save", "load"]
+
+
+def _reexport(names):
+    from .. import ndarray as nd
+
+    g = globals()
+    for name in names:
+        fn = getattr(nd, name, None)
+        if fn is None:
+            continue
+
+        def mk(f):
+            def wrapped(*args, **kwargs):
+                return _np(f(*args, **kwargs))
+            wrapped.__name__ = f.__name__
+            wrapped.__doc__ = f.__doc__
+            return wrapped
+
+        g[name] = mk(fn)
+        __all__.append(name)
+
+
+_reexport("""relu sigmoid softmax log_softmax activation leaky_relu
+    batch_dot pick topk one_hot gather_nd scatter_nd sequence_mask
+    broadcast_like arange_like embedding Embedding batch_norm layer_norm
+    fully_connected convolution pooling dropout reshape reshape_like
+    slice slice_axis slice_like smooth_l1 erf erfinv gamma gammaln
+    clip""".split())
+
+
+def waitall():
+    from .. import ndarray as nd
+
+    nd.waitall()
+
+
+def seed(seed_state):
+    from .. import random
+
+    random.seed(seed_state)
+
+
+def save(file, arr):
+    """Save np arrays (reference ``npx.save``): same container format as
+    ``nd.save`` (readable by the reference's ``NDArray::Load``)."""
+    from ..serialization import save_ndarrays
+
+    save_ndarrays(file, arr)
+
+
+def load(file):
+    from ..numpy import _np as _np_wrap
+    from ..serialization import load_ndarrays
+
+    out = load_ndarrays(file)
+    if isinstance(out, dict):
+        return {k: _np_wrap(v) for k, v in out.items()}
+    return [_np_wrap(v) for v in out]

@@ -63,7 +63,10 @@ def set_state(state="stop"):
     if state not in ("run", "stop"):
         raise MXNetError("profiler state must be 'run' or 'stop'")
     if state == "run" and _state != "run":
-        _t0 = time.perf_counter()
+        # keep the original epoch across pause/resume so chrome-trace
+        # timestamps stay monotonic within one profile
+        if _t0 is None:
+            _t0 = time.perf_counter()
         if _config["profile_device_trace"]:
             import jax
 
@@ -112,11 +115,16 @@ def record_op_event(name, dur_s, cat="operator"):
 
 def dump(finished=True, profile_process="worker"):
     """Write chrome://tracing JSON to ``filename`` (reference
-    ``profiler.dump``)."""
+    ``profiler.dump``).  ``finished=True`` ends the profile: the event
+    buffer and epoch reset so a later run starts a fresh trace."""
+    global _t0
     if finished:
         set_state("stop")
     with _lock:
         payload = {"traceEvents": list(_events), "displayTimeUnit": "ms"}
+        if finished:
+            _events.clear()
+            _t0 = None
     with open(_config["filename"], "w") as f:
         json.dump(payload, f)
 

@@ -24,7 +24,6 @@ def print_summary(symbol, shape=None, line_length=120, positions=None):
     positions = positions or [0.44, 0.64, 0.74, 1.0]
     shapes = {}
     if shape is not None:
-        arg_shapes, out_shapes, _aux = symbol.infer_shape(**shape)
         internals = symbol.get_internals()
         onames = internals.list_outputs()
         _, int_shapes, _ = internals.infer_shape(**shape)

@@ -1,0 +1,169 @@
+"""mx.np / mx.npx front-end tests (reference model:
+tests/python/unittest/test_numpy_ndarray.py + test_numpy_op.py — numpy
+cross-checks over the np-semantics array type, SURVEY §4)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+from mxnet_tpu.ndarray import NDArray
+
+np = mx.np
+npx = mx.npx
+
+
+def test_array_creation_and_types():
+    a = np.array([[1.0, 2], [3, 4]])
+    assert type(a).__name__ == "ndarray"
+    assert isinstance(a, NDArray)  # np arrays flow through gluon unchanged
+    assert a.dtype == onp.float32  # classic default dtype
+    assert np.array([1, 2]).dtype in (onp.int32, onp.int64)
+    z = np.zeros((2, 3))
+    assert z.shape == (2, 3) and z.dtype == onp.float32
+    assert np.ones((2,), dtype="float64").dtype == onp.float64
+    assert np.arange(5).shape == (5,)
+    assert np.linspace(0, 1, 11).shape == (11,)
+    assert np.eye(3).shape == (3, 3)
+
+
+def test_zero_dim_and_zero_size():
+    z = np.zeros(())
+    assert z.shape == ()
+    assert float(z.item()) == 0.0
+    e = np.zeros((0, 3))
+    assert e.shape == (0, 3) and e.size == 0
+    s = np.sum(np.ones((2, 2)))
+    assert s.shape == ()  # true scalar, not (1,)
+
+
+def test_operators_stay_np_typed():
+    a = np.array([1.0, 2.0])
+    b = np.array([3.0, 4.0])
+    for r in (a + b, a - b, a * b, a / b, a ** 2, -a, abs(a), a + 1, 2 * a):
+        assert type(r).__name__ == "ndarray"
+    onp.testing.assert_allclose((a * b).asnumpy(), [3, 8])
+
+
+def test_elemwise_and_reductions_match_numpy():
+    rng = onp.random.RandomState(0)
+    x = rng.uniform(0.5, 2.0, (3, 4)).astype(onp.float32)
+    a = np.array(x)
+    for name in ["exp", "log", "sqrt", "sin", "cos", "tanh", "square",
+                 "sign", "floor", "ceil"]:
+        got = getattr(np, name)(a).asnumpy()
+        want = getattr(onp, name)(x)
+        onp.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+    onp.testing.assert_allclose(np.sum(a, axis=1).asnumpy(), x.sum(axis=1),
+                                rtol=1e-5)
+    onp.testing.assert_allclose(np.mean(a).item(), x.mean(), rtol=1e-5)
+    assert np.argmax(a).item() == x.argmax()
+    onp.testing.assert_allclose(np.cumsum(a, axis=0).asnumpy(),
+                                x.cumsum(axis=0), rtol=1e-5)
+
+
+def test_shape_manipulation():
+    a = np.arange(12).reshape((3, 4)) if hasattr(np.arange(12), "reshape") \
+        else np.reshape(np.arange(12), (3, 4))
+    a = np.reshape(np.arange(12), (3, 4))
+    assert a.shape == (3, 4)
+    assert np.transpose(a).shape == (4, 3)
+    assert np.expand_dims(a, 0).shape == (1, 3, 4)
+    assert np.squeeze(np.expand_dims(a, 0)).shape == (3, 4)
+    b = np.concatenate([a, a], axis=0)
+    assert b.shape == (6, 4)
+    s = np.split(b, 2, axis=0)
+    assert len(s) == 2 and s[0].shape == (3, 4)
+    assert np.stack([a, a]).shape == (2, 3, 4)
+    assert np.tile(a, (2, 1)).shape == (6, 4)
+    assert np.broadcast_to(np.ones((1, 4)), (3, 4)).shape == (3, 4)
+    assert np.where(a > 5, a, np.zeros_like(a)).shape == (3, 4)
+
+
+def test_matmul_dot_einsum():
+    a = np.array(onp.arange(6).reshape(2, 3).astype(onp.float32))
+    b = np.array(onp.arange(12).reshape(3, 4).astype(onp.float32))
+    onp.testing.assert_allclose(
+        np.matmul(a, b).asnumpy(), a.asnumpy() @ b.asnumpy())
+    onp.testing.assert_allclose(
+        np.dot(a, b).asnumpy(), a.asnumpy() @ b.asnumpy())
+    onp.testing.assert_allclose(
+        np.einsum("ij,jk->ik", a, b).asnumpy(), a.asnumpy() @ b.asnumpy(),
+        rtol=1e-5)
+
+
+def test_linalg():
+    x = onp.array([[4.0, 2], [2, 3]], dtype=onp.float32)
+    a = np.array(x)
+    onp.testing.assert_allclose(np.linalg.norm(a).item(),
+                                onp.linalg.norm(x), rtol=1e-5)
+    onp.testing.assert_allclose(np.linalg.inv(a).asnumpy(),
+                                onp.linalg.inv(x), rtol=1e-4)
+    onp.testing.assert_allclose(np.linalg.det(a).item(),
+                                onp.linalg.det(x), rtol=1e-5)
+    l = np.linalg.cholesky(a).asnumpy()
+    onp.testing.assert_allclose(l @ l.T, x, rtol=1e-5)
+
+
+def test_random():
+    np.random.seed(0)
+    u = np.random.uniform(size=(100,))
+    assert u.shape == (100,)
+    assert 0 <= float(u.asnumpy().min()) and float(u.asnumpy().max()) <= 1
+    n = np.random.normal(0, 1, size=(50, 2))
+    assert n.shape == (50, 2)
+    r = np.random.randint(0, 10, size=(20,))
+    assert r.asnumpy().min() >= 0 and r.asnumpy().max() < 10
+    assert np.random.rand(2, 3).shape == (2, 3)
+    c = np.random.choice(5, size=(10,))
+    assert c.shape == (10,)
+    g = np.random.gamma(2.0, 1.0, size=(10,))
+    assert (g.asnumpy() > 0).all()
+
+
+def test_autograd_through_np():
+    x = np.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = np.sum(x * x * 3)
+    y.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), 6 * x.asnumpy())
+
+
+def test_npx_ops_and_np_mode():
+    a = np.array([-1.0, 2.0])
+    onp.testing.assert_allclose(npx.relu(a).asnumpy(), [0, 2])
+    sm = npx.softmax(np.array([[1.0, 1.0]]))
+    onp.testing.assert_allclose(sm.asnumpy(), [[0.5, 0.5]], rtol=1e-6)
+    npx.set_np()
+    try:
+        assert mx.util.is_np_array() and mx.util.is_np_shape()
+    finally:
+        npx.reset_np()
+    assert not mx.util.is_np_array()
+
+
+def test_npx_save_load(tmp_path):
+    f = str(tmp_path / "arrs.npz")
+    npx.save(f, {"w": np.array([1.0, 2.0])})
+    back = npx.load(f)
+    assert type(back["w"]).__name__ == "ndarray"
+    onp.testing.assert_allclose(back["w"].asnumpy(), [1, 2])
+
+
+def test_conversion_nd_np():
+    a = np.array([1.0, 2.0])
+    nd_a = a.as_nd_ndarray()
+    assert type(nd_a) is NDArray
+    back = np._np(nd_a)
+    assert type(back).__name__ == "ndarray"
+    # shared storage
+    assert nd_a._data is a._data
+
+
+def test_np_interops_with_gluon():
+    from mxnet_tpu.gluon import nn
+
+    net = nn.Dense(3, in_units=2)
+    net.initialize()
+    out = net(np.ones((4, 2)))
+    assert out.shape == (4, 3)
