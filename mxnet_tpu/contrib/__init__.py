@@ -4,5 +4,6 @@ Reference: ``python/mxnet/contrib/__init__.py:?`` — amp, quantization,
 onnx, ndarray/symbol contrib re-exports (SURVEY §2.4).
 """
 from .. import amp  # noqa: F401
+from . import quantization  # noqa: F401
 from ..ndarray import contrib as ndarray  # noqa: F401
 from ..symbol import contrib as symbol  # noqa: F401

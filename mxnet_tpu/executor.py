@@ -42,6 +42,7 @@ class Executor:
         self.aux_dict = aux_dict
         self._grad_req = grad_req          # name -> req string
         self._monitor_callback = None
+        self._monitor_all = False
         self.outputs = []
         self._arg_names = symbol.list_arguments()
         self._aux_names = symbol.list_auxiliary_states()
@@ -161,6 +162,10 @@ class Executor:
             outs = out if isinstance(out, (tuple, list)) else (out,)
             values[id(node)] = tuple(outs)
             if self._monitor_callback is not None:
+                if getattr(self, "_monitor_all", False):
+                    for ii, i_arr in enumerate(ins):
+                        self._monitor_callback(
+                            f"{node.name}_input{ii}", i_arr)
                 for oi, o in enumerate(outs):
                     suffix = f"_output{oi}" if len(outs) > 1 else "_output"
                     self._monitor_callback(node.name + suffix, o)
@@ -181,9 +186,11 @@ class Executor:
 
     def set_monitor_callback(self, callback, monitor_all=False):
         """Install a per-op-output callback ``cb(name, array)`` invoked
-        during ``forward`` (reference ``MXExecutorSetMonitorCallback*``,
+        during ``forward``; ``monitor_all`` also reports op inputs
+        (reference ``MXExecutorSetMonitorCallback{,EX}``,
         src/c_api/c_api_executor.cc:?)."""
         self._monitor_callback = callback
+        self._monitor_all = monitor_all
 
     def forward(self, is_train=False, **kwargs):
         for name, value in kwargs.items():

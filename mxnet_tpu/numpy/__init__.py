@@ -200,6 +200,44 @@ def empty(shape, dtype=None, ctx=None, device=None):
                                          device=device)
 
 
+# metadata/introspection: plain python results, NOT op-dispatched
+def shape(a):
+    return tuple(a.shape) if isinstance(a, NDArray) else _onp.shape(a)
+
+
+def ndim(a):
+    return a.ndim if isinstance(a, NDArray) else _onp.ndim(a)
+
+
+def size(a, axis=None):
+    if isinstance(a, NDArray):
+        return a.size if axis is None else a.shape[axis]
+    return _onp.size(a, axis)
+
+
+def result_type(*args):
+    return _onp.result_type(*[a.dtype if isinstance(a, NDArray) else a
+                              for a in args])
+
+
+def can_cast(from_, to, casting="safe"):
+    f = from_.dtype if isinstance(from_, NDArray) else from_
+    return _onp.can_cast(f, to, casting)
+
+
+def promote_types(t1, t2):
+    return _onp.promote_types(t1, t2)
+
+
+def may_share_memory(a, b, max_work=None):
+    if isinstance(a, NDArray) and isinstance(b, NDArray):
+        return a._data is b._data
+    return False
+
+
+shares_memory = may_share_memory
+
+
 # --- namespace assembly ------------------------------------------------------
 
 def _install():
@@ -213,7 +251,7 @@ def _install():
         logical_not isnan isinf isfinite isneginf isposinf conj real
         imag angle degrees radians ravel sort unique nonzero
         copy diag diagonal atleast_1d atleast_2d atleast_3d
-        flatnonzero ndim shape size""".split()
+        flatnonzero""".split()
     binary = """add subtract multiply divide true_divide floor_divide mod
         remainder power float_power maximum minimum fmax fmin arctan2
         hypot logaddexp logaddexp2 copysign nextafter logical_and
@@ -232,9 +270,7 @@ def _install():
         trace tensordot einsum pad bincount digitize interp histogram
         allclose isclose array_equal array_equiv triu tril trilu
         meshgrid unravel_index ravel_multi_index diff ediff1d gradient
-        trapz dot insert delete resize flatten invert
-        may_share_memory shares_memory result_type can_cast
-        promote_types""".split()
+        trapz dot insert delete resize flatten invert""".split()
     creation = """zeros ones full arange linspace logspace geomspace eye
         identity tri zeros_like ones_like full_like empty_like
         frombuffer""".split()

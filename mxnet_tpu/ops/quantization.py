@@ -1,0 +1,261 @@
+"""INT8 quantization operators.
+
+Reference: ``src/operator/quantization/`` — ``quantize{,_v2}.cc:?``,
+``dequantize.cc:?``, ``requantize.cc:?``, ``quantized_conv.cc:?``,
+``quantized_fully_connected.cc:?``, ``quantized_pooling.cc:?``,
+``quantized_flatten.cc:?`` (SURVEY §2.2 quantization row).  The reference
+computes these with MKLDNN/cuDNN int8 kernels.
+
+TPU-native: int8 tensors feed ``lax.dot_general``/``conv_general_dilated``
+with ``preferred_element_type=int32`` — the MXU has a native int8×int8→
+int32 path, which is exactly the role the cuDNN int8 kernels played.
+Ranges travel alongside data as (min, max) scalars, same 3-tensor
+convention as the reference so the symbolic quantization pass composes.
+"""
+from __future__ import annotations
+
+import sys
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import MXNetError
+from .registry import apply_op, make_exporter
+
+_this = sys.modules[__name__]
+_export = make_exporter(_this)
+
+_QMIN = {"int8": -127.0, "uint8": 0.0, "int32": -(2.0 ** 31 - 1)}
+_QMAX = {"int8": 127.0, "uint8": 255.0, "int32": 2.0 ** 31 - 1}
+
+
+def _scale(mn, mx, out_type):
+    """float range → quant scale (reference symmetric int8 / affine uint8
+    convention: int8 uses max(|min|,|max|)/127)."""
+    if out_type == "uint8":
+        rng = jnp.maximum(mx - mn, 1e-12)
+        return 255.0 / rng
+    amax = jnp.maximum(jnp.maximum(jnp.abs(mn), jnp.abs(mx)), 1e-12)
+    return _QMAX[out_type] / amax
+
+
+def quantize(data, min_range, max_range, out_type="uint8", **kwargs):
+    """Reference ``_contrib_quantize``: float → quantized with given
+    range.  Returns (q, min, max)."""
+
+    def _f(x, mn, mx):
+        s = _scale(mn, mx, out_type)
+        if out_type == "uint8":
+            q = jnp.clip(jnp.round((x - mn) * s), 0, 255).astype(jnp.uint8)
+            return q, mn, mx
+        q = jnp.clip(jnp.round(x * s), -127, 127).astype(jnp.int8)
+        amax = jnp.maximum(jnp.abs(mn), jnp.abs(mx))
+        return q, -amax, amax
+
+    return apply_op(_f, data, min_range, max_range, name="quantize")
+
+
+_export(quantize, aliases=("_contrib_quantize",))
+
+
+def quantize_v2(data, out_type="int8", min_calib_range=None,
+                max_calib_range=None, **kwargs):
+    """Reference ``_contrib_quantize_v2``: range from calibration or from
+    the data itself.  Returns (q, min, max)."""
+
+    def _f(x):
+        if min_calib_range is not None and max_calib_range is not None:
+            mn = jnp.asarray(min_calib_range, jnp.float32)
+            mx = jnp.asarray(max_calib_range, jnp.float32)
+        else:
+            mn = x.min().astype(jnp.float32)
+            mx = x.max().astype(jnp.float32)
+        s = _scale(mn, mx, out_type)
+        if out_type == "uint8":
+            q = jnp.clip(jnp.round((x - mn) * s), 0, 255).astype(jnp.uint8)
+            return q, mn, mx
+        q = jnp.clip(jnp.round(x * s), -127, 127).astype(jnp.int8)
+        amax = jnp.maximum(jnp.abs(mn), jnp.abs(mx))
+        return q, -amax, amax
+
+    return apply_op(_f, data, name="quantize_v2")
+
+
+_export(quantize_v2, aliases=("_contrib_quantize_v2",))
+
+
+def dequantize(data, min_range, max_range, out_type="float32", **kwargs):
+    """Reference ``_contrib_dequantize``: quantized → float."""
+
+    def _f(q, mn, mx):
+        if q.dtype == jnp.uint8:
+            s = _scale(mn, mx, "uint8")
+            return q.astype(jnp.float32) / s + mn
+        qtype = "int8" if q.dtype == jnp.int8 else "int32"
+        s = _scale(mn, mx, qtype)
+        return q.astype(jnp.float32) / s
+
+    return apply_op(_f, data, min_range, max_range, name="dequantize")
+
+
+_export(dequantize, aliases=("_contrib_dequantize",))
+
+
+def requantize(data, min_range, max_range, min_calib_range=None,
+               max_calib_range=None, out_type="int8", **kwargs):
+    """Reference ``_contrib_requantize``: int32 accumulator → int8 with a
+    (possibly calibrated) narrower range."""
+
+    def _f(q, mn, mx):
+        real = q.astype(jnp.float32) / _scale(mn, mx, "int32")
+        if min_calib_range is not None:
+            omn = jnp.asarray(min_calib_range, jnp.float32)
+            omx = jnp.asarray(max_calib_range, jnp.float32)
+        else:
+            omn, omx = real.min(), real.max()
+        s = _scale(omn, omx, "int8")
+        q8 = jnp.clip(jnp.round(real * s), -127, 127).astype(jnp.int8)
+        amax = jnp.maximum(jnp.abs(omn), jnp.abs(omx))
+        return q8, -amax, amax
+
+    return apply_op(_f, data, min_range, max_range, name="requantize")
+
+
+_export(requantize, aliases=("_contrib_requantize",))
+
+
+def _range_scales(mnd, mxd, mnw, mxw):
+    sd = _scale(mnd, mxd, "int8")
+    sw = _scale(mnw, mxw, "int8")
+    return sd, sw
+
+
+def quantized_fully_connected(*args, num_hidden=0, no_bias=False,
+                              flatten=True, **kwargs):
+    """Reference ``_contrib_quantized_fully_connected``: int8×int8→int32
+    matmul on the MXU.  Inputs (positional, reference order):
+    ``data, weight, [bias,] min_data, max_data, min_weight, max_weight``.
+    Returns (int32 out, min_out, max_out)."""
+
+    def _f(x, w, *rest):
+        if no_bias:
+            b, (mnd, mxd, mnw, mxw) = None, rest[:4]
+        else:
+            b, (mnd, mxd, mnw, mxw) = rest[0], rest[1:5]
+        xi = x.reshape(x.shape[0], -1) if flatten else x
+        sw = _scale(mnw, mxw, "int8")
+        w8 = w.astype(jnp.int8)
+        if x.dtype == jnp.uint8:
+            # affine uint8: x ≈ q/s + mn.  Shift by 128 so the matmul runs
+            # int8×int8→int32 on the MXU; the zero-point terms (128 shift +
+            # mn offset) fold into a per-output-column constant (exact)
+            sd = _scale(mnd, mxd, "uint8")
+            q8 = (xi.astype(jnp.int32) - 128).astype(jnp.int8)
+            acc = lax.dot_general(
+                q8, w8, (((xi.ndim - 1,), (1,)), ((), ())),
+                preferred_element_type=jnp.int32)
+            colsum = w8.sum(axis=1).astype(jnp.float32)
+            real = acc.astype(jnp.float32) / (sd * sw) \
+                + colsum * (128.0 / (sd * sw) + mnd / sw)
+            # re-express as int32 + symmetric range so (out,min,max)
+            # contract matches the int8 path
+            amax = jnp.maximum(jnp.abs(real).max(), 1e-12)
+            oscale = _QMAX["int32"] / amax
+            out = jnp.round(real * oscale).astype(jnp.int32)
+            if b is not None:
+                out = out + b.astype(jnp.int32)
+            return out, -amax, amax
+        sd = _scale(mnd, mxd, "int8")
+        out = lax.dot_general(
+            xi.astype(jnp.int8), w8,
+            (((xi.ndim - 1,), (1,)), ((), ())),
+            preferred_element_type=jnp.int32)
+        if b is not None:
+            # bias arrives int8 in the accumulator scale (reference
+            # requantizes bias into data_scale*weight_scale)
+            out = out + b.astype(jnp.int32)
+        amax = _QMAX["int32"] / (sd * sw)
+        return out, -amax, amax
+
+    return apply_op(_f, *args, name="quantized_fully_connected")
+
+
+_export(quantized_fully_connected,
+        aliases=("_contrib_quantized_fully_connected",))
+
+
+def quantized_conv(*args, kernel=None, stride=(1, 1), pad=(0, 0),
+                   dilate=(1, 1), num_filter=0, no_bias=False,
+                   layout="NCHW", **kwargs):
+    """Reference ``_contrib_quantized_conv``: int8 NCHW convolution
+    accumulating int32 (cuDNN int8x4 analog → MXU int8 path).  Inputs
+    positional as in ``quantized_fully_connected``."""
+    stride = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    pad = (pad, pad) if isinstance(pad, int) else tuple(pad)
+    dilate = (dilate, dilate) if isinstance(dilate, int) else tuple(dilate)
+
+    def _f(x, w, *rest):
+        if x.dtype == jnp.uint8:
+            raise MXNetError(
+                "quantized_conv requires int8 data: the uint8 zero-point "
+                "correction is not exact under zero padding (the reference "
+                "MKLDNN u8s8 path has the same caveat); quantize data with "
+                "out_type='int8'")
+        if x.ndim != 4:
+            raise MXNetError("quantized_conv supports 2D NCHW only")
+        if no_bias:
+            b, (mnd, mxd, mnw, mxw) = None, rest[:4]
+        else:
+            b, (mnd, mxd, mnw, mxw) = rest[0], rest[1:5]
+        dn = lax.conv_dimension_numbers(x.shape, w.shape,
+                                        ("NCHW", "OIHW", "NCHW"))
+        out = lax.conv_general_dilated(
+            x.astype(jnp.int8), w.astype(jnp.int8),
+            window_strides=stride,
+            padding=tuple((p, p) for p in pad),
+            rhs_dilation=dilate, dimension_numbers=dn,
+            preferred_element_type=jnp.int32)
+        if b is not None:
+            out = out + b.astype(jnp.int32)[None, :, None, None]
+        sd, sw = _range_scales(mnd, mxd, mnw, mxw)
+        amax = _QMAX["int32"] / (sd * sw)
+        return out, -amax, amax
+
+    return apply_op(_f, *args, name="quantized_conv")
+
+
+_export(quantized_conv, aliases=("_contrib_quantized_conv",))
+
+
+def quantized_pooling(data, min_data, max_data, kernel=None,
+                      pool_type="max", stride=None, pad=None,
+                      global_pool=False, **kwargs):
+    """Reference ``_contrib_quantized_pooling``: pool via a float32 view
+    and cast back (range is preserved; avg-pool cannot overflow)."""
+    from .nn_ops import pooling
+
+    out = pooling(
+        _as_float_view(data), kernel=kernel, pool_type=pool_type,
+        stride=stride, pad=pad, global_pool=global_pool)
+    q = apply_op(lambda f, s=data._data.dtype:
+                 jnp.round(f).astype(s), out, name="quantized_pool_cast")
+    return q, min_data, max_data
+
+
+def _as_float_view(q):
+    return apply_op(lambda x: x.astype(jnp.float32), q, name="q2f")
+
+
+_export(quantized_pooling, aliases=("_contrib_quantized_pooling",))
+
+
+def quantized_flatten(data, min_data, max_data, **kwargs):
+    """Reference ``_contrib_quantized_flatten``."""
+    out = apply_op(lambda q: q.reshape(q.shape[0], -1), data,
+                   name="quantized_flatten")
+    return out, min_data, max_data
+
+
+_export(quantized_flatten, aliases=("_contrib_quantized_flatten",))

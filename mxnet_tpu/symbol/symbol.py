@@ -48,6 +48,20 @@ _MULTI_OUT = {
     "topk": lambda a: (2, 2) if a.get("ret_typ") == "both" else (1, 1),
     "BatchNorm": lambda a: (3, 3 if a.get("output_mean_var") else 1),
     "batch_norm": lambda a: (3, 3 if a.get("output_mean_var") else 1),
+    # quantization family: (out, min, max) triples
+    **{k: (lambda a: (3, 3)) for k in (
+        "quantize", "_contrib_quantize", "quantize_v2",
+        "_contrib_quantize_v2", "requantize", "_contrib_requantize",
+        "quantized_conv", "_contrib_quantized_conv",
+        "quantized_fully_connected",
+        "_contrib_quantized_fully_connected", "quantized_pooling",
+        "_contrib_quantized_pooling", "quantized_flatten",
+        "_contrib_quantized_flatten")},
+    # detection multi-output contribs
+    **{k: (lambda a: (3, 3)) for k in (
+        "multibox_target", "MultiBoxTarget", "_contrib_MultiBoxTarget")},
+    **{k: (lambda a: (2, 2)) for k in (
+        "bipartite_matching", "_contrib_bipartite_matching")},
 }
 
 # parameter-bearing ops: ordered input names after ``data``; (name, is_aux,

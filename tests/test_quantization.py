@@ -1,0 +1,142 @@
+"""Quantization tests (reference model: tests/python/quantization/
+test_quantization.py — roundtrip + quantized-vs-fp32 op consistency,
+SURVEY §4 backend-delta tests)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+import mxnet_tpu.symbol as sym
+from mxnet_tpu.contrib import quantization as qz
+
+
+def test_quantize_dequantize_roundtrip_int8():
+    x = nd.random.uniform(-3, 3, shape=(4, 16))
+    q, mn, mxr = nd.quantize_v2(x, out_type="int8")
+    assert q.dtype == np.int8
+    back = nd.dequantize(q, mn, mxr)
+    assert float(nd.abs(back - x).max().asscalar()) < 3 / 127 + 1e-4
+
+
+def test_quantize_dequantize_roundtrip_uint8():
+    x = nd.random.uniform(0, 5, shape=(4, 16))
+    q, mn, mxr = nd.quantize_v2(x, out_type="uint8")
+    assert q.dtype == np.uint8
+    back = nd.dequantize(q, mn, mxr)
+    assert float(nd.abs(back - x).max().asscalar()) < 5 / 255 + 1e-4
+
+
+def test_quantize_with_calib_range():
+    x = nd.array([[0.5, -0.5, 2.0]])
+    q, mn, mxr = nd.quantize_v2(x, out_type="int8", min_calib_range=-1.0,
+                                max_calib_range=1.0)
+    # 2.0 clips to the calibrated max
+    back = nd.dequantize(q, mn, mxr).asnumpy()
+    assert back[0, 2] == pytest.approx(1.0, abs=0.02)
+
+
+def test_requantize():
+    x = nd.random.uniform(-1, 1, shape=(8, 8))
+    w = nd.random.uniform(-1, 1, shape=(4, 8))
+    qd, dmn, dmx = nd.quantize_v2(x, out_type="int8")
+    qw, wmn, wmx = nd.quantize_v2(w, out_type="int8")
+    o32, omn, omx = nd.quantized_fully_connected(
+        qd, qw, dmn, dmx, wmn, wmx, no_bias=True, num_hidden=4)
+    assert o32.dtype == np.int32
+    q8, qmn, qmx = nd.requantize(o32, omn, omx)
+    assert q8.dtype == np.int8
+    ref = nd.dot(x, nd.transpose(w))
+    got = nd.dequantize(q8, qmn, qmx)
+    rel = float((nd.abs(got - ref).max() / nd.abs(ref).max()).asscalar())
+    assert rel < 0.05
+
+
+def test_quantized_conv_matches_fp32():
+    x = nd.random.uniform(-1, 1, shape=(2, 3, 8, 8))
+    w = nd.random.uniform(-1, 1, shape=(4, 3, 3, 3))
+    qd, dmn, dmx = nd.quantize_v2(x, out_type="int8")
+    qw, wmn, wmx = nd.quantize_v2(w, out_type="int8")
+    o, omn, omx = nd.quantized_conv(qd, qw, dmn, dmx, wmn, wmx,
+                                    no_bias=True, kernel=(3, 3),
+                                    pad=(1, 1), num_filter=4)
+    got = nd.dequantize(o, omn, omx)
+    ref = nd.Convolution(x, w, None, kernel=(3, 3), pad=(1, 1),
+                         num_filter=4, no_bias=True)
+    rel = float((nd.abs(got - ref).max() / nd.abs(ref).max()).asscalar())
+    assert rel < 0.05
+
+
+def _small_net():
+    data = sym.var("data")
+    net = sym.FullyConnected(data, num_hidden=16, name="fc1")
+    net = sym.Activation(net, act_type="relu", name="relu1")
+    net = sym.FullyConnected(net, num_hidden=4, name="fc2")
+    return net
+
+
+def _params_for(net, data_shape):
+    shapes, _, _ = net.infer_shape(data=data_shape)
+    return {n: nd.random.uniform(-1, 1, shape=s)
+            for n, s in zip(net.list_arguments(), shapes) if n != "data"}
+
+
+@pytest.mark.parametrize("mode", ["naive", "entropy"])
+def test_quantize_model(mode):
+    net = _small_net()
+    params = _params_for(net, (2, 8))
+    calib = [nd.random.uniform(-1, 1, shape=(2, 8)) for _ in range(4)]
+    qsym, qarg, qaux = qz.quantize_model(net, params, {}, calib_mode=mode,
+                                         calib_data=calib)
+    names = " ".join(n.op for n in qsym._topo())
+    assert "quantized_fully_connected" in names
+    assert "dequantize" in names
+    exe = net.simple_bind(mx.cpu(), grad_req="null", data=(2, 8))
+    for k, v in params.items():
+        exe.arg_dict[k]._data = v._data
+    ref = exe.forward(data=calib[0])[0]
+    qexe = qsym.simple_bind(mx.cpu(), grad_req="null", data=(2, 8))
+    for k, v in qarg.items():
+        if k in qexe.arg_dict:
+            qexe.arg_dict[k]._data = v._data
+    got = qexe.forward(data=calib[0])[0]
+    rel = float((nd.abs(got - ref).max() / nd.abs(ref).max()).asscalar())
+    assert rel < 0.15
+
+
+def test_quantize_model_excluded():
+    net = _small_net()
+    params = _params_for(net, (2, 8))
+    calib = [nd.random.uniform(shape=(2, 8))]
+    qsym, _, _ = qz.quantize_model(net, params, {}, calib_mode="naive",
+                                   calib_data=calib,
+                                   excluded_sym_names=["fc1"])
+    ops = [n.op for n in qsym._topo()]
+    assert ops.count("quantized_fully_connected") == 1
+    assert "FullyConnected" in ops  # fc1 stays fp32
+
+
+def test_quantize_net_gluon():
+    from mxnet_tpu.gluon import nn
+
+    net = nn.HybridSequential()
+    net.add(nn.Dense(32, in_units=16, activation="relu"),
+            nn.Dense(4, in_units=32))
+    net.initialize(mx.init.Xavier())
+    calib = [nd.random.uniform(-1, 1, shape=(4, 16)) for _ in range(3)]
+    ref = net(calib[0]).asnumpy()
+    qz.quantize_net(net, calib_data=calib, calib_mode="naive")
+    got = net(calib[0]).asnumpy()
+    rel = np.abs(got - ref).max() / np.abs(ref).max()
+    assert rel < 0.1
+    # quantized net still hybridizes
+    net.hybridize()
+    got2 = net(calib[0]).asnumpy()
+    np.testing.assert_allclose(got2, got, rtol=1e-4, atol=1e-5)
+
+
+def test_optimal_threshold_prefers_clipping_outliers():
+    rng = np.random.RandomState(0)
+    data = np.concatenate([rng.normal(0, 0.1, 100000), [50.0]])
+    hist, edges = np.histogram(data, bins=8001, range=(-50, 50))
+    lo, hi = qz._optimal_threshold(hist, edges)
+    assert hi < 10.0  # the single outlier should be clipped away
