@@ -46,6 +46,7 @@ _LAZY = {
     "sym": ".symbol",
     "module": ".module",
     "mod": ".module",
+    "operator": ".operator",
     "executor": ".executor",
     "name": ".name",
     "gluon": ".gluon",

@@ -81,7 +81,7 @@ def _collect_ranges(sym, arg_params, aux_params, calib_data, data_names,
             for k, v in (aux_params or {}).items():
                 if k in exe.aux_dict:
                     exe.aux_dict[k]._data = v._data
-            exe.set_monitor_callback(cb)
+            exe.set_monitor_callback(cb, monitor_all=True)
             first = False
         exe.forward(is_train=False, **feed)
         seen += arrays[0].shape[0]
@@ -204,15 +204,18 @@ def quantize_symbol(sym, excluded_sym_names=(), offline_params=(),
             no_bias = str(attrs.get("no_bias", False)).lower() in \
                 ("true", "1")
             bias = None if no_bias else ins[2]
-            # calibrated range of THIS layer's input, if the pass collected
-            # one (ranges are keyed by producing layer's output name)
-            rng = calib_ranges.get(f"{node.name}_input") \
+            # calibrated range of THIS layer's input: prefer the directly
+            # recorded input range (monitor_all), else the producer's
+            # output range
+            rng = calib_ranges.get(f"{node.name}_input0") \
                 or _producer_range(node, calib_ranges)
             qkw = {}
             if rng is not None:
                 qkw = {"min_calib_range": float(rng[0]),
                        "max_calib_range": float(rng[1])}
-            qd = S.quantize_v2(data, out_type=quantized_dtype,
+            # conv requires symmetric int8 data (zero-padding exactness)
+            ddtype = "int8" if node.op == "Convolution" else quantized_dtype
+            qd = S.quantize_v2(data, out_type=ddtype,
                                name=f"{node.name}_data_quantize", **qkw)
             qw = S.quantize_v2(weight, out_type="int8",
                                name=f"{node.name}_weight_quantize")
@@ -369,7 +372,8 @@ def quantize_net(network, quantized_dtype="int8", exclude_layers=None,
 
     def conv_forward(rng, layer):
         def hybrid_forward(self, F, x, weight, bias=None):
-            qd = F.quantize_v2(x, out_type=quantized_dtype,
+            # conv requires symmetric int8 data (zero-padding exactness)
+            qd = F.quantize_v2(x, out_type="int8",
                                min_calib_range=rng[0],
                                max_calib_range=rng[1])
             qw = F.quantize_v2(weight, out_type="int8")

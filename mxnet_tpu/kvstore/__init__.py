@@ -74,6 +74,10 @@ class KVStore:
             if k not in self._store:
                 raise MXNetError(f"key {k!r} not initialized")
             merged = _merge(v)
+            if self._compression is not None and \
+                    getattr(merged, "stype", "default") == "default":
+                merged, self._residuals[k] = self._compression.roundtrip(
+                    merged, self._residuals.get(k))
             if self._updater is not None:
                 self._updater(int(k) if k.isdigit() else k, merged,
                               self._store[k])
@@ -142,9 +146,13 @@ class KVStore:
 
     def set_gradient_compression(self, compression_params):
         """Reference: 2-bit gradient compression w/ error feedback
-        (src/kvstore/gradient_compression.cc:?).  Stored and applied on the
-        dist path; single-process modes don't compress (same as reference)."""
-        self._compression = dict(compression_params or {})
+        (src/kvstore/gradient_compression.cc:?).  Pushed gradients are
+        quantized (with per-key residual) before aggregation, so training
+        sees exactly what the compressed dist path would deliver."""
+        from . import gradient_compression as gc
+
+        self._compression = gc.create(compression_params)
+        self._residuals = {}
 
     # -- state persistence ---------------------------------------------------
     def save_optimizer_states(self, fname, dump_optimizer=False):

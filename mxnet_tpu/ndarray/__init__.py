@@ -23,6 +23,7 @@ from ..ops.attention import *  # noqa: F401,F403
 from ..ops.output_ops import *  # noqa: F401,F403
 from ..ops.contrib import *  # noqa: F401,F403  (legacy top-level names)
 from ..ops.quantization import *  # noqa: F401,F403
+from ..operator import custom as Custom  # noqa: F401  (mx.nd.Custom)
 from . import contrib  # noqa: F401  (mx.nd.contrib namespace)
 from ..ops import registry as _registry
 

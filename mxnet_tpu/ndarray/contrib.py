@@ -14,7 +14,8 @@ from ..ops.tensor import boolean_mask  # noqa: F401
 from ..ops.attention import (  # noqa: F401
     div_sqrt_dim, interleaved_matmul_selfatt_qk,
     interleaved_matmul_selfatt_valatt)
+from ..ops.control_flow import foreach, while_loop, cond  # noqa: F401
 
 __all__ = list(_contrib_all) + [
     "boolean_mask", "div_sqrt_dim", "interleaved_matmul_selfatt_qk",
-    "interleaved_matmul_selfatt_valatt"]
+    "interleaved_matmul_selfatt_valatt", "foreach", "while_loop", "cond"]

@@ -1,0 +1,200 @@
+"""Custom operators defined in Python.
+
+Reference: ``python/mxnet/operator.py:?`` + ``src/operator/custom/
+custom.cc:?`` (SURVEY §2.2 custom-op row) — users subclass ``CustomOp``
+(forward/backward with ``self.assign``) and ``CustomOpProp`` (shape/type
+inference), register with ``@mx.operator.register("name")`` and invoke via
+``mx.nd.Custom(..., op_type="name")``.  The reference runs these on a
+dedicated thread pool outside the engine.
+
+TPU-native: imperatively the python code just runs (and wires an autograd
+tape node whose backward calls the user's ``backward``).  Inside a traced/
+jitted graph the op becomes a ``jax.pure_callback`` — host python embedded
+in the XLA program, the analog of the reference's engine callback into the
+interpreter — with a ``jax.custom_vjp`` routing gradients through a second
+callback.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .base import MXNetError
+
+_REGISTRY = {}
+
+
+class CustomOp:
+    """Base class for user ops (reference ``mx.operator.CustomOp``)."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise NotImplementedError
+
+    def assign(self, dst, req, src):
+        """Honour the write-request mode (reference semantics)."""
+        if req in ("null",):
+            return
+        from .ndarray import NDArray
+
+        s = src if isinstance(src, NDArray) else NDArray(src)
+        if req == "add":
+            dst._data = dst._data + s._data.astype(dst.dtype)
+        else:  # write / inplace
+            dst._data = s._data.astype(dst.dtype)
+
+
+class CustomOpProp:
+    """Op metadata + factory (reference ``mx.operator.CustomOpProp``)."""
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def list_auxiliary_states(self):
+        return []
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]] * len(self.list_outputs()), []
+
+    def infer_type(self, in_type):
+        return in_type, [in_type[0]] * len(self.list_outputs()), \
+            [in_type[0]] * len(self.list_auxiliary_states())
+
+    def infer_storage_type(self, in_stype):
+        return in_stype, ["default"] * len(self.list_outputs()), []
+
+    def declare_backward_dependency(self, out_grad, in_data, out_data):
+        return list(out_grad) + list(in_data) + list(out_data)
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        raise NotImplementedError
+
+    def need_top_grad(self):
+        return self.need_top_grad_
+
+
+def register(reg_name):
+    """Decorator registering a ``CustomOpProp`` subclass (reference
+    ``mx.operator.register``)."""
+
+    def deco(prop_cls):
+        if not issubclass(prop_cls, CustomOpProp):
+            raise MXNetError("register() expects a CustomOpProp subclass")
+        _REGISTRY[reg_name] = prop_cls
+        return prop_cls
+
+    return deco
+
+
+def get_all_registered_operators():
+    return sorted(_REGISTRY)
+
+
+def _make_prop(op_type, kwargs):
+    if op_type not in _REGISTRY:
+        raise MXNetError(
+            f"custom op {op_type!r} is not registered; known: "
+            f"{sorted(_REGISTRY)}")
+    str_kwargs = {k: str(v) for k, v in kwargs.items()}
+    return _REGISTRY[op_type](**str_kwargs)
+
+
+def custom(*data, op_type=None, **kwargs):
+    """``mx.nd.Custom`` (reference ``c_api custom`` dispatch)."""
+    import jax
+
+    from . import autograd as ag
+    from .context import current_context
+    from .ndarray import NDArray
+
+    if op_type is None:
+        raise MXNetError("Custom requires op_type=")
+    prop = _make_prop(op_type, kwargs)
+    in_shapes = [list(d.shape) for d in data]
+    _, out_shapes, _aux_shapes = prop.infer_shape(in_shapes)
+    in_types = [d.dtype for d in data]
+    _, out_types, _ = prop.infer_type(in_types)
+    op = prop.create_operator(current_context(), in_shapes, in_types)
+    n_out = len(prop.list_outputs())
+
+    traced = any(isinstance(d._data, jax.core.Tracer) for d in data)
+    if traced:
+        return _traced_custom(op, prop, data, out_shapes, out_types, n_out)
+
+    out_data = [NDArray(np.zeros(tuple(s), np.dtype(t)))
+                for s, t in zip(out_shapes, out_types)]
+    is_train = ag.is_recording()
+    op.forward(is_train, ["write"] * n_out, list(data), out_data, [])
+    if is_train and any(getattr(d, "_req_grad", False) or
+                        d._node is not None for d in data):
+        def vjp(cots):
+            cots = (cots,) if not isinstance(cots, (tuple, list)) else cots
+            out_grads = [NDArray(c) for c in cots]
+            in_grads = [NDArray(np.zeros(tuple(s), np.dtype(t)))
+                        for s, t in zip(in_shapes, in_types)]
+            op.backward(["write"] * len(data), out_grads, list(data),
+                        out_data, in_grads, [])
+            return tuple(g._data for g in in_grads)
+
+        node = ag.Node(vjp, list(data),
+                       [(o.shape, o.dtype) for o in out_data],
+                       name=f"custom_{op_type}", single=False)
+        for i, o in enumerate(out_data):
+            o._node = node
+            o._oidx = i
+    return out_data[0] if n_out == 1 else tuple(out_data)
+
+
+def _traced_custom(op, prop, data, out_shapes, out_types, n_out):
+    """Inside a jit/hybridize trace: pure_callback + custom_vjp."""
+    import jax
+    import jax.numpy as jnp
+
+    from .ndarray import NDArray
+
+    out_struct = tuple(jax.ShapeDtypeStruct(tuple(s), np.dtype(t))
+                       for s, t in zip(out_shapes, out_types))
+    in_struct = tuple(jax.ShapeDtypeStruct(d.shape, d.dtype) for d in data)
+
+    def host_fwd(*raws):
+        ins = [NDArray(np.asarray(r)) for r in raws]
+        outs = [NDArray(np.zeros(s.shape, s.dtype)) for s in out_struct]
+        op.forward(True, ["write"] * n_out, ins, outs, [])
+        return tuple(np.asarray(o._data) for o in outs)
+
+    def host_bwd(*raws):
+        k = len(data)
+        ins = [NDArray(np.asarray(r)) for r in raws[:k]]
+        cots = [NDArray(np.asarray(r)) for r in raws[k:k + n_out]]
+        outs = [NDArray(np.asarray(r)) for r in raws[k + n_out:]]
+        in_grads = [NDArray(np.zeros(s.shape, s.dtype)) for s in in_struct]
+        op.backward(["write"] * k, cots, ins, outs, in_grads, [])
+        return tuple(np.asarray(g._data) for g in in_grads)
+
+    @jax.custom_vjp
+    def fn(*raws):
+        return jax.pure_callback(host_fwd, out_struct, *raws)
+
+    def fwd(*raws):
+        outs = jax.pure_callback(host_fwd, out_struct, *raws)
+        return outs, (raws, outs)
+
+    def bwd(res, cots):
+        raws, outs = res
+        gin = jax.pure_callback(host_bwd, in_struct, *raws, *cots, *outs)
+        return tuple(gin)
+
+    fn.defvjp(fwd, bwd)
+    from .ops.registry import apply_op
+
+    if n_out == 1:
+        return apply_op(lambda *rs: fn(*rs)[0], *data,
+                        name="custom_traced")
+    return apply_op(lambda *rs: fn(*rs), *data, name="custom_traced")

@@ -1,0 +1,189 @@
+"""Control-flow operators: foreach / while_loop / cond.
+
+Reference: ``src/operator/control_flow.cc:?`` (SURVEY §2.2 tensor row
+[med]) + python frontends ``python/mxnet/ndarray/contrib.py:?`` and
+``symbol/contrib.py:?`` — subgraph-based loop ops so RNN-style iteration
+lives inside the executor graph.
+
+TPU-native: imperative calls run plain python loops (each body op lands on
+the autograd tape, so ``backward()`` just works).  Inside a jit/hybridize
+trace the SAME functions lower to ``lax.scan`` / ``lax.while_loop`` /
+``lax.cond`` — XLA keeps the loop on-device as a rolled loop, which is the
+whole reason the reference built subgraph ops instead of python loops.
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+
+
+def _is_traced(*nds):
+    import jax
+
+    for x in nds:
+        if x is None:
+            continue
+        if isinstance(getattr(x, "_data", None), jax.core.Tracer):
+            return True
+    return False
+
+
+def _wrap(raw):
+    from ..ndarray import NDArray
+
+    return NDArray(raw)
+
+
+def _unwrap(x):
+    return x._data
+
+
+def _aslist(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+def foreach(body, data, init_states, name="foreach"):
+    """Reference ``mx.nd.contrib.foreach``: scan ``body(slice, states) →
+    (outputs, states)`` over axis 0 of ``data``.  Returns (outputs stacked
+    on axis 0, final states)."""
+    from ..ndarray import stack as nd_stack
+
+    data_list = _aslist(data)
+    states = _aslist(init_states)
+    single_data = not isinstance(data, (list, tuple))
+    single_state = not isinstance(init_states, (list, tuple))
+
+    if _is_traced(*data_list, *states):
+        import jax
+        from jax import lax
+
+        def scan_body(carry, xs):
+            sts = [_wrap(c) for c in carry]
+            sl = [_wrap(x) for x in xs]
+            out, new_sts = body(sl[0] if single_data else sl,
+                                sts[0] if single_state else sts)
+            out_l = _aslist(out)
+            new_l = _aslist(new_sts)
+            return tuple(_unwrap(s) for s in new_l), \
+                tuple(_unwrap(o) for o in out_l)
+
+        carry0 = tuple(_unwrap(s) for s in states)
+        xs = tuple(_unwrap(d) for d in data_list)
+        final, outs = lax.scan(scan_body, carry0, xs)
+        outs = [_wrap(o) for o in outs]
+        final = [_wrap(f) for f in final]
+        single_out = len(outs) == 1
+        return (outs[0] if single_out else outs), \
+            (final[0] if single_state and final else final)
+
+    n = data_list[0].shape[0]
+    outputs = None
+    cur = init_states
+    for i in range(n):
+        sl = [d[i] for d in data_list]
+        out, cur = body(sl[0] if single_data else sl, cur)
+        out_l = _aslist(out)
+        if outputs is None:
+            outputs = [[] for _ in out_l]
+        for buf, o in zip(outputs, out_l):
+            buf.append(o)
+    stacked = [nd_stack(*buf, axis=0) for buf in (outputs or [])]
+    single_out = len(stacked) == 1
+    return (stacked[0] if single_out else stacked), cur
+
+
+def while_loop(cond, func, loop_vars, max_iterations=None,
+               name="while_loop"):
+    """Reference ``mx.nd.contrib.while_loop``: iterate ``func(*loop_vars)
+    → (step_outputs, new_loop_vars)`` while ``cond(*loop_vars)`` is true.
+    Step outputs are stacked into ``max_iterations``-row buffers (rows
+    beyond the actual iteration count are zeros — reference contract)."""
+    from ..ndarray import stack as nd_stack, zeros_like
+
+    if max_iterations is None:
+        raise MXNetError("while_loop requires max_iterations")
+    lv = _aslist(loop_vars)
+    single_var = not isinstance(loop_vars, (list, tuple))
+
+    if _is_traced(*lv):
+        import jax.numpy as jnp
+        from jax import lax
+
+        # probe one step to learn step-output structure
+        probe_out, _probe_vars = func(*lv)
+        probe_l = _aslist(probe_out)
+
+        def body_fn(state):
+            i, vars_raw, bufs = state
+            vs = [_wrap(v) for v in vars_raw]
+            outs, new_vars = func(*vs)
+            outs_l = _aslist(outs)
+            new_l = _aslist(new_vars)
+            bufs = tuple(b.at[i].set(_unwrap(o))
+                         for b, o in zip(bufs, outs_l))
+            return i + 1, tuple(_unwrap(v) for v in new_l), bufs
+
+        def cond_fn(state):
+            i, vars_raw, _ = state
+            vs = [_wrap(v) for v in vars_raw]
+            c = cond(*vs)
+            return (_unwrap(c).astype(bool).reshape(())) & \
+                (i < max_iterations)
+
+        bufs0 = tuple(jnp.zeros((max_iterations,) + o.shape, o.dtype)
+                      for o in probe_l)
+        state0 = (jnp.asarray(0), tuple(_unwrap(v) for v in lv), bufs0)
+        _i, final_vars, bufs = lax.while_loop(cond_fn, body_fn, state0)
+        outs = [_wrap(b) for b in bufs]
+        fv = [_wrap(v) for v in final_vars]
+        return (outs[0] if len(outs) == 1 else outs), \
+            (fv[0] if single_var else fv)
+
+    steps = []
+    cur = lv
+    it = 0
+    while it < max_iterations and bool(cond(*cur).asscalar()):
+        outs, new_vars = func(*cur)
+        steps.append(_aslist(outs))
+        cur = _aslist(new_vars)
+        it += 1
+    if not steps:
+        # zero iterations: probe shapes (discarding state) so imperative
+        # matches the traced path's zero-filled buffers
+        probe_out, _ = func(*cur)
+        steps_shapes = _aslist(probe_out)
+        zero_rows = [zeros_like(o) for o in steps_shapes]
+        stacked = [nd_stack(*([z] * max_iterations), axis=0)
+                   for z in zero_rows]
+        n_out = len(stacked)
+        return (stacked[0] if n_out == 1 else stacked), \
+            (cur[0] if single_var else cur)
+    n_out = len(steps[0])
+    stacked = []
+    for j in range(n_out):
+        rows = [s[j] for s in steps]
+        pad = [zeros_like(rows[0]) for _ in range(max_iterations - it)]
+        stacked.append(nd_stack(*(rows + pad), axis=0))
+    return (stacked[0] if n_out == 1 else stacked), \
+        (cur[0] if single_var else cur)
+
+
+def cond(pred, then_func, else_func, name="cond"):
+    """Reference ``mx.nd.contrib.cond``: run one of two branches."""
+    if _is_traced(pred):
+        import jax.numpy as jnp
+        from jax import lax
+
+        def _then():
+            return tuple(_unwrap(o) for o in _aslist(then_func()))
+
+        def _else():
+            return tuple(_unwrap(o) for o in _aslist(else_func()))
+
+        p = _unwrap(pred).astype(bool).reshape(())
+        outs = lax.cond(p, _then, _else)
+        outs = [_wrap(o) for o in outs]
+        return outs[0] if len(outs) == 1 else outs
+    branch = then_func if bool(pred.asscalar()) else else_func
+    return branch()

@@ -27,7 +27,6 @@ from .registry import apply_op, make_exporter
 _this = sys.modules[__name__]
 _export = make_exporter(_this)
 
-_QMIN = {"int8": -127.0, "uint8": 0.0, "int32": -(2.0 ** 31 - 1)}
 _QMAX = {"int8": 127.0, "uint8": 255.0, "int32": 2.0 ** 31 - 1}
 
 
@@ -41,20 +40,23 @@ def _scale(mn, mx, out_type):
     return _QMAX[out_type] / amax
 
 
+def _quantize_body(x, mn, mx, out_type):
+    """Shared quantize kernel: uint8 affine / int8 symmetric."""
+    s = _scale(mn, mx, out_type)
+    if out_type == "uint8":
+        q = jnp.clip(jnp.round((x - mn) * s), 0, 255).astype(jnp.uint8)
+        return q, mn, mx
+    q = jnp.clip(jnp.round(x * s), -127, 127).astype(jnp.int8)
+    amax = jnp.maximum(jnp.abs(mn), jnp.abs(mx))
+    return q, -amax, amax
+
+
 def quantize(data, min_range, max_range, out_type="uint8", **kwargs):
     """Reference ``_contrib_quantize``: float → quantized with given
     range.  Returns (q, min, max)."""
-
-    def _f(x, mn, mx):
-        s = _scale(mn, mx, out_type)
-        if out_type == "uint8":
-            q = jnp.clip(jnp.round((x - mn) * s), 0, 255).astype(jnp.uint8)
-            return q, mn, mx
-        q = jnp.clip(jnp.round(x * s), -127, 127).astype(jnp.int8)
-        amax = jnp.maximum(jnp.abs(mn), jnp.abs(mx))
-        return q, -amax, amax
-
-    return apply_op(_f, data, min_range, max_range, name="quantize")
+    return apply_op(
+        lambda x, mn, mx: _quantize_body(x, mn, mx, out_type),
+        data, min_range, max_range, name="quantize")
 
 
 _export(quantize, aliases=("_contrib_quantize",))
@@ -72,13 +74,7 @@ def quantize_v2(data, out_type="int8", min_calib_range=None,
         else:
             mn = x.min().astype(jnp.float32)
             mx = x.max().astype(jnp.float32)
-        s = _scale(mn, mx, out_type)
-        if out_type == "uint8":
-            q = jnp.clip(jnp.round((x - mn) * s), 0, 255).astype(jnp.uint8)
-            return q, mn, mx
-        q = jnp.clip(jnp.round(x * s), -127, 127).astype(jnp.int8)
-        amax = jnp.maximum(jnp.abs(mn), jnp.abs(mx))
-        return q, -amax, amax
+        return _quantize_body(x, mn, mx, out_type)
 
     return apply_op(_f, data, name="quantize_v2")
 
@@ -159,13 +155,15 @@ def quantized_fully_connected(*args, num_hidden=0, no_bias=False,
             colsum = w8.sum(axis=1).astype(jnp.float32)
             real = acc.astype(jnp.float32) / (sd * sw) \
                 + colsum * (128.0 / (sd * sw) + mnd / sw)
+            if b is not None:
+                # bias contract: int8 units in the sd*sw accumulator scale
+                # (same as the int8 path below)
+                real = real + b.astype(jnp.float32) / (sd * sw)
             # re-express as int32 + symmetric range so (out,min,max)
             # contract matches the int8 path
             amax = jnp.maximum(jnp.abs(real).max(), 1e-12)
             oscale = _QMAX["int32"] / amax
             out = jnp.round(real * oscale).astype(jnp.int32)
-            if b is not None:
-                out = out + b.astype(jnp.int32)
             return out, -amax, amax
         sd = _scale(mnd, mxd, "int8")
         out = lax.dot_general(

@@ -1,0 +1,182 @@
+"""CustomOp + control-flow op tests (reference model:
+tests/python/unittest/test_operator.py::test_custom_op and
+test_contrib_control_flow.py, SURVEY §4)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+
+
+# --- CustomOp ---------------------------------------------------------------
+
+class Sigmoid(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        x = in_data[0].asnumpy()
+        y = 1.0 / (1.0 + onp.exp(-x))
+        self.assign(out_data[0], req[0], mx.nd.array(y))
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        y = out_data[0].asnumpy()
+        gy = out_grad[0].asnumpy()
+        self.assign(in_grad[0], req[0], mx.nd.array(gy * y * (1 - y)))
+
+
+@mx.operator.register("test_sigmoid")
+class SigmoidProp(mx.operator.CustomOpProp):
+    def __init__(self):
+        super().__init__(need_top_grad=True)
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return Sigmoid()
+
+
+def test_custom_op_forward_backward():
+    x = nd.array([0.0, 1.0, -1.0])
+    x.attach_grad()
+    with autograd.record():
+        y = nd.Custom(x, op_type="test_sigmoid")
+        loss = y.sum()
+    loss.backward()
+    expect = 1 / (1 + onp.exp(-x.asnumpy()))
+    onp.testing.assert_allclose(y.asnumpy(), expect, rtol=1e-6)
+    onp.testing.assert_allclose(x.grad.asnumpy(), expect * (1 - expect),
+                                rtol=1e-5)
+
+
+def test_custom_op_registry():
+    assert "test_sigmoid" in mx.operator.get_all_registered_operators()
+    with pytest.raises(mx.MXNetError):
+        nd.Custom(nd.ones((2,)), op_type="not_registered")
+
+
+def test_custom_op_in_jit():
+    import jax
+
+    def step(raw):
+        x = nd.NDArray(raw)
+        return nd.Custom(x, op_type="test_sigmoid")._data
+
+    out = jax.jit(step)(nd.array([0.0, 2.0])._data)
+    onp.testing.assert_allclose(
+        onp.asarray(out), 1 / (1 + onp.exp(-onp.array([0.0, 2.0]))),
+        rtol=1e-6)
+
+
+def test_custom_op_grad_in_jit():
+    import jax
+
+    def loss_fn(raw):
+        x = nd.NDArray(raw)
+        y = nd.Custom(x, op_type="test_sigmoid")
+        return y._data.sum()
+
+    g = jax.grad(loss_fn)(nd.array([0.5, -0.5])._data)
+    s = 1 / (1 + onp.exp(-onp.array([0.5, -0.5])))
+    onp.testing.assert_allclose(onp.asarray(g), s * (1 - s), rtol=1e-5)
+
+
+# --- control flow -----------------------------------------------------------
+
+def test_foreach_eager():
+    data = nd.array(onp.arange(6, dtype=onp.float32).reshape(3, 2))
+    init = nd.zeros((2,))
+
+    def body(x, state):
+        new = state + x
+        return new * 2, new
+
+    outs, final = nd.contrib.foreach(body, data, init)
+    assert outs.shape == (3, 2)
+    # state accumulates rows: [0,1], [2,4], [6,9] → outputs are 2x
+    onp.testing.assert_allclose(final.asnumpy(), [6, 9])
+    onp.testing.assert_allclose(outs.asnumpy()[-1], [12, 18])
+
+
+def test_foreach_grad():
+    data = nd.array([[1.0], [2.0], [3.0]])
+    data.attach_grad()
+    init = nd.zeros((1,))
+    with autograd.record():
+        outs, final = nd.contrib.foreach(
+            lambda x, s: (x * x, s + x), data, init)
+        loss = outs.sum()
+    loss.backward()
+    onp.testing.assert_allclose(data.grad.asnumpy(), [[2.0], [4.0], [6.0]])
+
+
+def test_foreach_traced():
+    import jax
+
+    def step(raw):
+        data = nd.NDArray(raw)
+        init = nd.NDArray(raw[0] * 0)
+        outs, final = nd.contrib.foreach(
+            lambda x, s: (x + s, s + x), data, init)
+        return outs._data
+
+    raw = nd.array([[1.0], [2.0], [3.0]])._data
+    out = jax.jit(step)(raw)
+    onp.testing.assert_allclose(onp.asarray(out), [[1.0], [3.0], [6.0]])
+
+
+def test_while_loop_eager():
+    # sum integers until total >= 10, max 20 iters
+    def cond_fn(i, total):
+        return total < 10
+
+    def body_fn(i, total):
+        return i, (i + 1, total + i)
+
+    outs, (fi, ftotal) = nd.contrib.while_loop(
+        cond_fn, body_fn, (nd.array([1.0]), nd.array([0.0])),
+        max_iterations=20)
+    # 1+2+3+4 = 10 → 4 iterations
+    assert float(ftotal.asscalar()) == 10.0
+    assert outs.shape == (20, 1)
+    onp.testing.assert_allclose(outs.asnumpy()[:4, 0], [1, 2, 3, 4])
+    assert onp.all(outs.asnumpy()[4:] == 0)  # padded rows
+
+
+def test_while_loop_traced():
+    import jax
+
+    def step(raw):
+        i0 = nd.NDArray(raw)
+        t0 = nd.NDArray(raw * 0)
+        outs, fv = nd.contrib.while_loop(
+            lambda i, t: t < 10, lambda i, t: (i, (i + 1, t + i)),
+            (i0, t0), max_iterations=20)
+        return fv[1]._data
+
+    out = jax.jit(step)(nd.array([1.0])._data)
+    assert float(out[0]) == 10.0
+
+
+def test_cond():
+    x = nd.array([2.0])
+    out = nd.contrib.cond(x.sum() > 1, lambda: x * 10, lambda: x - 1)
+    assert float(out.asscalar()) == 20.0
+    out = nd.contrib.cond(x.sum() > 5, lambda: x * 10, lambda: x - 1)
+    assert float(out.asscalar()) == 1.0
+
+
+def test_cond_traced():
+    import jax
+
+    def step(raw):
+        x = nd.NDArray(raw)
+        return nd.contrib.cond(x.sum() > 1, lambda: x * 10,
+                               lambda: x - 1)._data
+
+    assert float(jax.jit(step)(nd.array([2.0])._data)[0]) == 20.0
+    assert float(jax.jit(step)(nd.array([0.5])._data)[0]) == -0.5

@@ -1,0 +1,89 @@
+"""Gradient compression tests (reference model:
+tests/python/unittest/test_kvstore.py gradient compression cases +
+tests/nightly/dist_sync_kvstore.py compressed rounds, SURVEY §4)."""
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.kvstore import gradient_compression as gc
+
+
+def test_compress_decompress_values():
+    comp = gc.GradientCompression(threshold=0.5)
+    g = nd.array([0.6, -0.7, 0.1, 0.0, -0.2, 1.5])
+    packed, res = comp.compress(g)
+    assert packed.dtype == onp.uint32
+    assert packed.shape == (1,)  # 6 codes pack into one word
+    out = comp.decompress(packed, (6,)).asnumpy()
+    onp.testing.assert_allclose(out, [0.5, -0.5, 0, 0, 0, 0.5])
+    # residual keeps what wasn't sent
+    onp.testing.assert_allclose(res.asnumpy(),
+                                [0.1, -0.2, 0.1, 0, -0.2, 1.0], atol=1e-6)
+
+
+def test_error_feedback_accumulates():
+    comp = gc.GradientCompression(threshold=0.5)
+    g = nd.array([0.3])
+    out1, res = comp.roundtrip(g)
+    assert out1.asnumpy()[0] == 0.0          # below threshold: nothing sent
+    out2, res = comp.roundtrip(g, res)
+    assert out2.asnumpy()[0] == 0.5          # residual pushed it over
+    onp.testing.assert_allclose(res.asnumpy(), [0.1], atol=1e-6)
+
+
+def test_wire_size_16x():
+    comp = gc.GradientCompression()
+    g = nd.random.uniform(-1, 1, shape=(1024,))
+    packed, _ = comp.compress(g)
+    assert packed.shape == (64,)  # 1024 / 16
+
+
+def test_kvstore_compressed_push():
+    kv = mx.kv.create("local")
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    kv.init(3, nd.zeros((4,)))
+    kv.push(3, nd.array([1.0, -1.0, 0.2, 0.0]))
+    out = nd.zeros((4,))
+    kv.pull(3, out=out)
+    onp.testing.assert_allclose(out.asnumpy(), [0.5, -0.5, 0.0, 0.0])
+    # second push: residuals (0.5, -0.5, 0.2) carry forward
+    kv.push(3, nd.array([0.0, 0.0, 0.2, 0.0]))
+    kv.pull(3, out=out)
+    onp.testing.assert_allclose(out.asnumpy(), [0.5, -0.5, 0.0, 0.0])
+
+
+def test_trainer_with_compression_converges():
+    from mxnet_tpu import autograd, gluon
+    from mxnet_tpu.gluon import nn
+
+    net = nn.Dense(1, in_units=4)
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(
+        net.collect_params(), "sgd", {"learning_rate": 0.2},
+        compression_params={"type": "2bit", "threshold": 0.1})
+    x = nd.random.uniform(-1, 1, shape=(64, 4))
+    w_true = nd.array([[0.8, -0.6, 0.5, 0.7]])
+    y = nd.dot(x, nd.transpose(w_true))
+    first, best = None, float("inf")
+    # fixed ±threshold kicks oscillate near the optimum (inherent to the
+    # reference algorithm), so assert on the best loss along the way
+    for i in range(100):
+        with autograd.record():
+            loss = ((net(x) - y) ** 2).mean()
+        loss.backward()
+        trainer.step(1)
+        cur = float(loss.asscalar())
+        first = first if first is not None else cur
+        best = min(best, cur)
+    assert best < first * 0.1
+
+
+def test_bad_params_rejected():
+    import pytest
+
+    with pytest.raises(mx.MXNetError):
+        gc.create({"type": "1bit"})
+    with pytest.raises(mx.MXNetError):
+        gc.create({"type": "2bit", "bogus": 1})
+    with pytest.raises(mx.MXNetError):
+        gc.GradientCompression(threshold=-1)
