@@ -35,8 +35,14 @@ def _collect_ranges(sym, arg_params, aux_params, calib_data, data_names,
 
     stats = {}      # name -> [min, max]
     hists = {}      # name -> (hist, edges)
+    # only quantizable nodes' first inputs are ever consumed as '_input0'
+    # keys — skip everything else (weights repeat identically per batch)
+    want_inputs = {f"{n.name}_input0" for n in sym._topo()
+                   if n.op in _QUANTIZABLE}
 
     def cb(name, arr):
+        if "_input" in name and name not in want_inputs:
+            return
         a = arr.asnumpy()
         mn, mx = float(a.min()), float(a.max())
         if name in stats:

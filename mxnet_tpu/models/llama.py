@@ -1,0 +1,336 @@
+"""Llama model family (stretch config 5 in BASELINE.md).
+
+Reference: NONE — the reference predates Llama (SURVEY §5 long-context:
+ABSENT).  This is new capability, built the way the reference's GluonNLP
+zoo would have shipped it: config-driven Gluon HybridBlocks, so a stock
+``gluon.Trainer`` trains it and ``hybridize()`` compiles one XLA program.
+
+TPU-first design:
+- attention runs the Pallas flash kernel (ops/flash_attention.py) when on
+  TPU — O(T·D) HBM traffic; ring/Ulysses sequence parallelism plugs in via
+  ``attn_mode`` for long context (parallel/ring.py over the ICI mesh);
+- GQA: KV heads repeated at compute time (bf16-friendly, keeps the KV
+  projection narrow the way Llama-3 does);
+- RoPE is precomputed per (T, D) and baked into the trace as constants;
+- weights are all ``use_bias=False`` Dense layers → pure MXU matmuls, and
+  ``shard_llama`` annotates tp/dp shardings for pjit (megatron-style
+  column/row split pairs).
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from ..base import MXNetError
+from ..gluon.block import HybridBlock
+from ..gluon import nn
+
+__all__ = ["LlamaConfig", "RMSNorm", "LlamaAttention", "LlamaMLP",
+           "LlamaDecoderLayer", "LlamaModel", "LlamaForCausalLM",
+           "llama3_8b", "llama_tiny", "shard_llama", "LLAMA_CONFIGS"]
+
+
+class LlamaConfig:
+    def __init__(self, hidden_size=4096, intermediate_size=14336,
+                 num_layers=32, num_heads=32, num_kv_heads=8,
+                 vocab_size=128256, max_seq_len=8192, rope_theta=500000.0,
+                 rms_eps=1e-5, tie_embeddings=False, attn_mode="flash"):
+        self.hidden_size = hidden_size
+        self.intermediate_size = intermediate_size
+        self.num_layers = num_layers
+        self.num_heads = num_heads
+        self.num_kv_heads = num_kv_heads
+        self.vocab_size = vocab_size
+        self.max_seq_len = max_seq_len
+        self.rope_theta = rope_theta
+        self.rms_eps = rms_eps
+        self.tie_embeddings = tie_embeddings
+        self.attn_mode = attn_mode  # flash | sdpa | ring | ulysses
+        if hidden_size % num_heads:
+            raise MXNetError("hidden_size must divide num_heads")
+        if num_heads % num_kv_heads:
+            raise MXNetError("num_heads must divide num_kv_heads")
+        self.head_dim = hidden_size // num_heads
+
+
+LLAMA_CONFIGS = {
+    "llama3_8b": dict(hidden_size=4096, intermediate_size=14336,
+                      num_layers=32, num_heads=32, num_kv_heads=8,
+                      vocab_size=128256, rope_theta=500000.0),
+    "llama_tiny": dict(hidden_size=64, intermediate_size=176,
+                       num_layers=2, num_heads=4, num_kv_heads=2,
+                       vocab_size=256, max_seq_len=128),
+}
+
+
+class RMSNorm(HybridBlock):
+    """Root-mean-square LayerNorm (no mean subtraction, no bias); stats in
+    fp32 even under bf16 params."""
+
+    def __init__(self, units, eps=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self._eps = eps
+        with self.name_scope():
+            self.weight = self.params.get("weight", shape=(units,),
+                                          init="ones")
+
+    def hybrid_forward(self, F, x, weight):
+        from ..ops.registry import apply_op
+        import jax.numpy as jnp
+
+        def _f(xr, wr):
+            xf = xr.astype(jnp.float32)
+            var = (xf * xf).mean(axis=-1, keepdims=True)
+            out = xf / jnp.sqrt(var + self._eps)
+            return (out * wr.astype(jnp.float32)).astype(xr.dtype)
+
+        return apply_op(_f, x, weight, name="rms_norm")
+
+
+def _rope_tables(t, head_dim, theta):
+    """cos/sin tables (T, head_dim/2) — compile-time constants."""
+    inv = 1.0 / (theta ** (np.arange(0, head_dim, 2,
+                                     dtype=np.float64) / head_dim))
+    pos = np.arange(t, dtype=np.float64)
+    ang = np.outer(pos, inv)
+    return (np.cos(ang).astype(np.float32),
+            np.sin(ang).astype(np.float32))
+
+
+def _apply_rope(x, cos, sin):
+    """x (B, H, T, D) with D even; rotate pairs (x[..., ::2], x[..., 1::2])."""
+    import jax.numpy as jnp
+
+    x1 = x[..., ::2]
+    x2 = x[..., 1::2]
+    xr1 = x1 * cos - x2 * sin
+    xr2 = x1 * sin + x2 * cos
+    out = jnp.stack([xr1, xr2], axis=-1).reshape(x.shape)
+    return out.astype(x.dtype)
+
+
+class LlamaAttention(HybridBlock):
+    """GQA self-attention with RoPE + flash kernel."""
+
+    def __init__(self, cfg: LlamaConfig, **kwargs):
+        super().__init__(**kwargs)
+        self._cfg = cfg
+        hd = cfg.head_dim
+        with self.name_scope():
+            self.q_proj = nn.Dense(cfg.num_heads * hd, use_bias=False,
+                                   flatten=False, in_units=cfg.hidden_size,
+                                   prefix="q_")
+            self.k_proj = nn.Dense(cfg.num_kv_heads * hd, use_bias=False,
+                                   flatten=False, in_units=cfg.hidden_size,
+                                   prefix="k_")
+            self.v_proj = nn.Dense(cfg.num_kv_heads * hd, use_bias=False,
+                                   flatten=False, in_units=cfg.hidden_size,
+                                   prefix="v_")
+            self.o_proj = nn.Dense(cfg.hidden_size, use_bias=False,
+                                   flatten=False,
+                                   in_units=cfg.num_heads * hd, prefix="o_")
+        self._rope_cache = {}
+
+    def _rope(self, t):
+        if t not in self._rope_cache:
+            import jax.numpy as jnp
+
+            cos, sin = _rope_tables(t, self._cfg.head_dim,
+                                    self._cfg.rope_theta)
+            self._rope_cache[t] = (jnp.asarray(cos), jnp.asarray(sin))
+        return self._rope_cache[t]
+
+    def hybrid_forward(self, F, x, **params):
+        from ..ops.registry import apply_op
+
+        cfg = self._cfg
+        b, t = x.shape[0], x.shape[1]
+        q = self.q_proj(x)
+        k = self.k_proj(x)
+        v = self.v_proj(x)
+        cos, sin = self._rope(t)
+
+        def _attend(qr, kr, vr):
+            import jax.numpy as jnp
+
+            hd = cfg.head_dim
+            qh = qr.reshape(b, t, cfg.num_heads, hd).transpose(0, 2, 1, 3)
+            kh = kr.reshape(b, t, cfg.num_kv_heads, hd) \
+                .transpose(0, 2, 1, 3)
+            vh = vr.reshape(b, t, cfg.num_kv_heads, hd) \
+                .transpose(0, 2, 1, 3)
+            qh = _apply_rope(qh, cos[None, None], sin[None, None])
+            kh = _apply_rope(kh, cos[None, None], sin[None, None])
+            rep = cfg.num_heads // cfg.num_kv_heads
+            if rep > 1:
+                kh = jnp.repeat(kh, rep, axis=1)
+                vh = jnp.repeat(vh, rep, axis=1)
+            if cfg.attn_mode in ("ring", "ulysses"):
+                from ..parallel import ring as _ring
+
+                fn = (_ring.ring_attention_raw
+                      if cfg.attn_mode == "ring"
+                      else _ring.ulysses_attention_raw)
+                out = fn(qh, kh, vh, causal=True,
+                         scale=1.0 / math.sqrt(hd))
+            elif cfg.attn_mode == "flash":
+                from ..ops.flash_attention import flash_attention_raw
+
+                out = flash_attention_raw(qh, kh, vh, True,
+                                          1.0 / math.sqrt(hd))
+            else:
+                from ..ops.flash_attention import _sdpa_ref
+
+                out = _sdpa_ref(qh, kh, vh, True, 1.0 / math.sqrt(hd))
+            return out.transpose(0, 2, 1, 3).reshape(b, t, -1)
+
+        ctx = apply_op(_attend, q, k, v, name="llama_attention")
+        return self.o_proj(ctx)
+
+
+class LlamaMLP(HybridBlock):
+    """SwiGLU feed-forward: down(silu(gate(x)) * up(x))."""
+
+    def __init__(self, cfg: LlamaConfig, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.gate_proj = nn.Dense(cfg.intermediate_size, use_bias=False,
+                                      flatten=False,
+                                      in_units=cfg.hidden_size,
+                                      prefix="gate_")
+            self.up_proj = nn.Dense(cfg.intermediate_size, use_bias=False,
+                                    flatten=False, in_units=cfg.hidden_size,
+                                    prefix="up_")
+            self.down_proj = nn.Dense(cfg.hidden_size, use_bias=False,
+                                      flatten=False,
+                                      in_units=cfg.intermediate_size,
+                                      prefix="down_")
+
+    def hybrid_forward(self, F, x):
+        g = self.gate_proj(x)
+        return self.down_proj(g * F.sigmoid(g) * self.up_proj(x))
+
+
+class LlamaDecoderLayer(HybridBlock):
+    def __init__(self, cfg: LlamaConfig, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.input_layernorm = RMSNorm(cfg.hidden_size, cfg.rms_eps,
+                                           prefix="ln_in_")
+            self.self_attn = LlamaAttention(cfg, prefix="attn_")
+            self.post_attention_layernorm = RMSNorm(
+                cfg.hidden_size, cfg.rms_eps, prefix="ln_post_")
+            self.mlp = LlamaMLP(cfg, prefix="mlp_")
+
+    def hybrid_forward(self, F, x):
+        x = x + self.self_attn(self.input_layernorm(x))
+        return x + self.mlp(self.post_attention_layernorm(x))
+
+
+class LlamaModel(HybridBlock):
+    def __init__(self, cfg: LlamaConfig, **kwargs):
+        super().__init__(**kwargs)
+        self._cfg = cfg
+        with self.name_scope():
+            self.embed_tokens = nn.Embedding(cfg.vocab_size,
+                                             cfg.hidden_size,
+                                             prefix="embed_")
+            self.layers = nn.HybridSequential(prefix="layers_")
+            for _ in range(cfg.num_layers):
+                self.layers.add(LlamaDecoderLayer(cfg))
+            self.norm = RMSNorm(cfg.hidden_size, cfg.rms_eps,
+                                prefix="norm_")
+
+    def hybrid_forward(self, F, input_ids):
+        h = self.embed_tokens(input_ids)
+        for layer in self.layers:
+            h = layer(h)
+        return self.norm(h)
+
+
+class LlamaForCausalLM(HybridBlock):
+    """Decoder + LM head; training forward returns logits (B, T, V)."""
+
+    def __init__(self, cfg: LlamaConfig, **kwargs):
+        super().__init__(**kwargs)
+        self._cfg = cfg
+        with self.name_scope():
+            self.model = LlamaModel(cfg, prefix="model_")
+            self.lm_head = nn.Dense(cfg.vocab_size, use_bias=False,
+                                    flatten=False,
+                                    in_units=cfg.hidden_size,
+                                    prefix="lm_head_")
+
+    @property
+    def config(self):
+        return self._cfg
+
+    def hybrid_forward(self, F, input_ids):
+        h = self.model(input_ids)
+        if self._cfg.tie_embeddings:
+            from ..ops.registry import apply_op
+
+            w = self.model.embed_tokens.weight.data()
+            return apply_op(lambda hr, wr: hr @ wr.T, h, w,
+                            name="tied_lm_head")
+        return self.lm_head(h)
+
+    def generate(self, input_ids, max_new_tokens=16):
+        """Greedy decoding (no KV cache — full re-forward per token; a
+        cached incremental path is future work)."""
+        from .. import ndarray as nd
+        from .. import autograd as ag
+
+        cur = input_ids
+        with ag.pause():
+            for _ in range(max_new_tokens):
+                logits = self(cur)
+                nxt = nd.argmax(logits, axis=-1)[:, -1:]
+                cur = nd.concat(cur, nxt.astype(cur.dtype), dim=1)
+        return cur
+
+
+def llama3_8b(**overrides):
+    """Llama-3-8B architecture (BASELINE config 5)."""
+    return LlamaForCausalLM(LlamaConfig(**{**LLAMA_CONFIGS["llama3_8b"],
+                                           **overrides}))
+
+
+def llama_tiny(**overrides):
+    """Tiny config for tests/dryruns."""
+    return LlamaForCausalLM(LlamaConfig(**{**LLAMA_CONFIGS["llama_tiny"],
+                                           **overrides}))
+
+
+def shard_llama(net, mesh=None, tp_axis="tp", dp_axis="dp"):
+    """Annotate megatron-style TP shardings over ``mesh`` (pjit/GSPMD
+    derives the collectives — SURVEY §2.3 D6, new capability):
+
+    - q/k/v/gate/up: column-parallel (output dim split over tp)
+    - o/down:       row-parallel (input dim split over tp)
+    - embed/lm_head: vocab-parallel
+    Replicates everything else.  Weights are stored (out, in), so the
+    output dim is axis 0.
+    """
+    from .. import parallel
+
+    mesh = mesh or parallel.current_mesh()
+    if mesh is None or tp_axis not in mesh.shape:
+        parallel.replicate_block_params(net)
+        return net
+    col = (tp_axis, None)
+    row = (None, tp_axis)
+    parallel.replicate_block_params(net)  # baseline: replicate all
+    for layer in net.model.layers:
+        attn, mlp = layer.self_attn, layer.mlp
+        for p in (attn.q_proj.weight, attn.k_proj.weight,
+                  attn.v_proj.weight, mlp.gate_proj.weight,
+                  mlp.up_proj.weight):
+            parallel.shard_param(p, col, mesh)
+        for p in (attn.o_proj.weight, mlp.down_proj.weight):
+            parallel.shard_param(p, row, mesh)
+    parallel.shard_param(net.model.embed_tokens.weight, col, mesh)
+    if not net._cfg.tie_embeddings:
+        parallel.shard_param(net.lm_head.weight, col, mesh)
+    return net

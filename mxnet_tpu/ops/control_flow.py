@@ -150,8 +150,14 @@ def while_loop(cond, func, loop_vars, max_iterations=None,
         it += 1
     if not steps:
         # zero iterations: probe shapes (discarding state) so imperative
-        # matches the traced path's zero-filled buffers
-        probe_out, _ = func(*cur)
+        # matches the traced path's zero-filled buffers.  Contract (same
+        # as the traced path, which also traces func for structure): func
+        # must be safely callable on the initial loop_vars even when cond
+        # is false.  The probe runs outside the autograd tape.
+        from .. import autograd as _ag
+
+        with _ag.pause():
+            probe_out, _ = func(*cur)
         steps_shapes = _aslist(probe_out)
         zero_rows = [zeros_like(o) for o in steps_shapes]
         stacked = [nd_stack(*([z] * max_iterations), axis=0)

@@ -235,9 +235,20 @@ class TPUSyncKVStore:
         self.type = "dist_tpu_sync"
         self._local = kvs.KVStore("dist_tpu_sync_local")
         self._mesh = current_mesh()
+        self._compression = None
+        self._residuals = {}
 
     # Trainer hook: gradients are already globally reduced by GSPMD.
+    # With compression enabled, quantize them here (per-param residual) so
+    # dist_tpu_sync training sees exactly what the reference's compressed
+    # worker→server hop would deliver.
     def allreduce_grads(self, params):
+        if self._compression is not None:
+            for p in params:
+                for g in p.list_grad():
+                    q, self._residuals[p.name] = self._compression.roundtrip(
+                        g, self._residuals.get(p.name))
+                    g._data = q._data
         return params
 
     @property
@@ -287,6 +298,10 @@ class TPUSyncKVStore:
         self._local.set_updater(updater)
 
     def set_gradient_compression(self, compression_params):
+        from ..kvstore import gradient_compression as gc
+
+        self._compression = gc.create(compression_params)
+        self._residuals = {}
         self._local.set_gradient_compression(compression_params)
 
     def save_optimizer_states(self, fname, dump_optimizer=False):

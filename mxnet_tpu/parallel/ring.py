@@ -68,10 +68,12 @@ def _ring_sharded(q, k, v, *, axis_name, n, causal, scale):
     qpos = idx * tq + jnp.arange(tq)
     perm = [(i, (i + 1) % n) for i in range(n)]
 
-    vary = partial(jax.lax.pcast, axis_name=(axis_name,), to="varying")
-    m0 = vary(jnp.full((b, nh, tq), _FLOOR, np.float32))
-    l0 = vary(jnp.zeros((b, nh, tq), np.float32))
-    a0 = vary(jnp.zeros((b, nh, tq, hd), np.float32))
+    # derive the carries from q so they inherit ALL of q's varying axes
+    # (sp always; dp/tp too when the caller sharded batch/heads)
+    qt = jnp.swapaxes(q, 1, 2).astype(np.float32)      # (B, N, Tq, H)
+    m0 = jnp.full_like(qt[..., 0], _FLOOR)
+    l0 = jnp.zeros_like(qt[..., 0])
+    a0 = jnp.zeros_like(qt)
 
     def step(carry, r):
         k_c, v_c, m, l, acc = carry
@@ -121,15 +123,7 @@ def _sp_apply(body, query, key, value, causal, scale, mesh, axis_name):
     spec = P(None, axis_name, None, None)
 
     def f(q, k, v):
-        for name, a in (("query", q), ("key", k), ("value", v)):
-            if a.shape[1] % n:
-                raise MXNetError(
-                    f"{name} sequence length {a.shape[1]} not divisible "
-                    f"by {axis_name}={n}")
-        if body is _ulysses_sharded and q.shape[2] % n:
-            raise MXNetError(
-                f"ulysses_attention needs heads ({q.shape[2]}) divisible "
-                f"by {axis_name}={n}")
+        _validate_sp(body, q, n, axis_name)
         return jax.shard_map(
             partial(body, axis_name=axis_name, n=n, causal=causal,
                     scale=scale),
@@ -155,3 +149,67 @@ def ulysses_attention(query, key, value, causal=False, scale=None, mesh=None,
     ``axis_name`` mesh axis size."""
     return _sp_apply(_ulysses_sharded, query, key, value, causal, scale,
                      mesh, axis_name)
+
+
+def _validate_sp(body, q_btnh, n, axis_name):
+    """Shared divisibility checks for both the NDArray and raw entries
+    (q in (B, T, N, H) layout)."""
+    if q_btnh.shape[1] % n:
+        raise MXNetError(
+            f"sequence length {q_btnh.shape[1]} not divisible by "
+            f"{axis_name}={n}")
+    if body is _ulysses_sharded and q_btnh.shape[2] % n:
+        raise MXNetError(
+            f"ulysses_attention needs heads ({q_btnh.shape[2]}) divisible "
+            f"by {axis_name}={n}")
+
+
+def _raw_sp(body, q, k, v, causal, scale, mesh, axis_name,
+            batch_axis="dp", head_axis="tp"):
+    """Raw-array entry for use inside traced model code: q/k/v are
+    (B, H, T, D) jax arrays.  Without an active mesh carrying the sp axis,
+    falls back to the single-device flash kernel (so the same model code
+    runs on 1 chip and on an sp ring).
+
+    Batch and head dims are additionally sharded over the mesh's dp/tp
+    axes when divisible — otherwise shard_map would all-gather the
+    dp-sharded batch onto every device and compute attention redundantly.
+    """
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from . import current_mesh
+
+    mesh = mesh or current_mesh()
+    if mesh is None or axis_name not in mesh.shape:
+        from ..ops.flash_attention import flash_attention_raw
+
+        return flash_attention_raw(q, k, v, causal, scale)
+    n = mesh.shape[axis_name]
+    qt = q.transpose(0, 2, 1, 3)  # → (B, T, H, D): shard T over the ring
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    _validate_sp(body, qt, n, axis_name)
+    b_ax = batch_axis if (batch_axis in mesh.shape and
+                          qt.shape[0] % mesh.shape[batch_axis] == 0) \
+        else None
+    h_ax = head_axis if (head_axis in mesh.shape and
+                         qt.shape[2] % mesh.shape[head_axis] == 0) \
+        else None
+    spec = P(b_ax, axis_name, h_ax, None)
+    out = jax.shard_map(
+        partial(body, axis_name=axis_name, n=n, causal=causal,
+                scale=scale),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)(qt, kt, vt)
+    return out.transpose(0, 2, 1, 3)
+
+
+def ring_attention_raw(q, k, v, causal=False, scale=None, mesh=None,
+                       axis_name="sp"):
+    return _raw_sp(_ring_sharded, q, k, v, causal, scale, mesh, axis_name)
+
+
+def ulysses_attention_raw(q, k, v, causal=False, scale=None, mesh=None,
+                          axis_name="sp"):
+    return _raw_sp(_ulysses_sharded, q, k, v, causal, scale, mesh,
+                   axis_name)

@@ -1,0 +1,172 @@
+"""Llama family + flash attention tests (new capability vs the reference —
+SURVEY §5 long-context ABSENT; test strategy mirrors the reference's op
+unit tests + consistency cross-checks, SURVEY §4)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd, parallel
+from mxnet_tpu.models import llama
+
+
+def _ids(b, t, vocab=256, seed=0):
+    return nd.array(onp.random.RandomState(seed).randint(0, vocab, (b, t)),
+                    dtype="int32")
+
+
+def test_flash_attention_matches_reference():
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ops.flash_attention import (_sdpa_ref,
+                                               flash_attention_raw)
+
+    rng = onp.random.RandomState(0)
+    q, k, v = (jnp.asarray(rng.normal(size=(2, 4, 64, 32)).astype("f"))
+               for _ in range(3))
+    for causal in (False, True):
+        out = flash_attention_raw(q, k, v, causal, None)
+        ref = _sdpa_ref(q, k, v, causal, 1 / onp.sqrt(32))
+        assert float(jnp.abs(out - ref).max()) < 1e-4
+        grads = jax.grad(
+            lambda a, b, c: (flash_attention_raw(a, b, c, causal,
+                                                 None) ** 2).sum(),
+            argnums=(0, 1, 2))(q, k, v)
+        refg = jax.grad(
+            lambda a, b, c: (_sdpa_ref(a, b, c, causal,
+                                       1 / onp.sqrt(32)) ** 2).sum(),
+            argnums=(0, 1, 2))(q, k, v)
+        for g, r in zip(grads, refg):
+            assert float(jnp.abs(g - r).max()) < 1e-4
+
+
+def test_flash_attention_chunked_backward():
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ops import flash_attention as fa
+
+    rng = onp.random.RandomState(1)
+    q, k, v = (jnp.asarray(rng.normal(size=(1, 2, 256, 16)).astype("f"))
+               for _ in range(3))
+    g = jnp.asarray(rng.normal(size=(1, 2, 256, 16)).astype("f"))
+    o = fa._sdpa_ref(q, k, v, True, 0.25)
+    # small block forces the multi-block scan path
+    dq, dk, dv = fa._fa_backward(q, k, v, o, g, True, 0.25, block=64)
+    dq2, dk2, dv2 = fa._fa_backward_dense(
+        q, k, v, g, q, k, v, True, 0.25, 256, 256)
+    for a, b in ((dq, dq2), (dk, dk2), (dv, dv2)):
+        assert float(jnp.abs(a - b).max()) < 1e-4
+
+
+def test_rmsnorm():
+    ln = llama.RMSNorm(8)
+    ln.initialize()
+    x = nd.random.uniform(-2, 2, shape=(2, 3, 8))
+    out = ln(x).asnumpy()
+    xa = x.asnumpy()
+    want = xa / onp.sqrt((xa ** 2).mean(-1, keepdims=True) + 1e-5)
+    onp.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-6)
+
+
+def test_rope_rotation_properties():
+    from mxnet_tpu.models.llama import _apply_rope, _rope_tables
+    import jax.numpy as jnp
+
+    cos, sin = _rope_tables(16, 8, 10000.0)
+    x = jnp.asarray(onp.random.RandomState(0).normal(
+        size=(1, 2, 16, 8)).astype("f"))
+    out = _apply_rope(x, cos[None, None], sin[None, None])
+    # norms preserved (rotation)
+    onp.testing.assert_allclose(
+        onp.asarray((out ** 2).sum(-1)), onp.asarray((x ** 2).sum(-1)),
+        rtol=1e-4)
+    # position 0 is identity
+    onp.testing.assert_allclose(onp.asarray(out[:, :, 0]),
+                                onp.asarray(x[:, :, 0]), rtol=1e-6)
+
+
+def test_llama_tiny_forward_and_train():
+    net = llama.llama_tiny()
+    net.initialize(mx.init.Xavier())
+    ids = _ids(2, 32)
+    logits = net(ids)
+    assert logits.shape == (2, 32, 256)
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 1e-3})
+    labels = _ids(2, 32, seed=1)
+    first = None
+    for _ in range(5):
+        with autograd.record():
+            lg = net(ids)
+            loss = nd.softmax_cross_entropy(
+                lg.reshape((-1, 256)), labels.reshape((-1,))).mean()
+        loss.backward()
+        trainer.step(1)
+        first = first if first is not None else float(loss.asscalar())
+    assert float(loss.asscalar()) < first
+
+
+def test_llama_hybridize_consistent():
+    net = llama.llama_tiny()
+    net.initialize(mx.init.Xavier())
+    ids = _ids(1, 16)
+    eager = net(ids).asnumpy()
+    net.hybridize()
+    hybrid = net(ids).asnumpy()
+    onp.testing.assert_allclose(eager, hybrid, rtol=1e-4, atol=1e-5)
+
+
+def test_llama_gqa_heads():
+    cfg = llama.LlamaConfig(**{**llama.LLAMA_CONFIGS["llama_tiny"],
+                               "num_kv_heads": 1})
+    net = llama.LlamaForCausalLM(cfg)
+    net.initialize(mx.init.Xavier())
+    out = net(_ids(1, 8))
+    assert out.shape == (1, 8, 256)
+    attn = net.model.layers[0].self_attn
+    assert attn.k_proj.weight.shape[0] == cfg.head_dim  # 1 kv head
+
+
+def test_llama_generate():
+    net = llama.llama_tiny()
+    net.initialize(mx.init.Xavier())
+    out = net.generate(_ids(2, 4), max_new_tokens=3)
+    assert out.shape == (2, 7)
+    assert out.asnumpy()[:, :4].tolist() == _ids(2, 4).asnumpy().tolist()
+
+
+@pytest.mark.parametrize("mode", ["ring", "ulysses"])
+def test_llama_sequence_parallel_modes(mode):
+    mesh = parallel.make_mesh({"dp": 2, "sp": 4})
+    with parallel.mesh_scope(mesh):
+        net = llama.llama_tiny(attn_mode=mode)
+        net.initialize(mx.init.Xavier())
+        llama.shard_llama(net, mesh)
+        ids = parallel.shard_batch(_ids(2, 32), mesh)
+        with autograd.record():
+            lg = net(ids)
+            loss = nd.softmax_cross_entropy(
+                lg.reshape((-1, 256)),
+                nd.zeros((2 * 32,), dtype="int32")).mean()
+        loss.backward()
+        assert onp.isfinite(float(loss.asscalar()))
+
+
+def test_llama_tp_matches_single_device():
+    ids = _ids(2, 16)
+    net = llama.llama_tiny(attn_mode="sdpa")
+    net.initialize(mx.init.Xavier())
+    ref = net(ids).asnumpy()
+    mesh = parallel.make_mesh({"dp": 2, "tp": 4})
+    with parallel.mesh_scope(mesh):
+        llama.shard_llama(net, mesh)
+        got = net(parallel.shard_batch(ids, mesh)).asnumpy()
+    onp.testing.assert_allclose(got, ref, rtol=2e-3, atol=2e-4)
+
+
+def test_llama3_8b_config():
+    cfg = llama.LlamaConfig(**llama.LLAMA_CONFIGS["llama3_8b"])
+    assert cfg.head_dim == 128
+    assert cfg.num_kv_heads == 8
+    assert cfg.vocab_size == 128256
