@@ -14,6 +14,8 @@ search the reference implements in calibrate.cc).
 """
 from __future__ import annotations
 
+import re
+
 import numpy as np
 
 from ..base import MXNetError
@@ -41,7 +43,10 @@ def _collect_ranges(sym, arg_params, aux_params, calib_data, data_names,
                    if n.op in _QUANTIZABLE}
 
     def cb(name, arr):
-        if "_input" in name and name not in want_inputs:
+        # skip input records except quantizable nodes' first inputs
+        # (match the generated suffix only — node names may contain
+        # '_input' themselves)
+        if re.search(r"_input\d+$", name) and name not in want_inputs:
             return
         a = arr.asnumpy()
         mn, mx = float(a.min()), float(a.max())

@@ -23,7 +23,8 @@ from ..base import MXNetError
 from ..ndarray import NDArray
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "CSVIter",
-           "ResizeIter", "PrefetchingIter", "ImageRecordIter", "MNISTIter"]
+           "LibSVMIter", "ResizeIter", "PrefetchingIter",
+           "ImageRecordIter", "MNISTIter"]
 
 
 class DataDesc(namedtuple("DataDesc", ["name", "shape"])):
@@ -214,6 +215,109 @@ class CSVIter(DataIter):
     @property
     def provide_label(self):
         return self._inner.provide_label
+
+
+class LibSVMIter(DataIter):
+    """LibSVM text reader → CSR batches (reference C++ ``LibSVMIter``,
+    src/io/iter_libsvm.cc:? — the sparse pipeline feeding the
+    factorization-machine / linear-model workloads, SURVEY §2.5)."""
+
+    def __init__(self, data_libsvm, data_shape, batch_size=1,
+                 label_libsvm=None, round_batch=True, **kwargs):
+        super().__init__(batch_size)
+        self._num_features = int(data_shape[0]) \
+            if isinstance(data_shape, (tuple, list)) else int(data_shape)
+        labels = []
+        indices, values = [], []
+        indptr = [0]
+        with open(data_libsvm) as f:
+            for line in f:
+                parts = line.split()
+                if not parts:
+                    continue
+                labels.append(float(parts[0]))
+                for tok in parts[1:]:
+                    i, v = tok.split(":")
+                    indices.append(int(i))
+                    values.append(float(v))
+                indptr.append(len(indices))
+        if label_libsvm is not None:
+            # separate label file overrides the data file's lead column
+            # (reference LibSVMIter contract)
+            labels = []
+            with open(label_libsvm) as f:
+                for line in f:
+                    parts = line.split()
+                    if parts:
+                        labels.append(float(parts[0]))
+            if len(labels) != len(indptr) - 1:
+                raise MXNetError(
+                    f"label file has {len(labels)} rows but data file has "
+                    f"{len(indptr) - 1}")
+        self._labels = np.asarray(labels, np.float32)
+        self._indptr = np.asarray(indptr, np.int64)
+        self._indices = np.asarray(indices, np.int64)
+        self._values = np.asarray(values, np.float32)
+        self._n = len(labels)
+        self._cursor = 0
+        self._round = round_batch
+
+    @property
+    def provide_data(self):
+        return [DataDesc("data", (self.batch_size, self._num_features))]
+
+    @property
+    def provide_label(self):
+        return [DataDesc("softmax_label", (self.batch_size,))]
+
+    def reset(self):
+        self._cursor = 0
+
+    def _row_slice(self, lo, hi):
+        from ..ndarray import sparse as sp
+
+        start, end = self._indptr[lo], self._indptr[hi]
+        indptr = self._indptr[lo:hi + 1] - start
+        return sp.CSRNDArray(self._values[start:end],
+                             self._indices[start:end], indptr,
+                             (hi - lo, self._num_features))
+
+    def next(self):
+        if self._cursor >= self._n:
+            raise StopIteration
+        lo = self._cursor
+        hi = min(lo + self.batch_size, self._n)
+        pad = self.batch_size - (hi - lo)
+        if pad and not self._round:
+            raise StopIteration
+        csr = self._row_slice(lo, hi)
+        label = self._labels[lo:hi]
+        if pad:
+            # wrap around (reference round_batch contract); loop covers
+            # batch_size > dataset size
+            from ..ndarray import sparse as sp
+
+            data = [np.asarray(csr.data._data)]
+            indices = [np.asarray(csr.indices._data)]
+            indptr = np.asarray(csr.indptr._data)
+            labels = [label]
+            remaining = pad
+            while remaining > 0:
+                take = min(remaining, self._n)
+                extra = self._row_slice(0, take)
+                data.append(np.asarray(extra.data._data))
+                indices.append(np.asarray(extra.indices._data))
+                indptr = np.concatenate(
+                    [indptr,
+                     np.asarray(extra.indptr._data)[1:] + indptr[-1]])
+                labels.append(self._labels[:take])
+                remaining -= take
+            csr = sp.CSRNDArray(np.concatenate(data),
+                                np.concatenate(indices), indptr,
+                                (self.batch_size, self._num_features))
+            label = np.concatenate(labels)
+        self._cursor = hi
+        return DataBatch(data=[csr], label=[NDArray(label)], pad=pad)
 
 
 class ResizeIter(DataIter):

@@ -12,10 +12,10 @@ from .bert import BERTModel, BERTClassifier, bert_base, bert_large, \
 
 
 def __getattr__(name):
-    if name == "llama":
+    if name in ("llama", "fm"):
         import importlib
 
-        mod = importlib.import_module(".llama", __name__)
-        globals()["llama"] = mod
+        mod = importlib.import_module("." + name, __name__)
+        globals()[name] = mod
         return mod
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,153 @@
+"""Factorization machine (BASELINE config 4: the PS-shaped sparse
+workload).
+
+Reference: ``example/sparse/factorization_machine/`` (+ linear
+classification examples) — CSR minibatches from ``LibSVMIter``, row_sparse
+weight/embedding gradients pushed through the parameter-server kvstore,
+server-side lazy updates touching only live rows (SURVEY §2.3 D2 sparse
+keys, §2.5 iter_libsvm.cc).
+
+TPU-native: the FM score uses the O(N·K) identity
+``½[(Xv)² − X²v²]`` with CSR×dense products on the BCOO path; gradients
+w.r.t. w and v land only on rows with nonzeros, and ``FMModel.step``
+routes them through kvstore ``push``/``row_sparse_pull`` as
+``RowSparseNDArray``s — the exact push/pull shape the reference's dist
+PS path carries.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..base import MXNetError
+from ..ndarray import NDArray
+
+__all__ = ["FMModel"]
+
+
+class FMModel:
+    """y = w0 + X·w + ½ Σ_f [(X·v)² − X²·v²]  with sparse X (CSR).
+
+    Parameters live in a kvstore (default ``local``) under keys
+    ``fm_w0/fm_w/fm_v`` — ``step`` pushes row_sparse grads and pulls back
+    only the touched rows (``row_sparse_pull``), matching the reference's
+    embedding-style PS traffic."""
+
+    def __init__(self, num_features, factor_dim=8, lr=0.01, kvstore=None,
+                 seed=0):
+        from .. import kvstore as kvs
+        from .. import ndarray as nd
+
+        rng = np.random.RandomState(seed)
+        self.n = num_features
+        self.k = factor_dim
+        self.lr = lr
+        self.w0 = nd.zeros((1,))
+        self.w = nd.zeros((num_features, 1))
+        self.v = NDArray(rng.normal(0, 0.05,
+                                    (num_features, factor_dim))
+                         .astype(np.float32))
+        self.kv = kvs.create(kvstore) if isinstance(kvstore, str) \
+            else (kvstore or kvs.create("local"))
+        self.kv.init("fm_w0", self.w0)
+        self.kv.init("fm_w", self.w)
+        self.kv.init("fm_v", self.v)
+
+    # -- forward --------------------------------------------------------------
+    def _score_parts(self, csr):
+        from ..ndarray import sparse as sp
+
+        xv = sp.dot(csr, self.v)                     # (B, K)
+        x2 = self._square_csr(csr)
+        x2v2 = sp.dot(x2, self.v * self.v)           # (B, K)
+        linear = sp.dot(csr, self.w)                 # (B, 1)
+        return xv, x2v2, linear, x2
+
+    def _logits(self, xv, x2v2, linear):
+        from .. import ndarray as nd
+
+        inter = 0.5 * nd.sum(xv * xv - x2v2, axis=1, keepdims=True)
+        return self.w0 + linear + inter              # (B, 1)
+
+    @staticmethod
+    def _square_csr(csr):
+        from ..ndarray import sparse as sp
+
+        return sp.CSRNDArray(csr.data * csr.data, csr.indices, csr.indptr,
+                             csr.shape)
+
+    def forward(self, csr):
+        xv, x2v2, linear, _x2 = self._score_parts(csr)
+        return self._logits(xv, x2v2, linear)        # (B, 1) logits
+
+    __call__ = forward
+
+    # -- manual grads (logistic loss), row-sparse by construction -------------
+    def step(self, csr, labels):
+        """One logistic-regression FM step on a CSR batch; returns loss.
+        Gradients for w/v are RowSparseNDArrays over the batch's feature
+        rows, pushed + pulled through the kvstore."""
+        from .. import ndarray as nd
+        from ..ndarray import sparse as sp
+
+        b = csr.shape[0]
+        xv, x2v2, linear, x2 = self._score_parts(csr)  # computed ONCE
+        logits = self._logits(xv, x2v2, linear)
+        y = labels.reshape((b, 1))
+        p = nd.sigmoid(logits)
+        # dL/dlogit for mean logistic loss with labels in {0,1}
+        dlogit = (p - y) / b                          # (B, 1)
+        loss = -nd.mean(y * nd.log(p + 1e-12)
+                        + (1 - y) * nd.log(1 - p + 1e-12))
+
+        # grads: w0 ← Σ dlogit; w ← Xᵀ dlogit; v ← Xᵀ(dlogit·Xv) − X²ᵀdlogit·v
+        g_w0 = nd.sum(dlogit).reshape((1,))
+        g_w_dense = sp.dot(csr, dlogit, transpose_a=True)   # (N, 1)
+        t1 = sp.dot(csr, dlogit * xv, transpose_a=True)     # (N, K)
+        t2 = sp.dot(x2, dlogit, transpose_a=True) * self.v  # (N, K)
+        g_v_dense = t1 - t2
+
+        rows = self._touched_rows(csr)
+        g_w = self._rowslice(g_w_dense, rows)
+        g_v = self._rowslice(g_v_dense, rows)
+
+        # PS-style round trip: push row_sparse grads, pull fresh rows
+        self.kv.push("fm_w0", g_w0)
+        self.kv.push("fm_w", g_w)
+        self.kv.push("fm_v", g_v)
+        if getattr(self.kv, "_updater", None) is None:
+            # no server-side optimizer: apply local SGD on pulled grads
+            self._local_sgd(g_w0, g_w, g_v, rows)
+        else:
+            self.kv.row_sparse_pull("fm_w", out=self.w, row_ids=rows)
+            self.kv.row_sparse_pull("fm_v", out=self.v, row_ids=rows)
+            self.kv.pull("fm_w0", out=self.w0)
+        return float(loss.asscalar())
+
+    @staticmethod
+    def _touched_rows(csr):
+        from .. import ndarray as nd
+
+        return NDArray(np.unique(np.asarray(csr.indices._data)))
+
+    @staticmethod
+    def _rowslice(dense, rows):
+        from ..ndarray import sparse as sp
+
+        idx = rows._data.astype(np.int32)
+        return sp.RowSparseNDArray(NDArray(dense._data[idx]), rows,
+                                   dense.shape)
+
+    def _local_sgd(self, g_w0, g_w, g_v, rows):
+        idx = rows._data.astype(np.int32)
+        self.w0._data = self.w0._data - self.lr * g_w0._data
+        self.w._data = self.w._data.at[idx].add(
+            -self.lr * g_w.data._data)
+        self.v._data = self.v._data.at[idx].add(
+            -self.lr * g_v.data._data)
+
+    # -- evaluation -----------------------------------------------------------
+    def accuracy(self, csr, labels):
+        from .. import ndarray as nd
+
+        pred = (nd.sigmoid(self.forward(csr)) > 0.5).reshape((-1,))
+        return float(nd.mean(pred == labels).asscalar())

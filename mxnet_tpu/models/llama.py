@@ -48,9 +48,9 @@ class LlamaConfig:
         self.tie_embeddings = tie_embeddings
         self.attn_mode = attn_mode  # flash | sdpa | ring | ulysses
         if hidden_size % num_heads:
-            raise MXNetError("hidden_size must divide num_heads")
+            raise MXNetError("num_heads must evenly divide hidden_size")
         if num_heads % num_kv_heads:
-            raise MXNetError("num_heads must divide num_kv_heads")
+            raise MXNetError("num_kv_heads must evenly divide num_heads")
         self.head_dim = hidden_size // num_heads
 
 

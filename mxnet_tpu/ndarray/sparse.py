@@ -154,10 +154,16 @@ class CSRNDArray(BaseSparseNDArray):
         return NDArray(out)
 
     def to_bcoo(self):
-        """Bridge to jax.experimental.sparse BCOO for XLA sparse matmul."""
+        """Bridge to jax.experimental.sparse BCOO for XLA sparse matmul —
+        built straight from the CSR triplet (no densify round trip)."""
+        import jax.numpy as jnp
         from jax.experimental import sparse as jsparse
 
-        return jsparse.BCOO.from_dense(self.todense()._data)
+        indptr = np.asarray(self.indptr._data)
+        rows = np.repeat(np.arange(self._shape[0]), np.diff(indptr))
+        idx = jnp.stack([jnp.asarray(rows, jnp.int32),
+                         self.indices._data.astype(jnp.int32)], axis=1)
+        return jsparse.BCOO((self.data._data, idx), shape=self._shape)
 
     def tostype(self, stype):
         if stype == "default":

@@ -142,10 +142,12 @@ def _fa_forward_pallas(q, k, v, causal, scale, block_q=512, block_k=512):
 
 # --- chunked jnp backward ----------------------------------------------------
 
-def _causal_block_mask(tq, bk, j):
+def _causal_block_mask(tq, bk, j, offset=0):
+    """offset = tk - tq: query i attends keys ≤ i + offset (same
+    convention as _sdpa_ref's tril(k=tk-tq))."""
     qpos = lax.broadcasted_iota(jnp.int32, (tq, bk), 0)
     kpos = j * bk + lax.broadcasted_iota(jnp.int32, (tq, bk), 1)
-    return qpos >= kpos
+    return qpos + offset >= kpos
 
 
 def _fa_backward(q, k, v, o, g, causal, scale, block=512):
@@ -174,7 +176,7 @@ def _fa_backward(q, k, v, o, g, causal, scale, block=512):
         j, kj = inp
         s = jnp.einsum("bhqd,bhkd->bhqk", qf, kj) * scale
         if causal:
-            s = jnp.where(_causal_block_mask(tq, bk, j), s, -jnp.inf)
+            s = jnp.where(_causal_block_mask(tq, bk, j, tk - tq), s, -jnp.inf)
         m_new = jnp.maximum(m, s.max(-1))
         safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
         p = jnp.where(jnp.isfinite(s), jnp.exp(s - safe[..., None]), 0.0)
@@ -195,7 +197,7 @@ def _fa_backward(q, k, v, o, g, causal, scale, block=512):
         j, kj, vj = inp
         s = jnp.einsum("bhqd,bhkd->bhqk", qf, kj) * scale
         if causal:
-            s = jnp.where(_causal_block_mask(tq, bk, j), s, -jnp.inf)
+            s = jnp.where(_causal_block_mask(tq, bk, j, tk - tq), s, -jnp.inf)
         p = jnp.where(jnp.isfinite(s),
                       jnp.exp(s - lse[..., None]), 0.0)
         dvj = jnp.einsum("bhqk,bhqd->bhkd", p, gf)

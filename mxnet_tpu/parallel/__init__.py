@@ -56,9 +56,21 @@ def initialize(coordinator_address=None, num_processes=None, process_id=None,
                local_device_ids=None):
     """Multi-host bootstrap (reference: tools/launch.py + ps-lite Postoffice
     handshake via DMLC_PS_ROOT_URI, SURVEY §3.4).  Call once per host before
-    any jax computation; no-op for single-process runs."""
+    any jax computation; no-op for single-process runs.
+
+    ``tools/launch.py`` sets ``MXT_COORDINATOR``/``MXT_NUM_PROCESSES``/
+    ``MXT_PROCESS_ID`` — picked up here when args are omitted (the analog
+    of the DMLC_* env contract)."""
+    import os
+
     import jax
 
+    coordinator_address = coordinator_address or \
+        os.environ.get("MXT_COORDINATOR")
+    if num_processes is None and "MXT_NUM_PROCESSES" in os.environ:
+        num_processes = int(os.environ["MXT_NUM_PROCESSES"])
+    if process_id is None and "MXT_PROCESS_ID" in os.environ:
+        process_id = int(os.environ["MXT_PROCESS_ID"])
     if coordinator_address is None:
         return  # single-process
     jax.distributed.initialize(
@@ -245,7 +257,9 @@ class TPUSyncKVStore:
     def allreduce_grads(self, params):
         if self._compression is not None:
             for p in params:
-                for g in p.list_grad():
+                # list_grad repeats the SAME handle per ctx — dedupe so
+                # the residual sees each gradient exactly once
+                for g in {id(g): g for g in p.list_grad()}.values():
                     q, self._residuals[p.name] = self._compression.roundtrip(
                         g, self._residuals.get(p.name))
                     g._data = q._data

@@ -1,0 +1,106 @@
+"""Factorization machine + LibSVM pipeline tests (BASELINE config 4;
+reference model: example/sparse/factorization_machine + the sparse
+kvstore push/row_sparse_pull tests, SURVEY §4)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import io, nd
+from mxnet_tpu.models import fm
+from mxnet_tpu.ndarray import sparse as sp
+
+
+def _toy_libsvm(path, n=40, nfeat=16, seed=0):
+    """Separable data: label = 1 iff feature 0 present."""
+    rng = onp.random.RandomState(seed)
+    with open(path, "w") as f:
+        for i in range(n):
+            label = i % 2
+            feats = {0: 1.0} if label else {1: 1.0}
+            for _ in range(3):
+                feats[int(rng.randint(2, nfeat))] = float(
+                    rng.uniform(0.5, 1.0))
+            toks = " ".join(f"{k}:{v}" for k, v in sorted(feats.items()))
+            f.write(f"{label} {toks}\n")
+
+
+def test_libsvm_iter(tmp_path):
+    path = str(tmp_path / "data.libsvm")
+    _toy_libsvm(path, n=10, nfeat=16)
+    it = io.LibSVMIter(data_libsvm=path, data_shape=(16,), batch_size=4)
+    batches = list(it)
+    assert len(batches) == 3
+    b0 = batches[0]
+    assert isinstance(b0.data[0], sp.CSRNDArray)
+    assert b0.data[0].shape == (4, 16)
+    assert b0.label[0].shape == (4,)
+    assert batches[-1].pad == 2  # 10 rows → pad last batch of 4
+    dense = b0.data[0].todense().asnumpy()
+    assert dense.shape == (4, 16)
+    assert (dense != 0).sum() >= 8
+    it.reset()
+    assert len(list(it)) == 3
+
+
+def test_fm_forward_matches_dense_formula():
+    rng = onp.random.RandomState(0)
+    dense = (rng.uniform(size=(4, 8)) < 0.4) * rng.uniform(size=(4, 8))
+    dense = dense.astype(onp.float32)
+    csr = sp.cast_storage(nd.array(dense), "csr")
+    model = fm.FMModel(8, factor_dim=3, seed=1)
+    out = model(csr).asnumpy().ravel()
+    w0 = model.w0.asnumpy()[0]
+    w = model.w.asnumpy()
+    v = model.v.asnumpy()
+    xv = dense @ v
+    want = (w0 + dense @ w[:, 0]
+            + 0.5 * ((xv ** 2) - (dense ** 2) @ (v ** 2)).sum(1))
+    onp.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-5)
+
+
+def test_fm_trains_on_libsvm(tmp_path):
+    path = str(tmp_path / "train.libsvm")
+    _toy_libsvm(path, n=64, nfeat=16)
+    it = io.LibSVMIter(data_libsvm=path, data_shape=(16,), batch_size=16)
+    model = fm.FMModel(16, factor_dim=4, lr=0.5)
+    losses = []
+    for _epoch in range(15):
+        it.reset()
+        for batch in it:
+            losses.append(model.step(batch.data[0], batch.label[0]))
+    assert losses[-1] < losses[0] * 0.7
+    it.reset()
+    batch = next(iter(it))
+    assert model.accuracy(batch.data[0], batch.label[0]) >= 0.9
+
+
+def test_fm_rowsparse_grad_shape():
+    dense = onp.zeros((2, 10), onp.float32)
+    dense[0, 3] = 1.0
+    dense[1, 7] = 2.0
+    csr = sp.cast_storage(nd.array(dense), "csr")
+    model = fm.FMModel(10, factor_dim=2)
+    rows = model._touched_rows(csr).asnumpy()
+    assert sorted(rows.tolist()) == [3, 7]
+    g = model._rowslice(nd.array(onp.arange(20, dtype=onp.float32)
+                                 .reshape(10, 2)), model._touched_rows(csr))
+    assert isinstance(g, sp.RowSparseNDArray)
+    assert g.data.shape == (2, 2)
+
+
+def test_fm_with_kvstore_optimizer():
+    """update_on_kvstore path: server-side optimizer + row_sparse_pull."""
+    from mxnet_tpu import optimizer as opt
+
+    dense = onp.zeros((4, 6), onp.float32)
+    dense[:, 0] = [1, 0, 1, 0]
+    dense[:, 1] = [0, 1, 0, 1]
+    csr = sp.cast_storage(nd.array(dense), "csr")
+    labels = nd.array([1.0, 0, 1, 0])
+    kv = mx.kv.create("local")
+    kv.set_optimizer(opt.SGD(learning_rate=0.5))
+    model = fm.FMModel(6, factor_dim=2, kvstore=kv)
+    first = model.step(csr, labels)
+    for _ in range(30):
+        last = model.step(csr, labels)
+    assert last < first

