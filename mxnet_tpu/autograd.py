@@ -115,9 +115,10 @@ class Node:
     synthesise zero cotangents for unused outputs.
     """
 
-    __slots__ = ("vjp", "inputs", "out_avals", "name", "single")
+    __slots__ = ("vjp", "inputs", "out_avals", "name", "single", "fun")
 
-    def __init__(self, vjp, inputs, out_avals, name="", single=False):
+    def __init__(self, vjp, inputs, out_avals, name="", single=False,
+                 fun=None):
         self.vjp = vjp
         self.inputs = inputs
         self.out_avals = out_avals
@@ -125,10 +126,14 @@ class Node:
         # True when the differentiated callable returned a bare array (jax.vjp
         # then expects a bare cotangent, not a 1-tuple)
         self.single = single
+        # the pure forward function: kept so create_graph=True can rebuild
+        # the vjp as a function of the primals (higher-order autograd)
+        self.fun = fun
 
     def clear(self):
         self.vjp = None
         self.inputs = ()
+        self.fun = None
 
 
 def _zero_cotangent(shape, dtype):
@@ -259,22 +264,125 @@ def backward(heads, head_grads=None, retain_graph: bool = False,
         # 'null': drop
 
 
+def _backward_taped(heads, head_grads, retain_graph=True):
+    """create_graph=True walk: the vjp of every node is re-derived from
+    the stored pure function and applied THROUGH the op dispatcher, so the
+    gradient computation itself lands on the tape (higher-order autograd —
+    the reference supports this for a subset of ops, tests/python/unittest/
+    test_higher_order_grad.py:?).  Returns {id(var): grad NDArray}."""
+    import jax
+    import jax.numpy as jnp
+
+    from .ndarray import NDArray
+    from .ops.registry import apply_op, wrap_raw
+
+    if isinstance(heads, NDArray):
+        heads = [heads]
+    if head_grads is None:
+        head_grads = [None] * len(heads)
+    elif isinstance(head_grads, NDArray):
+        head_grads = [head_grads]
+    if len(heads) != len(head_grads):
+        raise MXNetError("heads and head_grads length mismatch")
+
+    cots = {}        # id(node) -> list of NDArray cotangents per slot
+    head_nodes = []
+    var_grads = {}   # id(var) -> (var, NDArray grad)
+
+    def add_var(arr, g):
+        k = id(arr)
+        var_grads[k] = (arr, g if k not in var_grads
+                        else var_grads[k][1] + g)
+
+    for h, hg in zip(heads, head_grads):
+        g = hg if hg is not None else wrap_raw(jnp.ones(h.shape, h.dtype))
+        node = getattr(h, "_node", None)
+        if node is not None:
+            sl = cots.setdefault(id(node), [None] * len(node.out_avals))
+            i = h._oidx
+            sl[i] = g if sl[i] is None else sl[i] + g
+            head_nodes.append(node)
+        elif getattr(h, "_req_grad", False):
+            add_var(h, g)
+        else:
+            raise MXNetError("head not attached to the graph")
+
+    order = _topo_order(head_nodes)
+    with record():
+        for node in reversed(order):
+            sl = cots.get(id(node))
+            if sl is None:
+                continue
+            if node.fun is None:
+                raise MXNetError(
+                    f"op {node.name!r} cannot participate in "
+                    "create_graph=True backward (no stored forward fn; "
+                    "the reference likewise supports higher-order grad "
+                    "for a subset of ops only)")
+            full = [s if s is not None else wrap_raw(_zero_cotangent(sh, dt))
+                    for s, (sh, dt) in zip(sl, node.out_avals)]
+            n_in = len(node.inputs)
+            single = node.single
+            fun = node.fun
+
+            def back_fun(*raws, _fun=fun, _n=n_in, _single=single):
+                primals, cts = raws[:_n], raws[_n:]
+                _out, vjp = jax.vjp(_fun, *primals)
+                gs = vjp(cts[0] if _single else tuple(cts))
+                # float0 (int primals) → zeros so results stay arrays
+                return tuple(
+                    jnp.zeros(p.shape, p.dtype) if _is_float0(g) else g
+                    for g, p in zip(gs, primals))
+
+            outs = apply_op(back_fun, *node.inputs, *full,
+                            name=f"bwd_{node.name}")
+            outs = (outs,) if isinstance(outs, NDArray) else outs
+            for inp, g in zip(node.inputs, outs):
+                pnode = getattr(inp, "_node", None)
+                if pnode is not None:
+                    pl = cots.setdefault(id(pnode),
+                                         [None] * len(pnode.out_avals))
+                    i = inp._oidx
+                    pl[i] = g if pl[i] is None else pl[i] + g
+                if getattr(inp, "_req_grad", False):
+                    add_var(inp, g)
+            if not retain_graph:
+                node.clear()
+    return {k: g for k, (_v, g) in var_grads.items()}, var_grads
+
+
 def grad(heads, variables, head_grads=None, retain_graph=None,
          create_graph: bool = False, train_mode: bool = True):
     """Functional gradient: return grads of ``heads`` w.r.t. ``variables``
     without touching ``.grad`` buffers (reference: ``autograd.grad``,
-    python/mxnet/autograd.py:?)."""
+    python/mxnet/autograd.py:?).  With ``create_graph=True`` the returned
+    grads are attached to the tape, so a second ``backward()`` through
+    them yields higher-order gradients."""
     from .ndarray import NDArray
     import jax.numpy as jnp
 
-    if create_graph:
-        raise NotImplementedError(
-            "create_graph=True (higher-order autograd) lands in a later "
-            "round; the reference supports it for a subset of ops only")
     if isinstance(variables, NDArray):
         variables = [variables]
     if retain_graph is None:
         retain_graph = create_graph
+
+    if create_graph:
+        saved = [(getattr(v, "_req_grad", False)) for v in variables]
+        for v in variables:
+            v._req_grad = True
+        try:
+            gmap, _ = _backward_taped(heads, head_grads,
+                                      retain_graph=True)
+        finally:
+            for v, rq in zip(variables, saved):
+                v._req_grad = rq
+        out = []
+        for v in variables:
+            g = gmap.get(id(v))
+            if g is None:
+                g = NDArray(jnp.zeros(v.shape, v.dtype))
+            out.append(g)
+        return out
 
     # Temporarily mark variables, run backward into scratch buffers.
     saved = []

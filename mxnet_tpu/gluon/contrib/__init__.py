@@ -1,0 +1,4 @@
+"""gluon.contrib (reference ``python/mxnet/gluon/contrib/__init__.py:?``):
+contrib layers + the Estimator fit-loop API (SURVEY §2.4)."""
+from . import nn
+from . import estimator

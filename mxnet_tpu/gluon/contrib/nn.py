@@ -1,0 +1,130 @@
+"""gluon.contrib.nn layers.
+
+Reference: ``python/mxnet/gluon/contrib/nn/basic_layers.py:?`` —
+``Concurrent``/``HybridConcurrent`` (parallel branches concatenated),
+``Identity``, ``SparseEmbedding``, ``SyncBatchNorm``, ``PixelShuffle1D/2D/
+3D`` (SURVEY §2.4 gluon contrib row).
+
+TPU notes: ``SyncBatchNorm`` here IS plain BatchNorm — under GSPMD the
+batch axis is sharded over the mesh and XLA's reductions are global, so
+cross-device statistics come for free (the reference needed a dedicated
+cross-GPU allreduce op, ``src/operator/contrib/sync_batch_norm.cc:?``).
+"""
+from __future__ import annotations
+
+from ...base import MXNetError
+from ..block import HybridBlock
+from .. import nn as _nn
+
+__all__ = ["Concurrent", "HybridConcurrent", "Identity", "SparseEmbedding",
+           "SyncBatchNorm", "PixelShuffle1D", "PixelShuffle2D",
+           "PixelShuffle3D"]
+
+
+class HybridConcurrent(HybridBlock):
+    """Run children on the same input, concat outputs along ``axis``."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def add(self, *blocks):
+        for b in blocks:
+            self.register_child(b)
+
+    def hybrid_forward(self, F, x):
+        out = [child(x) for child in self._children.values()]
+        return F.concat(*out, dim=self.axis)
+
+
+class Concurrent(HybridConcurrent):
+    """Imperative alias (reference keeps a non-hybrid variant)."""
+
+
+class Identity(HybridBlock):
+    def hybrid_forward(self, F, x):
+        return x
+
+
+class SparseEmbedding(_nn.Embedding):
+    """Reference ``contrib.nn.SparseEmbedding``: embedding whose gradient
+    is row_sparse.  On TPU the dense scatter-add XLA emits for embedding
+    grads already touches only live rows; this subclass exists for API
+    parity (weights stay dense jax.Arrays)."""
+
+
+SyncBatchNorm = _nn.SyncBatchNorm
+
+
+class _PixelShuffle(HybridBlock):
+    def __init__(self, factor, ndim, **kwargs):
+        super().__init__(**kwargs)
+        self._factors = (factor,) * ndim if isinstance(factor, int) \
+            else tuple(factor)
+        if len(self._factors) != ndim:
+            raise MXNetError(f"factor must have {ndim} elements")
+
+
+class PixelShuffle1D(_PixelShuffle):
+    """(N, C*f, W) → (N, C, W*f) (reference ``PixelShuffle1D``)."""
+
+    def __init__(self, factor, **kwargs):
+        super().__init__(factor, 1, **kwargs)
+
+    def hybrid_forward(self, F, x):
+        from ...ops.registry import apply_op
+
+        f = self._factors[0]
+
+        def _f(a):
+            n, cf, w = a.shape
+            c = cf // f
+            # channel-major split (C, f) — reference/torch ordering
+            return a.reshape(n, c, f, w).transpose(0, 1, 3, 2) \
+                .reshape(n, c, w * f)
+
+        return apply_op(_f, x, name="pixel_shuffle1d")
+
+
+class PixelShuffle2D(_PixelShuffle):
+    """(N, C*f1*f2, H, W) → (N, C, H*f1, W*f2)."""
+
+    def __init__(self, factor, **kwargs):
+        super().__init__(factor, 2, **kwargs)
+
+    def hybrid_forward(self, F, x):
+        from ...ops.registry import apply_op
+
+        f1, f2 = self._factors
+
+        def _f(a):
+            n, c_in, h, w = a.shape
+            c = c_in // (f1 * f2)
+            # channel-major split (C, f1, f2) — reference/torch ordering
+            a = a.reshape(n, c, f1, f2, h, w)
+            a = a.transpose(0, 1, 4, 2, 5, 3)  # n c h f1 w f2
+            return a.reshape(n, c, h * f1, w * f2)
+
+        return apply_op(_f, x, name="pixel_shuffle2d")
+
+
+class PixelShuffle3D(_PixelShuffle):
+    """(N, C*f1*f2*f3, D, H, W) → (N, C, D*f1, H*f2, W*f3)."""
+
+    def __init__(self, factor, **kwargs):
+        super().__init__(factor, 3, **kwargs)
+
+    def hybrid_forward(self, F, x):
+        from ...ops.registry import apply_op
+
+        f1, f2, f3 = self._factors
+
+        def _f(a):
+            n, c_in, d, h, w = a.shape
+            c = c_in // (f1 * f2 * f3)
+            # channel-major split (C, f1, f2, f3) — reference ordering
+            a = a.reshape(n, c, f1, f2, f3, d, h, w)
+            a = a.transpose(0, 1, 5, 2, 6, 3, 7, 4)
+            return a.reshape(n, c, d * f1, h * f2, w * f3)
+
+        return apply_op(_f, x, name="pixel_shuffle3d")

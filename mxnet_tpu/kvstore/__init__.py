@@ -127,7 +127,12 @@ class KVStore:
                 if isinstance(target, sp.RowSparseNDArray):
                     result.copyto(target)
                 else:
-                    _assign(target, result.todense())
+                    # dense target: update ONLY the requested rows — the
+                    # reference PullRowSparse contract; overwriting the
+                    # whole buffer would zero untouched rows
+                    target._data = target._data.at[
+                        idx.astype(np.int32)].set(
+                            rows.astype(target.dtype))
 
     def broadcast(self, key, value, out=None, priority=0):
         self.init(key, value)

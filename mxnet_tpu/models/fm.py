@@ -110,17 +110,19 @@ class FMModel:
         g_w = self._rowslice(g_w_dense, rows)
         g_v = self._rowslice(g_v_dense, rows)
 
-        # PS-style round trip: push row_sparse grads, pull fresh rows
-        self.kv.push("fm_w0", g_w0)
-        self.kv.push("fm_w", g_w)
-        self.kv.push("fm_v", g_v)
-        if getattr(self.kv, "_updater", None) is None:
-            # no server-side optimizer: apply local SGD on pulled grads
-            self._local_sgd(g_w0, g_w, g_v, rows)
-        else:
+        if getattr(self.kv, "_updater", None) is not None:
+            # PS round trip (update_on_kvstore): push row_sparse grads,
+            # server-side optimizer updates, pull back only touched rows
+            self.kv.push("fm_w0", g_w0)
+            self.kv.push("fm_w", g_w)
+            self.kv.push("fm_v", g_v)
             self.kv.row_sparse_pull("fm_w", out=self.w, row_ids=rows)
             self.kv.row_sparse_pull("fm_v", out=self.v, row_ids=rows)
             self.kv.pull("fm_w0", out=self.w0)
+        else:
+            # no server optimizer: local SGD (pushing grads would REPLACE
+            # the stored weights — reference local stores behave the same)
+            self._local_sgd(g_w0, g_w, g_v, rows)
         return float(loss.asscalar())
 
     @staticmethod

@@ -128,6 +128,28 @@ def stop_gradient(data, **kwargs):
 BlockGrad = stop_gradient
 
 
+def to_dlpack_for_read(data):
+    """Zero-copy DLPack export (reference ``mx.nd.to_dlpack_for_read``,
+    src/ndarray/ndarray.cc:? interop via 3rdparty/dlpack, SURVEY §2.7).
+
+    Returns the underlying buffer as a DLPack-protocol object (implements
+    ``__dlpack__``/``__dlpack_device__``) — the modern exchange form every
+    consumer (torch/np/jax ``from_dlpack``) accepts; legacy capsule-only
+    consumers can call ``.__dlpack__()`` on it."""
+    return data._data
+
+
+# write-side shares the same capsule semantics on an immutable jax buffer
+to_dlpack_for_write = to_dlpack_for_read
+
+
+def from_dlpack(capsule):
+    """Import a DLPack capsule (or any __dlpack__ object) as NDArray."""
+    import jax.numpy as jnp
+
+    return NDArray(jnp.from_dlpack(capsule))
+
+
 def waitall():
     """Block until all enqueued device work completes (reference
     ``mx.nd.waitall`` → ``Engine::WaitForAll``)."""

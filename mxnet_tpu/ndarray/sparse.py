@@ -155,7 +155,15 @@ class CSRNDArray(BaseSparseNDArray):
 
     def to_bcoo(self):
         """Bridge to jax.experimental.sparse BCOO for XLA sparse matmul —
-        built straight from the CSR triplet (no densify round trip)."""
+        built straight from the CSR triplet (no densify round trip).
+
+        Cached: the indptr expansion costs a blocking device→host read, and
+        hot loops (FM training) hit the same CSR batch several times.  CSR
+        batches are treated as immutable (reference NDArray CSR chunks
+        likewise never mutate in place)."""
+        cached = getattr(self, "_bcoo_cache", None)
+        if cached is not None:
+            return cached
         import jax.numpy as jnp
         from jax.experimental import sparse as jsparse
 
@@ -163,7 +171,9 @@ class CSRNDArray(BaseSparseNDArray):
         rows = np.repeat(np.arange(self._shape[0]), np.diff(indptr))
         idx = jnp.stack([jnp.asarray(rows, jnp.int32),
                          self.indices._data.astype(jnp.int32)], axis=1)
-        return jsparse.BCOO((self.data._data, idx), shape=self._shape)
+        self._bcoo_cache = jsparse.BCOO((self.data._data, idx),
+                                        shape=self._shape)
+        return self._bcoo_cache
 
     def tostype(self, stype):
         if stype == "default":

@@ -102,7 +102,7 @@ def apply_op(fun: Callable, *nd_args, name: str = ""):
     if recording:
         node = ag.Node(vjp, list(nd_args),
                        [(o.shape, o.dtype) for o in outs_t], name=name,
-                       single=single)
+                       single=single, fun=fun)
         for i, o in enumerate(nd_outs):
             o._node = node
             o._oidx = i

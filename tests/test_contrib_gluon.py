@@ -1,0 +1,168 @@
+"""gluon.contrib layers + Estimator + higher-order grad + DLPack tests
+(reference model: tests/python/unittest/test_gluon_contrib.py,
+test_gluon_estimator.py, test_higher_order_grad.py — SURVEY §4)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+from mxnet_tpu.gluon import contrib, nn
+
+
+def test_hybrid_concurrent():
+    net = contrib.nn.HybridConcurrent(axis=1)
+    net.add(nn.Dense(3, in_units=4), nn.Dense(2, in_units=4),
+            contrib.nn.Identity())
+    net.initialize()
+    out = net(nd.ones((2, 4)))
+    assert out.shape == (2, 3 + 2 + 4)
+    net.hybridize()
+    out2 = net(nd.ones((2, 4)))
+    onp.testing.assert_allclose(out.asnumpy(), out2.asnumpy(), rtol=1e-5)
+
+
+def test_identity():
+    layer = contrib.nn.Identity()
+    x = nd.random.uniform(shape=(2, 3))
+    onp.testing.assert_array_equal(layer(x).asnumpy(), x.asnumpy())
+
+
+def test_pixel_shuffle_2d():
+    layer = contrib.nn.PixelShuffle2D(2)
+    x = nd.array(onp.arange(16, dtype=onp.float32).reshape(1, 4, 2, 2))
+    out = layer(x)
+    assert out.shape == (1, 1, 4, 4)
+    # spot check: channel blocks interleave into space
+    o = out.asnumpy()[0, 0]
+    assert o[0, 0] == 0.0 and o[0, 1] == 4.0
+    assert o[1, 0] == 8.0 and o[1, 1] == 12.0
+
+
+def test_pixel_shuffle_1d_3d_shapes():
+    x1 = nd.random.uniform(shape=(2, 6, 5))
+    assert contrib.nn.PixelShuffle1D(3)(x1).shape == (2, 2, 15)
+    x3 = nd.random.uniform(shape=(1, 8, 2, 3, 4))
+    assert contrib.nn.PixelShuffle3D(2)(x3).shape == (1, 1, 4, 6, 8)
+
+
+def test_pixel_shuffle_channel_major_ordering():
+    # C=2, f=2: reference/torch ordering splits channels channel-major
+    x = nd.array(onp.arange(8, dtype=onp.float32).reshape(1, 4, 2))
+    out = contrib.nn.PixelShuffle1D(2)(x).asnumpy()[0]
+    onp.testing.assert_array_equal(out, [[0, 2, 1, 3], [4, 6, 5, 7]])
+
+
+def test_sync_batchnorm_alias():
+    assert contrib.nn.SyncBatchNorm is nn.SyncBatchNorm
+
+
+def test_estimator_fit_and_handlers(tmp_path):
+    from mxnet_tpu import gluon, metric
+    from mxnet_tpu.gluon.contrib import estimator as est
+
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, in_units=4, activation="relu"),
+            nn.Dense(2, in_units=8))
+    net.initialize(mx.init.Xavier())
+    rng = onp.random.RandomState(0)
+    x = rng.uniform(-1, 1, (64, 4)).astype(onp.float32)
+    y = (x[:, 0] > 0).astype(onp.float32)
+    from mxnet_tpu.gluon.data import ArrayDataset, DataLoader
+
+    loader = DataLoader(ArrayDataset(x, y), batch_size=16)
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.02})
+    e = est.Estimator(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                      train_metrics=[metric.Accuracy()], trainer=trainer)
+    ckpt = est.CheckpointHandler(str(tmp_path), save_every=1)
+    e.fit(loader, epochs=8, event_handlers=[ckpt])
+    import os
+
+    assert os.path.exists(str(tmp_path / "model-0008.params"))
+    name, acc = e.train_metrics[0].get()
+    assert acc > 0.6
+
+
+def test_estimator_early_stopping():
+    from mxnet_tpu import gluon, metric
+    from mxnet_tpu.gluon.contrib import estimator as est
+
+    net = nn.Dense(2, in_units=4)
+    net.initialize()
+    x = onp.zeros((8, 4), onp.float32)
+    y = onp.zeros((8,), onp.float32)
+    from mxnet_tpu.gluon.data import ArrayDataset, DataLoader
+
+    loader = DataLoader(ArrayDataset(x, y), batch_size=8)
+    acc = metric.Accuracy()
+    e = est.Estimator(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                      train_metrics=[acc])
+    stop = est.EarlyStoppingHandler(acc, patience=0, mode="max")
+    e.fit(loader, epochs=50, event_handlers=[stop])
+    # constant data: metric never improves after epoch 1 → stops early
+    assert stop.stop_training
+
+
+def test_higher_order_grad_polynomial():
+    x = nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x * x
+        gx, = autograd.grad(y, [x], create_graph=True)
+        z = gx.sum()
+    z.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), 6 * x.asnumpy(),
+                                rtol=1e-5)
+
+
+def test_higher_order_grad_trig():
+    x = nd.array([0.3, 0.7])
+    x.attach_grad()
+    with autograd.record():
+        y = nd.sin(x)
+        g1, = autograd.grad(y, [x], create_graph=True)
+        s = (g1 * g1).sum()
+    s.backward()
+    xa = x.asnumpy()
+    onp.testing.assert_allclose(x.grad.asnumpy(),
+                                -2 * onp.cos(xa) * onp.sin(xa), rtol=1e-5)
+
+
+def test_higher_order_through_network():
+    """Gradient-penalty style double backward through Dense layers."""
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, in_units=4, activation="tanh"),
+            nn.Dense(1, in_units=8))
+    net.initialize(mx.init.Xavier())
+    x = nd.random.uniform(-1, 1, shape=(4, 4))
+    x.attach_grad()
+    params = list(net.collect_params().values())
+    with autograd.record():
+        out = net(x).sum()
+        gx, = autograd.grad(out, [x], create_graph=True)
+        penalty = (gx * gx).sum()
+    penalty.backward()
+    g = params[0].grad()
+    assert float(nd.abs(g).sum().asscalar()) > 0
+
+
+def test_grad_without_create_graph_unchanged():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+    gx, = autograd.grad(y, [x])
+    assert float(gx.asscalar()) == 4.0
+
+
+def test_dlpack_roundtrip():
+    import jax.numpy as jnp
+
+    x = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    cap = nd.to_dlpack_for_read(x)
+    back = nd.from_dlpack(cap)
+    onp.testing.assert_array_equal(back.asnumpy(), x.asnumpy())
+    # direct jax interop
+    j = jnp.asarray([1.0, 5.0])
+    nd2 = nd.from_dlpack(j)
+    onp.testing.assert_array_equal(nd2.asnumpy(), onp.array([1.0, 5.0]))
