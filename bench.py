@@ -31,15 +31,23 @@ def main():
     import mxnet_tpu as mx
     from mxnet_tpu import autograd, gluon, nd
 
-    batch = int(os.environ.get("BENCH_BATCH", "32"))
+    batch = int(os.environ.get("BENCH_BATCH", "64"))
     steps = int(os.environ.get("BENCH_STEPS", "20"))
     warmup = int(os.environ.get("BENCH_WARMUP", "3"))
     image = int(os.environ.get("BENCH_IMAGE", "224"))
     model = os.environ.get("BENCH_MODEL", "resnet50_v1")
+    dtype = os.environ.get("BENCH_DTYPE", "bfloat16")
 
     mx.random.seed(0)
     net = gluon.model_zoo.vision.get_model(model, classes=1000)
     net.initialize(mx.init.Xavier())
+    # resolve deferred shapes on a tiny input: the resolve pass runs
+    # imperatively (per-op dispatch), so keep it off the 224² hot path
+    net(nd.ones((1, 3, 32, 32)))
+    if dtype in ("bfloat16", "float16"):
+        from mxnet_tpu import amp
+
+        amp.init(target_dtype=dtype)
     net.hybridize(static_alloc=True, static_shape=True)
     trainer = gluon.Trainer(net.collect_params(), "sgd",
                             {"learning_rate": 0.1, "momentum": 0.9})

@@ -172,6 +172,13 @@ def _is_float0(x) -> bool:
     return getattr(x, "dtype", None) == jax.dtypes.float0
 
 
+def _np_astype(nd_arr, dt):
+    """Taped dtype cast for cotangents (keeps the cast differentiable)."""
+    from .ops.registry import apply_op
+
+    return apply_op(lambda a: a.astype(dt), nd_arr, name="cot_cast")
+
+
 def backward(heads, head_grads=None, retain_graph: bool = False,
              train_mode: bool = True):
     """Run backward from ``heads``; fill ``.grad`` of attached variables.
@@ -319,8 +326,14 @@ def _backward_taped(heads, head_grads, retain_graph=True):
                     "create_graph=True backward (no stored forward fn; "
                     "the reference likewise supports higher-order grad "
                     "for a subset of ops only)")
-            full = [s if s is not None else wrap_raw(_zero_cotangent(sh, dt))
-                    for s, (sh, dt) in zip(sl, node.out_avals)]
+            full = []
+            for s, (sh, dt) in zip(sl, node.out_avals):
+                if s is None:
+                    s = wrap_raw(_zero_cotangent(sh, dt))
+                elif np.dtype(s.dtype) != np.dtype(dt) and \
+                        not _is_float0(s._data):
+                    s = _np_astype(s, dt)  # same coercion as backward()
+                full.append(s)
             n_in = len(node.inputs)
             single = node.single
             fun = node.fun

@@ -5,9 +5,9 @@ event_handler}.py:?`` (≥1.6, SURVEY §2.4 gluon contrib row) — wraps
 net/loss/trainer/metrics into ``est.fit(train_data, val_data, epochs)``
 with TrainBegin/EpochEnd/... handler hooks.
 
-TPU notes: the loop hybridizes the net by default so each batch is one
-XLA program; handlers run host-side between dispatches (they only touch
-scalars, so device queues stay full).
+TPU notes: ``fit(hybridize=True)`` (the default) hybridizes HybridBlock
+nets so each batch is one XLA program; handlers run host-side between
+dispatches (they only touch scalars, so device queues stay full).
 """
 from __future__ import annotations
 
@@ -85,6 +85,7 @@ class LoggingHandler(TrainBegin, TrainEnd, EpochEnd, BatchEnd):
 
     def train_begin(self, estimator, *args, **kwargs):
         self._tic = time.time()
+        self._batch = 0
         print(f"Training begin: {estimator.max_epoch} epochs")
 
     def train_end(self, estimator, *args, **kwargs):
@@ -121,6 +122,9 @@ class CheckpointHandler(TrainBegin, EpochEnd):
         self._epoch = 0
         os.makedirs(model_dir, exist_ok=True)
 
+    def train_begin(self, estimator, *args, **kwargs):
+        self._epoch = 0
+
     def epoch_end(self, estimator, *args, **kwargs):
         self._epoch += 1
         if self._epoch % self.save_every == 0:
@@ -143,6 +147,12 @@ class EarlyStoppingHandler(TrainBegin, EpochEnd):
             mode = "min" if any(
                 s in monitor.get()[0] for s in ("loss", "error")) else "max"
         self.mode = mode
+        self.best = None
+        self.wait = 0
+        self.stop_training = False
+
+    def train_begin(self, estimator, *args, **kwargs):
+        # reusable across fit() calls (reference resets here too)
         self.best = None
         self.wait = 0
         self.stop_training = False
@@ -197,9 +207,13 @@ class Estimator:
         return {m.get()[0]: m.get()[1] for m in self.val_metrics}
 
     def fit(self, train_data, val_data=None, epochs=1, event_handlers=None,
-            batch_axis=0):
+            batch_axis=0, hybridize=True):
         from ... import autograd
+        from ..block import HybridBlock
 
+        if hybridize and isinstance(self.net, HybridBlock) and \
+                not getattr(self.net, "_active", False):
+            self.net.hybridize()
         self.max_epoch = epochs
         handlers = self._handlers(event_handlers, epochs)
 

@@ -139,8 +139,16 @@ def to_dlpack_for_read(data):
     return data._data
 
 
-# write-side shares the same capsule semantics on an immutable jax buffer
-to_dlpack_for_write = to_dlpack_for_read
+def to_dlpack_for_write(data):
+    """The reference's write-through DLPack export has no sound analog:
+    jax buffers are immutable, so consumer writes could never become
+    visible in the NDArray.  Raise rather than silently lose writes."""
+    from ..base import MXNetError
+
+    raise MXNetError(
+        "to_dlpack_for_write is unsupported: jax/XLA buffers are "
+        "immutable. Export with to_dlpack_for_read and copy, or write "
+        "into a new array and assign it back")
 
 
 def from_dlpack(capsule):

@@ -185,13 +185,13 @@ def convolution(data, weight, bias=None, kernel=None, stride=None, dilate=None,
         ("NC" + spatial, "OI" + spatial, "NC" + spatial))
 
     def f(x, w, *b):
-        pet = np.float32 if _accum(x) else None
+        # no preferred_element_type: jax's conv transpose rule cannot mix
+        # a low-precision primal with the fp32 cotangent the pet+cast
+        # pattern produces.  XLA:TPU accumulates bf16 convs in fp32 on the
+        # MXU natively, so bf16 keeps fp32 math anyway.
         y = lax.conv_general_dilated(
             x, w, window_strides=strides, padding=padding, rhs_dilation=dil,
-            dimension_numbers=dn, feature_group_count=num_group,
-            preferred_element_type=pet)
-        if pet:
-            y = y.astype(x.dtype)
+            dimension_numbers=dn, feature_group_count=num_group)
         if b:
             y = y + b[0].reshape((1, -1) + (1,) * nsp)
         return y
