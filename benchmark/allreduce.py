@@ -1,0 +1,79 @@
+#!/usr/bin/env python
+"""KVStore allreduce bus-bandwidth benchmark.
+
+Reference: ``tools/bandwidth/measure.py:?`` + ``benchmark/opperf/``
+(SURVEY §6) — BASELINE.md tracked metric "KVStore allreduce GB/s":
+bus GB/s = 2(n−1)/n × bytes / time for a 100 MB dense key over the
+mesh (per-direction ICI).
+
+Run on hardware: ``python benchmark/allreduce.py`` (single host, all
+local devices).  On the CPU test mesh:
+``XLA_FLAGS=--xla_force_host_platform_device_count=8 BENCH_PLATFORM=cpu
+python benchmark/allreduce.py`` (numbers are meaningless on CPU; the
+point is the harness runs anywhere).
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main():
+    plat = os.environ.get("BENCH_PLATFORM")
+    if plat:
+        import jax
+
+        jax.config.update("jax_platforms", plat)
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+
+    from mxnet_tpu import parallel
+
+    n = jax.device_count()
+    mb = float(os.environ.get("BENCH_MB", "100"))
+    steps = int(os.environ.get("BENCH_STEPS", "20"))
+    # BENCH_MB is the PER-DEVICE payload (the reduced key each device
+    # holds) — the quantity the bus-bandwidth formula applies to
+    shard_elems = int(mb * 1e6 / 4)
+    elems = shard_elems * n
+    mesh = parallel.make_mesh({"dp": n})
+
+    def allreduce(x):
+        return jax.lax.psum(x, "dp")
+
+    fn = jax.jit(jax.shard_map(allreduce, mesh=mesh, in_specs=P("dp"),
+                               out_specs=P("dp")))
+    # per-device shard of elems/n; global array (elems,)
+    x = jnp.ones((elems,), jnp.float32)
+    from jax.sharding import NamedSharding
+
+    x = jax.device_put(x, NamedSharding(mesh, P("dp")))
+    fn(x).block_until_ready()
+    tic = time.time()
+    for _ in range(steps):
+        out = fn(x)
+    out.block_until_ready()
+    wall = (time.time() - tic) / steps
+    # bus GB/s over the per-device message size (shard), not the global;
+    # n=1 has no bus traffic — report raw touch bandwidth so the harness
+    # still produces a number on a single chip
+    factor = 2 * (n - 1) / n if n > 1 else 1.0
+    bus_gbs = factor * (shard_elems * 4) / wall / 1e9
+    print(json.dumps({
+        "metric": "kvstore_allreduce_bus_bandwidth",
+        "value": round(bus_gbs, 2),
+        "unit": "GB/s",
+        "devices": n,
+        "payload_mb": mb,
+        "vs_baseline": 0.0,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,108 @@
+#!/usr/bin/env python
+"""Image classification training (reference:
+``example/image-classification/train_cifar10.py:?`` style, BASELINE
+config 1).
+
+Synthetic CIFAR-shaped data by default so it runs anywhere; pass
+``--rec path.rec`` (from ``tools/im2rec.py``) for a real RecordIO
+pipeline.  One-line context swap: everything below is the reference's
+Gluon training loop; ``mx.tpu()`` + ``dist_tpu_sync`` are the only
+TPU-isms.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+
+
+def synthetic_batches(batch, steps, classes=10):
+    rng = np.random.RandomState(0)
+    for _ in range(steps):
+        x = nd.array(rng.uniform(0, 1, (batch, 3, 32, 32))
+                     .astype(np.float32))
+        y = nd.array(rng.randint(0, classes, (batch,)))
+        yield x, y
+
+
+def recordio_batches(rec, batch, steps):
+    from mxnet_tpu import io
+
+    it = io.ImageRecordIter(path_imgrec=rec, data_shape=(3, 32, 32),
+                            batch_size=batch, shuffle=True)
+    n = 0
+    while n < steps:
+        it.reset()
+        got_any = False
+        for b in it:
+            got_any = True
+            yield b.data[0], b.label[0]
+            n += 1
+            if n >= steps:
+                return
+        if not got_any:
+            raise RuntimeError(f"{rec!r} yielded no batches")
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default="resnet18_v1")
+    p.add_argument("--batch", type=int, default=64)
+    p.add_argument("--steps", type=int, default=30)
+    p.add_argument("--lr", type=float, default=0.05)
+    p.add_argument("--rec", default=None)
+    p.add_argument("--amp", action="store_true")
+    args = p.parse_args()
+
+    mx.random.seed(42)
+    net = gluon.model_zoo.vision.get_model(args.model, classes=10)
+    net.initialize(mx.init.Xavier())
+    net(nd.ones((1, 3, 32, 32)))  # resolve deferred shapes cheaply
+    if args.amp:
+        from mxnet_tpu import amp
+
+        amp.init(target_dtype="bfloat16")
+    net.hybridize(static_alloc=True)
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": args.lr, "momentum": 0.9},
+                            kvstore="device")
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    metric = mx.metric.Accuracy()
+    speed = mx.callback.Speedometer(args.batch, frequent=10)
+
+    batches = (recordio_batches(args.rec, args.batch, args.steps)
+               if args.rec else
+               synthetic_batches(args.batch, args.steps))
+    tic = None
+    timed = 0
+    for i, (x, y) in enumerate(batches):
+        with autograd.record():
+            out = net(x)
+            loss = loss_fn(out, y)
+        loss.backward()
+        trainer.step(args.batch)
+        metric.update(y, out)
+        speed(mx.callback.BatchEndParam(epoch=0, nbatch=i,
+                                        eval_metric=metric, locals=None))
+        if tic is None:   # first step paid XLA compile; time the rest
+            nd.waitall()
+            tic = time.time()
+        else:
+            timed += 1
+    nd.waitall()
+    name, acc = metric.get()
+    ips = args.batch * timed / (time.time() - tic) if timed else 0.0
+    print(f"done: {args.steps} steps, {ips:.0f} img/s (steady state), "
+          f"{name}={acc:.3f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,114 @@
+#!/usr/bin/env python
+"""Llama pretraining step benchmark over a device mesh (BASELINE stretch
+config 5 — capability the reference never had).
+
+Single chip: ``python examples/train_llama.py --layers 4 --hidden 512``.
+Virtual multi-chip (any machine):
+``XLA_FLAGS=--xla_force_host_platform_device_count=8 BENCH_PLATFORM=cpu \
+python examples/train_llama.py --mesh dp2,tp2,sp2 --attn ring``
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def parse_mesh(spec):
+    out = {}
+    for part in spec.split(","):
+        name = part.rstrip("0123456789")
+        out[name] = int(part[len(name):])
+    return out
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--layers", type=int, default=4)
+    p.add_argument("--hidden", type=int, default=512)
+    p.add_argument("--heads", type=int, default=8)
+    p.add_argument("--kv-heads", type=int, default=4)
+    p.add_argument("--vocab", type=int, default=2048)
+    p.add_argument("--seq", type=int, default=512)
+    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--steps", type=int, default=10)
+    p.add_argument("--mesh", default=None, help="e.g. dp2,tp2,sp2")
+    p.add_argument("--attn", default="flash",
+                   choices=["flash", "sdpa", "ring", "ulysses"])
+    args = p.parse_args()
+
+    plat = os.environ.get("BENCH_PLATFORM")
+    if plat:
+        import jax
+
+        jax.config.update("jax_platforms", plat)
+
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon, nd, parallel
+    from mxnet_tpu.models import llama
+
+    mx.random.seed(0)
+    cfg = dict(hidden_size=args.hidden,
+               intermediate_size=int(args.hidden * 2.75),
+               num_layers=args.layers, num_heads=args.heads,
+               num_kv_heads=args.kv_heads, vocab_size=args.vocab,
+               max_seq_len=args.seq, attn_mode=args.attn)
+    mesh = parallel.make_mesh(parse_mesh(args.mesh)) if args.mesh else None
+    scope = parallel.mesh_scope(mesh) if mesh else None
+    if scope:
+        scope.__enter__()
+    try:
+        net = llama.LlamaForCausalLM(llama.LlamaConfig(**cfg))
+        net.initialize(mx.init.Xavier())
+        if mesh:
+            llama.shard_llama(net, mesh)
+        net.hybridize(static_alloc=True)
+        trainer = gluon.Trainer(
+            net.collect_params(), "adam", {"learning_rate": 3e-4},
+            kvstore="dist_tpu_sync" if mesh else "device")
+        rng = np.random.RandomState(0)
+        ids = nd.array(rng.randint(0, args.vocab,
+                                   (args.batch, args.seq)), dtype="int32")
+        labels = nd.array(rng.randint(0, args.vocab,
+                                      (args.batch, args.seq)),
+                          dtype="int32")
+        if mesh:
+            ids = parallel.shard_batch(ids, mesh)
+            labels = parallel.shard_batch(labels, mesh)
+
+        ntok = args.batch * args.seq
+
+        def step():
+            with autograd.record():
+                logits = net(ids)
+                # softmax_cross_entropy SUMS over tokens (reference
+                # contract); normalize to per-token loss
+                loss = nd.softmax_cross_entropy(
+                    logits.reshape((-1, args.vocab)),
+                    labels.reshape((-1,))) / ntok
+            loss.backward()
+            trainer.step(args.batch)
+            return loss
+
+        step().wait_to_read()  # compile
+        tic = time.time()
+        for _ in range(args.steps):
+            loss = step()
+        loss.wait_to_read()
+        wall = time.time() - tic
+        toks = args.batch * args.seq * args.steps / wall
+        print(f"mesh={dict(mesh.shape) if mesh else None} "
+              f"attn={args.attn}: {toks:.0f} tok/s, "
+              f"loss={float(loss.asscalar()):.3f}")
+    finally:
+        if scope:
+            scope.__exit__(None, None, None)
+
+
+if __name__ == "__main__":
+    main()

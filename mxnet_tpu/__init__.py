@@ -47,6 +47,7 @@ _LAZY = {
     "module": ".module",
     "mod": ".module",
     "operator": ".operator",
+    "rtc": ".rtc",
     "executor": ".executor",
     "name": ".name",
     "gluon": ".gluon",

@@ -1,0 +1,52 @@
+"""Runtime kernel compilation.
+
+Reference: ``python/mxnet/rtc.py:?`` — ``CudaModule``/``CudaKernel`` wrap
+NVRTC to compile CUDA C at runtime and launch it on NDArrays (SURVEY §2.4
+misc row).
+
+TPU-native: there is no CUDA C on TPU; the runtime-kernel story is
+**Pallas**.  ``PallasKernel`` wraps a user-supplied pallas kernel function
+into an NDArray-level op on the same dispatch/autograd machinery every
+built-in op uses — the role ``CudaModule.get_kernel().launch`` played.
+``CudaModule`` raises with guidance instead of silently missing.
+"""
+from __future__ import annotations
+
+from .base import MXNetError
+
+__all__ = ["CudaModule", "PallasKernel"]
+
+
+class CudaModule:
+    def __init__(self, *args, **kwargs):
+        raise MXNetError(
+            "CUDA runtime compilation does not exist on TPU; write a "
+            "Pallas kernel (jax.experimental.pallas) and wrap it with "
+            "mxnet_tpu.rtc.PallasKernel")
+
+
+class PallasKernel:
+    """Wrap a jax/pallas callable into an ``mx.nd`` op.
+
+    ``fn(*raw_arrays) -> raw array (or tuple)`` — typically a
+    ``pl.pallas_call`` closure.  The wrapper routes through ``apply_op``
+    so autograd taping, AMP casts and profiler events all apply.
+    """
+
+    def __init__(self, fn, name=None):
+        self._fn = fn
+        self._name = name or getattr(fn, "__name__", "pallas_kernel")
+
+    def launch(self, *args, **const):
+        from .ops.registry import apply_op
+
+        if const:
+            fn = self._fn
+
+            def bound(*raws):
+                return fn(*raws, **const)
+
+            return apply_op(bound, *args, name=self._name)
+        return apply_op(self._fn, *args, name=self._name)
+
+    __call__ = launch

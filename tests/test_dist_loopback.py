@@ -1,0 +1,95 @@
+"""Multi-process distributed loopback test.
+
+Reference model (SURVEY §4): the nightly dist tests spawn scheduler +
+servers + workers as local processes via ``tools/launch.py -n N --launcher
+local`` and assert cross-worker consistency after push/pull rounds
+(tests/nightly/dist_sync_kvstore.py:?).  TPU analog: N local CPU
+processes form a ``jax.distributed`` group through the same launcher env
+contract (MXT_*), run a psum over the process mesh, and every replica
+must hold the identical global result.
+"""
+import os
+import subprocess
+import sys
+
+import pytest
+
+TOOLS = os.path.join(os.path.dirname(__file__), "..", "tools")
+
+_WORKER = r"""
+import os
+import sys
+sys.path.insert(0, os.environ["REPO_ROOT"])
+# each process is a single-device CPU host in the group
+os.environ.pop("XLA_FLAGS", None)
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, parallel
+
+parallel.initialize()  # picks up MXT_* env from tools/launch.py
+rank = jax.process_index()
+n = jax.process_count()
+assert n == int(os.environ["MXT_NUM_PROCESSES"])
+
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+mesh = parallel.make_mesh({"dp": n})
+with parallel.mesh_scope(mesh):
+    # global (n, 4): each process owns one row filled with rank+1;
+    # after psum over dp every replica must hold n(n+1)/2
+    sharding = NamedSharding(mesh, P("dp", None))
+    garr = jax.make_array_from_process_local_data(
+        sharding, np.full((1, 4), float(rank + 1), np.float32))
+
+    def summed(x):
+        return jax.lax.psum(x, "dp")
+
+    out = jax.jit(jax.shard_map(summed, mesh=mesh,
+                                in_specs=P("dp", None),
+                                out_specs=P("dp", None)))(garr)
+    want = n * (n + 1) / 2
+    got = np.asarray(out.addressable_data(0))
+    assert np.allclose(got, want), (rank, got, want)
+
+with open(os.environ["OUT_FILE"] + os.environ["MXT_PROCESS_ID"], "w") as f:
+    f.write("ok")
+"""
+
+
+def _free_port():
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.mark.skipif(sys.platform != "linux", reason="loopback group")
+def test_jax_distributed_loopback_psum(tmp_path):
+    import signal
+
+    script = tmp_path / "worker.py"
+    script.write_text(_WORKER)
+    out = str(tmp_path / "out")
+    env = dict(os.environ)
+    env["OUT_FILE"] = out
+    env["MXT_LAUNCH_PLATFORM"] = "cpu"
+    env["REPO_ROOT"] = os.path.join(os.path.dirname(__file__), "..")
+    n = 2
+    # own session so a timeout can reap launch.py AND its workers; free
+    # port so concurrent runs don't collide
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(TOOLS, "launch.py"), "-n", str(n),
+         "--coordinator", f"127.0.0.1:{_free_port()}",
+         sys.executable, str(script)], env=env, start_new_session=True)
+    try:
+        rc = proc.wait(timeout=240)
+    except subprocess.TimeoutExpired:
+        os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+        raise
+    assert rc == 0
+    for i in range(n):
+        assert os.path.exists(out + str(i)), f"worker {i} did not finish"
