@@ -29,7 +29,6 @@ def main():
 
         jax.config.update("jax_platforms", plat)
     import jax
-    import jax.numpy as jnp
     import numpy as np
     from jax.sharding import PartitionSpec as P
 
@@ -49,11 +48,12 @@ def main():
 
     fn = jax.jit(jax.shard_map(allreduce, mesh=mesh, in_specs=P("dp"),
                                out_specs=P("dp")))
-    # per-device shard of elems/n; global array (elems,)
-    x = jnp.ones((elems,), jnp.float32)
+    # stage from HOST so no single device ever holds the full n-shard
+    # payload (device_put of a numpy array shards directly)
     from jax.sharding import NamedSharding
 
-    x = jax.device_put(x, NamedSharding(mesh, P("dp")))
+    x = jax.device_put(np.ones((elems,), np.float32),
+                       NamedSharding(mesh, P("dp")))
     fn(x).block_until_ready()
     tic = time.time()
     for _ in range(steps):

@@ -76,7 +76,9 @@ def main():
                             kvstore="device")
     loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
     metric = mx.metric.Accuracy()
-    speed = mx.callback.Speedometer(args.batch, frequent=10)
+    # auto_reset=False: keep whole-run accuracy for the summary line
+    speed = mx.callback.Speedometer(args.batch, frequent=10,
+                                    auto_reset=False)
 
     batches = (recordio_batches(args.rec, args.batch, args.steps)
                if args.rec else

@@ -92,7 +92,8 @@ def main():
                     logits.reshape((-1, args.vocab)),
                     labels.reshape((-1,))) / ntok
             loss.backward()
-            trainer.step(args.batch)
+            # loss already per-token; step(1) keeps rescale_grad = 1
+            trainer.step(1)
             return loss
 
         step().wait_to_read()  # compile

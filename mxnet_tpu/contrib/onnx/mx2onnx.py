@@ -1,0 +1,255 @@
+"""Symbol graph → ONNX ModelProto exporter.
+
+Reference: ``python/mxnet/contrib/onnx/mx2onnx/export_model.py:?`` +
+``_op_translations.py:?`` (SURVEY §2.4) — per-op translation table from
+the nnvm graph to ONNX nodes.  Here the walk runs over the native Symbol
+node graph and the bytes are produced by the wire-format encoder in
+``_proto.py`` (no ``onnx`` package dependency); opset 13.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ...base import MXNetError
+from . import _proto as P
+
+_OPSET = 13
+_IR_VERSION = 8
+
+
+def _tensor(name, arr):
+    arr = np.ascontiguousarray(arr)
+    dt = {np.dtype(np.float32): P.FLOAT, np.dtype(np.float64): P.DOUBLE,
+          np.dtype(np.int64): P.INT64, np.dtype(np.int32): P.INT32,
+          np.dtype(np.int8): P.INT8, np.dtype(np.uint8): P.UINT8,
+          np.dtype(np.float16): P.FLOAT16}.get(arr.dtype)
+    if dt is None:
+        raise MXNetError(f"unsupported dtype {arr.dtype} for ONNX export")
+    body = b"".join(P.fint(1, d) for d in arr.shape)
+    body += P.fint(2, dt)
+    body += P.fstr(8, name)
+    body += P.fbytes(9, arr.tobytes())          # raw_data
+    return body
+
+
+def _value_info(name, shape, elem_type=P.FLOAT):
+    dims = b"".join(P.fbytes(1, P.fint(1, int(d))) for d in shape)
+    tensor_type = P.fint(1, elem_type) + P.fbytes(2, dims)
+    type_proto = P.fbytes(1, tensor_type)
+    return P.fstr(1, name) + P.fbytes(2, type_proto)
+
+
+def _attr(name, value):
+    body = P.fstr(1, name)
+    if isinstance(value, float):
+        body += P.ffloat(2, value) + P.fint(20, P.ATTR_FLOAT)
+    elif isinstance(value, bool) or isinstance(value, (int, np.integer)):
+        body += P.fint(3, int(value)) + P.fint(20, P.ATTR_INT)
+    elif isinstance(value, str):
+        body += P.fbytes(4, value.encode()) + P.fint(20, P.ATTR_STRING)
+    elif isinstance(value, (list, tuple)):
+        body += P.fpacked_ints(8, value) + P.fint(20, P.ATTR_INTS)
+    else:
+        raise MXNetError(f"unsupported attribute {name}={value!r}")
+    return body
+
+
+def _node(op_type, inputs, outputs, name, attrs=None):
+    body = b"".join(P.fstr(1, i) for i in inputs)
+    body += b"".join(P.fstr(2, o) for o in outputs)
+    body += P.fstr(3, name)
+    body += P.fstr(4, op_type)
+    for k, v in (attrs or {}).items():
+        body += P.fbytes(5, _attr(k, v))
+    return body
+
+
+def _tup(v, n=2):
+    if v is None:
+        return (1,) * n
+    t = tuple(int(x) for x in (v if isinstance(v, (list, tuple)) else
+                               (v,) * n))
+    return t
+
+
+# --- per-op translations ----------------------------------------------------
+
+def _conv(node, ins, out, attrs):
+    kernel = _tup(attrs.get("kernel"))
+    stride = _tup(attrs.get("stride"))
+    pad = _tup(attrs.get("pad"), len(kernel))
+    dil = _tup(attrs.get("dilate"))
+    a = {"kernel_shape": kernel, "strides": stride,
+         "pads": pad + pad, "dilations": dil,
+         "group": int(attrs.get("num_group", 1))}
+    return [_node("Conv", ins, [out], out, a)]
+
+
+def _fc(node, ins, out, attrs):
+    flatten = str(attrs.get("flatten", True)).lower() != "false"
+    nodes = []
+    data = ins[0]
+    if flatten:
+        nodes.append(_node("Flatten", [data], [out + "_flat"],
+                           out + "_flatten", {"axis": 1}))
+        data = out + "_flat"
+    gemm_ins = [data, ins[1]] + ins[2:]
+    nodes.append(_node("Gemm", gemm_ins, [out], out,
+                       {"alpha": 1.0, "beta": 1.0, "transA": 0,
+                        "transB": 1}))
+    return nodes
+
+
+_ACT = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
+        "softrelu": "Softplus", "softsign": "Softsign"}
+
+
+def _activation(node, ins, out, attrs):
+    act = attrs.get("act_type", "relu")
+    if act not in _ACT:
+        raise MXNetError(f"ONNX export: unsupported act_type {act!r}")
+    return [_node(_ACT[act], ins[:1], [out], out)]
+
+
+def _bn(node, ins, out, attrs):
+    # mxnet order: data gamma beta moving_mean moving_var (matches ONNX)
+    return [_node("BatchNormalization", ins[:5], [out], out,
+                  {"epsilon": float(attrs.get("eps", 1e-5)),
+                   "momentum": float(attrs.get("momentum", 0.9))})]
+
+
+def _pool(node, ins, out, attrs):
+    ptype = attrs.get("pool_type", "max")
+    if str(attrs.get("global_pool", False)).lower() in ("true", "1"):
+        op = "GlobalAveragePool" if ptype == "avg" else "GlobalMaxPool"
+        return [_node(op, ins[:1], [out], out)]
+    kernel = _tup(attrs.get("kernel"))
+    stride = _tup(attrs.get("stride"))
+    pad = _tup(attrs.get("pad"), len(kernel))
+    op = "AveragePool" if ptype == "avg" else "MaxPool"
+    return [_node(op, ins[:1], [out], out,
+                  {"kernel_shape": kernel, "strides": stride,
+                   "pads": pad + pad})]
+
+
+def _simple(onnx_op, n_in=1):
+    def conv(node, ins, out, attrs):
+        return [_node(onnx_op, ins[:n_in], [out], out)]
+    return conv
+
+
+def _softmax(node, ins, out, attrs):
+    return [_node("Softmax", ins[:1], [out], out,
+                  {"axis": int(attrs.get("axis", -1))})]
+
+
+def _concat(node, ins, out, attrs):
+    return [_node("Concat", ins, [out], out,
+                  {"axis": int(attrs.get("dim", 1))})]
+
+
+def _dropout(node, ins, out, attrs):
+    return [_node("Identity", ins[:1], [out], out)]  # inference export
+
+
+def _elemwise(onnx_op):
+    def conv(node, ins, out, attrs):
+        return [_node(onnx_op, ins[:2], [out], out)]
+    return conv
+
+
+_TRANSLATIONS = {
+    "Convolution": _conv,
+    "FullyConnected": _fc,
+    "Activation": _activation,
+    "BatchNorm": _bn,
+    "batch_norm": _bn,
+    "Pooling": _pool,
+    "Flatten": lambda n, i, o, a: [_node("Flatten", i[:1], [o], o,
+                                         {"axis": 1})],
+    "softmax": _softmax,
+    "log_softmax": lambda n, i, o, a: [_node("LogSoftmax", i[:1], [o], o)],
+    "Concat": _concat,
+    "concat": _concat,
+    "Dropout": _dropout,
+    "dropout": _dropout,
+    "relu": _simple("Relu"),
+    "sigmoid": _simple("Sigmoid"),
+    "tanh": _simple("Tanh"),
+    "exp": _simple("Exp"),
+    "log": _simple("Log"),
+    "sqrt": _simple("Sqrt"),
+    "elemwise_add": _elemwise("Add"),
+    "add": _elemwise("Add"),
+    "broadcast_add": _elemwise("Add"),
+    "elemwise_mul": _elemwise("Mul"),
+    "mul": _elemwise("Mul"),
+    "broadcast_mul": _elemwise("Mul"),
+    "elemwise_sub": _elemwise("Sub"),
+    "sub": _elemwise("Sub"),
+}
+
+
+def export_model(sym, params, input_shapes, input_types=None,
+                 onnx_file_path="model.onnx", verbose=False):
+    """Reference ``mx.contrib.onnx.export_model``: Symbol + params →
+    ONNX file.  ``input_shapes``: list of shapes for the graph's data
+    inputs (non-param vars, graph order)."""
+    params = {k.split(":", 1)[-1]: v for k, v in params.items()}
+    order = sym._topo()
+    names = {}           # (id(node), oidx) -> onnx tensor name
+    nodes_out = []
+    initializers = []
+    graph_inputs = []
+    data_idx = 0
+
+    for node in order:
+        if node.is_var():
+            names[(id(node), 0)] = node.name
+            if node.name in params:
+                arr = params[node.name]
+                initializers.append(
+                    _tensor(node.name, np.asarray(arr.asnumpy())))
+            else:
+                if data_idx >= len(input_shapes):
+                    raise MXNetError(
+                        f"no input shape provided for {node.name!r}")
+                graph_inputs.append(
+                    _value_info(node.name, input_shapes[data_idx]))
+                data_idx += 1
+            continue
+        trans = _TRANSLATIONS.get(node.op)
+        if trans is None:
+            raise MXNetError(
+                f"ONNX export: op {node.op!r} has no translation "
+                f"(supported: {sorted(_TRANSLATIONS)})")
+        ins = [names[(id(s), oi)] for s, oi in node.inputs]
+        out_name = node.name
+        for i in range(node.num_outputs):
+            names[(id(node), i)] = out_name if i == 0 else \
+                f"{out_name}_out{i}"
+        attrs = {k: v for k, v in node.attrs.items()
+                 if not k.startswith("__")}
+        nodes_out.extend(trans(node, ins, out_name, attrs))
+
+    outputs = [_value_info(names[(id(n), oi)], ())
+               for n, oi in sym._heads]
+    graph = b"".join(P.fbytes(1, nb) for nb in nodes_out)
+    graph += P.fstr(2, "mxnet_tpu_exported")
+    graph += b"".join(P.fbytes(5, t) for t in initializers)
+    graph += b"".join(P.fbytes(11, vi) for vi in graph_inputs)
+    graph += b"".join(P.fbytes(12, vo) for vo in outputs)
+
+    opset = P.fint(2, _OPSET)  # default domain ""
+    model = P.fint(1, _IR_VERSION)
+    model += P.fstr(2, "mxnet_tpu")
+    model += P.fstr(3, "0.1")
+    model += P.fbytes(7, graph)
+    model += P.fbytes(8, opset)
+
+    with open(onnx_file_path, "wb") as f:
+        f.write(model)
+    if verbose:
+        print(f"wrote {onnx_file_path}: {len(nodes_out)} nodes, "
+              f"{len(initializers)} initializers")
+    return onnx_file_path

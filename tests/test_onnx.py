@@ -1,0 +1,123 @@
+"""ONNX export tests: structural round trip through the bundled
+wire-format decoder (reference model: tests/python/unittest/onnx/ export
+tests, SURVEY §2.4 onnx row)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+import mxnet_tpu.symbol as sym
+from mxnet_tpu import nd
+from mxnet_tpu.contrib.onnx import export_model
+from mxnet_tpu.contrib.onnx import _proto as P
+
+
+def _mlp():
+    data = sym.var("data")
+    net = sym.FullyConnected(data, num_hidden=16, name="fc1")
+    net = sym.Activation(net, act_type="relu", name="relu1")
+    net = sym.FullyConnected(net, num_hidden=4, name="fc2")
+    net = sym.softmax(net, name="out")
+    return net
+
+
+def _params_for(net, data_shape):
+    shapes, _, aux_shapes = net.infer_shape(data=data_shape)
+    out = {n: nd.random.uniform(-1, 1, shape=s)
+           for n, s in zip(net.list_arguments(), shapes) if n != "data"}
+    for n, s in zip(net.list_auxiliary_states(), aux_shapes):
+        out[n] = nd.ones(s) if n.endswith("var") else nd.zeros(s)
+    return out
+
+
+def _model_fields(path):
+    with open(path, "rb") as f:
+        model = P.parse(f.read())
+    return model
+
+
+def test_export_mlp_structure(tmp_path):
+    net = _mlp()
+    params = _params_for(net, (2, 8))
+    path = str(tmp_path / "mlp.onnx")
+    export_model(net, params, [(2, 8)], onnx_file_path=path)
+    model = _model_fields(path)
+    # ModelProto: ir_version(1), producer(2), graph(7), opset(8)
+    assert P.fields(model, 1)[0] == 8
+    assert P.fields(model, 2)[0] == b"mxnet_tpu"
+    opset = P.parse(P.fields(model, 8)[0])
+    assert P.fields(opset, 2)[0] == 13
+    graph = P.parse(P.fields(model, 7)[0])
+    node_bufs = P.fields(graph, 1)
+    ops = []
+    for nb in node_bufs:
+        nproto = P.parse(nb)
+        ops.append(P.fields(nproto, 4)[0].decode())
+    # fc → Flatten+Gemm each; relu; softmax
+    assert ops == ["Flatten", "Gemm", "Relu", "Flatten", "Gemm",
+                   "Softmax"]
+    # initializers carry the 4 param tensors with raw data
+    inits = P.fields(graph, 5)
+    assert len(inits) == 4
+    t0 = P.parse(inits[0])
+    name = P.fields(t0, 8)[0].decode()
+    assert name in params
+    raw = P.fields(t0, 9)[0]
+    want = params[name].asnumpy()
+    got = onp.frombuffer(raw, onp.float32).reshape(want.shape)
+    onp.testing.assert_allclose(got, want, rtol=1e-6)
+    # one graph input (data), one output
+    assert len(P.fields(graph, 11)) == 1
+    assert len(P.fields(graph, 12)) == 1
+    vin = P.parse(P.fields(graph, 11)[0])
+    assert P.fields(vin, 1)[0] == b"data"
+
+
+def test_export_conv_net(tmp_path):
+    data = sym.var("data")
+    net = sym.Convolution(data, kernel=(3, 3), num_filter=8, pad=(1, 1),
+                          name="conv1")
+    net = sym.BatchNorm(net, name="bn1")
+    net = sym.Activation(net, act_type="relu", name="relu1")
+    net = sym.Pooling(net, kernel=(2, 2), stride=(2, 2), pool_type="max",
+                      name="pool1")
+    net = sym.Pooling(net, global_pool=True, pool_type="avg", name="gap")
+    net = sym.Flatten(net, name="flat")
+    net = sym.FullyConnected(net, num_hidden=2, name="fc")
+    params = _params_for(net, (1, 3, 8, 8))
+    path = str(tmp_path / "conv.onnx")
+    export_model(net, params, [(1, 3, 8, 8)], onnx_file_path=path)
+    graph = P.parse(P.fields(_model_fields(path), 7)[0])
+    ops = [P.fields(P.parse(nb), 4)[0].decode()
+           for nb in P.fields(graph, 1)]
+    assert ops[0] == "Conv"
+    assert "BatchNormalization" in ops
+    assert "MaxPool" in ops and "GlobalAveragePool" in ops
+    # conv node carries kernel/pads/strides attrs
+    conv_attrs = {}
+    for ab in P.fields(P.parse(P.fields(graph, 1)[0]), 5):
+        ap = P.parse(ab)
+        conv_attrs[P.fields(ap, 1)[0].decode()] = ap
+    assert {"kernel_shape", "strides", "pads",
+            "group"} <= set(conv_attrs)
+
+
+def test_export_rejects_unknown_op(tmp_path):
+    data = sym.var("data")
+    net = sym.topk(data, k=2, name="t")
+    with pytest.raises(mx.MXNetError):
+        export_model(net, {}, [(2, 8)],
+                     onnx_file_path=str(tmp_path / "x.onnx"))
+
+
+def test_import_gated():
+    from mxnet_tpu.contrib import onnx as onnx_mod
+
+    with pytest.raises((ImportError, NotImplementedError)):
+        onnx_mod.import_model("nonexistent.onnx")
+
+
+def test_varint_roundtrip():
+    for v in (0, 1, 127, 128, 300, 2 ** 32, 2 ** 60):
+        buf = P.fint(3, v)
+        parsed = P.parse(buf)
+        assert parsed == [(3, 0, v)]
