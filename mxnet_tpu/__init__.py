@@ -60,6 +60,7 @@ _LAZY = {
     "lr_scheduler": ".lr_scheduler",
     "callback": ".callback",
     "io": ".io",
+    "rnn": ".rnn",
     "image": ".image",
     "parallel": ".parallel",
     "profiler": ".profiler",

@@ -64,21 +64,26 @@ def _node(op_type, inputs, outputs, name, attrs=None):
     return body
 
 
-def _tup(v, n=2):
+def _tup(v, n, default):
+    """Normalize kernel/stride/pad attrs to rank ``n`` (same defaults as
+    the runtime ops: stride/dilate → 1, pad → 0)."""
     if v is None:
-        return (1,) * n
+        return (default,) * n
     t = tuple(int(x) for x in (v if isinstance(v, (list, tuple)) else
                                (v,) * n))
+    if len(t) != n:
+        raise MXNetError(f"attribute rank {len(t)} != spatial rank {n}")
     return t
 
 
 # --- per-op translations ----------------------------------------------------
 
 def _conv(node, ins, out, attrs):
-    kernel = _tup(attrs.get("kernel"))
-    stride = _tup(attrs.get("stride"))
-    pad = _tup(attrs.get("pad"), len(kernel))
-    dil = _tup(attrs.get("dilate"))
+    kernel = tuple(int(x) for x in attrs["kernel"])
+    n = len(kernel)
+    stride = _tup(attrs.get("stride"), n, 1)
+    pad = _tup(attrs.get("pad"), n, 0)
+    dil = _tup(attrs.get("dilate"), n, 1)
     a = {"kernel_shape": kernel, "strides": stride,
          "pads": pad + pad, "dilations": dil,
          "group": int(attrs.get("num_group", 1))}
@@ -123,9 +128,10 @@ def _pool(node, ins, out, attrs):
     if str(attrs.get("global_pool", False)).lower() in ("true", "1"):
         op = "GlobalAveragePool" if ptype == "avg" else "GlobalMaxPool"
         return [_node(op, ins[:1], [out], out)]
-    kernel = _tup(attrs.get("kernel"))
-    stride = _tup(attrs.get("stride"))
-    pad = _tup(attrs.get("pad"), len(kernel))
+    kernel = tuple(int(x) for x in attrs["kernel"])
+    n = len(kernel)
+    stride = _tup(attrs.get("stride"), n, 1)
+    pad = _tup(attrs.get("pad"), n, 0)
     op = "AveragePool" if ptype == "avg" else "MaxPool"
     return [_node(op, ins[:1], [out], out,
                   {"kernel_shape": kernel, "strides": stride,
@@ -190,12 +196,38 @@ _TRANSLATIONS = {
 }
 
 
+_NP2ONNX = {"float32": P.FLOAT, "float64": P.DOUBLE, "int64": P.INT64,
+            "int32": P.INT32, "int8": P.INT8, "uint8": P.UINT8,
+            "float16": P.FLOAT16}
+
+
 def export_model(sym, params, input_shapes, input_types=None,
                  onnx_file_path="model.onnx", verbose=False):
     """Reference ``mx.contrib.onnx.export_model``: Symbol + params →
-    ONNX file.  ``input_shapes``: list of shapes for the graph's data
-    inputs (non-param vars, graph order)."""
-    params = {k.split(":", 1)[-1]: v for k, v in params.items()}
+    ONNX file.  ``input_shapes``/``input_types``: per data input
+    (non-param vars, graph order; types default float32)."""
+    params = dict({k.split(":", 1)[-1]: v for k, v in params.items()})
+    # fix_gamma BatchNorms compute with gamma == 1 (runtime contract,
+    # ops/nn_ops.py batch_norm); the exported initializer must match
+    for node in sym._topo():
+        if node.op in ("BatchNorm", "batch_norm") and \
+                str(node.attrs.get("fix_gamma", True)).lower() in \
+                ("true", "1"):
+            if len(node.inputs) > 1:
+                gname = node.inputs[1][0].name
+                if gname in params:
+                    params[gname] = params[gname] * 0 + 1  # ones_like
+    # output shapes/dtypes for the declared ValueInfos
+    try:
+        shape_kwargs = {}
+        di = 0
+        for node in sym._topo():
+            if node.is_var() and node.name not in params:
+                shape_kwargs[node.name] = tuple(input_shapes[di])
+                di += 1
+        _, out_shapes, _ = sym.infer_shape(**shape_kwargs)
+    except Exception:
+        out_shapes = [() for _ in sym._heads]
     order = sym._topo()
     names = {}           # (id(node), oidx) -> onnx tensor name
     nodes_out = []
@@ -214,8 +246,12 @@ def export_model(sym, params, input_shapes, input_types=None,
                 if data_idx >= len(input_shapes):
                     raise MXNetError(
                         f"no input shape provided for {node.name!r}")
+                et = P.FLOAT
+                if input_types is not None and data_idx < len(input_types):
+                    et = _NP2ONNX.get(np.dtype(input_types[data_idx]).name,
+                                      P.FLOAT)
                 graph_inputs.append(
-                    _value_info(node.name, input_shapes[data_idx]))
+                    _value_info(node.name, input_shapes[data_idx], et))
                 data_idx += 1
             continue
         trans = _TRANSLATIONS.get(node.op)
@@ -232,8 +268,8 @@ def export_model(sym, params, input_shapes, input_types=None,
                  if not k.startswith("__")}
         nodes_out.extend(trans(node, ins, out_name, attrs))
 
-    outputs = [_value_info(names[(id(n), oi)], ())
-               for n, oi in sym._heads]
+    outputs = [_value_info(names[(id(n), oi)], shp or ())
+               for (n, oi), shp in zip(sym._heads, out_shapes)]
     graph = b"".join(P.fbytes(1, nb) for nb in nodes_out)
     graph += P.fstr(2, "mxnet_tpu_exported")
     graph += b"".join(P.fbytes(5, t) for t in initializers)

@@ -270,3 +270,9 @@ class ImageIter:
     @property
     def provide_label(self):
         return self._inner.provide_label
+
+
+# detection iterator + box-aware augmenters (reference image/detection.py)
+from .detection import (ImageDetIter, CreateDetAugmenter,  # noqa: E402,F401
+                        DetHorizontalFlipAug, DetResizeAug,
+                        DetRandomCropAug, DetAugmenter)

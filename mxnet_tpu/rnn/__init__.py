@@ -1,0 +1,114 @@
+"""Top-level ``mx.rnn`` namespace — bucketing utilities + legacy cell
+aliases.
+
+Reference: ``python/mxnet/rnn/{io,rnn_cell}.py:?`` — ``BucketSentenceIter``
+feeds ``BucketingModule`` (SURVEY §2.3 D8: bucketing is the reference's
+whole sequence-length story); the legacy cell API predates gluon.rnn.
+
+TPU notes: each bucket length is its own static shape → its own XLA
+executable, exactly matching the reference's per-bucket bound executors
+(bucketing_module.py).  Batches are padded INSIDE a bucket so shapes stay
+static.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..base import MXNetError
+from ..io import DataBatch, DataDesc
+from ..ndarray import NDArray
+
+# legacy cell names alias the gluon implementations (reference kept two
+# parallel cell APIs; here one implementation serves both surfaces)
+from ..gluon.rnn import (LSTMCell, GRUCell, RNNCell,  # noqa: F401
+                         SequentialRNNCell)
+
+__all__ = ["BucketSentenceIter", "LSTMCell", "GRUCell", "RNNCell",
+           "SequentialRNNCell"]
+
+
+class BucketSentenceIter:
+    """Reference ``mx.rnn.BucketSentenceIter``: bucket variable-length
+    token sequences by length; each batch comes from ONE bucket, padded
+    to that bucket's length, with ``bucket_key`` for BucketingModule."""
+
+    def __init__(self, sentences, batch_size, buckets=None, invalid_label=-1,
+                 data_name="data", label_name="softmax_label", dtype="int32",
+                 layout="NT"):
+        if not buckets:
+            lengths = [len(s) for s in sentences]
+            buckets = sorted({b for b in (8, 16, 32, 64, 128, 256, 512)
+                              if any(l <= b for l in lengths)})
+            if not buckets:
+                raise MXNetError("no bucket can hold the given sentences")
+        self.buckets = sorted(buckets)
+        self.batch_size = batch_size
+        self.invalid_label = invalid_label
+        self.data_name = data_name
+        self.label_name = label_name
+        self._dtype = np.dtype(dtype)
+        # assign each sentence to the smallest bucket that fits
+        self.data = [[] for _ in self.buckets]
+        ndiscard = 0
+        for s in sentences:
+            idx = next((i for i, b in enumerate(self.buckets)
+                        if len(s) <= b), None)
+            if idx is None:
+                ndiscard += 1
+                continue
+            buf = np.full((self.buckets[idx],), invalid_label, self._dtype)
+            buf[:len(s)] = s
+            self.data[idx].append(buf)
+        if ndiscard:
+            print(f"WARNING: discarded {ndiscard} sentences longer than "
+                  f"the largest bucket")
+        self.data = [np.asarray(x) for x in self.data]
+        self.default_bucket_key = max(self.buckets)
+        self._plan = []
+        self._shuffled = [None] * len(self.buckets)
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [DataDesc(self.data_name,
+                         (self.batch_size, self.default_bucket_key))]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(self.label_name,
+                         (self.batch_size, self.default_bucket_key))]
+
+    def reset(self):
+        self._plan = []
+        rng = np.random.RandomState(1)
+        for i, arr in enumerate(self.data):
+            if len(arr) == 0:
+                continue
+            order = rng.permutation(len(arr))
+            self._shuffled[i] = arr[order]
+            for lo in range(0, len(arr) - self.batch_size + 1,
+                            self.batch_size):
+                self._plan.append((i, lo))
+        rng.shuffle(self._plan)
+        self._cursor = 0
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        return self.next()
+
+    def next(self):
+        if self._cursor >= len(self._plan):
+            raise StopIteration
+        i, lo = self._plan[self._cursor]
+        self._cursor += 1
+        buf = self._shuffled[i][lo:lo + self.batch_size]
+        # label = data shifted one step left (language-model contract)
+        label = np.full_like(buf, self.invalid_label)
+        label[:, :-1] = buf[:, 1:]
+        return DataBatch(
+            data=[NDArray(buf)], label=[NDArray(label)], pad=0,
+            bucket_key=self.buckets[i],
+            provide_data=[DataDesc(self.data_name, buf.shape)],
+            provide_label=[DataDesc(self.label_name, label.shape)])

@@ -1,0 +1,136 @@
+"""BucketSentenceIter + ImageDetIter tests (reference model:
+tests/python/unittest/test_io.py + test_image.py detection cases,
+SURVEY §2.5)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_bucket_sentence_iter_shapes_and_buckets():
+    rng = onp.random.RandomState(0)
+    sentences = [list(rng.randint(1, 50, rng.randint(3, 30)))
+                 for _ in range(64)]
+    it = mx.rnn.BucketSentenceIter(sentences, batch_size=8,
+                                   buckets=[8, 16, 32])
+    seen_keys = set()
+    n = 0
+    for batch in it:
+        t = batch.bucket_key
+        seen_keys.add(t)
+        assert batch.data[0].shape == (8, t)
+        assert batch.label[0].shape == (8, t)
+        d = batch.data[0].asnumpy()
+        l = batch.label[0].asnumpy()
+        # label is data shifted left by one
+        onp.testing.assert_array_equal(l[:, :-1], d[:, 1:])
+        n += 1
+    assert n >= 2 and len(seen_keys) >= 2
+    it.reset()
+    assert sum(1 for _ in it) == n
+
+
+def test_bucket_sentence_iter_discards_too_long():
+    sentences = [[1] * 4, [1] * 100]
+    it = mx.rnn.BucketSentenceIter(sentences, batch_size=1, buckets=[8])
+    batches = list(it)
+    assert len(batches) == 1
+    assert batches[0].data[0].shape == (1, 8)
+
+
+def test_bucket_iter_feeds_bucketing_module():
+    """End-to-end: BucketSentenceIter + BucketingModule (reference
+    example/rnn bucketing pattern)."""
+    import mxnet_tpu.symbol as sym
+
+    rng = onp.random.RandomState(0)
+    sentences = [list(rng.randint(1, 20, rng.randint(3, 15)))
+                 for _ in range(32)]
+    it = mx.rnn.BucketSentenceIter(sentences, batch_size=4,
+                                   buckets=[8, 16])
+
+    def gen(bucket_key):
+        data = sym.var("data")
+        emb = sym.Embedding(data, input_dim=20, output_dim=8, name="embed")
+        fc = sym.FullyConnected(emb, num_hidden=20, flatten=False,
+                                name="fc")
+        out = sym.reshape(fc, shape=(-1, 20), name="r")
+        label = sym.var("softmax_label")
+        lab = sym.reshape(label, shape=(-1,), name="rl")
+        loss = sym.SoftmaxOutput(out, lab, name="softmax")
+        return loss, ("data",), ("softmax_label",)
+
+    mod = mx.mod.BucketingModule(gen, default_bucket_key=16)
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+    for batch in it:
+        mod.forward(batch, is_train=True)
+        mod.backward()
+        mod.update()
+    out = mod.get_outputs()[0]
+    assert out.shape[-1] == 20
+
+
+def _det_imglist(n=6):
+    rng = onp.random.RandomState(0)
+    out = []
+    for i in range(n):
+        img = rng.randint(0, 255, (20, 24, 3)).astype(onp.uint8)
+        boxes = onp.array([[i % 3, 0.2, 0.3, 0.6, 0.8]], onp.float32)
+        out.append((img, boxes))
+    return out
+
+
+def test_image_det_iter_batches():
+    it = mx.image.ImageDetIter(batch_size=4, data_shape=(3, 16, 16),
+                               imglist=_det_imglist(), aug_list=[])
+    batch = next(iter(it))
+    assert batch.data[0].shape == (4, 3, 16, 16)
+    assert batch.label[0].shape == (4, 1, 5)
+    lab = batch.label[0].asnumpy()
+    assert (lab[:, 0, 0] >= 0).all()
+
+
+def test_det_flip_aug_flips_boxes():
+    from mxnet_tpu.image.detection import DetHorizontalFlipAug
+
+    rng = onp.random.RandomState(0)
+    img = onp.arange(2 * 4 * 3).reshape(2, 4, 3).astype(onp.float32)
+    boxes = onp.array([[0, 0.1, 0.2, 0.4, 0.9]], onp.float32)
+    aug = DetHorizontalFlipAug(p=1.1)  # always flip
+    img2, boxes2 = aug(img, boxes, rng)
+    onp.testing.assert_array_equal(img2, img[:, ::-1, :])
+    onp.testing.assert_allclose(boxes2[0, 1], 1 - 0.4, rtol=1e-6)
+    onp.testing.assert_allclose(boxes2[0, 3], 1 - 0.1, rtol=1e-6)
+    assert boxes2[0, 2] == 0.2 and boxes2[0, 4] == 0.9
+
+
+def test_det_crop_aug_clips_and_keeps_centers():
+    from mxnet_tpu.image.detection import DetRandomCropAug
+
+    rng = onp.random.RandomState(1)
+    img = onp.zeros((40, 40, 3), onp.float32)
+    boxes = onp.array([[1, 0.4, 0.4, 0.6, 0.6]], onp.float32)
+    aug = DetRandomCropAug(min_crop=0.7)
+    img2, boxes2 = aug(img, boxes, rng)
+    assert img2.shape[0] <= 40 and img2.shape[1] <= 40
+    if len(boxes2):
+        assert ((boxes2[:, 1:] >= 0) & (boxes2[:, 1:] <= 1)).all()
+
+
+def test_image_det_iter_to_multibox_target():
+    """Pipeline contract: ImageDetIter labels feed MultiBoxTarget."""
+    it = mx.image.ImageDetIter(batch_size=2, data_shape=(3, 32, 32),
+                               imglist=_det_imglist(4), aug_list=[
+                                   mx.image.DetResizeAug(32)])
+    batch = next(iter(it))
+    anchors = nd.contrib.MultiBoxPrior(batch.data[0], sizes=[0.5],
+                                       ratios=[1, 2])
+    cls_preds = nd.zeros((2, 4, anchors.shape[1]))
+    loc_t, loc_m, cls_t = nd.contrib.MultiBoxTarget(
+        anchors, batch.label[0], cls_preds)
+    assert loc_t.shape == (2, anchors.shape[1] * 4)
+    assert (cls_t.asnumpy() >= 0).all()
