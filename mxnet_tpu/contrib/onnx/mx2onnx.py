@@ -78,8 +78,22 @@ def _tup(v, n, default):
 
 # --- per-op translations ----------------------------------------------------
 
+def _kernel_attr(attrs, op):
+    """Kernel rank drives every other spatial attr.  The runtime accepts
+    scalar kernels (broadcast to 2D) and None (rank from data); export
+    needs an explicit rank — fail with a clear message for None."""
+    k = attrs.get("kernel")
+    if k is None:
+        raise MXNetError(
+            f"ONNX export: {op} needs an explicit kernel attribute "
+            "(the runtime infers rank from data shapes; export cannot)")
+    if isinstance(k, (int, np.integer)):
+        return (int(k), int(k))
+    return tuple(int(x) for x in k)
+
+
 def _conv(node, ins, out, attrs):
-    kernel = tuple(int(x) for x in attrs["kernel"])
+    kernel = _kernel_attr(attrs, "Convolution")
     n = len(kernel)
     stride = _tup(attrs.get("stride"), n, 1)
     pad = _tup(attrs.get("pad"), n, 0)
@@ -128,7 +142,7 @@ def _pool(node, ins, out, attrs):
     if str(attrs.get("global_pool", False)).lower() in ("true", "1"):
         op = "GlobalAveragePool" if ptype == "avg" else "GlobalMaxPool"
         return [_node(op, ins[:1], [out], out)]
-    kernel = tuple(int(x) for x in attrs["kernel"])
+    kernel = _kernel_attr(attrs, "Pooling")
     n = len(kernel)
     stride = _tup(attrs.get("stride"), n, 1)
     pad = _tup(attrs.get("pad"), n, 0)

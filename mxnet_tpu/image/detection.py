@@ -58,18 +58,22 @@ class DetHorizontalFlipAug(DetAugmenter):
 
 
 class DetRandomCropAug(DetAugmenter):
-    """Random crop keeping boxes whose center survives (reference
-    min_object_covered-style constraint, simplified)."""
+    """Random crop (applied with probability ``p``) keeping boxes whose
+    center survives (reference min_object_covered-style constraint,
+    simplified)."""
 
-    def __init__(self, min_crop=0.6, attempts=10):
-        self.min_crop = min_crop
+    def __init__(self, min_crop=0.6, attempts=10, p=1.0):
+        self.min_crop = max(min_crop, 0.1)  # never emit zero-size crops
         self.attempts = attempts
+        self.p = p
 
     def __call__(self, img, boxes, rng):
+        if rng.uniform() >= self.p:
+            return img, boxes
         h, w = img.shape[:2]
         for _ in range(self.attempts):
             scale = rng.uniform(self.min_crop, 1.0)
-            cw, ch = int(w * scale), int(h * scale)
+            cw, ch = max(int(w * scale), 1), max(int(h * scale), 1)
             x0 = rng.randint(0, w - cw + 1)
             y0 = rng.randint(0, h - ch + 1)
             if not len(boxes):
@@ -89,15 +93,51 @@ class DetRandomCropAug(DetAugmenter):
         return img, boxes
 
 
+class DetNormalizeAug(DetAugmenter):
+    """Per-channel mean/std pixel normalization (boxes untouched)."""
+
+    def __init__(self, mean, std):
+        self.mean = None if mean is None else np.asarray(mean, np.float32)
+        self.std = None if std is None else np.asarray(std, np.float32)
+
+    def __call__(self, img, boxes, rng):
+        img = np.asarray(img, np.float32)
+        if self.mean is not None:
+            img = img - self.mean
+        if self.std is not None:
+            img = img / self.std
+        return img, boxes
+
+
+class DetResizeShortAug(DetAugmenter):
+    """Resize the short edge to ``size`` keeping aspect (boxes are
+    normalized, so unchanged)."""
+
+    def __init__(self, size):
+        self.size = int(size)
+
+    def __call__(self, img, boxes, rng):
+        h, w = img.shape[:2]
+        scale = self.size / min(h, w)
+        return imresize(img, max(1, int(w * scale)),
+                        max(1, int(h * scale))), boxes
+
+
 def CreateDetAugmenter(data_shape, resize=0, rand_crop=0, rand_mirror=False,
                        mean=None, std=None, **kwargs):
-    """Reference ``CreateDetAugmenter``: standard detection pipeline."""
+    """Reference ``CreateDetAugmenter``: standard detection pipeline.
+    ``rand_crop`` is the PROBABILITY of applying the random crop
+    (reference contract)."""
     augs = []
+    if resize > 0:
+        augs.append(DetResizeShortAug(resize))
     if rand_crop > 0:
-        augs.append(DetRandomCropAug(min_crop=1.0 - rand_crop))
+        augs.append(DetRandomCropAug(min_crop=0.6, p=float(rand_crop)))
     augs.append(DetResizeAug((data_shape[2], data_shape[1])))
     if rand_mirror:
         augs.append(DetHorizontalFlipAug(0.5))
+    if mean is not None or std is not None:
+        augs.append(DetNormalizeAug(mean, std))
     return augs
 
 
@@ -180,7 +220,9 @@ class ImageDetIter(DataIter):
         for bi, ri in enumerate(idxs):
             raw, boxes = self._records[ri]
             img = imdecode_raw(raw) if isinstance(raw, bytes) else raw
-            img = np.asarray(img, np.float32)
+            # copy: augmenters return views and normalization is in-place;
+            # the cached record must never mutate across epochs
+            img = np.array(img, np.float32, copy=True)
             for aug in self._aug:
                 img, boxes = aug(img, boxes, self._rng)
             if img.shape[:2] != (h, w):

@@ -34,13 +34,17 @@ class BucketSentenceIter:
 
     def __init__(self, sentences, batch_size, buckets=None, invalid_label=-1,
                  data_name="data", label_name="softmax_label", dtype="int32",
-                 layout="NT"):
+                 layout="NT", seed=1):
         if not buckets:
             lengths = [len(s) for s in sentences]
-            buckets = sorted({b for b in (8, 16, 32, 64, 128, 256, 512)
-                              if any(l <= b for l in lengths)})
-            if not buckets:
+            ladder = (8, 16, 32, 64, 128, 256, 512)
+            # smallest ladder entry covering the longest sentence caps the
+            # ladder — default_bucket_key (and its XLA executable) stays
+            # as small as the data allows
+            top = next((b for b in ladder if max(lengths) <= b), None)
+            if top is None:
                 raise MXNetError("no bucket can hold the given sentences")
+            buckets = [b for b in ladder if b <= top]
         self.buckets = sorted(buckets)
         self.batch_size = batch_size
         self.invalid_label = invalid_label
@@ -66,6 +70,9 @@ class BucketSentenceIter:
         self.default_bucket_key = max(self.buckets)
         self._plan = []
         self._shuffled = [None] * len(self.buckets)
+        # one RNG across resets: every epoch gets a fresh shuffle, whole
+        # runs stay reproducible via `seed`
+        self._rng = np.random.RandomState(seed)
         self.reset()
 
     @property
@@ -80,7 +87,7 @@ class BucketSentenceIter:
 
     def reset(self):
         self._plan = []
-        rng = np.random.RandomState(1)
+        rng = self._rng
         for i, arr in enumerate(self.data):
             if len(arr) == 0:
                 continue

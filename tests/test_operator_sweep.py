@@ -1,0 +1,248 @@
+"""Broad operator sweep: numpy cross-checks + finite-difference gradients
+across the op families (reference model: tests/python/unittest/
+test_operator.py — the reference's single most important correctness gate,
+SURVEY §4).  Small shapes keep the O(n) finite-difference loops cheap."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.test_utils import (assert_almost_equal,
+                                  check_numeric_gradient)
+
+_RNG = onp.random.RandomState(7)
+
+
+def _rand(shape, lo=-2.0, hi=2.0):
+    return _RNG.uniform(lo, hi, shape).astype(onp.float64)
+
+
+_UNARY = [
+    # (mx op name, numpy fn, domain lo, hi)
+    ("relu", lambda x: onp.maximum(x, 0), -2, 2),
+    ("sigmoid", lambda x: 1 / (1 + onp.exp(-x)), -3, 3),
+    ("tanh", onp.tanh, -2, 2),
+    ("exp", onp.exp, -2, 1),
+    ("log", onp.log, 0.2, 3),
+    ("sqrt", onp.sqrt, 0.2, 4),
+    ("square", onp.square, -2, 2),
+    ("abs", onp.abs, 0.3, 2),          # keep away from the kink
+    ("cbrt", onp.cbrt, 0.2, 3),
+    ("rsqrt", lambda x: 1 / onp.sqrt(x), 0.3, 3),
+    ("reciprocal", lambda x: 1 / x, 0.4, 3),
+    ("sin", onp.sin, -2, 2),
+    ("cos", onp.cos, -2, 2),
+    ("arctan", onp.arctan, -2, 2),
+    ("arcsinh", onp.arcsinh, -2, 2),
+    ("expm1", onp.expm1, -1, 1),
+    ("log1p", onp.log1p, -0.5, 2),
+    ("erf", None, -2, 2),
+    ("gamma", None, 0.5, 3),
+    ("gammaln", None, 0.5, 3),
+]
+
+
+@pytest.mark.parametrize("name,ref,lo,hi",
+                         _UNARY, ids=[u[0] for u in _UNARY])
+def test_unary_forward_and_grad(name, ref, lo, hi):
+    op = getattr(nd, name)
+    x = _rand((3, 4), lo, hi)
+    got = op(nd.array(x, dtype="float64")).asnumpy()
+    if ref is not None:
+        onp.testing.assert_allclose(got, ref(x), rtol=1e-6, atol=1e-8)
+    check_numeric_gradient(lambda a: op(a), [x], eps=1e-4, rtol=2e-2,
+                           atol=1e-4)
+
+
+_BINARY = [
+    ("broadcast_add", onp.add),
+    ("broadcast_sub", onp.subtract),
+    ("broadcast_mul", onp.multiply),
+    ("broadcast_div", onp.divide),
+    ("broadcast_maximum", onp.maximum),
+    ("broadcast_minimum", onp.minimum),
+    ("broadcast_power", onp.power),
+    ("broadcast_hypot", onp.hypot),
+]
+
+
+@pytest.mark.parametrize("name,ref", _BINARY, ids=[b[0] for b in _BINARY])
+def test_binary_broadcast_forward_and_grad(name, ref):
+    op = getattr(nd, name)
+    a = _rand((3, 1, 4), 0.5, 2.0)
+    b = _rand((1, 2, 4), 0.5, 2.0)
+    got = op(nd.array(a, dtype="float64"),
+             nd.array(b, dtype="float64")).asnumpy()
+    onp.testing.assert_allclose(got, ref(a, b), rtol=1e-6)
+    check_numeric_gradient(lambda x, y: op(x, y), [a, b], eps=1e-4,
+                           rtol=2e-2, atol=1e-4)
+
+
+_REDUCE = [
+    ("sum", onp.sum),
+    ("mean", onp.mean),
+    ("prod", onp.prod),
+    ("max", onp.max),
+    ("min", onp.min),
+]
+
+
+@pytest.mark.parametrize("name,ref", _REDUCE, ids=[r[0] for r in _REDUCE])
+@pytest.mark.parametrize("axis", [None, 0, 1, (0, 2)])
+def test_reductions(name, ref, axis):
+    op = getattr(nd, name)
+    x = _rand((2, 3, 4), 0.5, 2.0)
+    got = op(nd.array(x, dtype="float64"), axis=axis).asnumpy()
+    onp.testing.assert_allclose(onp.squeeze(got),
+                                onp.squeeze(ref(x, axis=axis)), rtol=1e-6)
+    check_numeric_gradient(lambda a: op(a, axis=axis), [x], eps=1e-4,
+                           rtol=2e-2, atol=1e-4)
+
+
+def test_keepdims_reductions():
+    x = _rand((2, 3))
+    got = nd.sum(nd.array(x), axis=1, keepdims=True)
+    assert got.shape == (2, 1)
+
+
+_SHAPE_OPS = [
+    ("transpose", dict(axes=(1, 0, 2)),
+     lambda x: onp.transpose(x, (1, 0, 2))),
+    ("reshape", dict(shape=(4, 6)), lambda x: x.reshape(4, 6)),
+    ("flip", dict(axis=1), lambda x: onp.flip(x, 1)),
+    ("tile", dict(reps=(2, 1, 1)), lambda x: onp.tile(x, (2, 1, 1))),
+    ("repeat", dict(repeats=2, axis=0), lambda x: onp.repeat(x, 2, 0)),
+    ("expand_dims", dict(axis=1), lambda x: x[:, None]),
+    ("squeeze", None, None),
+]
+
+
+@pytest.mark.parametrize("name,kwargs,ref", _SHAPE_OPS,
+                         ids=[s[0] for s in _SHAPE_OPS])
+def test_shape_ops_forward_and_grad_flow(name, kwargs, ref):
+    if name == "squeeze":
+        x = _rand((2, 1, 3))
+        got = nd.squeeze(nd.array(x, dtype="float64")).asnumpy()
+        onp.testing.assert_allclose(got, onp.squeeze(x))
+        return
+    op = getattr(nd, name)
+    x = _rand((2, 3, 4))
+    got = op(nd.array(x, dtype="float64"), **kwargs).asnumpy()
+    onp.testing.assert_allclose(got, ref(x), rtol=1e-7)
+    check_numeric_gradient(lambda a: op(a, **kwargs), [x], eps=1e-4,
+                           rtol=2e-2, atol=1e-4)
+
+
+def test_dot_batchdot_grads():
+    a = _rand((3, 4), 0.2, 1)
+    b = _rand((4, 5), 0.2, 1)
+    onp.testing.assert_allclose(
+        nd.dot(nd.array(a, dtype="float64"),
+               nd.array(b, dtype="float64")).asnumpy(), a @ b, rtol=1e-6)
+    check_numeric_gradient(lambda x, y: nd.dot(x, y), [a, b], eps=1e-4,
+                           rtol=2e-2, atol=1e-4)
+    ba = _rand((2, 3, 4), 0.2, 1)
+    bb = _rand((2, 4, 2), 0.2, 1)
+    onp.testing.assert_allclose(
+        nd.batch_dot(nd.array(ba, dtype="float64"),
+                     nd.array(bb, dtype="float64")).asnumpy(), ba @ bb,
+        rtol=1e-6)
+
+
+def test_softmax_family_grads():
+    x = _rand((3, 5), -2, 2)
+    s = nd.softmax(nd.array(x, dtype="float64"), axis=-1).asnumpy()
+    e = onp.exp(x - x.max(-1, keepdims=True))
+    onp.testing.assert_allclose(s, e / e.sum(-1, keepdims=True), rtol=1e-6)
+    w = nd.array(_rand((3, 5)), dtype="float64")  # fixed weighting
+    check_numeric_gradient(
+        lambda a: nd.softmax(a, axis=-1) * w,
+        [x], eps=1e-4, rtol=2e-2, atol=1e-4)
+    check_numeric_gradient(
+        lambda a: nd.log_softmax(a, axis=-1) * w,
+        [x], eps=1e-4, rtol=2e-2, atol=1e-4)
+
+
+def test_norm_layers_grads():
+    x = _rand((2, 3, 4), -1, 1)
+    g = _rand((3,), 0.5, 1.5)
+    b = _rand((3,), -0.5, 0.5)
+
+    def ln(a, gg, bb):
+        return nd.layer_norm(a, gg, bb, axis=-1)
+
+    check_numeric_gradient(ln, [x.transpose(0, 2, 1), g, b], eps=1e-4,
+                           rtol=3e-2, atol=2e-4)
+
+
+def test_take_gather_scatter():
+    x = _rand((5, 3))
+    idx = onp.array([0, 2, 4])
+    got = nd.take(nd.array(x, dtype="float64"),
+                  nd.array(idx, dtype="int32")).asnumpy()
+    onp.testing.assert_allclose(got, x[idx])
+    check_numeric_gradient(
+        lambda a: nd.take(a, nd.array(idx, dtype="int32")), [x],
+        eps=1e-4, rtol=2e-2, atol=1e-4)
+    # mxnet gather_nd: indices (ndim, N)
+    gnd = nd.gather_nd(nd.array(x, dtype="float64"),
+                       nd.transpose(nd.array([[0, 1], [2, 0]],
+                                             dtype="int32")))
+    assert gnd.shape == (2,)
+
+
+def test_where_clip_grads():
+    x = _rand((3, 4), -2, 2)
+    check_numeric_gradient(
+        lambda a: nd.clip(a, -1.0, 1.0) * 2, [x], eps=1e-4, rtol=3e-2,
+        atol=1e-3)
+    cond = (onp.abs(x) > 1).astype(onp.float64)
+    y = _rand((3, 4))
+    check_numeric_gradient(
+        lambda a, b: nd.where(nd.array(cond), a, b), [x, y], eps=1e-4,
+        rtol=2e-2, atol=1e-4)
+
+
+def test_linalg_ops_vs_numpy():
+    a = _rand((3, 4), 0.2, 1)
+    b = _rand((4, 5), 0.2, 1)
+    onp.testing.assert_allclose(
+        nd.linalg_gemm2(nd.array(a, dtype="float64"),
+                        nd.array(b, dtype="float64")).asnumpy(), a @ b,
+        rtol=1e-6)
+    spd = onp.eye(3) * 2 + 0.3
+    l = nd.linalg_potrf(nd.array(spd, dtype="float64")).asnumpy()
+    onp.testing.assert_allclose(l @ l.T, spd, rtol=1e-6)
+    s = nd.linalg_syrk(nd.array(a, dtype="float64")).asnumpy()
+    onp.testing.assert_allclose(s, a @ a.T, rtol=1e-6)
+
+
+def test_topk_sort_argsort():
+    x = onp.array([[3.0, 1.0, 2.0], [0.0, 5.0, -1.0]])
+    top = nd.topk(nd.array(x), k=2, ret_typ="value").asnumpy()
+    onp.testing.assert_allclose(top, [[3, 2], [5, 0]])
+    srt = nd.sort(nd.array(x), axis=1).asnumpy()
+    onp.testing.assert_allclose(srt, onp.sort(x, 1))
+    arg = nd.argsort(nd.array(x), axis=1).asnumpy()
+    onp.testing.assert_allclose(arg, onp.argsort(x, 1))
+
+
+def test_one_hot_pick():
+    idx = nd.array([0, 2], dtype="int32")
+    oh = nd.one_hot(idx, depth=3).asnumpy()
+    onp.testing.assert_allclose(oh, [[1, 0, 0], [0, 0, 1]])
+    x = nd.array([[1.0, 2, 3], [4, 5, 6]])
+    p = nd.pick(x, nd.array([2, 0]), axis=1).asnumpy()
+    onp.testing.assert_allclose(p, [3, 4])
+
+
+def test_random_moments():
+    mx.random.seed(3)
+    u = nd.random.uniform(0, 1, shape=(20000,)).asnumpy()
+    assert abs(u.mean() - 0.5) < 0.02
+    assert abs(u.var() - 1 / 12) < 0.01
+    n = nd.random.normal(1.0, 2.0, shape=(20000,)).asnumpy()
+    assert abs(n.mean() - 1.0) < 0.06
+    assert abs(n.std() - 2.0) < 0.06
+    p = nd.random.poisson(4.0, shape=(20000,)).asnumpy()
+    assert abs(p.mean() - 4.0) < 0.1
