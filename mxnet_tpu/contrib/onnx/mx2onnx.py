@@ -79,16 +79,15 @@ def _tup(v, n, default):
 # --- per-op translations ----------------------------------------------------
 
 def _kernel_attr(attrs, op):
-    """Kernel rank drives every other spatial attr.  The runtime accepts
-    scalar kernels (broadcast to 2D) and None (rank from data); export
-    needs an explicit rank — fail with a clear message for None."""
+    """Kernel rank drives every other spatial attr.  The runtime derives
+    rank from the DATA shape for scalar/missing kernels; export has no
+    shapes, so both cases need an explicit tuple — fail clearly."""
     k = attrs.get("kernel")
-    if k is None:
+    if k is None or isinstance(k, (int, np.integer)):
         raise MXNetError(
-            f"ONNX export: {op} needs an explicit kernel attribute "
-            "(the runtime infers rank from data shapes; export cannot)")
-    if isinstance(k, (int, np.integer)):
-        return (int(k), int(k))
+            f"ONNX export: {op} needs an explicit kernel tuple, e.g. "
+            f"kernel=(3, 3) (got {k!r}; the runtime infers spatial rank "
+            "from data shapes, export cannot)")
     return tuple(int(x) for x in k)
 
 

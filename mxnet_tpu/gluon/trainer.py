@@ -47,6 +47,7 @@ class Trainer:
         self._kv_initialized = False
         self._kvstore = None
         self._update_on_kvstore = None
+        self._fused_cache = {}  # sig -> jitted multi-tensor update
 
     def _check_contexts(self):
         contexts = None
@@ -165,6 +166,8 @@ class Trainer:
         self._update(ignore_stale_grad)
 
     def _update(self, ignore_stale_grad=False):
+        if not self._update_on_kvstore and self._try_fused_update():
+            return
         for i, param in enumerate(self._params):
             if param.grad_req == "null":
                 continue
@@ -180,6 +183,120 @@ class Trainer:
             self._init_states(i)
             self._optimizer.update_multi_precision(
                 i, param.data(), param.grad(), self._states[i])
+
+    # -- fused multi-tensor update -------------------------------------------
+    # The reference fuses optimizer updates across params into single
+    # kernels (multi_sgd_update / preloaded_multi_sgd_*, SURVEY §2.2
+    # optimizer-ops row) because per-param launches dominate for nets with
+    # many small tensors.  Here ALL per-param ``_step`` rules trace into
+    # ONE jitted program: a single dispatch per training step, and XLA
+    # fuses across tensors.  lr/wd/t enter as traced scalars so LR
+    # schedules don't retrace.
+    def _try_fused_update(self):
+        from ..ndarray import sparse as sp
+
+        optzr = self._optimizer
+        if type(optzr)._step is opt.Optimizer._step:
+            return False  # optimizer has no pure step rule
+        live = []
+        for i, param in enumerate(self._params):
+            if param.grad_req == "null":
+                continue
+            if param._data is None:
+                if param._deferred_init is not None:
+                    continue
+                raise MXNetError(
+                    f"parameter {param.name} was not initialized")
+            if isinstance(param.grad(), sp.BaseSparseNDArray):
+                return False  # sparse grads use the lazy eager path
+            live.append(i)
+        if not live:
+            return True
+        import jax
+        import numpy as np
+
+        for i in live:
+            self._init_states(i)
+            optzr._update_count(i)
+        weights, grads, states, masters = [], [], [], []
+        lrs, wds, ts = [], [], []
+        mp_flags = []
+        for i in live:
+            param = self._params[i]
+            state = self._states[i]
+            use_mp = optzr.multi_precision and \
+                np.dtype(param.dtype).name in ("float16", "bfloat16")
+            if use_mp:
+                master, sub_state = state
+                masters.append(master)
+                states.append(opt._flatten_state(sub_state))
+            else:
+                masters.append(None)
+                states.append(opt._flatten_state(state))
+            mp_flags.append(use_mp)
+            weights.append(param.data())
+            grads.append(param.grad())
+            lrs.append(optzr._get_lr(i))
+            wds.append(optzr._get_wd(i))
+            ts.append(optzr._index_update_count[i])
+
+        sig = (type(optzr).__name__, float(optzr.rescale_grad),
+               tuple(mp_flags),
+               tuple((w.shape, str(w.dtype)) for w in weights),
+               tuple(len(s) for s in states))
+        fn = self._fused_cache.get(sig)
+        if fn is None:
+            n = len(live)
+            flags = tuple(mp_flags)
+
+            def fused(w_raws, m_raws, g_raws, s_raws, lr_v, wd_v, t_v):
+                # m_raws holds ONLY mp masters (keyed by position among
+                # mp params) — never an alias of a donated weight buffer
+                new_w, new_m, new_s = [], [], []
+                mi = 0
+                for j in range(n):
+                    if flags[j]:
+                        nw, ns = optzr._step(
+                            m_raws[mi], g_raws[j].astype(np.float32),
+                            s_raws[j], lr_v[j], wd_v[j], t_v[j])
+                        mi += 1
+                        new_m.append(nw)
+                        new_w.append(nw.astype(w_raws[j].dtype))
+                    else:
+                        nw, ns = optzr._step(w_raws[j], g_raws[j],
+                                             s_raws[j], lr_v[j], wd_v[j],
+                                             t_v[j])
+                        new_w.append(nw)
+                    new_s.append(ns)
+                return tuple(new_w), tuple(new_m), tuple(new_s)
+
+            # donate weights, masters and states; grads are read-only
+            fn = jax.jit(fused, donate_argnums=(0, 1, 3))
+            self._fused_cache[sig] = fn
+
+        import jax.numpy as jnp
+
+        w_raws = tuple(w._data for w in weights)
+        m_raws = tuple(m._data for m in masters if m is not None)
+        g_raws = tuple(g._data for g in grads)
+        s_raws = tuple(tuple(s._data for s in ss) for ss in states)
+        lr_v = jnp.asarray(lrs, jnp.float32)
+        wd_v = jnp.asarray(wds, jnp.float32)
+        t_v = jnp.asarray(ts, jnp.int32)
+        new_w, new_m, new_s = fn(w_raws, m_raws, g_raws, s_raws, lr_v,
+                                 wd_v, t_v)
+        mi = 0
+        for j, i in enumerate(live):
+            param = self._params[i]
+            param.data()._data = new_w[j]
+            if mp_flags[j]:
+                masters[j]._data = new_m[mi]
+                mi += 1
+                sub_state = self._states[i][1]
+            else:
+                sub_state = self._states[i]
+            opt._commit_state(sub_state, new_s[j])
+        return True
 
     # -- state persistence (reference: Trainer.save_states/load_states) ------
     def save_states(self, fname):

@@ -38,12 +38,13 @@ class BucketSentenceIter:
         if not buckets:
             lengths = [len(s) for s in sentences]
             ladder = (8, 16, 32, 64, 128, 256, 512)
-            # smallest ladder entry covering the longest sentence caps the
-            # ladder — default_bucket_key (and its XLA executable) stays
-            # as small as the data allows
-            top = next((b for b in ladder if max(lengths) <= b), None)
-            if top is None:
+            fitting = [l for l in lengths if l <= ladder[-1]]
+            if not fitting:
                 raise MXNetError("no bucket can hold the given sentences")
+            # smallest ladder entry covering the longest FITTING sentence
+            # caps the ladder (default_bucket_key and its XLA executable
+            # stay small); overlong sentences warn-and-discard below
+            top = next(b for b in ladder if max(fitting) <= b)
             buckets = [b for b in ladder if b <= top]
         self.buckets = sorted(buckets)
         self.batch_size = batch_size
