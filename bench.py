@@ -8,6 +8,9 @@ reference mount — BASELINE.md); reported as 0.0 meaning "no baseline
 available", NOT parity.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+``BENCH_MODEL=bert_base`` switches to the BASELINE metric #2 workload
+(BERT-base phase-1 pretraining shape, seq 128, samples/sec).
 """
 from __future__ import annotations
 
@@ -37,6 +40,9 @@ def main():
     image = int(os.environ.get("BENCH_IMAGE", "224"))
     model = os.environ.get("BENCH_MODEL", "resnet50_v1")
     dtype = os.environ.get("BENCH_DTYPE", "bfloat16")
+
+    if model.startswith("bert"):
+        return _bench_bert(batch, steps, warmup, dtype, model)
 
     mx.random.seed(0)
     net = gluon.model_zoo.vision.get_model(model, classes=1000)
@@ -81,6 +87,65 @@ def main():
         "value": round(ips, 2),
         "unit": "images/sec/chip",
         # reference baseline unrecoverable (BASELINE.md): 0.0 = no baseline
+        "vs_baseline": 0.0,
+    }))
+
+
+def _bench_bert(batch, steps, warmup, dtype, model_name):
+    """BERT-base MLM-style pretraining step (seq 128, BASELINE protocol)."""
+    import json
+    import time
+
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon, nd
+    from mxnet_tpu.models import bert
+
+    seq = int(os.environ.get("BENCH_SEQ", "128"))
+    vocab = 30522
+    mx.random.seed(0)
+    builder = getattr(bert, model_name)  # unknown names must fail loudly
+    net = builder(vocab_size=vocab)
+    net.initialize(mx.init.Xavier())
+    rng = np.random.RandomState(0)
+    ids = nd.array(rng.randint(0, vocab, (batch, seq)), dtype="int32")
+    seg = nd.zeros((batch, seq), dtype="int32")
+    labels = nd.array(rng.randint(0, vocab, (batch, seq)), dtype="int32")
+    net(ids, seg)  # resolve deferred shapes
+    if dtype in ("bfloat16", "float16"):
+        from mxnet_tpu import amp
+
+        amp.init(target_dtype=dtype)
+    net.hybridize(static_alloc=True)
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 1e-4})
+
+    def step():
+        with autograd.record():
+            # outputs: (seq, pooled, nsp_logits, mlm_logits)
+            outs = net(ids, seg)
+            mlm = outs[-1]
+            loss = nd.softmax_cross_entropy(
+                mlm.reshape((-1, vocab)), labels.reshape((-1,))) \
+                / (batch * seq)
+        loss.backward()
+        trainer.step(1)
+        return loss
+
+    for _ in range(warmup):
+        step().wait_to_read()
+    nd.waitall()
+    tic = time.time()
+    for _ in range(steps):
+        last = step()
+    last.wait_to_read()
+    nd.waitall()
+    wall = time.time() - tic
+    print(json.dumps({
+        "metric": f"{model_name}_pretrain_samples_per_sec_per_chip",
+        "value": round(batch * steps / wall, 2),
+        "unit": "samples/sec/chip",
         "vs_baseline": 0.0,
     }))
 

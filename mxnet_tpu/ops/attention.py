@@ -25,9 +25,25 @@ _export = make_exporter(_this)
 
 
 def sdpa_raw(q, k, v, m=None, scale=None, causal=False):
-    """Raw-array fused attention: jax.nn's flash-style kernel path on TPU
-    with an explicit einsum/softmax fallback.  Shared by the NDArray op
-    below and the sequence-parallel bodies (parallel/ring.py)."""
+    """Raw-array fused attention: the Pallas flash kernel when it applies
+    (TPU, unmasked/causal, 128-aligned lengths), else jax.nn's kernel
+    path, else an explicit einsum/softmax fallback.  Shared by the
+    NDArray op below and the sequence-parallel bodies (parallel/ring.py).
+
+    Layout here is (B, T, N, H); the flash kernel takes (B, N, T, H)."""
+    if m is None and q.shape[1] == k.shape[1] and \
+            q.shape[2] == k.shape[2] and \
+            q.shape[1] % 128 == 0 and q.shape[-1] <= 256:
+        # equal-head, unmasked, 128-aligned: the Pallas kernel applies
+        # (GQA/MQA head broadcasting stays on the jax.nn path)
+        from .flash_attention import _on_tpu, flash_attention_raw
+
+        if _on_tpu():
+            qt = q.transpose(0, 2, 1, 3)
+            out = flash_attention_raw(qt, k.transpose(0, 2, 1, 3),
+                                      v.transpose(0, 2, 1, 3), causal,
+                                      scale)
+            return out.transpose(0, 2, 1, 3)
     if m is not None and m.dtype != jnp.bool_:
         m = m.astype(jnp.bool_)
     try:

@@ -108,15 +108,21 @@ def test_fused_respects_lr_schedule_without_retrace():
 
 
 def test_sparse_grads_fall_back():
-    """row_sparse gradient params take the lazy eager path, others fuse."""
+    """A trainer whose params carry row_sparse grads must skip the fused
+    path entirely (lazy eager updates) — exercised through the Trainer."""
     from mxnet_tpu.ndarray import sparse as sp
-    from mxnet_tpu import optimizer as opt_mod
+    from mxnet_tpu.gluon import Parameter
 
-    w = nd.random.uniform(shape=(6, 3))
+    p = Parameter("emb_weight", shape=(6, 3))
+    p.initialize(init="zeros")
+    p.data()._data = nd.random.uniform(shape=(6, 3))._data
+    trainer = gluon.Trainer([p], "sgd", {"learning_rate": 0.5})
+    # hand the param a row_sparse gradient (grad lives on the NDArray)
     g = sp.RowSparseNDArray(nd.ones((2, 3)), nd.array([1, 4]), (6, 3))
-    opt = opt_mod.SGD(learning_rate=0.5)
-    before = w.asnumpy().copy()
-    opt.update_multi_precision(0, w, g, opt.create_state(0, w))
-    after = w.asnumpy()
-    assert not onp.allclose(after[1], before[1])
-    onp.testing.assert_allclose(after[0], before[0])
+    p.data()._grad = g
+    before = p.data().asnumpy().copy()
+    trainer.step(1)
+    assert trainer._fused_cache == {}     # fused path declined
+    after = p.data().asnumpy()
+    assert not onp.allclose(after[1], before[1])   # touched rows updated
+    onp.testing.assert_allclose(after[0], before[0])  # others untouched
