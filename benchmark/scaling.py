@@ -1,0 +1,123 @@
+#!/usr/bin/env python
+"""Scaling-efficiency harness: the north-star metric.
+
+BASELINE.md protocol: ``efficiency(n) = throughput(n) / (n ×
+throughput(1))`` for ResNet-50 (or BERT) under a STOCK ``gluon.Trainer``
+with ``kvstore='dist_tpu_sync'`` — the one-line-swap contract.  On real
+hardware run per-slice (``python benchmark/scaling.py``); the CPU-mesh
+mode exists to validate the harness end-to-end anywhere:
+
+``XLA_FLAGS=--xla_force_host_platform_device_count=8 BENCH_PLATFORM=cpu \
+BENCH_MODEL=resnet18_v1 BENCH_IMAGE=32 BENCH_BATCH=8 \
+python benchmark/scaling.py``
+
+Prints one JSON line per mesh size plus a final efficiency summary line.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def _throughput(n_devices, model, image, per_device_batch, steps, warmup,
+                dtype):
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon, nd, parallel
+
+    mesh = parallel.make_mesh({"dp": n_devices}) if n_devices > 1 else None
+    scope = parallel.mesh_scope(mesh) if mesh else None
+    if scope:
+        scope.__enter__()
+    try:
+        mx.random.seed(0)
+        net = gluon.model_zoo.vision.get_model(model, classes=100)
+        net.initialize(mx.init.Xavier())
+        net(nd.ones((1, 3, 32, 32)))
+        if dtype in ("bfloat16", "float16"):
+            from mxnet_tpu import amp
+
+            amp.init(target_dtype=dtype)
+        if mesh:
+            parallel.replicate_block_params(net)
+        net.hybridize(static_alloc=True)
+        trainer = gluon.Trainer(
+            net.collect_params(), "sgd",
+            {"learning_rate": 0.1, "momentum": 0.9},
+            kvstore="dist_tpu_sync" if mesh else "device")
+        loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+        batch = per_device_batch * n_devices
+        x = mx.random.uniform(shape=(batch, 3, image, image))
+        y = nd.array(np.random.RandomState(0).randint(0, 100, (batch,)))
+        if mesh:
+            x = parallel.shard_batch(x, mesh)
+            y = parallel.shard_batch(y, mesh)
+
+        def step():
+            with autograd.record():
+                loss = loss_fn(net(x), y)
+            loss.backward()
+            trainer.step(batch)
+            return loss
+
+        for _ in range(warmup):
+            step().wait_to_read()
+        nd.waitall()
+        tic = time.time()
+        for _ in range(steps):
+            last = step()
+        last.wait_to_read()
+        nd.waitall()
+        return batch * steps / (time.time() - tic)
+    finally:
+        from mxnet_tpu import amp
+
+        amp.turn_off()  # fresh AMP state for the next mesh size
+        if scope:
+            scope.__exit__(None, None, None)
+
+
+def main():
+    plat = os.environ.get("BENCH_PLATFORM")
+    if plat:
+        import jax
+
+        jax.config.update("jax_platforms", plat)
+    import jax
+
+    model = os.environ.get("BENCH_MODEL", "resnet50_v1")
+    image = int(os.environ.get("BENCH_IMAGE", "224"))
+    pdb = int(os.environ.get("BENCH_BATCH", "32"))
+    steps = int(os.environ.get("BENCH_STEPS", "10"))
+    warmup = int(os.environ.get("BENCH_WARMUP", "2"))
+    dtype = os.environ.get("BENCH_DTYPE", "bfloat16")
+    total = jax.device_count()
+    sizes = [1]
+    n = 2
+    while n <= total:
+        sizes.append(n)
+        n *= 2
+    results = {}
+    for n in sizes:
+        ips = _throughput(n, model, image, pdb, steps, warmup, dtype)
+        results[n] = ips
+        print(json.dumps({"devices": n, "images_per_sec": round(ips, 2)}),
+              flush=True)
+    base = results[1]
+    eff = {n: results[n] / (n * base) for n in sizes}
+    print(json.dumps({
+        "metric": f"{model}_dp_scaling_efficiency",
+        "value": round(eff[max(sizes)], 4),
+        "unit": f"throughput({max(sizes)}) / ({max(sizes)} x throughput(1))",
+        "per_size": {str(n): round(e, 4) for n, e in eff.items()},
+        "vs_baseline": round(eff[max(sizes)] / 0.90, 4),  # target ≥0.90
+    }))
+
+
+if __name__ == "__main__":
+    main()

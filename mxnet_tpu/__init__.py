@@ -79,6 +79,7 @@ _LAZY = {
     "util": ".util",
     "runtime": ".runtime",
     "models": ".models",
+    "model": ".model",
 }
 
 

@@ -42,12 +42,20 @@ def _norm_den(label, normalization, use_ignore, valid):
     return 1.0
 
 
-def softmax_output(data, label, grad_scale=1.0, ignore_label=-1.0,
+def softmax_output(data, label=None, grad_scale=1.0, ignore_label=-1.0,
                    multi_output=False, use_ignore=False,
                    preserve_shape=False, normalization="null",
                    out_grad=False, smooth_alpha=0.0, **kwargs):
-    """Reference ``SoftmaxOutput`` (src/operator/softmax_output.cc:?)."""
+    """Reference ``SoftmaxOutput`` (src/operator/softmax_output.cc:?).
+
+    Label is only consumed by backward (reference contract) — inference
+    graphs bound without a label still produce the softmax."""
     axis = 1 if multi_output else -1
+    if label is None:
+        return apply_op(
+            lambda d: jax.nn.softmax(d.astype(np.float32),
+                                     axis=axis).astype(d.dtype),
+            data, name="SoftmaxOutput")
 
     @jax.custom_vjp
     def f(d, l):
@@ -82,7 +90,12 @@ _export(softmax_output, aliases=("SoftmaxOutput",))
 
 
 def _regression_output(transform, grad_fn, opname):
-    def op(data, label, grad_scale=1.0, **kwargs):
+    def op(data, label=None, grad_scale=1.0, **kwargs):
+        if label is None:
+            # label feeds backward only (reference contract) — inference
+            # graphs bound without one still produce the transform
+            return apply_op(transform, data, name=opname)
+
         @jax.custom_vjp
         def f(d, l):
             return transform(d)

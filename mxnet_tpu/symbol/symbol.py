@@ -82,13 +82,23 @@ _OP_INPUTS = {
     "InstanceNorm": [("gamma", False, _ALWAYS), ("beta", False, _ALWAYS)],
     "Embedding": [("weight", False, _ALWAYS)],
     "LeakyReLU": [("gamma", False, lambda a: a.get("act_type") == "prelu")],
+    # output heads auto-create their label var (reference FListInputNames
+    # includes 'label'; the var lands as e.g. 'softmax_label')
+    "SoftmaxOutput": [("label", False, _ALWAYS)],
+    "LinearRegressionOutput": [("label", False, _ALWAYS)],
+    "LogisticRegressionOutput": [("label", False, _ALWAYS)],
+    "MAERegressionOutput": [("label", False, _ALWAYS)],
 }
 
 _canon = {"fully_connected": "FullyConnected", "convolution": "Convolution",
           "deconvolution": "Deconvolution", "batch_norm": "BatchNorm",
           "layer_norm": "LayerNorm", "instance_norm": "InstanceNorm",
           "embedding": "Embedding", "leaky_relu": "LeakyReLU",
-          "slice_channel": "SliceChannel"}
+          "slice_channel": "SliceChannel",
+          "softmax_output": "SoftmaxOutput",
+          "linear_regression_output": "LinearRegressionOutput",
+          "logistic_regression_output": "LogisticRegressionOutput",
+          "mae_regression_output": "MAERegressionOutput"}
 
 
 def _canon_op(op):
@@ -463,6 +473,14 @@ def _param_shapes(op, attrs, data_shape):
                                int(attrs["output_dim"]))}
         if op == "LeakyReLU":
             return {"gamma": (data_shape[1],)}
+        if op == "SoftmaxOutput":
+            multi = str(attrs.get("multi_output", False)).lower() in \
+                ("true", "1")
+            return {"label": (data_shape[0],) + data_shape[2:] if multi
+                    else data_shape[:-1]}
+        if op in ("LinearRegressionOutput", "LogisticRegressionOutput",
+                  "MAERegressionOutput"):
+            return {"label": data_shape}
     except (KeyError, IndexError):
         pass
     return {}

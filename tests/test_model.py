@@ -1,0 +1,108 @@
+"""Legacy mx.model.FeedForward + symbolic-training convergence tests
+(reference model: the legacy model API tests + train smoke tests,
+SURVEY §2.4 misc row / §4 tests/python/train).
+
+The convergence assertions here are load-bearing: output heads must
+auto-create their ``{name}_label`` variable (reference FListInputNames
+contract) or Module/FeedForward silently train without labels."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+import mxnet_tpu.symbol as sym
+
+
+def _toy():
+    data = sym.var("data")
+    net = sym.FullyConnected(data, num_hidden=16, name="fc1")
+    net = sym.Activation(net, act_type="relu", name="r1")
+    net = sym.FullyConnected(net, num_hidden=2, name="fc2")
+    net = sym.SoftmaxOutput(net, name="softmax")
+    rng = onp.random.RandomState(0)
+    X = rng.uniform(-1, 1, (64, 8)).astype("f")
+    y = (X[:, 0] > 0).astype("f")
+    return net, X, y
+
+
+def test_output_heads_autocreate_label_vars():
+    net, _, _ = _toy()
+    assert "softmax_label" in net.list_arguments()
+    d = sym.var("d")
+    reg = sym.LinearRegressionOutput(sym.FullyConnected(d, num_hidden=1,
+                                                        name="f"),
+                                     name="lro")
+    assert "lro_label" in reg.list_arguments()
+    # explicit label symbol still takes precedence
+    lab = sym.var("mylabel")
+    s2 = sym.SoftmaxOutput(sym.var("x"), lab, name="s2")
+    assert "mylabel" in s2.list_arguments()
+    assert "s2_label" not in s2.list_arguments()
+
+
+def test_module_fit_actually_learns():
+    """Regression: labels must reach SoftmaxOutput's backward — without
+    the auto label var, Module trained on garbage and stayed at chance."""
+    net, X, y = _toy()
+    it = mx.io.NDArrayIter(X, y, batch_size=16)
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(it, num_epoch=10, optimizer="sgd",
+            initializer=mx.init.Xavier(),
+            optimizer_params={"learning_rate": 0.3})
+    acc = mod.score(it, "acc")[0][1]
+    assert acc > 0.9, f"symbolic training did not learn (acc={acc})"
+
+
+def test_feedforward_fit_predict_score():
+    net, X, y = _toy()
+    model = mx.model.FeedForward(net, num_epoch=10, optimizer="sgd",
+                                 initializer=mx.init.Xavier(),
+                                 learning_rate=0.3)
+    model.fit(mx.io.NDArrayIter(X, y, batch_size=16))
+    pred = model.predict(mx.io.NDArrayIter(X, batch_size=16))
+    assert pred.shape == (64, 2)
+    assert (pred.argmax(1) == y).mean() > 0.9
+    acc = model.score(mx.io.NDArrayIter(X, y, batch_size=16))
+    assert acc > 0.9
+
+
+def test_feedforward_save_load(tmp_path):
+    net, X, y = _toy()
+    model = mx.model.FeedForward(net, num_epoch=3, optimizer="sgd",
+                                 initializer=mx.init.Xavier(),
+                                 learning_rate=0.3)
+    model.fit(mx.io.NDArrayIter(X, y, batch_size=16))
+    prefix = str(tmp_path / "ff")
+    model.save(prefix, 3)
+    loaded = mx.model.FeedForward.load(prefix, 3)
+    assert set(loaded.arg_params) == set(model.arg_params)
+    onp.testing.assert_allclose(
+        loaded.arg_params["fc1_weight"].asnumpy(),
+        model.arg_params["fc1_weight"].asnumpy())
+
+
+def test_softmax_output_label_free_inference():
+    """SoftmaxOutput without a bound label still runs forward (reference
+    contract: label only feeds backward)."""
+    from mxnet_tpu import nd
+
+    x = nd.array([[1.0, 2.0, 0.5]])
+    out = nd.SoftmaxOutput(x)
+    onp.testing.assert_allclose(out.asnumpy().sum(), 1.0, rtol=1e-6)
+
+
+def test_regression_output_trains():
+    data = sym.var("data")
+    net = sym.FullyConnected(data, num_hidden=1, name="fc")
+    net = sym.LinearRegressionOutput(net, name="lro")
+    rng = onp.random.RandomState(0)
+    X = rng.uniform(-1, 1, (64, 4)).astype("f")
+    w = onp.array([1.0, -2.0, 3.0, 0.5], "f")
+    y = (X @ w).astype("f")
+    it = mx.io.NDArrayIter(X, y.reshape(-1, 1), batch_size=16,
+                           label_name="lro_label")
+    mod = mx.mod.Module(net, context=mx.cpu(), label_names=["lro_label"])
+    mod.fit(it, num_epoch=30, optimizer="sgd",
+            initializer=mx.init.Xavier(),
+            optimizer_params={"learning_rate": 0.2}, eval_metric="mse")
+    mse = mod.score(it, "mse")[0][1]
+    assert mse < 0.05, f"regression head did not learn (mse={mse})"
