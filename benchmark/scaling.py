@@ -38,7 +38,7 @@ def _throughput(n_devices, model, image, per_device_batch, steps, warmup,
         mx.random.seed(0)
         net = gluon.model_zoo.vision.get_model(model, classes=100)
         net.initialize(mx.init.Xavier())
-        net(nd.ones((1, 3, 32, 32)))
+        net(nd.ones((1, 3, image, image)))
         if dtype in ("bfloat16", "float16"):
             from mxnet_tpu import amp
 

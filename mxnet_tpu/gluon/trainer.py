@@ -97,7 +97,16 @@ class Trainer:
             self._kvstore = kv
             if self._compression_params:
                 kv.set_gradient_compression(self._compression_params)
-            if update_on_kvstore is None:
+            if getattr(kv, "type", "") == "dist_async":
+                # async PS applies updates server-side on arrival; a
+                # client-side update would race stale pulls (reference
+                # kvstore_dist.h has the same update_on_kvstore=True
+                # requirement for dist_async)
+                if update_on_kvstore is False:
+                    raise MXNetError(
+                        "dist_async requires update_on_kvstore=True")
+                update_on_kvstore = True
+            elif update_on_kvstore is None:
                 # single logical array: updating locally is strictly better
                 # (fused jit update); dist PS-style configs opt in explicitly
                 update_on_kvstore = False
@@ -125,9 +134,12 @@ class Trainer:
     def step(self, batch_size, ignore_stale_grad=False):
         """Allreduce gradients and apply one optimizer update, scaling
         gradients by 1/batch_size (reference: ``Trainer.step``)."""
+        # rescale is set BEFORE kvstore init: update_on_kvstore ships a
+        # pickled optimizer copy to the (possibly remote) server, so it must
+        # already carry the right rescale_grad at that point
+        self._optimizer.rescale_grad = self._scale / batch_size
         if not self._kv_initialized:
             self._init_kvstore()
-        self._optimizer.rescale_grad = self._scale / batch_size
         self._allreduce_grads()
         self._update(ignore_stale_grad)
 

@@ -13,9 +13,10 @@ devices" is already done by XLA collectives inside the jitted step — the
 push/pull are explicit about updater semantics but move no data.  The new
 ``dist_tpu_sync`` mode (the north-star capability) runs psum over the ICI
 mesh inside the compiled training step; across hosts it rides
-``jax.distributed`` process groups (see mxnet_tpu/parallel).  ``dist_sync``/
-``dist_async`` names map onto it with a warning, so reference scripts run
-unchanged.
+``jax.distributed`` process groups (see mxnet_tpu/parallel).  ``dist_sync``
+maps onto it with a warning, so reference scripts run unchanged;
+``dist_async`` is a genuine host-side async parameter server (see
+``dist_async.py``) for the PS-shaped sparse workloads.
 """
 from __future__ import annotations
 
@@ -74,10 +75,11 @@ class KVStore:
             if k not in self._store:
                 raise MXNetError(f"key {k!r} not initialized")
             merged = _merge(v)
-            if self._compression is not None and \
-                    getattr(merged, "stype", "default") == "default":
-                merged, self._residuals[k] = self._compression.roundtrip(
-                    merged, self._residuals.get(k))
+            if self._compression is not None:
+                from .dist_async import _compress_merged
+
+                merged = _compress_merged(self._compression,
+                                          self._residuals, k, merged)
             if self._updater is not None:
                 self._updater(int(k) if k.isdigit() else k, merged,
                               self._store[k])
@@ -234,7 +236,7 @@ def create(name="local"):
                  "local_allreduce_device", "device", "nccl"):
         return KVStore(lname)
     if lname in ("dist_tpu_sync", "dist_sync", "dist_device_sync",
-                 "dist_async", "horovod"):
+                 "horovod"):
         from ..parallel import TPUSyncKVStore
 
         if lname != "dist_tpu_sync":
@@ -242,4 +244,8 @@ def create(name="local"):
                 f"kvstore {name!r} maps to 'dist_tpu_sync' on this backend "
                 "(XLA collectives over the ICI/DCN mesh replace ps-lite)")
         return TPUSyncKVStore()
+    if lname == "dist_async":
+        from .dist_async import AsyncPSKVStore
+
+        return AsyncPSKVStore()
     raise MXNetError(f"unknown kvstore type {name!r}")

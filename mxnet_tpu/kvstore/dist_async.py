@@ -1,0 +1,398 @@
+"""Asynchronous parameter-server KVStore (``dist_async``).
+
+Reference: ``src/kvstore/kvstore_dist.h:?`` + ``kvstore_dist_server.h:?`` —
+workers ZPush/ZPull through ps-lite (``3rdparty/ps-lite/src/van.cc:?`` ZMQ
+transport); in ``dist_async`` the server applies the optimizer updater to
+each arriving gradient immediately, with NO barrier across workers (SURVEY
+§2.3 D2, §3.4).  Each worker's own pushes stay ordered per key; staleness
+across workers is the accepted tradeoff.
+
+TPU-native redesign: the async PS is a HOST-side control plane (the one
+workload shape — sparse/embedding-heavy — where a PS beats allreduce).
+Device compute stays in XLA; values cross the wire as host numpy buffers.
+
+- In-process form: a dispatcher thread drains a FIFO queue and applies
+  updates to the server table — ``push`` returns immediately, exactly the
+  engine-async contract NDArray ops have (SURVEY §1 invariant).
+- Cross-process form: a TCP server thread (length-prefixed pickle frames)
+  plays ps-lite's role over localhost/DCN; workers connect via
+  ``MXT_PS_ROOT_URI`` (the ``DMLC_PS_ROOT_URI`` analog, see
+  tools/launch.py).  No scheduler role is needed: rank 0 hosts the table.
+
+Security note: frames are pickle — trust the cluster, same as ps-lite.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import queue
+import socket
+import socketserver
+import struct
+import threading
+
+import numpy as np
+
+from ..base import MXNetError
+from ..ndarray import NDArray
+
+__all__ = ["AsyncPSKVStore", "PSServer", "serve_forever"]
+
+
+def _compress_merged(compression, residuals, key, merged):
+    """Shared with KVStore.push: quantize dense grads with per-key error
+    feedback before they leave the worker."""
+    if getattr(merged, "stype", "default") != "default":
+        return merged
+    merged, residuals[key] = compression.roundtrip(merged,
+                                                   residuals.get(key))
+    return merged
+
+
+# --- wire helpers -----------------------------------------------------------
+
+def _send_frame(sock, obj):
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(struct.pack("<Q", len(payload)) + payload)
+
+
+def _recv_exact(sock, n):
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def _recv_frame(sock):
+    (n,) = struct.unpack("<Q", _recv_exact(sock, 8))
+    return pickle.loads(_recv_exact(sock, n))
+
+
+def _to_wire(v):
+    """NDArray/RowSparse → picklable host form."""
+    from ..ndarray import sparse as sp
+
+    if isinstance(v, sp.RowSparseNDArray):
+        return ("row_sparse", v.data.asnumpy(), v.indices.asnumpy(),
+                tuple(v.shape))
+    if isinstance(v, NDArray):
+        return ("dense", v.asnumpy())
+    return ("dense", np.asarray(v))
+
+
+def _from_wire(w):
+    from ..ndarray import sparse as sp
+
+    if w[0] == "row_sparse":
+        _, data, idx, shape = w
+        return sp.RowSparseNDArray(NDArray(data), NDArray(idx), shape)
+    return NDArray(w[1])
+
+
+# --- the server table -------------------------------------------------------
+
+class PSServer:
+    """The parameter table + async updater (reference
+    ``kvstore_dist_server.h:?`` request handler, dist_async branch: apply
+    update on arrival, never wait for other workers)."""
+
+    def __init__(self):
+        self._store = {}
+        self._updater = None
+        self._lock = threading.Lock()
+
+    def set_optimizer_bytes(self, opt_bytes):
+        from .. import optimizer as opt_mod
+
+        with self._lock:
+            self._updater = opt_mod.get_updater(pickle.loads(opt_bytes))
+
+    def handle(self, cmd, *args):
+        from ..ndarray import sparse as sp
+
+        if cmd == "init":
+            k, w = args
+            with self._lock:
+                if k not in self._store:
+                    self._store[k] = _from_wire(w)
+            return None
+        if cmd == "push":
+            k, w = args
+            grad = _from_wire(w)
+            with self._lock:
+                if k not in self._store:
+                    raise MXNetError(f"key {k!r} not initialized")
+                if self._updater is not None:
+                    self._updater(int(k) if k.isdigit() else k, grad,
+                                  self._store[k])
+                else:
+                    # no updater: the pushed value replaces the stored one
+                    # (matches KVStoreLocal and the reference async server;
+                    # accumulating here would corrupt the Trainer
+                    # push-grad/pull-grad sync path)
+                    g = grad.todense() \
+                        if isinstance(grad, sp.BaseSparseNDArray) else grad
+                    self._store[k] = g
+            return None
+        if cmd == "pull":
+            (k,) = args
+            with self._lock:
+                if k not in self._store:
+                    raise MXNetError(f"key {k!r} not initialized")
+                return _to_wire(self._store[k])
+        if cmd == "row_sparse_pull":
+            k, rows = args
+            with self._lock:
+                if k not in self._store:
+                    raise MXNetError(f"key {k!r} not initialized")
+                stored = self._store[k]
+                dense = stored.todense() \
+                    if isinstance(stored, sp.BaseSparseNDArray) else stored
+                picked = dense.asnumpy()[np.asarray(rows, np.int64)]
+            return ("rows", picked, np.asarray(rows, np.int64))
+        if cmd == "set_optimizer":
+            (ob,) = args
+            self.set_optimizer_bytes(ob)
+            return None
+        if cmd == "barrier":
+            return None  # per-connection FIFO makes this a flush marker
+        raise MXNetError(f"unknown PS command {cmd!r}")
+
+
+class _PSRequestHandler(socketserver.BaseRequestHandler):
+    def handle(self):
+        while True:
+            try:
+                msg = _recv_frame(self.request)
+            except (ConnectionError, struct.error):
+                return
+            if msg[0] == "bye":
+                return
+            try:
+                reply = ("ok", self.server.ps.handle(msg[0], *msg[1:]))
+            except Exception as e:  # error crosses the wire, like ps-lite
+                reply = ("err", repr(e))
+            _send_frame(self.request, reply)
+
+
+class _PSTCPServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+def serve_forever(uri, ps=None, background=True):
+    """Start the PS TCP server on ``uri`` ("host:port").  Returns the
+    server object (``.shutdown()`` to stop).  Reference analog: the server
+    role spawned by tools/launch.py (DMLC_ROLE=server)."""
+    host, port = uri.rsplit(":", 1)
+    srv = _PSTCPServer((host, int(port)), _PSRequestHandler)
+    srv.ps = ps or PSServer()
+    if background:
+        t = threading.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+    else:
+        srv.serve_forever()
+    return srv
+
+
+# --- the worker-side store --------------------------------------------------
+
+class AsyncPSKVStore:
+    """``dist_async`` worker store.
+
+    Embedded mode (no ``MXT_PS_ROOT_URI``): dispatcher thread + local
+    table — single-process async semantics for tests/FM workload.
+    Remote mode: frames go to the TCP server; the sender thread preserves
+    this worker's per-key FIFO order while keeping ``push`` non-blocking.
+    """
+
+    def __init__(self, root_uri=None, rank=None, num_workers=None):
+        self.type = "dist_async"
+        self._rank = int(rank if rank is not None
+                         else os.environ.get("MXT_RANK", 0))
+        self._num_workers = int(num_workers if num_workers is not None
+                                else os.environ.get("MXT_NWORKER", 1))
+        self._uri = root_uri or os.environ.get("MXT_PS_ROOT_URI")
+        self._queue = queue.Queue()
+        self._err = None
+        self._local = None
+        self._sock = None
+        self._sock_lock = threading.Lock()
+        if self._uri:
+            host, port = self._uri.rsplit(":", 1)
+            self._sock = socket.create_connection((host, int(port)),
+                                                  timeout=60)
+        else:
+            self._local = PSServer()
+        self._sender = threading.Thread(target=self._drain, daemon=True)
+        self._sender.start()
+        self._compression = None
+
+    # -- identity -----------------------------------------------------------
+    @property
+    def rank(self):
+        return self._rank
+
+    @property
+    def num_workers(self):
+        return self._num_workers
+
+    # -- dispatcher ----------------------------------------------------------
+    def _rpc(self, *msg):
+        """Synchronous round-trip (used by the sender thread and pulls)."""
+        if self._local is not None:
+            return self._local.handle(msg[0], *msg[1:])
+        with self._sock_lock:
+            _send_frame(self._sock, msg)
+            status, payload = _recv_frame(self._sock)
+        if status == "err":
+            raise MXNetError(f"PS server error: {payload}")
+        return payload
+
+    def _drain(self):
+        while True:
+            msg = self._queue.get()
+            if msg is None:
+                self._queue.task_done()
+                return
+            try:
+                self._rpc(*msg)
+            except Exception as e:  # surfaced at next sync point
+                self._err = e
+            finally:
+                self._queue.task_done()
+
+    def _enqueue(self, *msg):
+        if self._err is not None:
+            err, self._err = self._err, None
+            raise err
+        self._queue.put(msg)
+
+    def wait_all(self):
+        """Drain in-flight pushes (the ``Engine::WaitForAll`` analog)."""
+        self._queue.join()
+        if self._err is not None:
+            err, self._err = self._err, None
+            raise err
+
+    # -- core ops ------------------------------------------------------------
+    @staticmethod
+    def _key(key):
+        return str(key)
+
+    def init(self, key, value):
+        from . import _pairs
+
+        self.wait_all()  # control ops keep program order w.r.t. pushes
+        keys, values = _pairs(key, value)
+        for k, v in zip(keys, values):
+            self._rpc("init", self._key(k), _to_wire(v))
+
+    def push(self, key, value, priority=0):
+        """Non-blocking: enqueue and return (async PS contract)."""
+        from . import _merge, _pairs
+
+        keys, values = _pairs(key, value)
+        for k, v in zip(keys, values):
+            merged = _compress_merged(self._compression, self._residuals,
+                                      self._key(k), _merge(v)) \
+                if self._compression is not None else _merge(v)
+            self._enqueue("push", self._key(k), _to_wire(merged))
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        """Blocking; reflects this worker's completed pushes (per-worker
+        FIFO), may be stale w.r.t. other workers — dist_async semantics."""
+        from . import _assign, _pairs
+
+        self.wait_all()
+        keys, outs = _pairs(key, out)
+        for k, o in zip(keys, outs):
+            stored = _from_wire(self._rpc("pull", self._key(k)))
+            for target in (o if isinstance(o, (list, tuple)) else [o]):
+                _assign(target, stored)
+
+    def pushpull(self, key, value, out=None, priority=0):
+        self.push(key, value, priority)
+        if out is not None:
+            self.pull(key, out, priority)
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        from ..ndarray import sparse as sp
+        from . import _pairs
+
+        if row_ids is None:
+            raise MXNetError("row_sparse_pull requires row_ids")
+        self.wait_all()
+        keys, outs = _pairs(key, out)
+        rids = row_ids if isinstance(row_ids, (list, tuple)) else \
+            [row_ids] * len(keys)
+        for k, o, r in zip(keys, outs, rids):
+            ids = r.asnumpy().astype(np.int64) if isinstance(r, NDArray) \
+                else np.asarray(r, np.int64)
+            _, rows, ids = self._rpc("row_sparse_pull", self._key(k), ids)
+            for target in (o if isinstance(o, (list, tuple)) else [o]):
+                if isinstance(target, sp.RowSparseNDArray):
+                    result_full = sp.RowSparseNDArray(
+                        NDArray(rows), NDArray(ids), target.shape)
+                    result_full.copyto(target)
+                else:
+                    target._data = target._data.at[
+                        ids.astype(np.int32)].set(
+                            rows.astype(target.dtype))
+
+    def broadcast(self, key, value, out=None, priority=0):
+        self.init(key, value)
+        if out is not None:
+            self.pull(key, out, priority)
+
+    # -- optimizer wiring ----------------------------------------------------
+    def set_optimizer(self, optimizer):
+        """Ships the optimizer to the server (update_on_kvstore=True —
+        reference workers pickle the optimizer to servers the same way).
+        The server holds a COPY: later mutations of the local optimizer
+        (e.g. rescale_grad) don't propagate — same as the reference."""
+        self.wait_all()  # keep program order w.r.t. queued pushes
+        self._rpc("set_optimizer", pickle.dumps(optimizer))
+
+    def set_updater(self, updater):
+        raise MXNetError(
+            "dist_async runs the updater server-side; use set_optimizer "
+            "(reference kvstore_dist.h has the same restriction)")
+
+    def set_gradient_compression(self, compression_params):
+        from . import gradient_compression as gc
+
+        self._compression = gc.create(compression_params)
+        self._residuals = {}
+
+    # -- state / lifecycle ---------------------------------------------------
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        raise MXNetError("dist_async keeps optimizer state server-side; "
+                         "checkpoint from the server process")
+
+    def load_optimizer_states(self, fname):
+        raise MXNetError("dist_async keeps optimizer state server-side")
+
+    def close(self):
+        if getattr(self, "_closed", False):
+            return
+        self._closed = True
+        if self._sender.is_alive():
+            self.wait_all()
+        self._queue.put(None)
+        if self._sock is not None:
+            try:
+                with self._sock_lock:
+                    _send_frame(self._sock, ("bye",))
+                self._sock.close()
+            except OSError:
+                pass
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

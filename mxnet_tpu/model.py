@@ -74,8 +74,13 @@ class FeedForward:
 
         if self.num_epoch is None:
             raise MXNetError("num_epoch is required for fit")
-        data_iter = X if hasattr(X, "provide_data") else \
-            io.NDArrayIter(np.asarray(X), np.asarray(y), batch_size=32)
+        if hasattr(X, "provide_data"):
+            data_iter = X
+        else:
+            if y is None:
+                raise MXNetError("y must be specified when X is an array")
+            data_iter = io.NDArrayIter(np.asarray(X), np.asarray(y),
+                                       batch_size=32)
         m = self._create_module(data_iter)
         m.fit(data_iter, eval_data=eval_data, eval_metric=eval_metric,
               optimizer=self.optimizer,

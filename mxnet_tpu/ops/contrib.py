@@ -805,3 +805,151 @@ def amp_multicast(*data, num_outputs=None, cast_narrow=False, **kwargs):
 
 
 _export(amp_multicast, aliases=("_amp_multicast",))
+
+
+# --- Deformable convolution (reference src/operator/contrib/
+# deformable_convolution.cc:? and modulated_deformable_convolution.cc:?) ----
+
+def _deform_sample(img, offs, mask, kernel, stride, dilate, pad, oh, ow):
+    """Sample deformable-conv patches for one deformable group.
+
+    img (C, H, W); offs (2*KH*KW, OH, OW) with channel layout
+    [(y, x) per kernel tap, taps in row-major (kh, kw) order — the
+    reference's ordering]; mask (KH*KW, OH, OW) or None (DCNv2
+    modulation, multiplied into sampled values).  → (C, KH*KW, OH, OW).
+    Out-of-bounds bilinear samples contribute 0 (matches the reference's
+    zero-padding contract, like ROIAlign above)."""
+    kh, kw = kernel
+    sh, sw = stride
+    dh, dw = dilate
+    ph, pw = pad
+    # base sampling grid: y0[k, i, j] = i*sh - ph + kh_i*dh
+    # coordinates stay ≥f32 regardless of img dtype: bf16 can't represent
+    # integer positions past 256, which would shift taps on large maps
+    ct = jnp.promote_types(jnp.float32, offs.dtype)
+    ki = jnp.arange(kh * kw) // kw
+    kj = jnp.arange(kh * kw) % kw
+    oi = jnp.arange(oh)
+    oj = jnp.arange(ow)
+    base_y = (oi[None, :, None] * sh - ph
+              + ki[:, None, None] * dh).astype(ct)   # (K, OH, 1)
+    base_x = (oj[None, None, :] * sw - pw
+              + kj[:, None, None] * dw).astype(ct)   # (K, 1, OW)
+    off = offs.reshape(kh * kw, 2, oh, ow).astype(ct)
+    ys = base_y + off[:, 0]
+    xs = base_x + off[:, 1]
+    vals = _bilinear(img, ys.reshape(-1), xs.reshape(-1))   # (C, K*OH*OW)
+    vals = vals.reshape(img.shape[0], kh * kw, oh, ow)
+    if mask is not None:
+        vals = vals * mask[None, :, :, :]
+    return vals
+
+
+def _deform_conv_impl(d, off, w, b, msk, kernel, stride, dilate, pad,
+                      num_group, num_deformable_group):
+    kh, kw = kernel
+    ch = d.shape[1]
+    oh = (d.shape[2] + 2 * pad[0] - (dilate[0] * (kh - 1) + 1)) \
+        // stride[0] + 1
+    ow = (d.shape[3] + 2 * pad[1] - (dilate[1] * (kw - 1) + 1)) \
+        // stride[1] + 1
+    cpg = ch // num_deformable_group           # channels per deform group
+    k2 = kh * kw
+
+    def per_image(img, offs, mask):
+        parts = []
+        for g in range(num_deformable_group):
+            m = None if mask is None else mask[g * k2:(g + 1) * k2]
+            parts.append(_deform_sample(
+                img[g * cpg:(g + 1) * cpg],
+                offs[g * 2 * k2:(g + 1) * 2 * k2],
+                m, kernel, stride, dilate, pad, oh, ow))
+        return jnp.concatenate(parts, axis=0)  # (C, K, OH, OW)
+
+    if msk is None:
+        patches = jax.vmap(lambda i, o: per_image(i, o, None))(d, off)
+    else:
+        patches = jax.vmap(per_image)(d, off, msk)
+    # grouped contraction: weight (O, C/g, KH, KW)
+    o_total = w.shape[0]
+    wg = w.reshape(num_group, o_total // num_group, ch // num_group, k2)
+    pg = patches.reshape(patches.shape[0], num_group, ch // num_group, k2,
+                         oh, ow)
+    out = jnp.einsum("bgckij,gock->bgoij", pg, wg)
+    out = out.reshape(patches.shape[0], o_total, oh, ow)
+    if b is not None:
+        out = out + b[None, :, None, None]
+    return out.astype(d.dtype)
+
+
+def deformable_convolution(data, offset, weight, bias=None, kernel=(3, 3),
+                           stride=(1, 1), dilate=(1, 1), pad=(0, 0),
+                           num_filter=None, num_group=1,
+                           num_deformable_group=1, no_bias=False, **kwargs):
+    """Reference ``_contrib_DeformableConvolution`` (DCNv1, Dai et al.):
+    data (B, C, H, W), offset (B, 2*KH*KW*num_deformable_group, OH, OW),
+    weight (num_filter, C/num_group, KH, KW).
+
+    TPU-native form: the per-tap bilinear gather is expressed as a dense
+    masked sample over the feature map (static shapes, fuses under jit)
+    followed by one grouped einsum that lands on the MXU — rather than the
+    reference's im2col + per-position CUDA gather kernels."""
+    from .nn_ops import _tup
+
+    kernel = _tup(kernel, 2, "kernel")
+    stride = _tup(stride, 2, "stride")
+    dilate = _tup(dilate, 2, "dilate")
+    pad = _tup(pad, 2, "pad")
+
+    def _f(*args):
+        if no_bias or bias is None:
+            d, off, w = args
+            b = None
+        else:
+            d, off, w, b = args
+        return _deform_conv_impl(d, off, w, b, None, kernel, stride, dilate,
+                                 pad, num_group, num_deformable_group)
+
+    ins = [data, offset, weight] + \
+        ([] if (no_bias or bias is None) else [bias])
+    return apply_op(_f, *ins, name="deformable_convolution")
+
+
+_export(deformable_convolution,
+        aliases=("DeformableConvolution", "_contrib_DeformableConvolution"))
+
+
+def modulated_deformable_convolution(data, offset, mask, weight, bias=None,
+                                     kernel=(3, 3), stride=(1, 1),
+                                     dilate=(1, 1), pad=(0, 0),
+                                     num_filter=None, num_group=1,
+                                     num_deformable_group=1, no_bias=False,
+                                     **kwargs):
+    """Reference ``_contrib_ModulatedDeformableConvolution`` (DCNv2): like
+    DCNv1 plus a per-tap modulation mask (B, KH*KW*num_deformable_group,
+    OH, OW) multiplied into the sampled values (caller applies sigmoid,
+    matching the reference contract)."""
+    from .nn_ops import _tup
+
+    kernel = _tup(kernel, 2, "kernel")
+    stride = _tup(stride, 2, "stride")
+    dilate = _tup(dilate, 2, "dilate")
+    pad = _tup(pad, 2, "pad")
+
+    def _f(*args):
+        if no_bias or bias is None:
+            d, off, msk, w = args
+            b = None
+        else:
+            d, off, msk, w, b = args
+        return _deform_conv_impl(d, off, w, b, msk, kernel, stride, dilate,
+                                 pad, num_group, num_deformable_group)
+
+    ins = [data, offset, mask, weight] + \
+        ([] if (no_bias or bias is None) else [bias])
+    return apply_op(_f, *ins, name="modulated_deformable_convolution")
+
+
+_export(modulated_deformable_convolution,
+        aliases=("ModulatedDeformableConvolution",
+                 "_contrib_ModulatedDeformableConvolution"))

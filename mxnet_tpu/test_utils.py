@@ -87,6 +87,39 @@ def check_numeric_gradient(fn, inputs, eps=1e-3, rtol=1e-2, atol=1e-3):
                                    err_msg=f"gradient mismatch on input {i}")
 
 
+def with_seed(seed=None):
+    """Decorator: run the test under a fixed (or per-run random) seed and
+    print the seed on failure so it can be reproduced — the reference's
+    ``@with_seed()`` pattern (python/mxnet/test_utils.py:? / common.py:?,
+    env ``MXNET_TEST_SEED``)."""
+    import functools
+    import os
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            env = os.environ.get("MXNET_TEST_SEED")
+            # explicit decorator seed wins over the env var (reference
+            # semantics: pinned tests stay pinned)
+            s = (seed if seed is not None
+                 else int(env) if env is not None
+                 else np.random.randint(0, np.iinfo(np.int32).max))
+            np.random.seed(s)
+            from . import random as mx_random
+
+            mx_random.seed(s)
+            try:
+                return fn(*args, **kwargs)
+            except Exception:
+                print(f"with_seed: test failed with seed {s} "
+                      f"(reproduce with MXNET_TEST_SEED={s})")
+                raise
+
+        return wrapper
+
+    return deco
+
+
 def check_consistency(fn, inputs, ctxs=None, rtol=1e-4, atol=1e-5):
     """Run ``fn`` under each context and cross-check outputs (reference
     ``check_consistency`` runs one symbol across [cpu, gpu, ...])."""

@@ -260,3 +260,111 @@ def test_box_decode_encode():
     t, m = nd.contrib.box_encode(samples, matches, anchors, refs)
     assert np.allclose(t.asnumpy(), 0.0, atol=1e-5)
     assert np.all(m.asnumpy() == 1.0)
+
+
+def test_deformable_convolution_zero_offset_matches_conv():
+    # zero offsets + no modulation => identical to a plain dilated conv
+    np.random.seed(0)
+    b, c, h, w = 2, 4, 9, 9
+    o, kh, kw = 6, 3, 3
+    x = nd.array(np.random.randn(b, c, h, w).astype(np.float32))
+    wt = nd.array(np.random.randn(o, c, kh, kw).astype(np.float32) * 0.1)
+    bs = nd.array(np.random.randn(o).astype(np.float32))
+    oh = ow = h - 2  # stride 1, pad 0, dilate 1
+    off = nd.zeros((b, 2 * kh * kw, oh, ow))
+    y_def = nd.contrib.DeformableConvolution(
+        x, off, wt, bs, kernel=(kh, kw), num_filter=o)
+    y_ref = nd.Convolution(x, wt, bs, kernel=(kh, kw), num_filter=o)
+    assert_almost_equal(y_def, y_ref, rtol=1e-4, atol=1e-4)
+
+
+def test_deformable_convolution_pad_stride_groups():
+    np.random.seed(1)
+    b, c, h, w = 2, 4, 8, 8
+    o, kh, kw = 4, 3, 3
+    x = nd.array(np.random.randn(b, c, h, w).astype(np.float32))
+    wt = nd.array(np.random.randn(o, c // 2, kh, kw).astype(np.float32) * 0.1)
+    oh = ow = 4  # stride 2, pad 1
+    off = nd.zeros((b, 2 * 2 * kh * kw, oh, ow))  # 2 deformable groups
+    y_def = nd.contrib.DeformableConvolution(
+        x, off, wt, kernel=(kh, kw), stride=(2, 2), pad=(1, 1),
+        num_filter=o, num_group=2, num_deformable_group=2, no_bias=True)
+    y_ref = nd.Convolution(x, wt, kernel=(kh, kw), stride=(2, 2),
+                           pad=(1, 1), num_filter=o, num_group=2,
+                           no_bias=True)
+    assert_almost_equal(y_def, y_ref, rtol=1e-4, atol=1e-4)
+
+
+def test_deformable_convolution_offset_shifts_samples():
+    # integer offset (0.0, 1.0) on every tap == shifting the input left
+    np.random.seed(2)
+    b, c, h, w = 1, 2, 7, 9
+    o, kh, kw = 3, 3, 3
+    x_np = np.random.randn(b, c, h, w).astype(np.float32)
+    wt = nd.array(np.random.randn(o, c, kh, kw).astype(np.float32) * 0.1)
+    oh, ow = h - 2, w - 2
+    off_np = np.zeros((b, 2 * kh * kw, oh, ow), np.float32)
+    off_np[:, 1::2] = 1.0  # x-offsets = +1
+    y_def = nd.contrib.DeformableConvolution(
+        nd.array(x_np), nd.array(off_np), wt, kernel=(kh, kw),
+        num_filter=o, no_bias=True)
+    x_shift = np.zeros_like(x_np)
+    x_shift[..., :-1] = x_np[..., 1:]
+    y_ref = nd.Convolution(nd.array(x_shift), wt, kernel=(kh, kw),
+                           num_filter=o, no_bias=True)
+    # interior columns agree exactly (boundary column differs: zero pad)
+    assert_almost_equal(y_def.asnumpy()[..., :-1], y_ref.asnumpy()[..., :-1],
+                        rtol=1e-4, atol=1e-4)
+
+
+def test_deformable_convolution_numeric_gradient():
+    from mxnet_tpu.test_utils import check_numeric_gradient
+
+    np.random.seed(3)
+    b, c, h, w = 1, 2, 5, 5
+    o, kh, kw = 2, 3, 3
+    oh = ow = 3
+    x = np.random.randn(b, c, h, w)
+    off = np.random.uniform(-0.4, 0.4, (b, 2 * kh * kw, oh, ow))
+    wt = np.random.randn(o, c, kh, kw) * 0.3
+
+    def f(xx, oo, ww):
+        return nd.contrib.DeformableConvolution(
+            xx, oo, ww, kernel=(kh, kw), num_filter=o, no_bias=True)
+
+    check_numeric_gradient(f, [x, off, wt], eps=1e-4, rtol=2e-2, atol=2e-3)
+
+
+def test_modulated_deformable_convolution():
+    np.random.seed(4)
+    b, c, h, w = 2, 3, 7, 7
+    o, kh, kw = 4, 3, 3
+    oh = ow = 5
+    x = nd.array(np.random.randn(b, c, h, w).astype(np.float32))
+    wt = nd.array(np.random.randn(o, c, kh, kw).astype(np.float32) * 0.1)
+    off = nd.zeros((b, 2 * kh * kw, oh, ow))
+    # mask of ones => DCNv1 behaviour
+    ones = nd.ones((b, kh * kw, oh, ow))
+    y_mod = nd.contrib.ModulatedDeformableConvolution(
+        x, off, ones, wt, kernel=(kh, kw), num_filter=o, no_bias=True)
+    y_ref = nd.Convolution(x, wt, kernel=(kh, kw), num_filter=o,
+                           no_bias=True)
+    assert_almost_equal(y_mod, y_ref, rtol=1e-4, atol=1e-4)
+    # half mask scales contributions linearly
+    y_half = nd.contrib.ModulatedDeformableConvolution(
+        x, off, ones * 0.5, wt, kernel=(kh, kw), num_filter=o, no_bias=True)
+    assert_almost_equal(y_half, y_ref * 0.5, rtol=1e-4, atol=1e-4)
+
+
+def test_with_seed_decorator():
+    from mxnet_tpu.test_utils import with_seed
+
+    vals = []
+
+    @with_seed(42)
+    def gen():
+        vals.append(np.random.randint(0, 10 ** 9))
+
+    gen()
+    gen()
+    assert vals[0] == vals[1]

@@ -1,0 +1,208 @@
+"""dist_async parameter-server kvstore tests.
+
+Reference test model: tests/nightly/dist_async_kvstore.py:? — workers push
+without barriers, server applies updates on arrival; plus the single-process
+async-engine contract (push returns before the update lands, pull drains).
+"""
+import threading
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.kvstore.dist_async import (AsyncPSKVStore, PSServer,
+                                          serve_forever)
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _free_port():
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_embedded_push_pull_replaces():
+    kv = AsyncPSKVStore()
+    kv.init(3, nd.ones((2, 3)))
+    out = nd.zeros((2, 3))
+    for i in range(4):
+        kv.push(3, nd.ones((2, 3)) * (i + 1))
+    kv.pull(3, out=out)
+    # no updater: the last pushed value replaces the stored one (matches
+    # KVStoreLocal — keeps the Trainer push-grad/pull-grad path correct)
+    assert_almost_equal(out, np.full((2, 3), 4.0))
+    kv.close()
+
+
+def test_embedded_server_side_sgd():
+    kv = mx.kv.create("dist_async")
+    assert kv.type == "dist_async"
+    kv.init("w", nd.ones((4,)))
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.5))
+    kv.push("w", nd.ones((4,)) * 2.0)  # w -= 0.5 * 2
+    out = nd.zeros((4,))
+    kv.pull("w", out=out)
+    assert_almost_equal(out, np.zeros((4,)))  # 1 - 0.5*2
+    kv.close()
+
+
+def test_async_push_is_nonblocking_and_fifo():
+    kv = AsyncPSKVStore()
+    kv.init(0, nd.zeros((1000, 100)))
+    # lr=-1 SGD turns every push into "+= grad": 50 pushes => 50.0
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=-1.0))
+    for i in range(50):
+        kv.push(0, nd.ones((1000, 100)))
+    kv.wait_all()
+    out = nd.zeros((1000, 100))
+    kv.pull(0, out=out)
+    assert_almost_equal(out, np.full((1000, 100), 50.0))
+    kv.close()
+
+
+def test_tcp_two_workers_concurrent():
+    port = _free_port()
+    uri = f"127.0.0.1:{port}"
+    srv = serve_forever(uri, PSServer())
+    try:
+        w0 = AsyncPSKVStore(root_uri=uri, rank=0, num_workers=2)
+        w1 = AsyncPSKVStore(root_uri=uri, rank=1, num_workers=2)
+        w0.init("k", nd.zeros((64,)))
+        w1.init("k", nd.zeros((64,)))  # second init is a no-op
+        w0.set_optimizer(mx.optimizer.SGD(learning_rate=-1.0))
+
+        def hammer(kv, n):
+            for _ in range(n):
+                kv.push("k", nd.ones((64,)))
+            kv.wait_all()
+
+        t0 = threading.Thread(target=hammer, args=(w0, 20))
+        t1 = threading.Thread(target=hammer, args=(w1, 30))
+        t0.start(); t1.start(); t0.join(); t1.join()
+        out = nd.zeros((64,))
+        w0.pull("k", out=out)
+        assert_almost_equal(out, np.full((64,), 50.0))
+        w0.close(); w1.close()
+    finally:
+        srv.shutdown()
+
+
+def test_tcp_server_side_optimizer_no_barrier():
+    port = _free_port()
+    uri = f"127.0.0.1:{port}"
+    srv = serve_forever(uri, PSServer())
+    try:
+        w0 = AsyncPSKVStore(root_uri=uri, rank=0, num_workers=2)
+        w1 = AsyncPSKVStore(root_uri=uri, rank=1, num_workers=2)
+        w0.init("w", nd.ones((8,)) * 10.0)
+        w0.set_optimizer(mx.optimizer.SGD(learning_rate=1.0))
+        # worker 1 pushes alone — dist_async applies immediately, no
+        # waiting for worker 0 (the sync mode would block here)
+        w1.push("w", nd.ones((8,)))
+        w1.wait_all()
+        out = nd.zeros((8,))
+        w1.pull("w", out=out)
+        assert_almost_equal(out, np.full((8,), 9.0))
+        w0.close(); w1.close()
+    finally:
+        srv.shutdown()
+
+
+def test_row_sparse_pull_tcp():
+    from mxnet_tpu.ndarray import sparse as sp
+
+    port = _free_port()
+    uri = f"127.0.0.1:{port}"
+    srv = serve_forever(uri, PSServer())
+    try:
+        kv = AsyncPSKVStore(root_uri=uri)
+        table = np.arange(20, dtype=np.float32).reshape(10, 2)
+        kv.init("emb", nd.array(table))
+        out = sp.zeros("row_sparse", (10, 2))
+        kv.row_sparse_pull("emb", out=out, row_ids=nd.array([1, 7]))
+        dense = out.todense().asnumpy()
+        assert_almost_equal(dense[1], table[1])
+        assert_almost_equal(dense[7], table[7])
+        # dense target: only requested rows overwritten
+        dt = nd.ones((10, 2)) * -1.0
+        kv.row_sparse_pull("emb", out=dt, row_ids=nd.array([3]))
+        got = dt.asnumpy()
+        assert_almost_equal(got[3], table[3])
+        assert_almost_equal(got[0], [-1.0, -1.0])
+        kv.close()
+    finally:
+        srv.shutdown()
+
+
+def test_error_surfaces_at_sync_point():
+    kv = AsyncPSKVStore()
+    kv.init("a", nd.ones((2,)))
+    kv.push("a", nd.ones((2,)))
+    kv._enqueue("push", "nope", ("dense", np.ones((2,))))  # uninitialized
+    with pytest.raises(Exception):
+        kv.wait_all()
+    kv.close()
+
+
+def test_trainer_dist_async_matches_local():
+    """Single worker: dist_async (server-side SGD) must produce the exact
+    same weights as local training — the end-to-end Trainer contract."""
+    from mxnet_tpu import autograd, gluon
+
+    results = []
+    for kvname in (None, "dist_async"):
+        mx.random.seed(7)
+        net = gluon.nn.Dense(3)
+        net.initialize(mx.init.Xavier())
+        net(nd.ones((2, 5)))  # resolve deferred shapes
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.1, "momentum": 0.9},
+                                kvstore=kvname)
+        x = nd.array(np.random.RandomState(0).randn(2, 5)
+                     .astype(np.float32))
+        for _ in range(3):
+            with autograd.record():
+                loss = (net(x) ** 2).mean()
+            loss.backward()
+            trainer.step(2)
+        results.append(net.weight.data().asnumpy())
+        if kvname == "dist_async":
+            trainer._kvstore.close()
+    assert_almost_equal(results[0], results[1], rtol=1e-5, atol=1e-6)
+
+
+def test_trainer_dist_async_rejects_client_update():
+    from mxnet_tpu import gluon
+
+    net = gluon.nn.Dense(2)
+    net.initialize(mx.init.Xavier())
+    net(nd.ones((1, 4)))
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            kvstore="dist_async", update_on_kvstore=False)
+    with pytest.raises(Exception):
+        trainer._init_kvstore()
+
+
+def test_trainer_fm_style_sparse_training():
+    """Factorization-machine style: embedding-ish weight trained via
+    dist_async PS push/pull (the BASELINE config 4 shape)."""
+    np.random.seed(0)
+    kv = mx.kv.create("dist_async")
+    w = nd.array(np.random.randn(6, 3).astype(np.float32))
+    kv.init("w", w)
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.1))
+    before = None
+    for step in range(5):
+        grad = nd.array(np.random.randn(6, 3).astype(np.float32))
+        kv.push("w", grad)
+        out = nd.zeros((6, 3))
+        kv.pull("w", out=out)
+        if before is not None:
+            assert not np.allclose(before, out.asnumpy())
+        before = out.asnumpy()
+    kv.close()
