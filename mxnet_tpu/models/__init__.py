@@ -12,7 +12,7 @@ from .bert import BERTModel, BERTClassifier, bert_base, bert_large, \
 
 
 def __getattr__(name):
-    if name in ("llama", "fm"):
+    if name in ("llama", "fm", "moe"):
         import importlib
 
         mod = importlib.import_module("." + name, __name__)

@@ -28,14 +28,17 @@ from ..gluon import nn
 
 __all__ = ["LlamaConfig", "RMSNorm", "LlamaAttention", "LlamaMLP",
            "LlamaDecoderLayer", "LlamaModel", "LlamaForCausalLM",
-           "llama3_8b", "llama_tiny", "shard_llama", "LLAMA_CONFIGS"]
+           "llama3_8b", "llama_tiny", "mixtral_8x7b", "mixtral_tiny",
+           "shard_llama", "LLAMA_CONFIGS"]
 
 
 class LlamaConfig:
     def __init__(self, hidden_size=4096, intermediate_size=14336,
                  num_layers=32, num_heads=32, num_kv_heads=8,
                  vocab_size=128256, max_seq_len=8192, rope_theta=500000.0,
-                 rms_eps=1e-5, tie_embeddings=False, attn_mode="flash"):
+                 rms_eps=1e-5, tie_embeddings=False, attn_mode="flash",
+                 num_experts=0, num_experts_per_tok=2,
+                 capacity_factor=1.25, moe_router="topk"):
         self.hidden_size = hidden_size
         self.intermediate_size = intermediate_size
         self.num_layers = num_layers
@@ -47,6 +50,13 @@ class LlamaConfig:
         self.rms_eps = rms_eps
         self.tie_embeddings = tie_embeddings
         self.attn_mode = attn_mode  # flash | sdpa | ring | ulysses
+        # MoE (Mixtral-style): 0 experts = dense SwiGLU MLP
+        self.num_experts = num_experts
+        self.num_experts_per_tok = num_experts_per_tok
+        self.capacity_factor = capacity_factor
+        # topk | expert_choice — see models/moe.py: expert_choice leaks
+        # future-token info in causal decoders; topk for production LM
+        self.moe_router = moe_router
         if hidden_size % num_heads:
             raise MXNetError("num_heads must evenly divide hidden_size")
         if num_heads % num_kv_heads:
@@ -61,6 +71,15 @@ LLAMA_CONFIGS = {
     "llama_tiny": dict(hidden_size=64, intermediate_size=176,
                        num_layers=2, num_heads=4, num_kv_heads=2,
                        vocab_size=256, max_seq_len=128),
+    # Mixtral-8x7B architecture (sparse MoE decoder, top-2 of 8 experts)
+    "mixtral_8x7b": dict(hidden_size=4096, intermediate_size=14336,
+                         num_layers=32, num_heads=32, num_kv_heads=8,
+                         vocab_size=32000, rope_theta=1e6,
+                         num_experts=8, num_experts_per_tok=2),
+    "mixtral_tiny": dict(hidden_size=64, intermediate_size=176,
+                         num_layers=2, num_heads=4, num_kv_heads=2,
+                         vocab_size=256, max_seq_len=128,
+                         num_experts=4, num_experts_per_tok=2),
 }
 
 
@@ -221,7 +240,15 @@ class LlamaDecoderLayer(HybridBlock):
             self.self_attn = LlamaAttention(cfg, prefix="attn_")
             self.post_attention_layernorm = RMSNorm(
                 cfg.hidden_size, cfg.rms_eps, prefix="ln_post_")
-            self.mlp = LlamaMLP(cfg, prefix="mlp_")
+            if cfg.num_experts > 0:
+                from .moe import MoEMLP
+
+                self.mlp = MoEMLP(cfg.hidden_size, cfg.intermediate_size,
+                                  cfg.num_experts, cfg.num_experts_per_tok,
+                                  cfg.capacity_factor, cfg.moe_router,
+                                  prefix="moe_")
+            else:
+                self.mlp = LlamaMLP(cfg, prefix="mlp_")
 
     def hybrid_forward(self, F, x):
         x = x + self.self_attn(self.input_layernorm(x))
@@ -303,20 +330,37 @@ def llama_tiny(**overrides):
                                            **overrides}))
 
 
-def shard_llama(net, mesh=None, tp_axis="tp", dp_axis="dp"):
+def mixtral_8x7b(**overrides):
+    """Mixtral-8x7B sparse-MoE architecture (beyond-reference model
+    family: MoE + expert parallelism, SURVEY §2.3 D9)."""
+    return LlamaForCausalLM(LlamaConfig(**{**LLAMA_CONFIGS["mixtral_8x7b"],
+                                           **overrides}))
+
+
+def mixtral_tiny(**overrides):
+    """Tiny MoE config for tests/dryruns."""
+    return LlamaForCausalLM(LlamaConfig(**{**LLAMA_CONFIGS["mixtral_tiny"],
+                                           **overrides}))
+
+
+def shard_llama(net, mesh=None, tp_axis="tp", dp_axis="dp", ep_axis="ep"):
     """Annotate megatron-style TP shardings over ``mesh`` (pjit/GSPMD
     derives the collectives — SURVEY §2.3 D6, new capability):
 
     - q/k/v/gate/up: column-parallel (output dim split over tp)
     - o/down:       row-parallel (input dim split over tp)
     - embed/lm_head: vocab-parallel
+    - MoE layers: expert bank sharded over ``ep`` (+tp within experts)
     Replicates everything else.  Weights are stored (out, in), so the
     output dim is axis 0.
     """
     from .. import parallel
+    from .moe import MoEMLP, shard_moe
 
     mesh = mesh or parallel.current_mesh()
-    if mesh is None or tp_axis not in mesh.shape:
+    has_tp = mesh is not None and tp_axis in mesh.shape
+    has_ep = mesh is not None and ep_axis in mesh.shape
+    if mesh is None or not (has_tp or has_ep):
         parallel.replicate_block_params(net)
         return net
     col = (tp_axis, None)
@@ -324,13 +368,20 @@ def shard_llama(net, mesh=None, tp_axis="tp", dp_axis="dp"):
     parallel.replicate_block_params(net)  # baseline: replicate all
     for layer in net.model.layers:
         attn, mlp = layer.self_attn, layer.mlp
-        for p in (attn.q_proj.weight, attn.k_proj.weight,
-                  attn.v_proj.weight, mlp.gate_proj.weight,
-                  mlp.up_proj.weight):
-            parallel.shard_param(p, col, mesh)
-        for p in (attn.o_proj.weight, mlp.down_proj.weight):
-            parallel.shard_param(p, row, mesh)
-    parallel.shard_param(net.model.embed_tokens.weight, col, mesh)
-    if not net._cfg.tie_embeddings:
-        parallel.shard_param(net.lm_head.weight, col, mesh)
+        if has_tp:
+            for p in (attn.q_proj.weight, attn.k_proj.weight,
+                      attn.v_proj.weight):
+                parallel.shard_param(p, col, mesh)
+            parallel.shard_param(attn.o_proj.weight, row, mesh)
+        if isinstance(mlp, MoEMLP):
+            shard_moe(mlp, mesh, ep_axis=ep_axis,
+                      tp_axis=tp_axis if has_tp else None)
+        elif has_tp:
+            for p in (mlp.gate_proj.weight, mlp.up_proj.weight):
+                parallel.shard_param(p, col, mesh)
+            parallel.shard_param(mlp.down_proj.weight, row, mesh)
+    if has_tp:
+        parallel.shard_param(net.model.embed_tokens.weight, col, mesh)
+        if not net._cfg.tie_embeddings:
+            parallel.shard_param(net.lm_head.weight, col, mesh)
     return net

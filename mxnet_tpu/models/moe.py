@@ -1,0 +1,219 @@
+"""Mixture-of-Experts layer + expert parallelism (EP).
+
+Reference: NONE — MoE is ABSENT in the reference (SURVEY §2.3 D9); this is
+new TPU-native capability, built so a stock ``gluon.Trainer`` trains it and
+``shard_moe`` adds expert parallelism over an ``ep`` mesh axis.
+
+TPU-first design decisions:
+- Expert weights are STACKED into single (E, ...) parameters, so the whole
+  expert bank is one batched einsum on the MXU — not E small matmuls.  With
+  ``shard_moe`` the expert axis is sharded over ``ep`` and GSPMD derives the
+  token all-to-all (dispatch einsum) / all-reduce (combine einsum), the same
+  way psum is derived for dp.
+- Routing is FIXED-CAPACITY (dispatch/combine tensors of static shape
+  (N, E, C)); overflow tokens are dropped from the expert path (standard
+  Switch/GShard semantics) and pass through the residual stream.  Dynamic
+  per-expert token counts would not compile for the MXU.
+- Two routers:
+  * ``topk`` — tokens pick experts (GShard/Mixtral style, k experts per
+    token, gates renormalised over the chosen k); needs the load-balancing
+    auxiliary loss to avoid collapse (see ``collect_aux``).
+  * ``expert_choice`` — experts pick tokens (top-C over the token axis);
+    perfectly load-balanced by construction, no aux loss needed.  CAVEAT
+    for causal decoders: expert assignment of token t depends on the
+    top-C competition against LATER tokens, so training sees (weak)
+    future information that autoregressive inference won't have — the
+    known expert-choice-in-decoder train/inference mismatch.  Prefer
+    ``topk`` for production causal-LM training; expert_choice is ideal
+    for encoders and fine for routing-plumbing tests/dryruns.
+- Router math runs in float32 regardless of activation dtype (bf16 routing
+  logits are a known training-instability source).
+"""
+from __future__ import annotations
+
+import math
+
+from ..base import MXNetError
+from ..gluon.block import HybridBlock
+
+__all__ = ["MoEMLP", "collect_aux", "shard_moe"]
+
+
+# --- aux-loss collection ----------------------------------------------------
+
+# thread-local, matching autograd._AGState / parallel._STATE: concurrent
+# per-thread training must not share a sink
+import threading as _threading
+
+_TLS = _threading.local()
+
+
+def _sink():
+    return getattr(_TLS, "aux_sink", None)
+
+
+class collect_aux:
+    """Collect per-layer load-balancing losses during an EAGER forward::
+
+        with moe.collect_aux() as aux:
+            logits = net(x)                       # not hybridized
+            loss = ce(logits, y) + 0.01 * sum(aux)
+
+    Each entry is a tape-connected scalar NDArray (an extra output of the
+    MoE op), so ``backward()`` trains the router through it.  Under
+    ``hybridize()`` tracing this raises: traced values can't escape the
+    compiled graph — train un-hybridized when using the topk router with
+    aux loss, or use router="expert_choice" (needs no aux loss).
+    """
+
+    def __enter__(self):
+        self._prev = _sink()
+        _TLS.aux_sink = []
+        return _TLS.aux_sink
+
+    def __exit__(self, *exc):
+        _TLS.aux_sink = self._prev
+        return False
+
+
+class MoEMLP(HybridBlock):
+    """Sparse SwiGLU feed-forward: each token is processed by k of E
+    experts, outputs combined with the (renormalised) router gates.
+
+    Drop-in replacement for a dense SwiGLU MLP of the same
+    hidden/intermediate sizes (e.g. ``models.llama.LlamaMLP``).
+    """
+
+    def __init__(self, hidden_size, intermediate_size, num_experts,
+                 num_experts_per_tok=2, capacity_factor=1.25,
+                 router="topk", **kwargs):
+        super().__init__(**kwargs)
+        if router not in ("topk", "expert_choice"):
+            raise MXNetError(f"unknown MoE router {router!r}")
+        if num_experts_per_tok > num_experts:
+            raise MXNetError("num_experts_per_tok must be <= num_experts")
+        self._h = hidden_size
+        self._i = intermediate_size
+        self._e = num_experts
+        self._k = num_experts_per_tok
+        self._cf = capacity_factor
+        self._router = router
+        with self.name_scope():
+            self.router_weight = self.params.get(
+                "router_weight", shape=(num_experts, hidden_size))
+            self.gate_weight = self.params.get(
+                "gate_weight",
+                shape=(num_experts, intermediate_size, hidden_size))
+            self.up_weight = self.params.get(
+                "up_weight",
+                shape=(num_experts, intermediate_size, hidden_size))
+            self.down_weight = self.params.get(
+                "down_weight",
+                shape=(num_experts, hidden_size, intermediate_size))
+
+    def _capacity(self, n):
+        return max(1, int(math.ceil(n * self._k * self._cf / self._e)))
+
+    def hybrid_forward(self, F, x, router_weight, gate_weight, up_weight,
+                       down_weight):
+        from ..ops.registry import apply_op
+
+        e, k, router = self._e, self._k, self._router
+        cap_of = self._capacity
+
+        def _f(xr, rw, gw, uw, dw):
+            import jax
+            import jax.numpy as jnp
+            from jax import lax
+
+            b, t, h = xr.shape
+            n = b * t
+            c = min(cap_of(n), n)  # an expert can't hold > n tokens
+            xt = xr.reshape(n, h)
+            logits = xt.astype(jnp.float32) @ rw.astype(jnp.float32).T
+            probs = jax.nn.softmax(logits, axis=-1)          # (N, E) f32
+
+            if router == "expert_choice":
+                # experts pick tokens: balanced by construction
+                gates, idx = lax.top_k(probs.T, c)           # (E, C)
+                disp = jax.nn.one_hot(idx, n, dtype=xr.dtype)  # (E, C, N)
+                ein = jnp.einsum("ecn,nh->ech", disp, xt)
+                out_e = _expert_ffn(ein, gw, uw, dw)
+                y = jnp.einsum("ecn,ec,ech->nh", disp,
+                               gates.astype(xr.dtype), out_e)
+                aux = jnp.zeros((), jnp.float32)
+            else:
+                gates, idx = lax.top_k(probs, k)             # (N, k)
+                gates = gates / gates.sum(-1, keepdims=True)
+                disp = jnp.zeros((n, e, c), xr.dtype)
+                comb = jnp.zeros((n, e, c), xr.dtype)
+                counts = jnp.zeros((e,), jnp.int32)
+                rows = jnp.arange(n)
+                for s in range(k):  # k is tiny; unrolled at trace time
+                    sel = idx[:, s]                           # (N,)
+                    onehot = jax.nn.one_hot(sel, e, dtype=jnp.int32)
+                    pos = (onehot * (jnp.cumsum(onehot, axis=0) - 1
+                                     + counts[None, :])).sum(-1)
+                    keep = (pos < c).astype(xr.dtype)
+                    slot = jnp.clip(pos, 0, c - 1)
+                    disp = disp.at[rows, sel, slot].add(keep)
+                    comb = comb.at[rows, sel, slot].add(
+                        keep * gates[:, s].astype(xr.dtype))
+                    counts = counts + onehot.sum(0)
+                ein = jnp.einsum("nec,nh->ech", disp, xt)
+                out_e = _expert_ffn(ein, gw, uw, dw)
+                y = jnp.einsum("nec,ech->nh", comb, out_e)
+                # Switch-style load-balance loss: E * sum_e f_e * P_e
+                frac = jax.nn.one_hot(idx[:, 0], e,
+                                      dtype=jnp.float32).mean(0)
+                aux = e * (frac * probs.mean(0)).sum()
+            return y.reshape(b, t, h), aux
+
+        y, aux = apply_op(_f, x, router_weight, gate_weight, up_weight,
+                          down_weight, name="moe_mlp")
+        sink = _sink()
+        if sink is not None:
+            import jax
+
+            if isinstance(aux._data, jax.core.Tracer):
+                raise MXNetError(
+                    "collect_aux() cannot cross a hybridize() trace; train "
+                    "un-hybridized with the topk router, or use "
+                    "router='expert_choice' (no aux loss needed)")
+            sink.append(aux)
+        return y
+
+
+def _expert_ffn(ein, gw, uw, dw):
+    """SwiGLU over the stacked expert bank: ein (E, C, H) → (E, C, H).
+    One batched einsum per projection — the MXU sees E-batched matmuls."""
+    import jax
+    import jax.numpy as jnp
+
+    g = jnp.einsum("ech,eih->eci", ein, gw.astype(ein.dtype))
+    u = jnp.einsum("ech,eih->eci", ein, uw.astype(ein.dtype))
+    act = g * jax.nn.sigmoid(g) * u
+    return jnp.einsum("eci,ehi->ech", act, dw.astype(ein.dtype))
+
+
+def shard_moe(block, mesh=None, ep_axis="ep", tp_axis=None):
+    """Expert parallelism: shard the stacked expert bank over ``ep_axis``
+    (optionally tensor-parallel within each expert over ``tp_axis``).
+    Either axis may be absent from the mesh — a dp×tp mesh still gets the
+    experts tp-sharded (the expert bank dominates MoE parameter memory).
+    GSPMD derives the token all-to-all from the dispatch/combine einsums —
+    the TPU-native analog of hand-written MoE a2a kernels."""
+    from .. import parallel
+
+    mesh = mesh or parallel.current_mesh()
+    if mesh is None:
+        return block
+    ep = ep_axis if (ep_axis and ep_axis in mesh.shape) else None
+    tp = tp_axis if (tp_axis and tp_axis in mesh.shape) else None
+    if ep is None and tp is None:
+        return block
+    parallel.shard_param(block.router_weight, (None, None), mesh)
+    parallel.shard_param(block.gate_weight, (ep, tp, None), mesh)
+    parallel.shard_param(block.up_weight, (ep, tp, None), mesh)
+    parallel.shard_param(block.down_weight, (ep, None, tp), mesh)
+    return block
