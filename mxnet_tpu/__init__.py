@@ -80,6 +80,7 @@ _LAZY = {
     "runtime": ".runtime",
     "models": ".models",
     "model": ".model",
+    "predictor": ".predictor",
 }
 
 

@@ -1,17 +1,6 @@
 """``mx.contrib.onnx`` (reference ``python/mxnet/contrib/onnx/
-__init__.py:?``): ONNX export (mx2onnx).  Import (onnx2mx) requires the
-``onnx`` package to parse arbitrary external models and is gated on it;
-models exported HERE round-trip through the bundled wire-format decoder
-(see tests/test_onnx.py)."""
+__init__.py:?``): ONNX export (mx2onnx) AND import (onnx2mx), both over
+the bundled protobuf wire-format codec — no ``onnx`` package dependency
+in either direction (the reference needs it for both)."""
 from .mx2onnx import export_model  # noqa: F401
-
-
-def import_model(model_file):
-    try:
-        import onnx  # noqa: F401
-    except ImportError as e:
-        raise ImportError(
-            "onnx2mx import requires the 'onnx' package, which is not "
-            "installed in this environment") from e
-    raise NotImplementedError(
-        "onnx2mx import lands when an onnx runtime is available")
+from .onnx2mx import import_model  # noqa: F401

@@ -1,0 +1,115 @@
+"""Standalone predictor (c_predict_api analog) tests.
+
+Reference test model: the MXPredCreate → SetInput → Forward → GetOutput
+call sequence (src/c_api/c_predict_api.cc:?, SURVEY §3.5) driven over both
+serving formats: gluon export (StableHLO) and legacy nnvm symbol-json
+checkpoints.
+"""
+import json
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, nd
+from mxnet_tpu.predictor import Predictor, create
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _exported_mlp(tmp_path):
+    mx.random.seed(0)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(16, activation="relu"), gluon.nn.Dense(4))
+    net.initialize(mx.init.Xavier())
+    x = nd.array(np.random.RandomState(0).randn(2, 8).astype(np.float32))
+    ref = net(x).asnumpy()
+    net.hybridize()
+    net(x)
+    prefix = str(tmp_path / "mlp")
+    net.export(prefix, epoch=0)
+    return prefix, x, ref
+
+
+def test_predict_stablehlo_export(tmp_path):
+    prefix, x, ref = _exported_mlp(tmp_path)
+    pred = create(f"{prefix}-symbol.json", f"{prefix}-0000.params")
+    out = pred.predict(x)
+    assert_almost_equal(out, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_set_input_forward_get_output(tmp_path):
+    prefix, x, ref = _exported_mlp(tmp_path)
+    pred = Predictor(f"{prefix}-symbol.json", f"{prefix}-0000.params")
+    name = pred.input_names[0]
+    pred.set_input(name, x)
+    pred.forward()
+    assert pred.num_outputs == 1
+    assert_almost_equal(pred.get_output(0), ref, rtol=1e-5, atol=1e-6)
+    with pytest.raises(mx.MXNetError):
+        pred.get_output(3)
+
+
+def test_param_bytes_and_symbol_dict(tmp_path):
+    """MXPredCreate-style: symbol passed as parsed JSON (dict) and params
+    as raw BYTES; the stablehlo artifact referenced by absolute path."""
+    import os
+
+    prefix, x, ref = _exported_mlp(tmp_path)
+    with open(f"{prefix}-symbol.json") as f:
+        meta = json.load(f)
+    with open(f"{prefix}-0000.params", "rb") as f:
+        param_bytes = f.read()
+    meta["stablehlo_file"] = os.path.abspath(f"{prefix}-0000.stablehlo")
+    pred = Predictor(meta, param_bytes)
+    out = pred.predict(x)
+    assert_almost_equal(out, ref, rtol=1e-5, atol=1e-6)
+
+    # the documented bytes-everything surface: meta dict untouched,
+    # artifact shipped via stablehlo=<bytes>
+    with open(f"{prefix}-symbol.json") as f:
+        meta2 = json.load(f)
+    with open(f"{prefix}-0000.stablehlo", "rb") as f:
+        hlo_bytes = f.read()
+    pred2 = Predictor(meta2, param_bytes, stablehlo=hlo_bytes)
+    assert_almost_equal(pred2.predict(x), ref, rtol=1e-5, atol=1e-6)
+    # without the artifact, a clear error (not FileNotFoundError)
+    with pytest.raises(mx.MXNetError):
+        Predictor(json.load(open(f"{prefix}-symbol.json")), param_bytes)
+
+
+def test_predict_legacy_nnvm_checkpoint(tmp_path):
+    """Symbol-graph checkpoint (module save_checkpoint format) serves
+    through the same predictor."""
+    import mxnet_tpu.symbol as sym
+
+    data = sym.Variable("data")
+    w = sym.Variable("fc_weight")
+    b = sym.Variable("fc_bias")
+    out = sym.FullyConnected(data, w, b, num_hidden=3, name="fc")
+    out = sym.Activation(out, act_type="relu")
+
+    rs = np.random.RandomState(1)
+    wv = rs.randn(3, 6).astype(np.float32)
+    bv = rs.randn(3).astype(np.float32)
+    from mxnet_tpu import serialization
+
+    prefix = str(tmp_path / "legacy")
+    out.save(f"{prefix}-symbol.json")
+    serialization.save_ndarrays(f"{prefix}-0000.params", {
+        "arg:fc_weight": nd.array(wv), "arg:fc_bias": nd.array(bv)})
+
+    pred = Predictor(f"{prefix}-symbol.json", f"{prefix}-0000.params")
+    assert pred.input_names == ["data"]
+    x = rs.randn(5, 6).astype(np.float32)
+    got = pred.predict(x).asnumpy()
+    want = np.maximum(x @ wv.T + bv, 0.0)
+    assert_almost_equal(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_input_validation(tmp_path):
+    prefix, x, _ = _exported_mlp(tmp_path)
+    pred = Predictor(f"{prefix}-symbol.json", f"{prefix}-0000.params")
+    with pytest.raises(mx.MXNetError):
+        pred.set_input("nope", x)
+    with pytest.raises(mx.MXNetError):
+        pred.forward()  # nothing staged
