@@ -28,8 +28,8 @@ from ..gluon import nn
 
 __all__ = ["LlamaConfig", "RMSNorm", "LlamaAttention", "LlamaMLP",
            "LlamaDecoderLayer", "LlamaModel", "LlamaForCausalLM",
-           "llama3_8b", "llama_tiny", "mixtral_8x7b", "mixtral_tiny",
-           "shard_llama", "LLAMA_CONFIGS"]
+           "LlamaDecoder", "llama3_8b", "llama_tiny", "mixtral_8x7b",
+           "mixtral_tiny", "shard_llama", "LLAMA_CONFIGS"]
 
 
 class LlamaConfig:
@@ -303,12 +303,16 @@ class LlamaForCausalLM(HybridBlock):
                             name="tied_lm_head")
         return self.lm_head(h)
 
-    def generate(self, input_ids, max_new_tokens=16):
-        """Greedy decoding (no KV cache — full re-forward per token; a
-        cached incremental path is future work)."""
+    def generate(self, input_ids, max_new_tokens=16, use_cache=True):
+        """Greedy decoding.  ``use_cache=True`` (default) runs the jitted
+        incremental decode step with a static-shape KV cache
+        (O(T) per token); ``use_cache=False`` re-forwards the full
+        sequence per token (O(T²), kept as the reference oracle)."""
         from .. import ndarray as nd
         from .. import autograd as ag
 
+        if use_cache and self._cfg.num_experts == 0:
+            return self._generate_cached(input_ids, max_new_tokens)
         cur = input_ids
         with ag.pause():
             for _ in range(max_new_tokens):
@@ -316,6 +320,257 @@ class LlamaForCausalLM(HybridBlock):
                 nxt = nd.argmax(logits, axis=-1)[:, -1:]
                 cur = nd.concat(cur, nxt.astype(cur.dtype), dim=1)
         return cur
+
+    def _generate_cached(self, input_ids, max_new_tokens):
+        from .. import ndarray as nd
+
+        b, t0 = input_ids.shape
+        # bucket max_len to a power of two (min 64) so repeated calls with
+        # nearby lengths reuse ONE compiled decoder instead of recompiling
+        need = t0 + max_new_tokens
+        bucket = 64
+        while bucket < need:
+            bucket *= 2
+        cache = self.__dict__.setdefault("_kv_decoders", {})
+        dec = cache.get(bucket)
+        if dec is None:
+            dec = cache[bucket] = LlamaDecoder(self, max_len=bucket)
+        ids = dec.generate(input_ids._data, max_new_tokens)
+        return nd.NDArray(ids).astype(input_ids.dtype)
+
+
+class LlamaDecoder:
+    """Jitted incremental decoder with a static-shape KV cache.
+
+    Reference: NONE (the reference predates LLM serving).  TPU-first
+    design: ``generate`` is ONE compiled XLA program — a batched
+    full-sequence prefill writes the prompt's K/V into the
+    (B, Hkv, max_len, D) cache, then a ``lax.scan`` greedy-decode loop
+    runs entirely on device (no per-token host round trips).  Weights
+    enter as jit ARGUMENTS (pulled fresh from the net's Parameters on
+    every call), so generation always sees current weights and XLA does
+    not bake multi-GB constants into the executable.
+
+    The math mirrors ``LlamaAttention``/``LlamaMLP``; attention scores
+    accumulate in float32 (``preferred_element_type``) exactly like the
+    training ``_sdpa_ref`` path, and tests/test_llama.py pins cached ==
+    uncached logits so the paths cannot drift.  Dense MLP only (MoE
+    decode falls back to the oracle path).
+    """
+
+    def __init__(self, net: "LlamaForCausalLM", max_len: int):
+        import jax
+        import jax.numpy as jnp
+
+        cfg = net.config
+        if cfg.num_experts:
+            raise MXNetError("LlamaDecoder supports dense MLP configs")
+        self.cfg = cfg
+        self.max_len = int(max_len)
+        self._net = net
+        cos, sin = _rope_tables(self.max_len, cfg.head_dim, cfg.rope_theta)
+        self._cos, self._sin = jnp.asarray(cos), jnp.asarray(sin)
+        self._step = jax.jit(self._step_impl, donate_argnums=(1,))
+        self._gen = jax.jit(self._generate_impl, static_argnums=(2,))
+
+    def _weights(self):
+        """Fresh raw-weight pytree from the net's Parameters (cheap: just
+        handle plumbing; jit hashes it by shape/dtype, not value)."""
+        net = self._net
+        raw = lambda p: p.data()._data  # noqa: E731
+        layers = [
+            dict(ln_in=raw(lr.input_layernorm.weight),
+                 q=raw(lr.self_attn.q_proj.weight),
+                 k=raw(lr.self_attn.k_proj.weight),
+                 v=raw(lr.self_attn.v_proj.weight),
+                 o=raw(lr.self_attn.o_proj.weight),
+                 ln_post=raw(lr.post_attention_layernorm.weight),
+                 gate=raw(lr.mlp.gate_proj.weight),
+                 up=raw(lr.mlp.up_proj.weight),
+                 down=raw(lr.mlp.down_proj.weight))
+            for lr in net.model.layers]
+        emb = raw(net.model.embed_tokens.weight)
+        head = emb if self.cfg.tie_embeddings else raw(net.lm_head.weight)
+        return dict(layers=layers, emb=emb,
+                    norm=raw(net.model.norm.weight), head=head)
+
+    def init_cache(self, batch):
+        import jax.numpy as jnp
+
+        cfg = self.cfg
+        shape = (batch, cfg.num_kv_heads, self.max_len, cfg.head_dim)
+        dt = self._net.model.embed_tokens.weight.data().dtype
+        import numpy as np
+
+        dt = np.dtype(dt)
+        return [(jnp.zeros(shape, dt), jnp.zeros(shape, dt))
+                for _ in range(cfg.num_layers)]
+
+    @staticmethod
+    def _rms(x, w, eps):
+        import jax.numpy as jnp
+
+        xf = x.astype(jnp.float32)
+        var = (xf * xf).mean(axis=-1, keepdims=True)
+        return (xf / jnp.sqrt(var + eps) * w.astype(jnp.float32)) \
+            .astype(x.dtype)
+
+    def _attend(self, q, k, v, mask):
+        """Scores in f32 accumulation (matches _sdpa_ref), masked
+        softmax, context.  q (B,H,Q,D); k/v (B,Hkv,T,D); mask (Q,T)."""
+        import jax
+        import jax.numpy as jnp
+
+        cfg = self.cfg
+        rep = cfg.num_heads // cfg.num_kv_heads
+        if rep > 1:
+            k = jnp.repeat(k, rep, axis=1)
+            v = jnp.repeat(v, rep, axis=1)
+        scores = jnp.einsum("bhqd,bhtd->bhqt", q, k,
+                            preferred_element_type=jnp.float32)
+        scores = scores / jnp.sqrt(jnp.float32(cfg.head_dim))
+        scores = jnp.where(mask[None, None], scores, -jnp.inf)
+        attn = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+        return jnp.einsum("bhqt,bhtd->bhqd", attn, v)
+
+    def _layer(self, L, x, ctx_fn):
+        """Shared residual wiring: x + attn(ln(x)) then + mlp(ln(x))."""
+        import jax
+
+        cfg = self.cfg
+        h = self._rms(x, L["ln_in"], cfg.rms_eps)
+        x = x + ctx_fn(h)
+        h2 = self._rms(x, L["ln_post"], cfg.rms_eps)
+        g = h2 @ L["gate"].T
+        return x + (g * jax.nn.sigmoid(g) * (h2 @ L["up"].T)) @ L["down"].T
+
+    def _step_impl(self, w, caches, ids_t, pos):
+        """ids_t (B,) int32, pos () int32 → (logits (B, V), caches)."""
+        import jax.numpy as jnp
+        from jax import lax
+
+        cfg = self.cfg
+        hd = cfg.head_dim
+        b = ids_t.shape[0]
+        pos = jnp.asarray(pos, jnp.int32)
+        z = jnp.zeros((), jnp.int32)
+        cos = lax.dynamic_slice(self._cos, (pos, z), (1, hd // 2))
+        sin = lax.dynamic_slice(self._sin, (pos, z), (1, hd // 2))
+        x = w["emb"][ids_t]                                     # (B, H)
+        new_caches = []
+        mask = (jnp.arange(self.max_len) <= pos)[None, :]       # (1, T)
+        for L, (kc, vc) in zip(w["layers"], caches):
+
+            def ctx_fn(h, L=L, kc=kc, vc=vc):
+                q = (h @ L["q"].T).reshape(b, cfg.num_heads, 1, hd)
+                k = (h @ L["k"].T).reshape(b, cfg.num_kv_heads, 1, hd)
+                v = (h @ L["v"].T).reshape(b, cfg.num_kv_heads, 1, hd)
+                q = _apply_rope(q, cos[None, None], sin[None, None])
+                k = _apply_rope(k, cos[None, None], sin[None, None])
+                kc2 = lax.dynamic_update_slice(kc, k, (z, z, pos, z))
+                vc2 = lax.dynamic_update_slice(vc, v, (z, z, pos, z))
+                new_caches.append((kc2, vc2))
+                ctx = self._attend(q, kc2, vc2, mask)
+                return ctx.reshape(b, cfg.num_heads * hd) @ L["o"].T
+
+            x = self._layer(L, x, ctx_fn)
+        x = self._rms(x, w["norm"], cfg.rms_eps)
+        return x @ w["head"].T, new_caches
+
+    def _prefill_impl(self, w, ids):
+        """Batched full-sequence prompt pass: (B, T0) → (caches with K/V
+        written at [0:T0], last-position logits).  One MXU-friendly
+        forward instead of T0 serialized vector steps."""
+        import jax.numpy as jnp
+        from jax import lax
+
+        cfg = self.cfg
+        hd = cfg.head_dim
+        b, t0 = ids.shape
+        cos, sin = self._cos[:t0], self._sin[:t0]
+        x = w["emb"][ids]                                   # (B, T0, H)
+        causal = jnp.tril(jnp.ones((t0, t0), bool))         # (Q, T)
+        z = jnp.zeros((), jnp.int32)
+        caches = []
+        for L in w["layers"]:
+
+            def ctx_fn(h, L=L):
+                q = (h @ L["q"].T).reshape(b, t0, cfg.num_heads, hd) \
+                    .transpose(0, 2, 1, 3)
+                k = (h @ L["k"].T).reshape(b, t0, cfg.num_kv_heads, hd) \
+                    .transpose(0, 2, 1, 3)
+                v = (h @ L["v"].T).reshape(b, t0, cfg.num_kv_heads, hd) \
+                    .transpose(0, 2, 1, 3)
+                q = _apply_rope(q, cos[None, None], sin[None, None])
+                k = _apply_rope(k, cos[None, None], sin[None, None])
+                shape = (b, cfg.num_kv_heads, self.max_len, hd)
+                kc = lax.dynamic_update_slice(
+                    jnp.zeros(shape, k.dtype), k, (z, z, z, z))
+                vc = lax.dynamic_update_slice(
+                    jnp.zeros(shape, v.dtype), v, (z, z, z, z))
+                caches.append((kc, vc))
+                ctx = self._attend(q, k, v, causal)
+                return ctx.transpose(0, 2, 1, 3) \
+                    .reshape(b, t0, cfg.num_heads * hd) @ L["o"].T
+
+            x = self._layer(L, x, ctx_fn)
+        x_last = self._rms(x[:, -1], w["norm"], cfg.rms_eps)
+        return caches, x_last @ w["head"].T
+
+    def logits_at(self, ids):
+        """Teacher-forced per-step decode over ``ids`` (B, T) returning
+        logits at every position (B, T, V) — the parity-test surface for
+        the single-token step path."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        ids = jnp.asarray(ids, jnp.int32)
+        b, t = ids.shape
+        w = self._weights()
+        caches = self.init_cache(b)
+        outs = []
+        for p in range(t):
+            logits, caches = self._step(w, caches, ids[:, p], jnp.int32(p))
+            outs.append(np.asarray(logits))
+        return np.stack(outs, axis=1)
+
+    def _generate_impl(self, w, ids, max_new_tokens):
+        """(B, T0) int32 → (B, max_new_tokens) greedy continuation in one
+        XLA program: batched prefill, then a decode scan of N-1 steps
+        (the first new token comes from the prefill logits)."""
+        import jax.numpy as jnp
+        from jax import lax
+
+        b, t0 = ids.shape
+        caches, logits = self._prefill_impl(w, ids)
+        cur = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+        def decode_body(carry, _):
+            caches, cur, pos = carry
+            logits, caches = self._step_impl(w, caches, cur, pos)
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return (caches, nxt, pos + 1), nxt
+
+        (_, _, _), toks = lax.scan(
+            decode_body, (caches, cur, jnp.int32(t0)), None,
+            length=max_new_tokens - 1)
+        return jnp.concatenate([cur[:, None], toks.T], axis=1)
+
+    def generate(self, ids, max_new_tokens):
+        """Greedy decode: one compiled XLA program per (batch,
+        prompt_len, max_new_tokens) signature; weights read fresh from
+        the net each call."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        ids = jnp.asarray(ids, jnp.int32)
+        t0 = ids.shape[1]
+        if max_new_tokens < 1:
+            raise MXNetError("max_new_tokens must be >= 1")
+        if t0 + max_new_tokens > self.max_len:
+            raise MXNetError("max_len exceeded; build a larger decoder")
+        toks = self._gen(self._weights(), ids, int(max_new_tokens))
+        return np.concatenate([np.asarray(ids), np.asarray(toks)], axis=1)
 
 
 def llama3_8b(**overrides):

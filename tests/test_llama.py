@@ -170,3 +170,42 @@ def test_llama3_8b_config():
     assert cfg.head_dim == 128
     assert cfg.num_kv_heads == 8
     assert cfg.vocab_size == 128256
+
+
+def test_kv_cache_decoder_logits_parity():
+    """Jitted KV-cache decode must produce the same logits as the full
+    forward at every position (the anti-drift pin for LlamaDecoder)."""
+    mx.random.seed(0)
+    net = llama.llama_tiny(attn_mode="sdpa")
+    net.initialize(mx.init.Xavier())
+    ids = _ids(2, 12)
+    ref = net(ids).asnumpy()                       # (B, T, V)
+    dec = llama.LlamaDecoder(net, max_len=12)
+    got = dec.logits_at(ids.asnumpy())
+    onp.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_kv_cache_generate_matches_oracle():
+    mx.random.seed(1)
+    net = llama.llama_tiny(attn_mode="sdpa")
+    net.initialize(mx.init.Xavier())
+    prompt = _ids(2, 5, seed=3)
+    slow = net.generate(prompt, max_new_tokens=6, use_cache=False)
+    fast = net.generate(prompt, max_new_tokens=6, use_cache=True)
+    assert fast.shape == slow.shape == (2, 11)
+    assert fast.asnumpy().tolist() == slow.asnumpy().tolist()
+
+
+def test_kv_cache_rejects_overflow_and_moe():
+    net = llama.llama_tiny(attn_mode="sdpa")
+    net.initialize(mx.init.Xavier())
+    dec = llama.LlamaDecoder(net, max_len=6)
+    with pytest.raises(mx.MXNetError):
+        dec.generate(_ids(1, 4).asnumpy(), max_new_tokens=5)
+    moe_net = llama.mixtral_tiny(attn_mode="sdpa")
+    moe_net.initialize(mx.init.Xavier())
+    with pytest.raises(mx.MXNetError):
+        llama.LlamaDecoder(moe_net, max_len=8)
+    # MoE generate falls back to the oracle path
+    out = moe_net.generate(_ids(1, 3), max_new_tokens=2)
+    assert out.shape == (1, 5)
