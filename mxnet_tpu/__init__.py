@@ -81,6 +81,7 @@ _LAZY = {
     "models": ".models",
     "model": ".model",
     "predictor": ".predictor",
+    "checkpoint": ".checkpoint",
 }
 
 

@@ -136,6 +136,42 @@ class CheckpointHandler(TrainBegin, EpochEnd):
             estimator.net.save_parameters(path)
 
 
+class FaultTolerantCheckpoint(TrainBegin, EpochEnd):
+    """Atomic checkpoint + auto-resume handler (beyond the reference's
+    CheckpointHandler: includes Trainer state and survives mid-write
+    crashes — see mxnet_tpu/checkpoint.py).
+
+    On ``train_begin`` it RESUMES from the newest complete checkpoint in
+    ``ckpt_dir`` (restoring weights, optimizer state and RNG position);
+    every ``save_every`` epochs it writes ``ckpt-<epoch>`` atomically,
+    keeping the newest ``keep``.
+    """
+
+    def __init__(self, ckpt_dir, save_every=1, keep=3):
+        self.ckpt_dir = ckpt_dir
+        self.save_every = save_every
+        self.keep = keep
+        self.resumed_epoch = 0
+        self._epoch = 0
+
+    def train_begin(self, estimator, *args, **kwargs):
+        from ... import checkpoint
+
+        step, _extra = checkpoint.resume(self.ckpt_dir, estimator.net,
+                                         getattr(estimator, "trainer",
+                                                 None))
+        self.resumed_epoch = self._epoch = step
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        from ... import checkpoint
+
+        self._epoch += 1
+        if self._epoch % self.save_every == 0:
+            checkpoint.save_checkpoint(
+                self.ckpt_dir, self._epoch, estimator.net,
+                getattr(estimator, "trainer", None), keep=self.keep)
+
+
 class EarlyStoppingHandler(TrainBegin, EpochEnd):
     """Stop when a monitored metric stops improving."""
 

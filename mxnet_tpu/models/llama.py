@@ -324,6 +324,8 @@ class LlamaForCausalLM(HybridBlock):
     def _generate_cached(self, input_ids, max_new_tokens):
         from .. import ndarray as nd
 
+        if max_new_tokens < 1:  # n=0: prompt unchanged (oracle parity)
+            return input_ids
         b, t0 = input_ids.shape
         # bucket max_len to a power of two (min 64) so repeated calls with
         # nearby lengths reuse ONE compiled decoder instead of recompiling
@@ -371,7 +373,7 @@ class LlamaDecoder:
         cos, sin = _rope_tables(self.max_len, cfg.head_dim, cfg.rope_theta)
         self._cos, self._sin = jnp.asarray(cos), jnp.asarray(sin)
         self._step = jax.jit(self._step_impl, donate_argnums=(1,))
-        self._gen = jax.jit(self._generate_impl, static_argnums=(2,))
+        self._gen = jax.jit(self._generate_impl, static_argnums=(3,))
 
     def _weights(self):
         """Fresh raw-weight pytree from the net's Parameters (cheap: just
@@ -477,29 +479,33 @@ class LlamaDecoder:
         x = self._rms(x, w["norm"], cfg.rms_eps)
         return x @ w["head"].T, new_caches
 
-    def _prefill_impl(self, w, ids):
-        """Batched full-sequence prompt pass: (B, T0) → (caches with K/V
-        written at [0:T0], last-position logits).  One MXU-friendly
-        forward instead of T0 serialized vector steps."""
+    def _prefill_impl(self, w, ids, t0):
+        """Batched full-sequence prompt pass over PADDED ids (B, Lp) with
+        the true prompt length ``t0`` traced: caches get K/V written at
+        [0:Lp] (pad rows are overwritten by decode steps starting at
+        ``t0``, and the causal mask keeps them invisible to real rows);
+        logits are gathered at row t0-1.  One MXU-friendly forward
+        instead of T0 serialized vector steps, compiled once per padded
+        shape."""
         import jax.numpy as jnp
         from jax import lax
 
         cfg = self.cfg
         hd = cfg.head_dim
-        b, t0 = ids.shape
-        cos, sin = self._cos[:t0], self._sin[:t0]
-        x = w["emb"][ids]                                   # (B, T0, H)
-        causal = jnp.tril(jnp.ones((t0, t0), bool))         # (Q, T)
+        b, lp = ids.shape
+        cos, sin = self._cos[:lp], self._sin[:lp]
+        x = w["emb"][ids]                                   # (B, Lp, H)
+        causal = jnp.tril(jnp.ones((lp, lp), bool))         # (Q, T)
         z = jnp.zeros((), jnp.int32)
         caches = []
         for L in w["layers"]:
 
             def ctx_fn(h, L=L):
-                q = (h @ L["q"].T).reshape(b, t0, cfg.num_heads, hd) \
+                q = (h @ L["q"].T).reshape(b, lp, cfg.num_heads, hd) \
                     .transpose(0, 2, 1, 3)
-                k = (h @ L["k"].T).reshape(b, t0, cfg.num_kv_heads, hd) \
+                k = (h @ L["k"].T).reshape(b, lp, cfg.num_kv_heads, hd) \
                     .transpose(0, 2, 1, 3)
-                v = (h @ L["v"].T).reshape(b, t0, cfg.num_kv_heads, hd) \
+                v = (h @ L["v"].T).reshape(b, lp, cfg.num_kv_heads, hd) \
                     .transpose(0, 2, 1, 3)
                 q = _apply_rope(q, cos[None, None], sin[None, None])
                 k = _apply_rope(k, cos[None, None], sin[None, None])
@@ -511,10 +517,11 @@ class LlamaDecoder:
                 caches.append((kc, vc))
                 ctx = self._attend(q, k, v, causal)
                 return ctx.transpose(0, 2, 1, 3) \
-                    .reshape(b, t0, cfg.num_heads * hd) @ L["o"].T
+                    .reshape(b, lp, cfg.num_heads * hd) @ L["o"].T
 
             x = self._layer(L, x, ctx_fn)
-        x_last = self._rms(x[:, -1], w["norm"], cfg.rms_eps)
+        x_last = jnp.take(x, jnp.asarray(t0, jnp.int32) - 1, axis=1)
+        x_last = self._rms(x_last, w["norm"], cfg.rms_eps)
         return caches, x_last @ w["head"].T
 
     def logits_at(self, ids):
@@ -534,15 +541,15 @@ class LlamaDecoder:
             outs.append(np.asarray(logits))
         return np.stack(outs, axis=1)
 
-    def _generate_impl(self, w, ids, max_new_tokens):
-        """(B, T0) int32 → (B, max_new_tokens) greedy continuation in one
-        XLA program: batched prefill, then a decode scan of N-1 steps
-        (the first new token comes from the prefill logits)."""
+    def _generate_impl(self, w, ids, t0, n_steps):
+        """Padded ids (B, Lp) + traced true length ``t0`` → (B, n_steps)
+        greedy continuation in one XLA program: batched prefill, then a
+        decode scan (first new token comes from the prefill logits;
+        decode steps overwrite the pad K/V rows starting at ``t0``)."""
         import jax.numpy as jnp
         from jax import lax
 
-        b, t0 = ids.shape
-        caches, logits = self._prefill_impl(w, ids)
+        caches, logits = self._prefill_impl(w, ids, t0)
         cur = jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
         def decode_body(carry, _):
@@ -552,25 +559,41 @@ class LlamaDecoder:
             return (caches, nxt, pos + 1), nxt
 
         (_, _, _), toks = lax.scan(
-            decode_body, (caches, cur, jnp.int32(t0)), None,
-            length=max_new_tokens - 1)
+            decode_body, (caches, cur, jnp.asarray(t0, jnp.int32)), None,
+            length=n_steps - 1)
         return jnp.concatenate([cur[:, None], toks.T], axis=1)
 
+    @staticmethod
+    def _bucket(n, quantum=16):
+        b = quantum
+        while b < n:
+            b *= 2
+        return b
+
     def generate(self, ids, max_new_tokens):
-        """Greedy decode: one compiled XLA program per (batch,
-        prompt_len, max_new_tokens) signature; weights read fresh from
-        the net each call."""
+        """Greedy decode.  Prompt length and step count are padded to
+        power-of-two buckets (true length rides in as a traced scalar),
+        so nearby calls reuse ONE compiled XLA program instead of
+        retracing per exact (prompt_len, max_new_tokens)."""
         import jax.numpy as jnp
         import numpy as np
 
-        ids = jnp.asarray(ids, jnp.int32)
-        t0 = ids.shape[1]
-        if max_new_tokens < 1:
+        ids = np.asarray(ids, np.int32)
+        b, t0 = ids.shape
+        n = int(max_new_tokens)
+        if n < 1:
             raise MXNetError("max_new_tokens must be >= 1")
-        if t0 + max_new_tokens > self.max_len:
+        if t0 + n > self.max_len:
             raise MXNetError("max_len exceeded; build a larger decoder")
-        toks = self._gen(self._weights(), ids, int(max_new_tokens))
-        return np.concatenate([np.asarray(ids), np.asarray(toks)], axis=1)
+        lp = min(self._bucket(t0), self.max_len)
+        nb = min(self._bucket(n), self.max_len - lp)
+        if nb < n:  # bucketed padding doesn't fit: run exact shapes
+            lp, nb = t0, n
+        ids_pad = np.zeros((b, lp), np.int32)
+        ids_pad[:, :t0] = ids
+        toks = self._gen(self._weights(), jnp.asarray(ids_pad),
+                         jnp.int32(t0), int(nb))
+        return np.concatenate([ids, np.asarray(toks)[:, :n]], axis=1)
 
 
 def llama3_8b(**overrides):

@@ -1,0 +1,140 @@
+"""Atomic checkpoint/resume tests (SURVEY §5 checkpoint-resume, D10 —
+beyond the reference's do_checkpoint+restart posture).
+
+Key invariant: crash-resume-continue training produces EXACTLY the same
+weights as uninterrupted training (momentum optimizer forces the trainer
+state to matter)."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, checkpoint, gluon, nd
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _net():
+    mx.random.seed(0)
+    net = gluon.nn.Dense(4)
+    net.initialize(mx.init.Xavier())
+    net(nd.ones((2, 6)))
+    return net
+
+
+def _step(net, trainer, seed):
+    rs = np.random.RandomState(seed)
+    x = nd.array(rs.randn(2, 6).astype(np.float32))
+    with autograd.record():
+        loss = (net(x) ** 2).mean()
+    loss.backward()
+    trainer.step(2)
+
+
+def test_crash_resume_matches_uninterrupted(tmp_path):
+    ckpt = str(tmp_path / "ckpts")
+
+    # uninterrupted: 4 steps
+    net_a = _net()
+    tr_a = gluon.Trainer(net_a.collect_params(), "sgd",
+                         {"learning_rate": 0.1, "momentum": 0.9})
+    for s in range(4):
+        _step(net_a, tr_a, s)
+
+    # interrupted: 2 steps, checkpoint, "crash", resume into NEW objects
+    net_b = _net()
+    tr_b = gluon.Trainer(net_b.collect_params(), "sgd",
+                         {"learning_rate": 0.1, "momentum": 0.9})
+    for s in range(2):
+        _step(net_b, tr_b, s)
+    checkpoint.save_checkpoint(ckpt, 2, net_b, tr_b)
+    del net_b, tr_b
+
+    net_c = _net()  # fresh init (different weights until resume)
+    tr_c = gluon.Trainer(net_c.collect_params(), "sgd",
+                         {"learning_rate": 0.1, "momentum": 0.9})
+    tr_c._init_kvstore()  # materialise state slots before load
+    step, extra = checkpoint.resume(ckpt, net_c, tr_c)
+    assert step == 2
+    for s in range(2, 4):
+        _step(net_c, tr_c, s)
+    assert_almost_equal(net_c.weight.data(), net_a.weight.data(),
+                        rtol=1e-6, atol=1e-7)
+
+
+def test_latest_ignores_torn_and_foreign(tmp_path):
+    ckpt = str(tmp_path / "ckpts")
+    net = _net()
+    checkpoint.save_checkpoint(ckpt, 1, net)
+    checkpoint.save_checkpoint(ckpt, 5, net)
+    os.makedirs(os.path.join(ckpt, "ckpt-9"))       # torn: no manifest
+    os.makedirs(os.path.join(ckpt, ".tmp-7-123"))   # stale tmp
+    os.makedirs(os.path.join(ckpt, "ckpt-bogus"))   # unparseable
+    assert checkpoint.latest_checkpoint(ckpt).endswith("ckpt-5")
+    step, _ = checkpoint.resume(ckpt, _net())
+    assert step == 5
+
+
+def test_prune_keeps_newest(tmp_path):
+    ckpt = str(tmp_path / "ckpts")
+    net = _net()
+    for s in (1, 2, 3, 4, 5):
+        checkpoint.save_checkpoint(ckpt, s, net)
+    checkpoint.prune_checkpoints(ckpt, keep=2)
+    steps = sorted(int(n[5:]) for n in os.listdir(ckpt)
+                   if n.startswith("ckpt-"))
+    assert steps == [4, 5]
+
+
+def test_save_with_keep_autoprunes(tmp_path):
+    ckpt = str(tmp_path / "ckpts")
+    net = _net()
+    for s in (1, 2, 3):
+        checkpoint.save_checkpoint(ckpt, s, net, keep=2)
+    steps = sorted(int(n[5:]) for n in os.listdir(ckpt)
+                   if n.startswith("ckpt-"))
+    assert steps == [2, 3]
+
+
+def test_resume_empty_dir_returns_zero(tmp_path):
+    step, extra = checkpoint.resume(str(tmp_path / "none"), _net())
+    assert step == 0 and extra == {}
+
+
+def test_extra_payload_roundtrip(tmp_path):
+    ckpt = str(tmp_path / "ckpts")
+    net = _net()
+    checkpoint.save_checkpoint(ckpt, 3, net,
+                               extra={"epoch": 3, "lr": 0.01})
+    step, extra = checkpoint.resume(ckpt, _net())
+    assert step == 3
+    assert extra == {"epoch": 3, "lr": 0.01}
+
+
+def test_estimator_fault_tolerant_handler(tmp_path):
+    from mxnet_tpu.gluon.contrib.estimator import (Estimator,
+                                                   FaultTolerantCheckpoint)
+    from mxnet_tpu.gluon.data import ArrayDataset, DataLoader
+
+    ckpt = str(tmp_path / "est")
+    rs = np.random.RandomState(0)
+    x = rs.randn(16, 6).astype(np.float32)
+    y = rs.randint(0, 4, 16).astype(np.float32)
+
+    def fit_once():
+        net = _net()
+        est = Estimator(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                        trainer=gluon.Trainer(net.collect_params(), "sgd",
+                                              {"learning_rate": 0.05}))
+        handler = FaultTolerantCheckpoint(ckpt, save_every=1)
+        loader = DataLoader(ArrayDataset(nd.array(x), nd.array(y)),
+                            batch_size=8)
+        est.fit(loader, epochs=2, event_handlers=[handler])
+        return net, handler
+
+    _net1, h1 = fit_once()
+    assert h1.resumed_epoch == 0
+    assert checkpoint.latest_checkpoint(ckpt) is not None
+    # second run resumes from the first run's checkpoints
+    _net2, h2 = fit_once()
+    assert h2.resumed_epoch == 2

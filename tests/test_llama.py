@@ -209,3 +209,27 @@ def test_kv_cache_rejects_overflow_and_moe():
     # MoE generate falls back to the oracle path
     out = moe_net.generate(_ids(1, 3), max_new_tokens=2)
     assert out.shape == (1, 5)
+
+
+def test_kv_cache_zero_tokens_and_bucket_reuse():
+    mx.random.seed(2)
+    net = llama.llama_tiny(attn_mode="sdpa")
+    net.initialize(mx.init.Xavier())
+    p = _ids(1, 4)
+    out = net.generate(p, max_new_tokens=0)
+    assert out.asnumpy().tolist() == p.asnumpy().tolist()
+
+    # nearby prompt lengths / token counts share one compiled program
+    dec = llama.LlamaDecoder(net, max_len=64)
+    r5 = dec.generate(_ids(1, 5, seed=5).asnumpy(), 3)
+    r7 = dec.generate(_ids(1, 7, seed=7).asnumpy(), 4)
+    assert r5.shape == (1, 8) and r7.shape == (1, 11)
+    assert dec._gen._cache_size() == 1, \
+        f"expected 1 compiled program, got {dec._gen._cache_size()}"
+    # padded-prompt result must equal exact-shape decode
+    dec_exact = llama.LlamaDecoder(net, max_len=64)
+    exact = dec_exact._gen(dec_exact._weights(),
+                           _ids(1, 5, seed=5).asnumpy().astype("int32"),
+                           5, 3)
+    import numpy as _np
+    _np.testing.assert_array_equal(r5[:, 5:], _np.asarray(exact)[:, :3])

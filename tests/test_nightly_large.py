@@ -1,0 +1,48 @@
+"""Nightly large-array tests (reference: tests/nightly/test_large_array.py
+— int64-range shapes, SURVEY §4 nightly row).
+
+Gated behind ``MXNET_TEST_LARGE=1``: the arrays exceed 2**31 elements and
+need multi-GB host RAM, so they run as a nightly tier, same as the
+reference's.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+pytestmark = pytest.mark.skipif(
+    not os.environ.get("MXNET_TEST_LARGE"),
+    reason="large-array nightly tier; set MXNET_TEST_LARGE=1")
+
+# > int32 element count, int8 payload (~2.2 GB)
+LARGE = 2 ** 31 + 7
+
+
+def test_large_elementwise_and_reduce():
+    x = nd.ones((LARGE,), dtype="int8")
+    assert x.shape == (LARGE,)
+    # indexing beyond int32 offsets
+    assert int(x[LARGE - 1].asscalar()) == 1
+    s = x.astype("float32").sum()
+    np.testing.assert_allclose(float(s.asscalar()), float(LARGE), rtol=1e-6)
+
+
+def test_large_slice_and_write():
+    x = nd.zeros((LARGE,), dtype="int8")
+    x[LARGE - 5:] = 3
+    tail = x[LARGE - 8:].asnumpy()
+    assert tail.tolist() == [0, 0, 0, 3, 3, 3, 3, 3]
+
+
+def test_large_2d_matvec():
+    # (2**16 x 2**15) f32 = 8 GB FLOP-light matvec; checks int64 strides
+    rows, cols = 2 ** 16, 2 ** 15
+    x = nd.ones((rows, cols), dtype="float32")
+    v = nd.ones((cols, 1), dtype="float32")
+    out = nd.dot(x, v)
+    assert out.shape == (rows, 1)
+    np.testing.assert_allclose(out.asnumpy()[::7919].ravel(), cols,
+                               rtol=1e-5)
