@@ -1,0 +1,47 @@
+"""Train → export → serve with the standalone predictor.
+
+The gluon side exports a compiled StableHLO artifact + .params; the
+serving side needs only ``mxnet_tpu.predictor`` (MXPredCreate-style
+surface, SURVEY §3.5).
+
+Usage:  python examples/serve_predictor.py
+"""
+import tempfile
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, nd
+from mxnet_tpu.predictor import create
+
+
+def main():
+    # --- training side -----------------------------------------------------
+    mx.random.seed(0)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Conv2D(16, 3, padding=1, activation="relu"),
+            gluon.nn.GlobalAvgPool2D(), gluon.nn.Dense(10))
+    net.initialize(mx.init.Xavier())
+    x = nd.array(np.random.RandomState(0)
+                 .randn(4, 3, 32, 32).astype(np.float32))
+    net.hybridize()
+    net(x)  # one forward so a cached graph exists
+    prefix = tempfile.mkdtemp() + "/cnn"
+    net.export(prefix, epoch=0)
+    print("exported", prefix + "-symbol.json")
+
+    # --- serving side ------------------------------------------------------
+    pred = create(f"{prefix}-symbol.json", f"{prefix}-0000.params")
+    pred.set_input(pred.input_names[0], x)
+    pred.forward()
+    probs = nd.softmax(pred.get_output(0))
+    print("top-1 per image:", probs.asnumpy().argmax(axis=1).tolist())
+
+
+if __name__ == "__main__":
+    main()

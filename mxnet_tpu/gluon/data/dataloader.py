@@ -103,15 +103,25 @@ class DataLoader:
         lock = threading.Lock()
         cond = threading.Condition(lock)
         next_fetch = [0]
+        consumed = [0]
         errors = []
+        done = [False]
+        # workers may run at most this many batches ahead of the consumer
+        # (the reference's bounded prefetch queue; unbounded racing would
+        # buffer the whole dataset in memory)
+        window = max(self._prefetch, self._num_workers)
 
         def worker():
             while True:
-                with lock:
-                    i = next_fetch[0]
-                    if i >= len(batches) or errors:
-                        return
-                    next_fetch[0] = i + 1
+                with cond:
+                    while True:
+                        i = next_fetch[0]
+                        if i >= len(batches) or errors or done[0]:
+                            return
+                        if i < consumed[0] + window:
+                            next_fetch[0] = i + 1
+                            break
+                        cond.wait()
                 try:
                     out = self._fetch(batches[i])
                 except Exception as e:
@@ -140,7 +150,10 @@ class DataLoader:
                             f"DataLoader worker timeout after "
                             f"{self._timeout}s (batch {i})")
                     out = results.pop(i)
+                    consumed[0] = i + 1
+                    cond.notify_all()  # window advanced: wake workers
                 yield out
         finally:
-            with lock:
-                next_fetch[0] = len(batches)
+            with cond:
+                done[0] = True
+                cond.notify_all()

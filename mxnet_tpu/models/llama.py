@@ -303,16 +303,26 @@ class LlamaForCausalLM(HybridBlock):
                             name="tied_lm_head")
         return self.lm_head(h)
 
-    def generate(self, input_ids, max_new_tokens=16, use_cache=True):
-        """Greedy decoding.  ``use_cache=True`` (default) runs the jitted
+    def generate(self, input_ids, max_new_tokens=16, use_cache=True,
+                 do_sample=False, temperature=1.0, top_k=0, top_p=1.0,
+                 seed=None):
+        """Decoding.  ``use_cache=True`` (default) runs the jitted
         incremental decode step with a static-shape KV cache
         (O(T) per token); ``use_cache=False`` re-forwards the full
-        sequence per token (O(T²), kept as the reference oracle)."""
+        sequence per token (O(T²), kept as the greedy reference oracle).
+        ``do_sample=True`` draws from the (temperature / top-k / top-p
+        filtered) distribution — cached path only."""
         from .. import ndarray as nd
         from .. import autograd as ag
 
         if use_cache and self._cfg.num_experts == 0:
-            return self._generate_cached(input_ids, max_new_tokens)
+            return self._generate_cached(
+                input_ids, max_new_tokens, do_sample=do_sample,
+                temperature=temperature, top_k=top_k, top_p=top_p,
+                seed=seed)
+        if do_sample:
+            raise MXNetError("do_sample requires the KV-cache path "
+                             "(use_cache=True, dense MLP config)")
         cur = input_ids
         with ag.pause():
             for _ in range(max_new_tokens):
@@ -321,7 +331,7 @@ class LlamaForCausalLM(HybridBlock):
                 cur = nd.concat(cur, nxt.astype(cur.dtype), dim=1)
         return cur
 
-    def _generate_cached(self, input_ids, max_new_tokens):
+    def _generate_cached(self, input_ids, max_new_tokens, **sample_kw):
         from .. import ndarray as nd
 
         if max_new_tokens < 1:  # n=0: prompt unchanged (oracle parity)
@@ -337,7 +347,7 @@ class LlamaForCausalLM(HybridBlock):
         dec = cache.get(bucket)
         if dec is None:
             dec = cache[bucket] = LlamaDecoder(self, max_len=bucket)
-        ids = dec.generate(input_ids._data, max_new_tokens)
+        ids = dec.generate(input_ids._data, max_new_tokens, **sample_kw)
         return nd.NDArray(ids).astype(input_ids.dtype)
 
 
@@ -373,7 +383,8 @@ class LlamaDecoder:
         cos, sin = _rope_tables(self.max_len, cfg.head_dim, cfg.rope_theta)
         self._cos, self._sin = jnp.asarray(cos), jnp.asarray(sin)
         self._step = jax.jit(self._step_impl, donate_argnums=(1,))
-        self._gen = jax.jit(self._generate_impl, static_argnums=(3,))
+        self._gen = jax.jit(self._generate_impl,
+                            static_argnums=(6, 7, 8, 9))
 
     def _weights(self):
         """Fresh raw-weight pytree from the net's Parameters (cheap: just
@@ -541,25 +552,59 @@ class LlamaDecoder:
             outs.append(np.asarray(logits))
         return np.stack(outs, axis=1)
 
-    def _generate_impl(self, w, ids, t0, n_steps):
+    def _pick(self, logits, key, temperature, top_p, top_k, do_sample,
+              use_top_p):
+        """Greedy or filtered sampling from last-position logits (B, V).
+        ``top_k``/``do_sample``/``use_top_p`` are trace-static;
+        temperature/top_p ride as traced scalars so tuning them doesn't
+        recompile.  The nucleus filter (two full-vocab sorts per token)
+        only compiles in when actually requested."""
+        import jax
+        import jax.numpy as jnp
+
+        if not do_sample:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        lg = logits.astype(jnp.float32) / jnp.maximum(temperature, 1e-6)
+        if top_k and top_k < lg.shape[-1]:
+            kth = jnp.sort(lg, axis=-1)[:, -top_k][:, None]
+            lg = jnp.where(lg < kth, -jnp.inf, lg)
+        if use_top_p:
+            # nucleus: drop tokens whose EXCLUSIVE cumulative prob ≥
+            # top_p (the top token always survives)
+            srt = jnp.sort(lg, axis=-1)[:, ::-1]
+            probs = jax.nn.softmax(srt, axis=-1)
+            cum = jnp.cumsum(probs, axis=-1) - probs
+            count = jnp.maximum((cum < top_p).sum(-1), 1)
+            thresh = jnp.take_along_axis(srt, (count - 1)[:, None], axis=1)
+            lg = jnp.where(lg < thresh, -jnp.inf, lg)
+        return jax.random.categorical(key, lg, axis=-1).astype(jnp.int32)
+
+    def _generate_impl(self, w, ids, t0, key, temperature, top_p,
+                       n_steps, top_k, do_sample, use_top_p):
         """Padded ids (B, Lp) + traced true length ``t0`` → (B, n_steps)
-        greedy continuation in one XLA program: batched prefill, then a
-        decode scan (first new token comes from the prefill logits;
-        decode steps overwrite the pad K/V rows starting at ``t0``)."""
+        continuation in one XLA program: batched prefill, then a decode
+        scan (first new token comes from the prefill logits; decode
+        steps overwrite the pad K/V rows starting at ``t0``)."""
+        import jax
         import jax.numpy as jnp
         from jax import lax
 
         caches, logits = self._prefill_impl(w, ids, t0)
-        cur = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        key, sub = jax.random.split(key)
+        cur = self._pick(logits, sub, temperature, top_p, top_k,
+                         do_sample, use_top_p)
 
         def decode_body(carry, _):
-            caches, cur, pos = carry
+            caches, cur, pos, key = carry
             logits, caches = self._step_impl(w, caches, cur, pos)
-            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            return (caches, nxt, pos + 1), nxt
+            key, sub = jax.random.split(key)
+            nxt = self._pick(logits, sub, temperature, top_p, top_k,
+                             do_sample, use_top_p)
+            return (caches, nxt, pos + 1, key), nxt
 
-        (_, _, _), toks = lax.scan(
-            decode_body, (caches, cur, jnp.asarray(t0, jnp.int32)), None,
+        (_, _, _, _), toks = lax.scan(
+            decode_body,
+            (caches, cur, jnp.asarray(t0, jnp.int32), key), None,
             length=n_steps - 1)
         return jnp.concatenate([cur[:, None], toks.T], axis=1)
 
@@ -570,11 +615,14 @@ class LlamaDecoder:
             b *= 2
         return b
 
-    def generate(self, ids, max_new_tokens):
-        """Greedy decode.  Prompt length and step count are padded to
-        power-of-two buckets (true length rides in as a traced scalar),
-        so nearby calls reuse ONE compiled XLA program instead of
-        retracing per exact (prompt_len, max_new_tokens)."""
+    def generate(self, ids, max_new_tokens, do_sample=False,
+                 temperature=1.0, top_k=0, top_p=1.0, seed=None):
+        """Decode (greedy, or sampled with ``do_sample=True``).  Prompt
+        length and step count are padded to power-of-two buckets (true
+        length rides in as a traced scalar), so nearby calls reuse ONE
+        compiled XLA program instead of retracing per exact
+        (prompt_len, max_new_tokens)."""
+        import jax
         import jax.numpy as jnp
         import numpy as np
 
@@ -591,8 +639,21 @@ class LlamaDecoder:
             lp, nb = t0, n
         ids_pad = np.zeros((b, lp), np.int32)
         ids_pad[:, :t0] = ids
+        if not do_sample:
+            # greedy must not touch the global RNG stream (reproducible
+            # training runs interleave greedy eval generates)
+            key = jax.random.PRNGKey(0)
+        elif seed is None:
+            from .. import random as mx_random
+
+            key = mx_random.next_key()
+        else:
+            key = jax.random.PRNGKey(int(seed))
         toks = self._gen(self._weights(), jnp.asarray(ids_pad),
-                         jnp.int32(t0), int(nb))
+                         jnp.int32(t0), key,
+                         jnp.float32(temperature), jnp.float32(top_p),
+                         int(nb), int(top_k), bool(do_sample),
+                         bool(do_sample and top_p < 1.0))
         return np.concatenate([ids, np.asarray(toks)[:, :n]], axis=1)
 
 

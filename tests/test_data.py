@@ -229,3 +229,47 @@ def test_record_file_dataset(tmp_path):
     ds = gluon.data.RecordFileDataset(rec_path)
     assert len(ds) == 4
     assert ds[2] == b"item2"
+
+
+def test_dataloader_prefetch_bounded():
+    """Workers must not race more than the prefetch window ahead of the
+    consumer (unbounded racing would buffer the whole dataset)."""
+    import threading
+    import time
+
+    from mxnet_tpu.gluon.data import DataLoader
+
+    fetched = []
+    lock = threading.Lock()
+
+    class Spy:
+        def __len__(self):
+            return 64
+
+        def __getitem__(self, i):
+            with lock:
+                fetched.append(i)
+            return np.float32(i)
+
+    loader = DataLoader(Spy(), batch_size=1, num_workers=4, prefetch=4)
+    max_ahead = 0
+    for n_consumed, _batch in enumerate(loader):
+        time.sleep(0.005)  # slow consumer lets workers run ahead
+        with lock:
+            max_ahead = max(max_ahead, len(fetched) - (n_consumed + 1))
+    assert len(fetched) == 64
+    # window = max(prefetch, workers) = 4, +workers in flight slack
+    assert max_ahead <= 4 + 4 + 1, f"prefetch unbounded: {max_ahead}"
+
+
+def test_dataloader_threaded_matches_serial():
+    from mxnet_tpu.gluon.data import ArrayDataset, DataLoader
+
+    x = np.arange(40, dtype=np.float32).reshape(20, 2)
+    ds = ArrayDataset(nd.array(x))
+    serial = [b.asnumpy() for b in DataLoader(ds, batch_size=4)]
+    threaded = [b.asnumpy()
+                for b in DataLoader(ds, batch_size=4, num_workers=3)]
+    assert len(serial) == len(threaded)
+    for a, b in zip(serial, threaded):
+        np.testing.assert_array_equal(a, b)

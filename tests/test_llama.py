@@ -227,9 +227,55 @@ def test_kv_cache_zero_tokens_and_bucket_reuse():
     assert dec._gen._cache_size() == 1, \
         f"expected 1 compiled program, got {dec._gen._cache_size()}"
     # padded-prompt result must equal exact-shape decode
+    import jax as _jax
+    import jax.numpy as _jnp
+    import numpy as _np
+
     dec_exact = llama.LlamaDecoder(net, max_len=64)
     exact = dec_exact._gen(dec_exact._weights(),
-                           _ids(1, 5, seed=5).asnumpy().astype("int32"),
-                           5, 3)
-    import numpy as _np
+                           _jnp.asarray(_ids(1, 5, seed=5).asnumpy(),
+                                        _jnp.int32),
+                           _jnp.int32(5), _jax.random.PRNGKey(0),
+                           _jnp.float32(1.0), _jnp.float32(1.0),
+                           3, 0, False, False)
     _np.testing.assert_array_equal(r5[:, 5:], _np.asarray(exact)[:, :3])
+
+
+def test_sampling_modes():
+    mx.random.seed(3)
+    net = llama.llama_tiny(attn_mode="sdpa")
+    net.initialize(mx.init.Xavier())
+    p = _ids(2, 6, seed=9)
+    greedy = net.generate(p, max_new_tokens=8)
+
+    # temperature -> 0 converges to greedy
+    cold = net.generate(p, max_new_tokens=8, do_sample=True,
+                        temperature=1e-4, seed=0)
+    assert cold.asnumpy().tolist() == greedy.asnumpy().tolist()
+    # top_k=1 is argmax regardless of temperature
+    k1 = net.generate(p, max_new_tokens=8, do_sample=True,
+                      temperature=5.0, top_k=1, seed=1)
+    assert k1.asnumpy().tolist() == greedy.asnumpy().tolist()
+    # same seed reproduces; sampling is well-formed with top_p
+    s_a = net.generate(p, max_new_tokens=8, do_sample=True,
+                       temperature=1.0, top_p=0.9, seed=42)
+    s_b = net.generate(p, max_new_tokens=8, do_sample=True,
+                       temperature=1.0, top_p=0.9, seed=42)
+    assert s_a.asnumpy().tolist() == s_b.asnumpy().tolist()
+    assert s_a.shape == (2, 14)
+    # sampled ids stay in-vocab
+    assert int(s_a.asnumpy().max()) < 256 and int(s_a.asnumpy().min()) >= 0
+
+
+def test_greedy_generate_leaves_rng_untouched():
+    from mxnet_tpu import random as mx_random
+
+    mx.random.seed(11)
+    net = llama.llama_tiny(attn_mode="sdpa")
+    net.initialize(mx.init.Xavier())
+    mx.random.seed(11)
+    before = mx_random.uniform(shape=(4,)).asnumpy()
+    mx.random.seed(11)
+    net.generate(_ids(1, 4), max_new_tokens=2)  # greedy: no RNG draw
+    after = mx_random.uniform(shape=(4,)).asnumpy()
+    onp.testing.assert_array_equal(before, after)
