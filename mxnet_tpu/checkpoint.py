@@ -35,6 +35,22 @@ __all__ = ["save_checkpoint", "latest_checkpoint", "resume",
 _PREFIX = "ckpt-"
 
 
+def _fsync_file(path):
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _fsync_dir(path):
+    fd = os.open(path, os.O_RDONLY | getattr(os, "O_DIRECTORY", 0))
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
 def save_checkpoint(ckpt_dir, step, net, trainer=None, extra=None,
                     keep=None):
     """Write ``<ckpt_dir>/ckpt-<step>`` atomically.  Returns its path.
@@ -65,9 +81,16 @@ def save_checkpoint(ckpt_dir, step, net, trainer=None, extra=None,
             json.dump(manifest, f)
             f.flush()
             os.fsync(f.fileno())
+        # durability, not just atomicity: fsync every payload file and the
+        # directories so a power loss after the rename can't surface a
+        # manifest-bearing checkpoint with truncated payloads
+        for name in os.listdir(tmp):
+            _fsync_file(os.path.join(tmp, name))
+        _fsync_dir(tmp)
         if os.path.exists(final):
             shutil.rmtree(final)  # re-checkpoint of the same step
         os.rename(tmp, final)
+        _fsync_dir(ckpt_dir)  # persist the rename itself
     except BaseException:
         shutil.rmtree(tmp, ignore_errors=True)
         raise

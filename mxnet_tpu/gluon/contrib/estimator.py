@@ -145,6 +145,12 @@ class FaultTolerantCheckpoint(TrainBegin, EpochEnd):
     ``ckpt_dir`` (restoring weights, optimizer state and RNG position);
     every ``save_every`` epochs it writes ``ckpt-<epoch>`` atomically,
     keeping the newest ``keep``.
+
+    ``fit(epochs=N)`` is treated as a TOTAL budget: a run resumed at
+    epoch k trains only the remaining N-k epochs (the handler raises
+    ``stop_training`` once the global epoch counter reaches N), so an
+    interrupted-and-rerun job lands on exactly the same epoch count as an
+    uninterrupted one.
     """
 
     def __init__(self, ckpt_dir, save_every=1, keep=3):
@@ -153,6 +159,7 @@ class FaultTolerantCheckpoint(TrainBegin, EpochEnd):
         self.keep = keep
         self.resumed_epoch = 0
         self._epoch = 0
+        self.stop_training = False
 
     def train_begin(self, estimator, *args, **kwargs):
         from ... import checkpoint
@@ -161,6 +168,8 @@ class FaultTolerantCheckpoint(TrainBegin, EpochEnd):
                                          getattr(estimator, "trainer",
                                                  None))
         self.resumed_epoch = self._epoch = step
+        budget = getattr(estimator, "max_epoch", None)
+        self.stop_training = budget is not None and self._epoch >= budget
 
     def epoch_end(self, estimator, *args, **kwargs):
         from ... import checkpoint
@@ -170,6 +179,9 @@ class FaultTolerantCheckpoint(TrainBegin, EpochEnd):
             checkpoint.save_checkpoint(
                 self.ckpt_dir, self._epoch, estimator.net,
                 getattr(estimator, "trainer", None), keep=self.keep)
+        budget = getattr(estimator, "max_epoch", None)
+        if budget is not None and self._epoch >= budget:
+            self.stop_training = True
 
 
 class EarlyStoppingHandler(TrainBegin, EpochEnd):
@@ -263,6 +275,10 @@ class Estimator:
                     if hasattr(h, "stop_training")]
         fire("train_begin")
         for _epoch in range(epochs):
+            # checked at loop top so a train_begin resume that already
+            # exhausted the epoch budget runs zero epochs
+            if any(s.stop_training for s in stoppers):
+                break
             for m in self.train_metrics:
                 m.reset()
             fire("epoch_begin")
@@ -282,7 +298,5 @@ class Estimator:
             if val_data is not None:
                 self.evaluate(val_data, batch_axis)
             fire("epoch_end")
-            if any(s.stop_training for s in stoppers):
-                break
         fire("train_end")
         return self

@@ -116,7 +116,27 @@ class Trainer:
                     if param.grad_req != "null":
                         self._kvstore.init(i, param.data())
                 self._kvstore.set_optimizer(self._optimizer)
+                self._shipped_hparams = self._hparams_sig()
         self._kv_initialized = True
+
+    def _hparams_sig(self):
+        lr = None if self._optimizer.lr_scheduler is not None \
+            else self._optimizer.lr
+        return (lr, self._optimizer.rescale_grad, self._optimizer.wd)
+
+    def _sync_kvstore_hparams(self):
+        """The server holds a pickled optimizer COPY; re-sync lr /
+        rescale_grad / wd whenever they change locally (set_learning_rate,
+        a different batch_size) so the server never trains on stale
+        hyperparameters.  lr under an LRScheduler progresses server-side
+        (the server's num_update advances as it applies updates)."""
+        ship = getattr(self._kvstore, "set_optimizer_hparams", None)
+        if ship is None:
+            return
+        sig = self._hparams_sig()
+        if sig != getattr(self, "_shipped_hparams", None):
+            ship(lr=sig[0], rescale_grad=sig[1], wd=sig[2])
+            self._shipped_hparams = sig
 
     # -- public properties ---------------------------------------------------
     @property
@@ -140,6 +160,8 @@ class Trainer:
         self._optimizer.rescale_grad = self._scale / batch_size
         if not self._kv_initialized:
             self._init_kvstore()
+        if self._update_on_kvstore:
+            self._sync_kvstore_hparams()
         self._allreduce_grads()
         self._update(ignore_stale_grad)
 

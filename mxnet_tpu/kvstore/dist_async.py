@@ -14,15 +14,28 @@ Device compute stays in XLA; values cross the wire as host numpy buffers.
 - In-process form: a dispatcher thread drains a FIFO queue and applies
   updates to the server table — ``push`` returns immediately, exactly the
   engine-async contract NDArray ops have (SURVEY §1 invariant).
-- Cross-process form: a TCP server thread (length-prefixed pickle frames)
-  plays ps-lite's role over localhost/DCN; workers connect via
+- Cross-process form: a TCP server thread (length-prefixed frames) plays
+  ps-lite's role over localhost/DCN; workers connect via
   ``MXT_PS_ROOT_URI`` (the ``DMLC_PS_ROOT_URI`` analog, see
   tools/launch.py).  No scheduler role is needed: rank 0 hosts the table.
 
-Security note: frames are pickle — trust the cluster, same as ps-lite.
+Security: the wire format is NON-EXECUTABLE — a JSON header plus raw
+numpy buffer bytes (like ps-lite's protobuf + blob layout), never pickle
+on the data path, so a hostile peer can at worst corrupt parameter
+values, not execute code.  The one rich payload, ``set_optimizer``
+(the reference pickles the optimizer to servers the same way), is only
+deserialized when the frame carries a valid HMAC-SHA256 signature under
+the ``MXT_PS_SECRET`` shared secret (tools/launch.py generates one per
+job); an unsigned remote ``set_optimizer`` is refused.  With a secret
+configured the server also challenges each connection (nonce +
+HMAC response) before reading any frame, so an unauthenticated peer is
+dropped after 32 bytes and cannot make the server buffer large frames.
 """
 from __future__ import annotations
 
+import hashlib
+import hmac
+import json
 import os
 import pickle
 import queue
@@ -50,10 +63,114 @@ def _compress_merged(compression, residuals, key, merged):
 
 
 # --- wire helpers -----------------------------------------------------------
+#
+# Frame layout (all little-endian):
+#   u64 payload_len | sig[32] | u32 header_len | header_json | buf0 buf1 ...
+# header_json = {"t": tree, "n": [buf nbytes...]} where tree mirrors the
+# message tuple with arrays/bytes swapped for {"__a__"/"__r__": buf_index}
+# markers.  sig = HMAC-SHA256(MXT_PS_SECRET, body) or 32 zero bytes when no
+# secret is configured.  Nothing in a frame is executable.
 
-def _send_frame(sock, obj):
-    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
-    sock.sendall(struct.pack("<Q", len(payload)) + payload)
+_SECRET_ENV = "MXT_PS_SECRET"
+_MAX_FRAME = 1 << 33  # 8 GiB sanity cap on a single frame
+_SAFE_DTYPES = frozenset([
+    "bool", "int8", "int16", "int32", "int64",
+    "uint8", "uint16", "uint32", "uint64",
+    "float16", "float32", "float64", "bfloat16",
+    "complex64", "complex128",
+])
+
+
+def _secret():
+    s = os.environ.get(_SECRET_ENV)
+    return s.encode() if s else None
+
+
+def _np_dtype(name):
+    if name not in _SAFE_DTYPES:
+        raise MXNetError(f"refusing wire dtype {name!r}")
+    if name == "bfloat16":
+        import ml_dtypes
+
+        return np.dtype(ml_dtypes.bfloat16)
+    return np.dtype(name)
+
+
+def _encode_obj(o, bufs):
+    if isinstance(o, np.ndarray):
+        a = np.ascontiguousarray(o)
+        if str(a.dtype) not in _SAFE_DTYPES:
+            raise MXNetError(f"non-wireable dtype {a.dtype}")
+        bufs.append(a.tobytes())
+        return {"__a__": len(bufs) - 1, "dtype": str(a.dtype),
+                "shape": list(a.shape)}
+    if isinstance(o, (bytes, bytearray)):
+        bufs.append(bytes(o))
+        return {"__r__": len(bufs) - 1}
+    if isinstance(o, (tuple, list)):
+        return [_encode_obj(x, bufs) for x in o]
+    if isinstance(o, np.integer):
+        return int(o)
+    if isinstance(o, np.floating):
+        return float(o)
+    if o is None or isinstance(o, (bool, int, float, str)):
+        return o
+    raise MXNetError(f"non-wireable object of type {type(o).__name__}")
+
+
+def _decode_obj(o, bufs):
+    if isinstance(o, dict):
+        if "__a__" in o:
+            raw = bufs[o["__a__"]]
+            return np.frombuffer(raw, _np_dtype(o["dtype"])).reshape(
+                o["shape"]).copy()
+        if "__r__" in o:
+            return bufs[o["__r__"]]
+        raise MXNetError("malformed wire header")
+    if isinstance(o, list):
+        return tuple(_decode_obj(x, bufs) for x in o)
+    return o
+
+
+def _pack_frame(msg, secret):
+    bufs = []
+    tree = _encode_obj(msg, bufs)
+    header = json.dumps({"t": tree, "n": [len(b) for b in bufs]},
+                        separators=(",", ":")).encode()
+    body = struct.pack("<I", len(header)) + header + b"".join(bufs)
+    sig = hmac.new(secret, body, hashlib.sha256).digest() if secret \
+        else b"\x00" * 32
+    return struct.pack("<Q", 32 + len(body)) + sig + body
+
+
+def _unpack_frame(payload, secret):
+    """-> (msg, signed).  ``signed`` is True iff a secret is configured
+    AND the signature verifies; with a configured secret a bad signature
+    is rejected outright."""
+    sig, body = payload[:32], payload[32:]
+    signed = False
+    if secret is not None:
+        if not hmac.compare_digest(
+                hmac.new(secret, body, hashlib.sha256).digest(), sig):
+            raise MXNetError("PS frame signature mismatch (MXT_PS_SECRET "
+                             "differs between peers?)")
+        signed = True
+    try:
+        (hlen,) = struct.unpack("<I", body[:4])
+        header = json.loads(body[4:4 + hlen].decode())
+        bufs, off = [], 4 + hlen
+        for n in header["n"]:
+            bufs.append(body[off:off + n])
+            off += n
+        return _decode_obj(header["t"], bufs), signed
+    except MXNetError:
+        raise
+    except Exception as e:  # malformed header/buffers → one error type
+        raise MXNetError(f"malformed PS frame: {e!r}")
+
+
+def _send_frame(sock, obj, secret=None):
+    sock.sendall(_pack_frame(obj, secret))
 
 
 def _recv_exact(sock, n):
@@ -66,9 +183,11 @@ def _recv_exact(sock, n):
     return bytes(buf)
 
 
-def _recv_frame(sock):
+def _recv_frame(sock, secret=None):
     (n,) = struct.unpack("<Q", _recv_exact(sock, 8))
-    return pickle.loads(_recv_exact(sock, n))
+    if not 32 <= n <= _MAX_FRAME:
+        raise MXNetError(f"bad PS frame length {n}")
+    return _unpack_frame(_recv_exact(sock, n), secret)
 
 
 def _to_wire(v):
@@ -102,15 +221,21 @@ class PSServer:
     def __init__(self):
         self._store = {}
         self._updater = None
+        self._optimizer = None
         self._lock = threading.Lock()
 
     def set_optimizer_bytes(self, opt_bytes):
         from .. import optimizer as opt_mod
 
+        opt = pickle.loads(opt_bytes)
         with self._lock:
-            self._updater = opt_mod.get_updater(pickle.loads(opt_bytes))
+            self._optimizer = opt
+            self._updater = opt_mod.get_updater(opt)
 
-    def handle(self, cmd, *args):
+    def handle(self, cmd, *args, trusted=True):
+        """``trusted=False`` marks a request that arrived over TCP without
+        a verified HMAC — array/data commands are allowed (non-executable),
+        the pickled-optimizer command is not."""
         from ..ndarray import sparse as sp
 
         if cmd == "init":
@@ -154,28 +279,71 @@ class PSServer:
                 picked = dense.asnumpy()[np.asarray(rows, np.int64)]
             return ("rows", picked, np.asarray(rows, np.int64))
         if cmd == "set_optimizer":
+            if not trusted:
+                raise MXNetError(
+                    "set_optimizer over TCP requires HMAC-signed frames: "
+                    "set the MXT_PS_SECRET shared secret on server and "
+                    "workers (tools/launch.py generates one per job)")
             (ob,) = args
             self.set_optimizer_bytes(ob)
+            return None
+        if cmd == "set_hparams":
+            # lightweight hyperparameter refresh (lr / rescale_grad / wd)
+            # so Trainer-side changes propagate without re-shipping the
+            # optimizer (which would reset server-side state)
+            lr, rescale, wd = args
+            with self._lock:
+                if self._optimizer is None:
+                    raise MXNetError("set_hparams before set_optimizer")
+                if lr is not None and self._optimizer.lr_scheduler is None:
+                    self._optimizer.lr = lr
+                if rescale is not None:
+                    self._optimizer.rescale_grad = rescale
+                if wd is not None:
+                    self._optimizer.wd = wd
             return None
         if cmd == "barrier":
             return None  # per-connection FIFO makes this a flush marker
         raise MXNetError(f"unknown PS command {cmd!r}")
 
 
+_AUTH_TAG = b"mxt-ps-auth"
+
+
+def _auth_response(secret, nonce):
+    return hmac.new(secret, _AUTH_TAG + nonce, hashlib.sha256).digest()
+
+
 class _PSRequestHandler(socketserver.BaseRequestHandler):
     def handle(self):
+        secret = self.server.secret
+        # connection hello: 1 flag byte (auth required?) + 16-byte nonce.
+        # With a secret configured, the peer must answer the challenge
+        # BEFORE any frame is read — an unauthenticated peer is dropped
+        # after a 32-byte read, so it can never make the server buffer a
+        # large attacker-declared frame.
+        nonce = os.urandom(16)
+        self.request.sendall((b"\x01" if secret else b"\x00") + nonce)
+        if secret:
+            try:
+                resp = _recv_exact(self.request, 32)
+            except ConnectionError:
+                return
+            if not hmac.compare_digest(resp, _auth_response(secret, nonce)):
+                return  # drop: wrong or missing secret
         while True:
             try:
-                msg = _recv_frame(self.request)
-            except (ConnectionError, struct.error):
-                return
+                msg, signed = _recv_frame(self.request, secret)
+            except (ConnectionError, struct.error, MXNetError):
+                return  # malformed/forged frame: drop the connection
             if msg[0] == "bye":
                 return
             try:
-                reply = ("ok", self.server.ps.handle(msg[0], *msg[1:]))
+                reply = ("ok", self.server.ps.handle(msg[0], *msg[1:],
+                                                     trusted=signed))
             except Exception as e:  # error crosses the wire, like ps-lite
                 reply = ("err", repr(e))
-            _send_frame(self.request, reply)
+            _send_frame(self.request, reply, secret)
 
 
 class _PSTCPServer(socketserver.ThreadingTCPServer):
@@ -183,13 +351,16 @@ class _PSTCPServer(socketserver.ThreadingTCPServer):
     daemon_threads = True
 
 
-def serve_forever(uri, ps=None, background=True):
+def serve_forever(uri, ps=None, background=True, secret=None):
     """Start the PS TCP server on ``uri`` ("host:port").  Returns the
     server object (``.shutdown()`` to stop).  Reference analog: the server
-    role spawned by tools/launch.py (DMLC_ROLE=server)."""
+    role spawned by tools/launch.py (DMLC_ROLE=server).  ``secret``
+    defaults to ``MXT_PS_SECRET`` captured at start."""
     host, port = uri.rsplit(":", 1)
     srv = _PSTCPServer((host, int(port)), _PSRequestHandler)
     srv.ps = ps or PSServer()
+    srv.secret = secret.encode() if isinstance(secret, str) else \
+        (secret if secret is not None else _secret())
     if background:
         t = threading.Thread(target=srv.serve_forever, daemon=True)
         t.start()
@@ -209,13 +380,16 @@ class AsyncPSKVStore:
     this worker's per-key FIFO order while keeping ``push`` non-blocking.
     """
 
-    def __init__(self, root_uri=None, rank=None, num_workers=None):
+    def __init__(self, root_uri=None, rank=None, num_workers=None,
+                 secret=None):
         self.type = "dist_async"
         self._rank = int(rank if rank is not None
                          else os.environ.get("MXT_RANK", 0))
         self._num_workers = int(num_workers if num_workers is not None
                                 else os.environ.get("MXT_NWORKER", 1))
         self._uri = root_uri or os.environ.get("MXT_PS_ROOT_URI")
+        self._wire_secret = secret.encode() if isinstance(secret, str) \
+            else (secret if secret is not None else _secret())
         self._queue = queue.Queue()
         self._err = None
         self._local = None
@@ -225,6 +399,15 @@ class AsyncPSKVStore:
             host, port = self._uri.rsplit(":", 1)
             self._sock = socket.create_connection((host, int(port)),
                                                   timeout=60)
+            hello = _recv_exact(self._sock, 17)
+            if hello[:1] == b"\x01":  # server demands the auth challenge
+                if self._wire_secret is None:
+                    raise MXNetError(
+                        "PS server requires authentication: set the "
+                        "MXT_PS_SECRET shared secret (tools/launch.py "
+                        "generates one per job)")
+                self._sock.sendall(
+                    _auth_response(self._wire_secret, hello[1:]))
         else:
             self._local = PSServer()
         self._sender = threading.Thread(target=self._drain, daemon=True)
@@ -245,9 +428,10 @@ class AsyncPSKVStore:
         """Synchronous round-trip (used by the sender thread and pulls)."""
         if self._local is not None:
             return self._local.handle(msg[0], *msg[1:])
+        secret = self._wire_secret
         with self._sock_lock:
-            _send_frame(self._sock, msg)
-            status, payload = _recv_frame(self._sock)
+            _send_frame(self._sock, msg, secret)
+            (status, payload), _ = _recv_frame(self._sock, secret)
         if status == "err":
             raise MXNetError(f"PS server error: {payload}")
         return payload
@@ -352,10 +536,17 @@ class AsyncPSKVStore:
     def set_optimizer(self, optimizer):
         """Ships the optimizer to the server (update_on_kvstore=True —
         reference workers pickle the optimizer to servers the same way).
-        The server holds a COPY: later mutations of the local optimizer
-        (e.g. rescale_grad) don't propagate — same as the reference."""
+        The server holds a COPY: mutations of the local optimizer don't
+        propagate by themselves, but Trainer.step re-syncs lr /
+        rescale_grad / wd via :meth:`set_optimizer_hparams`."""
         self.wait_all()  # keep program order w.r.t. queued pushes
         self._rpc("set_optimizer", pickle.dumps(optimizer))
+
+    def set_optimizer_hparams(self, lr=None, rescale_grad=None, wd=None):
+        """Refresh server-side optimizer hyperparameters in place (keeps
+        momentum/Adam state, unlike a full set_optimizer re-ship)."""
+        self.wait_all()
+        self._rpc("set_hparams", lr, rescale_grad, wd)
 
     def set_updater(self, updater):
         raise MXNetError(
@@ -386,7 +577,7 @@ class AsyncPSKVStore:
         if self._sock is not None:
             try:
                 with self._sock_lock:
-                    _send_frame(self._sock, ("bye",))
+                    _send_frame(self._sock, ("bye",), self._wire_secret)
                 self._sock.close()
             except OSError:
                 pass

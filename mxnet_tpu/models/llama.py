@@ -315,6 +315,16 @@ class LlamaForCausalLM(HybridBlock):
         from .. import ndarray as nd
         from .. import autograd as ag
 
+        # guard BOTH paths (cached and oracle/MoE): positions past
+        # max_seq_len mean RoPE extrapolation outside the trained window
+        need = input_ids.shape[1] + max_new_tokens
+        max_ctx = getattr(self._cfg, "max_seq_len", None)
+        if max_ctx is not None and need > max_ctx:
+            raise MXNetError(
+                f"generate: prompt ({input_ids.shape[1]}) + max_new_tokens "
+                f"({max_new_tokens}) = {need} exceeds the model's "
+                f"max_seq_len ({max_ctx}); RoPE tables and KV caches are "
+                f"only valid inside the trained context window")
         if use_cache and self._cfg.num_experts == 0:
             return self._generate_cached(
                 input_ids, max_new_tokens, do_sample=do_sample,
@@ -339,10 +349,13 @@ class LlamaForCausalLM(HybridBlock):
         b, t0 = input_ids.shape
         # bucket max_len to a power of two (min 64) so repeated calls with
         # nearby lengths reuse ONE compiled decoder instead of recompiling
-        need = t0 + max_new_tokens
+        need = t0 + max_new_tokens  # generate() validated need<=max_seq_len
+        max_ctx = getattr(self._cfg, "max_seq_len", None)
         bucket = 64
         while bucket < need:
             bucket *= 2
+        if max_ctx is not None:
+            bucket = min(bucket, max_ctx)
         cache = self.__dict__.setdefault("_kv_decoders", {})
         dec = cache.get(bucket)
         if dec is None:

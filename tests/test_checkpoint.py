@@ -121,7 +121,7 @@ def test_estimator_fault_tolerant_handler(tmp_path):
     x = rs.randn(16, 6).astype(np.float32)
     y = rs.randint(0, 4, 16).astype(np.float32)
 
-    def fit_once():
+    def fit_once(epochs=2):
         net = _net()
         est = Estimator(net, gluon.loss.SoftmaxCrossEntropyLoss(),
                         trainer=gluon.Trainer(net.collect_params(), "sgd",
@@ -129,12 +129,20 @@ def test_estimator_fault_tolerant_handler(tmp_path):
         handler = FaultTolerantCheckpoint(ckpt, save_every=1)
         loader = DataLoader(ArrayDataset(nd.array(x), nd.array(y)),
                             batch_size=8)
-        est.fit(loader, epochs=2, event_handlers=[handler])
+        est.fit(loader, epochs=epochs, event_handlers=[handler])
         return net, handler
 
     _net1, h1 = fit_once()
     assert h1.resumed_epoch == 0
     assert checkpoint.latest_checkpoint(ckpt) is not None
-    # second run resumes from the first run's checkpoints
+    # second run resumes from the first run's checkpoints; epochs=2 is a
+    # TOTAL budget, so the resumed run trains zero additional epochs —
+    # rerunning an interrupted job never overshoots the original budget
     _net2, h2 = fit_once()
     assert h2.resumed_epoch == 2
+    assert h2._epoch == 2, "resumed fit overshot the epoch budget"
+    _, path = checkpoint._complete_checkpoints(ckpt)[-1]
+    assert path.endswith("ckpt-2")
+    # a LARGER budget resumes at 2 and trains exactly one more epoch
+    _net3, h3 = fit_once(epochs=3)
+    assert h3.resumed_epoch == 2 and h3._epoch == 3

@@ -26,6 +26,13 @@ def _free_port():
     return port
 
 
+@pytest.fixture
+def ps_secret(monkeypatch):
+    """Remote set_optimizer requires HMAC-signed frames (what
+    tools/launch.py provides via MXT_PS_SECRET)."""
+    monkeypatch.setenv("MXT_PS_SECRET", "test-job-secret")
+
+
 def test_embedded_push_pull_replaces():
     kv = AsyncPSKVStore()
     kv.init(3, nd.ones((2, 3)))
@@ -65,7 +72,7 @@ def test_async_push_is_nonblocking_and_fifo():
     kv.close()
 
 
-def test_tcp_two_workers_concurrent():
+def test_tcp_two_workers_concurrent(ps_secret):
     port = _free_port()
     uri = f"127.0.0.1:{port}"
     srv = serve_forever(uri, PSServer())
@@ -92,7 +99,7 @@ def test_tcp_two_workers_concurrent():
         srv.shutdown()
 
 
-def test_tcp_server_side_optimizer_no_barrier():
+def test_tcp_server_side_optimizer_no_barrier(ps_secret):
     port = _free_port()
     uri = f"127.0.0.1:{port}"
     srv = serve_forever(uri, PSServer())
@@ -206,3 +213,118 @@ def test_trainer_fm_style_sparse_training():
             assert not np.allclose(before, out.asnumpy())
         before = out.asnumpy()
     kv.close()
+
+
+# --- wire-security contract (non-executable frames, HMAC gating) ------------
+
+def test_tcp_unsigned_set_optimizer_refused(monkeypatch):
+    """Without MXT_PS_SECRET, remote set_optimizer (the one pickled
+    payload) must be refused; the non-executable data path still works."""
+    monkeypatch.delenv("MXT_PS_SECRET", raising=False)
+    port = _free_port()
+    uri = f"127.0.0.1:{port}"
+    srv = serve_forever(uri, PSServer())
+    try:
+        kv = AsyncPSKVStore(root_uri=uri)
+        kv.init("k", nd.ones((4,)))          # data commands: fine unsigned
+        out = nd.zeros((4,))
+        kv.pull("k", out=out)
+        assert_almost_equal(out, np.ones((4,)))
+        with pytest.raises(mx.MXNetError, match="MXT_PS_SECRET"):
+            kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.1))
+        kv.close()
+    finally:
+        srv.shutdown()
+
+
+def test_tcp_signature_mismatch_rejected():
+    """A worker with the wrong secret fails the connection challenge and
+    cannot complete a round-trip."""
+    port = _free_port()
+    uri = f"127.0.0.1:{port}"
+    srv = serve_forever(uri, PSServer(), secret="server-secret")
+    try:
+        kv = AsyncPSKVStore(root_uri=uri, secret="worker-secret")
+        with pytest.raises(Exception):
+            kv.init("k", nd.ones((4,)))
+        kv._sock.close()  # server dropped the connection; don't send bye
+        kv._local = PSServer()  # neutralize close() path
+        kv._sock = None
+        kv.close()
+    finally:
+        srv.shutdown()
+
+
+def test_tcp_hparam_resync(ps_secret):
+    """set_optimizer_hparams refreshes lr server-side without resetting
+    optimizer state (the Trainer.step re-sync path)."""
+    port = _free_port()
+    uri = f"127.0.0.1:{port}"
+    srv = serve_forever(uri, PSServer())
+    try:
+        kv = AsyncPSKVStore(root_uri=uri)
+        kv.init("w", nd.ones((4,)) * 10.0)
+        kv.set_optimizer(mx.optimizer.SGD(learning_rate=1.0))
+        kv.push("w", nd.ones((4,)))          # 10 - 1*1 = 9
+        kv.set_optimizer_hparams(lr=0.5)
+        kv.push("w", nd.ones((4,)))          # 9 - 0.5*1 = 8.5
+        out = nd.zeros((4,))
+        kv.pull("w", out=out)
+        assert_almost_equal(out, np.full((4,), 8.5))
+        kv.close()
+    finally:
+        srv.shutdown()
+
+
+def test_trainer_hparam_change_propagates_to_ps():
+    """Trainer.set_learning_rate + a changed batch_size reach the
+    (embedded) PS server before the next update (ADVICE round-1 fix)."""
+    from mxnet_tpu import autograd, gluon
+
+    net = gluon.nn.Dense(1, use_bias=False)
+    net.initialize(mx.init.Constant(0.0))
+    net(nd.ones((1, 2)))
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.0},  # first step: no-op
+                            kvstore="dist_async")
+    x = nd.ones((2, 2))
+    with autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    trainer.step(2)
+    w0 = net.weight.data().asnumpy().copy()
+    assert_almost_equal(w0, np.zeros_like(w0))  # lr=0 did nothing
+    trainer.set_learning_rate(0.5)              # must reach the server
+    with autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    trainer.step(2)
+    w1 = net.weight.data().asnumpy()
+    assert not np.allclose(w1, w0), "stale lr=0 stayed on the PS server"
+    trainer._kvstore.close()
+
+
+def test_tcp_secretless_client_rejected_at_connect():
+    """Server with a secret challenges at connect; a secretless client
+    fails immediately (pre-auth), before any frame is buffered."""
+    port = _free_port()
+    uri = f"127.0.0.1:{port}"
+    srv = serve_forever(uri, PSServer(), secret="server-secret")
+    try:
+        with pytest.raises(mx.MXNetError, match="MXT_PS_SECRET"):
+            AsyncPSKVStore(root_uri=uri)
+    finally:
+        srv.shutdown()
+
+
+def test_generate_oracle_path_rejects_beyond_context():
+    """The guard covers the uncached/MoE oracle path too, not just the
+    KV-cache path."""
+    import mxnet_tpu as mx2
+    from mxnet_tpu.models import llama as ll
+
+    net = ll.llama_tiny()
+    net.initialize(mx.init.Xavier())
+    with pytest.raises(mx.MXNetError, match="max_seq_len"):
+        net.generate(nd.array(np.zeros((1, 4)), dtype="int32"),
+                     max_new_tokens=200, use_cache=False)

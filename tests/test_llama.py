@@ -279,3 +279,12 @@ def test_greedy_generate_leaves_rng_untouched():
     net.generate(_ids(1, 4), max_new_tokens=2)  # greedy: no RNG draw
     after = mx_random.uniform(shape=(4,)).asnumpy()
     onp.testing.assert_array_equal(before, after)
+
+
+def test_generate_rejects_beyond_context():
+    """prompt + max_new_tokens past cfg.max_seq_len must error, not
+    silently build RoPE/KV state outside the trained window."""
+    net = llama.llama_tiny()  # max_seq_len=128
+    net.initialize(mx.init.Xavier())
+    with pytest.raises(mx.MXNetError, match="max_seq_len"):
+        net.generate(_ids(1, 4), max_new_tokens=200)

@@ -16,19 +16,26 @@ script compatibility:
   ``MXT_COORDINATOR``/``MXT_NUM_PROCESSES``/``MXT_PROCESS_ID`` set —
   the loopback test topology (the reference's ``--launcher local`` analog,
   used by the distributed tests, SURVEY §4);
-- ``--launcher ssh`` prints the per-host commands (one per line) — on real
-  pods the platform runner (GKE/xpk) plays this role, so we emit rather
-  than own ssh fanout.
+- ``--launcher ssh`` EMITS the per-host commands (one per line) for an
+  external runner to execute — it does NOT ssh anywhere itself; on real
+  pods the platform runner (GKE/xpk) owns process fanout, so parity with
+  the reference's ssh tracker is "same env contract", not "same spawner".
+
+Every launch mints one ``MXT_PS_SECRET`` shared across ranks: the
+dist_async parameter server HMAC-signs its frames with it (see
+``mxnet_tpu/kvstore/dist_async.py``).
 """
 from __future__ import annotations
 
 import argparse
 import os
+import secrets
 import subprocess
 import sys
 
 
 def launch_local(n, cmd, coordinator="127.0.0.1:12721"):
+    ps_secret = os.environ.get("MXT_PS_SECRET") or secrets.token_hex(16)
     procs = []
     for rank in range(n):
         env = dict(os.environ)
@@ -36,6 +43,7 @@ def launch_local(n, cmd, coordinator="127.0.0.1:12721"):
             "MXT_COORDINATOR": coordinator,
             "MXT_NUM_PROCESSES": str(n),
             "MXT_PROCESS_ID": str(rank),
+            "MXT_PS_SECRET": ps_secret,
             # loopback test topology runs every process on CPU
             "JAX_PLATFORMS": env.get("MXT_LAUNCH_PLATFORM", "cpu"),
         })
@@ -47,11 +55,17 @@ def launch_local(n, cmd, coordinator="127.0.0.1:12721"):
 
 
 def emit_ssh(hosts, n, cmd, coordinator):
+    # The secret is NOT embedded (emitted lines land in logs / shell
+    # history / remote argv): the single-quoted ${...:?} expands on the
+    # REMOTE shell, so the runner must export MXT_PS_SECRET on each host
+    # out-of-band, and the command fails loudly if it is missing.
     lines = []
     for rank in range(n):
         host = hosts[rank % len(hosts)]
         envs = (f"MXT_COORDINATOR={coordinator} MXT_NUM_PROCESSES={n} "
-                f"MXT_PROCESS_ID={rank}")
+                f"MXT_PROCESS_ID={rank} "
+                'MXT_PS_SECRET="${MXT_PS_SECRET:?export a shared '
+                'MXT_PS_SECRET on each host}"')
         lines.append(f"ssh {host} '{envs} {' '.join(cmd)}'")
     return lines
 
