@@ -159,8 +159,10 @@ class KLDivLoss(Loss):
 
 class CTCLoss(Loss):
     """Connectionist temporal classification (reference: ``gluon.loss.CTCLoss``
-    → src/operator/nn/ctc_loss.cc:?).  Layouts 'NTC'/'TNC'; blank label is
-    class 0 ('first') or last ('last')."""
+    → src/operator/nn/ctc_loss.cc:?).  Layouts 'NTC'/'TNC'.  Like the
+    reference, the underlying op is called with ``blank_label='last'``:
+    label values are 0..alphabet_size-2, class alphabet_size-1 is blank,
+    and rows are padded with -1 when ``label_lengths`` is not given."""
 
     def __init__(self, layout="NTC", label_layout="NT", weight=None,
                  **kwargs):
@@ -177,7 +179,10 @@ class CTCLoss(Loss):
             pred = F.swapaxes(pred, 0, 1)
         if self._batch_axis == 1:
             label = F.swapaxes(label, 0, 1)
-        loss = F.ctc_loss(pred, label, pred_lengths, label_lengths)
+        loss = F.ctc_loss(pred, label, pred_lengths, label_lengths,
+                          use_data_lengths=pred_lengths is not None,
+                          use_label_lengths=label_lengths is not None,
+                          blank_label="last")
         return _apply_weighting(F, loss, self._weight, sample_weight)
 
 

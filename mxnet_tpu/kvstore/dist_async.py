@@ -28,8 +28,11 @@ deserialized when the frame carries a valid HMAC-SHA256 signature under
 the ``MXT_PS_SECRET`` shared secret (tools/launch.py generates one per
 job); an unsigned remote ``set_optimizer`` is refused.  With a secret
 configured the server also challenges each connection (nonce +
-HMAC response) before reading any frame, so an unauthenticated peer is
-dropped after 32 bytes and cannot make the server buffer large frames.
+HMAC response, under a timeout) before reading any frame, so an
+unauthenticated peer is dropped after 32 bytes and cannot make the
+server buffer large frames; frame signatures additionally bind the
+connection nonce, direction and a per-direction sequence number, so
+recorded frames cannot be replayed or reflected.
 """
 from __future__ import annotations
 
@@ -68,10 +71,14 @@ def _compress_merged(compression, residuals, key, merged):
 #   u64 payload_len | sig[32] | u32 header_len | header_json | buf0 buf1 ...
 # header_json = {"t": tree, "n": [buf nbytes...]} where tree mirrors the
 # message tuple with arrays/bytes swapped for {"__a__"/"__r__": buf_index}
-# markers.  sig = HMAC-SHA256(MXT_PS_SECRET, body) or 32 zero bytes when no
-# secret is configured.  Nothing in a frame is executable.
+# markers.  body = everything after sig.  sig = HMAC-SHA256(secret,
+# nonce || direction || u64 seq || body) — nonce is the server's 16-byte
+# connection hello, direction is b"C" (worker→server) or b"S" (reply),
+# seq counts frames per direction — or 32 zero bytes when no secret is
+# configured.  Nothing in a frame is executable.
 
 _SECRET_ENV = "MXT_PS_SECRET"
+_ENV_SECRET = object()  # sentinel: "default to the MXT_PS_SECRET env var"
 _MAX_FRAME = 1 << 33  # 8 GiB sanity cap on a single frame
 _SAFE_DTYPES = frozenset([
     "bool", "int8", "int16", "int32", "int64",
@@ -132,18 +139,26 @@ def _decode_obj(o, bufs):
     return o
 
 
-def _pack_frame(msg, secret):
+def _mac(secret, nonce, direction, seq, body):
+    """Signature binds the connection nonce, direction and per-direction
+    sequence number, so a recorded frame cannot be replayed into the same
+    or another authenticated stream, nor reflected back."""
+    return hmac.new(secret, nonce + direction +
+                    struct.pack("<Q", seq) + body, hashlib.sha256).digest()
+
+
+def _pack_frame(msg, secret, nonce=b"", direction=b"", seq=0):
     bufs = []
     tree = _encode_obj(msg, bufs)
     header = json.dumps({"t": tree, "n": [len(b) for b in bufs]},
                         separators=(",", ":")).encode()
     body = struct.pack("<I", len(header)) + header + b"".join(bufs)
-    sig = hmac.new(secret, body, hashlib.sha256).digest() if secret \
+    sig = _mac(secret, nonce, direction, seq, body) if secret \
         else b"\x00" * 32
     return struct.pack("<Q", 32 + len(body)) + sig + body
 
 
-def _unpack_frame(payload, secret):
+def _unpack_frame(payload, secret, nonce=b"", direction=b"", seq=0):
     """-> (msg, signed).  ``signed`` is True iff a secret is configured
     AND the signature verifies; with a configured secret a bad signature
     is rejected outright."""
@@ -151,9 +166,10 @@ def _unpack_frame(payload, secret):
     signed = False
     if secret is not None:
         if not hmac.compare_digest(
-                hmac.new(secret, body, hashlib.sha256).digest(), sig):
+                _mac(secret, nonce, direction, seq, body), sig):
             raise MXNetError("PS frame signature mismatch (MXT_PS_SECRET "
-                             "differs between peers?)")
+                             "differs between peers, or a replayed/"
+                             "out-of-order frame)")
         signed = True
     try:
         (hlen,) = struct.unpack("<I", body[:4])
@@ -169,10 +185,6 @@ def _unpack_frame(payload, secret):
         raise MXNetError(f"malformed PS frame: {e!r}")
 
 
-def _send_frame(sock, obj, secret=None):
-    sock.sendall(_pack_frame(obj, secret))
-
-
 def _recv_exact(sock, n):
     buf = bytearray()
     while len(buf) < n:
@@ -183,11 +195,35 @@ def _recv_exact(sock, n):
     return bytes(buf)
 
 
-def _recv_frame(sock, secret=None):
-    (n,) = struct.unpack("<Q", _recv_exact(sock, 8))
-    if not 32 <= n <= _MAX_FRAME:
-        raise MXNetError(f"bad PS frame length {n}")
-    return _unpack_frame(_recv_exact(sock, n), secret)
+class _FrameChannel:
+    """Framed duplex channel over a connected socket, with per-direction
+    sequence-numbered signatures when a secret is set (``b"C"`` = worker →
+    server frames, ``b"S"`` = replies)."""
+
+    def __init__(self, sock, secret, nonce, is_server):
+        self._sock = sock
+        self._secret = secret
+        self._nonce = nonce
+        self._tx_dir = b"S" if is_server else b"C"
+        self._rx_dir = b"C" if is_server else b"S"
+        self._tx_seq = 0
+        self._rx_seq = 0
+
+    def send(self, obj):
+        payload = _pack_frame(obj, self._secret, self._nonce,
+                              self._tx_dir, self._tx_seq)
+        self._tx_seq += 1
+        self._sock.sendall(payload)
+
+    def recv(self):
+        (n,) = struct.unpack("<Q", _recv_exact(self._sock, 8))
+        if not 32 <= n <= _MAX_FRAME:
+            raise MXNetError(f"bad PS frame length {n}")
+        msg, signed = _unpack_frame(_recv_exact(self._sock, n),
+                                    self._secret, self._nonce,
+                                    self._rx_dir, self._rx_seq)
+        self._rx_seq += 1
+        return msg, signed
 
 
 def _to_wire(v):
@@ -320,20 +356,24 @@ class _PSRequestHandler(socketserver.BaseRequestHandler):
         # connection hello: 1 flag byte (auth required?) + 16-byte nonce.
         # With a secret configured, the peer must answer the challenge
         # BEFORE any frame is read — an unauthenticated peer is dropped
-        # after a 32-byte read, so it can never make the server buffer a
+        # after a 32-byte read (under a timeout, so idle connects can't
+        # pin handler threads), and can never make the server buffer a
         # large attacker-declared frame.
         nonce = os.urandom(16)
         self.request.sendall((b"\x01" if secret else b"\x00") + nonce)
         if secret:
+            self.request.settimeout(30)
             try:
                 resp = _recv_exact(self.request, 32)
-            except ConnectionError:
-                return
+            except (ConnectionError, OSError):
+                return  # includes the pre-auth timeout
             if not hmac.compare_digest(resp, _auth_response(secret, nonce)):
                 return  # drop: wrong or missing secret
+            self.request.settimeout(None)  # workers idle legitimately
+        chan = _FrameChannel(self.request, secret, nonce, is_server=True)
         while True:
             try:
-                msg, signed = _recv_frame(self.request, secret)
+                msg, signed = chan.recv()
             except (ConnectionError, struct.error, MXNetError):
                 return  # malformed/forged frame: drop the connection
             if msg[0] == "bye":
@@ -343,7 +383,7 @@ class _PSRequestHandler(socketserver.BaseRequestHandler):
                                                      trusted=signed))
             except Exception as e:  # error crosses the wire, like ps-lite
                 reply = ("err", repr(e))
-            _send_frame(self.request, reply, secret)
+            chan.send(reply)
 
 
 class _PSTCPServer(socketserver.ThreadingTCPServer):
@@ -351,16 +391,17 @@ class _PSTCPServer(socketserver.ThreadingTCPServer):
     daemon_threads = True
 
 
-def serve_forever(uri, ps=None, background=True, secret=None):
+def serve_forever(uri, ps=None, background=True, secret=_ENV_SECRET):
     """Start the PS TCP server on ``uri`` ("host:port").  Returns the
     server object (``.shutdown()`` to stop).  Reference analog: the server
     role spawned by tools/launch.py (DMLC_ROLE=server).  ``secret``
-    defaults to ``MXT_PS_SECRET`` captured at start."""
+    defaults to ``MXT_PS_SECRET`` captured at start; pass ``None`` to
+    explicitly run unauthenticated."""
     host, port = uri.rsplit(":", 1)
     srv = _PSTCPServer((host, int(port)), _PSRequestHandler)
     srv.ps = ps or PSServer()
-    srv.secret = secret.encode() if isinstance(secret, str) else \
-        (secret if secret is not None else _secret())
+    srv.secret = _secret() if secret is _ENV_SECRET else \
+        (secret.encode() if isinstance(secret, str) else secret)
     if background:
         t = threading.Thread(target=srv.serve_forever, daemon=True)
         t.start()
@@ -381,15 +422,15 @@ class AsyncPSKVStore:
     """
 
     def __init__(self, root_uri=None, rank=None, num_workers=None,
-                 secret=None):
+                 secret=_ENV_SECRET):
         self.type = "dist_async"
         self._rank = int(rank if rank is not None
                          else os.environ.get("MXT_RANK", 0))
         self._num_workers = int(num_workers if num_workers is not None
                                 else os.environ.get("MXT_NWORKER", 1))
         self._uri = root_uri or os.environ.get("MXT_PS_ROOT_URI")
-        self._wire_secret = secret.encode() if isinstance(secret, str) \
-            else (secret if secret is not None else _secret())
+        self._wire_secret = _secret() if secret is _ENV_SECRET else \
+            (secret.encode() if isinstance(secret, str) else secret)
         self._queue = queue.Queue()
         self._err = None
         self._local = None
@@ -399,15 +440,27 @@ class AsyncPSKVStore:
             host, port = self._uri.rsplit(":", 1)
             self._sock = socket.create_connection((host, int(port)),
                                                   timeout=60)
-            hello = _recv_exact(self._sock, 17)
-            if hello[:1] == b"\x01":  # server demands the auth challenge
-                if self._wire_secret is None:
+            try:
+                hello = _recv_exact(self._sock, 17)
+                if hello[:1] == b"\x01":  # server demands auth challenge
+                    if self._wire_secret is None:
+                        raise MXNetError(
+                            "PS server requires authentication: set the "
+                            "MXT_PS_SECRET shared secret (tools/launch.py "
+                            "generates one per job)")
+                    self._sock.sendall(
+                        _auth_response(self._wire_secret, hello[1:]))
+                elif self._wire_secret is not None:
                     raise MXNetError(
-                        "PS server requires authentication: set the "
-                        "MXT_PS_SECRET shared secret (tools/launch.py "
-                        "generates one per job)")
-                self._sock.sendall(
-                    _auth_response(self._wire_secret, hello[1:]))
+                        "this worker has MXT_PS_SECRET but the PS server "
+                        f"at {self._uri} runs UNAUTHENTICATED — restart "
+                        "the server with the same shared secret")
+            except BaseException:
+                self._sock.close()  # don't leak the connection on a
+                self._sock = None   # handshake/config error
+                raise
+            self._chan = _FrameChannel(
+                self._sock, self._wire_secret, hello[1:], is_server=False)
         else:
             self._local = PSServer()
         self._sender = threading.Thread(target=self._drain, daemon=True)
@@ -428,10 +481,9 @@ class AsyncPSKVStore:
         """Synchronous round-trip (used by the sender thread and pulls)."""
         if self._local is not None:
             return self._local.handle(msg[0], *msg[1:])
-        secret = self._wire_secret
         with self._sock_lock:
-            _send_frame(self._sock, msg, secret)
-            (status, payload), _ = _recv_frame(self._sock, secret)
+            self._chan.send(msg)
+            (status, payload), _ = self._chan.recv()
         if status == "err":
             raise MXNetError(f"PS server error: {payload}")
         return payload
@@ -577,7 +629,7 @@ class AsyncPSKVStore:
         if self._sock is not None:
             try:
                 with self._sock_lock:
-                    _send_frame(self._sock, ("bye",), self._wire_secret)
+                    self._chan.send(("bye",))
                 self._sock.close()
             except OSError:
                 pass

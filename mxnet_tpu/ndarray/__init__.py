@@ -85,6 +85,12 @@ def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype=None,
     return NDArray(r, ctx=ctx or current_context())
 
 
+# creation op with no tensor inputs: registered so the executor can
+# evaluate the zero-input graph node mx.sym.arange builds (symbol.py
+# defines the builder explicitly so positional start/stop work)
+_registry.defop("arange")(arange)
+
+
 def linspace(start, stop, num, endpoint=True, ctx=None, dtype=None):
     import jax.numpy as jnp
 
@@ -99,18 +105,6 @@ def eye(N, M=0, k=0, ctx=None, dtype=None, **kwargs):
     return NDArray(jnp.eye(N, M or None, k,
                            _resolve_dtype(dtype) or _np.float32),
                    ctx=ctx or current_context())
-
-
-def zeros_like(data, **kwargs):
-    import jax.numpy as jnp
-
-    return _registry.apply_op(jnp.zeros_like, data, name="zeros_like")
-
-
-def ones_like(data, **kwargs):
-    import jax.numpy as jnp
-
-    return _registry.apply_op(jnp.ones_like, data, name="ones_like")
 
 
 def full_like(data, fill_value, **kwargs):

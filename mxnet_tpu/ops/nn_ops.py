@@ -128,6 +128,113 @@ def softmax_cross_entropy(data, label, **kwargs):
 _export(softmax_cross_entropy)
 
 
+def ctc_loss(data, label, data_lengths=None, label_lengths=None,
+             use_data_lengths=None, use_label_lengths=None,
+             blank_label="first", **kwargs):
+    """Connectionist temporal classification loss (reference
+    ``src/operator/nn/ctc_loss.cc`` — CTCLoss / contrib.ctc_loss).
+
+    ``data``: (T, N, C) UNNORMALIZED activations (softmax over C applied
+    internally, like the reference); ``label``: (N, L) class indices.
+    ``blank_label='first'``: class 0 is blank, real labels 1..C-1, and —
+    when ``label_lengths`` is absent — label rows are padded with 0;
+    ``'last'``: class C-1 is blank, labels 0..C-2, padding -1.  Returns
+    per-sequence negative log likelihood, shape (N,), accumulated in
+    float32 (float64 under x64 mode).
+
+    TPU-first: one ``lax.scan`` over time on the (N, 2L+1) alpha lattice
+    in log space (static shapes, batched gathers), gradients via jax
+    autodiff through the scan — no custom backward kernel needed.
+    """
+    if blank_label not in ("first", "last"):
+        raise MXNetError(f"bad blank_label {blank_label!r}")
+    blank_first = blank_label == "first"
+    # symbol-graph calls arrive with length tensors POSITIONAL and the
+    # use_* flags as attrs: when only label lengths are in use, rebind the
+    # single positional length tensor to label_lengths
+    if use_label_lengths and label_lengths is None and \
+            data_lengths is not None and not use_data_lengths:
+        label_lengths, data_lengths = data_lengths, None
+    if use_data_lengths is None:
+        use_data_lengths = data_lengths is not None
+    if use_label_lengths is None:
+        use_label_lengths = label_lengths is not None
+    if use_data_lengths and data_lengths is None:
+        raise MXNetError("use_data_lengths=True but no data_lengths given")
+    if use_label_lengths and label_lengths is None:
+        raise MXNetError("use_label_lengths=True but no label_lengths "
+                         "given")
+    args = [data, label]
+    if use_data_lengths:
+        args.append(data_lengths)
+    if use_label_lengths:
+        args.append(label_lengths)
+
+    NEG = jnp.float32(-1e30)  # -inf stand-in: keeps logaddexp NaN-free
+
+    def f(*raws):
+        logits, lab = raws[0], raws[1]
+        in_len = raws[2] if use_data_lengths else None
+        lab_len = raws[-1] if use_label_lengths else None
+        T, N, C = logits.shape
+        L = lab.shape[1]
+        S = 2 * L + 1
+        blank = 0 if blank_first else C - 1
+        pad_val = 0 if blank_first else -1
+        lab = lab.astype(jnp.int32)
+        in_len = jnp.full((N,), T, jnp.int32) if in_len is None \
+            else in_len.astype(jnp.int32)
+        if lab_len is None:
+            # reference LabelTensorToPackedVector: length = position of
+            # the first padding value
+            not_pad = (lab != pad_val).astype(jnp.int32)
+            lab_len = jnp.cumprod(not_pad, axis=1).sum(axis=1)
+        else:
+            lab_len = lab_len.astype(jnp.int32)
+
+        logp = jax.nn.log_softmax(
+            logits.astype(jnp.promote_types(logits.dtype, jnp.float32)),
+            axis=-1)
+        # extended label sequence [blank, l1, blank, ..., lL, blank]
+        valid = jnp.arange(L)[None, :] < lab_len[:, None]
+        lab_v = jnp.where(valid, jnp.clip(lab, 0, C - 1), blank)
+        ext = jnp.full((N, S), blank, jnp.int32).at[:, 1::2].set(lab_v)
+        ext_m2 = jnp.concatenate(
+            [jnp.full((N, 2), -1, jnp.int32), ext[:, :-2]], axis=1)
+        can_skip = (ext != blank) & (ext != ext_m2)  # (N, S)
+
+        # emissions gathered once for all t: (T, N, S)
+        emit = jnp.take_along_axis(
+            logp, jnp.broadcast_to(ext[None], (T, N, S)), axis=2)
+        s_idx = jnp.arange(S)
+        alpha0 = jnp.where(s_idx[None, :] < 2, emit[0], NEG)
+
+        def step(alpha, xs):
+            em, t = xs
+            a1 = jnp.concatenate(
+                [jnp.full((N, 1), NEG), alpha[:, :-1]], axis=1)
+            a2 = jnp.concatenate(
+                [jnp.full((N, 2), NEG), alpha[:, :-2]], axis=1)
+            a2 = jnp.where(can_skip, a2, NEG)
+            new = jnp.logaddexp(jnp.logaddexp(alpha, a1), a2) + em
+            # past a sequence's own length the lattice is frozen
+            return jnp.where(t < in_len[:, None], new, alpha), None
+
+        alpha_T, _ = lax.scan(step, alpha0,
+                              (emit[1:], jnp.arange(1, T)))
+        s_end = 2 * lab_len  # index of the final blank
+        last = jnp.take_along_axis(alpha_T, s_end[:, None], 1)[:, 0]
+        last2 = jnp.take_along_axis(
+            alpha_T, jnp.maximum(s_end - 1, 0)[:, None], 1)[:, 0]
+        last2 = jnp.where(lab_len > 0, last2, NEG)
+        return -jnp.logaddexp(last, last2)
+
+    return apply_op(f, *args, name="ctc_loss")
+
+
+_export(ctc_loss, aliases=("CTCLoss",))
+
+
 # --- linear / conv ----------------------------------------------------------
 
 def fully_connected(data, weight, bias=None, num_hidden=None, no_bias=False,

@@ -79,6 +79,20 @@ def transpose(data, axes=None, **kwargs):
 _export(transpose)
 
 
+def zeros_like(data, **kwargs):
+    return apply_op(jnp.zeros_like, data, name="zeros_like")
+
+
+_export(zeros_like)
+
+
+def ones_like(data, **kwargs):
+    return apply_op(jnp.ones_like, data, name="ones_like")
+
+
+_export(ones_like)
+
+
 def swapaxes(data, dim1=0, dim2=1, **kwargs):
     return apply_op(lambda a: jnp.swapaxes(a, dim1, dim2), data,
                     name="swapaxes")

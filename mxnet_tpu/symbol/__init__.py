@@ -9,11 +9,11 @@ from __future__ import annotations
 
 from ..ops import registry as _registry
 from .symbol import (Symbol, Variable, var, Group, load, load_json,
-                     zeros, ones, _sym_op)
+                     zeros, ones, arange, _sym_op)
 from . import contrib  # noqa: F401  (mx.sym.contrib namespace)
 
 __all__ = ["Symbol", "Variable", "var", "Group", "load", "load_json",
-           "zeros", "ones"]
+           "zeros", "ones", "arange"]
 
 # generate mx.sym.<op> for every registered op; ops land as module attrs so
 # tab-completion and getattr both work (the reference generates these from

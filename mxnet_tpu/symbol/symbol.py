@@ -634,3 +634,16 @@ def ones(shape, dtype=None, **kwargs):
     return _make_node("_ones", [], {"shape": tuple(shape),
                                     "dtype": np.dtype(dtype or "float32").name},
                       name=kwargs.get("name"))
+
+
+def arange(start, stop=None, step=1.0, repeat=1, dtype=None, **kwargs):
+    """Symbolic arange: zero-input creation node.  Defined explicitly
+    (rather than via the generic op wrapper, which keeps only Symbol
+    positionals) so positional start/stop work like the reference
+    mx.sym.arange."""
+    attrs = {"start": start, "step": step, "repeat": repeat}
+    if stop is not None:
+        attrs["stop"] = stop
+    if dtype is not None:
+        attrs["dtype"] = np.dtype(dtype).name
+    return _make_node("arange", [], attrs, name=kwargs.get("name"))

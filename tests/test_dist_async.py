@@ -328,3 +328,33 @@ def test_generate_oracle_path_rejects_beyond_context():
     with pytest.raises(mx.MXNetError, match="max_seq_len"):
         net.generate(nd.array(np.zeros((1, 4)), dtype="int32"),
                      max_new_tokens=200, use_cache=False)
+
+
+def test_frame_signature_binds_nonce_and_sequence():
+    """A signed frame is not valid under another nonce, direction, or
+    sequence position — the anti-replay property."""
+    from mxnet_tpu.kvstore import dist_async as da
+
+    secret, nonce = b"s3cret", b"n" * 16
+    frame = da._pack_frame(("push", "k"), secret, nonce, b"C", 5)
+    payload = frame[8:]
+    msg, signed = da._unpack_frame(payload, secret, nonce, b"C", 5)
+    assert signed and msg[0] == "push"
+    for bad in [(secret, b"m" * 16, b"C", 5),   # other connection
+                (secret, nonce, b"S", 5),        # reflected
+                (secret, nonce, b"C", 6)]:       # replayed later
+        with pytest.raises(mx.MXNetError, match="signature mismatch"):
+            da._unpack_frame(payload, *bad)
+
+
+def test_secret_worker_rejects_unauthenticated_server():
+    """Worker configured with a secret must refuse to talk to a server
+    that runs unauthenticated (clear connect-time diagnostic)."""
+    port = _free_port()
+    uri = f"127.0.0.1:{port}"
+    srv = serve_forever(uri, PSServer(), secret=None)
+    try:
+        with pytest.raises(mx.MXNetError, match="UNAUTHENTICATED"):
+            AsyncPSKVStore(root_uri=uri, secret="worker-secret")
+    finally:
+        srv.shutdown()
