@@ -33,10 +33,12 @@ _OPS: Dict[str, Callable] = {}
 
 
 def defop(name: str = None, aliases=()):
-    """Decorator: register an NDArray-level op under ``name`` (+aliases)."""
+    """Decorator: register an NDArray-level op under ``name`` (+aliases).
+    Like make_exporter, registration adds unknown-attribute validation."""
 
     def deco(fn):
         opname = name or fn.__name__
+        fn = _attr_validated(fn, opname)
         _OPS[opname] = fn
         for a in aliases:
             _OPS[a] = fn
@@ -136,6 +138,51 @@ def accum_dtype(dt):
     return np.float32 if np.dtype(dt).name in ("bfloat16", "float16") else None
 
 
+# Attributes every op tolerates: graph/bookkeeping junk the reference's
+# dmlc Parameter layer also strips before validation (node naming, symbol
+# attrs, arity hints the json graph carries) plus the reference's harmless
+# backend performance hints, which legacy MXNet-exported json checkpoints
+# carry on conv/pool/BN nodes and which have no TPU meaning.
+_COMMON_ATTRS = frozenset(["name", "attr", "num_args", "num_outputs",
+                           "__layout__",
+                           "workspace", "cudnn_tune", "cudnn_off"])
+
+
+def _attr_validated(fn, opname):
+    """The dmlc ``Parameter`` role (SURVEY §5 config row): a typo'd or
+    unknown op attribute RAISES instead of vanishing into ``**kwargs``.
+    Known attributes = the op function's named parameters + _COMMON_ATTRS;
+    ops without a ``**kwargs`` catch-all already validate natively."""
+    import functools
+    import inspect
+
+    try:
+        sig = inspect.signature(fn)
+    except (TypeError, ValueError):
+        return fn
+    params = sig.parameters.values()
+    if not any(p.kind is inspect.Parameter.VAR_KEYWORD for p in params):
+        return fn  # no silent catch-all to guard
+    named = frozenset(
+        p.name for p in params
+        if p.kind in (inspect.Parameter.POSITIONAL_OR_KEYWORD,
+                      inspect.Parameter.KEYWORD_ONLY))
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        unknown = [k for k in kwargs
+                   if k not in named and k not in _COMMON_ATTRS]
+        if unknown:
+            from ..base import MXNetError
+
+            raise MXNetError(
+                f"operator {opname!r} got unknown attribute(s) "
+                f"{sorted(unknown)}; accepted: {sorted(named)}")
+        return fn(*args, **kwargs)
+
+    return wrapper
+
+
 def make_exporter(module):
     """Create the per-opmodule ``_export`` helper: registers the op under its
     name + aliases and exposes it as a module attribute (the analog of the
@@ -146,6 +193,7 @@ def make_exporter(module):
     def _export(fn, name=None, aliases=()):
         name = name or fn.__name__
         fn.__name__ = name
+        fn = _attr_validated(fn, name)
         _OPS[name] = fn
         setattr(module, name, fn)
         module.__all__.append(name)

@@ -30,23 +30,95 @@ _export = make_exporter(_this)
 
 # --- shape manipulation -----------------------------------------------------
 
+def _mx_reshape_target(in_shape, spec):
+    """Reference ReshapeShape (src/operator/tensor/matrix_op.cc): resolve
+    the full special-code vocabulary against ``in_shape``.
+
+    0 = copy input dim at the cursor; -1 = infer one dim from the total
+    size; -2 = copy ALL remaining input dims; -3 = merge the next two
+    input dims; -4 = split one input dim into the two spec values that
+    follow (one may be -1).  Positive values consume one input dim.
+    """
+    out = []
+    src = 0  # input-dim cursor
+    j = 0
+    infer_at = None
+    spec = [int(s) for s in spec]
+    while j < len(spec):
+        s = spec[j]
+        if s == 0:
+            if src >= len(in_shape):
+                raise MXNetError(f"reshape code 0 at {j} has no input dim")
+            out.append(in_shape[src])
+            src += 1
+        elif s == -1:
+            if infer_at is not None:
+                raise MXNetError("reshape allows at most one -1")
+            infer_at = len(out)
+            out.append(-1)
+            src += 1
+        elif s == -2:
+            out.extend(in_shape[src:])
+            src = len(in_shape)
+        elif s == -3:
+            if src + 2 > len(in_shape):
+                raise MXNetError("reshape code -3 needs two input dims")
+            out.append(in_shape[src] * in_shape[src + 1])
+            src += 2
+        elif s == -4:
+            if j + 2 >= len(spec):
+                raise MXNetError("reshape code -4 needs two following "
+                                 "values")
+            if src >= len(in_shape):
+                raise MXNetError("reshape code -4 has no input dim")
+            d = in_shape[src]
+            d1, d2 = spec[j + 1], spec[j + 2]
+            if d1 == -1 and d2 == -1:
+                raise MXNetError("reshape -4: both split factors are -1")
+            if d1 == -1:
+                d1 = d // d2
+            if d2 == -1:
+                d2 = d // d1
+            if d1 * d2 != d:
+                raise MXNetError(
+                    f"reshape -4: {d1}x{d2} != input dim {d}")
+            out.extend([d1, d2])
+            src += 1
+            j += 2
+        elif s > 0:
+            out.append(s)
+            src += 1
+        else:
+            raise MXNetError(f"bad reshape code {s}")
+        j += 1
+    if infer_at is not None:
+        known = 1
+        for v in out:
+            if v != -1:
+                known *= v
+        total = 1
+        for v in in_shape:
+            total *= v
+        if known == 0 or total % known:
+            raise MXNetError(
+                f"cannot infer -1: {in_shape} -> {tuple(out)}")
+        out[infer_at] = total // known
+    return tuple(out)
+
+
 def reshape(data, shape=None, reverse=False, **kwargs):
-    """Reshape with MXNet's special codes (0 = keep dim, -1 = infer,
-    reference src/operator/tensor/matrix_op.cc:? ReshapeShape).  Codes
-    -2/-3/-4 are not yet supported (rarely used; raise clearly)."""
+    """Reshape with the reference's full special-code vocabulary
+    (0 keep / -1 infer / -2 copy-rest / -3 merge / -4 split — see
+    ``_mx_reshape_target``; src/operator/tensor/matrix_op.cc
+    ReshapeShape).  ``reverse=True`` resolves the codes right-to-left
+    (the reference runs the same routine on reversed shapes)."""
     if shape is None:
         raise MXNetError("reshape needs target shape")
     in_shape = data.shape
-    out = []
-    for i, s in enumerate(shape):
-        if s == 0:
-            out.append(in_shape[i])
-        elif s in (-2, -3, -4):
-            raise NotImplementedError(
-                f"reshape special code {s} not yet supported")
-        else:
-            out.append(int(s))
-    tgt = tuple(out)
+    if reverse:
+        tgt = _mx_reshape_target(in_shape[::-1], list(shape)[::-1])[::-1]
+    else:
+        tgt = _mx_reshape_target(in_shape, shape)
     return apply_op(lambda a: jnp.reshape(a, tgt), data, name="reshape")
 
 
@@ -659,17 +731,217 @@ _export(linalg_potrf)
 
 def linalg_trsm(A, B, transpose=False, rightside=False, lower=True,
                 alpha=1.0, **kwargs):
+    """Triangular solve (reference la_op.cc trsm): left ``op(A) X = αB``
+    or right ``X op(A) = αB`` (solved via ``Aᵀ Xᵀ = Bᵀ``)."""
     def f(a, b):
+        if rightside:
+            xt = jax.scipy.linalg.solve_triangular(
+                a, jnp.swapaxes(b, -1, -2),
+                trans=0 if transpose else 1, lower=lower)
+            return alpha * jnp.swapaxes(xt, -1, -2)
         return alpha * jax.scipy.linalg.solve_triangular(
             a, b, trans=1 if transpose else 0, lower=lower)
 
-    if rightside:
-        raise NotImplementedError("rightside trsm lands with the full linalg "
-                                  "family in a later round")
     return apply_op(f, A, B, name="linalg_trsm")
 
 
 _export(linalg_trsm)
+
+
+def linalg_gemm(A, B, C, transpose_a=False, transpose_b=False, alpha=1.0,
+                beta=1.0, axis=-2, **kwargs):
+    """Reference la_op.cc gemm: ``α·op(A)·op(B) + β·C``; ``axis`` names
+    the matrix-row axis (default -2, i.e. trailing matrix dims)."""
+    def f(a, b, c):
+        if axis != -2:
+            a, b, c = (jnp.moveaxis(t, axis, -2) for t in (a, b, c))
+        if transpose_a:
+            a = jnp.swapaxes(a, -1, -2)
+        if transpose_b:
+            b = jnp.swapaxes(b, -1, -2)
+        out = alpha * jnp.matmul(a, b) + beta * c
+        return jnp.moveaxis(out, -2, axis) if axis != -2 else out
+
+    return apply_op(f, A, B, C, name="linalg_gemm")
+
+
+_export(linalg_gemm)
+
+
+def linalg_trmm(A, B, transpose=False, rightside=False, lower=True,
+                alpha=1.0, **kwargs):
+    """Triangular matrix multiply (la_op.cc trmm): the triangle of A is
+    taken explicitly, matching BLAS semantics on a dirty other half."""
+    def f(a, b):
+        tri = jnp.tril(a) if lower else jnp.triu(a)
+        if transpose:
+            tri = jnp.swapaxes(tri, -1, -2)
+        return alpha * (jnp.matmul(b, tri) if rightside
+                        else jnp.matmul(tri, b))
+
+    return apply_op(f, A, B, name="linalg_trmm")
+
+
+_export(linalg_trmm)
+
+
+def linalg_potri(A, **kwargs):
+    """Inverse of an SPD matrix FROM its Cholesky factor (la_op.cc potri:
+    input is L with A = L·Lᵀ, output A⁻¹)."""
+    def f(a):
+        n = a.shape[-1]
+        eye = jnp.broadcast_to(jnp.eye(n, dtype=a.dtype), a.shape)
+        linv = jax.scipy.linalg.solve_triangular(a, eye, lower=True)
+        return jnp.matmul(jnp.swapaxes(linv, -1, -2), linv)
+
+    return apply_op(f, A, name="linalg_potri")
+
+
+_export(linalg_potri)
+
+
+def linalg_sumlogdiag(A, **kwargs):
+    """Σ log(diag(A)) per matrix (la_op.cc sumlogdiag)."""
+    return apply_op(
+        lambda a: jnp.log(jnp.diagonal(a, axis1=-2, axis2=-1)).sum(-1),
+        A, name="linalg_sumlogdiag")
+
+
+_export(linalg_sumlogdiag)
+
+
+def linalg_extractdiag(A, offset=0, **kwargs):
+    return apply_op(
+        lambda a: jnp.diagonal(a, offset=offset, axis1=-2, axis2=-1),
+        A, name="linalg_extractdiag")
+
+
+_export(linalg_extractdiag)
+
+
+def linalg_makediag(A, offset=0, **kwargs):
+    def f(a):
+        n = a.shape[-1] + abs(offset)
+        idx = (jnp.arange(a.shape[-1]),
+               jnp.arange(a.shape[-1]) + offset) if offset >= 0 else \
+              (jnp.arange(a.shape[-1]) - offset, jnp.arange(a.shape[-1]))
+        out = jnp.zeros(a.shape[:-1] + (n, n), a.dtype)
+        return out.at[..., idx[0], idx[1]].set(a)
+
+    return apply_op(f, A, name="linalg_makediag")
+
+
+_export(linalg_makediag)
+
+
+def _trian_indices(n, offset, lower):
+    """Reference extracttrian semantics: offset>0 selects the strict
+    upper band starting at that superdiagonal, offset<0 the lower band;
+    ``lower`` only disambiguates offset=0."""
+    if offset > 0:
+        return np.triu_indices(n, offset)
+    if offset < 0:
+        return np.tril_indices(n, offset)
+    return np.tril_indices(n) if lower else np.triu_indices(n)
+
+
+def linalg_extracttrian(A, offset=0, lower=True, **kwargs):
+    """Pack a triangle into a vector, row-major — la_op.cc extracttrian
+    (see ``_trian_indices`` for the offset/lower rules)."""
+    def f(a):
+        r, c = _trian_indices(a.shape[-1], offset, lower)
+        return a[..., r, c]
+
+    return apply_op(f, A, name="linalg_extracttrian")
+
+
+_export(linalg_extracttrian)
+
+
+def linalg_maketrian(A, offset=0, lower=True, **kwargs):
+    """Unpack a vector into a triangular matrix — inverse of
+    extracttrian."""
+    def f(a):
+        m = a.shape[-1]
+        # the packed triangle has (n-k)(n-k+1)/2 entries for |offset|=k
+        k = abs(offset)
+        n = int(round((np.sqrt(8 * m + 1) - 1) / 2)) + k
+        r, c = _trian_indices(n, offset, lower)
+        if len(r) != m:
+            raise MXNetError(
+                f"maketrian: vector length {m} does not pack an "
+                f"offset-{offset} triangle")
+        out = jnp.zeros(a.shape[:-1] + (n, n), a.dtype)
+        return out.at[..., r, c].set(a)
+
+    return apply_op(f, A, name="linalg_maketrian")
+
+
+_export(linalg_maketrian)
+
+
+def linalg_gelqf(A, **kwargs):
+    """LQ factorization A = L·Q with orthonormal rows of Q (la_op.cc
+    gelqf, m ≤ n), computed as the transposed QR of Aᵀ on the MXU."""
+    def f(a):
+        q, r = jnp.linalg.qr(jnp.swapaxes(a, -1, -2), mode="reduced")
+        return jnp.swapaxes(r, -1, -2), jnp.swapaxes(q, -1, -2)
+
+    return apply_op(f, A, name="linalg_gelqf")
+
+
+_export(linalg_gelqf)
+
+
+def linalg_syevd(A, **kwargs):
+    """Symmetric eigendecomposition (la_op.cc syevd): returns (U, Λ) with
+    A = Uᵀ·diag(Λ)·U — rows of U are eigenvectors, Λ ascending."""
+    def f(a):
+        w, v = jnp.linalg.eigh(a)
+        return jnp.swapaxes(v, -1, -2), w
+
+    return apply_op(f, A, name="linalg_syevd")
+
+
+_export(linalg_syevd)
+
+
+def linalg_gesvd(A, **kwargs):
+    """Singular value decomposition (la_op.cc gesvd, m ≤ n): returns
+    (UT, L, V) with A = UT·diag(L)·V."""
+    def f(a):
+        u, s, vh = jnp.linalg.svd(a, full_matrices=False)
+        return u, s, vh
+
+    return apply_op(f, A, name="linalg_gesvd")
+
+
+_export(linalg_gesvd)
+
+
+def linalg_inverse(A, **kwargs):
+    return apply_op(jnp.linalg.inv, A, name="linalg_inverse")
+
+
+_export(linalg_inverse, aliases=("inverse",))
+
+
+def linalg_det(A, **kwargs):
+    return apply_op(jnp.linalg.det, A, name="linalg_det")
+
+
+_export(linalg_det, aliases=("det",))
+
+
+def linalg_slogdet(A, **kwargs):
+    def f(a):
+        sign, logdet = jnp.linalg.slogdet(a)
+        return sign, logdet
+
+    return apply_op(f, A, name="linalg_slogdet")
+
+
+_export(linalg_slogdet, aliases=("slogdet",))
 
 
 def linalg_syrk(A, transpose=False, alpha=1.0, **kwargs):
