@@ -5,17 +5,32 @@ workers returning batches through CPU shared-memory NDArrays
 (``src/storage/cpu_shared_storage_manager.h:?``) to avoid pickling tensor
 payloads.
 
-TPU-native redesign: worker *threads* (decode releases the GIL in cv2/
-numpy) + a bounded prefetch queue; the shared-memory trick is unnecessary
-because batches stay host-numpy until a single ``device_put`` — optionally
-sharded straight over the mesh data axis (``jax.device_put`` with a
-NamedSharding is itself the zero-copy handoff).  ``num_workers`` keeps the
-reference meaning (parallel fetch); batchify functions are compatible.
+TPU-native redesign, two worker modes:
+
+- ``worker_type='thread'`` (default): decode releases the GIL in
+  cv2/numpy, so threads + a bounded prefetch window cover most jobs with
+  zero process overhead; batches stay host-numpy until one
+  ``device_put``.
+- ``worker_type='process'``: the reference's multiprocessing design for
+  GIL-bound python transforms.  Workers are SPAWNED (not forked — a fork
+  of a live TPU-client process would share device state) and pin jax to
+  CPU before touching arrays; batch payloads come back through POSIX
+  shared memory (``multiprocessing.shared_memory``), with only the
+  (name, dtype, shape) metadata pickled — the
+  cpu_shared_storage_manager.h role.  Dataset + batchify_fn must be
+  picklable, and per-worker numpy seeds are derailed so random
+  augmentations differ across workers (reference ``_worker_initializer``).
+
+``num_workers`` keeps the reference meaning (parallel fetch); batchify
+functions are compatible; ``thread_pool=True`` forces thread mode like
+the reference flag.
 """
 from __future__ import annotations
 
+import pickle
 import queue as _queue
 import threading
+import traceback
 
 import numpy as np
 
@@ -43,6 +58,107 @@ def default_batchify_fn(data):
 default_mp_batchify_fn = default_batchify_fn
 
 
+# --- process-worker machinery (shared-memory handoff) -----------------------
+
+def _flatten_host(obj, arrays):
+    """Nested tuple/list of array-likes → template with leaf indices;
+    arrays collected as contiguous host numpy."""
+    if isinstance(obj, (list, tuple)):
+        return [_flatten_host(o, arrays) for o in obj]
+    a = obj.asnumpy() if isinstance(obj, NDArray) else np.asarray(obj)
+    if a.dtype == np.float64:
+        a = a.astype(np.float32)
+    arrays.append(np.ascontiguousarray(a))
+    return len(arrays) - 1
+
+
+def _unflatten(tmpl, leaves):
+    if isinstance(tmpl, list):
+        return [_unflatten(t, leaves) for t in tmpl]
+    return leaves[tmpl]
+
+
+def _shm_unregister(name):
+    """The child hands shm ownership to the parent; unregister from the
+    child's resource_tracker so it doesn't warn/unlink at exit."""
+    try:
+        from multiprocessing import resource_tracker
+
+        resource_tracker.unregister(f"/{name}", "shared_memory")
+    except Exception:
+        pass
+
+
+def _process_worker_loop(payload, index_q, result_q, worker_id):
+    """Child main: runs dataset fetch + batchify, exports each result
+    array via shared memory, sends only metadata through the queue.
+    Jobs/results carry the parent's epoch counter so abandoned epochs
+    can never leak into the next one."""
+    from multiprocessing import shared_memory
+
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")  # never touch the TPU
+    except Exception:
+        pass
+    import os
+
+    np.random.seed((os.getpid() * 2654435761 + worker_id) % (2 ** 31 - 1))
+    dataset, batchify_fn = pickle.loads(payload)
+    while True:
+        job = index_q.get()
+        if job is None:
+            return
+        epoch, i, indices = job
+        try:
+            batch = batchify_fn([dataset[j] for j in indices])
+            arrays = []
+            tmpl = _flatten_host(batch, arrays)
+            metas = []
+            for a in arrays:
+                shm = shared_memory.SharedMemory(create=True,
+                                                 size=max(a.nbytes, 1))
+                np.ndarray(a.shape, a.dtype, buffer=shm.buf)[...] = a
+                metas.append((shm.name, str(a.dtype), a.shape))
+                shm.close()
+                _shm_unregister(shm.name)
+            result_q.put((epoch, i, tmpl, metas, None))
+        except Exception:
+            result_q.put((epoch, i, None, None, traceback.format_exc()))
+
+
+def _free_metas(metas):
+    """Unlink shared-memory blocks the parent will never turn into a
+    batch (stale epoch, error path, shutdown)."""
+    from multiprocessing import shared_memory
+
+    for name, _dtype, _shape in metas or ():
+        try:
+            shm = shared_memory.SharedMemory(name=name)
+            shm.close()
+            shm.unlink()
+        except FileNotFoundError:
+            pass
+        except Exception:
+            pass
+
+
+def _attach_result(tmpl, metas):
+    """Parent side: copy each shared-memory block out, unlink it, and
+    rebuild the batch as NDArrays."""
+    from multiprocessing import shared_memory
+
+    leaves = []
+    for name, dtype, shape in metas:
+        shm = shared_memory.SharedMemory(name=name)
+        arr = np.array(np.ndarray(shape, np.dtype(dtype), buffer=shm.buf))
+        shm.close()
+        shm.unlink()
+        leaves.append(NDArray(arr))
+    return _unflatten(tmpl, leaves)
+
+
 class DataLoader:
     """Loads batches from a Dataset (reference ``gluon.data.DataLoader``).
 
@@ -54,9 +170,14 @@ class DataLoader:
     def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
                  last_batch=None, batch_sampler=None, batchify_fn=None,
                  num_workers=0, pin_memory=False, prefetch=None,
-                 thread_pool=False, timeout=120):
+                 thread_pool=False, timeout=120, worker_type="thread"):
+        if worker_type not in ("thread", "process"):
+            raise MXNetError(f"bad worker_type {worker_type!r}")
+        self._worker_type = "thread" if thread_pool else worker_type
         self._dataset = dataset
         self._timeout = timeout
+        self._pool = None
+        self._iter_lock = threading.Lock()
         if batch_sampler is None:
             if batch_size is None:
                 raise MXNetError(
@@ -92,7 +213,141 @@ class DataLoader:
             for indices in self._batch_sampler:
                 yield self._fetch(indices)
             return
+        if self._worker_type == "process":
+            yield from self._process_iter()
+            return
         yield from self._threaded_iter()
+
+    # --- process pool -------------------------------------------------------
+
+    def _ensure_pool(self):
+        """Spawn the persistent worker pool on first use (the reference
+        also keeps its pool for the DataLoader's lifetime)."""
+        if self._pool is not None:
+            return self._pool
+        import multiprocessing as mp
+
+        ctx = mp.get_context("spawn")
+        payload = pickle.dumps((self._dataset, self._batchify_fn))
+        index_q = ctx.Queue()
+        result_q = ctx.Queue()
+        procs = []
+        for wid in range(self._num_workers):
+            p = ctx.Process(target=_process_worker_loop,
+                            args=(payload, index_q, result_q, wid),
+                            daemon=True)
+            p.start()
+            procs.append(p)
+        self._pool = (procs, index_q, result_q)
+        return self._pool
+
+    def _process_iter(self):
+        if not self._iter_lock.acquire(blocking=False):
+            raise MXNetError("process-mode DataLoader supports one active "
+                             "iterator at a time")
+        buffered = {}
+        try:
+            procs, index_q, result_q = self._ensure_pool()
+            self._epoch = epoch = getattr(self, "_epoch", 0) + 1
+            batches = list(self._batch_sampler)
+            window = max(self._prefetch, self._num_workers)
+            submitted = 0
+            for _ in range(min(window, len(batches))):
+                index_q.put((epoch, submitted, list(batches[submitted])))
+                submitted += 1
+            import time as _time
+
+            for i in range(len(batches)):
+                deadline = _time.monotonic() + self._timeout
+                while i not in buffered:
+                    try:
+                        ep, j, tmpl, metas, err = result_q.get(timeout=1.0)
+                    except _queue.Empty:
+                        dead = [p for p in procs if not p.is_alive()]
+                        if dead:
+                            raise MXNetError(
+                                f"{len(dead)} DataLoader worker(s) died "
+                                f"(exitcode {dead[0].exitcode}). Spawned "
+                                "workers re-import __main__: scripts "
+                                "using worker_type='process' must guard "
+                                "their entry point with "
+                                "if __name__ == '__main__':")
+                        if _time.monotonic() > deadline:
+                            raise MXNetError(
+                                f"DataLoader worker timeout after "
+                                f"{self._timeout}s (batch {i})")
+                        continue
+                    if ep != epoch:  # abandoned-epoch leftovers
+                        _free_metas(metas)
+                        continue
+                    if err is not None:
+                        raise MXNetError(
+                            f"DataLoader worker failed on batch {j}:\n"
+                            f"{err}")
+                    buffered[j] = (tmpl, metas)
+                tmpl, metas = buffered.pop(i)
+                if submitted < len(batches):
+                    index_q.put((epoch, submitted,
+                                 list(batches[submitted])))
+                    submitted += 1
+                yield _attach_result(tmpl, metas)
+        finally:
+            # free every result this epoch will never consume: buffered
+            # ones and whatever already landed in the queue
+            for tmpl, metas in buffered.values():
+                _free_metas(metas)
+            while self._pool is not None:
+                try:
+                    _ep, _j, _tmpl, metas, err = \
+                        self._pool[2].get_nowait()
+                except Exception:
+                    break
+                if err is None:
+                    _free_metas(metas)
+            self._iter_lock.release()
+
+    def close(self):
+        """Shut the worker pool down (also runs at GC), freeing any
+        in-flight shared-memory results."""
+        if self._pool is None:
+            return
+        procs, index_q, result_q = self._pool
+        self._pool = None
+        for _ in procs:
+            try:
+                index_q.put(None)
+            except Exception:
+                pass
+        import time as _time
+
+        deadline = _time.monotonic() + 10
+        while any(p.is_alive() for p in procs) and \
+                _time.monotonic() < deadline:
+            # workers may still be finishing queued jobs: free their
+            # results so the shm blocks don't outlive the process
+            try:
+                _ep, _j, _tmpl, metas, err = result_q.get(timeout=0.2)
+                if err is None:
+                    _free_metas(metas)
+            except Exception:
+                pass
+        for p in procs:
+            p.join(timeout=1)
+            if p.is_alive():
+                p.terminate()
+        while True:  # final sweep of the result queue
+            try:
+                _ep, _j, _tmpl, metas, err = result_q.get_nowait()
+                if err is None:
+                    _free_metas(metas)
+            except Exception:
+                break
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
 
     def _threaded_iter(self):
         """Ordered parallel fetch: workers fill per-batch slots, the

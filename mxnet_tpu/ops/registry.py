@@ -144,7 +144,7 @@ def accum_dtype(dt):
 # backend performance hints, which legacy MXNet-exported json checkpoints
 # carry on conv/pool/BN nodes and which have no TPU meaning.
 _COMMON_ATTRS = frozenset(["name", "attr", "num_args", "num_outputs",
-                           "__layout__",
+                           "__layout__", "layout",
                            "workspace", "cudnn_tune", "cudnn_off"])
 
 

@@ -75,6 +75,10 @@ def _mx_reshape_target(in_shape, spec):
             d1, d2 = spec[j + 1], spec[j + 2]
             if d1 == -1 and d2 == -1:
                 raise MXNetError("reshape -4: both split factors are -1")
+            if (d1 != -1 and d1 <= 0) or (d2 != -1 and d2 <= 0):
+                raise MXNetError(
+                    f"reshape -4: split factors must be positive or -1, "
+                    f"got ({d1}, {d2})")
             if d1 == -1:
                 d1 = d // d2
             if d2 == -1:

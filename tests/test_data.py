@@ -273,3 +273,124 @@ def test_dataloader_threaded_matches_serial():
     assert len(serial) == len(threaded)
     for a, b in zip(serial, threaded):
         np.testing.assert_array_equal(a, b)
+
+
+# --- process-based workers (shared-memory handoff) --------------------------
+
+class _SquareDataset:
+    """Picklable dataset with a CPU-bound python transform."""
+
+    def __init__(self, n):
+        self._x = np.arange(n * 3, dtype=np.float32).reshape(n, 3)
+
+    def __getitem__(self, i):
+        return self._x[i] ** 2, np.float32(i)
+
+    def __len__(self):
+        return len(self._x)
+
+
+def test_dataloader_process_matches_serial():
+    from mxnet_tpu.gluon.data import DataLoader
+
+    ds = _SquareDataset(21)
+    serial = [(d.asnumpy(), l.asnumpy())
+              for d, l in DataLoader(ds, batch_size=4)]
+    loader = DataLoader(ds, batch_size=4, num_workers=2,
+                        worker_type="process")
+    try:
+        proc = [(d.asnumpy(), l.asnumpy()) for d, l in loader]
+        assert len(serial) == len(proc)
+        for (a, al), (b, bl) in zip(serial, proc):
+            np.testing.assert_array_equal(a, b)
+            np.testing.assert_array_equal(al, bl)
+        # epoch 2 reuses the persistent pool, same order
+        proc2 = [(d.asnumpy(), l.asnumpy()) for d, l in loader]
+        for (a, al), (b, bl) in zip(serial, proc2):
+            np.testing.assert_array_equal(a, b)
+    finally:
+        loader.close()
+
+
+class _FailingDataset:
+    def __getitem__(self, i):
+        if i == 5:
+            raise ValueError("boom at 5")
+        return np.zeros(2, np.float32)
+
+    def __len__(self):
+        return 8
+
+
+def test_dataloader_process_error_propagates():
+    import pytest
+
+    from mxnet_tpu.gluon.data import DataLoader
+
+    loader = DataLoader(_FailingDataset(), batch_size=2, num_workers=2,
+                        worker_type="process")
+    try:
+        with pytest.raises(mx.MXNetError, match="boom at 5"):
+            list(loader)
+    finally:
+        loader.close()
+
+
+def test_dataloader_thread_pool_flag_forces_threads():
+    from mxnet_tpu.gluon.data import ArrayDataset, DataLoader
+
+    x = np.arange(12, dtype=np.float32).reshape(6, 2)
+    loader = DataLoader(ArrayDataset(nd.array(x)), batch_size=2,
+                        num_workers=2, worker_type="process",
+                        thread_pool=True)
+    assert loader._worker_type == "thread"
+    out = [b.asnumpy() for b in loader]
+    np.testing.assert_array_equal(np.concatenate(out), x)
+
+
+def test_dataloader_process_abandoned_epoch_no_poison():
+    """Breaking out mid-epoch must not leak the old epoch's batches into
+    the next iteration (epoch-tagged jobs/results)."""
+    from mxnet_tpu.gluon.data import DataLoader
+
+    ds = _SquareDataset(24)
+    loader = DataLoader(ds, batch_size=4, num_workers=2,
+                        worker_type="process", prefetch=4)
+    try:
+        it = iter(loader)
+        next(it)  # abandon with jobs still queued/in flight
+        del it
+        serial = [(d.asnumpy(), l.asnumpy())
+                  for d, l in DataLoader(ds, batch_size=4)]
+        again = [(d.asnumpy(), l.asnumpy()) for d, l in loader]
+        assert len(serial) == len(again)
+        for (a, al), (b, bl) in zip(serial, again):
+            np.testing.assert_array_equal(a, b)
+            np.testing.assert_array_equal(al, bl)
+    finally:
+        loader.close()
+
+
+def test_dataloader_process_no_shm_leak():
+    """Every shared-memory block is unlinked, including abandoned-epoch
+    and shutdown-time results."""
+    import glob
+    import time
+
+    from mxnet_tpu.gluon.data import DataLoader
+
+    before = set(glob.glob("/dev/shm/*"))
+    ds = _SquareDataset(32)
+    loader = DataLoader(ds, batch_size=4, num_workers=2,
+                        worker_type="process", prefetch=6)
+    it = iter(loader)
+    next(it)
+    del it          # abandoned epoch: leftovers freed on next use/close
+    list(loader)    # full epoch
+    loader.close()  # shutdown drains in-flight results
+    for _ in range(50):
+        leaked = set(glob.glob("/dev/shm/*")) - before
+        if not leaked:
+            break
+        time.sleep(0.1)
+    assert not leaked, f"leaked shm segments: {leaked}"
