@@ -3,7 +3,9 @@
 Reference posture: the C++ ImageRecordIter (src/io/iter_image_recordio_2.cc)
 exists so JPEG decode + augmentation never starve the GPUs; the equivalent
 TPU question is whether this python/cv2 pipeline sustains more images/sec
-than the ResNet-50 train step consumes (BENCH ~3000+ img/s/chip).
+than the ResNet-50 train step consumes (BENCH ~4,900 img/s/chip).  Decode
+scales with cores: this box's throughput × its core count bounds what a
+real TPU-VM host (100+ cores) sustains.
 
 Writes a synthetic .rec of REAL encoded JPEGs, then measures:
   1. ImageRecordIter decode+augment+batch throughput (thread prefetch)
@@ -47,7 +49,7 @@ def make_recfile(path_prefix, n, size):
     return path_prefix + ".rec"
 
 
-def bench_record_iter(rec, n, size, batch_size, threads):
+def bench_record_iter(rec, size, batch_size, threads):
     from mxnet_tpu.io import ImageRecordIter
 
     it = ImageRecordIter(path_imgrec=rec, data_shape=(3, size, size),
@@ -89,7 +91,7 @@ def main(argv=None):
     p.add_argument("--size", type=int, default=224)
     p.add_argument("--batch-size", type=int, default=64)
     p.add_argument("--workers", type=int, default=os.cpu_count() or 4)
-    p.add_argument("--target", type=float, default=3500.0,
+    p.add_argument("--target", type=float, default=4900.0,
                    help="img/s the train step consumes (BENCH resnet50)")
     args = p.parse_args(argv)
 
@@ -98,7 +100,7 @@ def main(argv=None):
                            args.size)
         results = {}
         results["image_record_iter"] = bench_record_iter(
-            rec, args.images, args.size, args.batch_size, args.workers)
+            rec, args.size, args.batch_size, args.workers)
         results["dataloader_thread"] = bench_dataloader(
             rec, args.size, args.batch_size, args.workers, "thread")
         results["dataloader_process"] = bench_dataloader(

@@ -178,6 +178,17 @@ def _attr_validated(fn, opname):
             raise MXNetError(
                 f"operator {opname!r} got unknown attribute(s) "
                 f"{sorted(unknown)}; accepted: {sorted(named)}")
+        if "layout" in kwargs and "layout" not in named:
+            # tolerated only as the channel-first default the op already
+            # implements; a channels-last request must NOT be swallowed
+            # (it would silently pool/conv over the wrong axes)
+            v = kwargs["layout"]
+            if v is not None and str(v) not in ("NCHW", "NCW", "NCDHW"):
+                from ..base import MXNetError
+
+                raise MXNetError(
+                    f"operator {opname!r} does not implement "
+                    f"layout={v!r} (channel-first only)")
         return fn(*args, **kwargs)
 
     return wrapper

@@ -379,7 +379,7 @@ def test_dataloader_process_no_shm_leak():
 
     from mxnet_tpu.gluon.data import DataLoader
 
-    before = set(glob.glob("/dev/shm/*"))
+    before = set(glob.glob("/dev/shm/psm_*"))
     ds = _SquareDataset(32)
     loader = DataLoader(ds, batch_size=4, num_workers=2,
                         worker_type="process", prefetch=6)
@@ -389,7 +389,7 @@ def test_dataloader_process_no_shm_leak():
     list(loader)    # full epoch
     loader.close()  # shutdown drains in-flight results
     for _ in range(50):
-        leaked = set(glob.glob("/dev/shm/*")) - before
+        leaked = set(glob.glob("/dev/shm/psm_*")) - before
         if not leaked:
             break
         time.sleep(0.1)

@@ -237,3 +237,12 @@ def test_linalg_gemm_axis():
     want = np.moveaxis(np.moveaxis(a, 0, -2) @ np.moveaxis(b, 0, -2)
                        + np.moveaxis(c, 0, -2), -2, 0)
     np.testing.assert_allclose(out, want, rtol=1e-5)
+
+
+def test_channels_last_layout_rejected_not_swallowed():
+    """layout is tolerated only at its channel-first default; NHWC on an
+    op without a layout param must raise, not silently mis-pool."""
+    x = nd.ones((1, 2, 4, 4))
+    nd.pooling(x, kernel=(2, 2), layout="NCHW")  # default: fine
+    with pytest.raises(mx.MXNetError, match="channel-first"):
+        nd.pooling(x, kernel=(2, 2), layout="NHWC")
