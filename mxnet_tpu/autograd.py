@@ -25,10 +25,11 @@ Semantics preserved from the reference:
     backward calls, ``'write'`` overwrites.
   * ``retain_graph=False`` frees the tape (residuals) after one backward.
 
-Known departures (documented, revisit in later rounds):
-  * ``create_graph=True`` (higher-order grad) is not yet supported; the
-    reference supports it for a subset of ops only (tests
-    ``tests/python/unittest/test_higher_order_grad.py:?``).
+  * ``create_graph=True`` (higher-order grad) IS supported — backward
+    itself runs through the tape (``_backward_taped``), so grad-of-grad
+    composes for every differentiable op; the reference only supports a
+    per-op subset (tests ``tests/python/unittest/test_higher_order_grad
+    .py:?``, here tests/test_autograd.py).
 """
 from __future__ import annotations
 

@@ -47,14 +47,18 @@ class Context:
         on first use)."""
         import jax
 
+        # LOCAL devices only: in a multi-process group jax.devices() is
+        # the global list, and a context on another host's device would
+        # device_put to a non-addressable target (and desync the
+        # process-collective bookkeeping).  Single-process, local==global.
         if self.device_type == "cpu":
-            devs = jax.devices("cpu")
+            devs = jax.local_devices(backend="cpu")
         else:
             # 'tpu' and the 'gpu' compat alias both mean "the accelerator
             # backend jax booted with" — under JAX_PLATFORMS=cpu that is the
             # (virtual) CPU device list, which is exactly what the unit-test
             # mesh wants.
-            devs = jax.devices()
+            devs = jax.local_devices()
         if self.device_id >= len(devs):
             raise MXNetError(
                 f"context {self} out of range: only {len(devs)} "

@@ -216,16 +216,110 @@ def replicate_block_params(block, mesh=None):
 
 
 def all_sum(arrays, mesh=None):
-    """Eager cross-replica sum: for a replicated-layout array this is the
-    identity (XLA already reduced it); for host-local shards it runs one
-    jitted psum.  The building block of the eager KVStore path."""
+    """Eager cross-replica gradient sum (the building block of the eager
+    KVStore path).
+
+    Single-process: GSPMD backward delivers gradients already reduced
+    over the mesh, so this VERIFIES the replicated layout and passes
+    through — a partitioned (non-replicated) gradient here is a layout
+    bug and raises rather than training silently wrong.
+
+    Multi-process (``jax.process_count() > 1``): gradients are
+    host-local arrays; all ranks must call this collectively (SPMD).
+    Per dtype, gradients are flattened into ONE global (n, F) array over
+    a process-axis mesh and summed with a single jitted psum — the
+    ps-lite allreduce hop, ridden over ICI/DCN collectives."""
     import jax
+    import numpy as onp
 
     if isinstance(arrays, NDArray):
         arrays = [arrays]
-    # arrays produced by GSPMD backward are already globally reduced;
-    # verify layout and pass through.
-    return arrays
+
+    def _verify_reduced(raw):
+        sh = getattr(raw, "sharding", None)
+        if sh is not None and len(sh.device_set) > 1 and \
+                not sh.is_fully_replicated:
+            raise MXNetError(
+                "all_sum: gradient is partitioned, not replicated — "
+                "GSPMD backward delivers grads pre-reduced, so a "
+                "partial per-device gradient indicates a sharding "
+                "bug upstream")
+
+    def _spans_processes(raw):
+        sh = getattr(raw, "sharding", None)
+        if sh is None:
+            return False
+        return len({d.process_index for d in sh.device_set}) > 1
+
+    n = jax.process_count()
+    if n == 1:
+        for a in arrays:
+            _verify_reduced(a._data if isinstance(a, NDArray) else a)
+        return arrays
+
+    raws = [a._data if isinstance(a, NDArray) else a for a in arrays]
+    out = list(arrays)
+    # grads living on a process-spanning global mesh were already psummed
+    # in-jit by GSPMD — summing them again would scale by n
+    local_idx = []
+    for i, r in enumerate(raws):
+        if _spans_processes(r):
+            _verify_reduced(r)
+        else:
+            local_idx.append(i)
+    if not local_idx:
+        return out
+
+    pmesh, summed_fn = _process_psum(n)
+    by_dtype = {}
+    for i in local_idx:
+        by_dtype.setdefault(onp.dtype(raws[i].dtype).name, []).append(i)
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    sharding = NamedSharding(pmesh, PartitionSpec("dp", None))
+    for _dtype, idxs in sorted(by_dtype.items()):
+        flat = onp.concatenate(
+            [onp.asarray(raws[i]).ravel() for i in idxs])[None]
+        garr = jax.make_array_from_process_local_data(sharding, flat)
+        vec = onp.asarray(summed_fn(garr).addressable_data(0))[0]
+        off = 0
+        for i in idxs:
+            size = raws[i].size
+            # back onto the source grad's own placement (no default-
+            # device bounce on the optimizer's hot path)
+            out[i] = NDArray(jax.device_put(
+                vec[off:off + size].reshape(raws[i].shape),
+                raws[i].sharding))
+            off += size
+    return out
+
+
+_PROCESS_PSUM_CACHE = {}
+
+
+def _process_psum(n):
+    """(mesh, jitted psum) over a one-device-per-process 'dp' axis,
+    memoized so the hot training loop never retraces the collective."""
+    import jax
+    import numpy as onp
+
+    per_proc = {}
+    for d in jax.devices():
+        per_proc.setdefault(d.process_index, d)
+    devs = tuple(per_proc[i] for i in range(n))
+    key = tuple(d.id for d in devs)
+    hit = _PROCESS_PSUM_CACHE.get(key)
+    if hit is not None:
+        return hit
+    from jax.sharding import PartitionSpec
+
+    pmesh = jax.sharding.Mesh(onp.asarray(devs), ("dp",))
+    fn = jax.jit(jax.shard_map(
+        lambda x: jax.lax.psum(x, "dp"), mesh=pmesh,
+        in_specs=PartitionSpec("dp", None),
+        out_specs=PartitionSpec("dp", None)))
+    _PROCESS_PSUM_CACHE[key] = (pmesh, fn)
+    return pmesh, fn
 
 
 class TPUSyncKVStore:
@@ -250,11 +344,15 @@ class TPUSyncKVStore:
         self._compression = None
         self._residuals = {}
 
-    # Trainer hook: gradients are already globally reduced by GSPMD.
-    # With compression enabled, quantize them here (per-param residual) so
-    # dist_tpu_sync training sees exactly what the reference's compressed
-    # worker→server hop would deliver.
+    # Trainer hook.  Single-process: gradients are already globally
+    # reduced by GSPMD (the in-jit psum) — nothing to move.  Multi-
+    # process: each rank holds host-local gradients; sum them with one
+    # collective per dtype (parallel.all_sum).  With compression
+    # enabled, quantize BEFORE the cross-host hop (per-param residual),
+    # exactly what the reference's compressed worker→server hop delivers.
     def allreduce_grads(self, params):
+        import jax
+
         if self._compression is not None:
             for p in params:
                 # list_grad repeats the SAME handle per ctx — dedupe so
@@ -263,6 +361,15 @@ class TPUSyncKVStore:
                     q, self._residuals[p.name] = self._compression.roundtrip(
                         g, self._residuals.get(p.name))
                     g._data = q._data
+        if jax.process_count() > 1:
+            grads, seen = [], set()
+            for p in params:
+                for g in {id(g): g for g in p.list_grad()}.values():
+                    if id(g) not in seen:
+                        seen.add(id(g))
+                        grads.append(g)
+            for g, s in zip(grads, all_sum(grads)):
+                g._data = s._data.astype(g._data.dtype)
         return params
 
     @property

@@ -24,6 +24,26 @@ jax.config.update("jax_enable_x64", True)
 
 import pytest  # noqa: E402
 
+# Fast CI lane: the heavyweight model/system suites carry the 'slow'
+# marker so `pytest -m "not slow"` is a <5-min core lane (ops, autograd,
+# gluon fundamentals, data plane, serialization, kvstore), while the
+# default full run keeps everything.  Module-level marking keeps the
+# split in one place.
+_SLOW_MODULES = {
+    "test_llama", "test_model_zoo", "test_nlp_models",
+    "test_detection_models", "test_operator_sweep", "test_quantization",
+    "test_module", "test_moe", "test_ring", "test_parallel",
+    "test_onnx", "test_dist_loopback", "test_nightly_large",
+    "test_model", "test_rnn", "test_contrib_gluon", "test_fm",
+    "test_contrib",
+}
+
+
+def pytest_collection_modifyitems(items):
+    for item in items:
+        if item.module.__name__ in _SLOW_MODULES:
+            item.add_marker(pytest.mark.slow)
+
 
 @pytest.fixture(autouse=True)
 def _seed():

@@ -93,3 +93,93 @@ def test_jax_distributed_loopback_psum(tmp_path):
     assert rc == 0
     for i in range(n):
         assert os.path.exists(out + str(i)), f"worker {i} did not finish"
+
+
+_TRAIN_WORKER = r"""
+import os
+import sys
+sys.path.insert(0, os.environ["REPO_ROOT"])
+os.environ.pop("XLA_FLAGS", None)
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd, parallel
+
+parallel.initialize()
+rank, n = jax.process_index(), jax.process_count()
+
+mx.random.seed(42)
+net = gluon.nn.Dense(3, use_bias=True)
+net.initialize(mx.init.Xavier())
+net(nd.ones((1, 5)))
+trainer = gluon.Trainer(net.collect_params(), "sgd",
+                        {"learning_rate": 0.1, "momentum": 0.9},
+                        kvstore="dist_tpu_sync")
+
+full = np.random.RandomState(0).randn(8, 5).astype(np.float32)
+shard = full[rank * 4:(rank + 1) * 4]          # disjoint per-rank data
+x = nd.array(shard)
+for _ in range(4):
+    with autograd.record():
+        loss = (net(x) ** 2).sum()             # sum-loss: step() rescales
+    loss.backward()
+    trainer.step(8)                            # GLOBAL batch size
+assert trainer._kvstore.num_workers == n
+np.save(os.environ["OUT_FILE"] + str(rank) + ".npy",
+        np.concatenate([net.weight.data().asnumpy().ravel(),
+                        net.bias.data().asnumpy().ravel()]))
+"""
+
+
+@pytest.mark.skipif(sys.platform != "linux", reason="loopback group")
+def test_two_process_dist_sync_trainer_matches_single(tmp_path):
+    """The dist_sync_kvstore.py analog (SURVEY §4): a full 2-process
+    dist_tpu_sync Trainer run — per-rank disjoint shards, cross-host
+    gradient psum — must leave BYTE-IDENTICAL params on both ranks, equal
+    to a single-process run over the concatenated batch."""
+    import signal
+
+    import numpy as np
+
+    script = tmp_path / "train_worker.py"
+    script.write_text(_TRAIN_WORKER)
+    out = str(tmp_path / "params")
+    env = dict(os.environ)
+    env["OUT_FILE"] = out
+    env["MXT_LAUNCH_PLATFORM"] = "cpu"
+    env["REPO_ROOT"] = os.path.join(os.path.dirname(__file__), "..")
+    n = 2
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(TOOLS, "launch.py"), "-n", str(n),
+         "--coordinator", f"127.0.0.1:{_free_port()}",
+         sys.executable, str(script)], env=env, start_new_session=True)
+    try:
+        rc = proc.wait(timeout=240)
+    except subprocess.TimeoutExpired:
+        os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+        raise
+    assert rc == 0
+    got = [np.load(out + f"{i}.npy") for i in range(n)]
+    assert got[0].tobytes() == got[1].tobytes(), "ranks diverged"
+
+    # single-process oracle over the concatenated batch
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon, nd
+
+    mx.random.seed(42)
+    net = gluon.nn.Dense(3, use_bias=True)
+    net.initialize(mx.init.Xavier())
+    net(nd.ones((1, 5)))
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1, "momentum": 0.9})
+    x = nd.array(np.random.RandomState(0).randn(8, 5).astype(np.float32))
+    for _ in range(4):
+        with autograd.record():
+            loss = (net(x) ** 2).sum()
+        loss.backward()
+        trainer.step(8)
+    want = np.concatenate([net.weight.data().asnumpy().ravel(),
+                           net.bias.data().asnumpy().ravel()])
+    np.testing.assert_allclose(got[0], want, rtol=1e-5, atol=1e-6)
