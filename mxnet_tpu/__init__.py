@@ -37,6 +37,7 @@ from .ndarray import NDArray
 from . import autograd
 from . import random
 from . import ops
+from . import engine
 
 # subsystems imported lazily on attribute access to keep `import mxnet_tpu`
 # fast (the reference generates op wrappers at import; we defer heavyweight

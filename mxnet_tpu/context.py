@@ -29,7 +29,7 @@ class Context:
     device_type : str
         'cpu', 'tpu' or 'gpu' ('gpu' aliases the default jax accelerator).
     device_id : int
-        Index into ``jax.devices(backend)``.
+        Index into this process's ``jax.local_devices(backend)``.
     """
 
     _local = threading.local()
@@ -134,12 +134,22 @@ def current_context() -> Context:
 
 
 def num_devices(device_type: Optional[str] = None) -> int:
-    """Reference analog: ``mx.context.num_gpus()``."""
+    """Reference analog: ``mx.context.num_gpus()`` — counts THIS
+    process's devices (like CUDA device enumeration), so the canonical
+    ``[mx.tpu(i) for i in range(num_devices())]`` idiom stays valid in
+    multi-process groups.  Use ``global_num_devices`` for mesh math."""
     import jax
 
     if device_type == "cpu":
-        return len(jax.devices("cpu"))
-    return len(jax.devices())
+        return len(jax.local_devices(backend="cpu"))
+    return len(jax.local_devices())
+
+
+def global_num_devices() -> int:
+    """Total devices across the process group (``jax.device_count()``)."""
+    import jax
+
+    return jax.device_count()
 
 
 def num_gpus() -> int:  # compat shim; counts accelerator devices

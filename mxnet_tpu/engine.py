@@ -1,0 +1,83 @@
+"""Execution-engine debug levers.
+
+Reference: ``src/engine/`` — ``MXNET_ENGINE_TYPE`` selects
+``ThreadedEnginePerDevice`` (default), ``ThreadedEngine`` or
+``NaiveEngine`` (fully serial; THE lever for bisecting async/scheduling
+bugs: errors surface at the faulting op with a usable stack), plus
+``python/mxnet/engine.py`` bulk-execution hooks.
+
+TPU analog: XLA's async dispatch plays the threaded engine's role, and
+``jit`` plays bulking.  ``NaiveEngine`` here means
+
+- ``hybridize()`` becomes a no-op (no CachedOp jit): every op runs
+  imperatively, so a failure's python stack names the exact op/block;
+- every op dispatch blocks until the result is ready
+  (``jax.block_until_ready``), so device errors surface at the op that
+  caused them instead of a later sync point;
+- the Trainer's fused multi-tensor optimizer update falls back to
+  per-parameter eager updates.
+
+Select with ``MXT_ENGINE_TYPE=NaiveEngine`` (``MXNET_ENGINE_TYPE`` is
+honoured too) or :func:`set_engine_type` at runtime.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+
+from .base import MXNetError
+
+__all__ = ["engine_type", "set_engine_type", "is_naive", "bulk",
+           "set_bulk_size"]
+
+_TYPES = ("ThreadedEnginePerDevice", "ThreadedEngine", "NaiveEngine")
+_type = None
+
+
+def engine_type():
+    global _type
+    if _type is None:
+        _type = os.environ.get(
+            "MXT_ENGINE_TYPE",
+            os.environ.get("MXNET_ENGINE_TYPE", _TYPES[0]))
+        if _type not in _TYPES:
+            raise MXNetError(f"unknown engine type {_type!r}; "
+                             f"one of {_TYPES}")
+    return _type
+
+
+def set_engine_type(name):
+    """Runtime override (tests / debugging sessions)."""
+    global _type
+    if name not in _TYPES:
+        raise MXNetError(f"unknown engine type {name!r}; one of {_TYPES}")
+    _type = name
+    return name
+
+
+def is_naive():
+    return engine_type() == "NaiveEngine"
+
+
+# --- reference python/mxnet/engine.py bulk hooks ----------------------------
+
+_bulk_size = 15  # reference default (MXNET_ENGINE_BULK_SIZE_*)
+
+
+def set_bulk_size(size):
+    """Reference tunes how many async ops the engine groups; XLA's jit IS
+    the bulking mechanism here, so this records and returns the previous
+    value for API compatibility."""
+    global _bulk_size
+    prev = _bulk_size
+    _bulk_size = int(size)
+    return prev
+
+
+@contextlib.contextmanager
+def bulk(size):
+    prev = set_bulk_size(size)
+    try:
+        yield
+    finally:
+        set_bulk_size(prev)

@@ -563,7 +563,9 @@ class HybridBlock(Block):
         return self.hybrid_forward(nd, *args, **params)
 
     def forward(self, *args):
-        if self._active and not _is_tracing():
+        from .. import engine as _engine
+
+        if self._active and not _is_tracing() and not _engine.is_naive():
             if self._cached_op is None:
                 self._cached_op = CachedOp(self, **self._flags)
             return self._cached_op(*args)

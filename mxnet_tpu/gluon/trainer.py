@@ -227,8 +227,11 @@ class Trainer:
     # fuses across tensors.  lr/wd/t enter as traced scalars so LR
     # schedules don't retrace.
     def _try_fused_update(self):
+        from .. import engine
         from ..ndarray import sparse as sp
 
+        if engine.is_naive():
+            return False  # NaiveEngine: per-param eager updates
         optzr = self._optimizer
         if type(optzr)._step is opt.Optimizer._step:
             return False  # optimizer has no pure step rule

@@ -95,6 +95,22 @@ def apply_op(fun: Callable, *nd_args, name: str = ""):
         outs, vjp = jax.vjp(fun, *raws)
     else:
         outs = fun(*raws)
+    from .. import engine as _engine
+
+    if _engine.is_naive():
+        # NaiveEngine: synchronous dispatch — device errors surface HERE,
+        # at the op that caused them, with this op's name in the stack.
+        # (Tracers pass through: export/vjp tracing has no async result.)
+        flat = outs if isinstance(outs, (tuple, list)) else [outs]
+        if not any(isinstance(o, jax.core.Tracer) for o in flat):
+            from ..base import MXNetError
+
+            try:
+                jax.block_until_ready(outs)
+            except Exception as e:
+                raise MXNetError(
+                    f"operator {name or 'op'!r} failed under NaiveEngine "
+                    f"(synchronous) dispatch: {e}") from e
     if prof is not None:
         prof.record_op_event(prof.current_scope_prefix() + (name or "op"),
                              time.perf_counter() - t0)

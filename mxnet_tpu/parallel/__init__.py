@@ -215,35 +215,30 @@ def replicate_block_params(block, mesh=None):
     return block
 
 
-def all_sum(arrays, mesh=None):
+def all_sum(arrays):
     """Eager cross-replica gradient sum (the building block of the eager
     KVStore path).
 
-    Single-process: GSPMD backward delivers gradients already reduced
-    over the mesh, so this VERIFIES the replicated layout and passes
-    through — a partitioned (non-replicated) gradient here is a layout
-    bug and raises rather than training silently wrong.
+    Single-process: pass-through by construction — GSPMD backward
+    delivers every gradient already reduced over the mesh in the layout
+    its parameter dictates (fully replicated for DP params, partitioned
+    for TP-sharded params; both are the REDUCED value, so there is
+    nothing left to sum and no local property distinguishes a correct
+    partitioned grad from a wrong one).
 
-    Multi-process (``jax.process_count() > 1``): gradients are
-    host-local arrays; all ranks must call this collectively (SPMD).
-    Per dtype, gradients are flattened into ONE global (n, F) array over
-    a process-axis mesh and summed with a single jitted psum — the
-    ps-lite allreduce hop, ridden over ICI/DCN collectives."""
+    Multi-process (``jax.process_count() > 1``): host-LOCAL gradients
+    (sharding confined to this process) are flattened per dtype into ONE
+    global (n, F) array over a process-axis mesh and summed with a
+    single memoized jitted psum — the ps-lite allreduce hop, ridden over
+    ICI/DCN collectives.  Gradients whose sharding already spans
+    processes were reduced in-jit by GSPMD and pass through (summing
+    them again would scale by n).  All ranks must call this collectively
+    (SPMD)."""
     import jax
     import numpy as onp
 
     if isinstance(arrays, NDArray):
         arrays = [arrays]
-
-    def _verify_reduced(raw):
-        sh = getattr(raw, "sharding", None)
-        if sh is not None and len(sh.device_set) > 1 and \
-                not sh.is_fully_replicated:
-            raise MXNetError(
-                "all_sum: gradient is partitioned, not replicated — "
-                "GSPMD backward delivers grads pre-reduced, so a "
-                "partial per-device gradient indicates a sharding "
-                "bug upstream")
 
     def _spans_processes(raw):
         sh = getattr(raw, "sharding", None)
@@ -253,20 +248,11 @@ def all_sum(arrays, mesh=None):
 
     n = jax.process_count()
     if n == 1:
-        for a in arrays:
-            _verify_reduced(a._data if isinstance(a, NDArray) else a)
-        return arrays
+        return list(arrays)
 
     raws = [a._data if isinstance(a, NDArray) else a for a in arrays]
     out = list(arrays)
-    # grads living on a process-spanning global mesh were already psummed
-    # in-jit by GSPMD — summing them again would scale by n
-    local_idx = []
-    for i, r in enumerate(raws):
-        if _spans_processes(r):
-            _verify_reduced(r)
-        else:
-            local_idx.append(i)
+    local_idx = [i for i, r in enumerate(raws) if not _spans_processes(r)]
     if not local_idx:
         return out
 
@@ -364,7 +350,7 @@ class TPUSyncKVStore:
         if jax.process_count() > 1:
             grads, seen = [], set()
             for p in params:
-                for g in {id(g): g for g in p.list_grad()}.values():
+                for g in p.list_grad():
                     if id(g) not in seen:
                         seen.add(id(g))
                         grads.append(g)

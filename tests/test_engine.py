@@ -1,0 +1,97 @@
+"""NaiveEngine debug-lever tests (reference: MXNET_ENGINE_TYPE=NaiveEngine
+serial engine, the bisection tool for async/scheduling bugs — SURVEY §5
+race-detection row)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, engine, gluon, nd
+
+
+@pytest.fixture
+def naive():
+    prev = engine.engine_type()
+    engine.set_engine_type("NaiveEngine")
+    yield
+    engine.set_engine_type(prev)
+
+
+def _train(n_steps=3):
+    mx.random.seed(5)
+    net = gluon.nn.Dense(3)
+    net.initialize(mx.init.Xavier())
+    net(nd.ones((1, 4)))
+    net.hybridize(static_alloc=True)
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1, "momentum": 0.9})
+    x = nd.array(np.random.RandomState(0).randn(4, 4).astype(np.float32))
+    for _ in range(n_steps):
+        with autograd.record():
+            loss = (net(x) ** 2).mean()
+        loss.backward()
+        trainer.step(4)
+    return net, net.weight.data().asnumpy()
+
+
+def test_naive_engine_matches_threaded(naive):
+    _, w_naive = _train()
+    engine.set_engine_type("ThreadedEnginePerDevice")
+    _, w_fast = _train()
+    np.testing.assert_allclose(w_naive, w_fast, rtol=1e-5, atol=1e-6)
+
+
+def test_naive_engine_bypasses_cached_op(naive):
+    net, _ = _train()
+    assert net._cached_op is None, "NaiveEngine must not build CachedOp"
+
+
+def test_threaded_engine_builds_cached_op():
+    net, _ = _train()
+    assert net._cached_op is not None
+
+
+def test_naive_engine_dispatch_is_synchronous(naive, monkeypatch):
+    """NaiveEngine must block on every op result (the mechanism that
+    surfaces device errors at the faulting op); threaded mode must not."""
+    import jax
+
+    calls = []
+    real = jax.block_until_ready
+
+    def spy(x):
+        calls.append(1)
+        return real(x)
+
+    monkeypatch.setattr(jax, "block_until_ready", spy)
+    nd.relu(nd.array(np.ones((2, 2), np.float32)))
+    assert calls, "naive dispatch did not block on the op result"
+
+    engine.set_engine_type("ThreadedEnginePerDevice")
+    calls.clear()
+    nd.relu(nd.array(np.ones((2, 2), np.float32)))
+    assert not calls, "threaded dispatch must stay asynchronous"
+
+
+def test_naive_engine_wraps_device_error(naive, monkeypatch):
+    """A failure surfacing at block_until_ready is rewrapped as MXNetError
+    naming the op."""
+    import jax
+
+    def boom(x):
+        raise RuntimeError("async device explosion")
+
+    monkeypatch.setattr(jax, "block_until_ready", boom)
+    with pytest.raises(mx.MXNetError, match="relu.*NaiveEngine"):
+        nd.relu(nd.array(np.ones((2, 2), np.float32)))
+
+
+def test_engine_type_validation():
+    with pytest.raises(mx.MXNetError):
+        engine.set_engine_type("WarpEngine")
+
+
+def test_bulk_compat():
+    prev = engine.set_bulk_size(30)
+    with engine.bulk(5):
+        pass
+    engine.set_bulk_size(prev)
