@@ -37,12 +37,13 @@ _type = None
 def engine_type():
     global _type
     if _type is None:
-        _type = os.environ.get(
+        env = os.environ.get(
             "MXT_ENGINE_TYPE",
             os.environ.get("MXNET_ENGINE_TYPE", _TYPES[0]))
-        if _type not in _TYPES:
-            raise MXNetError(f"unknown engine type {_type!r}; "
+        if env not in _TYPES:  # don't cache a bad value: raise EVERY call
+            raise MXNetError(f"unknown engine type {env!r}; "
                              f"one of {_TYPES}")
+        _type = env
     return _type
 
 

@@ -381,12 +381,13 @@ class _CachedGraph:
     (reference: CachedOp's per-(shape,dtype,stype) graph cache,
     src/imperative/cached_op.cc:?)."""
 
-    def __init__(self, block, params, training):
+    def __init__(self, block, params, training, remat=False):
         import jax
 
         self.block = block
         self.params = params
         self.training = training
+        self.remat = remat
         self.struct = None
         self.aux_idx = ()
         self._fwd = jax.jit(self._pure)
@@ -423,9 +424,14 @@ class _CachedGraph:
     def _record_fwd(self, p_raws, in_raws, key):
         import jax
 
-        outs, vjp, auxs = jax.vjp(
-            lambda p, x: self._pure(p, x, key), list(p_raws), list(in_raws),
-            has_aux=True)
+        fn = lambda p, x: self._pure(p, x, key)  # noqa: E731
+        if self.remat:
+            # activation checkpointing: backward recomputes the forward
+            # instead of holding every intermediate in HBM — the standard
+            # TPU trade of FLOPs for memory (enables much larger batches)
+            fn = jax.checkpoint(fn)
+        outs, vjp, auxs = jax.vjp(fn, list(p_raws), list(in_raws),
+                                  has_aux=True)
         return outs, auxs, vjp
 
     def run(self, args):
@@ -504,7 +510,8 @@ class CachedOp:
                tuple((p.shape, str(np.dtype(p.dtype))) for p in params))
         g = self._graphs.get(sig)
         if g is None:
-            g = _CachedGraph(self.block, params, training)
+            g = _CachedGraph(self.block, params, training,
+                             remat=bool(self.flags.get("remat", False)))
             self._graphs[sig] = g
         return g.run(args)
 

@@ -95,3 +95,13 @@ def test_bulk_compat():
     with engine.bulk(5):
         pass
     engine.set_bulk_size(prev)
+
+
+def test_bad_env_engine_type_raises_every_call(monkeypatch):
+    monkeypatch.setenv("MXT_ENGINE_TYPE", "naive")  # typo'd value
+    monkeypatch.setattr(engine, "_type", None)
+    with pytest.raises(mx.MXNetError):
+        engine.engine_type()
+    with pytest.raises(mx.MXNetError):  # not cached as accepted
+        engine.is_naive()
+    monkeypatch.setattr(engine, "_type", "ThreadedEnginePerDevice")

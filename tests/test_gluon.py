@@ -309,3 +309,34 @@ def test_hybridize_retrace_on_new_shape():
     b = net(nd.ones((5, 3)))
     assert a.shape == (2, 4) and b.shape == (5, 4)
     assert len(net._cached_op._graphs) == 2
+
+
+def test_hybridize_remat_matches_plain():
+    """remat=True (activation checkpointing) must change memory, not
+    math: identical outputs and gradients."""
+    import numpy as onp
+
+    from mxnet_tpu import autograd
+
+    def run(remat):
+        mx.random.seed(11)
+        net = nn.HybridSequential()
+        net.add(nn.Dense(16, activation="relu"),
+                nn.Dense(8, activation="relu"), nn.Dense(4))
+        net.initialize(mx.init.Xavier())
+        net(nd.ones((1, 5)))
+        net.hybridize(static_alloc=True, remat=remat)
+        x = nd.array(onp.random.RandomState(3).randn(6, 5)
+                     .astype(onp.float32))
+        x.attach_grad()
+        with autograd.record():
+            loss = (net(x) ** 2).sum()
+        loss.backward()
+        return (float(loss.asscalar()), x.grad.asnumpy(),
+                net[0].weight.grad().asnumpy())
+
+    l0, xg0, wg0 = run(False)
+    l1, xg1, wg1 = run(True)
+    assert l0 == pytest.approx(l1, rel=1e-6)
+    onp.testing.assert_allclose(xg0, xg1, rtol=1e-6)
+    onp.testing.assert_allclose(wg0, wg1, rtol=1e-6)
