@@ -110,11 +110,13 @@ def color_normalize(src, mean, std=None):
     return NDArray(arr) if isinstance(src, NDArray) else arr
 
 
-def augment_basic(img, data_shape, rng, mean=(0, 0, 0), std=(1, 1, 1),
-                  scale=1.0, rand_crop=False, rand_mirror=False, resize=-1):
-    """The ImageRecordIter augmentation chain (reference
-    src/io/image_aug_default.cc:?): resize-short → crop → mirror →
-    normalize → CHW."""
+def augment_geom(img, data_shape, rng, rand_crop=False, rand_mirror=False,
+                 resize=-1):
+    """The GEOMETRIC half of the ImageRecordIter augmentation chain
+    (resize-short → crop → mirror), kept host-side on uint8 where cv2 is
+    cheap.  Returns HWC uint8; the numeric half (scale/mean/std/CHW)
+    belongs on DEVICE so batches cross host→HBM as uint8 — 4× less
+    transfer than float32 (see ImageRecordIter._device_finish)."""
     import cv2
 
     if resize > 0:
@@ -130,6 +132,17 @@ def augment_basic(img, data_shape, rng, mean=(0, 0, 0), std=(1, 1, 1),
             img, _ = center_crop(img, (w, h))
     if rand_mirror and rng.rand() < 0.5:
         img = img[:, ::-1]
+    return img
+
+
+def augment_basic(img, data_shape, rng, mean=(0, 0, 0), std=(1, 1, 1),
+                  scale=1.0, rand_crop=False, rand_mirror=False, resize=-1):
+    """The full ImageRecordIter augmentation chain (reference
+    src/io/image_aug_default.cc:?): resize-short → crop → mirror →
+    normalize → CHW.  Host-side numpy; ImageRecordIter uses
+    ``augment_geom`` + a device-side numeric stage instead."""
+    img = augment_geom(img, data_shape, rng, rand_crop=rand_crop,
+                       rand_mirror=rand_mirror, resize=resize)
     img = img.astype(np.float32) * scale
     mean = np.asarray(mean, np.float32)
     std = np.asarray(std, np.float32)

