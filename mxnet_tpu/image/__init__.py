@@ -17,6 +17,7 @@ from ..ndarray import NDArray
 
 __all__ = ["imdecode", "imresize", "imread", "resize_short", "fixed_crop",
            "center_crop", "random_crop", "color_normalize", "ImageIter",
+           "augment_basic", "augment_geom",
            "CreateAugmenter", "Augmenter", "ResizeAug", "ForceResizeAug",
            "RandomCropAug", "CenterCropAug", "HorizontalFlipAug", "CastAug"]
 

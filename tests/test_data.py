@@ -394,3 +394,18 @@ def test_dataloader_process_no_shm_leak():
             break
         time.sleep(0.1)
     assert not leaked, f"leaked shm segments: {leaked}"
+
+
+def test_augment_basic_matches_device_numeric_stage():
+    """The host-side augment_basic reference chain and ImageRecordIter's
+    device-side numeric stage must never diverge."""
+    from mxnet_tpu.image import augment_basic
+    from mxnet_tpu.io import _numeric_finish
+
+    rs = np.random.RandomState(0)
+    img = rs.randint(0, 255, (12, 12, 3), np.uint8)
+    mean, std, scale = (123.0, 117.0, 104.0), (58.0, 57.0, 57.0), 2.0
+    host = augment_basic(img, (3, 12, 12), rs, mean=mean, std=std,
+                         scale=scale)
+    dev = np.asarray(_numeric_finish(mean, std, scale)(img[None]))[0]
+    np.testing.assert_allclose(dev, host, rtol=1e-6)
