@@ -426,7 +426,7 @@ class PrefetchingIter(DataIter):
         return self.iter.provide_label
 
 
-@functools.lru_cache(maxsize=None)
+@functools.lru_cache(maxsize=16)  # bounded: one executable per config
 def _numeric_finish(mean, std, scale):
     """One shared jitted cast+normalize+CHW program per (mean, std,
     scale) config — train/val iterator pairs reuse a single compile."""

@@ -225,6 +225,10 @@ def test_kv_cache_zero_tokens_and_bucket_reuse():
     # sizes are environment-dependent (second call may even recompile
     # after eviction — what must never happen is a NEW signature).
     dec = llama.LlamaDecoder(net, max_len=64)
+    # the eviction-proof invariant: both calls resolve to the SAME
+    # (prompt, steps) buckets, so they share one compiled signature
+    assert dec._bucket(5) == dec._bucket(7)
+    assert dec._bucket(3) == dec._bucket(4)
     r5 = dec.generate(_ids(1, 5, seed=5).asnumpy(), 3)
     after_first = dec._gen._cache_size()
     r7 = dec.generate(_ids(1, 7, seed=7).asnumpy(), 4)
