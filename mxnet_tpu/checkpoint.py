@@ -51,26 +51,54 @@ def _fsync_dir(path):
         os.close(fd)
 
 
+def _fsync_tree(root):
+    """fsync every file and directory under ``root`` (bottom-up)."""
+    for dirpath, _dirnames, filenames in os.walk(root, topdown=False):
+        for fn in filenames:
+            _fsync_file(os.path.join(dirpath, fn))
+        _fsync_dir(dirpath)
+
+
 def save_checkpoint(ckpt_dir, step, net, trainer=None, extra=None,
-                    keep=None):
+                    keep=None, sharded=False):
     """Write ``<ckpt_dir>/ckpt-<step>`` atomically.  Returns its path.
 
     ``keep``: if set, prune to the newest ``keep`` checkpoints after a
     successful write.
+
+    ``sharded=True``: weights go through orbax/tensorstore as a SHARDED
+    array checkpoint (SURVEY §5 checkpoint row) — each host writes only
+    its addressable shards and restore re-places arrays on their saved
+    shardings, so multi-host meshes never funnel the model through one
+    host.  Multi-process jobs must call this COLLECTIVELY on a shared
+    filesystem: the orbax write is a collective into the final directory
+    (orbax owns cross-host atomicity/commit) and only process 0 writes
+    the manifest/sidecars, after a global barrier.  The default
+    ``.params`` container stays the reference-compatible interchange
+    format; trainer state remains the binary sidecar in both modes.
     """
+    import jax
+
     from . import random as mx_random
 
     step = int(step)
     os.makedirs(ckpt_dir, exist_ok=True)
-    tmp = os.path.join(ckpt_dir, f".tmp-{step}-{os.getpid()}")
     final = os.path.join(ckpt_dir, f"{_PREFIX}{step}")
+    if sharded and jax.process_count() > 1:
+        return _save_checkpoint_multihost(ckpt_dir, final, step, net,
+                                          trainer, extra, keep)
+    tmp = os.path.join(ckpt_dir, f".tmp-{step}-{os.getpid()}")
     if os.path.exists(tmp):
         shutil.rmtree(tmp)
     os.makedirs(tmp)
     try:
-        net.save_parameters(os.path.join(tmp, "model.params"))
+        if sharded:
+            _save_params_sharded(os.path.join(tmp, "model.orbax"), net)
+        else:
+            net.save_parameters(os.path.join(tmp, "model.params"))
         manifest = {"step": step, "time": time.time(),
                     "has_trainer": trainer is not None,
+                    "sharded": bool(sharded),
                     "extra": extra or {}}
         if trainer is not None:
             trainer.save_states(os.path.join(tmp, "trainer.states"))
@@ -81,12 +109,11 @@ def save_checkpoint(ckpt_dir, step, net, trainer=None, extra=None,
             json.dump(manifest, f)
             f.flush()
             os.fsync(f.fileno())
-        # durability, not just atomicity: fsync every payload file and the
-        # directories so a power loss after the rename can't surface a
-        # manifest-bearing checkpoint with truncated payloads
-        for name in os.listdir(tmp):
-            _fsync_file(os.path.join(tmp, name))
-        _fsync_dir(tmp)
+        # durability, not just atomicity: fsync every payload file and
+        # directory (recursively — the orbax payload is a tree) so a
+        # power loss after the rename can't surface a manifest-bearing
+        # checkpoint with truncated payloads
+        _fsync_tree(tmp)
         if os.path.exists(final):
             shutil.rmtree(final)  # re-checkpoint of the same step
         os.rename(tmp, final)
@@ -97,6 +124,80 @@ def save_checkpoint(ckpt_dir, step, net, trainer=None, extra=None,
     if keep is not None:
         prune_checkpoints(ckpt_dir, keep)
     return final
+
+
+def _save_checkpoint_multihost(ckpt_dir, final, step, net, trainer, extra,
+                               keep):
+    """Collective sharded save: every process writes its shards straight
+    into the final directory via orbax (which owns the cross-host commit
+    protocol), then a barrier, then ONLY process 0 writes the sidecars
+    and the completeness-marking manifest."""
+    import jax
+    from jax.experimental import multihost_utils
+
+    from . import random as mx_random
+
+    os.makedirs(final, exist_ok=True)
+    _save_params_sharded(os.path.join(final, "model.orbax"), net)
+    multihost_utils.sync_global_devices(f"mxt_ckpt_{step}")
+    if jax.process_index() == 0:
+        if trainer is not None:
+            trainer.save_states(os.path.join(final, "trainer.states"))
+        rng = mx_random._STATE.key
+        if rng is not None:
+            np.save(os.path.join(final, "rng.npy"), np.asarray(rng))
+        manifest = {"step": step, "time": time.time(),
+                    "has_trainer": trainer is not None,
+                    "sharded": True, "extra": extra or {}}
+        with open(os.path.join(final, "manifest.json"), "w") as f:
+            json.dump(manifest, f)
+            f.flush()
+            os.fsync(f.fileno())
+        _fsync_dir(final)
+        if keep is not None:
+            prune_checkpoints(ckpt_dir, keep)
+    multihost_utils.sync_global_devices(f"mxt_ckpt_done_{step}")
+    return final
+
+
+def _save_params_sharded(path, net):
+    """Orbax/tensorstore sharded write of the initialized parameters
+    (each host persists only its addressable shards)."""
+    import orbax.checkpoint as ocp
+
+    # block-STRUCTURAL names ("0.weight"), same convention as
+    # save_parameters, so restore works across differently-prefixed
+    # instances of the same architecture
+    tree = {name: p.data()._data
+            for name, p in net._collect_params_with_prefix().items()
+            if p._data is not None}
+    ck = ocp.StandardCheckpointer()
+    ck.save(os.path.abspath(path), tree)
+    ck.wait_until_finished()
+
+
+def _restore_params_sharded(path, net):
+    """Restore into the net's existing parameters, re-placing every
+    array on the sharding it was SAVED with (orbax's sharding file), so
+    a resumed job keeps its dp/tp layout without a host-side gather."""
+    import jax
+    import orbax.checkpoint as ocp
+
+    params = {name: p
+              for name, p in net._collect_params_with_prefix().items()
+              if p._data is not None}
+    target = {name: jax.ShapeDtypeStruct(p.data()._data.shape,
+                                         p.data()._data.dtype)
+              for name, p in params.items()}
+    ck = ocp.StandardCheckpointer()
+    try:
+        tree = ck.restore(os.path.abspath(path), target)
+    except Exception as e:
+        raise MXNetError(
+            f"sharded checkpoint at {path!r} does not match this "
+            f"model's parameter structure: {e}") from e
+    for name, p in params.items():
+        p.data()._data = tree[name]
 
 
 def _complete_checkpoints(ckpt_dir):
@@ -133,7 +234,10 @@ def resume(ckpt_dir, net, trainer=None, ctx=None):
         return 0, {}
     with open(os.path.join(path, "manifest.json")) as f:
         manifest = json.load(f)
-    net.load_parameters(os.path.join(path, "model.params"), ctx=ctx)
+    if manifest.get("sharded"):
+        _restore_params_sharded(os.path.join(path, "model.orbax"), net)
+    else:
+        net.load_parameters(os.path.join(path, "model.params"), ctx=ctx)
     if trainer is not None:
         ts = os.path.join(path, "trainer.states")
         if not os.path.exists(ts):
