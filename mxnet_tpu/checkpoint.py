@@ -131,28 +131,71 @@ def _save_checkpoint_multihost(ckpt_dir, final, step, net, trainer, extra,
     """Collective sharded save: every process writes its shards straight
     into the final directory via orbax (which owns the cross-host commit
     protocol), then a barrier, then ONLY process 0 writes the sidecars
-    and the completeness-marking manifest."""
+    and the completeness-marking manifest.
+
+    Re-checkpointing an existing step is supported: process 0 first
+    DEMOTES the old checkpoint (removes the manifest, so no crash window
+    ever shows a manifest-bearing dir with mixed-step payloads) and
+    clears the stale orbax tree (StandardCheckpointer refuses to
+    overwrite), with a barrier before any other process starts writing.
+    Sidecars go to temp names with atomic renames; the manifest — the
+    completeness marker — is written last."""
     import jax
     from jax.experimental import multihost_utils
 
     from . import random as mx_random
 
+    if jax.process_index() == 0:
+        os.makedirs(final, exist_ok=True)
+        old_manifest = os.path.join(final, "manifest.json")
+        if os.path.exists(old_manifest):
+            os.unlink(old_manifest)  # demote: no longer "complete"
+            _fsync_dir(final)
+        # clear EVERY stale artifact, not just the orbax tree: a leftover
+        # trainer.states/rng.npy from the previous save would otherwise be
+        # resumed alongside the new weights, and orphaned .tmp-* files
+        # from a crashed sidecar write would accumulate forever
+        for name in os.listdir(final):
+            if name == "manifest.json":
+                continue
+            p = os.path.join(final, name)
+            if os.path.isdir(p):
+                shutil.rmtree(p)
+            else:
+                os.unlink(p)
+    multihost_utils.sync_global_devices(f"mxt_ckpt_pre_{step}")
     os.makedirs(final, exist_ok=True)
     _save_params_sharded(os.path.join(final, "model.orbax"), net)
     multihost_utils.sync_global_devices(f"mxt_ckpt_{step}")
     if jax.process_index() == 0:
+        def _atomic(name, write_fn):
+            # temp name keeps the real extension (np.save appends .npy
+            # to anything else), hidden by the leading dot
+            tmp = os.path.join(final, f".tmp-{os.getpid()}-{name}")
+            write_fn(tmp)
+            _fsync_file(tmp)
+            os.rename(tmp, os.path.join(final, name))
+
         if trainer is not None:
-            trainer.save_states(os.path.join(final, "trainer.states"))
+            _atomic("trainer.states", trainer.save_states)
         rng = mx_random._STATE.key
         if rng is not None:
-            np.save(os.path.join(final, "rng.npy"), np.asarray(rng))
+            def _write_rng(p):
+                with open(p, "wb") as f:
+                    np.save(f, np.asarray(rng))
+            _atomic("rng.npy", _write_rng)
+        # durably order the sidecar renames BEFORE the completeness
+        # marker: without this fsync a power loss could persist the
+        # manifest entry while losing the sidecar renames
+        _fsync_dir(final)
         manifest = {"step": step, "time": time.time(),
                     "has_trainer": trainer is not None,
                     "sharded": True, "extra": extra or {}}
-        with open(os.path.join(final, "manifest.json"), "w") as f:
-            json.dump(manifest, f)
-            f.flush()
-            os.fsync(f.fileno())
+
+        def _write_manifest(p):
+            with open(p, "w") as f:
+                json.dump(manifest, f)
+        _atomic("manifest.json", _write_manifest)
         _fsync_dir(final)
         if keep is not None:
             prune_checkpoints(ckpt_dir, keep)
@@ -177,18 +220,31 @@ def _save_params_sharded(path, net):
 
 
 def _restore_params_sharded(path, net):
-    """Restore into the net's existing parameters, re-placing every
-    array on the sharding it was SAVED with (orbax's sharding file), so
-    a resumed job keeps its dp/tp layout without a host-side gather."""
+    """Restore into the net's existing parameters.
+
+    Each array is restored onto the net's CURRENT placement when the
+    caller has laid parameters out on a mesh (NamedSharding) — that is
+    the topology the resumed job actually runs on, and it makes resume
+    after a process-count/mesh change well-defined.  Parameters without
+    an explicit mesh placement fall back to orbax's saved-sharding file,
+    which is only safe when the topology is unchanged (orbax's own
+    warning); lay the net out first (as Trainer/parallel helpers do) to
+    avoid relying on it."""
     import jax
     import orbax.checkpoint as ocp
 
     params = {name: p
               for name, p in net._collect_params_with_prefix().items()
               if p._data is not None}
-    target = {name: jax.ShapeDtypeStruct(p.data()._data.shape,
-                                         p.data()._data.dtype)
-              for name, p in params.items()}
+
+    def _tgt(p):
+        arr = p.data()._data
+        sh = getattr(arr, "sharding", None)
+        if isinstance(sh, jax.sharding.NamedSharding):
+            return jax.ShapeDtypeStruct(arr.shape, arr.dtype, sharding=sh)
+        return jax.ShapeDtypeStruct(arr.shape, arr.dtype)
+
+    target = {name: _tgt(p) for name, p in params.items()}
     ck = ocp.StandardCheckpointer()
     try:
         tree = ck.restore(os.path.abspath(path), target)

@@ -27,6 +27,7 @@ the reference flag.
 """
 from __future__ import annotations
 
+import os
 import pickle
 import queue as _queue
 import threading
@@ -89,7 +90,49 @@ def _shm_unregister(name):
         pass
 
 
-def _process_worker_loop(payload, index_q, result_q, worker_id):
+def _shm_name(owner_pid):
+    """``mxt-<owner pid>-<random>`` shared-memory name: the pid tag is what
+    lets :func:`_sweep_stale_shm` tell live traffic from leaked blocks.
+    ``owner_pid`` is the loader parent's pid captured AT SPAWN — a worker
+    orphaned by a hard-killed parent would report ``getppid() == 1``,
+    which the sweep could never reclaim."""
+    import secrets
+
+    return f"mxt-{owner_pid}-{secrets.token_hex(6)}"
+
+
+def _sweep_stale_shm():
+    """Unlink ``/dev/shm/mxt-<pid>-*`` blocks whose owner pid is dead.
+
+    Blocks are unregistered from the resource_tracker when ownership moves
+    worker→parent, so a hard-killed parent leaks them permanently; each
+    pool startup reclaims any such leftovers (ADVICE r2: leak mode on
+    SIGKILL)."""
+    shm_dir = "/dev/shm"
+    try:
+        names = os.listdir(shm_dir)
+    except OSError:
+        return
+    for fn in names:
+        if not fn.startswith("mxt-"):
+            continue
+        parts = fn.split("-")
+        try:
+            pid = int(parts[1])
+        except (IndexError, ValueError):
+            continue
+        try:
+            os.kill(pid, 0)  # owner alive → in-flight, leave it
+        except ProcessLookupError:
+            try:
+                os.unlink(os.path.join(shm_dir, fn))
+            except OSError:
+                pass
+        except OSError:
+            pass
+
+
+def _process_worker_loop(payload, index_q, result_q, worker_id, owner_pid):
     """Child main: runs dataset fetch + batchify, exports each result
     array via shared memory, sends only metadata through the queue.
     Jobs/results carry the parent's epoch counter so abandoned epochs
@@ -117,8 +160,13 @@ def _process_worker_loop(payload, index_q, result_q, worker_id):
             tmpl = _flatten_host(batch, arrays)
             metas = []
             for a in arrays:
-                shm = shared_memory.SharedMemory(create=True,
-                                                 size=max(a.nbytes, 1))
+                # name carries the PARENT pid (captured at spawn) so a
+                # startup sweep can reclaim blocks whose owning loader
+                # died without close() (SIGKILL leaves them untracked:
+                # ownership is handed to the parent via _shm_unregister)
+                shm = shared_memory.SharedMemory(
+                    name=_shm_name(owner_pid), create=True,
+                    size=max(a.nbytes, 1))
                 np.ndarray(a.shape, a.dtype, buffer=shm.buf)[...] = a
                 metas.append((shm.name, str(a.dtype), a.shape))
                 shm.close()
@@ -227,6 +275,7 @@ class DataLoader:
             return self._pool
         import multiprocessing as mp
 
+        _sweep_stale_shm()
         ctx = mp.get_context("spawn")
         payload = pickle.dumps((self._dataset, self._batchify_fn))
         index_q = ctx.Queue()
@@ -234,7 +283,8 @@ class DataLoader:
         procs = []
         for wid in range(self._num_workers):
             p = ctx.Process(target=_process_worker_loop,
-                            args=(payload, index_q, result_q, wid),
+                            args=(payload, index_q, result_q, wid,
+                                  os.getpid()),
                             daemon=True)
             p.start()
             procs.append(p)

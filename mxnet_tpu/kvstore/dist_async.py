@@ -39,6 +39,7 @@ from __future__ import annotations
 import hashlib
 import hmac
 import json
+import logging
 import os
 import pickle
 import queue
@@ -331,7 +332,19 @@ class PSServer:
             with self._lock:
                 if self._optimizer is None:
                     raise MXNetError("set_hparams before set_optimizer")
-                if lr is not None and self._optimizer.lr_scheduler is None:
+                if lr is not None:
+                    if self._optimizer.lr_scheduler is not None:
+                        # the Trainer only ships an explicit lr when its
+                        # LOCAL optimizer has no scheduler — so the
+                        # worker side dropped its scheduler and the
+                        # server copy is stale; follow it rather than
+                        # silently ignoring the update (keeps optimizer
+                        # state, unlike a full set_optimizer re-ship)
+                        logging.warning(
+                            "PS set_hparams: explicit lr=%s overrides "
+                            "the server-side lr_scheduler (dropped to "
+                            "match the worker's optimizer)", lr)
+                        self._optimizer.lr_scheduler = None
                     self._optimizer.lr = lr
                 if rescale is not None:
                     self._optimizer.rescale_grad = rescale

@@ -170,6 +170,40 @@ def ctc_loss(data, label, data_lengths=None, label_lengths=None,
     if use_label_lengths:
         args.append(label_lengths)
 
+    # Host-side validation when inputs are concrete (the reference's shape/
+    # label CHECKs, ctc_loss.cc).  Under tracing (hybridize/export) values
+    # are abstract and only the padded-region clip below applies.  Only the
+    # small label/length tensors are materialized — the logits contribute
+    # just their (static) shape, so no device→host copy of activations.
+    from ..ndarray.ndarray import _is_tracer
+
+    def _concrete(x):
+        v = getattr(x, "_data", x)
+        return None if _is_tracer(v) else np.asarray(v)
+
+    c_label = _concrete(label)
+    if not _is_tracer(getattr(data, "_data", data)) and c_label is not None:
+        T_c, _, C_c = data.shape
+        lo, hi = (1, C_c - 1) if blank_first else (0, C_c - 2)
+        pad_c = 0 if blank_first else -1
+        c_len = _concrete(label_lengths) if use_label_lengths else None
+        if c_len is not None:
+            live = np.arange(c_label.shape[1])[None, :] < \
+                np.asarray(c_len).astype(np.int64)[:, None]
+        else:
+            live = c_label != pad_c
+        bad = c_label[live]
+        if bad.size and (bad.min() < lo or bad.max() > hi):
+            raise MXNetError(
+                f"ctc_loss: label values must lie in [{lo}, {hi}] for "
+                f"blank_label={blank_label!r} (got range "
+                f"[{bad.min()}, {bad.max()}])")
+        c_dlen = _concrete(data_lengths) if use_data_lengths else None
+        if c_dlen is not None and np.asarray(c_dlen).max() > T_c:
+            raise MXNetError(
+                f"ctc_loss: data_lengths exceed the time dimension "
+                f"T={T_c} (max {np.asarray(c_dlen).max()})")
+
     NEG = jnp.float32(-1e30)  # -inf stand-in: keeps logaddexp NaN-free
 
     def f(*raws):

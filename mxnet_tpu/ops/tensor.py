@@ -572,7 +572,7 @@ def scatter_nd(data, indices, shape, **kwargs):
 _export(scatter_nd)
 
 
-def boolean_mask(data, index, axis=0, **kwargs):  # pragma: no cover
+def boolean_mask(data, index, axis=0, **kwargs):
     """Reference contrib ``boolean_mask``.  Dynamic output shape cannot live
     under jit on TPU; eager-only (documented departure — SURVEY §7 hard
     parts: dynamic shapes)."""

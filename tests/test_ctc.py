@@ -197,3 +197,18 @@ def test_symbolic_arange_and_ctc_bindings():
                        label_lengths=nd.array(lens, dtype="int32"),
                        blank_label="first")
     np.testing.assert_allclose(got.asnumpy(), want.asnumpy(), rtol=1e-5)
+
+
+def test_ctc_loss_input_validation():
+    data = nd.random.uniform(shape=(5, 2, 4))
+    # blank='last' (C-1=3): a live label equal to the blank index raises
+    with pytest.raises(mx.base.MXNetError):
+        nd.ctc_loss(data, nd.array([[3, 1], [1, 2]]), blank_label="last")
+    # blank='first': label_lengths exposing a 0 (blank) as live raises
+    with pytest.raises(mx.base.MXNetError):
+        nd.ctc_loss(data, nd.array([[0, 1], [1, 2]]),
+                    label_lengths=nd.array([2, 2]), use_label_lengths=True)
+    # data_lengths beyond T raises
+    with pytest.raises(mx.base.MXNetError):
+        nd.ctc_loss(data, nd.array([[1, 2], [1, 2]]),
+                    data_lengths=nd.array([9, 3]), use_data_lengths=True)

@@ -1,9 +1,10 @@
 """Nightly large-array tests (reference: tests/nightly/test_large_array.py
 — int64-range shapes, SURVEY §4 nightly row).
 
-Gated behind ``MXNET_TEST_LARGE=1``: the arrays exceed 2**31 elements and
+Gated behind ``MXT_TEST_NIGHTLY=1``: the arrays exceed 2**31 elements and
 need multi-GB host RAM, so they run as a nightly tier, same as the
-reference's.
+reference's.  (``MXNET_TEST_LARGE=1`` is accepted as a legacy alias so
+existing invocations keep working.)
 """
 import os
 
@@ -14,8 +15,9 @@ import mxnet_tpu as mx
 from mxnet_tpu import nd
 
 pytestmark = pytest.mark.skipif(
-    not os.environ.get("MXNET_TEST_LARGE"),
-    reason="large-array nightly tier; set MXNET_TEST_LARGE=1")
+    not (os.environ.get("MXT_TEST_NIGHTLY")
+         or os.environ.get("MXNET_TEST_LARGE")),
+    reason="large-array nightly tier; set MXT_TEST_NIGHTLY=1")
 
 # > int32 element count, int8 payload (~2.2 GB)
 LARGE = 2 ** 31 + 7

@@ -246,3 +246,17 @@ def test_random_moments():
     assert abs(n.std() - 2.0) < 0.06
     p = nd.random.poisson(4.0, shape=(20000,)).asnumpy()
     assert abs(p.mean() - 4.0) < 0.1
+
+
+def test_boolean_mask():
+    x = onp.arange(12.0).reshape(4, 3)
+    mask = onp.array([1, 0, 1, 1])
+    out = nd.contrib.boolean_mask(nd.array(x), nd.array(mask)).asnumpy()
+    onp.testing.assert_allclose(out, x[mask.astype(bool)])
+    # axis=1 and the all-false edge (empty result, shape preserved elsewhere)
+    out1 = nd.contrib.boolean_mask(nd.array(x), nd.array([0, 1, 0]),
+                                   axis=1).asnumpy()
+    onp.testing.assert_allclose(out1, x[:, 1:2])
+    empty = nd.contrib.boolean_mask(nd.array(x),
+                                    nd.array([0, 0, 0, 0])).asnumpy()
+    assert empty.shape == (0, 3)
