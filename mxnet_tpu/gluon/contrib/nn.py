@@ -5,10 +5,13 @@ Reference: ``python/mxnet/gluon/contrib/nn/basic_layers.py:?`` —
 ``Identity``, ``SparseEmbedding``, ``SyncBatchNorm``, ``PixelShuffle1D/2D/
 3D`` (SURVEY §2.4 gluon contrib row).
 
-TPU notes: ``SyncBatchNorm`` here IS plain BatchNorm — under GSPMD the
-batch axis is sharded over the mesh and XLA's reductions are global, so
-cross-device statistics come for free (the reference needed a dedicated
-cross-GPU allreduce op, ``src/operator/contrib/sync_batch_norm.cc:?``).
+TPU notes: ``SyncBatchNorm`` equals plain BatchNorm under single-process
+GSPMD (the batch axis is sharded over the mesh and XLA's reductions are
+global, so cross-device statistics come for free), and under
+multi-process data parallelism it all-reduces batch statistics over the
+process mesh in forward AND backward (see ``nn.SyncBatchNorm`` — the
+analog of the reference's dedicated cross-GPU allreduce op,
+``src/operator/contrib/sync_batch_norm.cc:?``).
 """
 from __future__ import annotations
 

@@ -199,15 +199,97 @@ class BatchNorm(HybridBlock):
         return y
 
 
+class _SyncBNCrossProcess(autograd.Function):
+    """Differentiable cross-process BatchNorm (the sync_batch_norm.cc
+    analog): forward all-reduces per-channel (count, sum, sumsq) over the
+    process mesh, backward all-reduces (sum dy, sum dy·x̂) — the same two
+    collective hops the reference's GPU kernel does.  gamma/beta grads
+    stay host-LOCAL sums: they are parameter gradients, and the Trainer's
+    kvstore all-reduces those across processes itself."""
+
+    def __init__(self, eps, fix_gamma, axis):
+        super().__init__()
+        self._eps = eps
+        self._fix_gamma, self._axis = fix_gamma, axis
+        self.global_mean = self.global_var = None
+
+    def forward(self, x, gamma, beta):
+        import jax.numpy as jnp
+        from jax import lax
+
+        from ...ndarray import NDArray
+        from ...parallel import process_sum_hostvec
+
+        xr = x._data
+        ax = self._axis % xr.ndim
+        red = tuple(i for i in range(xr.ndim) if i != ax)
+        C = xr.shape[ax]
+        xf = xr.astype(np.float32)
+        local = jnp.concatenate([
+            jnp.sum(xf, axis=red), jnp.sum(xf * xf, axis=red),
+            jnp.full((1,), np.prod([xr.shape[i] for i in red],
+                                   dtype=np.float64).astype(np.float32))])
+        g = process_sum_hostvec(np.asarray(local))
+        count = float(g[2 * C])
+        mean = jnp.asarray(g[:C]) / count
+        # E[x²]−mean² can go (slightly) negative from float32
+        # cancellation when |mean| ≫ std; clamp so rsqrt stays finite
+        var = jnp.maximum(jnp.asarray(g[C:2 * C]) / count - mean * mean,
+                          0.0)
+        inv = lax.rsqrt(var + self._eps)
+        shape = [1] * xr.ndim
+        shape[ax] = C
+        xhat = (xf - mean.reshape(shape)) * inv.reshape(shape)
+        g_ = jnp.ones_like(gamma._data) if self._fix_gamma \
+            else gamma._data.astype(np.float32)
+        y = xhat * g_.reshape(shape) + \
+            beta._data.astype(np.float32).reshape(shape)
+        self.save_for_backward(NDArray(xhat), NDArray(g_),
+                               NDArray(inv))
+        self._count, self._red, self._shape = count, red, shape
+        self.global_mean, self.global_var = NDArray(mean), NDArray(var)
+        return NDArray(y.astype(xr.dtype))
+
+    def backward(self, dy):
+        import jax.numpy as jnp
+
+        from ...ndarray import NDArray
+        from ...parallel import process_sum_hostvec
+
+        xhat, g_, inv = self.saved_tensors
+        red, shape, count = self._red, self._shape, self._count
+        dyf = dy._data.astype(np.float32)
+        s1 = jnp.sum(dyf, axis=red)                       # Σdy  (local)
+        s2 = jnp.sum(dyf * xhat._data, axis=red)          # Σdy·x̂ (local)
+        gsum = process_sum_hostvec(
+            np.asarray(jnp.concatenate([s1, s2])))
+        C = s1.shape[0]
+        g1, g2 = jnp.asarray(gsum[:C]), jnp.asarray(gsum[C:])
+        dx = (g_._data * inv._data).reshape(shape) * (
+            dyf - (g1 / count).reshape(shape)
+            - xhat._data * (g2 / count).reshape(shape))
+        dgamma = jnp.zeros_like(s2) if self._fix_gamma else s2
+        return (NDArray(dx.astype(dy.dtype)), NDArray(dgamma),
+                NDArray(s1))
+
+
 class SyncBatchNorm(BatchNorm):
     """Cross-device BatchNorm (reference: ``contrib.nn.SyncBatchNorm``,
     src/operator/contrib/sync_batch_norm.cc:?).
 
-    TPU-native: under pjit/shard_map the batch axis is sharded and XLA's
-    batch-norm statistics become per-shard; the parallel layer runs the whole
-    step inside one jit where means/vars are psum-reduced over the data-axis
-    mesh by the `sync_batch_norm` op.  Single-process semantics equal
-    BatchNorm."""
+    TPU-native, two regimes:
+
+    * **Single process** (incl. single-jit GSPMD over any mesh): the whole
+      step runs inside one jit over the global batch array, so plain
+      BatchNorm statistics already cover the global batch — sync is free.
+    * **Multi-process data parallelism** (``jax.process_count() > 1``,
+      each host jitting over its host-local shard): batch statistics are
+      genuinely per-host, so training forward routes through
+      :class:`_SyncBNCrossProcess`, which all-reduces (count, Σx, Σx²)
+      across the process mesh in forward and (Σdy, Σdy·x̂) in backward —
+      global-batch statistics and exact global-batch gradients.  This
+      eager path cannot run inside a host-local jit; hybridized blocks
+      raise with the supported alternatives."""
 
     def __init__(self, in_channels=0, num_devices=None, momentum=0.9,
                  epsilon=1e-5, center=True, scale=True,
@@ -224,6 +306,36 @@ class SyncBatchNorm(BatchNorm):
             running_mean_initializer=running_mean_initializer,
             running_variance_initializer=running_variance_initializer,
             in_channels=in_channels, prefix=prefix, params=params)
+
+    def hybrid_forward(self, F, x, gamma, beta, running_mean, running_var):
+        import jax
+
+        if (jax.process_count() > 1 and autograd.is_training()
+                and not self._use_global_stats):
+            from ...ndarray.ndarray import _is_tracer
+
+            if _is_tracer(getattr(x, "_data", x)):
+                raise MXNetError(
+                    "SyncBatchNorm under multi-process data parallelism "
+                    "cannot run inside a host-local jit: each process "
+                    "would silently use its own batch statistics. "
+                    "Leave the block un-hybridized (statistics sync "
+                    "eagerly over the process mesh), or run the whole "
+                    "step as one GSPMD jit over the global mesh, where "
+                    "plain BatchNorm already sees the global batch.")
+            fn = _SyncBNCrossProcess(self._epsilon, not self._scale,
+                                     self._axis)
+            y = fn(x, gamma, beta)
+            m = self._momentum
+            running_mean._data = (
+                m * running_mean._data.astype(np.float32)
+                + (1 - m) * fn.global_mean._data)
+            running_var._data = (
+                m * running_var._data.astype(np.float32)
+                + (1 - m) * fn.global_var._data)
+            return y
+        return super().hybrid_forward(F, x, gamma, beta, running_mean,
+                                      running_var)
 
 
 class Embedding(HybridBlock):

@@ -256,18 +256,13 @@ def all_sum(arrays):
     if not local_idx:
         return out
 
-    pmesh, summed_fn = _process_psum(n)
     by_dtype = {}
     for i in local_idx:
         by_dtype.setdefault(onp.dtype(raws[i].dtype).name, []).append(i)
-    from jax.sharding import NamedSharding, PartitionSpec
-
-    sharding = NamedSharding(pmesh, PartitionSpec("dp", None))
     for _dtype, idxs in sorted(by_dtype.items()):
         flat = onp.concatenate(
-            [onp.asarray(raws[i]).ravel() for i in idxs])[None]
-        garr = jax.make_array_from_process_local_data(sharding, flat)
-        vec = onp.asarray(summed_fn(garr).addressable_data(0))[0]
+            [onp.asarray(raws[i]).ravel() for i in idxs])
+        vec = process_sum_hostvec(flat)
         off = 0
         for i in idxs:
             size = raws[i].size
@@ -278,6 +273,28 @@ def all_sum(arrays):
                 raws[i].sharding))
             off += size
     return out
+
+
+def process_sum_hostvec(vec):
+    """Sum a host-side 1-D numpy vector across all processes (SPMD: every
+    rank must call this with a same-shaped vector) and return the summed
+    numpy vector.  The cross-host hop of SyncBatchNorm statistics and
+    other small eager reductions; single-process it is the identity."""
+    import jax
+    import numpy as onp
+
+    n = jax.process_count()
+    vec = onp.asarray(vec)
+    if n == 1:
+        return vec
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    pmesh, summed_fn = _process_psum(n)
+    sharding = NamedSharding(pmesh, PartitionSpec("dp", None))
+    garr = jax.make_array_from_process_local_data(
+        sharding, vec.reshape(1, -1))
+    out = onp.asarray(summed_fn(garr).addressable_data(0))[0]
+    return out.reshape(vec.shape)
 
 
 _PROCESS_PSUM_CACHE = {}
