@@ -125,11 +125,26 @@ class Parameter:
         if initializer is None:
             initializer = init_mod.Uniform()
         initializer(desc, arr)
-        # under an active device mesh, parameters are born replicated so
-        # GSPMD derives the gradient all-reduce (mxnet_tpu/parallel)
+        # initializers assign fresh arrays born on jax's DEFAULT device;
+        # honor the requested context (e.g. cpu ctx on a TPU host — the
+        # parity lane's cross-backend runs) by re-placing when they
+        # differ.  Only without an active mesh: under a mesh `.device`
+        # is a Sharding and replicate() below owns placement (a
+        # device_put here would collapse the mesh layout, and would
+        # crash on non-addressable multi-process arrays).
         from .. import parallel
 
-        if parallel.current_mesh() is not None:
+        mesh = parallel.current_mesh()
+        if mesh is None:
+            import jax
+
+            want = ctx_list[0].device
+            dev = getattr(arr._data, "device", None)
+            if isinstance(dev, jax.Device) and dev != want:
+                arr._data = jax.device_put(arr._data, want)
+        else:
+            # under an active device mesh, parameters are born
+            # replicated so GSPMD derives the gradient all-reduce
             parallel.replicate(arr)
         self._data = arr
         self._deferred_init = None

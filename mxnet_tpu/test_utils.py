@@ -120,9 +120,37 @@ def with_seed(seed=None):
     return deco
 
 
-def check_consistency(fn, inputs, ctxs=None, rtol=1e-4, atol=1e-5):
+def max_rel_err(a, b, atol=0.0):
+    """Worst normalized error ``max(|a-b| / (|a| + max(atol, 1e-12)))``.
+    The denominator floor keeps exact zero-zero agreement at 0 instead of
+    0/0 = NaN.  Positions where BOTH sides are NaN count as agreement
+    (matching ``assert_allclose``'s equal_nan default); a NaN on one side
+    only returns inf so a max can never silently swallow it."""
+    if np.asarray(a).size == 0:
+        return 0.0
+    a = np.asarray(a, np.float64)
+    b = np.asarray(b, np.float64)
+    e = np.abs(a - b) / (np.abs(a) + max(atol, 1e-12))
+    both_nan = np.isnan(a) & np.isnan(b)
+    e = np.where(both_nan, 0.0, e)
+    if np.isnan(e).any():
+        return float("inf")
+    return float(np.max(e))
+
+
+def check_consistency(fn, inputs, ctxs=None, rtol=1e-4, atol=1e-5,
+                      collect=None, ref=None):
     """Run ``fn`` under each context and cross-check outputs (reference
-    ``check_consistency`` runs one symbol across [cpu, gpu, ...])."""
+    ``check_consistency`` runs one symbol across [cpu, gpu, ...]; here the
+    context list is typically ``[mx.cpu(0), mx.tpu(0)]`` — the on-chip
+    parity lane, tests_tpu/).
+
+    ``collect``: optional callable receiving the worst observed
+    :func:`max_rel_err` across the non-reference contexts (used by the
+    parity lane to log per-family error headroom).
+    ``ref``: optional precomputed reference output (numpy); when given,
+    every context in ``ctxs`` is compared against it instead of the first
+    context being re-run as the reference."""
     from .context import cpu
 
     ctxs = ctxs or [cpu(0)]
@@ -131,6 +159,13 @@ def check_consistency(fn, inputs, ctxs=None, rtol=1e-4, atol=1e-5):
         with ctx:
             nds = [nd.array(x, ctx=ctx) for x in inputs]
             outs.append(fn(*nds).asnumpy())
-    for o in outs[1:]:
-        np.testing.assert_allclose(outs[0], o, rtol=rtol, atol=atol)
-    return outs[0]
+    if ref is None:
+        ref, others = outs[0], outs[1:]
+    else:
+        ref, others = np.asarray(ref), outs
+    if collect is not None:
+        collect(max((max_rel_err(ref, o, atol) for o in others),
+                    default=0.0))
+    for o in others:
+        np.testing.assert_allclose(ref, o, rtol=rtol, atol=atol)
+    return ref

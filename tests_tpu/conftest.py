@@ -1,0 +1,103 @@
+"""On-chip TPU parity lane (reference pattern:
+tests/python/gpu/test_operator_gpu.py — the same op corpus re-run on the
+accelerator and cross-checked against the CPU backend, SURVEY §4).
+
+This lane deliberately does NOT inherit tests/conftest.py: no CPU-platform
+pin and no x64 — jax boots its default accelerator backend and the suite
+runs in exactly the x32/bf16 numerics the chip ships.  Tolerances are
+therefore chosen per op family (see test_tpu_parity.CASES), not inherited
+from a float64 oracle.
+
+Run with:  MXT_TEST_TPU=1 python -m pytest tests_tpu/ -q
+Artifact:  TPU_PARITY.json at the repo root (override MXT_TPU_PARITY_OUT)
+           — pass/fail counts + worst observed relative error per family.
+"""
+import json
+import os
+import time
+
+import pytest
+
+RUN = os.environ.get("MXT_TEST_TPU") == "1"
+
+STATS = {
+    "lane": "MXT_TEST_TPU=1 python -m pytest tests_tpu/",
+    "families": {},
+    "passed": 0,
+    "failed": 0,
+    "skipped": 0,
+}
+_T0 = time.time()
+
+
+def _on_chip():
+    """True only when jax's default backend is a real TPU — guards the
+    lane against a repo-root `pytest` run where tests/conftest.py already
+    pinned the CPU platform (a cpu-vs-cpu 'parity' pass would silently
+    overwrite the artifact with a trivial all-pass)."""
+    import jax
+
+    d = jax.devices()[0]
+    return "tpu" in (d.platform + " " + getattr(d, "device_kind",
+                                                "")).lower()
+
+
+def pytest_collection_modifyitems(config, items):
+    if RUN and _on_chip():
+        return
+    reason = ("on-chip TPU parity lane; set MXT_TEST_TPU=1" if not RUN
+              else "MXT_TEST_TPU=1 but jax's default backend is not a "
+                   "TPU (run the lane alone, not under tests/conftest's "
+                   "CPU pin)")
+    skip = pytest.mark.skip(reason=reason)
+    for item in items:
+        item.add_marker(skip)
+
+
+def record(family, case, err):
+    """Accumulate the worst observed relative error per op family."""
+    fam = STATS["families"].setdefault(
+        family, {"cases": 0, "worst_rel_err": 0.0, "worst_case": None})
+    fam["cases"] += 1
+    if err >= fam["worst_rel_err"]:
+        fam["worst_rel_err"] = err
+        fam["worst_case"] = case
+
+
+@pytest.fixture(scope="session")
+def parity_record():
+    return record
+
+
+def pytest_runtest_logreport(report):
+    if report.when == "call":
+        if report.passed:
+            STATS["passed"] += 1
+        elif report.failed:
+            STATS["failed"] += 1
+    elif report.when == "setup" and report.skipped:
+        STATS["skipped"] += 1
+
+
+def pytest_sessionfinish(session, exitstatus):
+    if not RUN or not _on_chip():
+        return
+    import jax
+
+    STATS["platform"] = str(jax.devices()[0])
+    STATS["x64_enabled"] = bool(jax.config.jax_enable_x64)
+    STATS["duration_sec"] = round(time.time() - _T0, 1)
+    STATS["time"] = time.strftime("%Y-%m-%dT%H:%M:%S%z")
+    for fam in STATS["families"].values():
+        fam["worst_rel_err"] = float(f"{fam['worst_rel_err']:.3e}")
+    out = os.environ.get("MXT_TPU_PARITY_OUT") or os.path.join(
+        os.path.dirname(__file__), "..", "TPU_PARITY.json")
+    # a filtered run (-k / single node id) must not clobber the full-sweep
+    # snapshot: route partial stats to a sidecar instead
+    filtered = bool(getattr(session.config.option, "keyword", "")) or \
+        any("::" in a for a in session.config.args)
+    if filtered:
+        STATS["partial"] = True
+        out += ".partial"
+    with open(out, "w") as f:
+        json.dump(STATS, f, indent=1, sort_keys=True)

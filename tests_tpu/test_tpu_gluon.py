@@ -1,0 +1,185 @@
+"""Gluon forward/backward and serialization parity on the real chip.
+
+Reference pattern (SURVEY §4): the gpu lane re-runs test_gluon.py's
+fundamentals under ctx=gpu.  Here each net is built twice with the same
+PRNG seed (jax's threefry is backend-deterministic, so cpu and tpu get
+bit-identical initial weights), driven forward+backward on both devices,
+and outputs / input grads / parameter grads are cross-checked at
+MXU-aware tolerances.  Serialization does device-crossing round-trips:
+params saved from the chip load into a CPU net and vice versa, and
+export → SymbolBlock.imports re-runs on the chip.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.test_utils import max_rel_err
+
+RT, AT = 2e-2, 2e-3
+R = np.random.RandomState(7)
+
+
+def _drive(factory, x_np, coef_np, ctx):
+    with ctx:
+        mx.random.seed(11)
+        net = factory()
+        net.initialize(ctx=ctx)
+        x = nd.array(x_np, ctx=ctx)
+        x.attach_grad()
+        coef = nd.array(coef_np, ctx=ctx)
+        with autograd.record():
+            y = net(x)
+            loss = ((y * coef) ** 2).sum()
+        loss.backward()
+        # block-STRUCTURAL names: the global name-counter differs
+        # between the two factory() calls, structural keys do not
+        grads = {k: p.grad().asnumpy()
+                 for k, p in sorted(
+                     net._collect_params_with_prefix().items())
+                 if p.grad_req != "null"}
+        return net, y.asnumpy(), x.grad.asnumpy(), grads
+
+
+def _net_parity(factory, xshape, parity_record, name):
+    x_np = R.randn(*xshape).astype(np.float32)
+    coef_np = R.randn(1).astype(np.float32)
+    _, y_c, dx_c, g_c = _drive(factory, x_np, coef_np, mx.cpu(0))
+    _, y_t, dx_t, g_t = _drive(factory, x_np, coef_np, mx.tpu(0))
+    worst = 0.0
+    for a, b in [(y_c, y_t), (dx_c, dx_t)] + \
+            [(g_c[k], g_t[k]) for k in g_c]:
+        # bf16-MXU error scales with the tensor's magnitude (chained
+        # convs/matmuls in backward), so the near-zero floor does too
+        atol = max(AT, RT * float(np.max(np.abs(a))))
+        worst = max(worst, max_rel_err(a, b, atol))
+        np.testing.assert_allclose(a, b, rtol=RT, atol=atol)
+    parity_record("gluon", name, worst)
+
+
+def test_dense_mlp(parity_record):
+    def factory():
+        net = gluon.nn.HybridSequential()
+        net.add(gluon.nn.Dense(16, activation="relu"),
+                gluon.nn.Dense(8))
+        return net
+
+    _net_parity(factory, (4, 10), parity_record, "dense_mlp")
+
+
+def test_conv_bn_pool(parity_record):
+    def factory():
+        net = gluon.nn.HybridSequential()
+        net.add(gluon.nn.Conv2D(8, 3, padding=1, activation="relu"),
+                gluon.nn.BatchNorm(),
+                gluon.nn.MaxPool2D(2),
+                gluon.nn.Flatten(),
+                gluon.nn.Dense(5))
+        return net
+
+    _net_parity(factory, (2, 3, 8, 8), parity_record, "conv_bn_pool")
+
+
+def test_lstm_layer(parity_record):
+    def factory():
+        return gluon.rnn.LSTM(6, num_layers=1)
+
+    _net_parity(factory, (5, 2, 4), parity_record, "lstm_layer")
+
+
+def test_hybridize_on_chip_matches_eager(parity_record):
+    """jit (CachedOp) vs eager on the SAME chip — catches compile-path
+    divergence that cross-backend parity can't see."""
+    with mx.tpu(0):
+        mx.random.seed(3)
+        net = gluon.nn.HybridSequential()
+        net.add(gluon.nn.Dense(12, activation="tanh"), gluon.nn.Dense(4))
+        net.initialize()
+        x = nd.array(R.randn(4, 6).astype(np.float32))
+        eager = net(x).asnumpy()
+        net.hybridize()
+        jitted = net(x).asnumpy()
+        jitted2 = net(x).asnumpy()
+    parity_record("gluon", "hybridize_vs_eager",
+                  max_rel_err(eager, jitted, AT))
+    np.testing.assert_allclose(eager, jitted, rtol=RT, atol=AT)
+    np.testing.assert_allclose(jitted, jitted2)
+
+
+def test_params_cross_device_roundtrip(tmp_path, parity_record):
+    """save_parameters on the chip → load into a CPU net (and back):
+    values must survive bit-exactly (the container stores f32 bytes)."""
+    def factory():
+        net = gluon.nn.Dense(5)
+        return net
+
+    with mx.tpu(0):
+        mx.random.seed(5)
+        net_t = factory()
+        net_t.initialize()
+        net_t(nd.ones((2, 3)))
+        f = str(tmp_path / "w.params")
+        net_t.save_parameters(f)
+        want = {k: p.data().asnumpy()
+                for k, p in net_t._collect_params_with_prefix().items()}
+    with mx.cpu(0):
+        net_c = factory()
+        net_c.load_parameters(f, ctx=mx.cpu(0))
+        for k, p in net_c._collect_params_with_prefix().items():
+            np.testing.assert_array_equal(p.data().asnumpy(), want[k])
+        f2 = str(tmp_path / "w2.params")
+        net_c.save_parameters(f2)
+    with mx.tpu(0):
+        net_t2 = factory()
+        net_t2.load_parameters(f2, ctx=mx.tpu(0))
+        for k, p in net_t2._collect_params_with_prefix().items():
+            np.testing.assert_array_equal(p.data().asnumpy(), want[k])
+    parity_record("serialization", "params_cross_device", 0.0)
+
+
+def test_export_imports_on_chip(tmp_path, parity_record):
+    """HybridBlock.export → SymbolBlock.imports, forward re-run on chip."""
+    with mx.tpu(0):
+        mx.random.seed(6)
+        net = gluon.nn.HybridSequential()
+        net.add(gluon.nn.Dense(6, activation="relu"), gluon.nn.Dense(3))
+        net.initialize()
+        net.hybridize()
+        x = nd.array(R.randn(2, 4).astype(np.float32))
+        want = net(x).asnumpy()
+        net.export(str(tmp_path / "m"), epoch=0)
+        sb = gluon.SymbolBlock.imports(
+            str(tmp_path / "m-symbol.json"), ["data"],
+            str(tmp_path / "m-0000.params"), ctx=mx.tpu(0))
+        got = sb(x).asnumpy()
+    parity_record("serialization", "export_imports",
+                  max_rel_err(want, got, AT))
+    np.testing.assert_allclose(want, got, rtol=1e-5, atol=1e-6)
+
+
+def test_trainer_step_on_chip(parity_record):
+    """One SGD step on chip vs cpu from identical weights: updated params
+    must agree (optimizer update ops ride the same jit path)."""
+    def run(ctx):
+        with ctx:
+            mx.random.seed(9)
+            net = gluon.nn.Dense(4)
+            net.initialize()
+            tr = gluon.Trainer(net.collect_params(), "sgd",
+                               {"learning_rate": 0.1, "momentum": 0.9})
+            x = nd.array(R.randn(6, 5).astype(np.float32) * 0 + 1.0)
+            with autograd.record():
+                loss = (net(x) ** 2).sum()
+            loss.backward()
+            tr.step(6)
+            return {k: p.data().asnumpy()
+                    for k, p in sorted(
+                        net._collect_params_with_prefix().items())}
+
+    pc = run(mx.cpu(0))
+    pt = run(mx.tpu(0))
+    worst = 0.0
+    for k in pc:
+        worst = max(worst, max_rel_err(pc[k], pt[k], AT))
+        np.testing.assert_allclose(pc[k], pt[k], rtol=RT, atol=AT)
+    parity_record("gluon", "trainer_sgd_step", worst)
