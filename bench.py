@@ -1,16 +1,20 @@
-"""Benchmark: ResNet-50 v1 training throughput, images/sec/chip.
+"""Benchmark: BASELINE.md tracked metrics on one chip.
+
+Default run measures BOTH tracked training metrics back to back —
+ResNet-50 v1 images/sec/chip, then BERT-base (seq 128) samples/sec/chip
+— and prints ONE JSON line.  Schema keeps ``metric``/``value`` as the
+ResNet number (driver compatibility); the BERT number rides alongside as
+``bert_base_samples_per_sec_per_chip``.
 
 Measurement protocol (BASELINE.md): synthetic data, hybridized net under
-``gluon.Trainer`` (sgd+momentum), steady state after warmup (compile)
-steps; images/sec = batch x steps / wall.  ``vs_baseline`` is measured
-against the reference's published number, which was unrecoverable (empty
-reference mount — BASELINE.md); reported as 0.0 meaning "no baseline
-available", NOT parity.
+``gluon.Trainer``, steady state after warmup (compile) steps, best of
+``BENCH_REPEATS`` windows.  ``vs_baseline`` is measured against the
+reference's published number, which was unrecoverable (empty reference
+mount — BASELINE.md); reported as 0.0 meaning "no baseline available",
+NOT parity.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
-
-``BENCH_MODEL=bert_base`` switches to the BASELINE metric #2 workload
-(BERT-base phase-1 pretraining shape, seq 128, samples/sec).
+``BENCH_MODEL=bert_base`` runs ONLY the BERT workload (its own JSON
+schema); ``BENCH_SKIP_BERT=1`` keeps the default run ResNet-only.
 """
 from __future__ import annotations
 
@@ -46,7 +50,15 @@ def main():
     dtype = os.environ.get("BENCH_DTYPE", "bfloat16")
 
     if model.startswith("bert"):
-        return _bench_bert(batch, steps, warmup, dtype, model)
+        ips, repeats = _bench_bert(batch, steps, warmup, dtype, model)
+        print(json.dumps({
+            "metric": f"{model}_pretrain_samples_per_sec_per_chip",
+            "value": round(ips, 2),
+            "unit": "samples/sec/chip",
+            "aggregation": f"best_of_{repeats}_windows",
+            "vs_baseline": 0.0,
+        }))
+        return
 
     mx.random.seed(0)
     net = gluon.model_zoo.vision.get_model(model, classes=1000)
@@ -85,14 +97,36 @@ def main():
     nd.waitall()
 
     ips, repeats = _best_window(step, batch, steps)
-    print(json.dumps({
+    record = {
         "metric": f"{model}_train_images_per_sec_per_chip",
         "value": round(ips, 2),
         "unit": "images/sec/chip",
         "aggregation": f"best_of_{repeats}_windows",
         # reference baseline unrecoverable (BASELINE.md): 0.0 = no baseline
         "vs_baseline": 0.0,
-    }))
+    }
+
+    if not int(os.environ.get("BENCH_SKIP_BERT", "0")):
+        # release the ResNet program + arrays before the BERT compile so
+        # both workloads see the full HBM
+        import gc
+
+        del net, trainer, loss_fn, x, y, step
+        gc.collect()
+        try:
+            # the tracked BERT metric is pinned to the BASELINE protocol
+            # batch (64) regardless of BENCH_BATCH overrides aimed at the
+            # ResNet leg (e.g. BENCH_REMAT=1 BENCH_BATCH=128)
+            bert_batch = int(os.environ.get("BENCH_BERT_BATCH", "64"))
+            bert_ips, _ = _bench_bert(bert_batch, steps, warmup, dtype,
+                                      "bert_base")
+            record["bert_base_samples_per_sec_per_chip"] = \
+                round(bert_ips, 2)
+            record["bert_base_unit"] = "samples/sec/chip"
+            record["bert_base_batch"] = bert_batch
+        except Exception as e:  # keep the measured ResNet number
+            record["bert_error"] = f"{type(e).__name__}: {e}"
+    print(json.dumps(record))
 
 
 def _best_window(step, batch, steps, repeats=None):
@@ -120,10 +154,8 @@ def _best_window(step, batch, steps, repeats=None):
 
 
 def _bench_bert(batch, steps, warmup, dtype, model_name):
-    """BERT-base MLM-style pretraining step (seq 128, BASELINE protocol)."""
-    import json
-    import time
-
+    """BERT-base MLM-style pretraining step (seq 128, BASELINE protocol).
+    Returns (samples/sec, window repeats)."""
     import numpy as np
 
     import mxnet_tpu as mx
@@ -164,14 +196,7 @@ def _bench_bert(batch, steps, warmup, dtype, model_name):
     for _ in range(warmup):
         step().wait_to_read()
     nd.waitall()
-    ips, repeats = _best_window(step, batch, steps)
-    print(json.dumps({
-        "metric": f"{model_name}_pretrain_samples_per_sec_per_chip",
-        "value": round(ips, 2),
-        "unit": "samples/sec/chip",
-        "aggregation": f"best_of_{repeats}_windows",
-        "vs_baseline": 0.0,
-    }))
+    return _best_window(step, batch, steps)
 
 
 if __name__ == "__main__":

@@ -16,10 +16,15 @@ script compatibility:
   ``MXT_COORDINATOR``/``MXT_NUM_PROCESSES``/``MXT_PROCESS_ID`` set —
   the loopback test topology (the reference's ``--launcher local`` analog,
   used by the distributed tests, SURVEY §4);
-- ``--launcher ssh`` EMITS the per-host commands (one per line) for an
-  external runner to execute — it does NOT ssh anywhere itself; on real
-  pods the platform runner (GKE/xpk) owns process fanout, so parity with
-  the reference's ssh tracker is "same env contract", not "same spawner".
+- ``--launcher ssh`` SPAWNS one ssh per rank (round-robin over the
+  hostfile), same as the reference's dmlc ssh tracker — with the env
+  contract exported on the remote shell and the per-job secret delivered
+  over ssh's stdin so it never appears in argv, logs, or shell history.
+  ``--dry-run`` restores emit-only mode (one command per line, secret
+  referenced as ``${MXT_PS_SECRET:?...}`` for an external runner);
+  ``MXT_SSH`` overrides the ssh binary (pluggable spawner — the loopback
+  test substitutes a local stub, and GKE/xpk-style runners can slot in a
+  pod exec).
 
 Every launch mints one ``MXT_PS_SECRET`` shared across ranks: the
 dist_async parameter server HMAC-signs its frames with it (see
@@ -30,6 +35,7 @@ from __future__ import annotations
 import argparse
 import os
 import secrets
+import shlex
 import subprocess
 import sys
 
@@ -70,6 +76,39 @@ def emit_ssh(hosts, n, cmd, coordinator):
     return lines
 
 
+def launch_ssh(hosts, n, cmd, coordinator):
+    """Spawn one ssh per rank and wait (the dmlc ssh tracker analog).
+
+    The per-job secret is piped to each remote's STDIN (``read -r`` on
+    the far side), keeping it out of ssh argv — the round-2 security
+    stance — while still making the launch one command end to end.
+    ``MXT_SSH`` swaps the transport (e.g. a test stub or a pod exec)."""
+    ssh = shlex.split(os.environ.get("MXT_SSH", "ssh"))
+    ps_secret = os.environ.get("MXT_PS_SECRET") or secrets.token_hex(16)
+    procs = []
+    for rank in range(n):
+        host = hosts[rank % len(hosts)]
+        exports = (f"export MXT_PS_SECRET; "
+                   f"export MXT_COORDINATOR={shlex.quote(coordinator)}; "
+                   f"export MXT_NUM_PROCESSES={n}; "
+                   f"export MXT_PROCESS_ID={rank}; ")
+        remote = ("read -r MXT_PS_SECRET; " + exports +
+                  "exec " + " ".join(shlex.quote(c) for c in cmd))
+        p = subprocess.Popen(ssh + [host, remote],
+                             stdin=subprocess.PIPE)
+        try:
+            p.stdin.write((ps_secret + "\n").encode())
+            p.stdin.flush()
+            p.stdin.close()
+        except OSError:
+            pass  # fast-failing ssh: its wait() status reports the rank
+        procs.append(p)
+    rc = 0
+    for p in procs:
+        rc = p.wait() or rc
+    return rc
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("-n", "--num-workers", type=int, required=True)
@@ -77,20 +116,29 @@ def main(argv=None):
                    choices=["local", "ssh"])
     p.add_argument("-H", "--hostfile", default=None)
     p.add_argument("--coordinator", default="127.0.0.1:12721")
+    p.add_argument("--dry-run", action="store_true",
+                   help="ssh launcher: print the per-host commands "
+                        "instead of spawning")
     p.add_argument("command", nargs=argparse.REMAINDER)
     args = p.parse_args(argv)
     if not args.command:
         p.error("no command given")
     if args.launcher == "local":
+        if args.dry_run:
+            p.error("--dry-run only applies to --launcher ssh")
         sys.exit(launch_local(args.num_workers, args.command,
                               args.coordinator))
     hosts = ["localhost"]
     if args.hostfile:
         with open(args.hostfile) as f:
             hosts = [l.strip() for l in f if l.strip()]
-    for line in emit_ssh(hosts, args.num_workers, args.command,
-                         args.coordinator):
-        print(line)
+    if args.dry_run:
+        for line in emit_ssh(hosts, args.num_workers, args.command,
+                             args.coordinator):
+            print(line)
+        return
+    sys.exit(launch_ssh(hosts, args.num_workers, args.command,
+                        args.coordinator))
 
 
 if __name__ == "__main__":
