@@ -29,7 +29,8 @@ from ..gluon import nn
 __all__ = ["LlamaConfig", "RMSNorm", "LlamaAttention", "LlamaMLP",
            "LlamaDecoderLayer", "LlamaModel", "LlamaForCausalLM",
            "LlamaDecoder", "llama3_8b", "llama_tiny", "mixtral_8x7b",
-           "mixtral_tiny", "shard_llama", "LLAMA_CONFIGS"]
+           "mixtral_tiny", "shard_llama", "llama_param_pspecs",
+           "llama_pipeline_forward", "LLAMA_CONFIGS"]
 
 
 class LlamaConfig:
@@ -695,6 +696,129 @@ def mixtral_tiny(**overrides):
                                            **overrides}))
 
 
+def llama_pipeline_forward(net, input_ids, n_microbatches, mesh=None,
+                           axis_name="pp"):
+    """Forward the SAME ``LlamaForCausalLM`` Block over a GPipe pipeline
+    (``parallel.pipeline_apply``, SURVEY §2.3 D7 — new capability).
+
+    The decoder stack is cut into ``mesh[axis_name]`` equal stages; each
+    stage applies its layers with the ORIGINAL Block code (layer 0 is the
+    template whose parameter handles are swapped per layer inside the
+    staged function), activations hop stage→stage over the ICI ring, and
+    embedding/final-norm/LM-head run outside the pipeline, replicated.
+    The per-layer parameter stacking is recorded nd ops, so
+    ``backward()`` routes pipeline gradients into every layer's own
+    ``Parameter.grad()`` and ``gluon.Trainer`` works unchanged —
+    equivalence with the unpipelined forward (loss AND per-param grads)
+    is asserted in tests/test_ring.py.
+
+    ``input_ids``: (B, T) with ``B % n_microbatches == 0``; returns
+    logits (B, T, vocab).
+    """
+    from .. import parallel
+    from ..ndarray import NDArray
+    from ..ops import tensor as tops
+
+    mesh = mesh or parallel.current_mesh()
+    if mesh is None:
+        raise MXNetError("no active mesh; call parallel.set_mesh first")
+    n_stages = mesh.shape[axis_name]
+    layers = list(net.model.layers)
+    n_layers = len(layers)
+    if n_layers % n_stages:
+        raise MXNetError(
+            f"{n_layers} decoder layers not divisible into "
+            f"{n_stages} pipeline stages")
+    lps = n_layers // n_stages
+    batch = input_ids.shape[0]
+    if batch % n_microbatches:
+        raise MXNetError(
+            f"batch {batch} not divisible by {n_microbatches} "
+            "microbatches")
+
+    h = net.model.embed_tokens(input_ids)  # (B, T, H)
+    t_len, hidden = h.shape[1], h.shape[2]
+    mbs = h.reshape((n_microbatches, batch // n_microbatches, t_len,
+                     hidden))
+
+    template = layers[0]
+    tparams = template._collect_params_with_prefix()
+    names = sorted(tparams)
+    # (S, L/S, *shape) stacks: recorded nd ops, so gradients flow back
+    # to each layer's own parameter
+    stacked = {}
+    per_layer_params = [ly._collect_params_with_prefix()
+                        for ly in layers]
+    for name in names:
+        per_layer = [lp[name].data() for lp in per_layer_params]
+        flat = tops.stack(*per_layer, axis=0)  # (L, *shape)
+        stacked[name] = flat.reshape(
+            (n_stages, lps) + tuple(flat.shape[1:]))
+
+    shells = [tparams[n]._data for n in names]
+    saved = [sh._data for sh in shells]
+
+    def stage_fn(ptree, x_raw):
+        out = x_raw
+        for i in range(lps):
+            for sh, name in zip(shells, names):
+                sh._data = ptree[name][i]
+            out = template(NDArray(out))._data
+        return out
+
+    try:
+        out = parallel.pipeline_apply(stage_fn, stacked, mbs, mesh=mesh,
+                                      axis_name=axis_name)
+    finally:
+        for sh, s in zip(shells, saved):
+            sh._data = s
+    h_out = out.reshape((batch, t_len, hidden))
+    h_out = net.model.norm(h_out)
+    return net.lm_head(h_out)
+
+
+def llama_param_pspecs(net, mesh, tp_axis="tp", ep_axis="ep"):
+    """{param_name (structural): partition-spec tuple} for the megatron
+    TP/EP layout over ``mesh`` — the single source of the sharding rules,
+    used by :func:`shard_llama` (placement of real arrays) AND by the
+    abstract 8B lowering proof (ShapeDtypeStruct shardings with no
+    memory).  Params not listed are replicated (spec ``()``)."""
+    has_tp = mesh is not None and tp_axis in mesh.shape
+    has_ep = mesh is not None and ep_axis in mesh.shape
+    names = {id(p): n for n, p in
+             net._collect_params_with_prefix().items()}
+    col = (tp_axis, None)
+    row = (None, tp_axis)
+    specs = {}
+
+    def put(p, spec):
+        specs[names[id(p)]] = spec
+
+    from .moe import MoEMLP, moe_param_specs
+
+    for layer in net.model.layers:
+        attn, mlp = layer.self_attn, layer.mlp
+        if has_tp:
+            for p in (attn.q_proj.weight, attn.k_proj.weight,
+                      attn.v_proj.weight):
+                put(p, col)
+            put(attn.o_proj.weight, row)
+        if isinstance(mlp, MoEMLP):
+            for p, spec in moe_param_specs(
+                    mlp, ep_axis=ep_axis if has_ep else None,
+                    tp_axis=tp_axis if has_tp else None).items():
+                put(p, spec)
+        elif has_tp:
+            for p in (mlp.gate_proj.weight, mlp.up_proj.weight):
+                put(p, col)
+            put(mlp.down_proj.weight, row)
+    if has_tp:
+        put(net.model.embed_tokens.weight, col)
+        if not net._cfg.tie_embeddings:
+            put(net.lm_head.weight, col)
+    return specs
+
+
 def shard_llama(net, mesh=None, tp_axis="tp", dp_axis="dp", ep_axis="ep"):
     """Annotate megatron-style TP shardings over ``mesh`` (pjit/GSPMD
     derives the collectives — SURVEY §2.3 D6, new capability):
@@ -704,10 +828,11 @@ def shard_llama(net, mesh=None, tp_axis="tp", dp_axis="dp", ep_axis="ep"):
     - embed/lm_head: vocab-parallel
     - MoE layers: expert bank sharded over ``ep`` (+tp within experts)
     Replicates everything else.  Weights are stored (out, in), so the
-    output dim is axis 0.
+    output dim is axis 0.  The rules live in
+    :func:`llama_param_pspecs`; this function applies them to the
+    initialized arrays.
     """
     from .. import parallel
-    from .moe import MoEMLP, shard_moe
 
     mesh = mesh or parallel.current_mesh()
     has_tp = mesh is not None and tp_axis in mesh.shape
@@ -715,25 +840,9 @@ def shard_llama(net, mesh=None, tp_axis="tp", dp_axis="dp", ep_axis="ep"):
     if mesh is None or not (has_tp or has_ep):
         parallel.replicate_block_params(net)
         return net
-    col = (tp_axis, None)
-    row = (None, tp_axis)
     parallel.replicate_block_params(net)  # baseline: replicate all
-    for layer in net.model.layers:
-        attn, mlp = layer.self_attn, layer.mlp
-        if has_tp:
-            for p in (attn.q_proj.weight, attn.k_proj.weight,
-                      attn.v_proj.weight):
-                parallel.shard_param(p, col, mesh)
-            parallel.shard_param(attn.o_proj.weight, row, mesh)
-        if isinstance(mlp, MoEMLP):
-            shard_moe(mlp, mesh, ep_axis=ep_axis,
-                      tp_axis=tp_axis if has_tp else None)
-        elif has_tp:
-            for p in (mlp.gate_proj.weight, mlp.up_proj.weight):
-                parallel.shard_param(p, col, mesh)
-            parallel.shard_param(mlp.down_proj.weight, row, mesh)
-    if has_tp:
-        parallel.shard_param(net.model.embed_tokens.weight, col, mesh)
-        if not net._cfg.tie_embeddings:
-            parallel.shard_param(net.lm_head.weight, col, mesh)
+    params = net._collect_params_with_prefix()
+    for name, spec in llama_param_pspecs(net, mesh, tp_axis=tp_axis,
+                                         ep_axis=ep_axis).items():
+        parallel.shard_param(params[name], spec, mesh)
     return net

@@ -196,6 +196,20 @@ def _expert_ffn(ein, gw, uw, dw):
     return jnp.einsum("eci,ehi->ech", act, dw.astype(ein.dtype))
 
 
+def moe_param_specs(block, ep_axis="ep", tp_axis=None):
+    """{param: partition-spec tuple} for an MoE block — the rule table
+    :func:`shard_moe` applies, reusable against abstract shapes (the 8B
+    lowering proof).  Pass ``ep_axis``/``tp_axis`` as None when absent
+    from the target mesh."""
+    ep, tp = ep_axis, tp_axis
+    return {
+        block.router_weight: (None, None),
+        block.gate_weight: (ep, tp, None),
+        block.up_weight: (ep, tp, None),
+        block.down_weight: (ep, None, tp),
+    }
+
+
 def shard_moe(block, mesh=None, ep_axis="ep", tp_axis=None):
     """Expert parallelism: shard the stacked expert bank over ``ep_axis``
     (optionally tensor-parallel within each expert over ``tp_axis``).
@@ -212,8 +226,7 @@ def shard_moe(block, mesh=None, ep_axis="ep", tp_axis=None):
     tp = tp_axis if (tp_axis and tp_axis in mesh.shape) else None
     if ep is None and tp is None:
         return block
-    parallel.shard_param(block.router_weight, (None, None), mesh)
-    parallel.shard_param(block.gate_weight, (ep, tp, None), mesh)
-    parallel.shard_param(block.up_weight, (ep, tp, None), mesh)
-    parallel.shard_param(block.down_weight, (ep, None, tp), mesh)
+    for p, spec in moe_param_specs(block, ep_axis=ep,
+                                   tp_axis=tp).items():
+        parallel.shard_param(p, spec, mesh)
     return block

@@ -1,0 +1,200 @@
+#!/usr/bin/env python
+"""Llama-3-8B scale proof, part (a): AOT-lower the TRUE 8B config's
+dp×tp×sp train step on a virtual 8-device mesh and emit a committed
+artifact (BASELINE config 5, SURVEY §7 step 12; VERDICT r2 missing #3).
+
+No 8B array is ever materialized: parameters enter the jitted train step
+as ``jax.ShapeDtypeStruct`` avals (every Llama parameter declares its
+shape at construction), sharded by the SAME rule table the real
+placement path uses (``models.llama.llama_param_pspecs``), so what
+lowers here is exactly what would run on a v5e slice.  The step is a
+full training step: forward (ring attention over ``sp``, megatron TP
+matmuls), causal-LM cross-entropy, backward, and an Adam update with
+f32 moments over bf16 parameters.
+
+The artifact records: parameter count, the per-HLO collective counts
+after SPMD partitioning (proof GSPMD actually derived the dp psum, tp
+all-reduces and sp collective-permutes), XLA's own per-device memory
+analysis when available, and the manual per-shard HBM byte math for the
+lowering mesh AND a production v5e-32 (dp4×tp8) layout vs the 16 GiB
+budget.
+
+Run: ``python tools/llama8b_proof.py [out.json]`` (self-contained: forces
+the virtual CPU mesh before jax init, like __graft_entry__'s dryrun).
+"""
+import json
+import os
+import re
+import sys
+import time
+
+if __name__ == "__main__":
+    os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + \
+        " --xla_force_host_platform_device_count=8"
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main(out_path=None):
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from mxnet_tpu import parallel
+    from mxnet_tpu.models import llama
+    from mxnet_tpu.ndarray import NDArray
+
+    MESH = {"dp": 2, "tp": 2, "sp": 2}
+    BATCH, SEQ = 4, 4096
+    P_DTYPE = jnp.bfloat16
+
+    t0 = time.time()
+    net = llama.llama3_8b(attn_mode="ring")
+    cfg = net._cfg
+    params = net._collect_params_with_prefix()
+    shapes = {}
+    for name, p in params.items():
+        shape = tuple(int(s) for s in (p.shape or ()))
+        assert shape and all(s > 0 for s in shape), \
+            f"{name} shape not fully declared: {p.shape}"
+        shapes[name] = shape
+    n_params = sum(int(np.prod(s)) for s in shapes.values())
+
+    mesh = parallel.make_mesh(MESH)
+    pspecs = llama.llama_param_pspecs(net, mesh)
+    shard = {name: NamedSharding(mesh, P(*pspecs.get(name, ())))
+             for name in shapes}
+
+    # shell NDArray handles: tracing swaps tracers into ._data, so the
+    # parameters never need real storage (the CachedOp machinery's
+    # handle-swap trick, gluon/block.py _CachedGraph._pure)
+    shells = {}
+    for name, p in params.items():
+        a = NDArray.__new__(NDArray)
+        a._data = None
+        a._node = None
+        a._oidx = 0
+        a._req_grad = False
+        a._grad = None
+        a._grad_req = "null"
+        p._data = a
+        shells[name] = a
+
+    def loss_fn(p_raws, ids_r, labels_r):
+        for name, sh in shells.items():
+            sh._data = p_raws[name]
+        logits = net(NDArray(ids_r))._data  # (B, T, V)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        nll = -jnp.take_along_axis(
+            logp, labels_r.astype(jnp.int32)[..., None], axis=-1)
+        return nll.mean()
+
+    def train_step(p_raws, m, v, ids_r, labels_r):
+        loss, grads = jax.value_and_grad(loss_fn)(p_raws, ids_r,
+                                                  labels_r)
+        new_m = jax.tree.map(
+            lambda mm, g: 0.9 * mm + 0.1 * g.astype(jnp.float32),
+            m, grads)
+        new_v = jax.tree.map(
+            lambda vv, g: 0.999 * vv
+            + 0.001 * jnp.square(g.astype(jnp.float32)), v, grads)
+        new_p = jax.tree.map(
+            lambda p, mm, vv: (p.astype(jnp.float32) - 1e-4 * mm
+                               / (jnp.sqrt(vv) + 1e-8)).astype(p.dtype),
+            p_raws, new_m, new_v)
+        return loss, new_p, new_m, new_v
+
+    abs_p = {n: jax.ShapeDtypeStruct(shapes[n], P_DTYPE,
+                                     sharding=shard[n])
+             for n in shapes}
+    abs_m = {n: jax.ShapeDtypeStruct(shapes[n], jnp.float32,
+                                     sharding=shard[n])
+             for n in shapes}
+    data_sharding = NamedSharding(mesh, P("dp", None))
+    abs_ids = jax.ShapeDtypeStruct((BATCH, SEQ), jnp.int32,
+                                   sharding=data_sharding)
+
+    with parallel.mesh_scope(mesh):
+        jitted = jax.jit(train_step)
+        lowered = jitted.lower(abs_p, abs_m, abs_m, abs_ids, abs_ids)
+    lower_sec = time.time() - t0
+    stablehlo = lowered.as_text()
+
+    t1 = time.time()
+    compiled = lowered.compile()
+    compile_sec = time.time() - t1
+    hlo = compiled.as_text()
+    collectives = {k: len(re.findall(k, hlo)) for k in
+                   ("all-reduce", "collective-permute", "all-gather",
+                    "reduce-scatter", "all-to-all")}
+    mem = {}
+    try:
+        ma = compiled.memory_analysis()
+        for k in ("argument_size_in_bytes", "output_size_in_bytes",
+                  "temp_size_in_bytes", "generated_code_size_in_bytes"):
+            v = getattr(ma, k, None)
+            if v is not None:
+                mem[k] = int(v)
+    except Exception as e:
+        mem["unavailable"] = str(e)
+
+    # manual per-shard HBM math for a production v5e-32 layout: dp4×tp8,
+    # remat (layer-boundary activations only), bf16 params/grads, f32
+    # Adam moments, per-chip batch 2 × seq 4096
+    tp = 8
+    b_local, seq = 2, 4096
+    sharded = {n: s for n, s in shapes.items()
+               if pspecs.get(n) and any(a == "tp" for a in pspecs[n])}
+    p_shard = sum(int(np.prod(s)) // tp for n, s in sharded.items())
+    p_repl = n_params - sum(int(np.prod(s)) for s in sharded.values())
+    per_chip_params = p_shard + p_repl
+    bf16_b = 2 * per_chip_params
+    moments_b = 2 * 4 * per_chip_params
+    act_b = cfg.num_layers * b_local * seq * cfg.hidden_size * 2
+    logits_b = b_local * seq * cfg.vocab_size * 2 // tp
+    budget = {
+        "mesh": "v5e-32 dp4 x tp8",
+        "per_chip_batch_x_seq": [b_local, seq],
+        "params_bf16_gib": round(bf16_b / 2 ** 30, 2),
+        "grads_bf16_gib": round(bf16_b / 2 ** 30, 2),
+        "adam_moments_f32_gib": round(moments_b / 2 ** 30, 2),
+        "remat_layer_activations_gib": round(act_b / 2 ** 30, 2),
+        "logits_vocab_sharded_gib": round(logits_b / 2 ** 30, 2),
+    }
+    total = 2 * bf16_b + moments_b + act_b + logits_b
+    budget["total_gib"] = round(total / 2 ** 30, 2)
+    budget["hbm_budget_gib"] = 16.0
+    budget["fits"] = bool(total < 16 * 2 ** 30)
+
+    artifact = {
+        "proof": "llama3-8b dp2xtp2xsp2 train step AOT lowering + SPMD "
+                 "compile on 8 virtual devices (no arrays materialized)",
+        "config": {"hidden": cfg.hidden_size, "layers": cfg.num_layers,
+                   "heads": cfg.num_heads, "kv_heads": cfg.num_kv_heads,
+                   "ffn": cfg.intermediate_size,
+                   "vocab": cfg.vocab_size, "attn_mode": "ring"},
+        "n_params": n_params,
+        "lowering_mesh": MESH,
+        "batch_seq": [BATCH, SEQ],
+        "param_dtype": "bfloat16",
+        "adam_moments_dtype": "float32",
+        "lower_sec": round(lower_sec, 1),
+        "compile_sec": round(compile_sec, 1),
+        "stablehlo_bytes": len(stablehlo),
+        "spmd_collectives": collectives,
+        "xla_memory_analysis_per_device": mem,
+        "v5e32_byte_math": budget,
+        "time": time.strftime("%Y-%m-%dT%H:%M:%S%z"),
+    }
+    blob = json.dumps(artifact, indent=1)
+    print(blob)
+    if out_path:
+        with open(out_path, "w") as f:
+            f.write(blob + "\n")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1] if len(sys.argv) > 1 else None)
