@@ -340,3 +340,50 @@ def test_hybridize_remat_matches_plain():
     assert l0 == pytest.approx(l1, rel=1e-6)
     onp.testing.assert_allclose(xg0, xg1, rtol=1e-6)
     onp.testing.assert_allclose(wg0, wg1, rtol=1e-6)
+
+
+def test_batch_norm_training_gradients_finite_difference():
+    """The training BatchNorm backward is a hand-written custom vjp
+    (ops/nn_ops.py _bn_train, the bf16-clean TPU path) — pin its dx /
+    dgamma / dbeta against central finite differences so a future edit
+    to the formula cannot pass silently."""
+    r = np.random.RandomState(0)
+    x0 = r.randn(4, 3, 5, 5).astype(np.float32)
+    g0 = (np.abs(r.randn(3)) + 0.5).astype(np.float32)
+    b0 = r.randn(3).astype(np.float32)
+    coef = r.randn(4, 3, 5, 5).astype(np.float32)
+    c = nd.array(coef)
+
+    def loss_val(xv, gv, bv):
+        with autograd.record():
+            out = nd.BatchNorm(xv, gv, bv, nd.zeros(3), nd.ones(3))[0]
+            return float(((out * c).sum()).asscalar())
+
+    x, g, b = nd.array(x0), nd.array(g0), nd.array(b0)
+    for v in (x, g, b):
+        v.attach_grad()
+    with autograd.record():
+        out = nd.BatchNorm(x, g, b, nd.zeros(3), nd.ones(3))[0]
+        loss = (out * c).sum()
+    loss.backward()
+
+    eps = 1e-3
+    rs = np.random.RandomState(1)
+    for name, base, grad in (("x", x0, x.grad), ("g", g0, g.grad),
+                             ("b", b0, b.grad)):
+        an = grad.asnumpy()
+        k = min(5, base.size)
+        for flat in rs.choice(base.size, k, replace=False):
+            idx = np.unravel_index(flat, base.shape)
+            ap, am = base.copy(), base.copy()
+            ap[idx] += eps
+            am[idx] -= eps
+            args_p = {"x": (nd.array(ap), g, b),
+                      "g": (x, nd.array(ap), b),
+                      "b": (x, g, nd.array(ap))}[name]
+            args_m = {"x": (nd.array(am), g, b),
+                      "g": (x, nd.array(am), b),
+                      "b": (x, g, nd.array(am))}[name]
+            fd = (loss_val(*args_p) - loss_val(*args_m)) / (2 * eps)
+            assert abs(fd - an[idx]) <= 2e-2 * max(1.0, abs(fd)), \
+                (name, idx, fd, an[idx])
