@@ -1,0 +1,107 @@
+"""Largest-fits-one-chip Llama pretraining (BASELINE config 5 half of the
+8B scale proof — tools/llama8b_proof.py carries the multi-chip lowering;
+this trains a real ~1.3B decoder on the single v5e).
+
+Config: hidden 2304, 20 layers, 18 heads (head_dim 128, GQA kv 6), SwiGLU
+ffn 6144, vocab 32k, seq 2048 → 1.28B parameters.  Fit strategy (VERDICT
+r2's "~1.3-1.5B with remat + bf16"): parameters cast to bf16
+(`net.cast`), optimizer state rides the param dtype, activation
+rematerialization via `hybridize(remat=True)`, flash attention.  At
+bf16+remat the resident footprint is ~6 bytes/param + layer-boundary
+activations — ~9 GiB of the 16 GiB HBM.
+
+Run: PYTHONPATH=/root/repo python examples/train_llama_1b.py
+(env: STEPS=300 BATCH=4 SEQ=2048 LOG_EVERY=20)
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.models import llama
+
+
+def main():
+    steps = int(os.environ.get("STEPS", "300"))
+    batch = int(os.environ.get("BATCH", "4"))
+    seq = int(os.environ.get("SEQ", "2048"))
+    log_every = int(os.environ.get("LOG_EVERY", "20"))
+    vocab = 32000
+
+    mx.random.seed(0)
+    layers = int(os.environ.get("LAYERS", "20"))
+    net = llama.LlamaForCausalLM(llama.LlamaConfig(
+        hidden_size=2304, intermediate_size=6144, num_layers=layers,
+        num_heads=18, num_kv_heads=6, vocab_size=vocab,
+        max_seq_len=seq, attn_mode="flash"))
+    net.initialize(mx.init.Normal(0.02))
+    net(nd.ones((1, 8), dtype="int32"))  # resolve deferred shapes cheaply
+    n_params = sum(int(np.prod(p.shape))
+                   for p in net.collect_params().values())
+    print(f"params: {n_params/1e9:.2f}B")
+    net.cast("bfloat16")
+    net.hybridize(static_alloc=True, remat=True)
+    # SGD+momentum: 8 bytes/param resident (bf16 p+g, f32 momentum) vs
+    # Adam's 16 (f32 m AND v for bf16 weights) — the difference between
+    # 1.28B fitting and OOM on a 16 GiB chip
+    opt = os.environ.get("OPT", "sgd")
+    hp = {"learning_rate": float(os.environ.get("LR", "1e-3"))}
+    if opt == "sgd":
+        hp["momentum"] = 0.9
+    trainer = gluon.Trainer(net.collect_params(), opt, hp)
+
+    rng = np.random.RandomState(0)
+    ids_np = rng.randint(0, vocab, (batch, seq + 1))
+    ids = nd.array(ids_np[:, :-1], dtype="int32")
+    labels = nd.array(ids_np[:, 1:], dtype="int32")
+
+    def step():
+        with autograd.record():
+            logits = net(ids)
+            loss = nd.softmax_cross_entropy(
+                logits.reshape((-1, vocab)),
+                labels.reshape((-1,))) / (batch * seq)
+        loss.backward()
+        trainer.step(1)
+        return loss
+
+    print("compiling...")
+    t0 = time.time()
+    first = float(step().asscalar())
+    print(f"first step {time.time()-t0:.0f}s loss={first:.3f}")
+    tok_per_step = batch * seq
+    tic = time.time()
+    done = 0
+    best = 0.0
+    last = None
+    for i in range(1, steps):
+        last = step()
+        done += 1
+        if done % log_every == 0:
+            last.wait_to_read()
+            dt = time.time() - tic
+            tps = log_every * tok_per_step / dt
+            best = max(best, tps)
+            print(f"step {i:4d} loss={float(last.asscalar()):.3f} "
+                  f"{tps:,.0f} tok/s")
+            tic = time.time()
+    final = float(last.asscalar())
+    # model FLOPs: 6N per token fwd+bwd (remat recompute excluded — the
+    # standard accounting); MFU vs 197 bf16 TFLOP/s
+    mfu = best * 6 * n_params / 197e12
+    print(json.dumps({
+        "model": f"llama_h2304_l{layers}", "params": n_params,
+        "seq": seq, "batch": batch, "optimizer": opt,
+        "first_loss": round(first, 3), "final_loss": round(final, 3),
+        "best_tok_per_sec": round(best, 0), "mfu_6N": round(mfu, 3)}))
+
+
+if __name__ == "__main__":
+    main()

@@ -476,9 +476,10 @@ def _bn_train(x, g, b, eps, red, shape):
     float32 only inside the per-channel reductions.  jax autodiff through
     the f32-upcast formulation dragged full-size f32 tensors (and their
     layout copies) through the backward — profiled at ~20% of a ResNet-50
-    step on chip."""
-    y, _, _, _ = _bn_train_fwd_impl(x, g, b, eps, red, shape)
-    return y
+    step on chip.  Returns (y, mean, var) so the caller's moving-stat
+    update reuses the SAME reductions (mean/var carry no gradient)."""
+    y, mean, var, _ = _bn_train_fwd_impl(x, g, b, eps, red, shape)
+    return y, mean, var
 
 
 def _bn_train_fwd_impl(x, g, b, eps, red, shape):
@@ -493,12 +494,13 @@ def _bn_train_fwd_impl(x, g, b, eps, red, shape):
 
 
 def _bn_train_fwd(x, g, b, eps, red, shape):
-    y, mean, _var, inv = _bn_train_fwd_impl(x, g, b, eps, red, shape)
-    return y, (x, g, b, mean, inv)
+    y, mean, var, inv = _bn_train_fwd_impl(x, g, b, eps, red, shape)
+    return (y, mean, var), (x, g, b, mean, inv)
 
 
-def _bn_train_bwd(eps, red, shape, res, dy):
+def _bn_train_bwd(eps, red, shape, res, cots):
     x, g, b, mean, inv = res
+    dy = cots[0]  # mean/var outputs are stop_gradient'd by the caller
     m = 1.0
     for i in red:
         m *= x.shape[i]
@@ -518,6 +520,48 @@ def _bn_train_bwd(eps, red, shape, res, dy):
 
 
 _bn_train.defvjp(_bn_train_fwd, _bn_train_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _ln_train(x, g, b, eps, ax, shape):
+    """Layer norm with the same hand-written, dtype-preserving backward
+    as :func:`_bn_train` (BERT's bf16 path: autodiff through the
+    f32-upcast body materialized full-size f32 residuals)."""
+    y, _, _ = _ln_fwd_impl(x, g, b, eps, ax, shape)
+    return y
+
+
+def _ln_fwd_impl(x, g, b, eps, ax, shape):
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=ax, keepdims=True)
+    var = jnp.var(xf, axis=ax, keepdims=True)
+    inv = lax.rsqrt(var + eps)
+    y = ((xf - mean) * inv * g.astype(jnp.float32).reshape(shape)
+         + b.astype(jnp.float32).reshape(shape)).astype(x.dtype)
+    return y, mean, inv
+
+
+def _ln_train_fwd(x, g, b, eps, ax, shape):
+    y, mean, inv = _ln_fwd_impl(x, g, b, eps, ax, shape)
+    return y, (x, g, b, mean, inv)
+
+
+def _ln_train_bwd(eps, ax, shape, res, dy):
+    x, g, b, mean, inv = res
+    n = x.shape[ax]
+    xhat = (x.astype(jnp.float32) - mean) * inv
+    dyf = dy.astype(jnp.float32)
+    other = tuple(i for i in range(x.ndim) if i != ax % x.ndim)
+    dbeta = jnp.sum(dyf, axis=other)
+    dgamma = jnp.sum(dyf * xhat, axis=other)
+    dyg = dyf * g.astype(jnp.float32).reshape(shape)
+    dx = inv * (dyg - jnp.mean(dyg, axis=ax, keepdims=True)
+                - xhat * jnp.mean(dyg * xhat, axis=ax, keepdims=True))
+    return (dx.astype(x.dtype), dgamma.astype(g.dtype),
+            dbeta.astype(b.dtype))
+
+
+_ln_train.defvjp(_ln_train_fwd, _ln_train_bwd)
 
 
 def batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-5,
@@ -542,12 +586,11 @@ def batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-5,
                       for i in range(x.ndim))
         g_ = jnp.ones_like(g) if fix_gamma else g
         if training:
-            xf = lax.stop_gradient(x).astype(np.float32)
-            mean = jnp.mean(xf, axis=red)
-            var = jnp.var(xf, axis=red)
+            y, mean, var = _bn_train(x, g_, b, float(eps), red, shape)
+            mean = lax.stop_gradient(mean)
+            var = lax.stop_gradient(var)
             new_mmean = momentum * mmean + (1 - momentum) * mean
             new_mvar = momentum * mvar + (1 - momentum) * var
-            y = _bn_train(x, g_, b, float(eps), red, shape)
             return (y, lax.stop_gradient(new_mmean),
                     lax.stop_gradient(new_mvar))
         xf = x.astype(np.float32)
@@ -565,16 +608,14 @@ _export(batch_norm, aliases=("BatchNorm",))
 
 
 def layer_norm(data, gamma, beta, axis=-1, eps=1e-5, **kwargs):
-    """Reference ``LayerNorm`` (src/operator/nn/layer_norm.cc:?)."""
+    """Reference ``LayerNorm`` (src/operator/nn/layer_norm.cc:?).
+    Stats in f32, tensors in the input dtype fwd AND bwd (custom vjp,
+    see ``_ln_train``)."""
     def f(x, g, b):
-        xf = x.astype(np.float32)
-        mean = jnp.mean(xf, axis=axis, keepdims=True)
-        var = jnp.var(xf, axis=axis, keepdims=True)
-        y = (xf - mean) * lax.rsqrt(var + eps)
-        shape = [1] * x.ndim
         ax = axis % x.ndim
-        shape[ax] = x.shape[ax]
-        return (y * g.reshape(shape) + b.reshape(shape)).astype(x.dtype)
+        shape = tuple(x.shape[i] if i == ax else 1
+                      for i in range(x.ndim))
+        return _ln_train(x, g, b, float(eps), ax, shape)
 
     return apply_op(f, data, gamma, beta, name="layer_norm")
 

@@ -387,3 +387,46 @@ def test_batch_norm_training_gradients_finite_difference():
             fd = (loss_val(*args_p) - loss_val(*args_m)) / (2 * eps)
             assert abs(fd - an[idx]) <= 2e-2 * max(1.0, abs(fd)), \
                 (name, idx, fd, an[idx])
+
+
+def test_layer_norm_gradients_finite_difference():
+    """layer_norm backward is also a hand-written custom vjp
+    (ops/nn_ops.py _ln_train) — pin dx / dgamma / dbeta the same way."""
+    r = np.random.RandomState(2)
+    x0 = r.randn(3, 4, 6).astype(np.float32)
+    g0 = (np.abs(r.randn(6)) + 0.5).astype(np.float32)
+    b0 = r.randn(6).astype(np.float32)
+    c = nd.array(r.randn(3, 4, 6).astype(np.float32))
+
+    def loss_val(xv, gv, bv):
+        out = nd.LayerNorm(xv, gv, bv, axis=-1)
+        return float(((out * c).sum()).asscalar())
+
+    x, g, b = nd.array(x0), nd.array(g0), nd.array(b0)
+    for v in (x, g, b):
+        v.attach_grad()
+    with autograd.record():
+        out = nd.LayerNorm(x, g, b, axis=-1)
+        loss = (out * c).sum()
+    loss.backward()
+
+    eps = 1e-3
+    rs = np.random.RandomState(3)
+    for name, base, grad in (("x", x0, x.grad), ("g", g0, g.grad),
+                             ("b", b0, b.grad)):
+        an = grad.asnumpy()
+        for flat in rs.choice(base.size, min(5, base.size),
+                              replace=False):
+            idx = np.unravel_index(flat, base.shape)
+            ap, am = base.copy(), base.copy()
+            ap[idx] += eps
+            am[idx] -= eps
+            args_p = {"x": (nd.array(ap), g, b),
+                      "g": (x, nd.array(ap), b),
+                      "b": (x, g, nd.array(ap))}[name]
+            args_m = {"x": (nd.array(am), g, b),
+                      "g": (x, nd.array(am), b),
+                      "b": (x, g, nd.array(am))}[name]
+            fd = (loss_val(*args_p) - loss_val(*args_m)) / (2 * eps)
+            assert abs(fd - an[idx]) <= 2e-2 * max(1.0, abs(fd)), \
+                (name, idx, fd, an[idx])
