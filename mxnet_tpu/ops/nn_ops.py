@@ -482,14 +482,18 @@ def _bn_train(x, g, b, eps, red, shape):
     return y, mean, var
 
 
+def _acc_dt(x):
+    return jnp.promote_types(x.dtype, jnp.float32)
+
+
 def _bn_train_fwd_impl(x, g, b, eps, red, shape):
-    xf = x.astype(jnp.float32)
+    xf = x.astype(_acc_dt(x))
     mean = jnp.mean(xf, axis=red)
     var = jnp.var(xf, axis=red)
     inv = lax.rsqrt(var + eps)
     y = ((xf - mean.reshape(shape)) * inv.reshape(shape)
-         * g.astype(jnp.float32).reshape(shape)
-         + b.astype(jnp.float32).reshape(shape)).astype(x.dtype)
+         * g.astype(xf.dtype).reshape(shape)
+         + b.astype(xf.dtype).reshape(shape)).astype(x.dtype)
     return y, mean, var, inv
 
 
@@ -507,12 +511,12 @@ def _bn_train_bwd(eps, red, shape, res, cots):
     # per-channel reductions in f32; the full-size intermediates
     # (xhat·dy products) are fused into the reduction by XLA and the
     # materialized dx comes out in x.dtype
-    xhat = (x.astype(jnp.float32) - mean.reshape(shape)) \
+    xhat = (x.astype(_acc_dt(x)) - mean.reshape(shape)) \
         * inv.reshape(shape)
-    dyf = dy.astype(jnp.float32)
+    dyf = dy.astype(xhat.dtype)
     dbeta = jnp.sum(dyf, axis=red)
     dgamma = jnp.sum(dyf * xhat, axis=red)
-    gi = (g.astype(jnp.float32) * inv).reshape(shape)
+    gi = (g.astype(xhat.dtype) * inv).reshape(shape)
     dx = gi * (dyf - (dbeta / m).reshape(shape)
                - xhat * (dgamma / m).reshape(shape))
     return (dx.astype(x.dtype), dgamma.astype(g.dtype),
@@ -532,12 +536,12 @@ def _ln_train(x, g, b, eps, ax, shape):
 
 
 def _ln_fwd_impl(x, g, b, eps, ax, shape):
-    xf = x.astype(jnp.float32)
+    xf = x.astype(_acc_dt(x))
     mean = jnp.mean(xf, axis=ax, keepdims=True)
     var = jnp.var(xf, axis=ax, keepdims=True)
     inv = lax.rsqrt(var + eps)
-    y = ((xf - mean) * inv * g.astype(jnp.float32).reshape(shape)
-         + b.astype(jnp.float32).reshape(shape)).astype(x.dtype)
+    y = ((xf - mean) * inv * g.astype(xf.dtype).reshape(shape)
+         + b.astype(xf.dtype).reshape(shape)).astype(x.dtype)
     return y, mean, inv
 
 
@@ -548,13 +552,12 @@ def _ln_train_fwd(x, g, b, eps, ax, shape):
 
 def _ln_train_bwd(eps, ax, shape, res, dy):
     x, g, b, mean, inv = res
-    n = x.shape[ax]
-    xhat = (x.astype(jnp.float32) - mean) * inv
-    dyf = dy.astype(jnp.float32)
+    xhat = (x.astype(_acc_dt(x)) - mean) * inv
+    dyf = dy.astype(xhat.dtype)
     other = tuple(i for i in range(x.ndim) if i != ax % x.ndim)
     dbeta = jnp.sum(dyf, axis=other)
     dgamma = jnp.sum(dyf * xhat, axis=other)
-    dyg = dyf * g.astype(jnp.float32).reshape(shape)
+    dyg = dyf * g.astype(xhat.dtype).reshape(shape)
     dx = inv * (dyg - jnp.mean(dyg, axis=ax, keepdims=True)
                 - xhat * jnp.mean(dyg * xhat, axis=ax, keepdims=True))
     return (dx.astype(x.dtype), dgamma.astype(g.dtype),
@@ -562,6 +565,42 @@ def _ln_train_bwd(eps, ax, shape, res, dy):
 
 
 _ln_train.defvjp(_ln_train_fwd, _ln_train_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def _standardize(x, eps, red):
+    """Normalize-only kernel ``(x - mean) / sqrt(var + eps)`` over the
+    ``red`` axes with the same dtype-preserving hand-written backward as
+    the other norms (f32 only inside reductions); instance/group norm
+    layer their affine on top in the input dtype, where jax autodiff is
+    already cheap elementwise math."""
+    y, _, _ = _standardize_impl(x, eps, red)
+    return y
+
+
+def _standardize_impl(x, eps, red):
+    xf = x.astype(_acc_dt(x))
+    mean = jnp.mean(xf, axis=red, keepdims=True)
+    var = jnp.var(xf, axis=red, keepdims=True)
+    inv = lax.rsqrt(var + eps)
+    return ((xf - mean) * inv).astype(x.dtype), mean, inv
+
+
+def _standardize_fwd(x, eps, red):
+    y, mean, inv = _standardize_impl(x, eps, red)
+    return y, (x, mean, inv)
+
+
+def _standardize_bwd(eps, red, res, dy):
+    x, mean, inv = res
+    xhat = (x.astype(_acc_dt(x)) - mean) * inv
+    dyf = dy.astype(xhat.dtype)
+    dx = inv * (dyf - jnp.mean(dyf, axis=red, keepdims=True)
+                - xhat * jnp.mean(dyf * xhat, axis=red, keepdims=True))
+    return (dx.astype(x.dtype),)
+
+
+_standardize.defvjp(_standardize_fwd, _standardize_bwd)
 
 
 def batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-5,
@@ -593,11 +632,11 @@ def batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-5,
             new_mvar = momentum * mvar + (1 - momentum) * var
             return (y, lax.stop_gradient(new_mmean),
                     lax.stop_gradient(new_mvar))
-        xf = x.astype(np.float32)
-        inv = lax.rsqrt(mvar + eps)
+        xf = x.astype(_acc_dt(x))
+        inv = lax.rsqrt(mvar.astype(xf.dtype) + eps)
         y = (xf - mmean.reshape(shape)) * inv.reshape(shape)
-        y = y * g_.astype(np.float32).reshape(shape) \
-            + b.astype(np.float32).reshape(shape)
+        y = y * g_.astype(xf.dtype).reshape(shape) \
+            + b.astype(xf.dtype).reshape(shape)
         return y.astype(x.dtype), mmean, mvar
 
     return apply_op(f, data, gamma, beta, moving_mean, moving_var,
@@ -627,13 +666,13 @@ def group_norm(data, gamma, beta, num_groups=1, eps=1e-5, **kwargs):
     def f(x, g, b):
         n, c = x.shape[0], x.shape[1]
         xr = x.reshape((n, num_groups, c // num_groups) + x.shape[2:])
-        xf = xr.astype(np.float32)
-        red = tuple(range(2, xf.ndim))
-        mean = jnp.mean(xf, axis=red, keepdims=True)
-        var = jnp.var(xf, axis=red, keepdims=True)
-        y = ((xf - mean) * lax.rsqrt(var + eps)).reshape(x.shape)
+        red = tuple(range(2, xr.ndim))
+        y = _standardize(xr, float(eps), red).reshape(x.shape)
         shape = (1, c) + (1,) * (x.ndim - 2)
-        return (y * g.reshape(shape) + b.reshape(shape)).astype(x.dtype)
+        acc = _acc_dt(x)  # f32 param-grad reductions, see instance_norm
+        out = y.astype(acc) * g.astype(acc).reshape(shape) \
+            + b.astype(acc).reshape(shape)
+        return out.astype(x.dtype)
 
     return apply_op(f, data, gamma, beta, name="group_norm")
 
@@ -644,12 +683,15 @@ _export(group_norm, aliases=("GroupNorm",))
 def instance_norm(data, gamma, beta, eps=1e-5, **kwargs):
     def f(x, g, b):
         red = tuple(range(2, x.ndim))
-        xf = x.astype(np.float32)
-        mean = jnp.mean(xf, axis=red, keepdims=True)
-        var = jnp.var(xf, axis=red, keepdims=True)
-        y = (xf - mean) * lax.rsqrt(var + eps)
+        y = _standardize(x, float(eps), red)
         shape = (1, x.shape[1]) + (1,) * (x.ndim - 2)
-        return (y * g.reshape(shape) + b.reshape(shape)).astype(x.dtype)
+        # affine in the f32 accumulator so autodiff's dgamma/dbeta
+        # reductions keep f32 precision (bf16 sums over N*spatial lose
+        # the tail); the materialized output is back in x.dtype
+        acc = _acc_dt(x)
+        out = y.astype(acc) * g.astype(acc).reshape(shape) \
+            + b.astype(acc).reshape(shape)
+        return out.astype(x.dtype)
 
     return apply_op(f, data, gamma, beta, name="instance_norm")
 
