@@ -22,6 +22,7 @@ import math
 
 import numpy as np
 
+from .. import autograd
 from ..base import MXNetError
 from ..gluon.block import HybridBlock
 from ..gluon import nn
@@ -30,7 +31,8 @@ __all__ = ["LlamaConfig", "RMSNorm", "LlamaAttention", "LlamaMLP",
            "LlamaDecoderLayer", "LlamaModel", "LlamaForCausalLM",
            "LlamaDecoder", "llama3_8b", "llama_tiny", "mixtral_8x7b",
            "mixtral_tiny", "shard_llama", "llama_param_pspecs",
-           "llama_pipeline_forward", "LLAMA_CONFIGS"]
+           "llama_pipeline_forward", "llama_pipeline_train_step",
+           "LLAMA_CONFIGS"]
 
 
 class LlamaConfig:
@@ -723,13 +725,6 @@ def llama_pipeline_forward(net, input_ids, n_microbatches, mesh=None,
     if mesh is None:
         raise MXNetError("no active mesh; call parallel.set_mesh first")
     n_stages = mesh.shape[axis_name]
-    layers = list(net.model.layers)
-    n_layers = len(layers)
-    if n_layers % n_stages:
-        raise MXNetError(
-            f"{n_layers} decoder layers not divisible into "
-            f"{n_stages} pipeline stages")
-    lps = n_layers // n_stages
     batch = input_ids.shape[0]
     if batch % n_microbatches:
         raise MXNetError(
@@ -741,22 +736,45 @@ def llama_pipeline_forward(net, input_ids, n_microbatches, mesh=None,
     mbs = h.reshape((n_microbatches, batch // n_microbatches, t_len,
                      hidden))
 
+    mach = _pipeline_machinery(net, n_stages)
+    names, shells, lps = mach["names"], mach["shells"], mach["lps"]
+    stacked = _stacked_layer_params(net, names, n_stages, lps)
+    saved = [sh._data for sh in shells]
+
+    try:
+        out = parallel.pipeline_apply(mach["stage_fn"], stacked, mbs,
+                                      mesh=mesh, axis_name=axis_name)
+    finally:
+        for sh, s in zip(shells, saved):
+            sh._data = s
+    h_out = out.reshape((batch, t_len, hidden))
+    h_out = net.model.norm(h_out)
+    return net.lm_head(h_out)
+
+
+def _pipeline_machinery(net, n_stages):
+    """Cached per-(net, n_stages) pipeline plumbing: template layer,
+    its parameter shells (handle-swap targets), and the stage function.
+    Caching keeps ``stage_fn`` IDENTITY stable across training steps so
+    :func:`parallel.pipeline_train_1f1b`'s program cache hits instead of
+    re-tracing the whole schedule every call.  Shared by the GPipe
+    forward and the fused 1F1B train step."""
+    from ..ndarray import NDArray
+
+    cache = getattr(net, "_pp_machinery", None)
+    if cache is not None and cache["n_stages"] == n_stages:
+        return cache
+    layers = list(net.model.layers)
+    n_layers = len(layers)
+    if n_layers % n_stages:
+        raise MXNetError(
+            f"{n_layers} decoder layers not divisible into "
+            f"{n_stages} pipeline stages")
+    lps = n_layers // n_stages
     template = layers[0]
     tparams = template._collect_params_with_prefix()
     names = sorted(tparams)
-    # (S, L/S, *shape) stacks: recorded nd ops, so gradients flow back
-    # to each layer's own parameter
-    stacked = {}
-    per_layer_params = [ly._collect_params_with_prefix()
-                        for ly in layers]
-    for name in names:
-        per_layer = [lp[name].data() for lp in per_layer_params]
-        flat = tops.stack(*per_layer, axis=0)  # (L, *shape)
-        stacked[name] = flat.reshape(
-            (n_stages, lps) + tuple(flat.shape[1:]))
-
     shells = [tparams[n]._data for n in names]
-    saved = [sh._data for sh in shells]
 
     def stage_fn(ptree, x_raw):
         out = x_raw
@@ -766,15 +784,137 @@ def llama_pipeline_forward(net, input_ids, n_microbatches, mesh=None,
             out = template(NDArray(out))._data
         return out
 
-    try:
-        out = parallel.pipeline_apply(stage_fn, stacked, mbs, mesh=mesh,
-                                      axis_name=axis_name)
-    finally:
-        for sh, s in zip(shells, saved):
-            sh._data = s
-    h_out = out.reshape((batch, t_len, hidden))
-    h_out = net.model.norm(h_out)
-    return net.lm_head(h_out)
+    cache = {"n_stages": n_stages, "names": names, "shells": shells,
+             "template": template, "lps": lps, "stage_fn": stage_fn,
+             "loss_fn": None}
+    net._pp_machinery = cache
+    return cache
+
+
+def _stacked_layer_params(net, names, n_stages, lps):
+    """{name: (S, L/S, *shape)} stacks of the per-layer parameters via
+    RECORDED nd ops, so gradients through the stack reach each layer's
+    own Parameter.  Rebuilt every call (the values change each step);
+    the trace-stable machinery lives in :func:`_pipeline_machinery`."""
+    from ..ops import tensor as tops
+
+    per_layer_params = [ly._collect_params_with_prefix()
+                        for ly in net.model.layers]
+    stacked = {}
+    for name in names:
+        flat = tops.stack(*[lp[name].data() for lp in per_layer_params],
+                          axis=0)
+        stacked[name] = flat.reshape(
+            (n_stages, lps) + tuple(flat.shape[1:]))
+    return stacked
+
+
+class _FusedGradStep(autograd.Function):
+    """Wire a fused train step (loss + precomputed grads, e.g. the 1F1B
+    schedule) into the tape: forward runs the runner, backward returns
+    the stashed gradients scaled by the incoming cotangent."""
+
+    def __init__(self, runner):
+        super().__init__()
+        self._runner = runner
+
+    def forward(self, *inputs):
+        loss, grads = self._runner(*inputs)
+        self._grads = grads
+        return loss
+
+    def backward(self, dloss):
+        from ..ndarray import NDArray
+
+        scale = dloss._data
+        return tuple(
+            None if g is None else NDArray(g._data * scale)
+            for g in self._grads)
+
+
+def llama_pipeline_train_step(net, input_ids, labels, n_microbatches,
+                              mesh=None, axis_name="pp"):
+    """Fused 1F1B pipeline train step for a ``LlamaForCausalLM``: one
+    compiled program interleaves each microbatch's backward right behind
+    its forward (``parallel.pipeline_train_1f1b`` — peak activation
+    memory O(S) instead of GPipe's O(M)), with the final RMSNorm + LM
+    head + token cross-entropy computed on the last stage and the
+    embedding stack outside the schedule.  Returns the MEAN token loss
+    as a recorded NDArray: ``loss.backward()`` deposits gradients into
+    every parameter (decoder layers via the stacked-params path,
+    embedding via the schedule's input cotangent, norm/head via tail
+    grads), so ``gluon.Trainer`` works unchanged."""
+    import jax
+    import jax.numpy as jnp
+
+    from .. import parallel
+    from ..ndarray import NDArray
+
+    mesh = mesh or parallel.current_mesh()
+    if mesh is None:
+        raise MXNetError("no active mesh; call parallel.set_mesh first")
+    n_stages = mesh.shape[axis_name]
+    batch = input_ids.shape[0]
+    if batch % n_microbatches:
+        raise MXNetError(
+            f"batch {batch} not divisible by {n_microbatches} "
+            "microbatches")
+    cfg = net._cfg
+    eps = float(cfg.rms_eps)
+
+    h = net.model.embed_tokens(input_ids)  # recorded
+    t_len, hidden = h.shape[1], h.shape[2]
+    mbs = h.reshape((n_microbatches, batch // n_microbatches, t_len,
+                     hidden))
+    lab_mbs = labels.reshape((n_microbatches,
+                              batch // n_microbatches, t_len))
+    mach = _pipeline_machinery(net, n_stages)
+    names, shells, lps = mach["names"], mach["shells"], mach["lps"]
+    stacked = _stacked_layer_params(net, names, n_stages, lps)
+    saved = [sh._data for sh in shells]
+    norm_w = net.model.norm.weight.data()
+    # tied models reuse the embedding matrix as the LM head (same (V, H)
+    # layout as lm_head.weight) — the tape then accumulates BOTH the
+    # input-cotangent and the head contributions into the embedding
+    head_w = (net.model.embed_tokens.weight.data()
+              if cfg.tie_embeddings else net.lm_head.weight.data())
+
+    if mach["loss_fn"] is None:
+        def loss_fn(out, lab, tail):
+            nw, hw = tail
+            xf = out.astype(jnp.float32)
+            var = (xf * xf).mean(axis=-1, keepdims=True)
+            hn = (xf * jax.lax.rsqrt(var + eps)
+                  * nw.astype(jnp.float32)).astype(out.dtype)
+            logits = hn @ hw.T
+            ls = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+            nll = -jnp.take_along_axis(
+                ls, lab.astype(jnp.int32)[..., None], axis=-1)
+            return jnp.sum(nll)
+
+        mach["loss_fn"] = loss_fn
+    stack_leaves = [stacked[name] for name in names]
+
+    def runner(mbs_nd, lab_nd, *leaf_nds):
+        k = len(names)
+        stack_tree = {name: leaf_nds[i]
+                      for i, name in enumerate(names)}
+        tail = tuple(leaf_nds[k:])
+        try:
+            loss, grads, tgrads, dxs = parallel.pipeline_train_1f1b(
+                mach["stage_fn"], mach["loss_fn"], stack_tree, mbs_nd,
+                lab_nd, tail_params=tail, mesh=mesh,
+                axis_name=axis_name)
+        finally:
+            for sh, s_ in zip(shells, saved):
+                sh._data = s_
+        return loss, (dxs, None,
+                      *[grads[name] for name in names],
+                      *list(jax.tree_util.tree_leaves(tgrads)))
+
+    loss_sum = _FusedGradStep(runner)(mbs, lab_mbs, *stack_leaves,
+                                      norm_w, head_w)
+    return loss_sum / float(batch * t_len)
 
 
 def llama_param_pspecs(net, mesh, tp_axis="tp", ep_axis="ep"):

@@ -46,7 +46,8 @@ from ..ndarray import NDArray
 __all__ = ["initialize", "make_mesh", "set_mesh", "current_mesh",
            "mesh_scope", "shard_batch", "replicate", "shard_param",
            "with_sharding", "TPUSyncKVStore", "all_sum",
-           "ring_attention", "ulysses_attention", "pipeline_apply"]
+           "ring_attention", "ulysses_attention", "pipeline_apply",
+           "pipeline_train_1f1b"]
 
 
 _STATE = threading.local()
@@ -436,4 +437,4 @@ class TPUSyncKVStore:
 
 
 from .ring import ring_attention, ulysses_attention  # noqa: E402
-from .pipeline import pipeline_apply  # noqa: E402
+from .pipeline import pipeline_apply, pipeline_train_1f1b  # noqa: E402

@@ -20,7 +20,7 @@ import numpy as np
 
 from ..base import MXNetError
 
-__all__ = ["pipeline_apply"]
+__all__ = ["pipeline_apply", "pipeline_train_1f1b"]
 
 
 def _pipeline_sharded(params, xs, *, stage_fn, axis_name, n):
@@ -106,3 +106,247 @@ def pipeline_apply(stage_fn, stage_params, microbatches, mesh=None,
     nd_leaves = [lf if isinstance(lf, NDArray) else NDArray(lf)
                  for lf in leaves]
     return apply_op(g, xs_nd, *nd_leaves, name="pipeline_apply")
+
+
+def _one_f_one_b_sharded(params, tail, xs, labels, *, stage_fn, loss_fn,
+                         axis_name, n):
+    """1F1B schedule body (inside shard_map over the ``axis_name`` ring).
+
+    Tick layout: forward of microbatch ``i`` runs on stage ``s`` at tick
+    ``s + i`` (as GPipe); its BACKWARD runs at tick ``2n - 1 - s + i`` —
+    the last stage turns a microbatch around immediately, so at most
+    ``2(n - s) - 1`` microbatch inputs are ever stashed per stage (a ring
+    of 2n slots) instead of GPipe's M+S-1 residual sets.  Backward
+    recomputes the stage forward from the stashed INPUT and applies its
+    vjp (per-stage rematerialization — the standard pipeline trade).
+    Each tick does one masked forward AND one masked backward; cotangents
+    ride the reverse ring.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    stage = jax.lax.axis_index(axis_name)
+    m = xs.shape[0]
+    fwd_perm = [(i, (i + 1) % n) for i in range(n)]
+    bwd_perm = [(i, (i - 1) % n) for i in range(n)]
+    ticks = 2 * n - 1 + m
+    ring = 2 * n
+
+    vary = partial(jax.lax.pcast, axis_name=(axis_name,), to="varying")
+    # tail arrives INVARIANT (replicated): differentiating a use of an
+    # invariant value inside shard_map makes jax psum the cotangent over
+    # the axis — every stage's (garbage) contribution would fold into
+    # dt.  pcast to varying first so the vjp stays device-local; the
+    # masked accumulate + final psum then see only the last stage's real
+    # terms.
+    tail = jax.tree_util.tree_map(vary, tail)
+
+    def fwd_only(p, x):
+        return stage_fn(p, x)
+
+    def last_stage_bwd(p, tl, x, lab):
+        def f(pp, tt, xx):
+            return loss_fn(stage_fn(pp, xx), lab, tt)
+
+        (lval, vjp) = jax.vjp(f, p, tl, x)
+        # ones_like keeps the stage-varying aval the vjp seed must have
+        dp, dt, dx = vjp(jnp.ones_like(lval))
+        return lval, dp, dt, dx
+
+    def mid_stage_bwd(p, x, dy):
+        (_, vjp) = jax.vjp(fwd_only, p, x)
+        dp, dx = vjp(dy)
+        return dp, dx
+
+    zero_p = jax.tree_util.tree_map(jnp.zeros_like, params)
+    zero_t = jax.tree_util.tree_map(jnp.zeros_like, tail)
+
+    def tick(carry, t):
+        recv_f, recv_b, stash, gacc, tacc, dxs, lsum = carry
+        # ---- forward leg ------------------------------------------------
+        i_f = t - stage
+        valid_f = (i_f >= 0) & (i_f < m)
+        mb = jnp.clip(i_f, 0, m - 1)
+        inp = jnp.where(stage == 0, xs[mb], recv_f)
+        act = fwd_only(params, inp)
+        slot = jnp.mod(t, ring)
+        stash = jax.lax.dynamic_update_index_in_dim(
+            stash, jnp.where(valid_f, inp, stash[slot]), slot, 0)
+        nxt_f = jax.lax.ppermute(act, axis_name, fwd_perm)
+        # ---- backward leg -----------------------------------------------
+        i_b = t - (2 * n - 1 - stage)
+        valid_b = (i_b >= 0) & (i_b < m)
+        bslot = jnp.mod(t - (2 * (n - stage) - 1), ring)
+        binp = jax.lax.dynamic_index_in_dim(stash, bslot, 0,
+                                            keepdims=False)
+        lab = labels[jnp.clip(i_b, 0, m - 1)]
+        is_last = stage == n - 1
+
+        # lax.cond with the device-local predicate: one branch executes
+        # per device, so only the LAST stage pays the tail loss (LM-head
+        # matmul + softmax) fwd+vjp; masking here would run both on all
+        # stages every tick
+        def _branch_last(_):
+            lval, dp, dt, dx = last_stage_bwd(params, tail, binp, lab)
+            return lval, dp, dt, dx
+
+        def _branch_mid(_):
+            dp, dx = mid_stage_bwd(params, binp, recv_b)
+            return (vary(jnp.zeros((), jnp.float32)), dp,
+                    jax.tree_util.tree_map(jnp.zeros_like, tail), dx)
+
+        lval, dp, dt_last, dx = jax.lax.cond(is_last, _branch_last,
+                                             _branch_mid, None)
+        gacc = jax.tree_util.tree_map(
+            lambda acc, g: acc + jnp.where(valid_b, g,
+                                           jnp.zeros_like(g)),
+            gacc, dp)
+        tacc = jax.tree_util.tree_map(
+            lambda acc, g: acc + jnp.where(valid_b & is_last, g,
+                                           jnp.zeros_like(g)),
+            tacc, dt_last)
+        # stage 0's input cotangent feeds the (recorded) embedding stack
+        dxs = jax.lax.dynamic_update_index_in_dim(
+            dxs, jnp.where(valid_b & (stage == 0), dx,
+                           jax.lax.dynamic_index_in_dim(
+                               dxs, jnp.clip(i_b, 0, m - 1), 0,
+                               keepdims=False)),
+            jnp.clip(i_b, 0, m - 1), 0)
+        lsum = lsum + jnp.where(valid_b & is_last,
+                                lval.astype(jnp.float32), 0.0)
+        nxt_b = jax.lax.ppermute(jnp.where(valid_b, dx,
+                                           jnp.zeros_like(dx)),
+                                 axis_name, bwd_perm)
+        return (nxt_f, nxt_b, stash, gacc, tacc, dxs, lsum), None
+
+    act0 = vary(jnp.zeros_like(xs[0]))
+    stash0 = vary(jnp.zeros((ring,) + xs.shape[1:], xs.dtype))
+    # zero_p/zero_t derive from already stage-varying values — only the
+    # xs-derived/fresh buffers need the invariant→varying pcast
+    carry0 = (act0, act0, stash0, zero_p,
+              jax.tree_util.tree_map(jnp.zeros_like, tail),
+              vary(jnp.zeros_like(xs)),
+              vary(jnp.zeros((), jnp.float32)))
+    (_, _, _, gacc, tacc, dxs, lsum), _ = jax.lax.scan(
+        tick, carry0, jnp.arange(ticks))
+    # loss lives on the last stage, dxs on stage 0, tail grads on the
+    # last stage — psum broadcasts each (zeros elsewhere); stage grads
+    # keep their own stage's layout (matches the stacked params)
+    loss = jax.lax.psum(lsum, axis_name)
+    dxs = jax.lax.psum(dxs, axis_name)
+    tgrads = jax.tree_util.tree_map(
+        lambda a: jax.lax.psum(a, axis_name), tacc)
+    return loss, gacc, tgrads, dxs
+
+
+_1F1B_PROGRAMS = {}
+
+
+def pipeline_train_1f1b(stage_fn, loss_fn, stage_params, microbatches,
+                        labels, tail_params=None, mesh=None,
+                        axis_name="pp"):
+    """One fused 1F1B pipeline TRAIN step.
+
+    Returns ``(loss_sum, stage_grads, tail_grads, dxs)``:
+    ``stage_grads`` matches the ``stage_params`` stacking (leading stage
+    dim), ``tail_grads`` matches ``tail_params`` (the head that runs
+    inside ``loss_fn`` on the last stage — e.g. final norm + LM head),
+    and ``dxs`` is the cotangent wrt ``microbatches`` so an embedding
+    stack OUTSIDE the schedule can continue backward through the tape.
+    ``loss_fn(last_stage_out, labels_mb, tail_params) -> scalar`` runs
+    per microbatch on the last stage; ``loss_sum`` is the sum over
+    microbatches (scale inside ``loss_fn``).
+
+    Unlike :func:`pipeline_apply` (forward only, backward via scan
+    transpose, M+S-1 residual sets live), the 1F1B schedule interleaves
+    each microbatch's backward immediately behind its forward and
+    recomputes stage activations from a 2S-deep input stash — peak
+    activation memory is O(S), independent of M.  Gradients are produced
+    directly (no outer autodiff pass through the schedule); wire them
+    into the tape via ``autograd.Function`` (see
+    ``models.llama.llama_pipeline_train_step``).
+    """
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from . import current_mesh
+    from ..ndarray import NDArray
+
+    mesh = mesh or current_mesh()
+    if mesh is None:
+        raise MXNetError("no active mesh; call parallel.set_mesh first")
+    if axis_name not in mesh.shape:
+        raise MXNetError(f"mesh has no '{axis_name}' axis: {mesh.shape}")
+    n = mesh.shape[axis_name]
+    if tail_params is None:
+        tail_params = ()
+
+    treedef = jax.tree_util.tree_structure(stage_params)
+    leaves = jax.tree_util.tree_leaves(stage_params)
+    tail_def = jax.tree_util.tree_structure(tail_params)
+    tail_leaves = jax.tree_util.tree_leaves(tail_params)
+    n_tail = len(tail_leaves)
+    for lf in leaves:
+        if tuple(getattr(lf, "shape", ()))[:1] != (n,):
+            raise MXNetError(
+                f"stage_params leaves must be stacked to leading dim {n} "
+                f"(got {getattr(lf, 'shape', None)})")
+
+    def local_fn(p, x):
+        return stage_fn(jax.tree_util.tree_map(lambda a: a[0], p), x)
+
+    def g(xs_raw, labels_raw, *raws):
+        praws, traws = raws[:len(leaves)], raws[len(leaves):]
+        ptree = jax.tree_util.tree_unflatten(treedef, list(praws))
+        ttree = jax.tree_util.tree_unflatten(tail_def, list(traws))
+        loss, gacc, tgrads, dxs = jax.shard_map(
+            partial(_one_f_one_b_sharded, stage_fn=local_fn,
+                    loss_fn=loss_fn, axis_name=axis_name, n=n),
+            mesh=mesh,
+            in_specs=(jax.tree_util.tree_map(lambda a: P(axis_name),
+                                             ptree),
+                      jax.tree_util.tree_map(lambda a: P(), ttree),
+                      P(), P()),
+            out_specs=(P(),
+                       jax.tree_util.tree_map(lambda a: P(axis_name),
+                                              ptree),
+                       jax.tree_util.tree_map(lambda a: P(), ttree),
+                       P()),
+        )(ptree, ttree, xs_raw, labels_raw)
+        return ((loss,) + tuple(jax.tree_util.tree_leaves(gacc))
+                + tuple(jax.tree_util.tree_leaves(tgrads)) + (dxs,))
+
+    # this runs once per TRAINING STEP: memoize the jitted program so
+    # re-traces happen only on shape/config change, not every call.
+    # Keyed on the callables' identities (pinned in the cache value so
+    # id() can't be recycled), the mesh and the tree structures; jax.jit
+    # then caches compiles per input avals.
+    key = (id(stage_fn), id(loss_fn), id(mesh), axis_name, n,
+           treedef, tail_def)
+    hit = _1F1B_PROGRAMS.get(key)
+    if hit is None:
+        if len(_1F1B_PROGRAMS) >= 16:
+            _1F1B_PROGRAMS.clear()
+        import jax as _jax
+
+        hit = (_jax.jit(g), stage_fn, loss_fn, mesh)
+        _1F1B_PROGRAMS[key] = hit
+    jfn = hit[0]
+
+    xs_nd = (microbatches if isinstance(microbatches, NDArray)
+             else NDArray(np.asarray(microbatches)))
+    lab_nd = (labels if isinstance(labels, NDArray)
+              else NDArray(np.asarray(labels)))
+    nd_leaves = [lf if isinstance(lf, NDArray) else NDArray(lf)
+                 for lf in leaves + tail_leaves]
+    from ..ops.registry import apply_op
+
+    outs = apply_op(jfn, xs_nd, lab_nd, *nd_leaves,
+                    name="pipeline_train_1f1b")
+    loss = outs[0]
+    grads = jax.tree_util.tree_unflatten(
+        treedef, list(outs[1:1 + len(leaves)]))
+    tgrads = jax.tree_util.tree_unflatten(
+        tail_def, list(outs[1 + len(leaves):1 + len(leaves) + n_tail]))
+    dxs = outs[-1]
+    return loss, grads, tgrads, dxs
