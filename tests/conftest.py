@@ -39,10 +39,23 @@ _SLOW_MODULES = {
 }
 
 
+# Heaviest tier: the model-family suites (big configs, many compiles).
+# `pytest -m "not heavy"` is the mid lane — core + distributed-system
+# suites in a ~15-min window — while cheap per-family smokes live in the
+# fast lane (tests/test_model_smoke.py).
+_HEAVY_MODULES = {
+    "test_llama", "test_model_zoo", "test_nlp_models",
+    "test_detection_models", "test_moe", "test_onnx", "test_model",
+    "test_rnn", "test_quantization",
+}
+
+
 def pytest_collection_modifyitems(items):
     for item in items:
         if item.module.__name__ in _SLOW_MODULES:
             item.add_marker(pytest.mark.slow)
+        if item.module.__name__ in _HEAVY_MODULES:
+            item.add_marker(pytest.mark.heavy)
 
 
 @pytest.fixture(autouse=True)

@@ -1,0 +1,77 @@
+"""Fast-lane model-family smokes (VERDICT r2 #10: one cheap smoke per
+family in the <5-min core lane, while the heavy configs sit behind the
+``heavy`` marker).  Each case is a tiny-config forward(+backward) that
+proves the family's code path wires up — coverage depth stays in the
+heavy suites."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+
+
+def test_vision_resnet_smoke():
+    net = gluon.model_zoo.vision.get_model("resnet18_v1", classes=10)
+    net.initialize(mx.init.Xavier())
+    with autograd.record():
+        out = net(nd.random.uniform(shape=(2, 3, 32, 32)))
+        loss = (out ** 2).mean()
+    loss.backward()
+    assert out.shape == (2, 10) and np.isfinite(float(loss.asscalar()))
+
+
+def test_bert_smoke():
+    from mxnet_tpu.models import bert
+
+    net = bert.bert_tiny(vocab_size=128)
+    net.initialize(mx.init.Xavier())
+    ids = nd.array(np.random.RandomState(0).randint(0, 128, (2, 12)),
+                   dtype="int32")
+    seg = nd.zeros((2, 12), dtype="int32")
+    with autograd.record():
+        outs = net(ids, seg)
+        loss = (outs[-1] ** 2).mean()
+    loss.backward()
+    assert np.isfinite(float(loss.asscalar()))
+
+
+def test_llama_smoke():
+    from mxnet_tpu.models import llama
+
+    net = llama.llama_tiny(attn_mode="sdpa")
+    net.initialize()
+    ids = nd.array(np.random.RandomState(1).randint(0, 256, (2, 12)),
+                   dtype="int32")
+    with autograd.record():
+        logits = net(ids)
+        loss = nd.softmax_cross_entropy(
+            logits.reshape((-1, 256)), ids.reshape((-1,))).mean()
+    loss.backward()
+    assert logits.shape == (2, 12, 256)
+    assert np.isfinite(float(loss.asscalar()))
+
+
+def test_moe_smoke():
+    from mxnet_tpu.models import llama
+
+    net = llama.mixtral_tiny(attn_mode="sdpa")
+    net.initialize()
+    ids = nd.array(np.random.RandomState(2).randint(0, 256, (2, 12)),
+                   dtype="int32")
+    with autograd.record():
+        logits = net(ids)
+        loss = (logits ** 2).mean()
+    loss.backward()
+    assert np.isfinite(float(loss.asscalar()))
+
+
+def test_detection_ops_smoke():
+    # the detection families hinge on box ops; one NMS + ROIAlign pass
+    boxes = nd.array([[[0.1, 0.1, 0.4, 0.4, 0.9],
+                       [0.12, 0.12, 0.42, 0.42, 0.8],
+                       [0.6, 0.6, 0.9, 0.9, 0.7]]])
+    kept = nd.contrib.box_nms(boxes, overlap_thresh=0.5)
+    assert kept.shape == boxes.shape
+    feat = nd.random.uniform(shape=(1, 4, 8, 8))
+    rois = nd.array([[0, 1, 1, 6, 6]])
+    out = nd.ROIAlign(feat, rois, pooled_size=(2, 2), spatial_scale=1.0)
+    assert out.shape == (1, 4, 2, 2)
