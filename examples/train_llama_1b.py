@@ -24,7 +24,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 import mxnet_tpu as mx  # noqa: E402
-from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu import autograd, checkpoint, gluon, nd
 from mxnet_tpu.models import llama
 
 
@@ -72,26 +72,54 @@ def main():
         trainer.step(1)
         return loss
 
+    # D10 at scale: CKPT_DIR enables periodic atomic checkpoints and
+    # crash-resume — a rerun with the same dir continues from the newest
+    # complete step instead of restarting.  Every optimizer update is a
+    # counted step (the compile-paying first iteration included), so the
+    # resumed trajectory is update-for-update identical to an
+    # uninterrupted run.
+    ckpt_dir = os.environ.get("CKPT_DIR")
+    ckpt_every = int(os.environ.get("CKPT_EVERY", "100"))
+    start = 0
+    if ckpt_dir:
+        start, _ = checkpoint.resume(ckpt_dir, net, trainer)
+        if start:
+            print(f"resumed from step {start}")
+    if start >= steps - 1:
+        print(json.dumps({"model": f"llama_h2304_l{layers}",
+                          "resumed_at": start, "steps": steps,
+                          "note": "nothing left to train"}))
+        return
+
     print("compiling...")
     t0 = time.time()
-    first = float(step().asscalar())
-    print(f"first step {time.time()-t0:.0f}s loss={first:.3f}")
     tok_per_step = batch * seq
     tic = time.time()
-    done = 0
-    best = 0.0
+    win = 0  # steps measured in the current window (resets with tic so
+    best = 0.0  # checkpoint wall time never pollutes a tok/s sample)
     last = None
-    for i in range(1, steps):
+    first = None
+    for i in range(start + 1, steps):
         last = step()
-        done += 1
-        if done % log_every == 0:
+        if first is None:
+            last.wait_to_read()
+            first = float(last.asscalar())
+            print(f"first step {time.time()-t0:.0f}s loss={first:.3f}")
+            tic, win = time.time(), 0
+        else:
+            win += 1
+        if win >= log_every:
             last.wait_to_read()
             dt = time.time() - tic
-            tps = log_every * tok_per_step / dt
+            tps = win * tok_per_step / dt
             best = max(best, tps)
             print(f"step {i:4d} loss={float(last.asscalar()):.3f} "
                   f"{tps:,.0f} tok/s")
-            tic = time.time()
+            tic, win = time.time(), 0
+        if ckpt_dir and i % ckpt_every == 0:
+            last.wait_to_read()
+            checkpoint.save_checkpoint(ckpt_dir, i, net, trainer, keep=2)
+            tic, win = time.time(), 0
     final = float(last.asscalar())
     # model FLOPs: 6N per token fwd+bwd (remat recompute excluded — the
     # standard accounting); MFU vs 197 bf16 TFLOP/s
