@@ -71,7 +71,7 @@ def main():
         "unit": "GB/s",
         "devices": n,
         "payload_mb": mb,
-        "vs_baseline": 0.0,
+        "vs_baseline": None,
     }))
 
 

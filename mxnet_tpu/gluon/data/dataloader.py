@@ -31,6 +31,7 @@ import os
 import pickle
 import queue as _queue
 import threading
+import time
 import traceback
 
 import numpy as np
@@ -90,29 +91,69 @@ def _shm_unregister(name):
         pass
 
 
+def _proc_start_ticks(pid):
+    """Owner identity token: the process start time (clock ticks since
+    boot, field 22 of ``/proc/<pid>/stat``).  pid + start-ticks uniquely
+    names a process on this boot — a recycled pid gets fresh ticks."""
+    try:
+        with open(f"/proc/{pid}/stat", "rb") as f:
+            stat = f.read()
+        # comm (field 2) may contain spaces/parens; fields resume after
+        # the LAST ')'.
+        tail = stat[stat.rindex(b")") + 2:].split()
+        return int(tail[19])  # field 22 overall
+    except (OSError, ValueError, IndexError):
+        return None
+
+
+#: Legacy/foreign blocks are only reclaimed once this old (seconds) —
+#: guards against unlinking a live foreign-pid-namespace owner's block
+#: when /dev/shm is shared across containers (ADVICE r3).
+_SHM_SWEEP_MIN_AGE = 600.0
+
+
 def _shm_name(owner_pid):
-    """``mxt-<owner pid>-<random>`` shared-memory name: the pid tag is what
-    lets :func:`_sweep_stale_shm` tell live traffic from leaked blocks.
-    ``owner_pid`` is the loader parent's pid captured AT SPAWN — a worker
-    orphaned by a hard-killed parent would report ``getppid() == 1``,
-    which the sweep could never reclaim."""
+    """``mxt-<owner pid>-<start ticks>-<random>`` shared-memory name: the
+    pid+start-time tag is what lets :func:`_sweep_stale_shm` tell live
+    traffic from leaked blocks without pid-reuse false negatives
+    (ADVICE r3: bare-pid liveness breaks under pid recycling and shared
+    /dev/shm mounts).  ``owner_pid`` is the loader parent's pid captured
+    AT SPAWN — a worker orphaned by a hard-killed parent would report
+    ``getppid() == 1``, which the sweep could never reclaim."""
     import secrets
 
-    return f"mxt-{owner_pid}-{secrets.token_hex(6)}"
+    ticks = _proc_start_ticks(owner_pid)
+    tag = ticks if ticks is not None else 0
+    return f"mxt-{owner_pid}-{tag}-{secrets.token_hex(6)}"
 
 
 def _sweep_stale_shm():
-    """Unlink ``/dev/shm/mxt-<pid>-*`` blocks whose owner pid is dead.
+    """Unlink ``/dev/shm/mxt-<pid>-<ticks>-*`` blocks whose owner process
+    is gone.
 
     Blocks are unregistered from the resource_tracker when ownership moves
     worker→parent, so a hard-killed parent leaks them permanently; each
     pool startup reclaims any such leftovers (ADVICE r2: leak mode on
-    SIGKILL)."""
+    SIGKILL).  A block is reclaimed only when BOTH hold:
+
+    - its owner looks gone — the pid is dead, or its /proc start ticks
+      don't match the token baked into the name (so a recycled pid can't
+      pin a leaked block forever; legacy names without a ticks token use
+      bare pid-liveness);
+    - AND its mtime is older than :data:`_SHM_SWEEP_MIN_AGE`.
+
+    The unconditional age gate is what protects a live neighbor sharing
+    /dev/shm across pid namespaces (ADVICE r3): from inside another
+    container the owner's pid/ticks are unreadable or belong to a
+    different process, so "looks gone" is unavoidable — but its
+    in-flight blocks are seconds old and never meet the age bar, while
+    genuine leaks age past it and get reclaimed by a later sweep."""
     shm_dir = "/dev/shm"
     try:
         names = os.listdir(shm_dir)
     except OSError:
         return
+    now = time.time()
     for fn in names:
         if not fn.startswith("mxt-"):
             continue
@@ -121,13 +162,31 @@ def _sweep_stale_shm():
             pid = int(parts[1])
         except (IndexError, ValueError):
             continue
-        try:
-            os.kill(pid, 0)  # owner alive → in-flight, leave it
-        except ProcessLookupError:
+        ticks = None
+        if len(parts) >= 4:
             try:
-                os.unlink(os.path.join(shm_dir, fn))
-            except OSError:
+                ticks = int(parts[2])
+            except ValueError:
+                ticks = None
+        if ticks:
+            if _proc_start_ticks(pid) == ticks:
+                continue  # owner verifiably alive → in-flight
+        else:
+            try:
+                os.kill(pid, 0)
+                continue  # owner (or its pid-reuser) alive → leave it
+            except ProcessLookupError:
                 pass
+            except OSError:
+                continue
+        path = os.path.join(shm_dir, fn)
+        try:
+            if (now - os.stat(path).st_mtime) <= _SHM_SWEEP_MIN_AGE:
+                continue  # too fresh — could be a foreign namespace's
+        except OSError:
+            continue
+        try:
+            os.unlink(path)
         except OSError:
             pass
 

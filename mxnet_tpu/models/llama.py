@@ -298,13 +298,7 @@ class LlamaForCausalLM(HybridBlock):
 
     def hybrid_forward(self, F, input_ids):
         h = self.model(input_ids)
-        if self._cfg.tie_embeddings:
-            from ..ops.registry import apply_op
-
-            w = self.model.embed_tokens.weight.data()
-            return apply_op(lambda hr, wr: hr @ wr.T, h, w,
-                            name="tied_lm_head")
-        return self.lm_head(h)
+        return _lm_head(self, h)
 
     def generate(self, input_ids, max_new_tokens=16, use_cache=True,
                  do_sample=False, temperature=1.0, top_k=0, top_p=1.0,
@@ -698,6 +692,25 @@ def mixtral_tiny(**overrides):
                                            **overrides}))
 
 
+def _lm_head(net, h):
+    """Project hidden states to vocab logits for ``net`` — THE single
+    definition of the head routing: tied configs reuse the embedding
+    matrix ((V, H), recorded ``tied_lm_head`` op so the head gradient
+    accumulates into the tied embedding), untied use the dedicated
+    Dense.  Every forward path (plain, GPipe) must call this so the
+    routing can't diverge (ADVICE r3: the pipelined forward once used
+    the dead lm_head for tied configs); the fused 1F1B loss keeps an
+    inline jnp equivalent pinned by the tied/untied grad-equality
+    tests."""
+    if net._cfg.tie_embeddings:
+        from ..ops.registry import apply_op
+
+        w = net.model.embed_tokens.weight.data()
+        return apply_op(lambda hr, wr: hr @ wr.T, h, w,
+                        name="tied_lm_head")
+    return net.lm_head(h)
+
+
 def llama_pipeline_forward(net, input_ids, n_microbatches, mesh=None,
                            axis_name="pp"):
     """Forward the SAME ``LlamaForCausalLM`` Block over a GPipe pipeline
@@ -749,7 +762,7 @@ def llama_pipeline_forward(net, input_ids, n_microbatches, mesh=None,
             sh._data = s
     h_out = out.reshape((batch, t_len, hidden))
     h_out = net.model.norm(h_out)
-    return net.lm_head(h_out)
+    return _lm_head(net, h_out)
 
 
 def _pipeline_machinery(net, n_stages):

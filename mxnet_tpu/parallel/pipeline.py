@@ -326,7 +326,9 @@ def pipeline_train_1f1b(stage_fn, loss_fn, stage_params, microbatches,
     hit = _1F1B_PROGRAMS.get(key)
     if hit is None:
         if len(_1F1B_PROGRAMS) >= 16:
-            _1F1B_PROGRAMS.clear()
+            # evict oldest (insertion order), never the about-to-be-hot
+            # entry — clear() would re-trace every live config each step
+            _1F1B_PROGRAMS.pop(next(iter(_1F1B_PROGRAMS)))
         import jax as _jax
 
         hit = (_jax.jit(g), stage_fn, loss_fn, mesh)

@@ -396,6 +396,62 @@ def test_dataloader_process_no_shm_leak():
     assert not leaked, f"leaked shm segments: {leaked}"
 
 
+def test_shm_sweep_start_time_token():
+    """The stale-shm sweep keys liveness on pid + /proc start ticks
+    (ADVICE r3): a live owner's block survives, a dead/recycled owner's
+    block is reclaimed, and legacy bare-pid names need BOTH a dead pid
+    and an old mtime before they're touched."""
+    import os
+    import time as _time
+
+    from mxnet_tpu.gluon.data import dataloader as dl
+
+    if not os.path.isdir("/dev/shm"):
+        pytest.skip("no /dev/shm")
+
+    me = os.getpid()
+    ticks = dl._proc_start_ticks(me)
+    assert ticks is not None and ticks > 0
+    # a pid that can't exist → dead owner
+    dead_pid = 2 ** 22 + 12345
+
+    live = f"mxt-{me}-{ticks}-deadbeef0001"
+    recycled = f"mxt-{me}-{ticks + 777}-deadbeef0002"  # pid alive, ticks differ
+    dead = f"mxt-{dead_pid}-12345-deadbeef0003"
+    legacy = f"mxt-{dead_pid}-deadbeef0004"            # bare-pid name
+    paths = {}
+    for name in (live, recycled, dead, legacy):
+        p = os.path.join("/dev/shm", name)
+        with open(p, "w") as f:
+            f.write("x")
+        paths[name] = p
+    try:
+        # fresh blocks: NOTHING is reclaimed, even with a dead owner —
+        # the age gate protects live foreign-namespace owners whose
+        # pid/ticks we can't verify (shared /dev/shm mounts)
+        dl._sweep_stale_shm()
+        for name, p in paths.items():
+            assert os.path.exists(p), f"fresh block swept: {name}"
+        # age everything past the threshold → dead/recycled reclaimed,
+        # verifiably-live owner's block still kept
+        old = _time.time() - dl._SHM_SWEEP_MIN_AGE - 5
+        for p in paths.values():
+            os.utime(p, (old, old))
+        dl._sweep_stale_shm()
+        assert os.path.exists(paths[live]), "live owner's block swept"
+        assert not os.path.exists(paths[recycled]), \
+            "recycled-pid block not reclaimed"
+        assert not os.path.exists(paths[dead]), "dead-owner block kept"
+        assert not os.path.exists(paths[legacy]), \
+            "aged legacy block with dead owner not reclaimed"
+    finally:
+        for p in paths.values():
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
+
+
 def test_augment_basic_matches_device_numeric_stage():
     """The host-side augment_basic reference chain and ImageRecordIter's
     device-side numeric stage must never diverge."""
