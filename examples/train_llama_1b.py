@@ -113,12 +113,16 @@ def main():
         else:
             win += 1
         if win >= log_every:
-            last.wait_to_read()
+            # scalar fetch BEFORE reading the clock: through the tunnel
+            # wait_to_read can return at dispatch, and a window closed
+            # that way measures enqueue rate, not compute (the r4 MFU
+            # audit caught bench.py's old protocol pricing BERT >100%
+            # of peak) — only a host fetch proves the work is done
+            lv = float(last.asscalar())
             dt = time.time() - tic
             tps = win * tok_per_step / dt
             best = max(best, tps)
-            print(f"step {i:4d} loss={float(last.asscalar()):.3f} "
-                  f"{tps:,.0f} tok/s")
+            print(f"step {i:4d} loss={lv:.3f} {tps:,.0f} tok/s")
             tic, win = time.time(), 0
         if ckpt_dir and i % ckpt_every == 0:
             last.wait_to_read()

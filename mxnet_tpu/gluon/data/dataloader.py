@@ -106,10 +106,13 @@ def _proc_start_ticks(pid):
         return None
 
 
-#: Legacy/foreign blocks are only reclaimed once this old (seconds) —
-#: guards against unlinking a live foreign-pid-namespace owner's block
-#: when /dev/shm is shared across containers (ADVICE r3).
-_SHM_SWEEP_MIN_AGE = 600.0
+#: Blocks are only reclaimed once this old (seconds) — guards against
+#: unlinking a live foreign-pid-namespace owner's block when /dev/shm is
+#: shared across containers (ADVICE r3).  Set to an hour: in-flight
+#: handoff blocks live for seconds (worst observed stall: a multi-minute
+#: first jit compile), while genuine leaks persist forever, so a long
+#: gate costs only reclamation latency, never correctness.
+_SHM_SWEEP_MIN_AGE = 3600.0
 
 
 def _shm_name(owner_pid):

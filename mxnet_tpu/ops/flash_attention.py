@@ -10,8 +10,10 @@ matmuls per tile.  HBM traffic drops from O(T²) to O(T·D).
 
 Backward: ``jax.custom_vjp`` with a K-block-chunked jnp backward
 (``lax.scan``) — recompute-based, so backward memory is O(T·block) too.
-Non-TPU platforms (the CPU test mesh) fall back to a jnp reference
-implementation with identical semantics.
+Non-TPU platforms (the CPU test mesh) fall back to a jnp online-softmax
+scan with identical semantics AND the same O(T*block) score memory, so
+CPU lowerings (virtual-mesh scale proofs) price the flash memory
+profile rather than a dense (T, T) materialization.
 """
 from __future__ import annotations
 
@@ -41,6 +43,64 @@ def _sdpa_ref(q, k, v, causal, scale):
         s = jnp.where(mask, s, -jnp.inf)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)
+
+
+def _fa_forward_chunked(q, k, v, causal, scale, block=512):
+    """jnp online-softmax forward scanned over K blocks — the non-TPU
+    analog of the pallas kernel with the SAME O(T*block) score memory.
+    Replaces the dense ``_sdpa_ref`` fallback on CPU lowerings so the
+    scale-proof memory analysis (tools/scale_proof.py) prices the
+    flash memory profile, not a (T, T) materialization the real TPU
+    program never allocates."""
+    tq, tk = q.shape[-2], k.shape[-2]
+    block = min(block, tk)
+    # pad K/V up to a block multiple and mask the tail: non-multiple
+    # (even prime) lengths keep the O(T*block) profile AND the block-
+    # sized matmuls — neither a dense (tq, tk) slab nor a length-tk
+    # scan of width-1 steps
+    pad = (-tk) % block
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    if pad:
+        widths = [(0, 0)] * (k.ndim - 2) + [(0, pad), (0, 0)]
+        kf = jnp.pad(kf, widths)
+        vf = jnp.pad(vf, widths)
+    nk = (tk + pad) // block
+    qf = q.astype(jnp.float32)
+    kb = jnp.moveaxis(kf.reshape(*kf.shape[:-2], nk, block,
+                                 kf.shape[-1]), -3, 0)
+    vb = jnp.moveaxis(vf.reshape(*vf.shape[:-2], nk, block,
+                                 vf.shape[-1]), -3, 0)
+    qpos = jnp.arange(tq)
+
+    m0 = jnp.full(q.shape[:-1], -jnp.inf, jnp.float32)
+    l0 = jnp.zeros(q.shape[:-1], jnp.float32)
+    acc0 = jnp.zeros(qf.shape, jnp.float32)
+
+    def body(carry, inp):
+        m, l, acc = carry
+        j, kj, vj = inp
+        s = jnp.einsum("...qd,...kd->...qk", qf, kj,
+                       preferred_element_type=jnp.float32) * scale
+        kpos = j * block + jnp.arange(block)
+        keep = kpos[None, :] < tk  # padded tail keys never attend
+        if causal:
+            keep = keep & (qpos[:, None] + (tk - tq) >= kpos[None, :])
+        s = jnp.where(keep, s, -jnp.inf)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        safe_m = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        p = jnp.exp(s - safe_m[..., None])
+        p = jnp.where(jnp.isfinite(s), p, 0.0)
+        corr = jnp.where(jnp.isfinite(m), jnp.exp(m - safe_m), 0.0)
+        l_new = l * corr + p.sum(axis=-1)
+        acc_new = acc * corr[..., None] + jnp.einsum(
+            "...qk,...kd->...qd", p, vj,
+            preferred_element_type=jnp.float32)
+        return (m_new, l_new, acc_new), ()
+
+    (m, l, acc), _ = lax.scan(
+        body, (m0, l0, acc0), (jnp.arange(nk), kb, vb))
+    return (acc / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
 
 
 # --- pallas forward kernel ---------------------------------------------------
@@ -237,7 +297,7 @@ def flash_attention_raw(q, k, v, causal=False, scale=None):
     if _on_tpu() and q.shape[-2] % 128 == 0 and k.shape[-2] % 128 == 0 \
             and q.shape[-2] == k.shape[-2]:
         return _fa_forward_pallas(q, k, v, causal, scale)
-    return _sdpa_ref(q, k, v, causal, scale).astype(q.dtype)
+    return _fa_forward_chunked(q, k, v, causal, scale)
 
 
 def _fwd(q, k, v, causal, scale):

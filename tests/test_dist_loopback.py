@@ -371,3 +371,115 @@ def test_two_process_sync_batch_norm(tmp_path):
     assert rc == 0
     for i in range(n):
         assert os.path.exists(out + str(i)), f"rank {i} did not finish"
+
+
+_COMPOSED_WORKER = r"""
+import os
+import sys
+sys.path.insert(0, os.environ["REPO_ROOT"])
+# THE production topology in miniature: each process is a multi-chip
+# host (4 virtual devices), so the step composes GSPMD sharding INSIDE
+# the process with cross-process gradient collectives OUTSIDE it
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd, parallel
+
+parallel.initialize()
+rank, n = jax.process_index(), jax.process_count()
+assert n == 2, n
+assert len(jax.local_devices()) == 4, jax.local_devices()
+assert len(jax.devices()) == 8, jax.devices()
+
+# GSPMD mesh over this host's 4 LOCAL devices only (the in-host ICI
+# analog); the cross-host hop is dist_tpu_sync's process allreduce
+mesh = parallel.make_mesh({"dp": 4}, devices=jax.local_devices())
+with parallel.mesh_scope(mesh):
+    mx.random.seed(42)
+    net = gluon.nn.Dense(3, use_bias=True)
+    net.initialize(mx.init.Xavier())
+    net(nd.ones((1, 5)))
+    parallel.replicate_block_params(net)
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1, "momentum": 0.9},
+                            kvstore="dist_tpu_sync")
+
+    full = np.random.RandomState(0).randn(16, 5).astype(np.float32)
+    shard = full[rank * 8:(rank + 1) * 8]      # disjoint per-host data
+    x = parallel.shard_batch(nd.array(shard))  # GSPMD dp inside the host
+    for _ in range(4):
+        with autograd.record():
+            loss = (net(x) ** 2).sum()         # sum-loss: step() rescales
+        loss.backward()
+        trainer.step(16)                       # GLOBAL batch size
+assert trainer._kvstore.num_workers == n
+np.save(os.environ["OUT_FILE"] + str(rank) + ".npy",
+        np.concatenate([net.weight.data().asnumpy().ravel(),
+                        net.bias.data().asnumpy().ravel()]))
+"""
+
+
+@pytest.mark.skipif(sys.platform != "linux", reason="loopback group")
+def test_composed_multihost_topology_matches_single_process(tmp_path):
+    """VERDICT r3 item 7 — the production v5e-32 topology (8 hosts x 4
+    chips) in miniature: 2 processes x 4 virtual devices each.  GSPMD
+    shards the batch over each host's local 4-device mesh; the
+    cross-process gradient path rides dist_tpu_sync's process
+    allreduce — BOTH in one stock ``gluon.Trainer`` step.  Ranks must
+    end byte-identical AND equal to a single-process 8-device GSPMD run
+    over the same global batch (the composition changes the reduction
+    tree, not the math).  Reference composition style:
+    tests/nightly/dist_sync_kvstore.py:? (scheduler+server+worker in one
+    test)."""
+    import signal
+
+    import numpy as np
+
+    script = tmp_path / "composed_worker.py"
+    script.write_text(_COMPOSED_WORKER)
+    out = str(tmp_path / "params")
+    env = dict(os.environ)
+    env["OUT_FILE"] = out
+    env["MXT_LAUNCH_PLATFORM"] = "cpu"
+    env["REPO_ROOT"] = os.path.join(os.path.dirname(__file__), "..")
+    n = 2
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(TOOLS, "launch.py"), "-n", str(n),
+         "--coordinator", f"127.0.0.1:{_free_port()}",
+         sys.executable, str(script)], env=env, start_new_session=True)
+    try:
+        rc = proc.wait(timeout=300)
+    except subprocess.TimeoutExpired:
+        os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+        raise
+    assert rc == 0
+    got = [np.load(out + f"{i}.npy") for i in range(n)]
+    assert got[0].tobytes() == got[1].tobytes(), "ranks diverged"
+
+    # single-process 8-device GSPMD oracle over the full global batch
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon, nd, parallel
+
+    mesh = parallel.make_mesh({"dp": 8})
+    with parallel.mesh_scope(mesh):
+        mx.random.seed(42)
+        net = gluon.nn.Dense(3, use_bias=True)
+        net.initialize(mx.init.Xavier())
+        net(nd.ones((1, 5)))
+        parallel.replicate_block_params(net)
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.1, "momentum": 0.9},
+                                kvstore="dist_tpu_sync")
+        x = parallel.shard_batch(nd.array(
+            np.random.RandomState(0).randn(16, 5).astype(np.float32)))
+        for _ in range(4):
+            with autograd.record():
+                loss = (net(x) ** 2).sum()
+            loss.backward()
+            trainer.step(16)
+        want = np.concatenate([net.weight.data().asnumpy().ravel(),
+                               net.bias.data().asnumpy().ravel()])
+    np.testing.assert_allclose(got[0], want, rtol=1e-5, atol=1e-6)

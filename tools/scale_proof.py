@@ -1,0 +1,433 @@
+#!/usr/bin/env python
+"""Production-mesh scale proofs: AOT-compile REAL train steps on large
+virtual device meshes and let XLA's memory analysis carry the HBM-fit
+claim (VERDICT r3 item 5 — replaces hand byte-math as the load-bearing
+number).
+
+Two workloads:
+
+- ``llama8b32``: Llama-3-8B full train step on a 32-virtual-device
+  dp4 x tp8 mesh (the production v5e-32 layout the r3 artifact only
+  byte-mathed), per-chip batch 2 x seq 4096, PER-LAYER remat, bf16
+  params, f32 Adam moments, donated buffers.  LLAMA8B_LOWER_r04.json.
+- ``mixtral``: Mixtral-8x7B (46.7B total, top-2 of 8 experts) full
+  train step on a 64-virtual-device dp2 x ep8 x tp4 mesh, per-layer
+  remat, bf16 params, f32 SGD momentum (Adam's f32 m+v cannot fit 16
+  GiB at this scale — recorded in the artifact), topk router with
+  fixed-capacity dispatch.  MIXTRAL_LOWER_r04.json.
+
+No parameter array is ever materialized: parameters enter the jitted
+step as ``jax.ShapeDtypeStruct`` avals sharded by the SAME rule tables
+the real placement path uses (``llama_param_pspecs`` /
+``moe_param_specs``), so what compiles here is exactly what would run
+on the slice.  The artifact records XLA's per-device memory analysis
+(argument/temp/output bytes), the post-SPMD collective counts, and the
+old byte math alongside for comparison.
+
+Run: ``python tools/scale_proof.py llama8b32|mixtral [out.json]``
+(self-contained: forces the virtual CPU device count before jax init).
+"""
+import json
+import os
+import re
+import sys
+import time
+
+WORKLOADS = {
+    "llama8b32": dict(n_devices=32, mesh={"dp": 4, "tp": 8}),
+    "mixtral": dict(n_devices=64, mesh={"dp": 2, "ep": 8, "tp": 4}),
+}
+
+_DUMP_DIR = "/tmp/scale_proof_dump"
+
+if __name__ == "__main__":
+    _w = sys.argv[1] if len(sys.argv) > 1 else "llama8b32"
+    import shutil
+
+    shutil.rmtree(_DUMP_DIR, ignore_errors=True)
+    os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + \
+        f" --xla_force_host_platform_device_count={WORKLOADS[_w]['n_devices']}" \
+        f" --xla_dump_to={_DUMP_DIR}"
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def _shell_params(net):
+    """Replace every Parameter's storage with an empty shell handle:
+    tracing swaps tracers into ``._data`` so no real array is needed
+    (the CachedOp handle-swap trick, gluon/block.py _CachedGraph)."""
+    import numpy as np
+
+    from mxnet_tpu.ndarray import NDArray
+
+    params = net._collect_params_with_prefix()
+    shapes, shells = {}, {}
+    for name, p in params.items():
+        shape = tuple(int(s) for s in (p.shape or ()))
+        assert shape and all(s > 0 for s in shape), \
+            f"{name} shape not fully declared: {p.shape}"
+        shapes[name] = shape
+        a = NDArray.__new__(NDArray)
+        a._data = None
+        a._node = None
+        a._oidx = 0
+        a._req_grad = False
+        a._grad = None
+        a._grad_req = "null"
+        p._data = a
+        shells[name] = a
+    n_params = sum(int(np.prod(s)) for s in shapes.values())
+    return params, shapes, shells, n_params
+
+
+LAYER0_PREFIX = "model.layers.0."
+
+
+def _remat_forward(net, shells, p_raws, ids_r, head=True,
+                   no_remat=False, act_sharding=None):
+    """embed -> lax.scan(jax.checkpoint(layer)) -> norm -> head.
+
+    Same math as ``LlamaModel.hybrid_forward`` + ``_lm_head``, shaped
+    the way a production TPU trainer compiles it (r4 memory findings):
+
+    - **scan over stacked layer params** (p_raws carries ONE (L, ...)
+      array per layer parameter; the layer-0 Block is the template,
+      handle-swapped per iteration — the pipeline machinery's trick).
+      A python layer loop gave XLA one copy of every per-layer buffer
+      (collective buffers included): ~1 GiB x L of temp that scan
+      eliminates by construction, and L x faster tracing.
+    - **jax.checkpoint around the scan body**: only the (L, B, T, H)
+      layer-boundary stack survives to the backward.
+    - **one-hot MATMUL embedding lookup**: the transpose of a gather
+      over the vocab-sharded table is a scatter-add that GSPMD lowers
+      by materializing the FULL f32 table per device (measured 2
+      GiB/device on 8B); as a matmul, lookup AND gradient are ordinary
+      sharded contractions.
+    - ``act_sharding`` pins the residual stream (P('dp', None, None))
+      at the scan boundary so GSPMD can't replicate it over dp.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from mxnet_tpu.ndarray import NDArray
+
+    def pin(x):
+        if act_sharding is not None:
+            return jax.lax.with_sharding_constraint(x, act_sharding)
+        return x
+
+    for name, sh in shells.items():
+        if not name.startswith("model.layers."):
+            sh._data = p_raws[name]
+    table = p_raws["model.embed_tokens.weight"]
+    onehot = jax.nn.one_hot(ids_r, table.shape[0], dtype=table.dtype)
+    h = pin(jnp.einsum("btv,vh->bth", onehot, table))
+
+    template = net.model.layers[0]
+    suffixes = [n[len(LAYER0_PREFIX):] for n in shells
+                if n.startswith(LAYER0_PREFIX)]
+
+    def apply_layer(pslice, hr):
+        for sfx in suffixes:
+            shells[LAYER0_PREFIX + sfx]._data = pslice[sfx]
+        return pin(template(NDArray(hr))._data)
+
+    wrap = (lambda f: f) if no_remat else jax.checkpoint
+
+    def body(hr, pslice):
+        return wrap(apply_layer)(pslice, hr), ()
+
+    stacked = {sfx: p_raws["stacked_layers." + sfx] for sfx in suffixes}
+    h, _ = lax.scan(body, h, stacked)
+
+    h = net.model.norm(NDArray(h))._data
+    if not head:
+        return h
+    if net._cfg.tie_embeddings:
+        return h @ p_raws["model.embed_tokens.weight"].T
+    return net.lm_head(NDArray(h))._data
+
+
+def _cpu_upcast_artifact_bytes(n_layers):
+    """Sum the preallocated-temp slots that are f32 CONVERTS of bf16
+    layer-stacked arrays (shape leading dim == n_layers, producer a
+    convert fusion) in the dumped buffer assignment — the XLA:CPU
+    bf16-dot upcast artifact quantified in the fit verdict.  Returns
+    (bytes, [slot descriptions])."""
+    import glob
+    import re
+
+    files = glob.glob(os.path.join(_DUMP_DIR,
+                                   "*buffer-assignment.txt"))
+    if not files:
+        return 0, []
+    txt = open(max(files, key=os.path.getmtime)).read()
+    m = re.search(r"allocation \d+: size \d+, preallocated-temp:(.*?)"
+                  r"(?=\nallocation |\Z)", txt, re.S)
+    if not m:
+        return 0, []
+    slots = {}
+    for name, sz, off, shape in re.findall(
+            r"value: <\d+ ([\w.\-]+) @0> \(size=(\d+),offset=(\d+)\): "
+            r"(\S+)", m.group(1)):
+        slots.setdefault((int(off), int(sz)), []).append((name, shape))
+    total, picked = 0, []
+    for (off, sz), vals in slots.items():
+        for name, shape in vals:
+            if re.match(rf"f32\[{n_layers},", shape) and "convert" in name:
+                total += sz
+                picked.append(f"{shape} {name} ({sz / 2**20:.0f} MB)")
+                break
+    return total, picked
+
+
+def main():
+    which = sys.argv[1] if len(sys.argv) > 1 else "llama8b32"
+    out_path = sys.argv[2] if len(sys.argv) > 2 else None
+    spec = WORKLOADS[which]
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from mxnet_tpu import parallel
+    from mxnet_tpu.models import llama
+
+    sp_layers = int(os.environ.get("SP_LAYERS", "0"))
+    sp_attn = os.environ.get("SP_ATTN", "flash")
+
+    t0 = time.time()
+    if which == "llama8b32":
+        net = llama.llama3_8b(attn_mode=sp_attn)
+        if sp_layers:  # memory-scaling experiments only
+            net = llama.LlamaForCausalLM(llama.LlamaConfig(
+                **{**llama.LLAMA_CONFIGS["llama3_8b"],
+                   "num_layers": sp_layers, "attn_mode": sp_attn}))
+        optimizer = "adam_f32_moments"
+        n_state = 2  # m, v
+        per_chip_batch, seq = 2, 4096
+    else:
+        net = llama.mixtral_8x7b(attn_mode="flash")
+        optimizer = "sgd_f32_momentum"
+        n_state = 1  # momentum
+        per_chip_batch, seq = 1, 4096
+    cfg = net._cfg
+
+    mesh = parallel.make_mesh(spec["mesh"])
+    dp = spec["mesh"].get("dp", 1)
+    batch = per_chip_batch * dp
+
+    params, shapes, shells, n_params = _shell_params(net)
+    pspecs = llama.llama_param_pspecs(net, mesh)
+    # abstract step arguments: non-layer params by name, plus ONE
+    # layer-stacked (L, ...) entry per layer-0 parameter (scan operand);
+    # stacking adds a leading unsharded axis to the layer-0 pspec
+    n_layers = cfg.num_layers
+    abs_shapes, abs_specs = {}, {}
+    for name, shp in shapes.items():
+        if name.startswith("model.layers."):
+            if not name.startswith(LAYER0_PREFIX):
+                continue
+            sfx = name[len(LAYER0_PREFIX):]
+            abs_shapes["stacked_layers." + sfx] = (n_layers,) + shp
+            abs_specs["stacked_layers." + sfx] = \
+                (None,) + tuple(pspecs.get(name, ()))
+        else:
+            abs_shapes[name] = shp
+            abs_specs[name] = tuple(pspecs.get(name, ()))
+    shard = {name: NamedSharding(mesh, P(*abs_specs[name]))
+             for name in abs_shapes}
+
+    # SP_* env knobs: memory-shape experiments (debugging what drives
+    # XLA's temp_size); the committed artifact uses the defaults.
+    no_remat = bool(int(os.environ.get("SP_NO_REMAT", "0")))
+    no_opt = bool(int(os.environ.get("SP_NO_OPT", "0")))
+    ce_chunks = int(os.environ.get("SP_CE_CHUNKS", "0"))
+
+    act_sharding = (None if int(os.environ.get("SP_NO_ACT_PIN", "0"))
+                    else NamedSharding(mesh, P("dp", None, None)))
+
+    def _ce(logits, labels_r):
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        nll = -jnp.take_along_axis(
+            logp, labels_r.astype(jnp.int32)[..., None], axis=-1)
+        return nll.sum()
+
+    def loss_fn(p_raws, ids_r, labels_r):
+        if ce_chunks:
+            # chunk the vocab-wide CE over the sequence axis so the
+            # (B, T, V) f32 logits never exist whole: per chunk,
+            # recompute head-projection + CE under jax.checkpoint
+            h = _remat_forward(net, shells, p_raws, ids_r,
+                               head=False, no_remat=no_remat,
+                               act_sharding=act_sharding)
+            w = (p_raws["model.embed_tokens.weight"]
+                 if net._cfg.tie_embeddings
+                 else p_raws["lm_head.weight"])
+
+            def chunk_ce(hc, lc):
+                return _ce(hc @ w.T, lc)
+
+            total = 0.0
+            t_len = h.shape[1]
+            step = t_len // ce_chunks
+            for c in range(ce_chunks):
+                sl = slice(c * step, (c + 1) * step)
+                total = total + jax.checkpoint(chunk_ce)(
+                    h[:, sl], labels_r[:, sl])
+            return total / (batch * seq)
+        logits = _remat_forward(net, shells, p_raws, ids_r,
+                                no_remat=no_remat,
+                                act_sharding=act_sharding)
+        return _ce(logits, labels_r) / (batch * seq)
+
+    if no_opt:
+        def train_step(p_raws, ids_r, labels_r):
+            return jax.value_and_grad(loss_fn)(p_raws, ids_r, labels_r)
+
+        donate = ()
+        n_state = 0
+    elif which == "llama8b32":
+        def train_step(p_raws, m, v, ids_r, labels_r):
+            loss, grads = jax.value_and_grad(loss_fn)(p_raws, ids_r,
+                                                      labels_r)
+            new_m = jax.tree.map(
+                lambda mm, g: 0.9 * mm + 0.1 * g.astype(jnp.float32),
+                m, grads)
+            new_v = jax.tree.map(
+                lambda vv, g: 0.999 * vv
+                + 0.001 * jnp.square(g.astype(jnp.float32)), v, grads)
+            new_p = jax.tree.map(
+                lambda p, mm, vv: (
+                    p.astype(jnp.float32) - 1e-4 * mm
+                    / (jnp.sqrt(vv) + 1e-8)).astype(p.dtype),
+                p_raws, new_m, new_v)
+            return loss, new_p, new_m, new_v
+
+        donate = (0, 1, 2)
+    else:
+        def train_step(p_raws, mom, ids_r, labels_r):
+            loss, grads = jax.value_and_grad(loss_fn)(p_raws, ids_r,
+                                                      labels_r)
+            new_mom = jax.tree.map(
+                lambda mm, g: 0.9 * mm - 1e-3 * g.astype(jnp.float32),
+                mom, grads)
+            new_p = jax.tree.map(
+                lambda p, mm: (p.astype(jnp.float32)
+                               + mm).astype(p.dtype),
+                p_raws, new_mom)
+            return loss, new_p, new_mom
+
+        donate = (0, 1)
+
+    abs_p = {n: jax.ShapeDtypeStruct(abs_shapes[n], jnp.bfloat16,
+                                     sharding=shard[n])
+             for n in abs_shapes}
+    abs_s = {n: jax.ShapeDtypeStruct(abs_shapes[n], jnp.float32,
+                                     sharding=shard[n])
+             for n in abs_shapes}
+    data_sharding = NamedSharding(mesh, P("dp", None))
+    abs_ids = jax.ShapeDtypeStruct((batch, seq), jnp.int32,
+                                   sharding=data_sharding)
+
+    with parallel.mesh_scope(mesh):
+        jitted = jax.jit(train_step, donate_argnums=donate)
+        state_args = (abs_s,) * n_state
+        lowered = jitted.lower(abs_p, *state_args, abs_ids, abs_ids)
+    lower_sec = time.time() - t0
+
+    t1 = time.time()
+    compiled = lowered.compile()
+    compile_sec = time.time() - t1
+    hlo = compiled.as_text()
+    collectives = {k: len(re.findall(k, hlo)) for k in
+                   ("all-reduce", "collective-permute", "all-gather",
+                    "reduce-scatter", "all-to-all")}
+    mem = {}
+    try:
+        ma = compiled.memory_analysis()
+        for k in ("argument_size_in_bytes", "output_size_in_bytes",
+                  "alias_size_in_bytes", "temp_size_in_bytes",
+                  "generated_code_size_in_bytes"):
+            v = getattr(ma, k, None)
+            if v is not None:
+                mem[k] = int(v)
+    except Exception as e:
+        mem["unavailable"] = str(e)
+
+    cpu_artifact_b, cpu_artifact_slots = _cpu_upcast_artifact_bytes(
+        cfg.num_layers)
+
+    verdict = {}
+    if "argument_size_in_bytes" in mem:
+        # resident working set per device: live arguments + XLA temps
+        # (donated outputs alias arguments — alias_size removes the
+        # double count when reported)
+        args_b = mem["argument_size_in_bytes"]
+        temp_b = mem.get("temp_size_in_bytes", 0)
+        resident = args_b + temp_b
+        corrected = resident - cpu_artifact_b
+        verdict = {
+            "resident_bytes_per_device_args_plus_temp": resident,
+            "resident_gib_per_device": round(resident / 2 ** 30, 2),
+            # XLA:CPU lowers every bf16 dot by converting its operands
+            # to f32 and LICM-hoists those converts of scanned weight /
+            # boundary stacks OUT of the loop, materializing full f32
+            # copies of bf16 stacks.  A TPU lowering never does this —
+            # the MXU consumes bf16 natively (minimal repro: scan +
+            # pure-bf16 dot_general shows the same f32[L,...] stacks on
+            # CPU).  The artifact below sums exactly those hoisted
+            # f32-of-bf16-stack slots from the buffer assignment.
+            "cpu_bf16_upcast_artifact_bytes": cpu_artifact_b,
+            "cpu_bf16_upcast_artifact_gib": round(
+                cpu_artifact_b / 2 ** 30, 2),
+            "cpu_bf16_upcast_artifact_slots": cpu_artifact_slots,
+            "resident_gib_corrected_for_cpu_artifact": round(
+                corrected / 2 ** 30, 2),
+            "hbm_budget_gib": 16.0,
+            "fits_16gib_raw_cpu_analysis": bool(
+                resident < 16 * 2 ** 30),
+            "fits_16gib_corrected": bool(corrected < 16 * 2 ** 30),
+        }
+
+    artifact = {
+        "proof": f"{which}: full train step AOT-compiled on "
+                 f"{spec['n_devices']} virtual devices "
+                 f"(mesh {spec['mesh']}), per-layer remat, no arrays "
+                 "materialized — XLA memory analysis is the "
+                 "load-bearing HBM-fit number",
+        "config": {"hidden": cfg.hidden_size, "layers": cfg.num_layers,
+                   "heads": cfg.num_heads, "kv_heads": cfg.num_kv_heads,
+                   "ffn": cfg.intermediate_size, "vocab": cfg.vocab_size,
+                   "num_experts": cfg.num_experts,
+                   "experts_per_tok": cfg.num_experts_per_tok,
+                   "attn_mode": "flash"},
+        "n_params": n_params,
+        "mesh": spec["mesh"],
+        "n_devices": spec["n_devices"],
+        "global_batch_x_seq": [batch, seq],
+        "per_chip_batch": per_chip_batch,
+        "param_dtype": "bfloat16",
+        "optimizer": optimizer,
+        "remat": "per-decoder-layer jax.checkpoint",
+        "donated": "params + optimizer state",
+        "lower_sec": round(lower_sec, 1),
+        "compile_sec": round(compile_sec, 1),
+        "spmd_collectives": collectives,
+        "xla_memory_analysis_per_device": mem,
+        "fit_verdict": verdict,
+        "time": time.strftime("%Y-%m-%dT%H:%M:%S%z"),
+    }
+    blob = json.dumps(artifact, indent=1)
+    print(blob)
+    if out_path:
+        with open(out_path, "w") as f:
+            f.write(blob + "\n")
+
+
+if __name__ == "__main__":
+    main()
