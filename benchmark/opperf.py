@@ -69,6 +69,12 @@ def _cases(nd, mxr):
     spd = nd.dot(la, la, transpose_b=True) + 512 * nd.eye(512)
 
     conv_flops = 2 * B * C * C * 3 * 3 * H * W
+    qcx, qcx_mn, qcx_mx = nd.quantize_v2(x_conv.astype("float32"),
+                                         out_type="int8")
+    qcw, qcw_mn, qcw_mx = nd.quantize_v2(w3.astype("float32"),
+                                         out_type="int8")
+    qma, qma_mn, qma_mx = nd.quantize_v2(a32, out_type="int8")
+    qmb, qmb_mn, qmb_mx = nd.quantize_v2(b32, out_type="int8")
     return [
         ("conv3x3_b64_c256_s56_bf16",
          lambda x, w: nd.Convolution(x, w, kernel=(3, 3), pad=(1, 1),
@@ -79,6 +85,20 @@ def _cases(nd, mxr):
                                      no_bias=True),
          [x_conv, w1], 2 * B * C * C * H * W, 0),
         ("matmul_2048_bf16", lambda a, b: nd.dot(a, b), [a_mm, b_mm],
+         2 * M * N * K, 0),
+        # int8 MXU rows (VERDICT r3 item 4): v5e's 2x int8 headline —
+        # pre-quantized operands, the row measures the int8xint8->int32
+        # contraction itself ("gflops" = int ops, 1 MAC = 2)
+        ("quantized_conv3x3_b64_c256_s56_int8",
+         lambda qx, qw, a1, a2, a3, a4: nd.quantized_conv(
+             qx, qw, a1, a2, a3, a4, kernel=(3, 3), pad=(1, 1),
+             num_filter=C, no_bias=True)[0],
+         [qcx, qcw, qcx_mn, qcx_mx, qcw_mn, qcw_mx], conv_flops, 0),
+        ("quantized_matmul_2048_int8",
+         lambda qa, qb, a1, a2, a3, a4: nd.quantized_fully_connected(
+             qa, qb, a1, a2, a3, a4, num_hidden=N, no_bias=True,
+             flatten=False)[0],
+         [qma, qmb, qma_mn, qma_mx, qmb_mn, qmb_mx],
          2 * M * N * K, 0),
         ("matmul_2048_f32", lambda a, b: nd.dot(a, b), [a32, b32],
          2 * M * N * K, 0),
@@ -238,12 +258,21 @@ def main():
     for name, fn, inputs, flops, nbytes in _cases(nd, mx.random):
         if filt and filt not in name:
             continue
-        best = _measure(fn, inputs, inner, repeats)
+        # adaptive chain length (VERDICT r3 weak 3): if the slope
+        # vanishes into RTT jitter at this length, the per-op cost is
+        # below the floor — QUADRUPLE the chain until the aggregate
+        # delta dominates the noise (caps at 64x so a genuinely-free op
+        # can't spin forever)
+        inner_n = inner
+        best = _measure(fn, inputs, inner_n, repeats)
+        while best <= 2e-9 and inner_n < inner * 64:
+            inner_n *= 4
+            best = _measure(fn, inputs, inner_n, repeats)
         row = {"usec_per_call": round(best * 1e6, 2)}
+        if inner_n != inner:
+            row["chain_len"] = inner_n
         if best <= 2e-9:
-            # slope vanished into RTT jitter: the op is cheaper than the
-            # measurement floor at this scan length — don't read the
-            # derived throughputs as real
+            # still unresolved at the longest chain — flag honestly
             row["below_noise_floor"] = True
         if flops:
             row["gflops_per_sec"] = round(flops / best / 1e9, 1)

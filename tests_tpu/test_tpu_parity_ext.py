@@ -403,8 +403,9 @@ _BWD_UNARY2 = [
     ("pad_like", lambda x: nd.concat(x, x * 0.5, dim=1), BX),
     ("stack", lambda x: nd.stack(x, x * 2.0, axis=0), BX),
     ("squeeze_expand", lambda x: nd.expand_dims(x, axis=0), BX),
-    ("dropout_eval", lambda x: nd.Dropout(x, p=0.5, mode="training"),
-     BX),  # eval-mode forward == identity, grad too (not recording RNG)
+    ("dropout_p0", lambda x: nd.Dropout(x, p=0.0, mode="training"),
+     BX),  # p=0 keeps the op on the recorded path with NO live mask —
+           # a p>0 mask would draw different RNG keys per backend
     ("gather_nd", lambda x: nd.gather_nd(x, nd.array(
         np.array([[0, 1], [1, 2]]), dtype="int32")), BX),
     ("batchnorm_like",

@@ -1,0 +1,134 @@
+"""Composed multi-host topology harness — ONE definition of the
+"2 processes x N local virtual devices" loopback (GSPMD batch sharding
+inside each process, dist_tpu_sync's cross-process gradient allreduce
+outside, one stock ``gluon.Trainer`` step), shared by
+``__graft_entry__.dryrun_multichip`` phase 5 and
+``tests/test_dist_loopback.py`` so the topology and launch contract
+cannot drift between the two (they briefly did in r4).
+
+Reference composition style: the nightly dist tests always ran the full
+scheduler+server+worker stack in one script
+(tests/nightly/dist_sync_kvstore.py:?).
+"""
+from __future__ import annotations
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Per-rank worker: {local} virtual CPU devices, GSPMD dp over the LOCAL
+# mesh, disjoint per-rank rows of a shared global batch, {steps}
+# momentum-SGD steps at global batch size — then dump weight+bias.
+_WORKER = """
+import os
+import sys
+sys.path.insert(0, {repo!r})
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count={local}"
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd, parallel
+
+parallel.initialize()
+rank, n = jax.process_index(), jax.process_count()
+assert n == 2, n
+assert len(jax.local_devices()) == {local}, jax.local_devices()
+assert len(jax.devices()) == 2 * {local}, jax.devices()
+
+mesh = parallel.make_mesh({{"dp": {local}}}, devices=jax.local_devices())
+with parallel.mesh_scope(mesh):
+    mx.random.seed({seed})
+    net = gluon.nn.Dense(3, use_bias=True)
+    net.initialize(mx.init.Xavier())
+    net(nd.ones((1, 5)))
+    parallel.replicate_block_params(net)
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {{"learning_rate": 0.1, "momentum": 0.9}},
+                            kvstore="dist_tpu_sync")
+    rows = 2 * {local}                       # per-rank rows
+    full = np.random.RandomState(0).randn(2 * rows, 5).astype(np.float32)
+    x = parallel.shard_batch(nd.array(
+        full[rank * rows:(rank + 1) * rows]))
+    for _ in range({steps}):
+        with autograd.record():
+            loss = (net(x) ** 2).sum()       # sum-loss: step() rescales
+        loss.backward()
+        trainer.step(2 * rows)               # GLOBAL batch size
+assert trainer._kvstore.num_workers == n
+np.save(os.environ["OUT_FILE"] + str(rank) + ".npy",
+        np.concatenate([net.weight.data().asnumpy().ravel(),
+                        net.bias.data().asnumpy().ravel()]))
+"""
+
+
+def global_batch(n_local):
+    return 4 * n_local
+
+
+def run_composed(n_local, steps=4, seed=42, timeout=300):
+    """Launch the 2-process composed topology; returns the two ranks'
+    flattened (weight, bias) arrays.  Raises on nonzero exit."""
+    import numpy as np
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    with tempfile.TemporaryDirectory() as td:
+        script = os.path.join(td, "composed_worker.py")
+        with open(script, "w") as f:
+            f.write(_WORKER.format(repo=REPO, local=n_local, seed=seed,
+                                   steps=steps))
+        out = os.path.join(td, "params")
+        env = dict(os.environ)
+        env["OUT_FILE"] = out
+        env["MXT_LAUNCH_PLATFORM"] = "cpu"
+        proc = subprocess.Popen(
+            [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+             "-n", "2", "--coordinator", f"127.0.0.1:{port}",
+             sys.executable, script], env=env, start_new_session=True)
+        try:
+            rc = proc.wait(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+            raise
+        if rc != 0:
+            raise RuntimeError(f"composed multi-host workers rc={rc}")
+        return [np.load(out + f"{i}.npy") for i in range(2)]
+
+
+def oracle_single_process(n_local, steps=4, seed=42):
+    """The single-process GSPMD oracle over the same global batch on a
+    2*n_local-device dp mesh (call from a process that HAS the devices,
+    e.g. under tests/conftest's virtual mesh)."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon, nd, parallel
+
+    mesh = parallel.make_mesh({"dp": 2 * n_local})
+    with parallel.mesh_scope(mesh):
+        mx.random.seed(seed)
+        net = gluon.nn.Dense(3, use_bias=True)
+        net.initialize(mx.init.Xavier())
+        net(nd.ones((1, 5)))
+        parallel.replicate_block_params(net)
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.1, "momentum": 0.9},
+                                kvstore="dist_tpu_sync")
+        gb = global_batch(n_local)
+        x = parallel.shard_batch(nd.array(
+            np.random.RandomState(0).randn(gb, 5).astype(np.float32)))
+        for _ in range(steps):
+            with autograd.record():
+                loss = (net(x) ** 2).sum()
+            loss.backward()
+            trainer.step(gb)
+        return np.concatenate([net.weight.data().asnumpy().ravel(),
+                               net.bias.data().asnumpy().ravel()])
