@@ -117,9 +117,9 @@ def main():
             data_ips, data_note = _bench_resnet_recordio(
                 net, trainer, loss_fn, batch, image,
                 min(steps, int(os.environ.get("BENCH_DATA_STEPS", "20"))))
-            record["resnet50_recordio_images_per_sec_per_chip"] = \
+            record[f"{model}_recordio_images_per_sec_per_chip"] = \
                 round(data_ips, 2)
-            record["resnet50_recordio_note"] = data_note
+            record[f"{model}_recordio_note"] = data_note
         except Exception as e:
             record["recordio_error"] = f"{type(e).__name__}: {e}"
 

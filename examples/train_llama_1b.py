@@ -44,7 +44,11 @@ def main():
     net = llama.LlamaForCausalLM(llama.LlamaConfig(
         hidden_size=2304, intermediate_size=6144, num_layers=layers,
         num_heads=18, num_kv_heads=6, vocab_size=vocab,
-        max_seq_len=seq, attn_mode="flash"))
+        max_seq_len=seq, attn_mode="flash",
+        # SCAN_LAYERS=1: lax.scan over the stacked decoder — layer-
+        # count-independent compile, one layer's buffers, per-iteration
+        # remat; costs one recorded weight restack per step (r4)
+        scan_layers=bool(int(os.environ.get("SCAN_LAYERS", "0")))))
     net.initialize(mx.init.Normal(0.02))
     net(nd.ones((1, 8), dtype="int32"))  # resolve deferred shapes cheaply
     n_params = sum(int(np.prod(p.shape))

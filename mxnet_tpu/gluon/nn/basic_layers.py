@@ -339,13 +339,19 @@ class SyncBatchNorm(BatchNorm):
 
 
 class Embedding(HybridBlock):
+    """``matmul_lookup=True`` lowers the lookup as a one-hot matmul so a
+    vocab-sharded (TP) table gets sharded-contraction forward AND
+    backward instead of a full-table scatter-add (see
+    ops.nn_ops.embedding); leave False for replicated tables."""
+
     def __init__(self, input_dim, output_dim, dtype=np.float32,
-                 weight_initializer=None, sparse_grad=False, prefix=None,
-                 params=None):
+                 weight_initializer=None, sparse_grad=False,
+                 matmul_lookup=False, prefix=None, params=None):
         super().__init__(prefix=prefix, params=params)
         self._input_dim = input_dim
         self._output_dim = output_dim
         self._sparse_grad = sparse_grad
+        self._matmul_lookup = matmul_lookup
         grad_stype = "row_sparse" if sparse_grad else "default"
         with self.name_scope():
             self.weight = self.params.get(
@@ -355,7 +361,8 @@ class Embedding(HybridBlock):
     def hybrid_forward(self, F, x, weight):
         return F.embedding(x, weight, input_dim=self._input_dim,
                            output_dim=self._output_dim,
-                           sparse_grad=self._sparse_grad)
+                           sparse_grad=self._sparse_grad,
+                           matmul_lookup=self._matmul_lookup)
 
 
 class Flatten(HybridBlock):

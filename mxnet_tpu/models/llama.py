@@ -41,7 +41,8 @@ class LlamaConfig:
                  vocab_size=128256, max_seq_len=8192, rope_theta=500000.0,
                  rms_eps=1e-5, tie_embeddings=False, attn_mode="flash",
                  num_experts=0, num_experts_per_tok=2,
-                 capacity_factor=1.25, moe_router="topk"):
+                 capacity_factor=1.25, moe_router="topk",
+                 scan_layers=False):
         self.hidden_size = hidden_size
         self.intermediate_size = intermediate_size
         self.num_layers = num_layers
@@ -60,6 +61,14 @@ class LlamaConfig:
         # topk | expert_choice — see models/moe.py: expert_choice leaks
         # future-token info in causal decoders; topk for production LM
         self.moe_router = moe_router
+        # scan_layers: trace/compile ONE decoder layer and lax.scan it
+        # over a stacked parameter tree (the production TPU idiom —
+        # layer-count-independent compile time, per-layer buffers
+        # allocated once, per-iteration remat).  Cost: one recorded
+        # restack of the layer parameters per step (an extra HBM pass
+        # over the weights); leave False when squeezing the last GiB on
+        # a single chip.  r4 scale-proof finding, tools/scale_proof.py.
+        self.scan_layers = scan_layers
         if hidden_size % num_heads:
             raise MXNetError("num_heads must evenly divide hidden_size")
         if num_heads % num_kv_heads:
@@ -274,8 +283,11 @@ class LlamaModel(HybridBlock):
 
     def hybrid_forward(self, F, input_ids):
         h = self.embed_tokens(input_ids)
-        for layer in self.layers:
-            h = layer(h)
+        if self._cfg.scan_layers and len(self.layers) > 1:
+            h = _apply_layers_scanned(self, h)
+        else:
+            for layer in self.layers:
+                h = layer(h)
         return self.norm(h)
 
 
@@ -763,6 +775,72 @@ def llama_pipeline_forward(net, input_ids, n_microbatches, mesh=None,
     h_out = out.reshape((batch, t_len, hidden))
     h_out = net.model.norm(h_out)
     return _lm_head(net, h_out)
+
+
+def _apply_layers_scanned(model, h):
+    """cfg.scan_layers: apply the decoder stack as
+    ``lax.scan(jax.checkpoint(layer))`` over a stacked parameter tree.
+
+    The layer-0 Block is the compile template (handle-swap per
+    iteration, the pipeline machinery's trick), so the stack traces and
+    compiles ONE layer regardless of depth, XLA allocates one layer's
+    buffers instead of L copies, and each iteration rematerializes in
+    the backward (r4 finding: a python layer loop cost ~1 GiB x L of
+    XLA temp that scan removes by construction —
+    tools/scale_proof.py).  The per-layer parameters are restacked with
+    RECORDED ops every call, so gradients reach each layer's own
+    Parameter and ``gluon.Trainer`` works unchanged."""
+    from ..ops import tensor as tops
+    from ..ops.registry import apply_op
+
+    mach = _scan_machinery(model)
+    names, shells = mach["names"], mach["shells"]
+    per_layer = [ly._collect_params_with_prefix()
+                 for ly in model.layers]
+    stacked = [tops.stack(*[lp[n].data() for lp in per_layer], axis=0)
+               for n in names]
+    saved = [sh._data for sh in shells]
+    try:
+        return apply_op(mach["fn"], h, *stacked, name="scan_layers")
+    finally:
+        for sh, s in zip(shells, saved):
+            sh._data = s
+
+
+def _scan_machinery(model):
+    """Cached per-model scan plumbing (identity-stable like
+    :func:`_pipeline_machinery`, so jit caches hit across steps)."""
+    cache = getattr(model, "_scan_mach", None)
+    if cache is not None:
+        return cache
+    from ..gluon.block import _trace_guard
+    from ..ndarray import NDArray
+
+    template = model.layers[0]
+    tparams = template._collect_params_with_prefix()
+    names = sorted(tparams)
+    shells = [tparams[n]._data for n in names]
+
+    def apply_one(sl, carry):
+        for sh, s in zip(shells, sl):
+            sh._data = s
+        with _trace_guard():  # inline the template body (no nested jit)
+            return template(NDArray(carry))._data
+
+    def fn(hr, *stk):
+        import jax
+        from jax import lax
+
+        def body(carry, sl):
+            return jax.checkpoint(apply_one)(sl, carry), ()
+
+        out, _ = lax.scan(body, hr, tuple(stk))
+        return out
+
+    cache = {"names": names, "shells": shells, "fn": fn,
+             "apply_one": apply_one}
+    model._scan_mach = cache
+    return cache
 
 
 def _pipeline_machinery(net, n_stages):

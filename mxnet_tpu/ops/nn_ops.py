@@ -785,13 +785,29 @@ _export(dropout, aliases=("Dropout",))
 # --- embedding --------------------------------------------------------------
 
 def embedding(data, weight, input_dim=None, output_dim=None, dtype=None,
-              sparse_grad=False, **kwargs):
+              sparse_grad=False, matmul_lookup=False, **kwargs):
     """Reference ``Embedding`` (indexing_op.cc:?): weight rows gathered by
     integer ids.  ``sparse_grad=True`` produces a row_sparse gradient in the
     reference; here the dense vjp scatter-add is already efficient on TPU —
-    the sparse path is wired through mxnet_tpu/ndarray/sparse.py."""
+    the sparse path is wired through mxnet_tpu/ndarray/sparse.py.
+
+    ``matmul_lookup=True`` lowers the lookup as ``one_hot(ids) @ w`` —
+    semantically identical, but lookup AND gradient become ordinary
+    contractions over the vocab axis.  Use it whenever the table is
+    sharded along dim 0 (vocab-parallel TP): the transpose of a gather
+    over a sharded operand is a scatter-add that GSPMD can only lower by
+    materializing the FULL f32 table per device (measured 2 GiB/device
+    on llama-3-8B, tools/scale_proof.py), while the one-hot matmul
+    shards like any other matmul.  On the MXU the one-hot contraction
+    fuses; don't use it for small replicated tables where the gather is
+    already a single cheap HBM pass."""
     def f(idx, w):
         ii = jnp.clip(idx.astype(np.int32), 0, w.shape[0] - 1)
+        if matmul_lookup:
+            import jax
+
+            oh = jax.nn.one_hot(ii, w.shape[0], dtype=w.dtype)
+            return jnp.einsum("...v,vh->...h", oh, w)
         return jnp.take(w, ii, axis=0)
 
     return apply_op(f, data, weight, name="embedding")

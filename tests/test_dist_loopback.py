@@ -395,303 +395,3 @@ def test_composed_multihost_topology_matches_single_process():
     assert got[0].tobytes() == got[1].tobytes(), "ranks diverged"
     want = oracle_single_process(4)
     np.testing.assert_allclose(got[0], want, rtol=1e-5, atol=1e-6)
-
-
-_SHARDED_CKPT_WORKER = r"""
-import os
-import sys
-sys.path.insert(0, os.environ["REPO_ROOT"])
-os.environ.pop("XLA_FLAGS", None)
-import jax
-jax.config.update("jax_platforms", "cpu")
-
-import numpy as np
-import mxnet_tpu as mx
-from mxnet_tpu import checkpoint, gluon, nd, parallel
-
-parallel.initialize()
-rank, n = jax.process_index(), jax.process_count()
-
-mesh = parallel.make_mesh({"dp": n})
-with parallel.mesh_scope(mesh):
-    mx.random.seed(21)
-    net = gluon.nn.Dense(4)
-    net.initialize(mx.init.Xavier())
-    net(nd.ones((1, 6)))
-    parallel.replicate_block_params(net)   # global (process-spanning)
-    want = net.weight.data().asnumpy().copy()
-
-    d = os.environ["CKPT_DIR"]
-    checkpoint.save_checkpoint(d, 3, net, sharded=True)  # collective
-
-    mx.random.seed(22)   # same-on-all-ranks re-init (replication over a
-                         # process-spanning mesh requires identical host
-                         # values), different from the saved weights
-    net2 = gluon.nn.Dense(4)
-    net2.initialize(mx.init.Xavier())
-    net2(nd.ones((1, 6)))
-    parallel.replicate_block_params(net2)
-    step, _ = checkpoint.resume(d, net2)
-    assert step == 3
-    np.testing.assert_allclose(net2.weight.data().asnumpy(), want,
-                               rtol=1e-6)
-with open(os.environ["OUT_FILE"] + os.environ["MXT_PROCESS_ID"], "w") as f:
-    f.write("ok")
-"""
-
-
-@pytest.mark.skipif(sys.platform != "linux", reason="loopback group")
-def test_two_process_collective_sharded_checkpoint(tmp_path):
-    """sharded=True in a 2-process group: orbax collective write into the
-    final dir, process-0 manifest after a barrier, both ranks resume to
-    identical weights."""
-    import signal
-
-    script = tmp_path / "ckpt_worker.py"
-    script.write_text(_SHARDED_CKPT_WORKER)
-    out = str(tmp_path / "out")
-    env = dict(os.environ)
-    env["OUT_FILE"] = out
-    env["CKPT_DIR"] = str(tmp_path / "ckpts")
-    env["MXT_LAUNCH_PLATFORM"] = "cpu"
-    env["REPO_ROOT"] = os.path.join(os.path.dirname(__file__), "..")
-    n = 2
-    proc = subprocess.Popen(
-        [sys.executable, os.path.join(TOOLS, "launch.py"), "-n", str(n),
-         "--coordinator", f"127.0.0.1:{_free_port()}",
-         sys.executable, str(script)], env=env, start_new_session=True)
-    try:
-        rc = proc.wait(timeout=240)
-    except subprocess.TimeoutExpired:
-        os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
-        raise
-    assert rc == 0
-    for i in range(n):
-        assert os.path.exists(out + str(i)), f"rank {i} did not finish"
-
-
-_SYNCBN_WORKER = r"""
-import os
-import sys
-sys.path.insert(0, os.environ["REPO_ROOT"])
-os.environ.pop("XLA_FLAGS", None)
-import jax
-jax.config.update("jax_platforms", "cpu")
-
-import jax.numpy as jnp
-import numpy as np
-import mxnet_tpu as mx
-from mxnet_tpu import autograd, gluon, nd, parallel
-
-parallel.initialize()
-rank, n = jax.process_index(), jax.process_count()
-
-EPS, MOM = 1e-5, 0.9
-full = np.random.RandomState(7).randn(8, 3, 2, 2).astype(np.float32)
-coef = np.random.RandomState(8).randn(8, 3, 2, 2).astype(np.float32)
-shard = full[rank * 4:(rank + 1) * 4]
-
-mx.random.seed(1)
-net = gluon.nn.SyncBatchNorm(in_channels=3, momentum=MOM, epsilon=EPS)
-net.initialize()
-# nontrivial gamma/beta so sync errors can't hide behind identities
-net.gamma.set_data(nd.array([1.5, 0.5, 2.0]))
-net.beta.set_data(nd.array([0.1, -0.2, 0.3]))
-
-x = nd.array(shard)
-x.attach_grad()
-with autograd.record():
-    y = net(x)
-    loss = (y * nd.array(coef[rank * 4:(rank + 1) * 4])).sum()
-loss.backward()
-
-# independent reference: jax autodiff through GLOBAL-batch BN
-def ref_loss(xg, gamma, beta):
-    xf = xg.astype(jnp.float32)
-    mean = jnp.mean(xf, axis=(0, 2, 3))
-    var = jnp.var(xf, axis=(0, 2, 3))
-    sh = (1, 3, 1, 1)
-    yg = (xf - mean.reshape(sh)) * jax.lax.rsqrt(var + EPS).reshape(sh)
-    yg = yg * gamma.reshape(sh) + beta.reshape(sh)
-    return (yg * jnp.asarray(coef)).sum(), (yg, mean, var)
-
-gamma = jnp.asarray([1.5, 0.5, 2.0], jnp.float32)
-beta = jnp.asarray([0.1, -0.2, 0.3], jnp.float32)
-(_, (y_ref, mean_ref, var_ref)), grads = jax.value_and_grad(
-    ref_loss, argnums=(0, 1, 2), has_aux=True)(jnp.asarray(full), gamma, beta)
-dx_ref, dgamma_ref, dbeta_ref = grads
-
-sl = slice(rank * 4, (rank + 1) * 4)
-np.testing.assert_allclose(y.asnumpy(), np.asarray(y_ref)[sl],
-                           rtol=1e-5, atol=1e-5)
-np.testing.assert_allclose(x.grad.asnumpy(), np.asarray(dx_ref)[sl],
-                           rtol=1e-4, atol=1e-5)
-# per-host running stats must equal GLOBAL-batch stats (the r2 defect)
-np.testing.assert_allclose(net.running_mean.data().asnumpy(),
-                           (1 - MOM) * np.asarray(mean_ref),
-                           rtol=1e-5, atol=1e-6)
-np.testing.assert_allclose(net.running_var.data().asnumpy(),
-                           MOM * 1.0 + (1 - MOM) * np.asarray(var_ref),
-                           rtol=1e-5, atol=1e-6)
-# param grads: LOCAL sums; all_sum (the Trainer's hop) gives the global ones
-gsum = parallel.all_sum([net.gamma.grad(), net.beta.grad()])
-np.testing.assert_allclose(gsum[0].asnumpy(), np.asarray(dgamma_ref),
-                           rtol=1e-4, atol=1e-5)
-np.testing.assert_allclose(gsum[1].asnumpy(), np.asarray(dbeta_ref),
-                           rtol=1e-4, atol=1e-5)
-
-# hybridized multi-process SyncBatchNorm must refuse loudly, not silently
-# train on per-host statistics
-net.hybridize()
-try:
-    with autograd.record():
-        net(x)
-    raise SystemExit("hybridized SyncBatchNorm did not raise")
-except mx.base.MXNetError:
-    pass
-
-with open(os.environ["OUT_FILE"] + os.environ["MXT_PROCESS_ID"], "w") as f:
-    f.write("ok")
-"""
-
-
-@pytest.mark.skipif(sys.platform != "linux", reason="loopback group")
-def test_two_process_sync_batch_norm(tmp_path):
-    """SyncBatchNorm in a 2-process dp group: forward/backward/running
-    stats must all match a global-batch reference on every rank (the
-    round-2 'does not sync' defect), and hybridize must raise instead of
-    silently using per-host statistics."""
-    import signal
-
-    script = tmp_path / "syncbn_worker.py"
-    script.write_text(_SYNCBN_WORKER)
-    out = str(tmp_path / "out")
-    env = dict(os.environ)
-    env["OUT_FILE"] = out
-    env["MXT_LAUNCH_PLATFORM"] = "cpu"
-    env["REPO_ROOT"] = os.path.join(os.path.dirname(__file__), "..")
-    n = 2
-    proc = subprocess.Popen(
-        [sys.executable, os.path.join(TOOLS, "launch.py"), "-n", str(n),
-         "--coordinator", f"127.0.0.1:{_free_port()}",
-         sys.executable, str(script)], env=env, start_new_session=True)
-    try:
-        rc = proc.wait(timeout=240)
-    except subprocess.TimeoutExpired:
-        os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
-        raise
-    assert rc == 0
-    for i in range(n):
-        assert os.path.exists(out + str(i)), f"rank {i} did not finish"
-
-
-_COMPOSED_WORKER = r"""
-import os
-import sys
-sys.path.insert(0, os.environ["REPO_ROOT"])
-# THE production topology in miniature: each process is a multi-chip
-# host (4 virtual devices), so the step composes GSPMD sharding INSIDE
-# the process with cross-process gradient collectives OUTSIDE it
-os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
-import jax
-jax.config.update("jax_platforms", "cpu")
-
-import numpy as np
-import mxnet_tpu as mx
-from mxnet_tpu import autograd, gluon, nd, parallel
-
-parallel.initialize()
-rank, n = jax.process_index(), jax.process_count()
-assert n == 2, n
-assert len(jax.local_devices()) == 4, jax.local_devices()
-assert len(jax.devices()) == 8, jax.devices()
-
-# GSPMD mesh over this host's 4 LOCAL devices only (the in-host ICI
-# analog); the cross-host hop is dist_tpu_sync's process allreduce
-mesh = parallel.make_mesh({"dp": 4}, devices=jax.local_devices())
-with parallel.mesh_scope(mesh):
-    mx.random.seed(42)
-    net = gluon.nn.Dense(3, use_bias=True)
-    net.initialize(mx.init.Xavier())
-    net(nd.ones((1, 5)))
-    parallel.replicate_block_params(net)
-    trainer = gluon.Trainer(net.collect_params(), "sgd",
-                            {"learning_rate": 0.1, "momentum": 0.9},
-                            kvstore="dist_tpu_sync")
-
-    full = np.random.RandomState(0).randn(16, 5).astype(np.float32)
-    shard = full[rank * 8:(rank + 1) * 8]      # disjoint per-host data
-    x = parallel.shard_batch(nd.array(shard))  # GSPMD dp inside the host
-    for _ in range(4):
-        with autograd.record():
-            loss = (net(x) ** 2).sum()         # sum-loss: step() rescales
-        loss.backward()
-        trainer.step(16)                       # GLOBAL batch size
-assert trainer._kvstore.num_workers == n
-np.save(os.environ["OUT_FILE"] + str(rank) + ".npy",
-        np.concatenate([net.weight.data().asnumpy().ravel(),
-                        net.bias.data().asnumpy().ravel()]))
-"""
-
-
-@pytest.mark.skipif(sys.platform != "linux", reason="loopback group")
-def test_composed_multihost_topology_matches_single_process(tmp_path):
-    """VERDICT r3 item 7 — the production v5e-32 topology (8 hosts x 4
-    chips) in miniature: 2 processes x 4 virtual devices each.  GSPMD
-    shards the batch over each host's local 4-device mesh; the
-    cross-process gradient path rides dist_tpu_sync's process
-    allreduce — BOTH in one stock ``gluon.Trainer`` step.  Ranks must
-    end byte-identical AND equal to a single-process 8-device GSPMD run
-    over the same global batch (the composition changes the reduction
-    tree, not the math).  Reference composition style:
-    tests/nightly/dist_sync_kvstore.py:? (scheduler+server+worker in one
-    test)."""
-    import signal
-
-    import numpy as np
-
-    script = tmp_path / "composed_worker.py"
-    script.write_text(_COMPOSED_WORKER)
-    out = str(tmp_path / "params")
-    env = dict(os.environ)
-    env["OUT_FILE"] = out
-    env["MXT_LAUNCH_PLATFORM"] = "cpu"
-    env["REPO_ROOT"] = os.path.join(os.path.dirname(__file__), "..")
-    n = 2
-    proc = subprocess.Popen(
-        [sys.executable, os.path.join(TOOLS, "launch.py"), "-n", str(n),
-         "--coordinator", f"127.0.0.1:{_free_port()}",
-         sys.executable, str(script)], env=env, start_new_session=True)
-    try:
-        rc = proc.wait(timeout=300)
-    except subprocess.TimeoutExpired:
-        os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
-        raise
-    assert rc == 0
-    got = [np.load(out + f"{i}.npy") for i in range(n)]
-    assert got[0].tobytes() == got[1].tobytes(), "ranks diverged"
-
-    # single-process 8-device GSPMD oracle over the full global batch
-    import mxnet_tpu as mx
-    from mxnet_tpu import autograd, gluon, nd, parallel
-
-    mesh = parallel.make_mesh({"dp": 8})
-    with parallel.mesh_scope(mesh):
-        mx.random.seed(42)
-        net = gluon.nn.Dense(3, use_bias=True)
-        net.initialize(mx.init.Xavier())
-        net(nd.ones((1, 5)))
-        parallel.replicate_block_params(net)
-        trainer = gluon.Trainer(net.collect_params(), "sgd",
-                                {"learning_rate": 0.1, "momentum": 0.9},
-                                kvstore="dist_tpu_sync")
-        x = parallel.shard_batch(nd.array(
-            np.random.RandomState(0).randn(16, 5).astype(np.float32)))
-        for _ in range(4):
-            with autograd.record():
-                loss = (net(x) ** 2).sum()
-            loss.backward()
-            trainer.step(16)
-        want = np.concatenate([net.weight.data().asnumpy().ravel(),
-                               net.bias.data().asnumpy().ravel()])
-    np.testing.assert_allclose(got[0], want, rtol=1e-5, atol=1e-6)

@@ -430,3 +430,29 @@ def test_layer_norm_gradients_finite_difference():
             fd = (loss_val(*args_p) - loss_val(*args_m)) / (2 * eps)
             assert abs(fd - an[idx]) <= 2e-2 * max(1.0, abs(fd)), \
                 (name, idx, fd, an[idx])
+
+
+def test_embedding_matmul_lookup_matches_take():
+    """matmul_lookup=True (the vocab-parallel one-hot-matmul lowering,
+    r4 scale-proof finding) must be numerically identical to the gather
+    path — forward and weight gradient."""
+    import numpy as np
+
+    rs = np.random.RandomState(3)
+    w0 = rs.randn(11, 6).astype(np.float32)
+    ids = nd.array(rs.randint(0, 11, (4, 5)), dtype="int32")
+
+    outs, grads = [], []
+    for matmul in (False, True):
+        emb = gluon.nn.Embedding(11, 6, matmul_lookup=matmul)
+        emb.initialize()
+        emb(ids)  # resolve
+        emb.weight.set_data(nd.array(w0))
+        with autograd.record():
+            y = emb(ids)
+            loss = (y * y).sum()
+        loss.backward()
+        outs.append(y.asnumpy())
+        grads.append(emb.weight.grad().asnumpy())
+    np.testing.assert_allclose(outs[1], outs[0], rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(grads[1], grads[0], rtol=1e-5, atol=1e-6)

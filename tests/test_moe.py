@@ -199,8 +199,6 @@ def _train_router_balance(use_aux, steps=40):
         trainer.step(2)
 
     # measured assignment distribution on held-out data
-    import jax.numpy as jnp
-
     xe = np.random.RandomState(19).randn(4, 32, 16).astype(np.float32)
     logits = xe.reshape(-1, 16) @ blk.router_weight.data().asnumpy().T
     frac = np.bincount(logits.argmax(-1), minlength=4) / logits.shape[0]
