@@ -47,11 +47,22 @@ def _attr(name, value):
         body += P.fint(3, int(value)) + P.fint(20, P.ATTR_INT)
     elif isinstance(value, str):
         body += P.fbytes(4, value.encode()) + P.fint(20, P.ATTR_STRING)
+    elif isinstance(value, np.ndarray):
+        body += P.fbytes(5, _tensor(name, value)) + \
+            P.fint(20, P.ATTR_TENSOR)
     elif isinstance(value, (list, tuple)):
         body += P.fpacked_ints(8, value) + P.fint(20, P.ATTR_INTS)
     else:
         raise MXNetError(f"unsupported attribute {name}={value!r}")
     return body
+
+
+def _const(out, arr):
+    """Constant node carrying ``arr`` as its value tensor — used by the
+    decomposed NLP exports (LayerNorm eps, GELU constants, Reshape
+    shapes, Slice indices)."""
+    return _node("Constant", [], [out], out,
+                 {"value": np.asarray(arr)})
 
 
 def _node(op_type, inputs, outputs, name, attrs=None):
@@ -177,6 +188,148 @@ def _elemwise(onnx_op):
     return conv
 
 
+# --- NLP subset (round 4): LayerNorm/GELU/attention building blocks ---------
+
+def _layer_norm(node, ins, out, attrs):
+    """Opset-13 decomposition (LayerNormalization is opset 17):
+    (x - mean) / sqrt(var + eps) * gamma + beta over the last axis."""
+    axis = int(attrs.get("axis", -1))
+    eps = float(attrs.get("eps", 1e-5))
+    x, g, b = ins[0], ins[1], ins[2]
+
+    def n(s):
+        return f"{out}__{s}"
+
+    return [
+        _node("ReduceMean", [x], [n("mu")], n("mu"),
+              {"axes": [axis], "keepdims": 1}),
+        _node("Sub", [x, n("mu")], [n("d")], n("d")),
+        _node("Mul", [n("d"), n("d")], [n("d2")], n("d2")),
+        _node("ReduceMean", [n("d2")], [n("var")], n("var"),
+              {"axes": [axis], "keepdims": 1}),
+        _const(n("eps"), np.float32(eps)),
+        _node("Add", [n("var"), n("eps")], [n("ve")], n("ve")),
+        _node("Sqrt", [n("ve")], [n("std")], n("std")),
+        _node("Div", [n("d"), n("std")], [n("norm")], n("norm")),
+        _node("Mul", [n("norm"), g], [n("sc")], n("sc")),
+        _node("Add", [n("sc"), b], [out], out),
+    ]
+
+
+def _leaky_relu(node, ins, out, attrs):
+    act = attrs.get("act_type", "leaky")
+    x = ins[0]
+
+    def n(s):
+        return f"{out}__{s}"
+
+    if act == "leaky":
+        return [_node("LeakyRelu", [x], [out], out,
+                      {"alpha": float(attrs.get("slope", 0.25))})]
+    if act == "elu":
+        # runtime default slope is 0.25 (LeakyReLU family default), NOT
+        # ONNX Elu's 1.0 — exporting the wrong default is 4x off on
+        # every negative value
+        return [_node("Elu", [x], [out], out,
+                      {"alpha": float(attrs.get("slope", 0.25))})]
+    if act == "gelu":
+        # exact erf form: 0.5 x (1 + erf(x / sqrt(2)))
+        return [
+            _const(n("rsqrt2"), np.float32(1.0 / np.sqrt(2.0))),
+            _node("Mul", [x, n("rsqrt2")], [n("xs")], n("xs")),
+            _node("Erf", [n("xs")], [n("erf")], n("erf")),
+            _const(n("one"), np.float32(1.0)),
+            _node("Add", [n("erf"), n("one")], [n("e1")], n("e1")),
+            _node("Mul", [x, n("e1")], [n("xe")], n("xe")),
+            _const(n("half"), np.float32(0.5)),
+            _node("Mul", [n("xe"), n("half")], [out], out),
+        ]
+    raise MXNetError(f"ONNX export: unsupported LeakyReLU act {act!r}")
+
+
+def _embedding(node, ins, out, attrs):
+    # mx Embedding(data, weight) -> Gather(weight, int64(data)).
+    # The runtime CLIPS ids to [0, input_dim-1] (nn_ops.embedding);
+    # a bare Gather instead wraps negatives from the end and errors on
+    # overflow in external runtimes — export the clip explicitly.
+    input_dim = attrs.get("input_dim")
+    if input_dim is None:
+        raise MXNetError(
+            "ONNX export: Embedding needs input_dim to export the "
+            "runtime's id-clipping semantics")
+
+    def n(s):
+        return f"{out}__{s}"
+
+    return [
+        _node("Cast", [ins[0]], [n("ids")], n("ids"), {"to": P.INT64}),
+        _const(n("lo"), np.asarray(0, np.int64)),
+        _const(n("hi"), np.asarray(int(input_dim) - 1, np.int64)),
+        _node("Clip", [n("ids"), n("lo"), n("hi")], [n("cl")], n("cl")),
+        _node("Gather", [ins[1], n("cl")], [out], out, {"axis": 0}),
+    ]
+
+
+def _batch_dot(node, ins, out, attrs):
+    ta = str(attrs.get("transpose_a", False)).lower() in ("true", "1")
+    tb = str(attrs.get("transpose_b", False)).lower() in ("true", "1")
+    if ta or tb:
+        raise MXNetError(
+            "ONNX export: batch_dot transpose flags need the operand "
+            "rank (unknown at export) — insert an explicit transpose "
+            "before batch_dot instead")
+    return [_node("MatMul", ins[:2], [out], out)]
+
+
+def _transpose_exp(node, ins, out, attrs):
+    axes = attrs.get("axes")
+    a = {} if axes in (None, "None", ()) else \
+        {"perm": [int(x) for x in axes]}
+    return [_node("Transpose", ins[:1], [out], out, a)]
+
+
+def _reshape_exp(node, ins, out, attrs):
+    shape = tuple(int(s) for s in attrs.get("shape", ()))
+    if any(s in (0, -2, -3, -4) for s in shape):
+        raise MXNetError(
+            "ONNX export: mx reshape special codes (0/-2/-3/-4) "
+            f"unsupported, got {shape}")
+    return [
+        _const(out + "__shape", np.asarray(shape, np.int64)),
+        _node("Reshape", [ins[0], out + "__shape"], [out], out),
+    ]
+
+
+def _slice_axis_exp(node, ins, out, attrs):
+    axis = int(attrs.get("axis", 0))
+    begin = int(attrs.get("begin", 0))
+    end = attrs.get("end")
+    end = 2 ** 62 if end in (None, "None") else int(end)
+    return [
+        _const(out + "__st", np.asarray([begin], np.int64)),
+        _const(out + "__en", np.asarray([end], np.int64)),
+        _const(out + "__ax", np.asarray([axis], np.int64)),
+        _node("Slice", [ins[0], out + "__st", out + "__en",
+                        out + "__ax"], [out], out),
+    ]
+
+
+def _expand_dims_exp(node, ins, out, attrs):
+    return [
+        _const(out + "__ax",
+               np.asarray([int(attrs.get("axis", 0))], np.int64)),
+        _node("Unsqueeze", [ins[0], out + "__ax"], [out], out),
+    ]
+
+
+def _where_exp(node, ins, out, attrs):
+    return [
+        _node("Cast", [ins[0]], [out + "__c"], out + "__c",
+              {"to": P.BOOL}),
+        _node("Where", [out + "__c", ins[1], ins[2]], [out], out),
+    ]
+
+
 _TRANSLATIONS = {
     "Convolution": _conv,
     "FullyConnected": _fc,
@@ -206,6 +359,29 @@ _TRANSLATIONS = {
     "broadcast_mul": _elemwise("Mul"),
     "elemwise_sub": _elemwise("Sub"),
     "sub": _elemwise("Sub"),
+    # NLP subset (round 4) — enough for a transformer encoder layer:
+    "LayerNorm": _layer_norm,
+    "layer_norm": _layer_norm,
+    "LeakyReLU": _leaky_relu,
+    "leaky_relu": _leaky_relu,
+    "erf": _simple("Erf"),
+    "Embedding": _embedding,
+    "embedding": _embedding,
+    "batch_dot": _batch_dot,
+    "transpose": _transpose_exp,
+    "Reshape": _reshape_exp,
+    "reshape": _reshape_exp,
+    "slice_axis": _slice_axis_exp,
+    "expand_dims": _expand_dims_exp,
+    "where": _where_exp,
+    "broadcast_div": _elemwise("Div"),
+    "div": _elemwise("Div"),
+    "broadcast_sub": _elemwise("Sub"),
+    "broadcast_power": _elemwise("Pow"),
+    "broadcast_maximum": _elemwise("Max"),
+    "broadcast_minimum": _elemwise("Min"),
+    "maximum": _elemwise("Max"),
+    "minimum": _elemwise("Min"),
 }
 
 

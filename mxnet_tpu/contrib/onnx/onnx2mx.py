@@ -266,6 +266,118 @@ def _identity(sym, node, ins, params):
     return sym.identity(ins[0], name=node["outputs"][0])
 
 
+# --- NLP subset (round 4) ----------------------------------------------------
+
+_ONNX2DT = {P.FLOAT: "float32", P.INT64: "int64", 6: "int32",
+            P.BOOL: "float32",  # bool masks: !=0 semantics preserved
+            10: "float16", 11: "float64"}
+
+
+def _matmul(sym, node, ins, params):
+    # transformer use is batched rank>=3; batch_dot broadcasts leading
+    # dims (2-D standalone MatMul exports arrive as Gemm instead)
+    return sym.batch_dot(ins[0], ins[1], name=node["outputs"][0])
+
+
+def _transpose_imp(sym, node, ins, params):
+    perm = node["attrs"].get("perm")
+    kw = {} if perm is None else {"axes": tuple(int(p) for p in perm)}
+    return sym.transpose(ins[0], name=node["outputs"][0], **kw)
+
+
+def _gather(sym, node, ins, params):
+    axis = int(node["attrs"].get("axis", 0))
+    return sym.take(ins[0], ins[1], axis=axis,
+                    name=node["outputs"][0])
+
+
+def _cast(sym, node, ins, params):
+    to = int(node["attrs"].get("to", P.FLOAT))
+    dt = _ONNX2DT.get(to)
+    if dt is None:
+        raise MXNetError(f"ONNX import: Cast to={to} unsupported")
+    return sym.cast(ins[0], dtype=dt, name=node["outputs"][0])
+
+
+def _leaky(sym, node, ins, params):
+    return sym.LeakyReLU(ins[0],
+                         slope=float(node["attrs"].get("alpha", 0.01)),
+                         name=node["outputs"][0])
+
+
+def _elu(sym, node, ins, params):
+    return sym.LeakyReLU(ins[0], act_type="elu",
+                         slope=float(node["attrs"].get("alpha", 1.0)),
+                         name=node["outputs"][0])
+
+
+def _reduce_mean(sym, node, ins, params):
+    axes = node["attrs"].get("axes")
+    kw = {"keepdims": bool(int(node["attrs"].get("keepdims", 1)))}
+    if axes is not None:
+        kw["axis"] = tuple(int(a) for a in axes)
+    return sym.mean(ins[0], name=node["outputs"][0], **kw)
+
+
+def _slice_imp(sym, node, ins, params):
+    def arr(i):
+        v = params.get(node["inputs"][i])
+        if v is None:
+            raise MXNetError(
+                "ONNX import: Slice indices must be initializers")
+        return [int(x) for x in np.asarray(v).ravel()]
+
+    starts, ends = arr(1), arr(2)
+    axes = arr(3) if len(node["inputs"]) > 3 else \
+        list(range(len(starts)))
+    if len(node["inputs"]) > 4:
+        steps = arr(4)
+        if any(s != 1 for s in steps):
+            raise MXNetError(
+                f"ONNX import: strided Slice (steps={steps}) "
+                "unsupported (subset)")
+    if len(starts) != 1:
+        raise MXNetError(
+            "ONNX import: multi-axis Slice unsupported (subset)")
+    end = None if ends[0] >= 2 ** 31 else ends[0]
+    return sym.slice_axis(ins[0], axis=axes[0], begin=starts[0],
+                          end=end, name=node["outputs"][0])
+
+
+def _unsqueeze(sym, node, ins, params):
+    axes = params.get(node["inputs"][1])
+    if axes is None:
+        raise MXNetError(
+            "ONNX import: Unsqueeze axes must be an initializer")
+    axes = [int(a) for a in np.asarray(axes).ravel()]
+    if len(axes) != 1:
+        raise MXNetError("ONNX import: multi-axis Unsqueeze unsupported")
+    return sym.expand_dims(ins[0], axis=axes[0],
+                           name=node["outputs"][0])
+
+
+def _where_imp(sym, node, ins, params):
+    return sym.where(ins[0], ins[1], ins[2], name=node["outputs"][0])
+
+
+def _clip_imp(sym, node, ins, params):
+    def scalar(i):
+        v = params.get(node["inputs"][i]) if \
+            len(node["inputs"]) > i else None
+        return None if v is None else float(np.asarray(v))
+
+    lo, hi = scalar(1), scalar(2)
+    return sym.clip(ins[0],
+                    a_min=-3.4e38 if lo is None else lo,
+                    a_max=3.4e38 if hi is None else hi,
+                    name=node["outputs"][0])
+
+
+# inputs consumed as attributes (constants) per op: {op: input indices}
+_ATTR_ONLY_INPUTS = {"Reshape": (1,), "Slice": (1, 2, 3, 4),
+                     "Unsqueeze": (1,), "Clip": (1, 2)}
+
+
 _IMPORTS = {
     "Conv": _conv,
     "Gemm": _gemm,
@@ -292,6 +404,22 @@ _IMPORTS = {
     "Mul": _binop("broadcast_mul"),
     "Sub": _binop("broadcast_sub"),
     "Div": _binop("broadcast_div"),
+    # NLP subset (round 4)
+    "MatMul": _matmul,
+    "Transpose": _transpose_imp,
+    "Gather": _gather,
+    "Cast": _cast,
+    "Erf": _act("erf"),
+    "LeakyRelu": _leaky,
+    "Elu": _elu,
+    "ReduceMean": _reduce_mean,
+    "Slice": _slice_imp,
+    "Unsqueeze": _unsqueeze,
+    "Where": _where_imp,
+    "Pow": _binop("broadcast_power"),
+    "Max": _binop("broadcast_maximum"),
+    "Min": _binop("broadcast_minimum"),
+    "Clip": _clip_imp,
 }
 
 
@@ -323,6 +451,13 @@ def import_model(model_file):
     aux_names = set()
     for node in nodes:
         op = node["op_type"]
+        if op == "Constant":
+            # value feeds downstream as an initializer-like tensor
+            val = node["attrs"].get("value")
+            if val is None:
+                raise MXNetError("ONNX import: Constant without value")
+            params[node["outputs"][0]] = np.asarray(val)
+            continue
         trans = _IMPORTS.get(op)
         if trans is None:
             raise MXNetError(
@@ -331,9 +466,11 @@ def import_model(model_file):
         if op == "BatchNormalization":
             aux_names.update(node["inputs"][3:5])
         ins = []
-        # consumed-as-attribute inputs (Reshape shape) stay out of the
-        # symbol graph
-        attr_only = {node["inputs"][1]} if op == "Reshape" else set()
+        # consumed-as-attribute inputs (Reshape shape, Slice/Unsqueeze
+        # indices) stay out of the symbol graph
+        attr_only = {node["inputs"][i]
+                     for i in _ATTR_ONLY_INPUTS.get(op, ())
+                     if i < len(node["inputs"])}
         for iname in node["inputs"]:
             if iname in attr_only:
                 continue
@@ -362,8 +499,14 @@ def import_model(model_file):
                     "undefined tensor")
         heads.append(tensors[name])
     sym = heads[0] if len(heads) == 1 else sym_mod.Group(heads)
+    # only tensors that actually became graph Variables are parameters:
+    # attribute-consumed inputs (Reshape shapes, Slice/Clip bounds) and
+    # folded Constants must NOT surface as bindable params — they'd trip
+    # Module.set_params(allow_extra=False) as unexpected keys
+    used = set(sym.list_arguments()) | set(
+        sym.list_auxiliary_states())
     arg_params = {k: nd.array(np.asarray(v)) for k, v in params.items()
-                  if k not in aux_names}
+                  if k in used and k not in aux_names}
     aux_params = {k: nd.array(np.asarray(params[k])) for k in aux_names
                   if k in params}
     return sym, arg_params, aux_params

@@ -807,6 +807,19 @@ def _apply_layers_scanned(model, h):
             sh._data = s
 
 
+def _layer_template(layers):
+    """(template layer-0 Block, sorted param names, shell handles) — the
+    ONE extraction of the handle-swap machinery's raw ingredients,
+    shared by the scan forward and the pipeline machinery (the 1F1B
+    commit unified the GPipe/1F1B copies; this keeps scan on the same
+    helper instead of growing a third)."""
+    template = layers[0]
+    tparams = template._collect_params_with_prefix()
+    names = sorted(tparams)
+    shells = [tparams[n]._data for n in names]
+    return template, names, shells
+
+
 def _scan_machinery(model):
     """Cached per-model scan plumbing (identity-stable like
     :func:`_pipeline_machinery`, so jit caches hit across steps)."""
@@ -816,10 +829,7 @@ def _scan_machinery(model):
     from ..gluon.block import _trace_guard
     from ..ndarray import NDArray
 
-    template = model.layers[0]
-    tparams = template._collect_params_with_prefix()
-    names = sorted(tparams)
-    shells = [tparams[n]._data for n in names]
+    template, names, shells = _layer_template(list(model.layers))
 
     def apply_one(sl, carry):
         for sh, s in zip(shells, sl):
@@ -862,10 +872,7 @@ def _pipeline_machinery(net, n_stages):
             f"{n_layers} decoder layers not divisible into "
             f"{n_stages} pipeline stages")
     lps = n_layers // n_stages
-    template = layers[0]
-    tparams = template._collect_params_with_prefix()
-    names = sorted(tparams)
-    shells = [tparams[n]._data for n in names]
+    template, names, shells = _layer_template(layers)
 
     def stage_fn(ptree, x_raw):
         out = x_raw

@@ -9,7 +9,7 @@ mkdir -p "$OUT"
 log() { echo "[runbook $(date +%H:%M:%S)] $*"; }
 
 log "1/7 sync probe (device kind, dispatch-vs-completion, achievable peak)"
-timeout 900 python /tmp/sync_probe2.py > "$OUT/sync_probe.txt" 2>&1
+timeout 900 python tools/sync_probe.py > "$OUT/sync_probe.txt" 2>&1
 cat "$OUT/sync_probe.txt"
 
 log "2/7 bench.py (hard-sync protocol, synthetic + recordio + BERT)"

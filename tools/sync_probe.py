@@ -1,0 +1,43 @@
+"""Tunnel sync-semantics probe: does block_until_ready wait for device
+COMPLETION or return at dispatch?  Dispatches a known-FLOP scanned
+matmul chain and times three sync methods against the chain's physical
+minimum time at peak (r4: the dispatch-return behavior inflated the r3
+BERT window into >100% of bf16 peak, MFU_AUDIT_r04.json).  Also
+reports the device kind and the achievable matmul TFLOP/s."""
+import time, sys
+import numpy as np
+t0=time.time()
+import jax, jax.numpy as jnp
+from jax import lax
+print(f"import {time.time()-t0:.1f}s", flush=True)
+t0=time.time()
+print("devices:", jax.devices(), f"{time.time()-t0:.1f}s", flush=True)
+N = 4096
+x = jnp.asarray(np.random.randn(N, N), dtype=jnp.bfloat16)
+print("array placed", flush=True)
+CHAIN = 500
+@jax.jit
+def chain(x):
+    def body(y, _):
+        y = y @ x
+        y = y / jnp.sqrt(jnp.float32(N)).astype(jnp.bfloat16)
+        return y, ()
+    y, _ = lax.scan(body, x, None, length=CHAIN)
+    return y
+t0=time.time()
+y = chain(x)
+print(f"dispatch1 {time.time()-t0:.1f}s", flush=True)
+t0=time.time()
+y.block_until_ready()
+print(f"block1(compile+run) {time.time()-t0:.1f}s", flush=True)
+t0=time.time()
+s = np.asarray(y[0,0])
+print(f"fetch1 {time.time()-t0:.3f}s", flush=True)
+flops = 2*N**3*CHAIN
+print(f"chain {flops/1e12:.1f} TF -> min {flops/197e12:.3f}s at peak", flush=True)
+for trial in range(3):
+    t0=time.time(); y = chain(x); t1=time.time()
+    y.block_until_ready(); t2=time.time()
+    jax.block_until_ready(jnp.zeros(())); t3=time.time()
+    s = np.asarray(y[0,0]); t4=time.time()
+    print(f"trial{trial}: dispatch={t1-t0:.3f} block=+{t2-t1:.3f} zeros=+{t3-t2:.3f} fetch=+{t4-t3:.3f} total={t4-t0:.3f}", flush=True)
