@@ -18,7 +18,10 @@ _IR_VERSION = 8
 
 
 def _tensor(name, arr):
-    arr = np.ascontiguousarray(arr)
+    shape = np.shape(arr)
+    # ascontiguousarray promotes 0-d to (1,) on NumPy 2.x — restore the
+    # true rank (ONNX requires e.g. Clip bounds to be rank-0)
+    arr = np.ascontiguousarray(arr).reshape(shape)
     dt = {np.dtype(np.float32): P.FLOAT, np.dtype(np.float64): P.DOUBLE,
           np.dtype(np.int64): P.INT64, np.dtype(np.int32): P.INT32,
           np.dtype(np.int8): P.INT8, np.dtype(np.uint8): P.UINT8,

@@ -269,8 +269,7 @@ def _identity(sym, node, ins, params):
 # --- NLP subset (round 4) ----------------------------------------------------
 
 _ONNX2DT = {P.FLOAT: "float32", P.INT64: "int64", 6: "int32",
-            P.BOOL: "float32",  # bool masks: !=0 semantics preserved
-            10: "float16", 11: "float64"}
+            10: "float16", 11: "float64"}  # BOOL handled in _cast
 
 
 def _matmul(sym, node, ins, params):
@@ -293,6 +292,11 @@ def _gather(sym, node, ins, params):
 
 def _cast(sym, node, ins, params):
     to = int(node["attrs"].get("to", P.FLOAT))
+    if to == P.BOOL:
+        # bool semantics = (x != 0) collapsed to 0/1, NOT a
+        # value-preserving cast (a later Cast-to-float of a real bool
+        # yields 1.0, never the original magnitude)
+        return sym.sign(sym.abs(ins[0]), name=node["outputs"][0])
     dt = _ONNX2DT.get(to)
     if dt is None:
         raise MXNetError(f"ONNX import: Cast to={to} unsupported")
@@ -362,9 +366,14 @@ def _where_imp(sym, node, ins, params):
 
 def _clip_imp(sym, node, ins, params):
     def scalar(i):
-        v = params.get(node["inputs"][i]) if \
-            len(node["inputs"]) > i else None
-        return None if v is None else float(np.asarray(v))
+        if len(node["inputs"]) <= i or not node["inputs"][i]:
+            return None  # genuinely absent optional bound
+        v = params.get(node["inputs"][i])
+        if v is None:
+            raise MXNetError(
+                "ONNX import: Clip bounds must be initializers "
+                "(computed min/max unsupported in the subset)")
+        return float(np.asarray(v).ravel()[0])
 
     lo, hi = scalar(1), scalar(2)
     return sym.clip(ins[0],

@@ -312,6 +312,74 @@ def _mxu_matmul_bwd(res, g):
 _mxu_matmul_p.defvjp(_mxu_matmul_fwd, _mxu_matmul_bwd)
 
 
+@jax.custom_vjp
+def mxu_matmul_nt(x, w):
+    """y = x·W for low-precision operands, W stored (K, N) — same
+    dtype-preserving contract as :func:`_mxu_matmul` (f32 accumulation,
+    bf16 cotangents) for the non-transposed layout ``ops.tensor.dot``
+    uses."""
+    return lax.dot_general(x, w, (((x.ndim - 1,), (0,)), ((), ())),
+                           preferred_element_type=np.float32).astype(
+                               x.dtype)
+
+
+def _mxu_nt_fwd(x, w):
+    return mxu_matmul_nt(x, w), (x, w)
+
+
+def _mxu_nt_bwd(res, g):
+    x, w = res
+    g = g.astype(x.dtype)
+    dx = lax.dot_general(g, w, (((g.ndim - 1,), (1,)), ((), ())),
+                         preferred_element_type=np.float32).astype(x.dtype)
+    gm = g.reshape((-1, g.shape[-1]))
+    xm = x.reshape((-1, x.shape[-1]))
+    dw = lax.dot_general(xm, gm, (((0,), (0,)), ((), ())),
+                         preferred_element_type=np.float32).astype(w.dtype)
+    return dx, dw
+
+
+mxu_matmul_nt.defvjp(_mxu_nt_fwd, _mxu_nt_bwd)
+
+
+@jax.custom_vjp
+def mxu_batch_matmul(a, b):
+    """Batched (..., M, K) @ (..., K, N) for low-precision operands:
+    f32 MXU accumulation, products AND cotangents downcast to the
+    operand dtype (see :func:`_mxu_matmul` for why the default
+    pet+astype pattern turns every backward dot into f32xf32)."""
+    return jnp.matmul(a, b, preferred_element_type=np.float32).astype(
+        a.dtype)
+
+
+def _mxu_bmm_fwd(a, b):
+    return mxu_batch_matmul(a, b), (a, b)
+
+
+def _mxu_bmm_bwd(res, g):
+    a, b = res
+    g = g.astype(a.dtype)
+    da = jnp.matmul(g, jnp.swapaxes(b, -1, -2),
+                    preferred_element_type=np.float32).astype(a.dtype)
+    db = jnp.matmul(jnp.swapaxes(a, -1, -2), g,
+                    preferred_element_type=np.float32).astype(b.dtype)
+    # broadcast batch dims: sum cotangents over broadcasted axes
+    def unbroadcast(d, shape):
+        if d.shape == shape:
+            return d
+        extra = d.ndim - len(shape)
+        if extra > 0:
+            d = d.sum(axis=tuple(range(extra)))
+        axes = tuple(i for i, (ds, s) in enumerate(zip(d.shape, shape))
+                     if ds != s)
+        return d.sum(axis=axes, keepdims=True) if axes else d
+
+    return unbroadcast(da, a.shape), unbroadcast(db, b.shape)
+
+
+mxu_batch_matmul.defvjp(_mxu_bmm_fwd, _mxu_bmm_bwd)
+
+
 def fully_connected(data, weight, bias=None, num_hidden=None, no_bias=False,
                     flatten=True, **kwargs):
     """Reference ``FullyConnected``: y = x·Wᵀ + b, weight stored (out, in).

@@ -676,14 +676,18 @@ def dot(lhs, rhs, transpose_a=False, transpose_b=False, **kwargs):
         return jnp.tensordot(a, b, axes=1)
 
     def f_acc(a, b):
+        from .nn_ops import mxu_matmul_nt
+
         if transpose_a:
             a = jnp.transpose(a)
         if transpose_b:
             b = jnp.transpose(b)
-        return lax.dot_general(
-            a.reshape((-1, a.shape[-1])), b.reshape((b.shape[0], -1)),
-            (((1,), (0,)), ((), ())),
-            preferred_element_type=np.float32).astype(a.dtype).reshape(
+        # dtype-preserving custom vjp: bf16 fwd AND bwd dots with f32
+        # accumulation (the plain pet+astype pattern upcasts every
+        # backward dot to f32xf32 — see nn_ops._mxu_matmul)
+        return mxu_matmul_nt(
+            a.reshape((-1, a.shape[-1])),
+            b.reshape((b.shape[0], -1))).reshape(
                 a.shape[:-1] + b.shape[1:])
 
     use_acc = _accum_dtype(lhs.dtype) is not None
@@ -696,12 +700,16 @@ _export(dot)
 def batch_dot(lhs, rhs, transpose_a=False, transpose_b=False, **kwargs):
     """Reference ``batch_dot``: (B..., M, K) x (B..., K, N)."""
     def f(a, b):
+        from .nn_ops import mxu_batch_matmul
+
         if transpose_a:
             a = jnp.swapaxes(a, -1, -2)
         if transpose_b:
             b = jnp.swapaxes(b, -1, -2)
-        return jnp.matmul(a, b, preferred_element_type=np.float32).astype(
-            a.dtype) if np.dtype(a.dtype).name in ("bfloat16", "float16") \
+        # dtype-preserving custom vjp for low-precision operands (bwd
+        # dots stay bf16 — nn_ops._mxu_matmul rationale)
+        return mxu_batch_matmul(a, b) \
+            if np.dtype(a.dtype).name in ("bfloat16", "float16") \
             else jnp.matmul(a, b)
 
     return apply_op(f, lhs, rhs, name="batch_dot")

@@ -351,7 +351,11 @@ class Symbol:
         info = {}
         for n in order:
             if n.is_var():
-                shape = given.get(n.name) or n.attrs.get("__shape__")
+                # NB: `or` would treat a provided 0-d shape () as
+                # missing (scalar constants from the ONNX importer)
+                shape = given.get(n.name)
+                if shape is None:
+                    shape = n.attrs.get("__shape__")
                 dt = dtypes.get(n.name) or np.dtype(
                     n.attrs.get("__dtype__", np.float32))
                 info[id(n)] = None if shape is None else \
