@@ -388,6 +388,31 @@ _TRANSLATIONS = {
 }
 
 
+def _scalar_op(onnx_op, reverse=False):
+    """Scalar-arithmetic family (x op c, and c op x for the _r
+    variants).  The constant is emitted float32 — the subset's scope is
+    float32 graphs (int/f16 tensors would need dtype-tracked constants;
+    opset 13 has no CastLike)."""
+    def conv(node, ins, out, attrs):
+        c = out + "__s"
+        operands = [c, ins[0]] if reverse else [ins[0], c]
+        return [
+            _const(c, np.float32(float(attrs.get("scalar", 0.0)))),
+            _node(onnx_op, operands, [out], out),
+        ]
+    return conv
+
+
+_TRANSLATIONS.update({
+    "_mul_scalar": _scalar_op("Mul"),
+    "_div_scalar": _scalar_op("Div"),
+    "_plus_scalar": _scalar_op("Add"),
+    "_minus_scalar": _scalar_op("Sub"),
+    "_rminus_scalar": _scalar_op("Sub", reverse=True),
+    "_rdiv_scalar": _scalar_op("Div", reverse=True),
+})
+
+
 _NP2ONNX = {"float32": P.FLOAT, "float64": P.DOUBLE, "int64": P.INT64,
             "int32": P.INT32, "int8": P.INT8, "uint8": P.UINT8,
             "float16": P.FLOAT16}
