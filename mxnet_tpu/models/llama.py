@@ -837,8 +837,9 @@ def _scan_machinery(model):
         with _trace_guard():  # inline the template body (no nested jit)
             return template(NDArray(carry))._data
 
-    def fn(hr, *stk):
-        import jax
+    import jax
+
+    def _scan_raw(hr, *stk):
         from jax import lax
 
         def body(carry, sl):
@@ -847,8 +848,14 @@ def _scan_machinery(model):
         out, _ = lax.scan(body, hr, tuple(stk))
         return out
 
+    # jit the scan program: (a) eager steps run ONE compiled program
+    # instead of a traced-eager loop, and (b) shard_map-based layers
+    # (ring/Ulysses attention) require a jit around them — eager scan
+    # evaluation of a shard_map body is NotImplemented in jax
+    fn = jax.jit(_scan_raw)
+
     cache = {"names": names, "shells": shells, "fn": fn,
-             "apply_one": apply_one}
+             "apply_one": apply_one, "_scan_raw": _scan_raw}
     model._scan_mach = cache
     return cache
 
