@@ -855,7 +855,7 @@ def _scan_machinery(model):
     fn = jax.jit(_scan_raw)
 
     cache = {"names": names, "shells": shells, "fn": fn,
-             "apply_one": apply_one, "_scan_raw": _scan_raw}
+             "apply_one": apply_one}
     model._scan_mach = cache
     return cache
 

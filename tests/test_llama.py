@@ -360,8 +360,6 @@ def test_scan_layers_on_tp_mesh_matches_loop():
     loss and gradients as the python layer loop on the same mesh."""
     import numpy as np
 
-    from mxnet_tpu import parallel
-
     rs = np.random.RandomState(0)
     ids_np = rs.randint(0, 256, (4, 16))
     labels_np = rs.randint(0, 256, (4, 16))
@@ -405,8 +403,6 @@ def test_scan_layers_ring_attention_on_mesh():
     scan evaluation of a shard_map body is NotImplemented in jax — the
     machinery jits the scan exactly for this) and match the loop."""
     import numpy as np
-
-    from mxnet_tpu import parallel
 
     rs = np.random.RandomState(0)
     ids_np = rs.randint(0, 256, (4, 32))
