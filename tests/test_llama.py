@@ -424,3 +424,36 @@ def test_scan_layers_ring_attention_on_mesh():
     np.testing.assert_allclose(res[True][0], res[False][0], rtol=1e-5)
     np.testing.assert_allclose(res[True][1], res[False][1], rtol=1e-4,
                                atol=1e-5)
+
+
+@pytest.mark.parametrize("mode,mesh_shape,factory", [
+    ("ulysses", {"dp": 2, "sp": 4},
+     lambda scan: llama.llama_tiny(num_layers=2, attn_mode="ulysses",
+                                   scan_layers=scan)),
+    ("moe", {"dp": 2, "ep": 2, "tp": 2},
+     lambda scan: llama.mixtral_tiny(attn_mode="sdpa",
+                                     moe_router="expert_choice",
+                                     scan_layers=scan)),
+], ids=["ulysses", "moe"])
+def test_scan_layers_composes(mode, mesh_shape, factory):
+    """scan_layers x {Ulysses sequence parallelism, MoE expert bank}:
+    the scanned stack (the (L, E, ...) stacked expert weights included)
+    must match the python loop on the sharded mesh."""
+    import numpy as np
+
+    rs = np.random.RandomState(0)
+    ids_np = rs.randint(0, 256, (4, 16))
+    mesh = parallel.make_mesh(mesh_shape)
+    res = {}
+    for scan in (False, True):
+        with parallel.mesh_scope(mesh):
+            mx.random.seed(9)
+            net = factory(scan)
+            net.initialize()
+            llama.shard_llama(net, mesh)
+            ids = parallel.shard_batch(nd.array(ids_np, dtype="int32"))
+            with autograd.record():
+                loss = (net(ids).astype("float32") ** 2).mean()
+            loss.backward()
+            res[scan] = float(loss.asscalar())
+    np.testing.assert_allclose(res[True], res[False], rtol=1e-5)
