@@ -33,6 +33,47 @@ import time
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 
+def _grad_matmul_case(use_custom):
+    """fn(a, b) -> loss + sum-of-grads for a 2048 matmul, either through
+    the framework's dtype-preserving custom vjp (bf16 backward dots) or
+    the naive dot(pet=f32).astype(bf16) pattern whose cotangents force
+    f32xf32 backward dots (the r4 _mxu_matmul rationale).  FLOPs per
+    call = 3x the forward (fwd + two bwd contractions); the loss value
+    is folded into the digest so DCE cannot drop the forward dot."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    def custom_fwd(ar, br):
+        from mxnet_tpu.ops.nn_ops import mxu_matmul_nt
+
+        return mxu_matmul_nt(ar, br)
+
+    def pet_fwd(ar, br):
+        return lax.dot_general(
+            ar, br, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32).astype(ar.dtype)
+
+    fwd = custom_fwd if use_custom else pet_fwd
+
+    def fn(a, b):
+        from mxnet_tpu.ops.registry import apply_op
+
+        def f(ar, br):
+            def loss(ar_, br_):
+                y = fwd(ar_, br_)
+                return jnp.sum(y.astype(jnp.float32))
+
+            lv, (da, db) = jax.value_and_grad(
+                loss, argnums=(0, 1))(ar, br)
+            return lv + jnp.sum(da.astype(jnp.float32)) + \
+                jnp.sum(db.astype(jnp.float32))
+
+        return apply_op(f, a, b, name="matmul_fwdbwd")
+
+    return fn
+
+
 def _cases(nd, mxr):
     """[(name, fn(*inputs)->NDArray, [inputs], flops, bytes_moved)] —
     flops use 1 MAC = 2."""
@@ -94,6 +135,15 @@ def _cases(nd, mxr):
              qx, qw, a1, a2, a3, a4, kernel=(3, 3), pad=(1, 1),
              num_filter=C, no_bias=True)[0],
          [qcx, qcw, qcx_mn, qcx_mx, qcw_mn, qcw_mx], conv_flops, 0),
+        # fwd+bwd matmul pair: the framework's dtype-preserving custom
+        # vjp (bf16 backward dots) vs the naive pet+astype reference
+        # whose backward runs f32xf32 — the r4 fix's measured win
+        ("matmul_fwdbwd_2048_bf16_customvjp",
+         _grad_matmul_case(use_custom=True),
+         [a_mm, b_mm], 3 * 2 * M * N * K, 0),
+        ("matmul_fwdbwd_2048_bf16_petref",
+         _grad_matmul_case(use_custom=False),
+         [a_mm, b_mm], 3 * 2 * M * N * K, 0),
         ("quantized_matmul_2048_int8",
          lambda qa, qb, a1, a2, a3, a4: nd.quantized_fully_connected(
              qa, qb, a1, a2, a3, a4, num_hidden=N, no_bias=True,

@@ -455,5 +455,16 @@ def test_scan_layers_composes(mode, mesh_shape, factory):
             with autograd.record():
                 loss = (net(ids).astype("float32") ** 2).mean()
             loss.backward()
-            res[scan] = float(loss.asscalar())
-    np.testing.assert_allclose(res[True], res[False], rtol=1e-5)
+            # representative LAYER-STACKED grads: layer-1's mlp (the
+            # (L, E, ...) expert bank for moe) + attention o_proj
+            mlp = net.model.layers[1].mlp
+            gw = (mlp.down_weight if hasattr(mlp, "down_weight")
+                  else mlp.down_proj.weight).grad().asnumpy()
+            go = net.model.layers[1].self_attn.o_proj.weight \
+                .grad().asnumpy()
+            res[scan] = (float(loss.asscalar()), gw, go)
+    np.testing.assert_allclose(res[True][0], res[False][0], rtol=1e-5)
+    np.testing.assert_allclose(res[True][1], res[False][1], rtol=1e-4,
+                               atol=1e-5)
+    np.testing.assert_allclose(res[True][2], res[False][2], rtol=1e-4,
+                               atol=1e-5)
