@@ -94,6 +94,63 @@ def div_sqrt_dim(data, **kwargs):
 _export(div_sqrt_dim, aliases=("_contrib_div_sqrt_dim",))
 
 
+def _mxu_einsum(spec, da_spec, db_spec):
+    """Dtype-preserving two-operand einsum for low-precision inputs:
+    f32 MXU accumulation, outputs AND cotangents downcast to the first
+    operand's dtype — same rationale as nn_ops._mxu_matmul (the plain
+    pet+astype pattern upcasts every backward contraction to f32xf32).
+    ``da_spec``/``db_spec`` are the transpose einsums over (g, other)
+    and (g, first) respectively."""
+    import jax
+
+    @jax.custom_vjp
+    def f(a, b):
+        return jnp.einsum(spec, a, b,
+                          preferred_element_type=np.float32).astype(
+                              a.dtype)
+
+    def fwd(a, b):
+        return f(a, b), (a, b)
+
+    def bwd(res, g):
+        a, b = res
+        g = g.astype(a.dtype)
+        ga = jnp.einsum(da_spec, g, b,
+                        preferred_element_type=np.float32).astype(a.dtype)
+        gb = jnp.einsum(db_spec, g, a,
+                        preferred_element_type=np.float32).astype(b.dtype)
+        return ga, gb
+
+    f.defvjp(fwd, bwd)
+    return f
+
+
+_QK_EINSUM = None
+_VALATT_EINSUM = None
+
+
+def _qk_einsum():
+    global _QK_EINSUM
+    if _QK_EINSUM is None:
+        _QK_EINSUM = _mxu_einsum("tbnh,sbnh->bnts",
+                                 "bnts,sbnh->tbnh",
+                                 "bnts,tbnh->sbnh")
+    return _QK_EINSUM
+
+
+def _valatt_einsum():
+    global _VALATT_EINSUM
+    if _VALATT_EINSUM is None:
+        _VALATT_EINSUM = _mxu_einsum("bnts,sbnh->tbnh",
+                                     "tbnh,sbnh->bnts",
+                                     "tbnh,bnts->sbnh")
+    return _VALATT_EINSUM
+
+
+def _low_precision(x):
+    return np.dtype(x.dtype).name in ("bfloat16", "float16")
+
+
 def interleaved_matmul_selfatt_qk(queries_keys_values, heads=1, **kwargs):
     """Reference contrib op: projected interleaved QKV (T, B, 3*E) →
     attention scores (B*heads, T, T) — kept for GluonNLP-script parity;
@@ -106,8 +163,11 @@ def interleaved_matmul_selfatt_qk(queries_keys_values, heads=1, **kwargs):
         q = qkv[:, :, :, 0]
         k = qkv[:, :, :, 1]
         q = q / np.sqrt(h)
-        scores = jnp.einsum("tbnh,sbnh->bnts", q, k,
-                            preferred_element_type=np.float32)
+        if _low_precision(qkv):
+            scores = _qk_einsum()(q, k)
+        else:
+            scores = jnp.einsum("tbnh,sbnh->bnts", q, k,
+                                preferred_element_type=np.float32)
         return scores.reshape(b * heads, t, t).astype(qkv.dtype)
 
     return apply_op(f, queries_keys_values, name="interleaved_selfatt_qk")
@@ -127,8 +187,11 @@ def interleaved_matmul_selfatt_valatt(queries_keys_values, attention,
         h = e // heads
         v = qkv.reshape(t, b, heads, 3, h)[:, :, :, 2]
         att = att.reshape(b, heads, t, t)
-        out = jnp.einsum("bnts,sbnh->tbnh", att, v,
-                         preferred_element_type=np.float32)
+        if _low_precision(qkv):
+            out = _valatt_einsum()(att.astype(qkv.dtype), v)
+        else:
+            out = jnp.einsum("bnts,sbnh->tbnh", att, v,
+                             preferred_element_type=np.float32)
         return out.reshape(t, b, e).astype(qkv.dtype)
 
     return apply_op(f, queries_keys_values, attention,

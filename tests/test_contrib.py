@@ -368,3 +368,32 @@ def test_with_seed_decorator():
     gen()
     gen()
     assert vals[0] == vals[1]
+
+
+def test_interleaved_attention_bf16_grads_match_f32():
+    """The interleaved attention pair's dtype-preserving custom vjps
+    (r4): bf16 input gradients must match the f32 oracle within bf16
+    rounding — the backward einsums stay low-precision instead of the
+    pet+astype pattern's f32xf32."""
+    import numpy as np
+
+    rs = np.random.RandomState(0)
+    qkv_np = rs.randn(6, 2, 3 * 8).astype(np.float32)
+
+    from mxnet_tpu import autograd
+
+    def grad_of(dtype):
+        qkv = nd.array(qkv_np).astype(dtype)
+        qkv.attach_grad()
+        with autograd.record():
+            att = nd.softmax(
+                nd.interleaved_matmul_selfatt_qk(qkv, heads=2), axis=-1)
+            out = nd.interleaved_matmul_selfatt_valatt(qkv, att, heads=2)
+            loss = (out.astype("float32") ** 2).sum()
+        loss.backward()
+        return qkv.grad.asnumpy().astype(np.float32)
+
+    g32 = grad_of("float32")
+    gb = grad_of("bfloat16")
+    rel = np.abs(g32 - gb).max() / (np.abs(g32).max() + 1e-9)
+    assert rel < 0.03, rel
