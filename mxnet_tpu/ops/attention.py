@@ -101,8 +101,6 @@ def _mxu_einsum(spec, da_spec, db_spec):
     pet+astype pattern upcasts every backward contraction to f32xf32).
     ``da_spec``/``db_spec`` are the transpose einsums over (g, other)
     and (g, first) respectively."""
-    import jax
-
     @jax.custom_vjp
     def f(a, b):
         return jnp.einsum(spec, a, b,
@@ -125,30 +123,19 @@ def _mxu_einsum(spec, da_spec, db_spec):
     return f
 
 
-_QK_EINSUM = None
-_VALATT_EINSUM = None
-
-
-def _qk_einsum():
-    global _QK_EINSUM
-    if _QK_EINSUM is None:
-        _QK_EINSUM = _mxu_einsum("tbnh,sbnh->bnts",
-                                 "bnts,sbnh->tbnh",
-                                 "bnts,tbnh->sbnh")
-    return _QK_EINSUM
-
-
-def _valatt_einsum():
-    global _VALATT_EINSUM
-    if _VALATT_EINSUM is None:
-        _VALATT_EINSUM = _mxu_einsum("bnts,sbnh->tbnh",
-                                     "tbnh,sbnh->bnts",
-                                     "tbnh,bnts->sbnh")
-    return _VALATT_EINSUM
+# module-level: stable function identity for XLA program caching
+_QK_EINSUM = _mxu_einsum("tbnh,sbnh->bnts",
+                         "bnts,sbnh->tbnh",
+                         "bnts,tbnh->sbnh")
+_VALATT_EINSUM = _mxu_einsum("bnts,sbnh->tbnh",
+                             "tbnh,sbnh->bnts",
+                             "tbnh,bnts->sbnh")
 
 
 def _low_precision(x):
-    return np.dtype(x.dtype).name in ("bfloat16", "float16")
+    from .registry import accum_dtype
+
+    return accum_dtype(x.dtype) is not None
 
 
 def interleaved_matmul_selfatt_qk(queries_keys_values, heads=1, **kwargs):
@@ -164,7 +151,7 @@ def interleaved_matmul_selfatt_qk(queries_keys_values, heads=1, **kwargs):
         k = qkv[:, :, :, 1]
         q = q / np.sqrt(h)
         if _low_precision(qkv):
-            scores = _qk_einsum()(q, k)
+            scores = _QK_EINSUM(q, k)
         else:
             scores = jnp.einsum("tbnh,sbnh->bnts", q, k,
                                 preferred_element_type=np.float32)
@@ -187,8 +174,13 @@ def interleaved_matmul_selfatt_valatt(queries_keys_values, attention,
         h = e // heads
         v = qkv.reshape(t, b, heads, 3, h)[:, :, :, 2]
         att = att.reshape(b, heads, t, t)
-        if _low_precision(qkv):
-            out = _valatt_einsum()(att.astype(qkv.dtype), v)
+        if _low_precision(qkv) and _low_precision(att):
+            # both operands already low-precision -> keep the backward
+            # einsums in that dtype too.  A mixed caller (f32 softmax
+            # probs x bf16 values — standard stability practice) keeps
+            # the full-precision contraction below: rounding the probs
+            # to bf16 here would silently degrade the forward.
+            out = _VALATT_EINSUM(att, v)
         else:
             out = jnp.einsum("bnts,sbnh->tbnh", att, v,
                              preferred_element_type=np.float32)
