@@ -708,8 +708,10 @@ def batch_dot(lhs, rhs, transpose_a=False, transpose_b=False, **kwargs):
             b = jnp.swapaxes(b, -1, -2)
         # dtype-preserving custom vjp for low-precision operands (bwd
         # dots stay bf16 — nn_ops._mxu_matmul rationale)
+        from .registry import accum_dtype
+
         return mxu_batch_matmul(a, b) \
-            if np.dtype(a.dtype).name in ("bfloat16", "float16") \
+            if accum_dtype(a.dtype) is not None \
             else jnp.matmul(a, b)
 
     return apply_op(f, lhs, rhs, name="batch_dot")
