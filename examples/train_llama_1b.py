@@ -70,12 +70,22 @@ def main():
     ids = nd.array(ids_np[:, :-1], dtype="int32")
     labels = nd.array(ids_np[:, 1:], dtype="int32")
 
+    # loss-in-graph: the token CE compiles as its own CachedOp instead
+    # of three eager dispatches per step (host dispatch is the scarce
+    # resource through the tunnel — bench.py's protocol, +11% measured
+    # on the ResNet leg)
+    class _TokenCE(gluon.HybridBlock):
+        def hybrid_forward(self, F, logits, lab):
+            return F.softmax_cross_entropy(
+                logits.reshape((-1, vocab)),
+                lab.reshape((-1,))) / (batch * seq)
+
+    loss_fn = _TokenCE()
+    loss_fn.hybridize()
+
     def step():
         with autograd.record():
-            logits = net(ids)
-            loss = nd.softmax_cross_entropy(
-                logits.reshape((-1, vocab)),
-                labels.reshape((-1,))) / (batch * seq)
+            loss = loss_fn(net(ids), labels)
         loss.backward()
         trainer.step(1)
         return loss
