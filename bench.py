@@ -32,6 +32,32 @@ def main():
         import jax
 
         jax.config.update("jax_platforms", plat)
+    else:
+        # fail FAST and machine-readably when the accelerator backend is
+        # down: in-process jax.devices() blocks for many minutes before
+        # raising when the remote tunnel is dead (observed r4), and a
+        # raw traceback leaves no JSON line for the driver to record
+        import subprocess
+        import sys
+
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; print(jax.devices()[0])"],
+                capture_output=True, text=True, timeout=240)
+            ok = r.returncode == 0
+            detail = (r.stdout or r.stderr).strip()[-200:]
+        except subprocess.TimeoutExpired:
+            ok, detail = False, "backend init timeout (240s)"
+        if not ok:
+            print(json.dumps({
+                "metric": "resnet50_v1_train_images_per_sec_per_chip",
+                "value": None,
+                "unit": "images/sec/chip",
+                "vs_baseline": None,
+                "error": f"accelerator backend unavailable: {detail}",
+            }))
+            return
 
     import numpy as np
 
