@@ -1,0 +1,59 @@
+"""Driver-entry-point guards: bench.py must print ONE parseable JSON
+line with the tracked keys, and __graft_entry__.entry() must return a
+jittable fn — a silent break in either loses the round's numbers (the
+driver runs them unattended on the chip)."""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.join(os.path.dirname(__file__), "..")
+
+
+@pytest.mark.slow
+def test_bench_py_emits_one_json_line():
+    env = dict(os.environ)
+    env.update(BENCH_PLATFORM="cpu", BENCH_STEPS="2", BENCH_WARMUP="1",
+               BENCH_REPEATS="1", BENCH_BATCH="2", BENCH_IMAGE="64",
+               BENCH_BERT_BATCH="2", BENCH_SEQ="16",
+               BENCH_DATA_STEPS="2")
+    env.pop("XLA_FLAGS", None)
+    r = subprocess.run([sys.executable, os.path.join(REPO, "bench.py")],
+                       capture_output=True, text=True, env=env,
+                       cwd=REPO, timeout=1500)
+    assert r.returncode == 0, r.stderr[-2000:]
+    lines = [ln for ln in r.stdout.splitlines() if ln.startswith("{")]
+    assert len(lines) == 1, r.stdout
+    rec = json.loads(lines[0])
+    assert rec["metric"] == "resnet50_v1_train_images_per_sec_per_chip"
+    assert rec["value"] > 0
+    assert rec["vs_baseline"] is None
+    assert "bert_base_samples_per_sec_per_chip" in rec, rec
+    assert "resnet50_v1_recordio_images_per_sec_per_chip" in rec, rec
+
+
+@pytest.mark.slow
+def test_graft_entry_compiles():
+    """entry() returns (fn, args) that jit-lowers (what the driver
+    compile-checks single-chip)."""
+    code = (
+        "import os, sys\n"
+        f"sys.path.insert(0, {REPO!r})\n"
+        "os.environ['XLA_FLAGS'] = "
+        "'--xla_force_host_platform_device_count=2'\n"
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "import __graft_entry__ as g\n"
+        "fn, args = g.entry()\n"
+        "jax.jit(fn).lower(*args)\n"
+        "print('ENTRY_OK')\n"
+    )
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    r = subprocess.run([sys.executable, "-c", code],
+                       capture_output=True, text=True, env=env,
+                       timeout=900)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "ENTRY_OK" in r.stdout
