@@ -1,0 +1,60 @@
+"""On-chip-lane HARNESS guard: every tests_tpu case must execute
+cleanly cpu-vs-cpu (tpu aliased to cpu) — a harness bug would void the
+entire 251-case on-chip run, which only happens when real chip time is
+available and can't be cheaply retried."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.join(os.path.dirname(__file__), "..")
+
+_BODY = r"""
+import os
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax; jax.config.update("jax_platforms", "cpu")
+import sys
+sys.path.insert(0, {repo!r}); sys.path.insert(0, {tpudir!r})
+import mxnet_tpu as mx
+
+mx.tpu = mx.cpu  # cpu-vs-cpu: harness-path validation, not numerics
+
+import test_tpu_parity as tp
+import test_tpu_parity_ext as te
+
+rec = lambda f, n, e: None
+fails = []
+for p in tp.CASES:
+    family, name, fn, inputs, rtol, atol = p.values
+    try:
+        tp.test_op_parity(family, name, fn, inputs, rtol, atol, rec)
+    except Exception as e:
+        fails.append((family, name, repr(e)))
+for p in te.CASES:
+    family, name, fn, inputs, rtol, atol, mxu = p.values
+    try:
+        te.test_op_parity_ext(family, name, fn, inputs, rtol, atol,
+                              mxu, rec)
+    except Exception as e:
+        fails.append((family, name, repr(e)))
+print(f"CASES={{len(tp.CASES) + len(te.CASES)}} FAILS={{len(fails)}}")
+for f in fails[:5]:
+    print("FAIL", f)
+assert not fails
+"""
+
+
+@pytest.mark.slow
+@pytest.mark.heavy
+def test_parity_lane_harness_executes_cpu_vs_cpu():
+    code = _BODY.format(repo=os.path.abspath(REPO),
+                        tpudir=os.path.abspath(
+                            os.path.join(REPO, "tests_tpu")))
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    r = subprocess.run([sys.executable, "-c", code],
+                       capture_output=True, text=True, env=env,
+                       timeout=1800)
+    assert r.returncode == 0, (r.stdout[-1500:], r.stderr[-1500:])
+    assert "FAILS=0" in r.stdout, r.stdout[-1500:]
