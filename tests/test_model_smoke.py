@@ -75,3 +75,27 @@ def test_detection_ops_smoke():
     rois = nd.array([[0, 1, 1, 6, 6]])
     out = nd.ROIAlign(feat, rois, pooled_size=(2, 2), spatial_scale=1.0)
     assert out.shape == (1, 4, 2, 2)
+
+
+def test_llama_scan_layers_smoke():
+    """Fast-lane guard for the scanned decoder (r4): one forward+step,
+    loss finite — full equivalences live in tests/test_llama.py."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon
+    from mxnet_tpu.models import llama
+
+    mx.random.seed(0)
+    net = llama.llama_tiny(num_layers=2, attn_mode="sdpa",
+                           scan_layers=True)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.05})
+    ids = nd.array(np.random.RandomState(0).randint(0, 256, (2, 8)),
+                   dtype="int32")
+    with autograd.record():
+        loss = (net(ids).astype("float32") ** 2).mean()
+    loss.backward()
+    trainer.step(2)
+    assert np.isfinite(float(loss.asscalar()))
