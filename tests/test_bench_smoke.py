@@ -34,6 +34,98 @@ def test_bench_py_emits_one_json_line():
     assert "resnet50_v1_recordio_images_per_sec_per_chip" in rec, rec
 
 
+# Faithful simulation of the accelerator environment whose downed tunnel
+# cost round 4 its multichip artifact (MULTICHIP_r04 rc=124): the real
+# sitecustomize registers an 'axon' PJRT backend and — crucially — sets
+# jax config jax_platforms='axon,cpu', which OVERRIDES the JAX_PLATFORMS
+# env var.  Any backend init then tries axon first and blocks (~25 min
+# observed).  The fake backend factory blocks 600s; only a subsequent
+# jax.config.update('jax_platforms', 'cpu') avoids it, exactly like the
+# real conftest/tooling route.
+_BLOCKED_SITECUSTOMIZE = """\
+import jax
+from jax._src import xla_bridge as _xb
+
+
+def _blocked_factory(*a, **k):
+    import sys, time
+    sys.stderr.write('SIMULATED TUNNEL HANG\\n')
+    sys.stderr.flush()
+    time.sleep(600)
+
+
+_xb.register_backend_factory('axon', _blocked_factory, priority=400,
+                             experimental=True)
+jax.config.update('jax_platforms', 'axon,cpu')
+"""
+
+
+def test_dryrun_multichip_tunnel_proof(tmp_path):
+    """With the driver's exact env shape (JAX_PLATFORMS=axon env var,
+    xla_force_host_platform_device_count in XLA_FLAGS, a sitecustomize
+    whose 'axon' backend init blocks), phase 1 must print within 60s —
+    i.e. dryrun_multichip must pin jax_platforms='cpu' at the config
+    level before any backend touch instead of querying devices."""
+    (tmp_path / "sitecustomize.py").write_text(_BLOCKED_SITECUSTOMIZE)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(tmp_path)
+    env["JAX_PLATFORMS"] = "axon"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    code = (
+        f"import sys\nsys.path.insert(0, {REPO!r})\n"
+        "import __graft_entry__ as g\n"
+        "g.dryrun_multichip(2)\n"
+    )
+    # ~26s measured idle; 180s gives CI-load headroom while still
+    # cleanly discriminating from the 600s simulated hang (and the real
+    # ~25-min one).  The stderr assert below catches any backend touch
+    # regardless of timing.
+    r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       text=True, env=env, timeout=180)
+    assert "SIMULATED TUNNEL HANG" not in r.stderr, \
+        "dryrun initialized the blocked accelerator backend"
+    assert r.returncode == 0, (r.stdout[-2000:], r.stderr[-2000:])
+    assert "dryrun_multichip(2)" in r.stdout and "OK" in r.stdout, r.stdout
+
+
+@pytest.mark.slow
+def test_dryrun_multichip_bootstrap_tunnel_proof(tmp_path):
+    """Same blocked-backend simulation, no XLA_FLAGS at all: the probe
+    subprocess hangs (killed at MXT_PROBE_TIMEOUT), and the bootstrap
+    child must still run the phases under its own cpu pin."""
+    (tmp_path / "sitecustomize.py").write_text(_BLOCKED_SITECUSTOMIZE)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(tmp_path)
+    env["JAX_PLATFORMS"] = "axon"
+    env.pop("XLA_FLAGS", None)
+    env["MXT_PROBE_TIMEOUT"] = "5"
+    code = (
+        f"import sys\nsys.path.insert(0, {REPO!r})\n"
+        "import __graft_entry__ as g\n"
+        "g.dryrun_multichip(2)\n"
+    )
+    r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       text=True, env=env, timeout=120)
+    assert r.returncode == 0, (r.stdout[-2000:], r.stderr[-2000:])
+    assert "dryrun_multichip(2)" in r.stdout and "OK" in r.stdout, r.stdout
+
+
+def test_dryrun_multichip_inprocess_smoke(monkeypatch, capfd):
+    """Core-lane guard (VERDICT r4 #10): drive the REAL
+    __graft_entry__.dryrun_multichip entry path end-to-end on the test
+    session's virtual mesh — no future round may ship a red
+    MULTICHIP artifact undetected."""
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    sys.path.insert(0, REPO)
+    try:
+        import __graft_entry__ as g
+        g.dryrun_multichip(2)
+    finally:
+        sys.path.remove(REPO)
+    out = capfd.readouterr().out
+    assert "dryrun_multichip(2)" in out and "OK" in out, out
+
+
 @pytest.mark.slow
 def test_graft_entry_compiles():
     """entry() returns (fn, args) that jit-lowers (what the driver
