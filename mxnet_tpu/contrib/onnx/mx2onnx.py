@@ -390,18 +390,38 @@ _TRANSLATIONS = {
 
 def _scalar_op(onnx_op, reverse=False):
     """Scalar-arithmetic family (x op c, and c op x for the _r
-    variants).  The constant is emitted float32 — the subset's scope is
-    float32 graphs (int/f16 tensors would need dtype-tracked constants;
-    opset 13 has no CastLike)."""
+    variants).  The constant is emitted in the TRACKED dtype of the
+    tensor operand (export_model threads it via the private
+    ``_onnx_in_dtype`` attr) so non-float32 graphs don't produce
+    type-mismatched binary ops that strict runtimes reject.  Integer
+    operands get a Cast-to-float32 mirroring the runtime's promotion
+    (the scalar passes through float(), so e.g. int32/2 is TRUE
+    division at runtime — an int ONNX Div would truncate)."""
     def conv(node, ins, out, attrs):
+        dt = np.dtype(attrs.get("_onnx_in_dtype") or np.float32)
+        val = float(attrs.get("scalar", 0.0))
         c = out + "__s"
-        operands = [c, ins[0]] if reverse else [ins[0], c]
-        return [
-            _const(c, np.float32(float(attrs.get("scalar", 0.0)))),
-            _node(onnx_op, operands, [out], out),
-        ]
+        nodes = []
+        data = ins[0]
+        if np.issubdtype(dt, np.integer):
+            # mirror the RUNTIME semantics: the scalar goes through
+            # float(), so an integer tensor promotes to float32 (true
+            # division included) — export a Cast, not an int constant
+            # (ONNX integer Div truncates; the runtime's never does)
+            data = out + "__f"
+            nodes.append(_node("Cast", [ins[0]], [data], data,
+                               {"to": P.FLOAT}))
+            dt = np.dtype(np.float32)
+        const = np.asarray(val, dtype=dt)
+        operands = [c, data] if reverse else [data, c]
+        nodes.append(_const(c, const))
+        nodes.append(_node(onnx_op, operands, [out], out))
+        return nodes
     return conv
 
+
+_SCALAR_OPS = ("_mul_scalar", "_div_scalar", "_plus_scalar",
+               "_minus_scalar", "_rminus_scalar", "_rdiv_scalar")
 
 _TRANSLATIONS.update({
     "_mul_scalar": _scalar_op("Mul"),
@@ -447,6 +467,7 @@ def export_model(sym, params, input_shapes, input_types=None,
         out_shapes = [() for _ in sym._heads]
     order = sym._topo()
     names = {}           # (id(node), oidx) -> onnx tensor name
+    tdtypes = {}         # onnx tensor name -> np.dtype (best effort)
     nodes_out = []
     initializers = []
     graph_inputs = []
@@ -456,17 +477,19 @@ def export_model(sym, params, input_shapes, input_types=None,
         if node.is_var():
             names[(id(node), 0)] = node.name
             if node.name in params:
-                arr = params[node.name]
-                initializers.append(
-                    _tensor(node.name, np.asarray(arr.asnumpy())))
+                arr = np.asarray(params[node.name].asnumpy())
+                tdtypes[node.name] = arr.dtype
+                initializers.append(_tensor(node.name, arr))
             else:
                 if data_idx >= len(input_shapes):
                     raise MXNetError(
                         f"no input shape provided for {node.name!r}")
                 et = P.FLOAT
+                tdtypes[node.name] = np.dtype(np.float32)
                 if input_types is not None and data_idx < len(input_types):
-                    et = _NP2ONNX.get(np.dtype(input_types[data_idx]).name,
-                                      P.FLOAT)
+                    dt = np.dtype(input_types[data_idx])
+                    et = _NP2ONNX.get(dt.name, P.FLOAT)
+                    tdtypes[node.name] = dt
                 graph_inputs.append(
                     _value_info(node.name, input_shapes[data_idx], et))
                 data_idx += 1
@@ -483,6 +506,24 @@ def export_model(sym, params, input_shapes, input_types=None,
                 f"{out_name}_out{i}"
         attrs = {k: v for k, v in node.attrs.items()
                  if not k.startswith("__")}
+        # dtype flow for the translators that need it (_scalar_op): the
+        # lookup result dtype follows the table, `where` follows its
+        # branches (the condition is Cast to BOOL), everything else in
+        # the subset follows its first dtype-known input
+        if node.op in ("Embedding", "embedding", "where") and len(ins) > 1:
+            out_dt = tdtypes.get(ins[1])
+        else:
+            out_dt = next((tdtypes[i] for i in ins if i in tdtypes), None)
+        if out_dt is not None:
+            attrs["_onnx_in_dtype"] = out_dt
+            if node.op in _SCALAR_OPS and np.issubdtype(out_dt,
+                                                        np.integer):
+                # the runtime promotes int scalar-arithmetic to float32
+                # (scalar passes through float()); the emitted Cast in
+                # _scalar_op makes the exported output f32 too
+                out_dt = np.dtype(np.float32)
+            for i in range(node.num_outputs):
+                tdtypes[names[(id(node), i)]] = out_dt
         nodes_out.extend(trans(node, ins, out_name, attrs))
 
     outputs = [_value_info(names[(id(n), oi)], shp or ())

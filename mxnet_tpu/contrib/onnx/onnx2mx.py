@@ -376,9 +376,14 @@ def _clip_imp(sym, node, ins, params):
         return float(np.asarray(v).ravel()[0])
 
     lo, hi = scalar(1), scalar(2)
+    if lo is None and hi is None:
+        return sym.identity(ins[0], name=node["outputs"][0])
+    # absent single bound: exact float32 extreme, so legitimate values up
+    # to f32 max pass through unclipped
+    f32max = float(np.finfo(np.float32).max)
     return sym.clip(ins[0],
-                    a_min=-3.4e38 if lo is None else lo,
-                    a_max=3.4e38 if hi is None else hi,
+                    a_min=-f32max if lo is None else lo,
+                    a_max=f32max if hi is None else hi,
                     name=node["outputs"][0])
 
 

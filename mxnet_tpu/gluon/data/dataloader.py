@@ -106,6 +106,25 @@ def _proc_start_ticks(pid):
         return None
 
 
+def _proc_start_epoch(pid):
+    """Wall-clock (epoch seconds) at which ``pid`` started, or None:
+    boot time (``/proc/stat`` btime) + start-ticks / CLK_TCK."""
+    ticks = _proc_start_ticks(pid)
+    if ticks is None:
+        return None
+    try:
+        with open("/proc/stat", "rb") as f:
+            for line in f:
+                if line.startswith(b"btime "):
+                    btime = int(line.split()[1])
+                    break
+            else:
+                return None
+        return btime + ticks / os.sysconf("SC_CLK_TCK")
+    except (OSError, ValueError):
+        return None
+
+
 #: Blocks are only reclaimed once this old (seconds) — guards against
 #: unlinking a live foreign-pid-namespace owner's block when /dev/shm is
 #: shared across containers (ADVICE r3).  Set to an hour: in-flight
@@ -177,7 +196,16 @@ def _sweep_stale_shm():
         else:
             try:
                 os.kill(pid, 0)
-                continue  # owner (or its pid-reuser) alive → leave it
+                # pid alive — but it may be a RECYCLER, not the owner
+                # (legacy mxt-<pid>-<hex> names carry no start-ticks).
+                # An owner creates its blocks AFTER it starts, so a
+                # block whose mtime PREDATES the live process's start
+                # time cannot belong to it → fall through to the age
+                # gate.  Unknown start time → conservatively leave it.
+                start = _proc_start_epoch(pid)
+                if start is None or os.stat(
+                        os.path.join(shm_dir, fn)).st_mtime >= start - 60:
+                    continue
             except ProcessLookupError:
                 pass
             except OSError:

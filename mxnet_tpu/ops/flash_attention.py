@@ -48,6 +48,9 @@ def _sdpa_ref(q, k, v, causal, scale):
 def _fa_forward_chunked(q, k, v, causal, scale, block=512):
     """jnp online-softmax forward scanned over K blocks — the non-TPU
     analog of the pallas kernel with the SAME O(T*block) score memory.
+    Fully-masked query rows (causal with tq > tk) output ZEROS — the
+    flash-kernel convention, unlike the dense softmax's NaN; pinned by
+    tests/test_llama.py::test_flash_attention_degenerate_fully_masked_rows.
     Replaces the dense ``_sdpa_ref`` fallback on CPU lowerings so the
     scale-proof memory analysis (tools/scale_proof.py) prices the
     flash memory profile, not a (T, T) materialization the real TPU

@@ -875,7 +875,11 @@ def embedding(data, weight, input_dim=None, output_dim=None, dtype=None,
             import jax
 
             oh = jax.nn.one_hot(ii, w.shape[0], dtype=w.dtype)
-            return jnp.einsum("...v,vh->...h", oh, w)
+            # mxu_matmul_nt pins f32 accumulation on the forward AND the
+            # derived dw contraction (many per-token low-precision
+            # gradient rows sum over the token axis), with cotangents
+            # kept in the operand dtype — the same contract as FC/dot
+            return mxu_matmul_nt(oh, w)
         return jnp.take(w, ii, axis=0)
 
     return apply_op(f, data, weight, name="embedding")

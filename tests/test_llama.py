@@ -59,6 +59,34 @@ def test_flash_attention_chunked_backward():
         assert float(jnp.abs(a - b).max()) < 1e-4
 
 
+def test_flash_attention_degenerate_fully_masked_rows():
+    """Causal with tq > tk leaves the leading (tq - tk) query rows with
+    ZERO visible keys.  The flash convention (and the pallas kernel's
+    online softmax) outputs ZEROS for such rows; the dense softmax
+    reference produces NaN (0/0).  Pin the zero-output semantics so the
+    TPU kernel and the chunked CPU fallback stay aligned and the
+    behavior change vs a NaN-propagating dense path is a documented
+    contract, not an accident (ADVICE r4)."""
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ops.flash_attention import (_fa_forward_chunked,
+                                               flash_attention_raw)
+
+    rng = onp.random.RandomState(2)
+    tq, tk = 8, 5
+    q = jnp.asarray(rng.normal(size=(1, 2, tq, 16)).astype("f"))
+    k = jnp.asarray(rng.normal(size=(1, 2, tk, 16)).astype("f"))
+    v = jnp.asarray(rng.normal(size=(1, 2, tk, 16)).astype("f"))
+    n_masked = tq - tk
+    for out in (flash_attention_raw(q, k, v, True, None),
+                _fa_forward_chunked(q, k, v, True, 0.25, block=4)):
+        out = onp.asarray(out)
+        assert onp.isfinite(out).all(), "NaN leaked from masked rows"
+        assert (out[:, :, :n_masked] == 0).all(), \
+            "fully-masked query rows must be exactly zero"
+        assert (onp.abs(out[:, :, n_masked:]) > 0).any()
+
+
 def test_rmsnorm():
     ln = llama.RMSNorm(8)
     ln.initialize()

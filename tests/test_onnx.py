@@ -302,3 +302,73 @@ def test_bert_onnx_export_roundtrip(tmp_path):
     assert len(got) == len(ref) == 4
     for g, r in zip(got, ref):
         onp.testing.assert_allclose(g, r, rtol=2e-4, atol=2e-5)
+
+
+def test_export_scalar_op_dtype_tracking(tmp_path):
+    """Non-float32 graphs with scalar arithmetic (ADVICE r4): constants
+    are emitted in the tracked operand dtype, integer operands get the
+    runtime's promote-to-f32 Cast (true division, never ONNX int
+    truncation), and `where` follows its BRANCH dtype, not the
+    condition's."""
+    from mxnet_tpu.contrib.onnx import import_model
+
+    # int32 / 2 == true division through export+import
+    path = str(tmp_path / "i32div.onnx")
+    export_model(sym.var("data") / 2.0, {}, [(1, 4)],
+                 input_types=["int32"], onnx_file_path=path)
+    s, a, _ = import_model(path)
+    exe = s.bind(mx.cpu(), {"data": nd.array(
+        onp.array([[5, 2, 7, 9]], dtype="int32"), dtype="int32"), **a})
+    got = exe.forward()[0].asnumpy()
+    assert got.ravel().tolist() == [2.5, 1.0, 3.5, 4.5], got
+
+    # fractional scalar on an int operand exports via the Cast path
+    path2 = str(tmp_path / "i32mul.onnx")
+    export_model(sym.var("data") * 0.5, {}, [(1, 3)],
+                 input_types=["int32"], onnx_file_path=path2)
+    s2, a2, _ = import_model(path2)
+    exe2 = s2.bind(mx.cpu(), {"data": nd.array(
+        onp.array([[1, 3, 5]], dtype="int32"), dtype="int32"), **a2})
+    assert exe2.forward()[0].asnumpy().ravel().tolist() == [0.5, 1.5, 2.5]
+
+    # where(mask:int32, x:f32, y:f32) * 0.5 — branch dtype wins
+    m, xx, yy = sym.var("mask"), sym.var("x"), sym.var("y")
+    path3 = str(tmp_path / "where.onnx")
+    export_model(sym.where(m, xx, yy) * 0.5, {}, [(2, 2)] * 3,
+                 input_types=["int32", "float32", "float32"],
+                 onnx_file_path=path3)
+    s3, a3, _ = import_model(path3)
+    exe3 = s3.bind(mx.cpu(), {
+        "mask": nd.array(onp.array([[1, 0], [0, 1]], dtype="int32"),
+                         dtype="int32"),
+        "x": nd.array(onp.full((2, 2), 4.0, dtype="float32")),
+        "y": nd.array(onp.full((2, 2), 8.0, dtype="float32")), **a3})
+    assert exe3.forward()[0].asnumpy().ravel().tolist() == \
+        [2.0, 4.0, 4.0, 2.0]
+
+
+def test_import_clip_absent_bounds(tmp_path):
+    """ONNX Clip with no min/max inputs is an identity: legitimate
+    extreme float32 values (inside (3.4e38, f32max]) pass through
+    unclipped (ADVICE r4)."""
+    from mxnet_tpu.contrib.onnx import import_model
+    from mxnet_tpu.contrib.onnx import mx2onnx as M
+
+    nodes = [M._node("Relu", ["data"], ["r0"], "r0"),
+             M._node("Clip", ["r0"], ["out"], "out")]
+    graph = b"".join(P.fbytes(1, nb) for nb in nodes)
+    graph += P.fstr(2, "clip_test")
+    graph += P.fbytes(11, M._value_info("data", (1, 2), P.FLOAT))
+    graph += P.fbytes(12, M._value_info("out", (1, 2), P.FLOAT))
+    model = P.fint(1, M._IR_VERSION) + P.fstr(2, "t") + P.fstr(3, "0")
+    model += P.fbytes(7, graph) + P.fbytes(8, P.fint(2, M._OPSET))
+    path = str(tmp_path / "clip.onnx")
+    with open(path, "wb") as f:
+        f.write(model)
+    s, a, _ = import_model(path)
+    big = float(onp.float32(3.402e38))
+    exe = s.bind(mx.cpu(), {"data": nd.array(
+        onp.array([[big, -5.0]], dtype="float32")), **a})
+    got = exe.forward()[0].asnumpy()
+    assert got[0, 0] == onp.float32(big), got
+    assert got[0, 1] == 0.0
