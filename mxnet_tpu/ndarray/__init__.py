@@ -189,3 +189,4 @@ def concat_dim0(arrays):
 # sparse lives in its own module (BCOO-backed); imported lazily to keep the
 # base import light
 from . import sparse  # noqa: E402,F401
+from .sparse import cast_storage  # noqa: E402,F401  (mx.nd.cast_storage)

@@ -274,6 +274,14 @@ class NDArray:
     def _binary(self, other, jf, name, reflected=False):
         from ..ops.registry import apply_op
 
+        from . import sparse as _sp
+
+        if isinstance(other, _sp.BaseSparseNDArray):
+            canon = {"add": "add", "sub": "subtract", "mul": "multiply",
+                     "div": "divide"}.get(name, name)
+            if reflected:
+                return _sp.dispatch_binary(canon, jf, other, self)
+            return _sp.dispatch_binary(canon, jf, self, other)
         if isinstance(other, NDArray):
             if reflected:
                 return apply_op(lambda a, b: jf(b, a), self, other, name=name)

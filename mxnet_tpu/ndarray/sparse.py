@@ -9,9 +9,19 @@ dense jax arrays — the exact layout the reference uses — so gather/scatter
 ops lower to XLA dynamic-slice/scatter which TPU executes natively.  CSR
 keeps (indptr, indices, data).  Dense bridges use jnp scatter/gather; the
 BCOO interop (jax.experimental.sparse) is exposed via ``to_bcoo`` for ops
-that want XLA's sparse matmul path.  This module covers the storage types +
-conversion + the row_sparse paths the optimizer/kvstore need; the wider
-sparse op algebra grows in later rounds (SURVEY §7 stage 8).
+that want XLA's sparse matmul path.  The module covers the storage types,
+``cast_storage`` across all stype pairs, the row_sparse optimizer/kvstore
+paths, sparse ``dot``, and an FComputeEx-style elemwise algebra
+(``dispatch_binary`` / ``dispatch_unary``, wired into the ``mx.nd``
+elemwise surface): binary kernels stay sparse where the math allows
+(union merge for ±, intersection for ×, stored-entry kernels against
+dense/scalars) and fall back to densify otherwise — mirroring the
+reference's storage-fallback behavior.
+
+Index-set merges (union/intersection/searchsorted) run on HOST numpy —
+they are data-dependent-shape operations that XLA cannot tile — while all
+VALUE arithmetic stays on device.  Imperative-only, like the reference's
+sparse NDArray surface: these ops do not record autograd tape.
 """
 from __future__ import annotations
 
@@ -41,6 +51,35 @@ class BaseSparseNDArray:
 
     def wait_to_read(self):
         return self
+
+    # arithmetic routes through the stype-dispatching nd elemwise ops
+    # (sparse kernels where they exist, storage fallback otherwise)
+    def __add__(self, other):
+        return add(self, other)
+
+    def __radd__(self, other):
+        return add(other, self)
+
+    def __sub__(self, other):
+        return subtract(self, other)
+
+    def __rsub__(self, other):
+        return subtract(other, self)
+
+    def __mul__(self, other):
+        return multiply(self, other)
+
+    def __rmul__(self, other):
+        return multiply(other, self)
+
+    def __truediv__(self, other):
+        return divide(self, other)
+
+    def __rtruediv__(self, other):
+        return divide(other, self)
+
+    def __neg__(self):
+        return _with_values(self, -self.data._data)
 
 
 class RowSparseNDArray(BaseSparseNDArray):
@@ -85,7 +124,7 @@ class RowSparseNDArray(BaseSparseNDArray):
             return self.todense()
         if stype == "row_sparse":
             return self
-        raise MXNetError(f"cannot convert row_sparse to {stype}")
+        return cast_storage(self, stype)
 
     def copyto(self, other):
         if isinstance(other, RowSparseNDArray):
@@ -144,11 +183,9 @@ class CSRNDArray(BaseSparseNDArray):
     def todense(self) -> NDArray:
         import jax.numpy as jnp
 
-        indptr = np.asarray(self.indptr._data)
         cols = self.indices._data.astype(np.int32)
-        nnz = cols.shape[0]
         # expand indptr to per-nnz row ids on host (indptr is host-small)
-        rows = np.repeat(np.arange(self._shape[0]), np.diff(indptr))
+        rows = _csr_rows(self)
         out = jnp.zeros(self._shape, self.data.dtype)
         out = out.at[jnp.asarray(rows), cols].set(self.data._data)
         return NDArray(out)
@@ -167,8 +204,7 @@ class CSRNDArray(BaseSparseNDArray):
         import jax.numpy as jnp
         from jax.experimental import sparse as jsparse
 
-        indptr = np.asarray(self.indptr._data)
-        rows = np.repeat(np.arange(self._shape[0]), np.diff(indptr))
+        rows = _csr_rows(self)
         idx = jnp.stack([jnp.asarray(rows, jnp.int32),
                          self.indices._data.astype(jnp.int32)], axis=1)
         self._bcoo_cache = jsparse.BCOO((self.data._data, idx),
@@ -180,7 +216,7 @@ class CSRNDArray(BaseSparseNDArray):
             return self.todense()
         if stype == "csr":
             return self
-        raise MXNetError(f"cannot convert csr to {stype}")
+        return cast_storage(self, stype)
 
     def __repr__(self):
         return (f"\n<CSRNDArray {'x'.join(map(str, self._shape))} "
@@ -208,33 +244,79 @@ def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
     return cast_storage(dense, "csr")
 
 
+def _csr_rows(csr):
+    """Per-nnz row ids (host int64) expanded from indptr."""
+    indptr = np.asarray(csr.indptr._data)
+    return np.repeat(np.arange(csr.shape[0], dtype=np.int64),
+                     np.diff(indptr))
+
+
+def _rows_to_indptr(rows, n_rows):
+    """Sorted per-nnz row ids -> CSR indptr (host)."""
+    return np.concatenate([[0], np.cumsum(
+        np.bincount(rows, minlength=n_rows)).astype(np.int64)])
+
+
 def cast_storage(data, stype):
-    """Reference ``cast_storage`` (cast_storage-inl.h:?)."""
+    """Reference ``cast_storage`` (cast_storage-inl.h:?): convert between
+    default/row_sparse/csr.  The nonzero PATTERN is fetched to host (a
+    data-dependent-shape step XLA cannot express); the values are
+    gathered on device."""
+    import jax.numpy as jnp
+
     if stype == "default":
         if isinstance(data, BaseSparseNDArray):
             return data.todense()
         return data
-    dense = data.asnumpy() if not isinstance(data, np.ndarray) else data
+    if isinstance(data, BaseSparseNDArray):
+        if data.stype == stype:
+            return data
+        if isinstance(data, RowSparseNDArray) and stype == "csr":
+            if len(data.shape) != 2:
+                raise MXNetError("csr requires 2D")
+            # rsp -> csr: each stored row contributes its nonzero cols
+            vals = np.asarray(data.data._data)
+            mask = vals != 0
+            r_in, cols = np.nonzero(mask)
+            rows = np.asarray(data.indices._data)[r_in]
+            order = np.argsort(rows, kind="stable")
+            flat = data.data._data.reshape(-1)
+            take = jnp.asarray((r_in * data.shape[1] + cols)[order])
+            return CSRNDArray(
+                NDArray(jnp.take(flat, take)),
+                NDArray(cols[order].astype(np.int64)),
+                NDArray(_rows_to_indptr(rows[order], data.shape[0])),
+                data.shape)
+        if isinstance(data, CSRNDArray) and stype == "row_sparse":
+            rows = _csr_rows(data)
+            nz_rows = np.unique(rows)
+            pos = np.searchsorted(nz_rows, rows)
+            cols = np.asarray(data.indices._data)
+            out = jnp.zeros((len(nz_rows), data.shape[1]),
+                            data.data._data.dtype)
+            out = out.at[jnp.asarray(pos), jnp.asarray(cols)].set(
+                data.data._data)
+            return RowSparseNDArray(NDArray(out), NDArray(nz_rows),
+                                    data.shape)
+        raise MXNetError(f"cannot cast {data.stype} to {stype}")
+    raw = data._data if isinstance(data, NDArray) else jnp.asarray(data)
     if stype == "row_sparse":
-        nz_rows = np.where(np.any(dense != 0,
-                                  axis=tuple(range(1, dense.ndim))))[0]
-        return RowSparseNDArray(NDArray(dense[nz_rows]),
-                                NDArray(nz_rows.astype(np.int64)),
-                                dense.shape)
+        mask = np.asarray(jnp.any(
+            raw != 0, axis=tuple(range(1, raw.ndim))))  # small bool fetch
+        nz_rows = np.where(mask)[0]
+        return RowSparseNDArray(
+            NDArray(jnp.take(raw, jnp.asarray(nz_rows), axis=0)),
+            NDArray(nz_rows.astype(np.int64)), raw.shape)
     if stype == "csr":
-        if dense.ndim != 2:
+        if raw.ndim != 2:
             raise MXNetError("csr requires 2D")
-        indptr = [0]
-        indices, vals = [], []
-        for r in range(dense.shape[0]):
-            cols = np.nonzero(dense[r])[0]
-            indices.extend(cols.tolist())
-            vals.extend(dense[r][cols].tolist())
-            indptr.append(len(indices))
+        mask = np.asarray(raw != 0)
+        rows, cols = np.nonzero(mask)  # row-major order, rows sorted
+        flat_idx = jnp.asarray(rows * raw.shape[1] + cols)
         return CSRNDArray(
-            NDArray(np.asarray(vals, dtype=dense.dtype)),
-            NDArray(np.asarray(indices, dtype=np.int64)),
-            NDArray(np.asarray(indptr, dtype=np.int64)), dense.shape)
+            NDArray(jnp.take(raw.reshape(-1), flat_idx)),
+            NDArray(cols.astype(np.int64)),
+            NDArray(_rows_to_indptr(rows, raw.shape[0])), raw.shape)
     raise MXNetError(f"unknown stype {stype}")
 
 
@@ -250,6 +332,223 @@ def zeros(stype, shape, ctx=None, dtype=None):
     from . import zeros as dense_zeros
 
     return dense_zeros(shape, ctx=ctx, dtype=dtype)
+
+
+# --- FComputeEx-style elemwise algebra --------------------------------------
+# Reference: sparse FComputeEx kernels + storage-type fallback in
+# src/operator/tensor/elemwise_binary_op_basic.cc:? and
+# elemwise_unary_op_basic.cc:?.  Dispatch keys on (op, lhs stype, rhs
+# stype); anything without a sparse kernel densifies, exactly like the
+# reference's FallBackCompute.
+
+#: unary ops with f(0) == 0: applying f to the stored values alone is
+#: exact, so structure and indices are preserved.
+_ZERO_PRESERVING = frozenset({
+    "abs", "sign", "ceil", "floor", "rint", "round", "trunc", "fix",
+    "sqrt", "cbrt", "square", "negative", "relu", "softsign", "sin",
+    "tan", "arcsin", "arctan", "sinh", "tanh", "arcsinh", "arctanh",
+    "expm1", "log1p", "erf", "erfinv", "degrees", "radians", "identity",
+})
+
+
+def _with_values(sp, new_vals):
+    """Same structure, new stored values."""
+    if isinstance(sp, RowSparseNDArray):
+        return RowSparseNDArray(NDArray(new_vals), sp.indices, sp.shape)
+    return CSRNDArray(NDArray(new_vals), sp.indices, sp.indptr, sp.shape)
+
+
+def dispatch_unary(name, jf, data):
+    """Sparse unary: zero-preserving ops map over stored values; others
+    have dense output by definition → densify (storage fallback)."""
+    if name in _ZERO_PRESERVING:
+        return _with_values(data, jf(data.data._data))
+    return NDArray(jf(data.todense()._data))
+
+
+def _rsp_union(jf, a, b):
+    """rsp ± rsp -> rsp over the UNION of stored rows (jf(x,0)=x-shaped
+    ops: add/sub)."""
+    import jax.numpy as jnp
+
+    ia = np.asarray(a.indices._data)
+    ib = np.asarray(b.indices._data)
+    union = np.union1d(ia, ib)
+    pa = np.searchsorted(union, ia)
+    pb = np.searchsorted(union, ib)
+    width = a.shape[1:]
+    dt = np.promote_types(a.dtype, b.dtype)
+    va = jnp.zeros((len(union),) + width, dt).at[jnp.asarray(pa)].set(
+        a.data._data.astype(dt))
+    vb = jnp.zeros((len(union),) + width, dt).at[jnp.asarray(pb)].set(
+        b.data._data.astype(dt))
+    return RowSparseNDArray(NDArray(jf(va, vb)), NDArray(union), a.shape)
+
+
+def _rsp_intersection(jf, a, b):
+    """rsp × rsp -> rsp over the INTERSECTION of stored rows (both-zero
+    annihilating ops: multiply)."""
+    import jax.numpy as jnp
+
+    ia = np.asarray(a.indices._data)
+    ib = np.asarray(b.indices._data)
+    common, ca, cb = np.intersect1d(ia, ib, return_indices=True)
+    va = jnp.take(a.data._data, jnp.asarray(ca), axis=0)
+    vb = jnp.take(b.data._data, jnp.asarray(cb), axis=0)
+    return RowSparseNDArray(NDArray(jf(va, vb)), NDArray(common), a.shape)
+
+
+def _csr_coo_keys(csr):
+    """Host flat coordinate keys (row-major) of the stored entries.
+    Cached on the array (CSR batches are treated as immutable, same
+    contract as ``to_bcoo``): the expansion costs a blocking
+    device→host read that hot elemwise loops would otherwise pay per
+    op per operand."""
+    cached = getattr(csr, "_coo_keys_cache", None)
+    if cached is not None:
+        return cached
+    rows = _csr_rows(csr)
+    cols = np.asarray(csr.indices._data)
+    csr._coo_keys_cache = rows * csr.shape[1] + cols
+    return csr._coo_keys_cache
+
+
+def _csr_from_keys(keys, vals, shape):
+    rows = (keys // shape[1]).astype(np.int64)
+    cols = (keys % shape[1]).astype(np.int64)
+    return CSRNDArray(NDArray(vals), NDArray(cols),
+                      NDArray(_rows_to_indptr(rows, shape[0])), shape)
+
+
+def _csr_union(jf, a, b):
+    import jax.numpy as jnp
+
+    ka, kb = _csr_coo_keys(a), _csr_coo_keys(b)
+    union = np.union1d(ka, kb)
+    pa = np.searchsorted(union, ka)
+    pb = np.searchsorted(union, kb)
+    dt = np.promote_types(a.dtype, b.dtype)
+    va = jnp.zeros((len(union),), dt).at[jnp.asarray(pa)].set(
+        a.data._data.astype(dt))
+    vb = jnp.zeros((len(union),), dt).at[jnp.asarray(pb)].set(
+        b.data._data.astype(dt))
+    return _csr_from_keys(union, jf(va, vb), a.shape)
+
+
+def _csr_intersection(jf, a, b):
+    import jax.numpy as jnp
+
+    ka, kb = _csr_coo_keys(a), _csr_coo_keys(b)
+    common, ca, cb = np.intersect1d(ka, kb, return_indices=True)
+    va = jnp.take(a.data._data, jnp.asarray(ca))
+    vb = jnp.take(b.data._data, jnp.asarray(cb))
+    return _csr_from_keys(common, jf(va, vb), a.shape)
+
+
+def _gather_dense_at(sp, dense_raw):
+    """Values of ``dense_raw`` at the sparse array's stored coordinates."""
+    import jax.numpy as jnp
+
+    if isinstance(sp, RowSparseNDArray):
+        return jnp.take(dense_raw, jnp.asarray(
+            np.asarray(sp.indices._data)), axis=0)
+    keys = _csr_coo_keys(sp)
+    return jnp.take(dense_raw.reshape(-1), jnp.asarray(keys))
+
+
+def dispatch_binary(name, jf, lhs, rhs):
+    """FComputeEx dispatch for the elemwise binary family.
+
+    Sparse kernels (everything else falls back to densify):
+      rsp ± rsp -> rsp (union)        csr ± csr -> csr (union)
+      rsp × rsp -> rsp (intersect)    csr × csr -> csr (intersect)
+      sparse × dense -> sparse        sparse ÷ dense -> sparse
+      (stored-entry kernels; same shape only)
+      sparse × scalar, sparse ÷ scalar, sparse ± 0 -> sparse
+    Division against dense/scalar is defined on the STORED entries (the
+    implicit zeros stay zero), matching the reference's sparse division
+    semantics rather than IEEE 0/0."""
+    l_sp = isinstance(lhs, BaseSparseNDArray)
+    r_sp = isinstance(rhs, BaseSparseNDArray)
+    if l_sp and r_sp:
+        if lhs.shape != rhs.shape or lhs.stype != rhs.stype:
+            return _fallback_binary(jf, lhs, rhs)
+        if name in ("add", "subtract"):
+            merge = _rsp_union if lhs.stype == "row_sparse" else _csr_union
+            return merge(jf, lhs, rhs)
+        if name == "multiply":
+            merge = (_rsp_intersection if lhs.stype == "row_sparse"
+                     else _csr_intersection)
+            return merge(jf, lhs, rhs)
+        return _fallback_binary(jf, lhs, rhs)
+    if l_sp and isinstance(rhs, NDArray):
+        if name in ("multiply", "divide") and rhs.shape == lhs.shape:
+            vals = jf(lhs.data._data, _gather_dense_at(lhs, rhs._data))
+            return _with_values(lhs, vals)
+        return _fallback_binary(jf, lhs, rhs)
+    if r_sp and isinstance(lhs, NDArray):
+        if name == "multiply" and lhs.shape == rhs.shape:
+            vals = jf(_gather_dense_at(rhs, lhs._data), rhs.data._data)
+            return _with_values(rhs, vals)
+        return _fallback_binary(jf, lhs, rhs)
+    # sparse vs python scalar
+    if l_sp and np.isscalar(rhs):
+        if name in ("multiply", "divide") or \
+                (name in ("add", "subtract") and rhs == 0):
+            return _with_values(lhs, jf(lhs.data._data, rhs))
+        return _fallback_binary(jf, lhs, rhs)
+    if r_sp and np.isscalar(lhs):
+        if name == "multiply" or (name == "add" and lhs == 0):
+            return _with_values(rhs, jf(lhs, rhs.data._data))
+        return _fallback_binary(jf, lhs, rhs)
+    return _fallback_binary(jf, lhs, rhs)
+
+
+def _fallback_binary(jf, lhs, rhs):
+    """Storage fallback: densify sparse operands, dense output.  Routes
+    through apply_op so a DENSE operand inside autograd.record() keeps
+    its tape node (the densified sparse operand is a constant, like the
+    reference's sparse fallback)."""
+    from ..ops.registry import apply_op
+
+    l = lhs.todense() if isinstance(lhs, BaseSparseNDArray) else lhs
+    r = rhs.todense() if isinstance(rhs, BaseSparseNDArray) else rhs
+    if isinstance(l, NDArray) and isinstance(r, NDArray):
+        return apply_op(jf, l, r, name="sparse_fallback")
+    if isinstance(l, NDArray):
+        c = r
+        return apply_op(lambda a: jf(a, c), l, name="sparse_fallback")
+    c = l
+    return apply_op(lambda b: jf(c, b), r, name="sparse_fallback")
+
+
+def _ew(name):
+    """The stype-dispatching nd-level elemwise op (lazy import: the ops
+    module imports this one)."""
+    from ..ops import elemwise as _e
+
+    return getattr(_e, name)
+
+
+def add(lhs, rhs):
+    return _ew("add")(lhs, rhs)
+
+
+def subtract(lhs, rhs):
+    return _ew("subtract")(lhs, rhs)
+
+
+def multiply(lhs, rhs):
+    return _ew("multiply")(lhs, rhs)
+
+
+def divide(lhs, rhs):
+    return _ew("divide")(lhs, rhs)
+
+
+def retain(data, indices):
+    """Module-level ``mx.nd.sparse.retain`` (reference parity)."""
+    return data.retain(indices)
 
 
 def dot(lhs, rhs, transpose_a=False, transpose_b=False):

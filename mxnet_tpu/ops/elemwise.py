@@ -32,6 +32,18 @@ def _export(name, fn, aliases=()):
 
 def _make_unary(name, jf, aliases=()):
     def fn(data, out=None, **kwargs):
+        from ..ndarray import sparse as _sp
+
+        if isinstance(data, _sp.BaseSparseNDArray):
+            # FComputeEx stype dispatch (reference
+            # elemwise_unary_op_basic.cc:?): zero-preserving ops keep
+            # the sparse structure, the rest densify
+            if out is not None:
+                from ..base import MXNetError
+
+                raise MXNetError(
+                    f"{name}: out= is not supported with sparse operands")
+            return _sp.dispatch_unary(name, jf, data)
         return commit_out(out, apply_op(jf, data, name=name))
 
     _export(name, fn, aliases)
@@ -40,7 +52,18 @@ def _make_unary(name, jf, aliases=()):
 def _make_binary(name, jf, aliases=()):
     def fn(lhs, rhs, out=None, **kwargs):
         from ..ndarray import NDArray
+        from ..ndarray import sparse as _sp
 
+        if isinstance(lhs, _sp.BaseSparseNDArray) or \
+                isinstance(rhs, _sp.BaseSparseNDArray):
+            # FComputeEx stype dispatch (reference
+            # elemwise_binary_op_basic.cc:?)
+            if out is not None:
+                from ..base import MXNetError
+
+                raise MXNetError(
+                    f"{name}: out= is not supported with sparse operands")
+            return _sp.dispatch_binary(name, jf, lhs, rhs)
         if isinstance(lhs, NDArray) and isinstance(rhs, NDArray):
             r = apply_op(jf, lhs, rhs, name=name)
         elif isinstance(lhs, NDArray):

@@ -667,7 +667,16 @@ _export(sequence_reverse, aliases=("SequenceReverse",))
 
 def dot(lhs, rhs, transpose_a=False, transpose_b=False, **kwargs):
     """Reference ``dot`` (src/operator/tensor/dot.cc:?): contracts the last
-    axis of lhs with the first axis of rhs (after optional transposes)."""
+    axis of lhs with the first axis of rhs (after optional transposes).
+    Sparse operands dispatch to the FComputeEx analog
+    (ndarray/sparse.py dot: csr rides XLA's BCOO path)."""
+    from ..ndarray import sparse as _sparse
+
+    if isinstance(lhs, _sparse.BaseSparseNDArray) or \
+            isinstance(rhs, _sparse.BaseSparseNDArray):
+        return _sparse.dot(lhs, rhs, transpose_a=transpose_a,
+                           transpose_b=transpose_b)
+
     def f(a, b):
         if transpose_a:
             a = jnp.transpose(a)

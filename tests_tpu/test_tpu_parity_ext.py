@@ -144,6 +144,50 @@ case("sparse", "rs_dot_dense",
      lambda a, b: nd.sparse.dot(a.tostype("row_sparse"), b), DENSE,
      VEC, mxu=True, rtol=MXU_RTOL)
 
+# elemwise algebra (VERDICT r4 #7): sparse kernels must agree with the
+# chip across the union/intersection merges, stored-entry dense/scalar
+# kernels, structure-preserving unary, and the rsp<->csr casts.
+DENSE2 = np.round(R.randn(5, 6), 2).astype(np.float32)
+DENSE2[DENSE2 < 0.2] = 0.0
+DENSE_FULL = (np.round(R.randn(5, 6), 2) + 3.0).astype(np.float32)
+
+case("sparse", "rs_add_rs",
+     lambda a, b: (a.tostype("row_sparse") +
+                   b.tostype("row_sparse")).tostype("default"),
+     DENSE, DENSE2)
+case("sparse", "rs_mul_rs",
+     lambda a, b: (a.tostype("row_sparse") *
+                   b.tostype("row_sparse")).tostype("default"),
+     DENSE, DENSE2)
+case("sparse", "rs_mul_dense",
+     lambda a, b: (a.tostype("row_sparse") * b).tostype("default"),
+     DENSE, DENSE_FULL)
+case("sparse", "rs_div_dense",
+     lambda a, b: (a.tostype("row_sparse") / b).tostype("default"),
+     DENSE, DENSE_FULL)
+case("sparse", "csr_add_csr",
+     lambda a, b: (a.tostype("csr") + b.tostype("csr")).tostype(
+         "default"), DENSE, DENSE2)
+case("sparse", "csr_mul_csr",
+     lambda a, b: (a.tostype("csr") * b.tostype("csr")).tostype(
+         "default"), DENSE, DENSE2)
+case("sparse", "csr_mul_dense",
+     lambda a, b: (a.tostype("csr") * b).tostype("default"),
+     DENSE, DENSE_FULL)
+case("sparse", "csr_scalar_mul",
+     lambda a: (a.tostype("csr") * 2.5).tostype("default"), DENSE)
+case("sparse", "rs_unary_square",
+     lambda a: nd.square(a.tostype("row_sparse")).tostype("default"),
+     DENSE)
+case("sparse", "csr_unary_tanh",
+     lambda a: nd.tanh(a.tostype("csr")).tostype("default"), DENSE)
+case("sparse", "rs_to_csr_cast",
+     lambda a: a.tostype("row_sparse").tostype("csr").tostype(
+         "default"), DENSE)
+case("sparse", "csr_to_rs_cast",
+     lambda a: a.tostype("csr").tostype("row_sparse").tostype(
+         "default"), DENSE)
+
 
 # --- int8 quantization ops ---------------------------------------------------
 # Integer arithmetic is exact on both backends; only the f32 range/scale
