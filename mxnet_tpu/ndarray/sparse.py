@@ -274,9 +274,10 @@ def cast_storage(data, stype):
         if isinstance(data, RowSparseNDArray) and stype == "csr":
             if len(data.shape) != 2:
                 raise MXNetError("csr requires 2D")
-            # rsp -> csr: each stored row contributes its nonzero cols
-            vals = np.asarray(data.data._data)
-            mask = vals != 0
+            # rsp -> csr: each stored row contributes its nonzero cols.
+            # Mask computed on DEVICE, only the bool pattern crosses to
+            # host; values are gathered on device below.
+            mask = np.asarray(data.data._data != 0)
             r_in, cols = np.nonzero(mask)
             rows = np.asarray(data.indices._data)[r_in]
             order = np.argsort(rows, kind="stable")
@@ -445,6 +446,19 @@ def _csr_intersection(jf, a, b):
     return _csr_from_keys(common, jf(va, vb), a.shape)
 
 
+def _dense_on_tape(x):
+    """True when ``x`` is a dense operand inside an active
+    autograd.record() scope: the stored-entry kernels would silently
+    sever its tape (sparse outputs carry no tape node), so dispatch
+    must take the fallback — dense output through apply_op — to keep
+    gradients correct.  Mirrors apply_op's recording check
+    (ops/registry.py)."""
+    from .. import autograd as ag
+    from ..ops.registry import _in_graph
+
+    return ag.is_recording() and _in_graph(x)
+
+
 def _gather_dense_at(sp, dense_raw):
     """Values of ``dense_raw`` at the sparse array's stored coordinates."""
     import jax.numpy as jnp
@@ -482,12 +496,14 @@ def dispatch_binary(name, jf, lhs, rhs):
             return merge(jf, lhs, rhs)
         return _fallback_binary(jf, lhs, rhs)
     if l_sp and isinstance(rhs, NDArray):
-        if name in ("multiply", "divide") and rhs.shape == lhs.shape:
+        if name in ("multiply", "divide") and rhs.shape == lhs.shape \
+                and not _dense_on_tape(rhs):
             vals = jf(lhs.data._data, _gather_dense_at(lhs, rhs._data))
             return _with_values(lhs, vals)
         return _fallback_binary(jf, lhs, rhs)
     if r_sp and isinstance(lhs, NDArray):
-        if name == "multiply" and lhs.shape == rhs.shape:
+        if name == "multiply" and lhs.shape == rhs.shape \
+                and not _dense_on_tape(lhs):
             vals = jf(_gather_dense_at(rhs, lhs._data), rhs.data._data)
             return _with_values(rhs, vals)
         return _fallback_binary(jf, lhs, rhs)

@@ -35,7 +35,7 @@ _SLOW_MODULES = {
     "test_module", "test_moe", "test_ring", "test_parallel",
     "test_onnx", "test_dist_loopback", "test_nightly_large",
     "test_model", "test_rnn", "test_contrib_gluon", "test_fm",
-    "test_contrib",
+    "test_contrib", "test_fault_injection",
 }
 
 

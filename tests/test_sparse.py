@@ -225,3 +225,23 @@ def test_cast_storage_3d_rsp_to_csr_raises():
                              onp.array([0, 2])), shape=(4, 2, 2))
     with pytest.raises(mx.MXNetError):
         r.tostype("csr")
+
+
+def test_stored_entry_kernel_defers_to_tape_when_recording():
+    """Inside autograd.record(), a dense operand's gradient must flow
+    even for multiply/divide (the stored-entry kernels would sever the
+    tape, so dispatch takes the dense fallback while recording)."""
+    from mxnet_tpu import autograd
+
+    x = nd.array(D)
+    x.attach_grad()
+    s = _csr(A)
+    with autograd.record():
+        z = x * s                       # recording: falls back to dense
+        loss = (z * z).sum() + (x * x).sum()
+    loss.backward()
+    want = 2.0 * (D * A) * A + 2.0 * D
+    onp.testing.assert_allclose(x.grad.asnumpy(), want, rtol=1e-5)
+    # outside record(): the sparse kernel engages again
+    out = x * s
+    assert out.stype == "csr"

@@ -40,24 +40,94 @@ import subprocess
 import sys
 
 
-def launch_local(n, cmd, coordinator="127.0.0.1:12721"):
-    ps_secret = os.environ.get("MXT_PS_SECRET") or secrets.token_hex(16)
+def _spawn_group(n, cmd, coordinator, ps_secret, attempt):
     procs = []
-    for rank in range(n):
-        env = dict(os.environ)
-        env.update({
-            "MXT_COORDINATOR": coordinator,
-            "MXT_NUM_PROCESSES": str(n),
-            "MXT_PROCESS_ID": str(rank),
-            "MXT_PS_SECRET": ps_secret,
-            # loopback test topology runs every process on CPU
-            "JAX_PLATFORMS": env.get("MXT_LAUNCH_PLATFORM", "cpu"),
-        })
-        procs.append(subprocess.Popen(cmd, env=env))
-    rc = 0
+    try:
+        for rank in range(n):
+            env = dict(os.environ)
+            env.update({
+                "MXT_COORDINATOR": coordinator,
+                "MXT_NUM_PROCESSES": str(n),
+                "MXT_PROCESS_ID": str(rank),
+                "MXT_PS_SECRET": ps_secret,
+                "MXT_LAUNCH_ATTEMPT": str(attempt),
+                # loopback test topology runs every process on CPU
+                "JAX_PLATFORMS": env.get("MXT_LAUNCH_PLATFORM", "cpu"),
+            })
+            procs.append(subprocess.Popen(cmd, env=env))
+    except OSError:
+        # partial group (EMFILE/EAGAIN mid-spawn): reap what spawned or
+        # the orphans wait at the coordinator forever
+        _reap(procs)
+        raise
+    return procs
+
+
+def _reap(procs, grace=10.0):
+    """SIGTERM the group, then SIGKILL stragglers after ``grace``.
+    A rank blocked inside a collective may never run its SIGTERM
+    handler — the hard kill is not optional."""
+    import time
+
     for p in procs:
-        rc = p.wait() or rc
-    return rc
+        if p.poll() is None:
+            try:
+                p.terminate()
+            except OSError:
+                pass
+    deadline = time.time() + grace
+    for p in procs:
+        while p.poll() is None and time.time() < deadline:
+            time.sleep(0.1)
+        if p.poll() is None:
+            try:
+                p.kill()
+                p.wait()
+            except OSError:
+                pass
+
+
+def _wait_group(procs, poll_s=0.2):
+    """Wait for all ranks; on the FIRST nonzero exit, reap the rest and
+    return that rc.  Failure detection is what the reference's tracker
+    gave for free (a dead dmlc worker tears down the job): without it, a
+    surviving rank blocks forever inside its next collective waiting for
+    the dead peer, and the job wedges instead of failing."""
+    import time
+
+    while True:
+        live = 0
+        for p in procs:
+            rc = p.poll()
+            if rc is None:
+                live += 1
+            elif rc != 0:
+                _reap(procs)
+                return rc
+        if live == 0:
+            return 0
+        time.sleep(poll_s)
+
+
+def launch_local(n, cmd, coordinator="127.0.0.1:12721", max_restarts=0):
+    """Fork n local ranks and babysit them.
+
+    On any rank's nonzero exit the whole group is reaped (failure
+    detection).  ``max_restarts`` > 0 then relaunches the full group —
+    ranks are expected to resume from their latest checkpoint
+    (mxnet_tpu.checkpoint.resume), which
+    tests/test_fault_injection.py proves reconverges to the
+    uninterrupted run."""
+    ps_secret = os.environ.get("MXT_PS_SECRET") or secrets.token_hex(16)
+    attempt = 0
+    while True:
+        procs = _spawn_group(n, cmd, coordinator, ps_secret, attempt)
+        rc = _wait_group(procs)
+        if rc == 0 or attempt >= max_restarts:
+            return rc
+        attempt += 1
+        print(f"launch.py: group failed (rc={rc}); "
+              f"restart {attempt}/{max_restarts}", file=sys.stderr)
 
 
 def emit_ssh(hosts, n, cmd, coordinator):
@@ -119,6 +189,10 @@ def main(argv=None):
     p.add_argument("--dry-run", action="store_true",
                    help="ssh launcher: print the per-host commands "
                         "instead of spawning")
+    p.add_argument("--max-restarts", type=int, default=0,
+                   help="local launcher: relaunch the whole group up to "
+                        "this many times after a rank failure (ranks "
+                        "resume from their latest checkpoint)")
     p.add_argument("command", nargs=argparse.REMAINDER)
     args = p.parse_args(argv)
     if not args.command:
@@ -127,7 +201,10 @@ def main(argv=None):
         if args.dry_run:
             p.error("--dry-run only applies to --launcher ssh")
         sys.exit(launch_local(args.num_workers, args.command,
-                              args.coordinator))
+                              args.coordinator,
+                              max_restarts=args.max_restarts))
+    if args.max_restarts:
+        p.error("--max-restarts only applies to --launcher local")
     hosts = ["localhost"]
     if args.hostfile:
         with open(args.hostfile) as f:
