@@ -496,10 +496,27 @@ def dispatch_binary(name, jf, lhs, rhs):
             return merge(jf, lhs, rhs)
         return _fallback_binary(jf, lhs, rhs)
     if l_sp and isinstance(rhs, NDArray):
-        if name in ("multiply", "divide") and rhs.shape == lhs.shape \
-                and not _dense_on_tape(rhs):
-            vals = jf(lhs.data._data, _gather_dense_at(lhs, rhs._data))
-            return _with_values(lhs, vals)
+        if name in ("multiply", "divide") and rhs.shape == lhs.shape:
+            if not _dense_on_tape(rhs):
+                vals = jf(lhs.data._data,
+                          _gather_dense_at(lhs, rhs._data))
+                return _with_values(lhs, vals)
+            if name == "divide":
+                # tape path must MATCH the stored-entry semantics
+                # (implicit zeros stay zero — a plain dense 0/0 would
+                # produce NaN at unstored coords and poison the loss;
+                # explicit stored zeros behave as unstored here)
+                import jax.numpy as jnp
+
+                def mjf(s, d):
+                    # double-where: a bare where(mask, s/d, 0) still
+                    # evaluates s/d at 0/0 coords and its vjp turns
+                    # 0*NaN into NaN gradients — sanitize d first
+                    mask = s != 0
+                    safe_d = jnp.where(mask, d, jnp.ones((), d.dtype))
+                    return jnp.where(mask, jf(s, safe_d),
+                                     jnp.zeros((), jnp.result_type(s, d)))
+                return _fallback_binary(mjf, lhs, rhs)
         return _fallback_binary(jf, lhs, rhs)
     if r_sp and isinstance(lhs, NDArray):
         if name == "multiply" and lhs.shape == rhs.shape \

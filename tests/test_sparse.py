@@ -245,3 +245,33 @@ def test_stored_entry_kernel_defers_to_tape_when_recording():
     # outside record(): the sparse kernel engages again
     out = x * s
     assert out.stype == "csr"
+
+
+def test_divide_semantics_match_inside_record():
+    """sparse/dense divide must produce the SAME values inside and
+    outside autograd.record(): implicit zeros stay zero on both paths
+    (the tape fallback masks, never 0/0-NaNs), and the dense operand's
+    gradient flows."""
+    from mxnet_tpu import autograd
+
+    dz = D.copy()
+    dz[0, 0] = 0.0  # a zero denominator at an UNSTORED coordinate
+    Az = A.copy()
+    Az[0, 0] = 0.0
+    s = _csr(Az)
+    outside = (s / nd.array(dz)).asnumpy()
+    x = nd.array(dz)
+    x.attach_grad()
+    with autograd.record():
+        z = s / x
+        loss = z.sum()
+    loss.backward()
+    inside = z.asnumpy()
+    assert onp.isfinite(inside).all(), "NaN leaked on the tape path"
+    onp.testing.assert_allclose(inside, outside, rtol=1e-6)
+    g = x.grad.asnumpy()
+    assert onp.isfinite(g).all()
+    assert g[0, 0] == 0.0  # unstored coord contributes no gradient
+    mask = Az != 0
+    onp.testing.assert_allclose(g[mask], -Az[mask] / dz[mask] ** 2,
+                                rtol=1e-5)
