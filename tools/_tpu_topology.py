@@ -1,0 +1,72 @@
+"""Shared offline-TPU-topology compile helpers.
+
+One copy of the hazard-prone setup used by scale_proof.py,
+int8_topology_probe.py, and pallas_topology_check.py: building a mesh
+over an OFFLINE libtpu topology client and compiling with TPU
+provenance ASSERTED.  The hazard: avals without shardings over the
+topology's devices silently compile against the process's default CPU
+backend and the "TPU evidence" is CPU HLO (this bug shipped once —
+PERF_NOTES round 5).  Single-process: libtpu holds
+/tmp/libtpu_lockfile.
+"""
+import re
+
+def _host_bounds(topology_name):
+    """chips_per_host_bounds for a v5e ``AxB`` shape: the 2x4 host tray
+    where it divides, clamped down for sub-tray single-chip layouts
+    (the API rejects bounds that don't divide the topology)."""
+    shape = topology_name.split(":", 1)[1]
+    a, b = (int(d) for d in shape.split("x")[:2])
+    return (2 if a % 2 == 0 else 1, 4 if b % 4 == 0 else 1, 1)
+
+
+def topology_mesh(topology_name="v5e:1x1", mesh_shape=None):
+    """Mesh over an offline TPU topology.  ``mesh_shape``: dict like
+    {"dp": 4, "tp": 8} (device count must match the topology) or None
+    for a 1-axis mesh over all devices."""
+    import numpy as np
+    from jax.experimental import topologies
+    from jax.sharding import Mesh
+
+    topo = topologies.get_topology_desc(
+        platform="tpu", topology_name=topology_name,
+        chips_per_host_bounds=_host_bounds(topology_name), num_slices=1)
+    if mesh_shape is None:
+        return Mesh(np.array(topo.devices), ("x",))
+    dims = tuple(mesh_shape.values())
+    n = int(np.prod(dims))
+    assert n == len(topo.devices), (mesh_shape, len(topo.devices))
+    return Mesh(np.array(topo.devices).reshape(dims),
+                tuple(mesh_shape.keys()))
+
+
+def assert_tpu_hlo(hlo, what=""):
+    """TPU provenance: tiled layouts (``{...:T(8,128)...}``) exist only
+    in XLA:TPU HLO.  A compile that silently targeted the CPU backend
+    fails here instead of shipping CPU numbers as TPU evidence."""
+    assert ":T(" in hlo, \
+        f"{what}: no TPU tiling in optimized HLO — compiled for CPU?"
+
+
+def count_mosaic_calls(hlo):
+    """Mosaic kernels appear as custom-calls with the
+    ``tpu_custom_call`` target — a bare 'custom-call' substring count
+    would also match sharding/annotation custom-calls and every USE of
+    an instruction named %custom-call.N."""
+    return len(re.findall(r'custom_call_target="tpu_custom_call"', hlo))
+
+
+def compile_tpu_checked(fn, avals, mesh, what=""):
+    """jit-compile ``fn`` on replicated shardings over ``mesh``'s
+    topology devices; returns (compiled, hlo) with TPU provenance
+    asserted."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    repl = NamedSharding(mesh, P())
+    shaped = [jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=repl)
+              for a in avals]
+    comp = jax.jit(fn).lower(*shaped).compile()
+    hlo = comp.as_text()
+    assert_tpu_hlo(hlo, what)
+    return comp, hlo

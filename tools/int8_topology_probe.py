@@ -49,29 +49,23 @@ def main():
 
     jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
-    import numpy as np
     from jax import lax
-    from jax.experimental import topologies
-    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-    topo = topologies.get_topology_desc(
-        platform="tpu", topology_name="v5e:1x1",
-        chips_per_host_bounds=(1, 1, 1), num_slices=1)
-    mesh = Mesh(np.array(topo.devices), ("x",))
-    repl = NamedSharding(mesh, P())
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from _tpu_topology import compile_tpu_checked, topology_mesh
+
+    mesh = topology_mesh("v5e:1x1")
 
     out = {"topology": "v5e:1x1 (offline libtpu AOT client)",
            "cases": {}}
 
     def probe(name, fn, *avals):
-        avals = [jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=repl)
-                 for a in avals]
-        comp = jax.jit(fn).lower(*avals).compile()
-        hlo = comp.as_text()
-        # TPU provenance: tiled layouts only exist in XLA:TPU HLO
-        assert ":T(" in hlo, \
-            f"{name}: no TPU tiling in HLO — compiled for CPU?"
-        defs = dict(re.findall(r"%(\S+?)(?:\.\d+)? = (\w+)\[", hlo))
+        comp, hlo = compile_tpu_checked(fn, avals, mesh, what=name)
+        # keyed by FULL instruction name: stripping the .N suffix would
+        # collapse same-named defs of different dtypes (%fusion.1 s32
+        # vs %fusion.2 s8) and let the widening scan resolve a
+        # convert's operand to the wrong dtype
+        defs = dict(re.findall(r"%([\w.\-]+) = (\w+)\[", hlo))
         has_s32_contraction = bool(re.search(
             r"= s32\[[^\]]*\]\S* (?:dot|convolution)\(", hlo))
         # any convert that WIDENS an s8 value disqualifies nativeness
@@ -79,8 +73,7 @@ def main():
         for m in re.finditer(
                 r"= (\w+)\[[^\]]*\]\S* convert\(%([\w.\-]+)\)", hlo):
             to_t, op = m.group(1), m.group(2)
-            frm = defs.get(re.sub(r"\.\d+$", "", op))
-            if frm == "s8" and to_t != "s8":
+            if defs.get(op) == "s8" and to_t != "s8":
                 widening_convert = True
         cycles = [int(c) for c in
                   re.findall(r'"estimated_cycles":"(\d+)"', hlo)]

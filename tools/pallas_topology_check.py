@@ -1,0 +1,112 @@
+#!/usr/bin/env python
+"""AOT-compile every pallas kernel in the framework for a REAL v5e
+target via the offline libtpu topology client (no tunnel, no chips).
+
+Purpose: de-risk the on-chip lane.  A mosaic lowering error would
+otherwise only surface when real chip time is available (and burn it).
+Each kernel must compile to TPU HLO (asserted via the TPU-only tiled
+layouts) carrying a mosaic custom-call.  XLA's estimated_cycles for its
+own reference implementation of the same computation is recorded where
+available as the bar the kernel has to beat on chip (custom-calls carry
+no XLA cycle estimate — timing is the runbook's job).
+
+Kernels covered:
+- flash-attention forward (ops/flash_attention._fa_forward_pallas)
+- fused matmul+affine+ReLU conv probe
+  (tools/pallas_conv_probe.fused_matmul_affine_relu)
+
+Writes one JSON blob to stdout (and argv[1] if given).  Single-process
+(libtpu lockfile).
+"""
+import json
+import re
+import sys
+
+
+def main():
+    import os
+
+    os.environ.pop("JAX_PLATFORMS", None)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), ".."))
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from _tpu_topology import (compile_tpu_checked, count_mosaic_calls,
+                               topology_mesh)
+
+    mesh = topology_mesh("v5e:1x1")
+
+    out = {"topology": "v5e:1x1 (offline libtpu AOT client)",
+           "kernels": {}}
+
+    def record(name, fn, avals, ref_fn=None):
+        try:
+            _comp, hlo = compile_tpu_checked(fn, avals, mesh, what=name)
+            mosaic = count_mosaic_calls(hlo)
+            # compiling without a mosaic kernel means the pallas path
+            # silently degraded — that's a failure for a DE-RISK tool
+            rec = {
+                "tpu_compile_ok": mosaic > 0,
+                "mosaic_custom_calls": mosaic,
+            }
+            if mosaic == 0:
+                rec["error"] = "compiled but no tpu_custom_call in HLO"
+        except Exception as e:
+            rec = {"tpu_compile_ok": False,
+                   "error": f"{type(e).__name__}: {e}"[:400]}
+        if ref_fn is not None:
+            try:
+                _rc, rhlo = compile_tpu_checked(ref_fn, avals, mesh,
+                                                what=name + "_ref")
+                cyc = [int(c) for c in re.findall(
+                    r'"estimated_cycles":"(\d+)"', rhlo)]
+                rec["xla_reference_estimated_cycles_sum"] = sum(cyc)
+            except Exception as e:
+                rec["xla_reference_error"] = str(e)[:200]
+        out["kernels"][name] = rec
+
+    # flash attention forward, llama-8B head geometry at T=2048
+    from mxnet_tpu.ops import flash_attention as fa
+
+    B, H, T, D = 1, 8, 2048, 128
+    qkv = [jax.ShapeDtypeStruct((B, H, T, D), jnp.bfloat16)] * 3
+    scale = 1 / float(np.sqrt(D))
+    record("flash_attention_fwd_bf16_T2048",
+           lambda q, k, v: fa._fa_forward_pallas(q, k, v, True, scale),
+           qkv,
+           ref_fn=lambda q, k, v: fa._sdpa_ref(q, k, v, True, scale))
+
+    # fused 1x1conv(matmul)+BN-affine+ReLU probe kernel
+    from pallas_conv_probe import fused_matmul_affine_relu
+
+    M, K, N = 4096, 256, 512  # 64x64 spatial x 256ch -> 512ch 1x1 conv
+    avals = [jax.ShapeDtypeStruct((M, K), jnp.bfloat16),
+             jax.ShapeDtypeStruct((K, N), jnp.bfloat16),
+             jax.ShapeDtypeStruct((N,), jnp.float32),
+             jax.ShapeDtypeStruct((N,), jnp.float32)]
+
+    def xla_ref(x, w, s, b):
+        y = jax.lax.dot_general(
+            x, w, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return jnp.maximum(y * s + b, 0.0).astype(x.dtype)
+
+    record("fused_matmul_affine_relu_bf16",
+           fused_matmul_affine_relu, avals, ref_fn=xla_ref)
+
+    blob = json.dumps(out, indent=1)
+    print(blob)
+    if len(sys.argv) > 1:
+        with open(sys.argv[1], "w") as f:
+            f.write(blob + "\n")
+    if not all(k["tpu_compile_ok"] for k in out["kernels"].values()):
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -40,9 +40,6 @@ WORKLOADS = {
                     tpu_topology="v5e:8x8"),
 }
 
-#: v5e host tray: 2x4 chips (the topology API wants 3 ints)
-_V5E_HOST_BOUNDS = (2, 4, 1)
-
 _DUMP_DIR = "/tmp/scale_proof_dump"
 
 #: SP_BACKEND=tpu compiles against an OFFLINE libtpu topology client
@@ -256,16 +253,9 @@ def main():
     cfg = net._cfg
 
     if _BACKEND == "tpu":
-        from jax.experimental import topologies
-        from jax.sharding import Mesh
+        from _tpu_topology import topology_mesh
 
-        topo = topologies.get_topology_desc(
-            platform="tpu", topology_name=spec["tpu_topology"],
-            chips_per_host_bounds=_V5E_HOST_BOUNDS, num_slices=1)
-        assert len(topo.devices) == spec["n_devices"], len(topo.devices)
-        mesh = Mesh(
-            np.array(topo.devices).reshape(tuple(spec["mesh"].values())),
-            tuple(spec["mesh"].keys()))
+        mesh = topology_mesh(spec["tpu_topology"], spec["mesh"])
     else:
         mesh = parallel.make_mesh(spec["mesh"])
     dp = spec["mesh"].get("dp", 1)
@@ -394,6 +384,13 @@ def main():
     compiled = lowered.compile()
     compile_sec = time.time() - t1
     hlo = compiled.as_text()
+    if _BACKEND == "tpu":
+        # guard the load-bearing number: a sharding-plumbing regression
+        # would silently compile CPU and skip the CPU-artifact
+        # correction at the same time
+        from _tpu_topology import assert_tpu_hlo
+
+        assert_tpu_hlo(hlo, f"scale_proof {which}")
     collectives = {k: len(re.findall(k, hlo)) for k in
                    ("all-reduce", "collective-permute", "all-gather",
                     "reduce-scatter", "all-to-all")}
