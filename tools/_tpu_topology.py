@@ -48,6 +48,21 @@ def assert_tpu_hlo(hlo, what=""):
         f"{what}: no TPU tiling in optimized HLO — compiled for CPU?"
 
 
+def estimated_cycles_sum(hlo, required=False):
+    """Sum XLA:TPU's per-fusion ``estimated_cycles`` backend-config
+    entries.  ``required=True`` raises when the HLO carries none — a
+    serialization-format drift would otherwise silently zero every
+    prediction built on this number (it is load-bearing for
+    PREDICTED_THROUGHPUT / CYCLES_AB artifacts)."""
+    cycles = [int(c) for c in
+              re.findall(r'"estimated_cycles":"(\d+)"', hlo)]
+    if required and not cycles:
+        raise AssertionError(
+            "no estimated_cycles in TPU HLO — backend_config "
+            "serialization changed?")
+    return sum(cycles), len(cycles)
+
+
 def count_mosaic_calls(hlo):
     """Mosaic kernels appear as custom-calls with the
     ``tpu_custom_call`` target — a bare 'custom-call' substring count

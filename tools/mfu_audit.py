@@ -62,13 +62,41 @@ HAND_GFLOP = {
 }
 
 
+#: MXU flops per TensorCore cycle on v5e (4 MXUs x 128x128 MACs x 2):
+#: XLA:TPU's per-fusion ``estimated_cycles`` measures in this clock
+#: domain — large-matmul probes resolve ~120k flops/cycle against this
+#: 131,072 ceiling (92%), which pins both the calibration and the
+#: implied ~1.5 GHz clock (197e12 / 131072).
+V5E_MXU_FLOPS_PER_CYCLE = 131072
+V5E_CLOCK_HZ = PEAK_BF16_TFLOPS * 1e12 / V5E_MXU_FLOPS_PER_CYCLE
+
+
 def _setup_platform():
+    """AUDIT_PLATFORM: ``cpu`` (default) prices FLOPs/bytes on the CPU
+    lowering; ``tpu_topology`` compiles against the OFFLINE libtpu
+    v5e:1x1 topology client — real XLA:TPU fusions, with the
+    per-fusion ``estimated_cycles`` summed into a predicted step time
+    (serial-fusion model: DMA/compute overlap ignored, so the
+    prediction is a floor on speed and measured throughput should land
+    at or above it)."""
     plat = os.environ.get("AUDIT_PLATFORM", "cpu")
-    if plat == "cpu":
+    if plat in ("cpu", "tpu_topology"):
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+    if plat == "tpu_topology":
+        # the prediction must price the kernels the real chip runs:
+        # route the pallas flash path (the process backend being cpu
+        # would otherwise silently swap in the chunked fallback)
+        os.environ.setdefault("MXT_FORCE_PALLAS_FLASH", "1")
     return plat
+
+
+def _topology_mesh():
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from _tpu_topology import topology_mesh
+
+    return topology_mesh("v5e:1x1")
 
 
 def _compose_step(net, loss_raw, opt, batch_for_rescale, key,
@@ -128,18 +156,37 @@ def _compose_step(net, loss_raw, opt, batch_for_rescale, key,
 
 
 def _cost(jfn, abstract_params, abstract_states, in_structs, label_struct):
-    lowered = jfn.lower(abstract_params, abstract_states, in_structs,
-                        label_struct)
+    import jax
+
+    args = (abstract_params, abstract_states, in_structs, label_struct)
+    plat = os.environ.get("AUDIT_PLATFORM", "cpu")
+    if plat == "tpu_topology":
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        repl = NamedSharding(_topology_mesh(), P())
+        args = jax.tree.map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype,
+                                           sharding=repl), args)
+    lowered = jfn.lower(*args)
     compiled = lowered.compile()
     ca = compiled.cost_analysis()
     if isinstance(ca, list):  # older jax returns per-device list
         ca = ca[0]
-    return {
+    out = {
         "flops": float(ca.get("flops", float("nan"))),
         "bytes_accessed": float(ca.get("bytes accessed",
                                        ca.get("bytes_accessed",
                                               float("nan")))),
     }
+    if plat == "tpu_topology":
+        from _tpu_topology import assert_tpu_hlo, estimated_cycles_sum
+
+        hlo = compiled.as_text()
+        assert_tpu_hlo(hlo, "mfu_audit")
+        total, n = estimated_cycles_sum(hlo, required=True)
+        out["tpu_estimated_cycles_sum"] = total
+        out["tpu_estimated_fusions"] = n
+    return out
 
 
 def _emit(workload, per_step, batch, cost, hand_gflop, note=""):
@@ -154,7 +201,13 @@ def _emit(workload, per_step, batch, cost, hand_gflop, note=""):
         "workload": workload,
         "per_step": per_step,
         "batch": batch,
-        "lowering_platform": jax.default_backend(),
+        # default_backend() reports the PROCESS backend (cpu even when
+        # the jit target is the topology client) — record the actual
+        # pricing backend
+        "lowering_platform": (
+            "xla:tpu (offline v5e:1x1 topology client)"
+            if os.environ.get("AUDIT_PLATFORM") == "tpu_topology"
+            else jax.default_backend()),
         "xla_flops_per_step": cost["flops"],
         "xla_bytes_accessed_per_step": cost["bytes_accessed"],
         "xla_gflop_per_sample": round(xla_gflop_sample, 3),
@@ -167,6 +220,19 @@ def _emit(workload, per_step, batch, cost, hand_gflop, note=""):
         "mfu": round(mfu, 4),
         "note": note,
     }
+    if cost.get("tpu_estimated_cycles_sum"):
+        step_s = cost["tpu_estimated_cycles_sum"] / V5E_CLOCK_HZ
+        rec["tpu_estimated_cycles_sum"] = cost["tpu_estimated_cycles_sum"]
+        rec["tpu_estimated_fusions"] = cost["tpu_estimated_fusions"]
+        rec["predicted_step_ms"] = round(step_s * 1e3, 2)
+        rec["predicted_throughput_per_sec"] = round(batch / step_s, 1)
+        rec["predicted_mfu"] = round(
+            cost["flops"] / step_s / 1e12 / PEAK_BF16_TFLOPS, 4)
+        rec["prediction_model"] = (
+            "sum of XLA:TPU per-fusion estimated_cycles / "
+            f"{V5E_CLOCK_HZ/1e9:.2f} GHz; serial-fusion, no DMA "
+            "overlap — a floor on speed, measured should land at or "
+            "above predicted_throughput")
     print(json.dumps(rec))
     return rec
 
@@ -290,10 +356,15 @@ def audit_llama1b():
     y = jax.ShapeDtypeStruct((batch, seq), jnp.int32)
     cost = _cost(jfn, ap, ast, [x], y)
     hand = 6 * n_params * seq / 1e9 * batch / batch  # 6N per token
+    from mxnet_tpu.ops import flash_attention as _fa
+
+    attn_path = ("pallas-flash" if _fa._on_tpu() and seq % 128 == 0
+                 else "chunked-jnp")
     rec = _emit("llama1b", "fwd+bwd(remat)+sgd_mom update", batch, cost,
                 round(hand, 1),
                 note=f"{n_params/1e9:.2f}B params; hand = 6N/token "
-                     "(remat recompute NOT in hand count, IS in XLA's)")
+                     "(remat recompute NOT in hand count, IS in "
+                     f"XLA's); attention kernel priced: {attn_path}")
     return rec
 
 
@@ -323,8 +394,11 @@ def main():
             print(f"{name}: FAILED", file=sys.stderr)
             continue
         out["workloads"].append(json.loads(lines[-1]))
+    default = ("PREDICTED_THROUGHPUT_r05.json"
+               if os.environ.get("AUDIT_PLATFORM") == "tpu_topology"
+               else "MFU_AUDIT_r04.json")
     path = os.path.join(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))), "MFU_AUDIT_r04.json")
+        os.path.abspath(__file__))), os.environ.get("AUDIT_OUT", default))
     with open(path, "w") as f:
         json.dump(out, f, indent=1)
     print(f"wrote {path}")

@@ -412,15 +412,27 @@ def main():
     verdict = {}
     if "argument_size_in_bytes" in mem and _BACKEND == "tpu":
         # REAL XLA:TPU buffer assignment: bf16 dots are native on the
-        # MXU, so the fit claim needs no correction term at all
+        # MXU, so the fit claim needs no correction term — and the
+        # STRONGEST signal is that the compile SUCCEEDED at all: the
+        # topology compiler enforces the device's usable HBM budget
+        # (15.75 GiB on v5e) and fails RESOURCE_EXHAUSTED when the
+        # scheduled program exceeds it (observed: llama-1.17B batch-4
+        # with chunked attention, "Used 15.78G of 15.75G hbm").
+        # Reaching this line therefore proves XLA scheduled the step
+        # within budget; the args+temp arithmetic below is a
+        # supplementary upper bound (it ignores donation aliasing).
         args_b = mem["argument_size_in_bytes"]
         temp_b = mem.get("temp_size_in_bytes", 0)
         resident = args_b + temp_b
         verdict = {
+            "fits_hbm_compiler_enforced": True,
+            "compiler_enforced_budget_gib": 15.75,
             "resident_bytes_per_device_args_plus_temp": resident,
-            "resident_gib_per_device": round(resident / 2 ** 30, 2),
-            "hbm_budget_gib": 16.0,
-            "fits_16gib_raw": bool(resident < 16 * 2 ** 30),
+            "resident_gib_per_device_upper_bound": round(
+                resident / 2 ** 30, 2),
+            "upper_bound_note": "args+temp, ignores donation aliasing "
+                                "— the compiler's own scheduler fit is "
+                                "the load-bearing verdict",
         }
     elif "argument_size_in_bytes" in mem:
         # resident working set per device: live arguments + XLA temps
