@@ -202,7 +202,7 @@ def _fa_forward_pallas(q, k, v, causal, scale, block_q=512, block_k=512):
             pl.BlockSpec((1, block_k, d), lambda b_, i, j: (b_, j, 0)),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda b_, i, j: (b_, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
+        out_shape=_pallas_out_shape((bh, tq, d), q.dtype, q, k, v),
         scratch_shapes=[
             pltpu.VMEM((block_q, 1), jnp.float32),   # m
             pltpu.VMEM((block_q, 1), jnp.float32),   # l
@@ -304,6 +304,26 @@ def _fa_backward_dense(qf, kf, vf, gf, q, k, v, causal, scale, tq, tk):
     dq = jnp.einsum("bhqk,bhkd->bhqd", ds, kf)
     dk = jnp.einsum("bhqk,bhqd->bhkd", ds, qf)
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+def _pallas_out_shape(shape, dtype, *operands):
+    """out_shape for pallas_call that survives a CHECKED shard_map:
+    inside a manual mesh, jax requires the custom-call's output to
+    declare which mesh axes it varies over (vma).  The output varies
+    over exactly the axes its OPERANDS do — declaring all manual axes
+    instead would over-claim on a multi-axis mesh whose shard_map specs
+    name only some of them (e.g. the sp-only specs of ring.py under a
+    dp×sp mesh) and fail the output typecheck.  Outside shard_map (or
+    on jax without the vma kwarg) this is a plain ShapeDtypeStruct."""
+    try:
+        vma = frozenset().union(
+            *(getattr(jax.typeof(o), "vma", frozenset()) or frozenset()
+              for o in operands))
+        if vma:
+            return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    except Exception:
+        pass
+    return jax.ShapeDtypeStruct(shape, dtype)
 
 
 def _inside_shard_map():
