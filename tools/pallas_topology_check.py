@@ -120,7 +120,11 @@ def main():
     sp_shard = NamedSharding(sp_mesh, P(None, None, "sp", None))
     B, N, T, H = 1, 8, 2048, 128
 
-    def sp_case(name, fn, mosaic_required):
+    def sp_case(name, fn, mosaic_required, collective):
+        """``collective``: (hlo_opcode, min_count) that PROVES the
+        sequence-parallel route engaged — a silent fallback (axis
+        rename, spec drift) otherwise compiles fine with zero
+        collectives and would record a vacuous pass."""
         try:
             with mesh_scope(sp_mesh):
                 shaped = [jax.ShapeDtypeStruct(
@@ -131,22 +135,27 @@ def main():
             assert_tpu_hlo(hlo, what=name)
             mosaic = count_mosaic_calls(hlo)
             # count instruction DEFINITIONS (one per op; async pairs
-            # count the -start only) — a bare substring count would
-            # also hit every USE of an %all-to-all.N name
+            # count the -start only; async ops have TUPLE types with
+            # spaces between '=' and the opcode) — a bare substring
+            # count would also hit every USE of an %all-to-all.N name
+            counts = {
+                op: len(re.findall(
+                    rf"= .* {op}(?:-start)?\(", hlo))
+                for op in ("collective-permute", "all-to-all")}
+            op, need = collective
+            ok = counts[op] >= need and \
+                (mosaic > 0 if mosaic_required else True)
             rec = {
-                "tpu_compile_ok": mosaic > 0 if mosaic_required
-                                  else True,
+                "tpu_compile_ok": ok,
                 "mosaic_custom_calls": mosaic,
-                # async ops have TUPLE types (spaces!) between '=' and
-                # the opcode, so match anything up to it on the line;
-                # -done ops are excluded (one op = one -start)
-                "collective_permutes": len(re.findall(
-                    r"= .* collective-permute(?:-start)?\(", hlo)),
-                "all_to_alls": len(re.findall(
-                    r"= .* all-to-all(?:-start)?\(", hlo)),
+                "collective_permutes": counts["collective-permute"],
+                "all_to_alls": counts["all-to-all"],
             }
-            if mosaic_required and mosaic == 0:
-                rec["error"] = "compiled but no tpu_custom_call in HLO"
+            if not ok:
+                rec["error"] = (
+                    f"compiled but route degraded: {counts[op]} "
+                    f"{op} (need >= {need}), {mosaic} mosaic calls"
+                    f" (required: {mosaic_required})")
         except Exception as e:
             rec = {"tpu_compile_ok": False,
                    "error": f"{type(e).__name__}: {e}"[:400]}
@@ -155,11 +164,12 @@ def main():
     sp_case("ulysses_attention_sp4_flash",
             lambda q, k, v: ulysses_attention_raw(
                 q, k, v, causal=True, mesh=sp_mesh),
-            mosaic_required=True)
+            mosaic_required=True, collective=("all-to-all", 4))
     sp_case("ring_attention_sp4",
             lambda q, k, v: ring_attention_raw(
                 q, k, v, causal=True, mesh=sp_mesh),
-            mosaic_required=False)
+            mosaic_required=False,
+            collective=("collective-permute", 2))
 
     # multi-axis mesh: operand vma ({'sp'} or {'dp','sp'}) is a strict
     # subset story — the kernel's out_shape must declare the OPERANDS'
@@ -170,7 +180,7 @@ def main():
     sp_case("ulysses_attention_dp2xsp4_flash",
             lambda q, k, v: ulysses_attention_raw(
                 q, k, v, causal=True, mesh=sp_mesh),
-            mosaic_required=True)
+            mosaic_required=True, collective=("all-to-all", 4))
 
     blob = json.dumps(out, indent=1)
     print(blob)

@@ -1,0 +1,120 @@
+#!/usr/bin/env python
+"""scan_layers compile-time A/B on the REAL XLA:TPU backend (offline
+topology client): the claim is layer-count-INDEPENDENT compile time —
+`lax.scan` over the stacked decoder compiles ONE layer body regardless
+of depth, while the python layer loop recompiles every layer.
+
+Four compiles of the same small llama geometry (hidden 1024, 8 heads,
+seq 1024, batch 2, bf16, sdpa attention — attention kernel choice is
+irrelevant to the scaling story): {8, 24} layers x {loop, scan}.
+Records wall-clock lower+compile seconds and the HLO size.  Measured
+signature (r5): the TPU compiler dedups identical per-layer fusions,
+so at this small geometry compile-TIME growth is the same for both
+(~1.7x for 3x layers; run-to-run noise swamps any difference) —
+scan's offline-provable win is CODE SIZE (optimized HLO ~2.8x smaller
+at L24) plus the near-zero lower/trace cost (0.1 s vs ~1 s at L24).
+The decisive scan wins remain the r4 ones: per-layer buffer dedup
+(memory) and trace cost at real depths.
+(estimated_cycles is NOT comparable across the two — a scanned body's
+fusions are counted once, not per iteration — so this artifact
+intentionally reports compile time and code size only.)
+
+Writes one JSON blob to stdout (and argv[1] if given).  Single-process
+(libtpu lockfile).
+"""
+import json
+import sys
+import time
+
+
+def main():
+    import os
+
+    os.environ.pop("JAX_PLATFORMS", None)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), ".."))
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from _tpu_topology import assert_tpu_hlo, topology_mesh
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+    from mxnet_tpu.gluon.block import _CachedGraph
+    from mxnet_tpu.models import llama
+
+    mesh = topology_mesh("v5e:1x1")
+    repl = NamedSharding(mesh, P())
+    out = {"topology": "v5e:1x1 (offline libtpu AOT client)",
+           "geometry": "hidden 1024, 8 heads, seq 1024, batch 2, bf16",
+           "cases": {}}
+
+    def build(layers, scan):
+        mx.random.seed(0)
+        net = llama.LlamaForCausalLM(llama.LlamaConfig(
+            hidden_size=1024, intermediate_size=2816, num_layers=layers,
+            num_heads=8, num_kv_heads=8, vocab_size=8192,
+            max_seq_len=1024, attn_mode="sdpa", scan_layers=scan))
+        net.initialize(mx.init.Zero())
+        net(nd.ones((1, 8), dtype="int32"))
+        net.cast("bfloat16")
+        params = list(net.collect_params().values())
+        graph = _CachedGraph(net, params, training=False)
+
+        def fwd(p_raws, ids):
+            outs, _ = graph._pure(p_raws, (ids,),
+                                  jax.random.PRNGKey(0))
+            return outs[0]
+
+        abs_p = tuple(
+            jax.ShapeDtypeStruct(p.shape, p.data()._data.dtype,
+                                 sharding=repl) for p in params)
+        ids = jax.ShapeDtypeStruct((2, 1024), jnp.int32, sharding=repl)
+        return fwd, abs_p, ids
+
+    for layers in (8, 24):
+        for scan in (False, True):
+            name = f"L{layers}_{'scan' if scan else 'loop'}"
+            fwd, abs_p, ids = build(layers, scan)
+            t0 = time.time()
+            lowered = jax.jit(fwd).lower(abs_p, ids)
+            t1 = time.time()
+            comp = lowered.compile()
+            t2 = time.time()
+            hlo = comp.as_text()
+            assert_tpu_hlo(hlo, what=name)
+            out["cases"][name] = {
+                "lower_sec": round(t1 - t0, 1),
+                "compile_sec": round(t2 - t1, 1),
+                "total_sec": round(t2 - t0, 1),
+                "hlo_chars": len(hlo),
+            }
+            print(f"{name}: {out['cases'][name]}", file=sys.stderr)
+
+    c = out["cases"]
+    out["loop_compile_ratio_24_vs_8"] = round(
+        c["L24_loop"]["total_sec"] / c["L8_loop"]["total_sec"], 2)
+    out["scan_compile_ratio_24_vs_8"] = round(
+        c["L24_scan"]["total_sec"] / c["L8_scan"]["total_sec"], 2)
+    out["hlo_size_loop_vs_scan_at_24"] = round(
+        c["L24_loop"]["hlo_chars"] / c["L24_scan"]["hlo_chars"], 2)
+    out["finding"] = (
+        "XLA:TPU dedups identical per-layer fusions, so loop compile "
+        "time grows sublinearly at this geometry; scan's offline-"
+        "provable wins are lower tracing cost and ~linear-in-L smaller "
+        "optimized HLO — the decisive wins (per-layer buffer dedup, "
+        "trace cost at real depths) are the r4 CPU-proven ones")
+
+    blob = json.dumps(out, indent=1)
+    print(blob)
+    if len(sys.argv) > 1:
+        with open(sys.argv[1], "w") as f:
+            f.write(blob + "\n")
+
+
+if __name__ == "__main__":
+    main()
