@@ -585,19 +585,28 @@ def retain(data, indices):
 
 
 def dot(lhs, rhs, transpose_a=False, transpose_b=False):
-    """Sparse-aware dot: csr × dense routes through BCOO (XLA sparse path);
-    row_sparse densifies (reference FComputeEx dispatch,
-    src/operator/tensor/dot.cc:?)."""
+    """Sparse-aware dot: csr × dense routes through BCOO (XLA sparse
+    path); row_sparse densifies (reference FComputeEx dispatch,
+    src/operator/tensor/dot.cc:?).
+
+    Autograd: the DENSE operand's gradient flows (the BCOO matmul is
+    routed through apply_op, and jax's BCOO rules supply the vjp wrt
+    the dense side); the sparse operand is a constant — same contract
+    as the sparse elemwise algebra."""
     from . import dot as dense_dot
 
     if isinstance(lhs, CSRNDArray) and not isinstance(rhs,
                                                       BaseSparseNDArray):
+        from ..ops.registry import apply_op
+
         bcoo = lhs.to_bcoo()
-        raw = rhs._data
         if transpose_a:
             bcoo = bcoo.T
-        out = bcoo @ (raw.T if transpose_b else raw)
-        return NDArray(out)
+
+        def f(r_raw):
+            return bcoo @ (r_raw.T if transpose_b else r_raw)
+
+        return apply_op(f, rhs, name="sparse_dot")
     l = lhs.todense() if isinstance(lhs, BaseSparseNDArray) else lhs
     r = rhs.todense() if isinstance(rhs, BaseSparseNDArray) else rhs
     return dense_dot(l, r, transpose_a=transpose_a, transpose_b=transpose_b)

@@ -200,3 +200,48 @@ def test_dist_async_worker_killed_mid_push_server_survives(monkeypatch):
         w1.close()
     finally:
         srv.shutdown()
+
+
+def test_dist_async_server_survives_garbage_frames(monkeypatch):
+    """Wire fuzz: raw connections feeding junk (random bytes, huge
+    length prefixes, valid-length-invalid-body frames) must each be
+    dropped without taking the server down or corrupting state for
+    authenticated workers."""
+    from mxnet_tpu import nd
+    from mxnet_tpu.kvstore.dist_async import (AsyncPSKVStore, PSServer,
+                                              serve_forever)
+    from mxnet_tpu.test_utils import assert_almost_equal
+
+    monkeypatch.setenv("MXT_PS_SECRET", "fuzz-test-secret")
+    port = _free_port()
+    uri = f"127.0.0.1:{port}"
+    srv = serve_forever(uri, PSServer())
+    try:
+        w = AsyncPSKVStore(root_uri=uri, rank=0, num_workers=1)
+        w.init("k", nd.zeros((8,)))
+
+        rng = np.random.RandomState(0)
+        for i in range(12):
+            s = socket.socket()
+            s.settimeout(5)
+            s.connect(("127.0.0.1", port))
+            mode = i % 3
+            try:
+                if mode == 0:      # pure junk
+                    s.sendall(rng.bytes(64))
+                elif mode == 1:    # absurd length prefix, no body
+                    s.sendall(struct.pack("<Q", 1 << 40))
+                else:              # plausible length, garbage body
+                    s.sendall(struct.pack("<Q", 128) + rng.bytes(128))
+            except OSError:
+                pass  # server may RST mid-send; that's a pass
+            s.close()
+
+        # the real worker is unaffected
+        time.sleep(0.3)
+        out = nd.zeros((8,))
+        w.pull("k", out=out)
+        assert_almost_equal(out, np.zeros((8,)))
+        w.close()
+    finally:
+        srv.shutdown()

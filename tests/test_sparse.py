@@ -8,6 +8,8 @@ elemwise_unary_op_basic.cc:? / cast_storage-inl.h:?, and their tests in
 tests/python/unittest/test_sparse_operator.py:?.  Oracle everywhere: the
 same op on the densified operands.
 """
+import os
+
 import numpy as onp
 import pytest
 
@@ -275,3 +277,35 @@ def test_divide_semantics_match_inside_record():
     mask = Az != 0
     onp.testing.assert_allclose(g[mask], -Az[mask] / dz[mask] ** 2,
                                 rtol=1e-5)
+
+
+def test_sparse_dot_dense_operand_gradient_flows():
+    """Autograd through sparse dot (reference example/sparse/
+    linear_classification workflow): the DENSE operand's gradient must
+    flow through the BCOO matmul; the sparse side is a constant."""
+    from mxnet_tpu import autograd
+
+    X = _sparse_np((12, 6), density=0.4)
+    w = nd.zeros((6, 1))
+    w.attach_grad()
+    csr = nd.array(X).tostype("csr")
+    with autograd.record():
+        out = nd.dot(csr, w)
+        loss = (out * out).sum() + out.sum()
+    loss.backward()
+    g = w.grad.asnumpy()
+    want = X.T @ (2 * (X @ onp.zeros((6, 1))) + 1)
+    onp.testing.assert_allclose(g, want, rtol=1e-5)
+    assert onp.abs(g).sum() > 0
+
+
+def test_sparse_linear_classification_example_smoke(monkeypatch):
+    """The user-facing example trains end to end on a tiny config."""
+    import runpy
+
+    for k, v in (("N", "1500"), ("D", "256"), ("STEPS", "45"),
+                 ("BATCH", "128"), ("LR", "3.0")):
+        monkeypatch.setenv(k, v)
+    runpy.run_path(os.path.join(
+        os.path.dirname(__file__), "..", "examples",
+        "sparse_linear_classification.py"), run_name="__main__")
