@@ -120,8 +120,8 @@ def _fa_forward_chunked(q, k, v, causal, scale, block=512):
 
 # --- pallas forward kernel ---------------------------------------------------
 
-def _fa_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
-               block_q, block_k, causal, scale, nk):
+def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref,
+               acc_ref, *, block_q, block_k, causal, scale, nk):
     """Canonical 3-D-grid flash kernel: grid (BH, nq, nk), kv innermost;
     running (m, l, acc) live in VMEM scratch across the kv sweep so pallas
     double-buffers the K/V block loads."""
@@ -167,20 +167,33 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
 
     @pl.when(kj == nk - 1)
     def _finish():
+        m = m_ref[...][:, 0]
         l = l_ref[...][:, 0]
         o_ref[0] = (acc_ref[...] /
                     jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+        # log-sum-exp per query row, saved for the pallas backward;
+        # fully-masked rows keep -inf (their backward p is zeroed).
+        # Stored (…, block_q, 1): mosaic requires the last two block
+        # dims (8, 128)-aligned or equal to the array's — a trailing
+        # singleton satisfies that where a 2-D (1, block_q) cannot.
+        lse_ref[0] = jnp.where(
+            jnp.isfinite(m) & (l > 0.0),
+            jnp.where(jnp.isfinite(m), m, 0.0) +
+            jnp.log(jnp.maximum(l, 1e-30)),
+            -jnp.inf)[:, None]
 
 
-def _fa_forward_pallas(q, k, v, causal, scale, block_q=512, block_k=512):
+def _divisor_block(t, pref):
+    for cand in (pref, 512, 256, 128):
+        if cand <= t and t % cand == 0:
+            return cand
+    return t
+
+
+def _fa_forward_pallas(q, k, v, causal, scale, block_q=512, block_k=512,
+                       with_lse=False, interpret=False):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
-
-    def _divisor_block(t, pref):
-        for cand in (pref, 512, 256, 128):
-            if cand <= t and t % cand == 0:
-                return cand
-        return t
 
     b, h, tq, d = q.shape
     tk = k.shape[2]
@@ -192,7 +205,7 @@ def _fa_forward_pallas(q, k, v, causal, scale, block_q=512, block_k=512):
     block_k = _divisor_block(tk, min(block_k, tk))
     nk = tk // block_k
     grid = (bh, tq // block_q, nk)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         functools.partial(_fa_kernel, block_q=block_q, block_k=block_k,
                           causal=causal, scale=scale, nk=nk),
         grid=grid,
@@ -201,8 +214,14 @@ def _fa_forward_pallas(q, k, v, causal, scale, block_q=512, block_k=512):
             pl.BlockSpec((1, block_k, d), lambda b_, i, j: (b_, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b_, i, j: (b_, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda b_, i, j: (b_, i, 0)),
-        out_shape=_pallas_out_shape((bh, tq, d), q.dtype, q, k, v),
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b_, i, j: (b_, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b_, i, j: (b_, i, 0)),
+        ],
+        out_shape=[
+            _pallas_out_shape((bh, tq, d), q.dtype, q, k, v),
+            _pallas_out_shape((bh, tq, 1), jnp.float32, q, k, v),
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 1), jnp.float32),   # m
             pltpu.VMEM((block_q, 1), jnp.float32),   # l
@@ -211,8 +230,192 @@ def _fa_forward_pallas(q, k, v, causal, scale, block_q=512, block_k=512):
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"))
         if hasattr(pltpu, "CompilerParams") else None,
+        interpret=interpret,
     )(qf, kf, vf)
-    return out.reshape(b, h, tq, d)
+    out = out.reshape(b, h, tq, d)
+    if with_lse:
+        return out, lse.reshape(b, h, tq)
+    return out
+
+
+# --- pallas backward kernels -------------------------------------------------
+# Standard two-kernel TPU flash backward (the same split
+# jax.experimental.pallas.ops.tpu.flash_attention uses): a dq kernel
+# sweeping K blocks innermost, and a dkv kernel sweeping Q blocks
+# innermost — no atomics needed, each output block is owned by exactly
+# one grid row.  p is recomputed from the saved per-row lse (written by
+# the forward kernel), delta = rowsum(dO * O) is a cheap fused
+# elementwise computed outside.
+
+def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                      dq_ref, acc_ref, *, block_q, block_k, causal,
+                      scale, nk):
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    pred = ((qi + 1) * block_q > kj * block_k) if causal else (kj == kj)
+
+    @pl.when(pred)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, 0]
+        delta = delta_ref[0][:, 0]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        if causal:
+            qpos = qi * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            kpos = kj * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(qpos >= kpos, s, -jnp.inf)
+        # fully-masked rows carry lse=-inf: zero their p explicitly
+        p = jnp.where(jnp.isfinite(s) & jnp.isfinite(lse)[:, None],
+                      jnp.exp(s - jnp.where(jnp.isfinite(lse), lse,
+                                            0.0)[:, None]), 0.0)
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale
+        acc_ref[...] += jnp.dot(ds, k,
+                                preferred_element_type=jnp.float32)
+
+    @pl.when(kj == nk - 1)
+    def _finish():
+        dq_ref[0] = acc_ref[...].astype(dq_ref.dtype)
+
+
+def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                       dk_ref, dv_ref, dk_acc, dv_acc, *, block_q,
+                       block_k, causal, scale, nq):
+    from jax.experimental import pallas as pl
+
+    ki = pl.program_id(1)
+    qj = pl.program_id(2)
+
+    @pl.when(qj == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    # causal: q blocks strictly above the diagonal see none of this k
+    # block
+    pred = ((qj + 1) * block_q > ki * block_k) if causal else (qj == qj)
+
+    @pl.when(pred)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, 0]
+        delta = delta_ref[0][:, 0]
+        st = jnp.dot(k, q.T, preferred_element_type=jnp.float32) * scale
+        if causal:
+            kpos = ki * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_k, block_q), 0)
+            qpos = qj * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_k, block_q), 1)
+            st = jnp.where(qpos >= kpos, st, -jnp.inf)
+        pt = jnp.where(jnp.isfinite(st) & jnp.isfinite(lse)[None, :],
+                       jnp.exp(st - jnp.where(jnp.isfinite(lse), lse,
+                                              0.0)[None, :]), 0.0)
+        dv_acc[...] += jnp.dot(pt, do,
+                               preferred_element_type=jnp.float32)
+        dpt = jnp.dot(v, do.T, preferred_element_type=jnp.float32)
+        dst = pt * (dpt - delta[None, :]) * scale
+        dk_acc[...] += jnp.dot(dst, q,
+                               preferred_element_type=jnp.float32)
+
+    @pl.when(qj == nq - 1)
+    def _finish():
+        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _fa_backward_pallas(q, k, v, o, do, lse, causal, scale, block_q=512,
+                        block_k=512, interpret=False):
+    """dq/dk/dv via the two pallas kernels; (B, H, T, D) in and out."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    bh = b * h
+    qf = q.reshape(bh, tq, d)
+    kf = k.reshape(bh, tk, d)
+    vf = v.reshape(bh, tk, d)
+    dof = do.reshape(bh, tq, d)
+    # trailing singleton: see the forward's lse block-alignment note
+    lsef = lse.reshape(bh, tq, 1)
+    # delta = rowsum(dO * O): one fused elementwise pass outside the
+    # kernels (XLA fuses it into the surrounding graph)
+    delta = (dof.astype(jnp.float32) *
+             o.reshape(bh, tq, d).astype(jnp.float32)).sum(
+                 -1, keepdims=True)
+    block_q = _divisor_block(tq, min(block_q, tq))
+    block_k = _divisor_block(tk, min(block_k, tk))
+    nq, nk = tq // block_q, tk // block_k
+
+    # dq: grid (bh, nq, nk) — K innermost, q/do/lse/delta follow i
+    dq = pl.pallas_call(
+        functools.partial(_fa_bwd_dq_kernel, block_q=block_q,
+                          block_k=block_k, causal=causal, scale=scale,
+                          nk=nk),
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b_, i, j: (b_, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b_, i, j: (b_, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b_, i, j: (b_, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b_, i, j: (b_, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b_, i, j: (b_, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b_, i, j: (b_, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d),
+                               lambda b_, i, j: (b_, i, 0)),
+        out_shape=_pallas_out_shape((bh, tq, d), q.dtype, q, k, v, do),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"))
+        if hasattr(pltpu, "CompilerParams") else None,
+        interpret=interpret,
+    )(qf, kf, vf, dof, lsef, delta)
+    # dkv: grid (bh, nk, nq) — Q innermost, k/v follow i
+    dk, dv = pl.pallas_call(
+        functools.partial(_fa_bwd_dkv_kernel, block_q=block_q,
+                          block_k=block_k, causal=causal, scale=scale,
+                          nq=nq),
+        grid=(bh, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b_, i, j: (b_, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b_, i, j: (b_, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b_, i, j: (b_, i, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b_, i, j: (b_, j, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b_, i, j: (b_, j, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b_, i, j: (b_, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda b_, i, j: (b_, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b_, i, j: (b_, i, 0)),
+        ],
+        out_shape=[
+            _pallas_out_shape((bh, tk, d), k.dtype, q, k, v, do),
+            _pallas_out_shape((bh, tk, d), v.dtype, q, k, v, do),
+        ],
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"))
+        if hasattr(pltpu, "CompilerParams") else None,
+        interpret=interpret,
+    )(qf, kf, vf, dof, lsef, delta)
+    return (dq.reshape(b, h, tq, d), dk.reshape(b, h, tk, d),
+            dv.reshape(b, h, tk, d))
 
 
 # --- chunked jnp backward ----------------------------------------------------
@@ -340,7 +543,13 @@ def _inside_shard_map():
         return False
 
 
-def _pallas_maybe_sharded(q, k, v, causal, scale):
+def _pallas_bwd_enabled():
+    import os
+
+    return os.environ.get("MXT_PALLAS_FLASH_BWD", "1") != "0"
+
+
+def _pallas_maybe_sharded(q, k, v, causal, scale, with_lse=False):
     """Route the pallas kernel under GSPMD: mosaic custom-calls cannot
     be automatically partitioned (XLA raises 'wrap the call in a
     shard_map'), so under an active multi-device mesh the kernel runs
@@ -354,53 +563,104 @@ def _pallas_maybe_sharded(q, k, v, causal, scale):
 
     mesh = current_mesh()
     if mesh is None or mesh.size == 1 or _inside_shard_map():
-        return _fa_forward_pallas(q, k, v, causal, scale)
+        return _fa_forward_pallas(q, k, v, causal, scale,
+                                  with_lse=with_lse)
     dp = "dp" if "dp" in mesh.shape else None
     tp = "tp" if "tp" in mesh.shape else None
     if dp is None and tp is None:
-        return _fa_forward_pallas(q, k, v, causal, scale)
+        return _fa_forward_pallas(q, k, v, causal, scale,
+                                  with_lse=with_lse)
     if (dp and q.shape[0] % mesh.shape[dp]) or \
             (tp and q.shape[1] % mesh.shape[tp]):
-        return _fa_forward_chunked(q, k, v, causal, scale)
-    import inspect
-
+        out = _fa_forward_chunked(q, k, v, causal, scale)
+        return (out, None) if with_lse else out
     from jax.sharding import PartitionSpec as P
 
     spec = P(dp, tp, None, None)
-    # the body is independent per (dp, tp) shard; the varying-axes
-    # checker can't see through kernel scratch init (or a mosaic
-    # custom-call at all) — disable it, under whichever name this jax
-    # spells it
-    kw = {}
+    return jax.shard_map(
+        lambda a, b, c: _fa_forward_pallas(a, b, c, causal, scale,
+                                           with_lse=with_lse),
+        mesh=mesh, in_specs=(spec, spec, spec),
+        out_specs=(spec, P(dp, tp, None)) if with_lse else spec,
+        **_shard_map_nocheck_kw())(q, k, v)
+
+
+def _shard_map_nocheck_kw():
+    """The kernel bodies are independent per shard; the varying-axes
+    checker can't see through kernel scratch init (or a mosaic
+    custom-call at all) — disable it, under whichever name this jax
+    spells it."""
+    import inspect
+
     params = inspect.signature(jax.shard_map).parameters
     if "check_vma" in params:
-        kw["check_vma"] = False
-    elif "check_rep" in params:
-        kw["check_rep"] = False
+        return {"check_vma": False}
+    if "check_rep" in params:
+        return {"check_rep": False}
+    return {}
+
+
+def _pallas_bwd_maybe_sharded(q, k, v, o, g, lse, causal, scale):
+    """Backward twin of :func:`_pallas_maybe_sharded`: same mesh
+    routing, same dp/tp specs (shapes matched the forward's sharded
+    decision, so divisibility holds by construction)."""
+    from ..parallel import current_mesh
+
+    mesh = current_mesh()
+    if mesh is None or mesh.size == 1 or _inside_shard_map():
+        return _fa_backward_pallas(q, k, v, o, g, lse, causal, scale)
+    dp = "dp" if "dp" in mesh.shape else None
+    tp = "tp" if "tp" in mesh.shape else None
+    if (dp is None and tp is None) or \
+            (dp and q.shape[0] % mesh.shape[dp]) or \
+            (tp and q.shape[1] % mesh.shape[tp]):
+        return _fa_backward_pallas(q, k, v, o, g, lse, causal, scale)
+    from jax.sharding import PartitionSpec as P
+
+    s4 = P(dp, tp, None, None)
+    s3 = P(dp, tp, None)
     return jax.shard_map(
-        lambda a, b, c: _fa_forward_pallas(a, b, c, causal, scale),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        **kw)(q, k, v)
+        lambda a, b, c, oo, gg, ll: _fa_backward_pallas(
+            a, b, c, oo, gg, ll, causal, scale),
+        mesh=mesh, in_specs=(s4, s4, s4, s4, s4, s3),
+        out_specs=(s4, s4, s4),
+        **_shard_map_nocheck_kw())(q, k, v, o, g, lse)
+
+
+def _pallas_applicable(q, k):
+    return (_on_tpu() and q.shape[-2] % 128 == 0
+            and k.shape[-2] % 128 == 0 and q.shape[-2] == k.shape[-2])
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def flash_attention_raw(q, k, v, causal=False, scale=None):
     """q/k/v (B, H, T, D) → (B, H, T, D).  Pallas on TPU, jnp fallback."""
     scale = float(scale) if scale is not None else 1.0 / float(np.sqrt(q.shape[-1]))
-    if _on_tpu() and q.shape[-2] % 128 == 0 and k.shape[-2] % 128 == 0 \
-            and q.shape[-2] == k.shape[-2]:
+    if _pallas_applicable(q, k):
         return _pallas_maybe_sharded(q, k, v, causal, scale)
     return _fa_forward_chunked(q, k, v, causal, scale)
 
 
 def _fwd(q, k, v, causal, scale):
+    s = float(scale) if scale is not None else \
+        1.0 / float(np.sqrt(q.shape[-1]))
+    if _pallas_applicable(q, k) and _pallas_bwd_enabled():
+        # the pallas forward saves per-row lse so the backward can run
+        # as pallas kernels too (VMEM-resident scores, no HBM
+        # (T, block) slabs); lse is None when the sharded wrapper fell
+        # back to chunked (indivisible batch/heads)
+        o, lse = _pallas_maybe_sharded(q, k, v, causal, s,
+                                       with_lse=True)
+        return o, (q, k, v, o, lse)
     o = flash_attention_raw(q, k, v, causal, scale)
-    return o, (q, k, v, o)
+    return o, (q, k, v, o, None)
 
 
 def _bwd(causal, scale, res, g):
-    q, k, v, o = res
+    q, k, v, o, lse = res
     s = float(scale) if scale is not None else 1.0 / float(np.sqrt(q.shape[-1]))
+    if lse is not None:
+        return _pallas_bwd_maybe_sharded(q, k, v, o, g, lse, causal, s)
     return _fa_backward(q, k, v, o, g, causal, s)
 
 

@@ -304,7 +304,7 @@ def test_sparse_linear_classification_example_smoke(monkeypatch):
     import runpy
 
     for k, v in (("N", "1500"), ("D", "256"), ("STEPS", "45"),
-                 ("BATCH", "128"), ("LR", "3.0")):
+                 ("BATCH", "128"), ("LR", "5.0")):
         monkeypatch.setenv(k, v)
     runpy.run_path(os.path.join(
         os.path.dirname(__file__), "..", "examples",

@@ -231,8 +231,10 @@ def _emit(workload, per_step, batch, cost, hand_gflop, note=""):
         rec["prediction_model"] = (
             "sum of XLA:TPU per-fusion estimated_cycles / "
             f"{V5E_CLOCK_HZ/1e9:.2f} GHz; serial-fusion, no DMA "
-            "overlap — a floor on speed, measured should land at or "
-            "above predicted_throughput")
+            "overlap, and mosaic custom-calls (pallas kernels) carry "
+            "NO estimate so their time is uncounted — a floor on "
+            "speed, measured should land at or above "
+            "predicted_throughput")
     print(json.dumps(rec))
     return rec
 

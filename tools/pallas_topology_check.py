@@ -81,6 +81,14 @@ def main():
            qkv,
            ref_fn=lambda q, k, v: fa._sdpa_ref(q, k, v, True, scale))
 
+    # flash backward: the two-kernel dq/dkv design (forward saves lse)
+    lse_aval = jax.ShapeDtypeStruct((B, H, T), jnp.float32)
+    record("flash_attention_bwd_bf16_T2048",
+           lambda q, k, v, o, g, lse: fa._fa_backward_pallas(
+               q, k, v, o, g, lse, True, scale),
+           qkv + [jax.ShapeDtypeStruct((B, H, T, D), jnp.bfloat16)] * 2
+           + [lse_aval])
+
     # fused 1x1conv(matmul)+BN-affine+ReLU probe kernel
     from pallas_conv_probe import fused_matmul_affine_relu
 

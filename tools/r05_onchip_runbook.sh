@@ -8,42 +8,47 @@ OUT=${1:-/tmp/r05_onchip}
 mkdir -p "$OUT"
 log() { echo "[runbook $(date +%H:%M:%S)] $*"; }
 
-log "1/8 sync probe (device kind, dispatch-vs-completion, achievable peak)"
+log "1/9 sync probe (device kind, dispatch-vs-completion, achievable peak)"
 timeout 900 python tools/sync_probe.py > "$OUT/sync_probe.txt" 2>&1
 cat "$OUT/sync_probe.txt"
 
-log "2/8 bench.py (hard-sync protocol, synthetic + recordio + BERT)"
+log "2/9 bench.py (hard-sync protocol, synthetic + recordio + BERT)"
 timeout 2400 python bench.py > "$OUT/bench.json" 2> "$OUT/bench.err"
 cat "$OUT/bench.json"
 
-log "3/8 on-chip parity lane (tests_tpu, derived MXU tolerances)"
+log "3/9 on-chip parity lane (tests_tpu, derived MXU tolerances)"
 MXT_TEST_TPU=1 MXT_TPU_PARITY_OUT=/root/repo/TPU_PARITY_r05.json \
   timeout 3600 python -m pytest tests_tpu/ -q > "$OUT/parity.txt" 2>&1
 tail -3 "$OUT/parity.txt"
 
-log "4/8 opperf (adaptive chains + int8 rows + bf16-bwd customvjp A/B)"
+log "4/9 opperf (adaptive chains + int8 rows + bf16-bwd customvjp A/B)"
 timeout 5400 python benchmark/opperf.py > /root/repo/OPPERF_r05.json \
   2> "$OUT/opperf.err"
 tail -5 /root/repo/OPPERF_r05.json
 
-log "5/8 quantized ResNet-18 inference e2e (int8 vs bf16)"
+log "5/9 quantized ResNet-18 inference e2e (int8 vs bf16)"
 timeout 1800 python tools/quantized_infer_bench.py \
   > "$OUT/quantized_infer.json" 2> "$OUT/quantized_infer.err"
 cat "$OUT/quantized_infer.json"
 
-log "6/8 pallas conv fusion probe (fused 1x1conv+BN+ReLU vs XLA)"
+log "6/9 pallas conv fusion probe (fused 1x1conv+BN+ReLU vs XLA)"
 timeout 1800 python tools/pallas_conv_probe.py \
   > "$OUT/pallas_probe.json" 2> "$OUT/pallas_probe.err"
 cat "$OUT/pallas_probe.json"
 
-log "7/8 llama 1.17B short re-measure (hard-sync tok/s)"
+log "7/9 llama 1.17B short re-measure (hard-sync tok/s)"
 STEPS=60 LOG_EVERY=20 timeout 3600 python examples/train_llama_1b.py \
   > "$OUT/llama1b.txt" 2>&1
 tail -3 "$OUT/llama1b.txt"
 
-log "8/8 llama 1.17B scan_layers A/B (compile time + tok/s)"
+log "8/9 llama 1.17B scan_layers A/B (compile time + tok/s)"
 SCAN_LAYERS=1 STEPS=60 LOG_EVERY=20 timeout 3600 \
   python examples/train_llama_1b.py > "$OUT/llama1b_scan.txt" 2>&1
 tail -3 "$OUT/llama1b_scan.txt"
+
+log "9/9 llama 1.17B pallas-flash-backward A/B (tok/s, kill-switch off)"
+MXT_PALLAS_FLASH_BWD=0 STEPS=60 LOG_EVERY=20 timeout 3600 \
+  python examples/train_llama_1b.py > "$OUT/llama1b_chunked_bwd.txt" 2>&1
+tail -3 "$OUT/llama1b_chunked_bwd.txt"
 
 log "runbook complete -> $OUT"
