@@ -190,6 +190,25 @@ def main():
                 q, k, v, causal=True, mesh=sp_mesh),
             mosaic_required=True, collective=("all-to-all", 4))
 
+    # the full sequence-parallel TRAIN direction: value_and_grad of the
+    # ulysses loss — the flash custom-vjp backward (two mosaic kernels)
+    # runs INSIDE the shard_map body, a2a count doubles (fwd q/k/v/out
+    # + bwd cotangent trades)
+    sp_mesh = topology_mesh("v5e:2x2", {"sp": 4})
+    sp_shard = NamedSharding(sp_mesh, P(None, None, "sp", None))
+    B = 1  # sp_case reads the geometry globals at call time — restore
+    # the sp4 forward case's shapes so the recorded numbers compare
+
+    def ulysses_loss_grad(q, k, v):
+        return jax.value_and_grad(
+            lambda a, b, c: (ulysses_attention_raw(
+                a, b, c, causal=True, mesh=sp_mesh)
+                .astype(jnp.float32) ** 2).sum(),
+            argnums=(0, 1, 2))(q, k, v)
+
+    sp_case("ulysses_sp4_value_and_grad_flash", ulysses_loss_grad,
+            mosaic_required=True, collective=("all-to-all", 8))
+
     blob = json.dumps(out, indent=1)
     print(blob)
     if len(sys.argv) > 1:
