@@ -22,6 +22,15 @@ def __getattr__(name):
         from .trainer import Trainer
 
         return Trainer
+    if name == "FusedTrainStep":
+        from .step_fusion import FusedTrainStep
+
+        return FusedTrainStep
+    if name == "step_fusion":
+        from . import step_fusion
+
+        globals()[name] = step_fusion
+        return step_fusion
     if name in _LAZY:
         import importlib
 

@@ -436,6 +436,7 @@ class _CachedGraph:
 
     def run(self, args):
         from .. import random as mxrand
+        from ..ops.registry import dispatch_platform, platform_of_raws
 
         p_handles = [p._data for p in self.params]
         p_raws = [h._data for h in p_handles]
@@ -445,10 +446,13 @@ class _CachedGraph:
             any(h._req_grad for h in p_handles) or
             any(getattr(a, "_req_grad", False) or a._node is not None
                 for a in args))
-        if recording:
-            outs, auxs, vjp = self._fwd_rec(p_raws, in_raws, key)
-        else:
-            outs, auxs = self._fwd(p_raws, in_raws, key)
+        # publish the operands' platform for platform-conditional ops
+        # traced inside this graph (see registry.dispatch_platform)
+        with dispatch_platform(platform_of_raws(in_raws + p_raws)):
+            if recording:
+                outs, auxs, vjp = self._fwd_rec(p_raws, in_raws, key)
+            else:
+                outs, auxs = self._fwd(p_raws, in_raws, key)
         for i, raw in zip(self.aux_idx, auxs):
             p_handles[i]._data = raw
         nd_outs = [NDArray(r) for r in outs]
@@ -506,7 +510,19 @@ class CachedOp:
                     "hybridized blocks take NDArray inputs only, got "
                     f"{type(a)}")
         training = ag.is_training()
-        sig = (tuple((a.shape, str(a.dtype)) for a in args), training,
+        from ..ops.registry import (current_dispatch_platform,
+                                    platform_of_raws)
+
+        # platform is part of the specialization: a graph traced for the
+        # TPU may bake platform-conditional branches (pallas flash) that
+        # cannot lower for host arrays in a mixed-platform process.
+        # Tracer args (this CachedOp called inside an outer trace) carry
+        # no device — inherit the outer dispatch's published platform so
+        # graphs traced under different hints don't share a cache slot.
+        plat = platform_of_raws([a._data for a in args])
+        if plat is None:
+            plat = current_dispatch_platform()
+        sig = (tuple((a.shape, str(a.dtype)) for a in args), training, plat,
                tuple((p.shape, str(np.dtype(p.dtype))) for p in params))
         g = self._graphs.get(sig)
         if g is None:

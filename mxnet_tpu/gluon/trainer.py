@@ -283,29 +283,12 @@ class Trainer:
                tuple(len(s) for s in states))
         fn = self._fused_cache.get(sig)
         if fn is None:
-            n = len(live)
             flags = tuple(mp_flags)
 
             def fused(w_raws, m_raws, g_raws, s_raws, lr_v, wd_v, t_v):
-                # m_raws holds ONLY mp masters (keyed by position among
-                # mp params) — never an alias of a donated weight buffer
-                new_w, new_m, new_s = [], [], []
-                mi = 0
-                for j in range(n):
-                    if flags[j]:
-                        nw, ns = optzr._step(
-                            m_raws[mi], g_raws[j].astype(np.float32),
-                            s_raws[j], lr_v[j], wd_v[j], t_v[j])
-                        mi += 1
-                        new_m.append(nw)
-                        new_w.append(nw.astype(w_raws[j].dtype))
-                    else:
-                        nw, ns = optzr._step(w_raws[j], g_raws[j],
-                                             s_raws[j], lr_v[j], wd_v[j],
-                                             t_v[j])
-                        new_w.append(nw)
-                    new_s.append(ns)
-                return tuple(new_w), tuple(new_m), tuple(new_s)
+                return opt._fused_param_updates(
+                    optzr, flags, w_raws, m_raws, g_raws, s_raws,
+                    lr_v, wd_v, t_v)
 
             # donate weights, masters and states; grads are read-only
             fn = jax.jit(fused, donate_argnums=(0, 1, 3))
@@ -322,17 +305,8 @@ class Trainer:
         t_v = jnp.asarray(ts, jnp.int32)
         new_w, new_m, new_s = fn(w_raws, m_raws, g_raws, s_raws, lr_v,
                                  wd_v, t_v)
-        mi = 0
-        for j, i in enumerate(live):
-            param = self._params[i]
-            param.data()._data = new_w[j]
-            if mp_flags[j]:
-                masters[j]._data = new_m[mi]
-                mi += 1
-                sub_state = self._states[i][1]
-            else:
-                sub_state = self._states[i]
-            opt._commit_state(sub_state, new_s[j])
+        opt._commit_param_updates(self, live, mp_flags, masters,
+                                  new_w, new_m, new_s)
         return True
 
     # -- state persistence (reference: Trainer.save_states/load_states) ------

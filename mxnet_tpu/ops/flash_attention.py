@@ -34,6 +34,15 @@ def _on_tpu():
         # topology client, so the mosaic kernel is both valid and the
         # true memory profile — the caller vouches for the target
         return True
+    # in a mixed-platform process, route by where the dispatch's operands
+    # actually live (r5 on-chip parity finding: the cpu-oracle leg was
+    # handed a mosaic kernel); the hint is published by apply_op and
+    # CachedOp dispatch whenever their operands are concrete
+    from .registry import current_dispatch_platform
+
+    hint = current_dispatch_platform()
+    if hint is not None:
+        return hint in ("tpu", "axon")
     try:
         return jax.devices()[0].platform in ("tpu", "axon")
     except Exception:
@@ -628,6 +637,13 @@ def _pallas_bwd_maybe_sharded(q, k, v, o, g, lse, causal, scale):
 
 
 def _pallas_applicable(q, k):
+    import os
+
+    # MXT_PALLAS_FLASH=0: master kill switch to the chunked-jnp path
+    # (both directions) — the operational lever when a backend update
+    # changes mosaic behavior under the same framework code
+    if os.environ.get("MXT_PALLAS_FLASH", "1") == "0":
+        return False
     return (_on_tpu() and q.shape[-2] % 128 == 0
             and k.shape[-2] % 128 == 0 and q.shape[-2] == k.shape[-2])
 
@@ -668,7 +684,8 @@ flash_attention_raw.defvjp(_fwd, _bwd)
 
 
 def flash_attention(query, key, value, causal=False, scale=None, **kwargs):
-    """NDArray-level op: fused attention over (B, H, T, D) operands."""
+    """NDArray-level op: fused attention over (B, H, T, D) operands.
+    Platform routing rides apply_op's dispatch-platform hint."""
     from .registry import apply_op
 
     return apply_op(

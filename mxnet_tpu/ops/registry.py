@@ -59,6 +59,77 @@ def _in_graph(x) -> bool:
     return getattr(x, "_req_grad", False) or getattr(x, "_node", None) is not None
 
 
+# --- dispatch-platform hint --------------------------------------------------
+# In a mixed-platform process (the on-chip parity lane runs its cpu
+# oracle and tpu leg in ONE process) ``jax.devices()[0]`` is the TPU
+# even when the op's operands are committed to host memory.  Ops whose
+# lowering is platform-conditional (the pallas flash kernel) must route
+# by where the computation will actually run, so every dispatch that
+# holds CONCRETE operands publishes their platform here for the
+# duration of its trace; platform-conditional ops consult it before
+# falling back to the process-default backend.  Thread-local: the
+# parity harness and data loaders run concurrent dispatches.
+
+import threading as _threading
+
+
+class _DispatchPlatform(_threading.local):
+    def __init__(self):
+        self.stack = []
+
+
+_DISPATCH_PLATFORM = _DispatchPlatform()
+
+
+def platform_of_raw(raw):
+    """Platform of a CONCRETE jax array (None for tracers/unknown)."""
+    import jax
+
+    if isinstance(raw, jax.core.Tracer):
+        return None  # keep the hot traced-dispatch path exception-free
+    try:
+        dev = raw.device  # Device for single-device arrays, else Sharding
+        plat = getattr(dev, "platform", None)
+        if plat is None:
+            plat = next(iter(dev.device_set)).platform
+        return plat
+    except Exception:
+        return None
+
+
+def platform_of_raws(raws):
+    """First non-None operand platform (the shared scan used by every
+    dispatch site: apply_op, CachedOp, FusedTrainStep)."""
+    for raw in raws:
+        plat = platform_of_raw(raw)
+        if plat is not None:
+            return plat
+    return None
+
+
+def current_dispatch_platform():
+    stack = _DISPATCH_PLATFORM.stack
+    return stack[-1] if stack else None
+
+
+class dispatch_platform:
+    """Publish ``platform`` while tracing a dispatch.  A None platform
+    (tracer operands) pushes nothing, preserving any outer hint."""
+
+    def __init__(self, platform):
+        self.platform = platform
+
+    def __enter__(self):
+        if self.platform is not None:
+            _DISPATCH_PLATFORM.stack.append(self.platform)
+        return self
+
+    def __exit__(self, *exc):
+        if self.platform is not None:
+            _DISPATCH_PLATFORM.stack.pop()
+        return False
+
+
 def _profiler_mod():
     """The profiler module iff it is loaded AND running (dispatch stays
     hook-free otherwise — same contract as the reference engine checking
@@ -91,10 +162,11 @@ def apply_op(fun: Callable, *nd_args, name: str = ""):
         import time
 
         t0 = time.perf_counter()
-    if recording:
-        outs, vjp = jax.vjp(fun, *raws)
-    else:
-        outs = fun(*raws)
+    with dispatch_platform(platform_of_raws(raws)):
+        if recording:
+            outs, vjp = jax.vjp(fun, *raws)
+        else:
+            outs = fun(*raws)
     from .. import engine as _engine
 
     if _engine.is_naive():

@@ -260,6 +260,52 @@ def _commit_state(state, new_raws):
         h._data = r
 
 
+def _fused_param_updates(optzr, mp_flags, w_raws, m_raws, g_raws, s_raws,
+                         lr_v, wd_v, t_v):
+    """One traced optimizer step across all params — the shared body of
+    the Trainer's fused multi-tensor update and FusedTrainStep's scan
+    (one contract, two dispatch shapes).  ``m_raws`` holds ONLY the
+    multi-precision masters, keyed by position among mp params — never
+    an alias of a donated weight buffer.  ``t_v`` may be per-param ints
+    or a traced int vector.  Returns (new_w, new_m, new_s) tuples."""
+    import numpy as _np
+
+    new_w, new_m, new_s = [], [], []
+    mi = 0
+    for j in range(len(mp_flags)):
+        if mp_flags[j]:
+            nw, ns = optzr._step(m_raws[mi],
+                                 g_raws[j].astype(_np.float32),
+                                 s_raws[j], lr_v[j], wd_v[j], t_v[j])
+            mi += 1
+            new_m.append(nw)
+            new_w.append(nw.astype(w_raws[j].dtype))
+        else:
+            nw, ns = optzr._step(w_raws[j], g_raws[j], s_raws[j],
+                                 lr_v[j], wd_v[j], t_v[j])
+            new_w.append(nw)
+        new_s.append(ns)
+    return tuple(new_w), tuple(new_m), tuple(new_s)
+
+
+def _commit_param_updates(trainer, live, mp_flags, masters, new_w, new_m,
+                          new_s):
+    """Write a fused update's results back into the trainer's params,
+    masters and optimizer state holders (shared by Trainer._update and
+    FusedTrainStep)."""
+    mi = 0
+    for j, i in enumerate(live):
+        param = trainer._params[i]
+        param.data()._data = new_w[j]
+        if mp_flags[j]:
+            masters[j]._data = new_m[mi]
+            mi += 1
+            sub_state = trainer._states[i][1]
+        else:
+            sub_state = trainer._states[i]
+        _commit_state(sub_state, new_s[j])
+
+
 register = Optimizer.register
 create = Optimizer.create_optimizer
 

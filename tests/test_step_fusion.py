@@ -1,0 +1,240 @@
+"""FusedTrainStep: K device-side optimizer steps == K eager Trainer steps.
+
+The fused program (gluon/step_fusion.py) must be a pure dispatch
+optimization — same parameters, same optimizer state, same aux (BN
+running stats), same per-step losses as the eager
+record/backward/step loop it replaces (reference protocol:
+python/mxnet/gluon/trainer.py:? Trainer.step per-batch semantics).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.base import MXNetError
+
+K = 4
+BATCH = 8
+
+
+def _mlp(bn=False, dropout=0.0):
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(16, activation="relu"))
+    if bn:
+        net.add(gluon.nn.BatchNorm())
+    if dropout:
+        net.add(gluon.nn.Dropout(dropout))
+    net.add(gluon.nn.Dense(4))
+    net.initialize(mx.init.Xavier())
+    net(nd.ones((1, 8)))  # resolve deferred shapes
+    return net
+
+
+def _data(k=K, seed=0):
+    rng = np.random.RandomState(seed)
+    xs = nd.array(rng.randn(k, BATCH, 8).astype(np.float32))
+    ys = nd.array(rng.randint(0, 4, (k, BATCH)))
+    return xs, ys
+
+
+def _eager_steps(net, trainer, loss_fn, xs, ys):
+    losses = []
+    for i in range(xs.shape[0]):
+        x, y = xs[i], ys[i]
+        with autograd.record():
+            loss = loss_fn(net(x), y)
+        loss.backward()
+        trainer.step(BATCH)
+        losses.append(float(loss.sum().asnumpy()))
+    return losses
+
+
+def _fused(net, trainer, loss_fn, k=K, batch_size=BATCH, stacked=True):
+    return gluon.FusedTrainStep(
+        net, trainer,
+        lambda n, x, y: loss_fn(n(x), y),
+        steps_per_execution=k, batch_size=batch_size,
+        stacked_inputs=stacked)
+
+
+def _params_of(net):
+    # global auto-naming differs between net instances (dense0 vs dense2):
+    # compare positionally, collect_params() preserves creation order
+    return [(name, p.data().asnumpy().copy())
+            for name, p in net.collect_params().items()]
+
+
+def _assert_tree_close(a, b, rtol=2e-5, atol=2e-6):
+    assert len(a) == len(b)
+    for (name, va), (_, vb) in zip(a, b):
+        np.testing.assert_allclose(va, vb, rtol=rtol, atol=atol,
+                                   err_msg=name)
+
+
+@pytest.mark.parametrize("optim,kw,hybridize", [
+    ("sgd", {"learning_rate": 0.1, "momentum": 0.9}, False),
+    ("adam", {"learning_rate": 1e-3}, False),
+    ("sgd", {"learning_rate": 0.1, "momentum": 0.9}, True),
+])
+def test_fused_matches_eager(optim, kw, hybridize):
+    mx.random.seed(7)
+    net_a = _mlp()
+    net_b = _mlp()
+    if hybridize:
+        # the bench shape: CachedOp jit inlines inside the fused program
+        net_a.hybridize(static_alloc=True)
+        net_b.hybridize(static_alloc=True)
+    # identical init
+    for (_, pa), (_, pb) in zip(net_a.collect_params().items(),
+                                net_b.collect_params().items()):
+        pb.set_data(pa.data().copy())
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    tr_a = gluon.Trainer(net_a.collect_params(), optim, dict(kw))
+    tr_b = gluon.Trainer(net_b.collect_params(), optim, dict(kw))
+    xs, ys = _data()
+
+    eager_losses = _eager_steps(net_a, tr_a, loss_fn, xs, ys)
+    fused_losses = _fused(net_b, tr_b, loss_fn)(xs, ys).asnumpy()
+
+    np.testing.assert_allclose(fused_losses, eager_losses,
+                               rtol=2e-5, atol=2e-6)
+    _assert_tree_close(_params_of(net_a), _params_of(net_b))
+    # optimizer state advanced identically (momenta / m,v)
+    import mxnet_tpu.optimizer as opt
+
+    for sa_state, sb_state in zip(tr_a._states, tr_b._states):
+        if sa_state is None:
+            assert sb_state is None
+            continue
+        sa = opt._flatten_state(sa_state)
+        sb = opt._flatten_state(sb_state)
+        for ra, rb in zip(sa, sb):
+            np.testing.assert_allclose(ra.asnumpy(), rb.asnumpy(),
+                                       rtol=2e-5, atol=2e-6)
+    # update counts advanced by K on both paths
+    assert tr_b._optimizer._index_update_count == \
+        tr_a._optimizer._index_update_count
+
+
+def test_bn_aux_threads_through_scan():
+    mx.random.seed(3)
+    net_a = _mlp(bn=True)
+    net_b = _mlp(bn=True)
+    for (_, pa), (_, pb) in zip(net_a.collect_params().items(),
+                                net_b.collect_params().items()):
+        pb.set_data(pa.data().copy())
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    tr_a = gluon.Trainer(net_a.collect_params(), "sgd",
+                         {"learning_rate": 0.05})
+    tr_b = gluon.Trainer(net_b.collect_params(), "sgd",
+                         {"learning_rate": 0.05})
+    xs, ys = _data(seed=1)
+    _eager_steps(net_a, tr_a, loss_fn, xs, ys)
+    _fused(net_b, tr_b, loss_fn)(xs, ys)
+    # running_mean/var are grad_req='null' aux params: the fused path
+    # must advance them through the scan carry exactly K times
+    _assert_tree_close(_params_of(net_a), _params_of(net_b),
+                       rtol=5e-5, atol=5e-6)
+
+
+def test_constant_batch_broadcasts():
+    """A plain (batch, ...) input is reused by every inner step (the
+    synthetic-bench shape); equivalent to stacking it K times."""
+    mx.random.seed(5)
+    net_a = _mlp()
+    net_b = _mlp()
+    for (_, pa), (_, pb) in zip(net_a.collect_params().items(),
+                                net_b.collect_params().items()):
+        pb.set_data(pa.data().copy())
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    tr_a = gluon.Trainer(net_a.collect_params(), "sgd",
+                         {"learning_rate": 0.1, "momentum": 0.9})
+    tr_b = gluon.Trainer(net_b.collect_params(), "sgd",
+                         {"learning_rate": 0.1, "momentum": 0.9})
+    xs, ys = _data(seed=2)
+    x0 = xs[0]
+    y0 = ys[0]
+    stacked_x = nd.array(np.repeat(x0.asnumpy()[None], K, axis=0))
+    stacked_y = nd.array(np.repeat(y0.asnumpy()[None], K, axis=0))
+    la = _fused(net_a, tr_a, loss_fn)(stacked_x, stacked_y).asnumpy()
+    lb = _fused(net_b, tr_b, loss_fn, stacked=False)(x0, y0).asnumpy()
+    np.testing.assert_allclose(la, lb, rtol=2e-5, atol=2e-6)
+    _assert_tree_close(_params_of(net_a), _params_of(net_b))
+
+
+def test_multi_precision_bf16():
+    """bf16 weights + f32 masters: the fused path must update the master
+    and write back a bf16 copy, matching the eager mp path."""
+    mx.random.seed(11)
+    net_a = _mlp()
+    net_b = _mlp()
+    for (_, pa), (_, pb) in zip(net_a.collect_params().items(),
+                                net_b.collect_params().items()):
+        pb.set_data(pa.data().copy())
+    net_a.cast("bfloat16")
+    net_b.cast("bfloat16")
+    kw = {"learning_rate": 0.05, "momentum": 0.9,
+          "multi_precision": True}
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    tr_a = gluon.Trainer(net_a.collect_params(), "sgd", dict(kw))
+    tr_b = gluon.Trainer(net_b.collect_params(), "sgd", dict(kw))
+    rng = np.random.RandomState(4)
+    xs = nd.array(rng.randn(K, BATCH, 8).astype(np.float32),
+                  dtype="bfloat16")
+    ys = nd.array(rng.randint(0, 4, (K, BATCH)))
+    _eager_steps(net_a, tr_a, loss_fn, xs, ys)
+    _fused(net_b, tr_b, loss_fn)(xs, ys)
+    _assert_tree_close(_params_of(net_a), _params_of(net_b),
+                       rtol=2e-2, atol=2e-3)  # bf16 storage
+
+
+def test_dropout_fresh_key_per_inner_step():
+    mx.random.seed(13)
+    net = _mlp(dropout=0.5)
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.0})  # lr 0: only masks vary
+    xs, ys = _data(seed=6)
+    x0, y0 = xs[0], ys[0]
+    losses = _fused(net, tr, loss_fn, k=8, stacked=False)(x0, y0).asnumpy()
+    # same data + frozen weights: loss differences can only come from
+    # per-step dropout masks — a replayed mask would repeat values
+    assert len(np.unique(np.round(losses, 6))) > 1
+
+
+def test_first_call_failure_restores_state():
+    """A failure during the validated first execution must leave params,
+    optimizer state and update counts pristine for the eager fallback."""
+    mx.random.seed(17)
+    net = _mlp()
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.1, "momentum": 0.9})
+    xs, ys = _data(seed=9)
+    before = _params_of(net)
+    counts_before = dict(tr._optimizer._index_update_count)
+
+    def bad_loss(n, x, y):
+        raise ValueError("injected trace failure")
+
+    fstep = gluon.FusedTrainStep(net, tr, bad_loss,
+                                 steps_per_execution=K,
+                                 batch_size=BATCH, stacked_inputs=True)
+    with pytest.raises(ValueError):
+        fstep(xs, ys)
+    _assert_tree_close(before, _params_of(net), rtol=0, atol=0)
+    assert dict(tr._optimizer._index_update_count) == counts_before
+    # eager path still trains from the pristine state
+    losses = _eager_steps(net, tr, loss_fn, xs, ys)
+    assert losses[-1] < losses[0] * 1.5  # sane, finite
+
+
+def test_update_on_kvstore_rejected():
+    net = _mlp()
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.1})
+    tr._kv_initialized = True
+    tr._update_on_kvstore = True
+    with pytest.raises(MXNetError):
+        gluon.FusedTrainStep(net, tr, lambda n, x, y: n(x),
+                             steps_per_execution=2, batch_size=BATCH)

@@ -22,6 +22,22 @@ from mxnet_tpu.test_utils import check_consistency
 
 from test_tpu_parity import EPS_MXU_IN, MXU_ATOL_SAFETY, MXU_RTOL
 
+# --- TPU transcendental / approximate-division tier (r5 on-chip triage) -----
+# XLA:TPU lowers tanh/log and reciprocal/rsqrt to polynomial/Newton
+# approximations on the VPU; the CPU oracle uses correctly-rounded libm.
+# Measured on the real chip (first 263-case run, 2026-08-02): tanh
+# FORWARD rel err ≤1.9e-5 (csr_unary_tanh); vjp chains amplify it —
+# (1−tanh²) cancellation and cot/x reciprocal reach rel 3.8e-4 / abs
+# 2.0e-4 (backward-tanh, backward-log at BPOS's smallest x), second
+# derivatives similar (d2_tanh 3.2e-4 rel); Adam's m̂/(√v̂+ε) chain puts
+# ~3e-4 of lr-scale error on one update (abs 1.5e-5 at lr 0.05, 3.6e-5
+# after five steps).  Bounds below = measured × ~4 safety; a
+# wrong-formula bug is O(0.1+) and still fails by orders of magnitude.
+TPU_TRANSC_FWD = dict(rtol=1e-4, atol=1e-5)
+TPU_TRANSC_BWD = dict(rtol=1.5e-3, atol=8e-4)
+TPU_APPROX_UPDATE_ATOL = 6e-5      # one/two optimizer update steps
+TPU_APPROX_UPDATE_ATOL_T5 = 1.5e-4  # five chained update steps
+
 R = np.random.RandomState(123)
 
 CASES = []
@@ -77,10 +93,14 @@ def _opt_fn(create_name, kwargs, mp=False, steps=2):
     return fn
 
 
+_OPT_TOL = {  # rsqrt-chain optimizers carry the approximate-division tier
+    "adam": dict(rtol=2e-5, atol=TPU_APPROX_UPDATE_ATOL),
+    "adamw": dict(rtol=2e-5, atol=TPU_APPROX_UPDATE_ATOL),
+}
 for _name, _kw in OPTIMIZERS:
     _create = _kw.pop("_create", _name)
     case("optimizer", _name, _opt_fn(_create, dict(_kw)), W, G,
-         rtol=2e-5, atol=2e-6)
+         **_OPT_TOL.get(_name, dict(rtol=2e-5, atol=2e-6)))
 # multi-precision: bf16 weights, f32 master + state — result rounds to
 # bf16, so the bound is one bf16 ulp of the weight scale
 for _name in ("sgd", "adam", "lamb"):
@@ -180,7 +200,8 @@ case("sparse", "rs_unary_square",
      lambda a: nd.square(a.tostype("row_sparse")).tostype("default"),
      DENSE)
 case("sparse", "csr_unary_tanh",
-     lambda a: nd.tanh(a.tostype("csr")).tostype("default"), DENSE)
+     lambda a: nd.tanh(a.tostype("csr")).tostype("default"), DENSE,
+     **TPU_TRANSC_FWD)
 case("sparse", "rs_to_csr_cast",
      lambda a: a.tostype("row_sparse").tostype("csr").tostype(
          "default"), DENSE)
@@ -309,7 +330,7 @@ def _grad2_square_exp(x):
     return x.grad
 
 
-case("higher_grad", "d2_tanh", _grad2_tanh, HX, rtol=5e-5, atol=5e-6)
+case("higher_grad", "d2_tanh", _grad2_tanh, HX, **TPU_TRANSC_BWD)
 case("higher_grad", "d2_exp", _grad2_square_exp, HX, rtol=5e-5,
      atol=5e-6)
 
@@ -362,8 +383,10 @@ _BWD_UNARY = [
      nd.sqrt(nd.mean(nd.square(x - nd.mean(x, axis=-1, keepdims=True)),
                      axis=-1, keepdims=True) + 1e-5), BX),
 ]
+_BWD_TOL = {"tanh": TPU_TRANSC_BWD, "log": TPU_TRANSC_BWD}
 for _name, _op, _inp in _BWD_UNARY:
-    case("backward", _name, _grad_of(_op), _inp, rtol=1e-4, atol=1e-5)
+    case("backward", _name, _grad_of(_op), _inp,
+         **_BWD_TOL.get(_name, dict(rtol=1e-4, atol=1e-5)))
 
 case("backward", "dot", _grad_of(lambda a, b: nd.dot(a, b), 2), BA, BB,
      mxu=True)
@@ -466,10 +489,12 @@ for _name in ("sgd", "adam"):
          _opt_fn(_name, dict(clip_gradient=0.05, rescale_grad=0.5,
                              **(dict(momentum=0.9)
                                 if _name == "sgd" else {}))),
-         W, G, rtol=2e-5, atol=2e-6)
+         W, G, rtol=2e-5,
+         atol=TPU_APPROX_UPDATE_ATOL if _name == "adam" else 2e-6)
 # lr scheduler interaction: t-dependent steps (bias correction at t>1)
 case("optimizer", "adam_t5",
-     _opt_fn("adam", dict(), steps=5), W, G, rtol=2e-5, atol=2e-6)
+     _opt_fn("adam", dict(), steps=5), W, G, rtol=2e-5,
+     atol=TPU_APPROX_UPDATE_ATOL_T5)
 case("optimizer", "ftrl_t5",
      _opt_fn("ftrl", dict(), steps=5), W, G, rtol=2e-5, atol=2e-6)
 
