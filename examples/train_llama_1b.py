@@ -16,6 +16,13 @@ activations — ~9 GiB of the 16 GiB HBM.
 
 Run: PYTHONPATH=/root/repo python examples/train_llama_1b.py
 (env: STEPS=300 BATCH=4 SEQ=2048 LOG_EVERY=20)
+
+Fit note (2026-08-02): a tunnel-backend update shrank the largest
+single-program training footprint that executes — the 1.17B default
+that trained in r3 now OOMs (r3 code verbatim reproduces it; PERF_NOTES
+"cont. 4").  Configs measured green on the current backend:
+``LAYERS=8`` (0.60B, 27.3k tok/s) and ``LAYERS=12 BATCH=2`` (0.83B,
+18.4k tok/s).
 """
 import json
 import os

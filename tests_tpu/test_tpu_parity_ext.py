@@ -572,10 +572,19 @@ def _flash_grad(causal):
     return fn
 
 
+# flash BACKWARD chain tier (first on-chip run, 2026-08-02): the dq/dkv
+# kernels chain TWO bf16 MXU contractions through a recomputed
+# p = exp(s − lse) and the (dp − δ) cancellation, so worst-case rounding
+# stacks deeper than the single-contraction MXU model: measured 2/8192
+# outliers at ≤3.03% rel / 0.059 abs against the rms-derived 0.0237
+# (99.98% of elements inside the plain MXU bound).  Bound = measured
+# × ~2: rtol 2⁻⁴; atol 0.1 ≈ 4× this pinned input's rms-derived scale
+# (the mxu branch takes max(case atol, rms-derived)).  A formula bug is
+# O(1)+ on most elements and still fails both.
 case("backward", "flash_attn", _flash_grad(False), FA_Q, FA_Q, FA_Q,
-     mxu=True)
+     mxu=True, rtol=2.0 ** -4, atol=0.1)
 case("backward", "flash_attn_causal", _flash_grad(True), FA_Q, FA_Q,
-     FA_Q, mxu=True)
+     FA_Q, mxu=True, rtol=2.0 ** -4, atol=0.1)
 
 # control flow extras
 case("control_flow", "cond_else",
