@@ -34,12 +34,21 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 
 def _grad_matmul_case(use_custom):
-    """fn(a, b) -> loss + sum-of-grads for a 2048 matmul, either through
-    the framework's dtype-preserving custom vjp (bf16 backward dots) or
-    the naive dot(pet=f32).astype(bf16) pattern whose cotangents force
-    f32xf32 backward dots (the r4 _mxu_matmul rationale).  FLOPs per
-    call = 3x the forward (fwd + two bwd contractions); the loss value
-    is folded into the digest so DCE cannot drop the forward dot."""
+    """fn(a, b, g) -> loss + sum-of-grads for a 2048 matmul, either
+    through the framework's dtype-preserving custom vjp (bf16 backward
+    dots) or the naive dot(pet=f32).astype(bf16) pattern whose
+    cotangents force f32xf32 backward dots (the r4 _mxu_matmul
+    rationale).  FLOPs per call = 3x the forward (fwd + two bwd
+    contractions).
+
+    The r5 first cut of this row priced at 281 TF/s > 197 peak (caught
+    by its own >peak audit rule): its loss was ``sum(y)``, so the
+    cotangent was literally ones and XLA collapsed BOTH backward
+    contractions (``ones @ b^T``/``a^T @ ones``) into reductions —
+    2/3 of the assumed FLOPs never ran.  Now the loss is weighted by a
+    full-rank random matrix ``g`` (cotangent = g, incompressible) and
+    the grads pass an optimization_barrier before the digest sums, so
+    ``sum(dy @ b^T)`` can't be rewritten as ``sum(dy) . sum(b)``."""
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -56,20 +65,22 @@ def _grad_matmul_case(use_custom):
 
     fwd = custom_fwd if use_custom else pet_fwd
 
-    def fn(a, b):
+    def fn(a, b, g):
         from mxnet_tpu.ops.registry import apply_op
 
-        def f(ar, br):
+        def f(ar, br, gr):
             def loss(ar_, br_):
                 y = fwd(ar_, br_)
-                return jnp.sum(y.astype(jnp.float32))
+                return jnp.sum(y.astype(jnp.float32) *
+                               gr.astype(jnp.float32))
 
             lv, (da, db) = jax.value_and_grad(
                 loss, argnums=(0, 1))(ar, br)
+            da, db = lax.optimization_barrier((da, db))
             return lv + jnp.sum(da.astype(jnp.float32)) + \
                 jnp.sum(db.astype(jnp.float32))
 
-        return apply_op(f, a, b, name="matmul_fwdbwd")
+        return apply_op(f, a, b, g, name="matmul_fwdbwd")
 
     return fn
 
@@ -91,6 +102,7 @@ def _cases(nd, mxr):
     w3 = U(C, C, 3, 3, dtype=bf16)
     w1 = U(C, C, 1, 1, dtype=bf16)
     a_mm, b_mm = U(M, K, dtype=bf16), U(K, N, dtype=bf16)
+    g_mm = U(M, N, dtype=bf16)  # full-rank cotangent for the fwdbwd A/B
     a32, b32 = U(M, K), U(K, N)
     big = U(64 * 1024 * 1024 // 4)  # 64 MB f32 vector
     x_bn, g = U(B, C, H, W), U(C)
@@ -140,10 +152,10 @@ def _cases(nd, mxr):
         # whose backward runs f32xf32 — the r4 fix's measured win
         ("matmul_fwdbwd_2048_bf16_customvjp",
          _grad_matmul_case(use_custom=True),
-         [a_mm, b_mm], 3 * 2 * M * N * K, 0),
+         [a_mm, b_mm, g_mm], 3 * 2 * M * N * K, 0),
         ("matmul_fwdbwd_2048_bf16_petref",
          _grad_matmul_case(use_custom=False),
-         [a_mm, b_mm], 3 * 2 * M * N * K, 0),
+         [a_mm, b_mm, g_mm], 3 * 2 * M * N * K, 0),
         ("quantized_matmul_2048_int8",
          lambda qa, qb, a1, a2, a3, a4: nd.quantized_fully_connected(
              qa, qb, a1, a2, a3, a4, num_hidden=N, no_bias=True,

@@ -35,8 +35,14 @@ TARGET_OPS = {
 # chip showed that doubled the bandwidth of all elementwise fusions AND
 # all layout-change copies (27% of ResNet step time was f32 activation
 # copies).  With bf16 flowing through, stats stay f32 inside the op.
+# softmax_cross_entropy is deliberately NOT pinned: like the norm
+# layers above, its body computes in f32 internally (logsumexp + an
+# iota-one-hot backward, nn_ops._softmax_ce_sum) and writes the
+# cotangent in the logits dtype — pre-casting a (rows, vocab) logits
+# tensor to f32 cost BERT-base ~6 GB/step of pure HBM traffic
+# (tools/bytes_breakdown.py, PERF_NOTES r5 cont. 6).
 FP32_OPS = {
-    "softmax", "log_softmax", "softmax_cross_entropy", "norm", "sum",
+    "softmax", "log_softmax", "norm", "sum",
     "mean", "l2_normalization", "exp", "log", "rnn_lstm", "rnn_gru",
 }
 

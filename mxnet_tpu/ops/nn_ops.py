@@ -115,13 +115,55 @@ def log_softmax(data, axis=-1, temperature=None, **kwargs):
 _export(log_softmax)
 
 
+@jax.custom_vjp
+def _softmax_ce_sum(x, lab):
+    """sum of -log_softmax(x)[lab] over all rows; f32 internal math,
+    custom vjp so low-precision logits never materialize in f32.
+
+    Without this, a bf16 MLM head under AMP pays ~6 GB/step of HBM at
+    BERT-base geometry (f32[8192,30522] logits written by the pre-cast,
+    re-read by log_softmax, a 1.5 GB layout copy, and a 2 GB f32
+    softmax-minus-onehot backward — tools/bytes_breakdown.py r5).  Here
+    the forward is one fused pass (read bf16 logits, upcast in
+    registers, write f32[rows] logsumexp) and the backward ONE fused
+    pass that rebuilds softmax from the saved logsumexp and subtracts
+    an iota-derived one-hot in registers, writing the cotangent
+    directly in the logits dtype — the same dtype-preserving contract
+    as ``_mxu_matmul``."""
+    return _softmax_ce_sum_fwd(x, lab)[0]
+
+
+def _softmax_ce_sum_fwd(x, lab):
+    # the f32 cast is consumed ONLY by the logsumexp reduce so XLA
+    # fuses it (in-registers upcast); picked gathers from the RAW
+    # tensor — casting first gave the cast a second consumer and XLA
+    # materialized a 1.5 GB f32 copy of the logits at BERT geometry
+    lse = jax.scipy.special.logsumexp(x.astype(np.float32), axis=-1)
+    picked = jnp.take_along_axis(
+        x, lab[..., None], axis=-1)[..., 0].astype(np.float32)
+    return jnp.sum(lse - picked), (x, lse, lab)
+
+
+def _softmax_ce_sum_bwd(res, g):
+    x, lse, lab = res
+    p = jnp.exp(x.astype(np.float32) - lse[..., None])
+    iota = lax.broadcasted_iota(np.int32, x.shape, x.ndim - 1)
+    onehot = (iota == lab[..., None]).astype(np.float32)
+    dx = (g * (p - onehot)).astype(x.dtype)
+    dlab = np.zeros(lab.shape, dtype=jax.dtypes.float0)
+    return dx, dlab
+
+
+_softmax_ce_sum.defvjp(_softmax_ce_sum_fwd, _softmax_ce_sum_bwd)
+
+
 def softmax_cross_entropy(data, label, **kwargs):
-    """Reference ``softmax_cross_entropy`` (fused logits+label CE, summed)."""
+    """Reference ``softmax_cross_entropy`` (fused logits+label CE,
+    summed).  Computes internally in float32 regardless of the logits
+    dtype (so AMP does NOT pre-cast its inputs — see amp.FP32_OPS),
+    with a dtype-preserving backward (:func:`_softmax_ce_sum`)."""
     def f(logits, lab):
-        ls = jax.nn.log_softmax(logits, axis=-1)
-        picked = jnp.take_along_axis(
-            ls, lab.astype(np.int32)[..., None], axis=-1)
-        return -jnp.sum(picked)
+        return _softmax_ce_sum(logits, lab.astype(np.int32))
 
     return apply_op(f, data, label, name="softmax_cross_entropy")
 

@@ -47,6 +47,24 @@ def test_amp_training_step_bf16():
     assert losses[-1] < losses[0]
 
 
+def test_amp_softmax_ce_not_precast():
+    """softmax_cross_entropy left OUT of FP32_OPS: under AMP the bf16
+    logits enter the op uncast (its body computes in f32 internally)
+    and the cotangent comes back bf16 — pre-casting a (rows, vocab)
+    logits tensor to f32 cost BERT ~6 GB/step (PERF_NOTES r5 cont. 6)."""
+    assert "softmax_cross_entropy" not in amp.FP32_OPS
+    amp.init("bfloat16")
+    x = mx.random.uniform(shape=(4, 7)).astype("bfloat16")
+    y = nd.array(np.array([1, 2, 0, 6]))
+    x.attach_grad()
+    with autograd.record():
+        loss = nd.softmax_cross_entropy(x, y)
+    loss.backward()
+    assert loss.dtype == np.float32  # f32 internal accumulation
+    assert x.grad.dtype.name == "bfloat16"
+    assert np.isfinite(float(loss.asscalar()))
+
+
 def test_loss_scaler_dynamics():
     s = amp.LossScaler(init_scale=16, scale_factor=2, scale_window=2)
     s.update_scale(False)

@@ -163,6 +163,42 @@ def test_softmax_family_grads():
         [x], eps=1e-4, rtol=2e-2, atol=1e-4)
 
 
+def test_softmax_cross_entropy_fused():
+    """The logsumexp-form CE with dtype-preserving custom vjp
+    (nn_ops._softmax_ce_sum): forward equals -sum(log_softmax picked),
+    backward equals softmax - onehot, and a bf16 logits tensor gets a
+    bf16 cotangent (the bandwidth contract PERF_NOTES r5 cont. 6 relies
+    on — no f32 materialization of (rows, vocab))."""
+    from mxnet_tpu import autograd
+
+    x = _rand((6, 11), -3, 3).astype(onp.float32)
+    lab = onp.array([0, 3, 10, 5, 5, 1])
+    e = onp.exp(x - x.max(-1, keepdims=True))
+    sm = e / e.sum(-1, keepdims=True)
+    want = -onp.sum(onp.log(sm)[onp.arange(6), lab])
+    got = nd.softmax_cross_entropy(nd.array(x), nd.array(lab))
+    onp.testing.assert_allclose(float(got.asscalar()), want, rtol=1e-5)
+
+    xv = nd.array(x)
+    xv.attach_grad()
+    with autograd.record():
+        loss = nd.softmax_cross_entropy(xv, nd.array(lab))
+    loss.backward()
+    onehot = onp.eye(11, dtype=onp.float32)[lab]
+    onp.testing.assert_allclose(xv.grad.asnumpy(), sm - onehot,
+                                rtol=1e-5, atol=1e-6)
+
+    xb = nd.array(x).astype("bfloat16")
+    xb.attach_grad()
+    with autograd.record():
+        loss = nd.softmax_cross_entropy(xb, nd.array(lab))
+    loss.backward()
+    assert xb.grad.dtype.name == "bfloat16"
+    onp.testing.assert_allclose(
+        xb.grad.asnumpy().astype(onp.float32), sm - onehot,
+        rtol=0.1, atol=0.02)  # bf16 input + bf16 cotangent rounding
+
+
 def test_norm_layers_grads():
     x = _rand((2, 3, 4), -1, 1)
     g = _rand((3,), 0.5, 1.5)

@@ -297,11 +297,15 @@ def audit_bert_base():
     amp.init(target_dtype="bfloat16")
 
     def loss_raw(outs, label):
-        mlm = outs[-1].astype(jnp.float32).reshape((-1, vocab))
-        logp = jax.nn.log_softmax(mlm, axis=-1)
-        ce = -jnp.take_along_axis(logp, label.reshape((-1,))[:, None],
-                                  axis=-1)
-        return ce.sum() / (batch * seq)
+        # the SAME fused CE the bench's _MLMLoss dispatches
+        # (nn_ops.softmax_cross_entropy): f32 internal math, no f32
+        # materialization of the (rows, vocab) logits
+        from mxnet_tpu.ops.nn_ops import _softmax_ce_sum
+
+        # no flatten: (b, s, vocab) direct — the reshape forced a
+        # layout copy of the logits (bytes_breakdown r5)
+        return _softmax_ce_sum(outs[-1],
+                               label.astype(jnp.int32)) / (batch * seq)
 
     opt = optimizer.Adam(learning_rate=1e-4)
     key = jax.random.PRNGKey(0)
