@@ -330,8 +330,10 @@ class FusedTrainStep:
             if snapshot is not None:
                 # force TRUE completion before declaring the program
                 # safe: dispatch is async and a runtime failure (OOM)
-                # surfaces only at a blocking fetch
-                np.asarray(losses)
+                # surfaces only at a blocking wait.  block_until_ready
+                # waits WITHOUT copying the buffer to host (np.asarray
+                # would add a device->host transfer to the stall).
+                losses.block_until_ready()  # mxlint: allow=T1
                 self._validated_sigs.add(sig)
             return NDArray(losses)
         except Exception:

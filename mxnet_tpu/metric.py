@@ -3,9 +3,13 @@
 Reference: ``python/mxnet/metric.py:?`` — ``EvalMetric`` registry with
 ``update(labels, preds)`` / ``get()`` / ``reset()``; the standard family
 below; ``CompositeEvalMetric`` aggregates; ``create()`` builds by name.
-Accumulation happens on host in float64 (metrics are tiny; keeping them off
-the device avoids blocking the dispatch queue — same reason the reference
-computes metrics outside the engine's hot path).
+Accumulation for the per-batch hot metrics (Accuracy/TopKAccuracy/Loss) is
+DEFERRED: ``update`` reduces on device (argmax/compare/sum are enqueued
+async on the dispatch stream, pulling only a running scalar — never the
+full (N, C) logits) and the single blocking host sync happens at ``get``.
+The reference instead copied every prediction to host per batch, which
+stalls the dispatch queue once per update.  Host-rare metrics (F1, MCC,
+Perplexity, ...) still accumulate on host in float64.
 """
 from __future__ import annotations
 
@@ -101,8 +105,24 @@ class EvalMetric:
     def reset(self):
         self.num_inst = 0
         self.sum_metric = 0.0
+        self._dev_sum = None
+
+    def _drain(self):
+        """Fold deferred device-side accumulation into ``sum_metric``.
+        One host sync drains ANY number of updates; the per-update path
+        never blocks the dispatch queue."""
+        if getattr(self, "_dev_sum", None) is not None:
+            self.sum_metric += float(self._dev_sum.asnumpy())  # mxlint: allow=T1
+            self._dev_sum = None
+
+    def _accum_device(self, scalar):
+        """Add an (async, still-on-device) scalar NDArray to the running
+        device accumulator."""
+        self._dev_sum = scalar if self._dev_sum is None \
+            else self._dev_sum + scalar
 
     def get(self):
+        self._drain()
         if self.num_inst == 0:
             return (self.name, float("nan"))
         return (self.name, self.sum_metric / self.num_inst)
@@ -156,6 +176,16 @@ class Accuracy(EvalMetric):
     def update(self, labels, preds):
         labels, preds = check_label_shapes(labels, preds)
         for label, pred in zip(labels, preds):
+            if isinstance(label, NDArray) and isinstance(pred, NDArray):
+                # device path: argmax + compare + reduce stay on device;
+                # only a running scalar survives, synced once at get()
+                if pred.ndim > label.ndim:
+                    pred = pred.argmax(axis=self.axis)
+                correct = (pred.astype(_np.int32).reshape(-1) ==
+                           label.astype(_np.int32).reshape(-1))
+                self._accum_device(correct.astype(_np.float32).sum())
+                self.num_inst += label.size
+                continue
             label = _to_np(label)
             pred = _to_np(pred)
             if pred.ndim > label.ndim:
@@ -179,6 +209,17 @@ class TopKAccuracy(EvalMetric):
     def update(self, labels, preds):
         labels, preds = check_label_shapes(labels, preds)
         for label, pred in zip(labels, preds):
+            if isinstance(label, NDArray) and isinstance(pred, NDArray):
+                # device path: top-k runs on device and only the (N, k)
+                # indices feed the running scalar — the full logits are
+                # never pulled to host
+                top = pred.topk(axis=-1, k=self.top_k)
+                hit = (top.astype(_np.int32).reshape(label.size, -1) ==
+                       label.astype(_np.int32).reshape(-1, 1))
+                self._accum_device(
+                    hit.max(axis=1).astype(_np.float32).sum())
+                self.num_inst += label.size
+                continue
             label = _to_np(label).astype(_np.int32).ravel()
             pred = _to_np(pred)
             top = _np.argpartition(pred, -self.top_k,
@@ -376,7 +417,12 @@ class Loss(EvalMetric):
         if isinstance(preds, NDArray):
             preds = [preds]
         for pred in preds:
-            loss = _to_np(pred)
+            if isinstance(pred, NDArray):
+                # device path: defer the reduction, sync once at get()
+                self._accum_device(pred.astype(_np.float32).sum())
+                self.num_inst += pred.size
+                continue
+            loss = _np.asarray(pred)
             self.sum_metric += loss.sum()
             self.num_inst += loss.size
 

@@ -77,6 +77,8 @@ def empty(shape, ctx=None, dtype=None):
 
 def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype=None,
            **kwargs):
+    """Reference ``arange``: evenly spaced values in ``[start, stop)``,
+    each repeated ``repeat`` times."""
     import jax.numpy as jnp
 
     r = jnp.arange(start, stop, step, _resolve_dtype(dtype) or _np.float32)

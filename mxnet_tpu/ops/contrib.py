@@ -640,10 +640,12 @@ def adaptive_avg_pooling_2d(data, output_size=1, **kwargs):
            else tuple(output_size))
 
     def _avg_mat(n_in, n_out):
+        # n_in/n_out are STATIC python ints (trace-time shapes), so the
+        # int() calls below never touch a tracer
         m = np.zeros((n_out, n_in), np.float32)
         for i in range(n_out):
-            s = int(np.floor(i * n_in / n_out))
-            e = int(np.ceil((i + 1) * n_in / n_out))
+            s = int(np.floor(i * n_in / n_out))    # mxlint: allow=T1
+            e = int(np.ceil((i + 1) * n_in / n_out))  # mxlint: allow=T1
             m[i, s:e] = 1.0 / (e - s)
         return jnp.asarray(m)
 

@@ -191,5 +191,7 @@ def cond(pred, then_func, else_func, name="cond"):
         outs = lax.cond(p, _then, _else)
         outs = [_wrap(o) for o in outs]
         return outs[0] if len(outs) == 1 else outs
-    branch = then_func if bool(pred.asscalar()) else else_func
+    # eager fallback: pred is CONCRETE here (traced preds took the
+    # lax.cond path above), so this sync is the op's documented contract
+    branch = then_func if bool(pred.asscalar()) else else_func  # mxlint: allow=T1
     return branch()

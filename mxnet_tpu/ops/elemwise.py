@@ -26,8 +26,20 @@ _this = sys.modules[__name__]
 _export_fn = make_exporter(_this)
 
 
-def _export(name, fn, aliases=()):
-    _export_fn(fn, name=name, aliases=aliases)
+def _export(name, fn, aliases=(), no_grad=False):
+    _export_fn(fn, name=name, aliases=aliases, no_grad=no_grad)
+
+
+# Intentionally non-differentiable table entries: integer-valued rounding /
+# predicates / comparisons.  Registered with ``no_grad=True`` so apply_op
+# skips the vjp trace entirely (their cotangents were always zero) and
+# mxlint's T3 rule knows the missing grad path is deliberate.
+_NO_GRAD = frozenset([
+    "sign", "ceil", "floor", "rint", "round", "trunc", "fix",
+    "logical_not", "isnan", "isinf", "isfinite",
+    "equal", "not_equal", "greater", "greater_equal", "lesser",
+    "lesser_equal", "logical_and", "logical_or", "logical_xor",
+])
 
 
 def _make_unary(name, jf, aliases=()):
@@ -46,7 +58,9 @@ def _make_unary(name, jf, aliases=()):
             return _sp.dispatch_unary(name, jf, data)
         return commit_out(out, apply_op(jf, data, name=name))
 
-    _export(name, fn, aliases)
+    fn.__doc__ = (f"Elementwise ``{name}`` (one jnp call; XLA fuses chains "
+                  "of these into a single VPU kernel).")
+    _export(name, fn, aliases, no_grad=name in _NO_GRAD)
 
 
 def _make_binary(name, jf, aliases=()):
@@ -76,7 +90,9 @@ def _make_binary(name, jf, aliases=()):
             return jf(lhs, rhs)
         return commit_out(out, r)
 
-    _export(name, fn, aliases)
+    fn.__doc__ = (f"Elementwise/broadcast ``{name}`` (numpy broadcasting "
+                  "semantics — the broadcast_* aliases are the same op).")
+    _export(name, fn, aliases, no_grad=name in _NO_GRAD)
 
 
 def _gamma(x):

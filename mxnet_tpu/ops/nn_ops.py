@@ -45,6 +45,9 @@ _ACTS = {
 
 
 def activation(data, act_type="relu", **kwargs):
+    """Reference ``Activation``: apply the ``act_type`` nonlinearity
+    elementwise.
+    """
     if act_type not in _ACTS:
         raise MXNetError(f"unknown act_type {act_type!r}")
     return apply_op(_ACTS[act_type], data, name=f"activation_{act_type}")
@@ -82,6 +85,7 @@ _export(leaky_relu, aliases=("LeakyReLU",))
 
 
 def hard_sigmoid(data, alpha=0.2, beta=0.5, **kwargs):
+    """Reference ``hard_sigmoid``: ``clip(alpha * x + beta, 0, 1)``."""
     return apply_op(lambda a: jnp.clip(alpha * a + beta, 0, 1), data,
                     name="hard_sigmoid")
 
@@ -90,6 +94,7 @@ _export(hard_sigmoid)
 
 
 def softmax(data, axis=-1, temperature=None, **kwargs):
+    """Reference ``softmax`` along ``axis`` with optional ``temperature``."""
     t = temperature
 
     def f(a):
@@ -103,6 +108,8 @@ _export(softmax)
 
 
 def log_softmax(data, axis=-1, temperature=None, **kwargs):
+    """Reference ``log_softmax`` along ``axis`` with optional ``temperature``.
+    """
     t = temperature
 
     def f(a):
@@ -813,6 +820,9 @@ _export(layer_norm, aliases=("LayerNorm",))
 
 
 def group_norm(data, gamma, beta, num_groups=1, eps=1e-5, **kwargs):
+    """Reference ``GroupNorm``: normalize over channel groups, then
+    scale/shift.
+    """
     def f(x, g, b):
         n, c = x.shape[0], x.shape[1]
         xr = x.reshape((n, num_groups, c // num_groups) + x.shape[2:])
@@ -831,6 +841,9 @@ _export(group_norm, aliases=("GroupNorm",))
 
 
 def instance_norm(data, gamma, beta, eps=1e-5, **kwargs):
+    """Reference ``InstanceNorm``: per-sample spatial normalization per
+    channel.
+    """
     def f(x, g, b):
         red = tuple(range(2, x.ndim))
         y = _standardize(x, float(eps), red)
@@ -850,6 +863,7 @@ _export(instance_norm, aliases=("InstanceNorm",))
 
 
 def l2_normalization(data, eps=1e-10, mode="instance", **kwargs):
+    """Reference ``L2Normalization``: rescale to unit L2 norm per ``mode``."""
     def f(x):
         if mode == "instance":
             red = tuple(range(1, x.ndim))

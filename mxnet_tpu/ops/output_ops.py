@@ -114,6 +114,9 @@ def _regression_output(transform, grad_fn, opname):
         return apply_op(f, data, label, name=opname)
 
     op.__name__ = opname
+    op.__doc__ = (f"Reference ``{opname}``: identity-style output layer "
+                  "whose custom vjp injects the regression gradient "
+                  "``grad_fn(out, label) * grad_scale / num_output``.")
     return op
 
 
@@ -173,7 +176,17 @@ def _scalar_op(opname, fn):
         return apply_op(lambda x: fn(x, s), data, name=opname)
 
     op.__name__ = opname
+    op.__doc__ = (f"Reference ``{opname}``: array-op-scalar form emitted "
+                  "into nnvm json by the python operators.")
     return op
+
+
+# comparison / predicate scalar ops: 0/1 outputs, no useful cotangent
+_NO_GRAD_SCALAR = frozenset([
+    "_equal_scalar", "_not_equal_scalar", "_greater_scalar",
+    "_greater_equal_scalar", "_lesser_scalar", "_lesser_equal_scalar",
+    "_logical_and_scalar", "_logical_or_scalar", "_logical_xor_scalar",
+])
 
 
 _SCALAR_OPS = {
@@ -202,7 +215,8 @@ _SCALAR_OPS = {
 }
 
 for _name, _fn in _SCALAR_OPS.items():
-    _export(_scalar_op(_name, _fn), name=_name)
+    _export(_scalar_op(_name, _fn), name=_name,
+            no_grad=_name in _NO_GRAD_SCALAR)
 
 
 # --- creation ops (registry-addressable for symbolic graphs) ---------------

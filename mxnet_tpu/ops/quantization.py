@@ -246,7 +246,8 @@ def _as_float_view(q):
     return apply_op(lambda x: x.astype(jnp.float32), q, name="q2f")
 
 
-_export(quantized_pooling, aliases=("_contrib_quantized_pooling",))
+_export(quantized_pooling, aliases=("_contrib_quantized_pooling",),
+        no_grad=True)
 
 
 def quantized_flatten(data, min_data, max_data, **kwargs):

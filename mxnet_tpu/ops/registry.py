@@ -31,17 +31,42 @@ from .. import autograd as ag
 # the symbol/json export path.)
 _OPS: Dict[str, Callable] = {}
 
+# Per-op metadata keyed by EVERY registered name (canonical + aliases):
+#   no_grad   -- op is intentionally non-differentiable; apply_op skips the
+#                jax.vjp trace and wires a zero-cotangent tape node instead
+#                (the analog of the reference marking FGradient absent)
+#   canonical -- canonical op name (aliases point at the same dict)
+#   aliases   -- alias tuple of the canonical registration
+_OP_META: Dict[str, dict] = {}
 
-def defop(name: str = None, aliases=()):
+# Registrations that overwrote an existing name.  Nothing in-tree should
+# ever land here; the runtime half of mxlint's T3 rule asserts it empty
+# (the static half cannot see table-driven registration loops).
+_DUPLICATE_REGISTRATIONS = []
+
+
+def _register(name: str, fn: Callable, aliases=(), no_grad: bool = False):
+    meta = {"no_grad": bool(no_grad), "canonical": name,
+            "aliases": tuple(aliases)}
+    for n in (name,) + tuple(aliases):
+        if n in _OPS and _OPS[n] is not fn:
+            _DUPLICATE_REGISTRATIONS.append(
+                (n, _OP_META.get(n, {}).get("canonical", n), name))
+        _OPS[n] = fn
+        _OP_META[n] = meta
+    return fn
+
+
+def defop(name: str = None, aliases=(), no_grad: bool = False):
     """Decorator: register an NDArray-level op under ``name`` (+aliases).
-    Like make_exporter, registration adds unknown-attribute validation."""
+    Like make_exporter, registration adds unknown-attribute validation.
+    ``no_grad=True`` marks an intentionally non-differentiable op (integer
+    outputs, comparisons): apply_op then skips the vjp trace for it."""
 
     def deco(fn):
         opname = name or fn.__name__
         fn = _attr_validated(fn, opname)
-        _OPS[opname] = fn
-        for a in aliases:
-            _OPS[a] = fn
+        _register(opname, fn, aliases, no_grad)
         return fn
 
     return deco
@@ -53,6 +78,18 @@ def get_op(name: str):
 
 def list_ops():
     return sorted(_OPS)
+
+
+def op_meta(name: str):
+    """Registration metadata for ``name`` (canonical or alias); {} if the
+    op predates metadata or does not exist."""
+    return _OP_META.get(name, {})
+
+
+def duplicate_registrations():
+    """(name, previous_canonical, new_canonical) for every registration
+    that overwrote an existing op name.  Should always be empty."""
+    return list(_DUPLICATE_REGISTRATIONS)
 
 
 def _in_graph(x) -> bool:
@@ -140,6 +177,19 @@ def _profiler_mod():
     return prof if prof is not None and prof.is_running() else None
 
 
+_NO_META = {"no_grad": False}
+
+
+def _zero_vjp(n_inputs: int):
+    """Tape vjp for no_grad ops: all-None cotangents (autograd skips
+    accumulation for None, exactly as it does for float0)."""
+
+    def vjp(cots):
+        return (None,) * n_inputs
+
+    return vjp
+
+
 def apply_op(fun: Callable, *nd_args, name: str = ""):
     """Apply pure raw-array function ``fun`` to NDArray operands.
 
@@ -157,16 +207,18 @@ def apply_op(fun: Callable, *nd_args, name: str = ""):
     if _amp.is_active():
         raws = _amp.maybe_cast_args(name, raws)
     recording = ag.is_recording() and any(_in_graph(a) for a in nd_args)
+    no_grad_op = recording and _OP_META.get(name, _NO_META)["no_grad"]
     prof = _profiler_mod()
     if prof is not None:
         import time
 
         t0 = time.perf_counter()
     with dispatch_platform(platform_of_raws(raws)):
-        if recording:
+        if recording and not no_grad_op:
             outs, vjp = jax.vjp(fun, *raws)
         else:
             outs = fun(*raws)
+            vjp = None
     from .. import engine as _engine
 
     if _engine.is_naive():
@@ -190,6 +242,13 @@ def apply_op(fun: Callable, *nd_args, name: str = ""):
     outs_t = (outs,) if single else tuple(outs)
     nd_outs = [NDArray(o) for o in outs_t]
     if recording:
+        if vjp is None:
+            # no_grad op: outputs stay ON the tape (heads remain attached,
+            # downstream backward() still works) but the vjp trace is
+            # skipped entirely — backward sees None cotangents and skips
+            # accumulation, which is observably identical to the zero
+            # gradients these ops produced before.
+            vjp = _zero_vjp(len(nd_args))
         node = ag.Node(vjp, list(nd_args),
                        [(o.shape, o.dtype) for o in outs_t], name=name,
                        single=single, fun=fun)
@@ -289,15 +348,14 @@ def make_exporter(module):
     python/mxnet/ndarray/register.py:?)."""
     module.__all__ = getattr(module, "__all__", [])
 
-    def _export(fn, name=None, aliases=()):
+    def _export(fn, name=None, aliases=(), no_grad=False):
         name = name or fn.__name__
         fn.__name__ = name
         fn = _attr_validated(fn, name)
-        _OPS[name] = fn
+        _register(name, fn, aliases, no_grad)
         setattr(module, name, fn)
         module.__all__.append(name)
         for a in aliases:
-            _OPS[a] = fn
             setattr(module, a, fn)
             module.__all__.append(a)
         return fn

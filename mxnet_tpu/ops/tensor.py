@@ -130,6 +130,7 @@ _export(reshape, aliases=("Reshape",))
 
 
 def reshape_like(lhs, rhs, **kwargs):
+    """Reference ``reshape_like``: reshape ``lhs`` to the shape of ``rhs``."""
     tgt = rhs.shape
     return apply_op(lambda a: jnp.reshape(a, tgt), lhs, name="reshape_like")
 
@@ -147,6 +148,9 @@ _export(flatten, aliases=("Flatten",))
 
 
 def transpose(data, axes=None, **kwargs):
+    """Reference ``transpose``: permute axes (reverses them when ``axes`` is
+    None).
+    """
     if axes is not None and len(axes) == 0:
         axes = None
     return apply_op(lambda a: jnp.transpose(a, axes), data, name="transpose")
@@ -156,6 +160,7 @@ _export(transpose)
 
 
 def zeros_like(data, **kwargs):
+    """Reference ``zeros_like``: zeros with the input's shape and dtype."""
     return apply_op(jnp.zeros_like, data, name="zeros_like")
 
 
@@ -163,6 +168,7 @@ _export(zeros_like)
 
 
 def ones_like(data, **kwargs):
+    """Reference ``ones_like``: ones with the input's shape and dtype."""
     return apply_op(jnp.ones_like, data, name="ones_like")
 
 
@@ -170,6 +176,7 @@ _export(ones_like)
 
 
 def swapaxes(data, dim1=0, dim2=1, **kwargs):
+    """Reference ``SwapAxis``: exchange axes ``dim1`` and ``dim2``."""
     return apply_op(lambda a: jnp.swapaxes(a, dim1, dim2), data,
                     name="swapaxes")
 
@@ -178,6 +185,7 @@ _export(swapaxes, aliases=("SwapAxis",))
 
 
 def expand_dims(data, axis, **kwargs):
+    """Reference ``expand_dims``: insert a length-1 axis at ``axis``."""
     return apply_op(lambda a: jnp.expand_dims(a, axis), data,
                     name="expand_dims")
 
@@ -186,6 +194,7 @@ _export(expand_dims)
 
 
 def squeeze(data, axis=None, **kwargs):
+    """Reference ``squeeze``: drop length-1 axes (all, or just ``axis``)."""
     return apply_op(lambda a: jnp.squeeze(a, axis), data, name="squeeze")
 
 
@@ -193,6 +202,9 @@ _export(squeeze)
 
 
 def broadcast_to(data, shape=None, **kwargs):
+    """Reference ``broadcast_to``: broadcast to ``shape`` (0 keeps the input
+    dim).
+    """
     in_shape = data.shape
     tgt = tuple(i if s == 0 else int(s) for i, s in zip(in_shape, shape)) \
         if len(shape) == len(in_shape) else tuple(shape)
@@ -204,6 +216,8 @@ _export(broadcast_to)
 
 
 def broadcast_like(lhs, rhs, **kwargs):
+    """Reference ``broadcast_like``: broadcast ``lhs`` to the shape of ``rhs``.
+    """
     tgt = rhs.shape
     return apply_op(lambda a: jnp.broadcast_to(a, tgt), lhs,
                     name="broadcast_like")
@@ -213,6 +227,8 @@ _export(broadcast_like)
 
 
 def broadcast_axis(data, axis=None, size=None, **kwargs):
+    """Reference ``broadcast_axis``: tile the given length-1 axes to ``size``.
+    """
     axes = (axis,) if isinstance(axis, int) else tuple(axis)
     sizes = (size,) if isinstance(size, int) else tuple(size)
     tgt = list(data.shape)
@@ -227,6 +243,7 @@ _export(broadcast_axis, aliases=("broadcast_axes",))
 
 
 def tile(data, reps, **kwargs):
+    """Reference ``tile``: repeat the whole array ``reps`` times per axis."""
     return apply_op(lambda a: jnp.tile(a, reps), data, name="tile")
 
 
@@ -234,6 +251,9 @@ _export(tile)
 
 
 def repeat(data, repeats, axis=None, **kwargs):
+    """Reference ``repeat``: repeat each element ``repeats`` times along
+    ``axis``.
+    """
     return apply_op(lambda a: jnp.repeat(a, repeats, axis=axis), data,
                     name="repeat")
 
@@ -242,6 +262,7 @@ _export(repeat)
 
 
 def flip(data, axis, **kwargs):
+    """Reference ``reverse``: reverse element order along ``axis``."""
     return apply_op(lambda a: jnp.flip(a, axis), data, name="flip")
 
 
@@ -266,6 +287,7 @@ _export(pad, aliases=("Pad",))
 
 
 def concat(*args, dim=1, out=None, **kwargs):
+    """Reference ``Concat``: join arrays along existing axis ``dim``."""
     if len(args) == 1 and isinstance(args[0], (list, tuple)):
         args = tuple(args[0])
     return commit_out(out, apply_op(
@@ -276,6 +298,7 @@ _export(concat, aliases=("Concat", "concatenate"))
 
 
 def stack(*args, axis=0, out=None, **kwargs):
+    """Reference ``stack``: join arrays along a NEW axis ``axis``."""
     if len(args) == 1 and isinstance(args[0], (list, tuple)):
         args = tuple(args[0])
     return commit_out(out, apply_op(
@@ -286,6 +309,9 @@ _export(stack)
 
 
 def split(data, num_outputs=None, axis=1, squeeze_axis=False, **kwargs):
+    """Reference ``SliceChannel``: split into ``num_outputs`` parts along
+    ``axis``.
+    """
     n = int(num_outputs)
 
     def f(a):
@@ -315,6 +341,7 @@ _export(slice, name="slice", aliases=("crop",))
 
 
 def slice_axis(data, axis=0, begin=0, end=None, **kwargs):
+    """Reference ``slice_axis``: slice ``[begin, end)`` along one axis."""
     key = [builtins.slice(None)] * data.ndim
     key[axis] = builtins.slice(begin, end)
     key = tuple(key)
@@ -325,6 +352,9 @@ _export(slice_axis)
 
 
 def slice_like(data, shape_like, axes=None, **kwargs):
+    """Reference ``slice_like``: crop ``data`` to ``shape_like``'s extents on
+    ``axes``.
+    """
     tgt = shape_like.shape
     key = [builtins.slice(None)] * data.ndim
     axes = axes if axes is not None else range(min(data.ndim, len(tgt)))
@@ -338,6 +368,9 @@ _export(slice_like)
 
 
 def where(condition, x, y, **kwargs):
+    """Reference ``where``: elementwise select ``x`` where ``condition`` else
+    ``y``.
+    """
     return apply_op(lambda c, a, b: jnp.where(c != 0, a, b), condition, x, y,
                     name="where")
 
@@ -346,6 +379,7 @@ _export(where)
 
 
 def clip(data, a_min=None, a_max=None, **kwargs):
+    """Reference ``clip``: clamp values into ``[a_min, a_max]``."""
     return apply_op(lambda a: jnp.clip(a, a_min, a_max), data, name="clip")
 
 
@@ -353,6 +387,7 @@ _export(clip)
 
 
 def cast(data, dtype, **kwargs):
+    """Reference ``Cast``: convert to ``dtype``."""
     from ..base import resolve_dtype
 
     dt = resolve_dtype(dtype)
@@ -363,6 +398,8 @@ _export(cast, aliases=("Cast",))
 
 
 def diag(data, k=0, **kwargs):
+    """Reference ``diag``: extract the k-th diagonal / build a diagonal matrix.
+    """
     return apply_op(lambda a: jnp.diag(a, k) if a.ndim <= 2
                     else jnp.diagonal(a, k, -2, -1), data, name="diag")
 
@@ -389,6 +426,8 @@ def _make_reduce(name, jf, aliases=()):
         return commit_out(out, apply_op(
             lambda a: jf(a, axis=ax, keepdims=keepdims), data, name=name))
 
+    fn.__doc__ = (f"Reference ``{name}``: reduce over ``axis`` "
+                  "(``exclude=True`` reduces every OTHER axis).")
     _export(fn, name=name, aliases=aliases)
 
 
@@ -402,6 +441,7 @@ _make_reduce("min", jnp.min, aliases=("min_axis",))
 
 
 def norm(data, ord=2, axis=None, keepdims=False, out=None, **kwargs):
+    """Reference ``norm``: L1/L2 (or Frobenius) norm over ``axis``."""
     ax = _norm_axis(axis)
 
     def f(a):
@@ -420,24 +460,33 @@ _export(norm)
 
 
 def argmax(data, axis=None, keepdims=False, **kwargs):
+    """Reference ``argmax``: index of the maximum along ``axis``
+    (non-differentiable).
+    """
     return apply_op(
         lambda a: jnp.argmax(a, axis=axis, keepdims=keepdims).astype(
             np.float32), data, name="argmax")
 
 
-_export(argmax)
+_export(argmax, no_grad=True)
 
 
 def argmin(data, axis=None, keepdims=False, **kwargs):
+    """Reference ``argmin``: index of the minimum along ``axis``
+    (non-differentiable).
+    """
     return apply_op(
         lambda a: jnp.argmin(a, axis=axis, keepdims=keepdims).astype(
             np.float32), data, name="argmin")
 
 
-_export(argmin)
+_export(argmin, no_grad=True)
 
 
 def argsort(data, axis=-1, is_ascend=True, dtype=np.float32, **kwargs):
+    """Reference ``argsort``: sorting permutation along ``axis``
+    (non-differentiable).
+    """
     def f(a):
         idx = jnp.argsort(a if is_ascend else -a, axis=axis)
         return idx.astype(dtype)
@@ -445,10 +494,11 @@ def argsort(data, axis=-1, is_ascend=True, dtype=np.float32, **kwargs):
     return apply_op(f, data, name="argsort")
 
 
-_export(argsort)
+_export(argsort, no_grad=True)
 
 
 def sort(data, axis=-1, is_ascend=True, **kwargs):
+    """Reference ``sort``: sorted copy along ``axis``."""
     def f(a):
         s = jnp.sort(a, axis=axis)
         return s if is_ascend else jnp.flip(s, axis=axis)
@@ -488,6 +538,9 @@ _export(topk)
 
 
 def cumsum(data, axis=None, dtype=None, **kwargs):
+    """Reference ``np.cumsum``: running sum along ``axis`` (flattened when
+    None).
+    """
     return apply_op(lambda a: jnp.cumsum(a, axis=axis, dtype=dtype), data,
                     name="cumsum")
 
@@ -533,6 +586,9 @@ _export(pick)
 
 def one_hot(indices, depth, on_value=1.0, off_value=0.0, dtype=np.float32,
             **kwargs):
+    """Reference ``one_hot``: expand integer indices to one-hot vectors of
+    ``depth``.
+    """
     def f(idx):
         oh = jax.nn.one_hot(idx.astype(np.int32), depth, dtype=np.dtype(dtype))
         return oh * (on_value - off_value) + off_value
@@ -540,7 +596,7 @@ def one_hot(indices, depth, on_value=1.0, off_value=0.0, dtype=np.float32,
     return apply_op(f, indices, name="one_hot")
 
 
-_export(one_hot)
+_export(one_hot, no_grad=True)
 
 
 def gather_nd(data, indices, **kwargs):
@@ -558,6 +614,9 @@ _export(gather_nd)
 
 
 def scatter_nd(data, indices, shape, **kwargs):
+    """Reference ``scatter_nd``: scatter updates into a zero array of
+    ``shape``.
+    """
     tgt = tuple(shape)
 
     def f(vals, idx):
@@ -586,27 +645,33 @@ _export(boolean_mask)
 
 
 def shape_array(data, **kwargs):
+    """Reference ``shape_array``: the input's shape as a 1-D int64 array."""
     from ..ndarray import NDArray
 
     return NDArray(np.array(data.shape, dtype=np.int64))
 
 
-_export(shape_array)
+_export(shape_array, no_grad=True)
 
 
 def size_array(data, **kwargs):
+    """Reference ``size_array``: the input's element count as a size-1 int64
+    array.
+    """
     from ..ndarray import NDArray
 
     return NDArray(np.array([data.size], dtype=np.int64))
 
 
-_export(size_array)
+_export(size_array, no_grad=True)
 
 
 # --- sequence ops (reference src/operator/sequence_*.cc:?) ------------------
 
 def sequence_mask(data, sequence_length=None, use_sequence_length=False,
                   value=0.0, axis=0, **kwargs):
+    """Reference ``SequenceMask``: zero/fill steps past each sequence length.
+    """
     if not use_sequence_length or sequence_length is None:
         return data
 
@@ -627,6 +692,7 @@ _export(sequence_mask, aliases=("SequenceMask",))
 
 def sequence_last(data, sequence_length=None, use_sequence_length=False,
                   axis=0, **kwargs):
+    """Reference ``SequenceLast``: last valid step of each sequence."""
     if not use_sequence_length or sequence_length is None:
         return slice_axis(data, axis=axis, begin=-1, end=None).squeeze(axis)
 
@@ -644,6 +710,8 @@ _export(sequence_last, aliases=("SequenceLast",))
 
 def sequence_reverse(data, sequence_length=None, use_sequence_length=False,
                      axis=0, **kwargs):
+    """Reference ``SequenceReverse``: reverse each sequence up to its length.
+    """
     if not use_sequence_length or sequence_length is None:
         return flip(data, axis=axis)
 
@@ -746,6 +814,7 @@ _export(linalg_gemm2)
 
 
 def linalg_potrf(A, **kwargs):
+    """Reference ``linalg_potrf``: Cholesky factor of a PSD matrix."""
     return apply_op(lambda a: jnp.linalg.cholesky(a), A, name="linalg_potrf")
 
 
@@ -834,6 +903,7 @@ _export(linalg_sumlogdiag)
 
 
 def linalg_extractdiag(A, offset=0, **kwargs):
+    """Reference ``linalg_extractdiag``: pull the ``offset`` diagonal."""
     return apply_op(
         lambda a: jnp.diagonal(a, offset=offset, axis1=-2, axis2=-1),
         A, name="linalg_extractdiag")
@@ -843,6 +913,9 @@ _export(linalg_extractdiag)
 
 
 def linalg_makediag(A, offset=0, **kwargs):
+    """Reference ``linalg_makediag``: embed a vector as the ``offset``
+    diagonal.
+    """
     def f(a):
         n = a.shape[-1] + abs(offset)
         idx = (jnp.arange(a.shape[-1]),
@@ -943,6 +1016,8 @@ _export(linalg_gesvd)
 
 
 def linalg_inverse(A, **kwargs):
+    """Reference ``linalg_inverse``: matrix inverse (batched on leading axes).
+    """
     return apply_op(jnp.linalg.inv, A, name="linalg_inverse")
 
 
@@ -950,6 +1025,8 @@ _export(linalg_inverse, aliases=("inverse",))
 
 
 def linalg_det(A, **kwargs):
+    """Reference ``linalg_det``: matrix determinant (batched on leading axes).
+    """
     return apply_op(jnp.linalg.det, A, name="linalg_det")
 
 
@@ -957,6 +1034,7 @@ _export(linalg_det, aliases=("det",))
 
 
 def linalg_slogdet(A, **kwargs):
+    """Reference ``linalg_slogdet``: sign and log|det| (batched)."""
     def f(a):
         sign, logdet = jnp.linalg.slogdet(a)
         return sign, logdet
@@ -968,6 +1046,8 @@ _export(linalg_slogdet, aliases=("slogdet",))
 
 
 def linalg_syrk(A, transpose=False, alpha=1.0, **kwargs):
+    """Reference ``linalg_syrk``: symmetric rank-k update ``alpha * A @ A.T``.
+    """
     def f(a):
         at = jnp.swapaxes(a, -1, -2)
         return alpha * (jnp.matmul(at, a) if transpose else jnp.matmul(a, at))
@@ -979,6 +1059,9 @@ _export(linalg_syrk)
 
 
 def smooth_l1(data, scalar=1.0, **kwargs):
+    """Reference ``smooth_l1``: Huber-style loss, quadratic inside
+    ``1/sigma^2``.
+    """
     s2 = float(scalar) ** 2
 
     def f(a):

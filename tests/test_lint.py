@@ -1,0 +1,184 @@
+"""mxlint analyzer tests: per-rule positives/negatives on the seeded
+fixtures, the baseline (waiver) gate, CLI exit codes, and the live
+op-registry invariants (no duplicate aliases, every op callable and
+documented, no_grad markers honoured by autograd)."""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from tools.lint.analyzer import analyze_paths  # noqa: E402
+from tools.lint.baseline import (apply_baseline, load_baseline,  # noqa: E402
+                                 save_baseline)
+from tools.lint.registry_check import run_registry_check  # noqa: E402
+
+FIXTURES = os.path.join("tools", "lint", "fixtures")
+
+
+def _analyze(fixture):
+    return analyze_paths([os.path.join(FIXTURES, fixture)], REPO)
+
+
+def _rule(violations, rule):
+    return [v for v in violations if v.rule == rule]
+
+
+# --- rule families: one positive and one negative each ----------------------
+
+def test_t1_flags_syncs_in_traced_regions():
+    vs = _rule(_analyze("t1_host_sync.py"), "T1")
+    errors = {v.context: v.message for v in vs if v.severity == "error"}
+    assert any("asnumpy" in m for c, m in errors.items()
+               if "hybrid_forward" in c)
+    assert any("float()" in m for c, m in errors.items() if c == "bad_step")
+    assert any("asarray" in m for c, m in errors.items()
+               if c == "bad_scan_body")
+    # eager sync downgrades to a warning
+    assert any(v.severity == "warning" and v.context == "eager_glue"
+               for v in vs)
+
+
+def test_t1_inline_suppression():
+    vs = _rule(_analyze("t1_host_sync.py"), "T1")
+    assert not any(v.context.startswith("suppressed_sync") for v in vs)
+
+
+def test_t2_flags_control_flow_on_traced_values():
+    vs = _rule(_analyze("t2_control_flow.py"), "T2")
+    kinds = {(v.context, v.message.split("`")[1]) for v in vs}
+    assert ("BadBlock.hybrid_forward", "if") in kinds
+    assert ("bad_loss", "while") in kinds
+    assert ("bad_loss", "assert") in kinds
+    # config dispatch / static metadata in GoodBlock must NOT flag
+    assert not any("GoodBlock" in v.context for v in vs)
+
+
+def test_t3_flags_registry_inconsistencies():
+    vs = _rule(_analyze("t3_registry.py"), "T3")
+    msgs = {v.context: v.message for v in vs}
+    assert "no_grad" in msgs["fix_argmax"]
+    assert "docstring" in msgs["fix_undocumented"]
+    assert any("duplicate" in v.message for v in vs)
+    # documented + no_grad-marked op is clean
+    assert "fix_sign" not in msgs
+
+
+def test_t4_flags_nondeterminism_in_traces():
+    vs = _rule(_analyze("t4_nondet.py"), "T4")
+    contexts = {v.context for v in vs}
+    assert "bad_dropout" in contexts
+    assert "NoisyBlock.hybrid_forward" in contexts
+    # keyed jax PRNG and eager host code must NOT flag
+    assert "good_dropout" not in contexts
+    assert "eager_logger" not in contexts
+
+
+def test_t5_flags_host_view_mutation():
+    vs = _rule(_analyze("t5_mutation.py"), "T5")
+    contexts = [v.context for v in vs]
+    assert contexts.count("clobber_weights") == 2
+    assert contexts.count("clobber_fresh_view") == 3
+    assert "fill_view" in contexts
+    # mutating an explicit np.array() copy is fine
+    assert "good_update" not in contexts
+
+
+def test_clean_fixture_has_no_violations():
+    assert _analyze("clean.py") == []
+
+
+# --- baseline gate ----------------------------------------------------------
+
+def test_baseline_waives_known_and_gates_new(tmp_path):
+    vs = analyze_paths([FIXTURES], REPO)
+    assert vs, "fixtures must seed violations"
+    path = str(tmp_path / "baseline.json")
+    save_baseline(path, vs)
+    baseline = load_baseline(path)
+    new, waived, stale = apply_baseline(vs, baseline)
+    assert new == [] and len(waived) == len(vs) and stale == []
+    # dropping one waiver makes exactly that violation "new" again
+    victim = vs[0].fingerprint()
+    short = {fp: n for fp, n in baseline.items() if fp != victim}
+    new, _, _ = apply_baseline(vs, short)
+    assert [v.fingerprint() for v in new] == [victim]
+    # a fixed violation shows up as a stale waiver, never a failure
+    _, _, stale = apply_baseline([v for v in vs if
+                                  v.fingerprint() != victim], baseline)
+    assert victim in stale
+
+
+def test_fingerprint_ignores_line_numbers():
+    from tools.lint.core import Violation
+
+    a = Violation("T1", "error", "p.py", 10, 0, "f", "m", "x.asnumpy()")
+    b = Violation("T1", "error", "p.py", 99, 4, "f", "m", "x.asnumpy()")
+    assert a.fingerprint() == b.fingerprint()
+
+
+# --- CLI --------------------------------------------------------------------
+
+def _run_cli(*args):
+    return subprocess.run(
+        [sys.executable, "-m", "tools.lint", *args], cwd=REPO,
+        capture_output=True, text=True)
+
+
+def test_cli_clean_against_committed_baseline():
+    # the repo must lint clean: new violations fail CI here
+    r = _run_cli("mxnet_tpu")
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_cli_fails_on_seeded_fixtures_with_json():
+    r = _run_cli(FIXTURES, "--no-baseline", "--no-registry", "--json")
+    assert r.returncode == 1
+    payload = json.loads(r.stdout)
+    by_rule = payload["summary"]["by_rule"]
+    for rule in ("T1", "T2", "T3", "T4", "T5"):
+        assert by_rule.get(rule, 0) > 0, f"{rule} missing from {by_rule}"
+
+
+# --- live registry invariants ----------------------------------------------
+
+def test_registry_has_no_duplicates_and_all_callable_documented():
+    assert run_registry_check() == []
+
+
+def test_registry_no_grad_metadata():
+    import mxnet_tpu  # noqa: F401
+    from mxnet_tpu.ops import registry
+
+    assert registry.duplicate_registrations() == []
+    for name in ("argmax", "argmin", "argsort", "sign", "floor", "equal",
+                 "one_hot", "shape_array"):
+        assert registry.op_meta(name).get("no_grad") is True, name
+    for name in ("add", "exp", "sum", "dot", "softmax"):
+        assert registry.op_meta(name).get("no_grad") is False, name
+    # aliases resolve to the same callable and metadata as the canonical
+    for name in registry.list_ops():
+        meta = registry.op_meta(name)
+        if meta and meta["canonical"] != name:
+            assert registry.get_op(name) is \
+                registry.get_op(meta["canonical"])
+
+
+def test_no_grad_ops_skip_vjp_but_stay_on_tape():
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+
+    x = nd.array([-2.0, 3.0, 0.5])
+    x.attach_grad()
+    with mx.autograd.record():
+        s = nd.sign(x)          # no_grad op: tape node, no vjp trace
+        z = (s * x).sum()       # sign(x) * x == |x|
+    z.backward()
+    # d|x|/dx contributes only through the differentiable product path
+    np.testing.assert_allclose(x.grad.asnumpy(), np.sign([-2.0, 3.0, 0.5]))

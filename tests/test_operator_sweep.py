@@ -296,3 +296,35 @@ def test_boolean_mask():
     empty = nd.contrib.boolean_mask(nd.array(x),
                                     nd.array([0, 0, 0, 0])).asnumpy()
     assert empty.shape == (0, 3)
+
+
+def test_registry_sweep_invariants():
+    """Every registered op: callable, documented (public names), alias
+    metadata self-consistent, and no registration ever shadowed another.
+    The static half of this lives in tools/lint (rule T3)."""
+    from mxnet_tpu.ops import registry
+
+    assert registry.duplicate_registrations() == []
+    names = registry.list_ops()
+    assert len(names) == len(set(names))
+    for name in names:
+        fn = registry.get_op(name)
+        assert callable(fn), name
+        meta = registry.op_meta(name)
+        assert meta, f"{name} registered without metadata"
+        canonical = meta["canonical"]
+        assert registry.get_op(canonical) is fn, name
+        if not canonical.startswith("_"):
+            assert (fn.__doc__ or "").strip(), f"{canonical} undocumented"
+
+
+def test_no_grad_ops_backward_matches_zero_grad():
+    """no_grad-marked ops skip the vjp trace; gradients THROUGH them
+    accumulate nothing — observably identical to the zero cotangents the
+    vjp produced before the markers existed."""
+    x = nd.array([-1.5, 0.5, 2.0])
+    x.attach_grad()
+    with mx.autograd.record():
+        z = (nd.floor(x) + x * 3.0).sum()
+    z.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), [3.0, 3.0, 3.0])

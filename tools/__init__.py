@@ -1,0 +1,3 @@
+# tools/ is a namespace for repo tooling.  This file exists so
+# ``python -m tools.lint`` resolves from the repo root; the standalone
+# scripts in this directory (im2rec.py, launch.py, ...) are unaffected.

@@ -1,0 +1,9 @@
+"""mxlint: trace-safety and op-registry static analyzer for mxnet_tpu.
+
+Run as ``python -m tools.lint [paths...]`` from the repo root.  See
+docs/lint.md for the rule families (T1..T5) and the baseline workflow.
+"""
+from .core import Violation, SEVERITY_ERROR, SEVERITY_WARNING  # noqa: F401
+from .rules import RULES  # noqa: F401
+from .analyzer import analyze_paths  # noqa: F401
+from .baseline import load_baseline, save_baseline, apply_baseline  # noqa: F401

@@ -1,0 +1,88 @@
+"""CLI: ``python -m tools.lint [paths...]``.
+
+Exit codes: 0 clean (all violations waived by baseline), 1 new
+violations (or tool errors), 2 usage error.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from .analyzer import analyze_paths
+from .baseline import load_baseline, save_baseline, apply_baseline
+from .registry_check import run_registry_check
+from .report import render_human, render_json
+from .rules import RULES
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "baseline.json")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.lint",
+        description="mxlint: trace-safety & op-registry static analyzer. "
+                    "Rules: " + "; ".join(f"{k}: {v}"
+                                          for k, v in sorted(RULES.items())))
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="files/directories to analyze "
+                         "(default: mxnet_tpu)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit a JSON report instead of human output")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="baseline (waiver) file "
+                         "(default: tools/lint/baseline.json)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline: report every violation")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="regenerate the baseline from the current "
+                         "violations and exit 0")
+    ap.add_argument("--rules", default=None, metavar="T1,T2,...",
+                    help="comma-separated rule families to run "
+                         "(default: all)")
+    ap.add_argument("--no-registry", action="store_true",
+                    help="skip the runtime registry check (T3's dynamic "
+                         "half; needs an importable mxnet_tpu)")
+    args = ap.parse_args(argv)
+
+    rules = None
+    if args.rules:
+        rules = {r.strip().upper() for r in args.rules.split(",")
+                 if r.strip()}
+        unknown = rules - set(RULES)
+        if unknown:
+            ap.error(f"unknown rule(s): {sorted(unknown)}; "
+                     f"known: {sorted(RULES)}")
+
+    paths = args.paths or ["mxnet_tpu"]
+    try:
+        violations = analyze_paths(paths, REPO_ROOT, rules=rules)
+    except FileNotFoundError as e:
+        ap.error(f"no such path: {e}")
+
+    if not args.no_registry and (rules is None or "T3" in rules):
+        violations.extend(run_registry_check())
+
+    if args.update_baseline:
+        save_baseline(args.baseline, violations)
+        rel = os.path.relpath(args.baseline, REPO_ROOT)
+        print(f"mxlint: baseline rewritten with {len(violations)} "
+              f"waived violation(s) -> {rel}")
+        return 0
+
+    baseline = {} if args.no_baseline else load_baseline(args.baseline)
+    new, waived, stale = apply_baseline(violations, baseline)
+
+    out = sys.stdout
+    if args.as_json:
+        render_json(new, waived, stale, out)
+    else:
+        render_human(new, waived, stale, out)
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

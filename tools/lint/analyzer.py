@@ -1,0 +1,69 @@
+"""mxlint driver: walk files, run per-file rules, finalize cross-file
+T3 checks, and hand the result to the baseline gate."""
+from __future__ import annotations
+
+import ast
+import os
+
+from .core import Violation, FileSource, SEVERITY_ERROR
+from .rules import FileChecker, check_registrations
+
+#: directories never worth analyzing
+_SKIP_DIRS = {".git", "__pycache__", "build", "dist", ".eggs",
+              "node_modules", ".pytest_cache"}
+
+
+def iter_py_files(paths, root):
+    """Yield (abspath, relpath) for every .py file under ``paths``
+    (files or directories), relpaths posix-style against ``root``."""
+    seen = set()
+    for p in paths:
+        ap = os.path.abspath(p if os.path.isabs(p)
+                             else os.path.join(root, p))
+        if os.path.isfile(ap):
+            cands = [ap]
+        elif os.path.isdir(ap):
+            cands = []
+            for dirpath, dirnames, filenames in os.walk(ap):
+                dirnames[:] = [d for d in sorted(dirnames)
+                               if d not in _SKIP_DIRS]
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        cands.append(os.path.join(dirpath, fn))
+        else:
+            raise FileNotFoundError(p)
+        for c in cands:
+            if c in seen:
+                continue
+            seen.add(c)
+            rel = os.path.relpath(c, root).replace(os.sep, "/")
+            yield c, rel
+
+
+def analyze_paths(paths, root, rules=None):
+    """Run the analyzer over ``paths``.  Returns a sorted violation list.
+
+    ``rules`` is an optional iterable of rule ids ("T1".."T5") limiting
+    which families run; None means all.
+    """
+    enabled = set(rules) if rules is not None else None
+    violations = []
+    all_regs = []
+    sources = []
+    for abspath, relpath in iter_py_files(paths, root):
+        try:
+            src = FileSource.parse(abspath, relpath)
+        except (SyntaxError, UnicodeDecodeError) as e:
+            violations.append(Violation(
+                rule="E0", severity=SEVERITY_ERROR, path=relpath,
+                line=getattr(e, "lineno", 0) or 0, col=0,
+                context="<parse>", message=f"unparseable file: {e}"))
+            continue
+        checker = FileChecker(src, enabled=enabled)
+        violations.extend(checker.run())
+        all_regs.extend(checker.registrations)
+        sources.append(src)
+    if enabled is None or "T3" in enabled:
+        violations.extend(check_registrations(all_regs, sources))
+    violations.sort(key=lambda v: (v.path, v.line, v.col, v.rule))
+    return violations

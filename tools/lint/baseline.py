@@ -1,0 +1,76 @@
+"""Baseline (waiver) gate.
+
+``baseline.json`` maps violation fingerprints to grandfathered counts.
+A run fails only when some fingerprint's *current* count exceeds its
+baseline count — pre-existing debt is waived, new debt is not, and
+fixing an old violation can never break the gate.  Fingerprints omit
+line numbers (see core.Violation.fingerprint) so unrelated edits do not
+churn this file.
+"""
+from __future__ import annotations
+
+import json
+import os
+from collections import Counter
+
+BASELINE_VERSION = 1
+
+
+def load_baseline(path):
+    """Return {fingerprint: count}; empty dict if the file is absent."""
+    if not os.path.exists(path):
+        return {}
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    waivers = data.get("waivers", data) if isinstance(data, dict) else {}
+    out = {}
+    for fp, entry in waivers.items():
+        if isinstance(entry, dict):
+            out[fp] = int(entry.get("count", 1))
+        else:
+            out[fp] = int(entry)
+    return out
+
+
+def save_baseline(path, violations):
+    """Write a fresh baseline from the current violation set, keeping a
+    human-auditable sample (rule/path/context/message) per fingerprint."""
+    grouped = {}
+    for v in violations:
+        fp = v.fingerprint()
+        entry = grouped.setdefault(fp, {
+            "count": 0, "rule": v.rule, "path": v.path,
+            "context": v.context, "message": v.message})
+        entry["count"] += 1
+    payload = {
+        "version": BASELINE_VERSION,
+        "note": ("Grandfathered mxlint violations. Regenerate with "
+                 "`python -m tools.lint --update-baseline`; fix debt by "
+                 "deleting entries and fixing the code."),
+        "waivers": {fp: grouped[fp] for fp in sorted(grouped)},
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=2, sort_keys=False)
+        f.write("\n")
+
+
+def apply_baseline(violations, baseline):
+    """Split ``violations`` into (new, waived, stale_fingerprints).
+
+    Per fingerprint, the first ``baseline[fp]`` occurrences are waived
+    and the rest are new.  ``stale`` lists baseline fingerprints that no
+    longer occur at all — fixed debt whose waivers can be deleted.
+    """
+    budget = dict(baseline)
+    new, waived = [], []
+    seen = Counter()
+    for v in violations:
+        fp = v.fingerprint()
+        seen[fp] += 1
+        if budget.get(fp, 0) > 0:
+            budget[fp] -= 1
+            waived.append(v)
+        else:
+            new.append(v)
+    stale = sorted(fp for fp in baseline if seen[fp] == 0)
+    return new, waived, stale

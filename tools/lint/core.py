@@ -1,0 +1,110 @@
+"""mxlint core data model: violations, fingerprints, inline suppressions.
+
+A violation's *fingerprint* deliberately excludes line/column numbers so
+that unrelated edits (imports added above, reflowed docstrings) do not
+churn the checked-in baseline: it hashes the rule id, the repo-relative
+path, the enclosing context (function qualname or op name) and the
+stripped source line text.  Two identical statements in one function
+share a fingerprint; the baseline stores a count per fingerprint so a
+*third* copy still gates.
+"""
+from __future__ import annotations
+
+import ast
+import hashlib
+import re
+from dataclasses import dataclass, field, asdict
+
+SEVERITY_ERROR = "error"
+SEVERITY_WARNING = "warning"
+
+#: ``# mxlint: disable=T1,T4`` / ``# mxlint: allow=all`` on the violating
+#: line (or the line above, for statements that wrap) suppresses matching
+#: rules.  ``allow`` and ``disable`` are synonyms; ids are case-insensitive.
+_SUPPRESS_RE = re.compile(
+    r"#\s*mxlint:\s*(?:disable|allow)\s*[=:]\s*([A-Za-z0-9_,\s*]+)")
+
+
+@dataclass
+class Violation:
+    rule: str                 # "T1".."T5" (or "E0" for tool errors)
+    severity: str             # "error" | "warning"
+    path: str                 # repo-relative posix path
+    line: int
+    col: int
+    context: str              # enclosing function qualname / op name
+    message: str
+    source: str = ""          # stripped source line (fingerprint material)
+
+    def fingerprint(self) -> str:
+        key = "|".join((self.rule, self.path, self.context,
+                        self.source or self.message))
+        return hashlib.sha1(key.encode("utf-8")).hexdigest()[:16]
+
+    def to_dict(self) -> dict:
+        d = asdict(self)
+        d["fingerprint"] = self.fingerprint()
+        return d
+
+
+@dataclass
+class FileSource:
+    """Parsed file + the bits every rule needs."""
+    path: str                 # repo-relative posix path
+    abspath: str
+    text: str
+    tree: ast.AST
+    lines: list = field(default_factory=list)
+    suppressions: dict = field(default_factory=dict)  # line -> set(rule ids)
+
+    @classmethod
+    def parse(cls, abspath, relpath):
+        with open(abspath, "r", encoding="utf-8") as f:
+            text = f.read()
+        tree = ast.parse(text, filename=relpath)
+        lines = text.splitlines()
+        return cls(path=relpath, abspath=abspath, text=text, tree=tree,
+                   lines=lines, suppressions=_collect_suppressions(lines))
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+    def is_suppressed(self, rule: str, lineno: int) -> bool:
+        for ln in (lineno, lineno - 1):
+            rules = self.suppressions.get(ln)
+            if rules and ("*" in rules or "ALL" in rules or
+                          rule.upper() in rules):
+                return True
+        return False
+
+
+def _collect_suppressions(lines):
+    out = {}
+    for i, raw in enumerate(lines, start=1):
+        m = _SUPPRESS_RE.search(raw)
+        if m:
+            ids = {tok.strip().upper() for tok in m.group(1).split(",")
+                   if tok.strip()}
+            out[i] = ids
+    return out
+
+
+def dotted_name(node) -> str:
+    """Best-effort dotted name of an expression: ``jax.lax.scan`` ->
+    "jax.lax.scan"; returns "" for anything unresolvable."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = dotted_name(node.value)
+        return f"{base}.{node.attr}" if base else node.attr
+    return ""
+
+
+def last_name(node) -> str:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return ""

@@ -1,0 +1,3 @@
+# mxlint test fixtures: these files are PARSED by the analyzer in
+# tests/test_lint.py, never imported/executed.  Each t*_ file seeds
+# positive violations for one rule family; clean.py must stay clean.

@@ -1,0 +1,50 @@
+"""Negative fixture: idiomatic hot-path code the analyzer must NOT flag."""
+import jax
+import jax.numpy as jnp
+
+from mxnet_tpu.ops.registry import apply_op, make_exporter
+
+_export = make_exporter(__import__(__name__))
+
+
+class GoodBlock:
+    def hybrid_forward(self, F, x, act="relu"):
+        if act == "relu":             # config dispatch on a default param
+            return jnp.maximum(x, 0)
+        if x.ndim == 2:               # static metadata
+            return x
+        return jnp.tanh(x)
+
+
+def train_step(params, batch, key):
+    noise = jax.random.normal(key, batch.shape)
+
+    def loss_fn(p):
+        return jnp.mean((p * batch - noise) ** 2)
+
+    loss, grads = jax.value_and_grad(loss_fn)(params)
+    return loss, grads
+
+
+train_step_jit = jax.jit(train_step)
+
+
+def clean_scale(a, scale=2.0):
+    """Scale every element (differentiable, documented)."""
+    return apply_op(lambda x: x * scale, a, name="clean_scale")
+
+
+_export(clean_scale, name="clean_scale")
+
+
+def clean_floor(a):
+    """Elementwise floor (explicitly non-differentiable)."""
+    return apply_op(lambda x: jnp.floor(x), a, name="clean_floor")
+
+
+_export(clean_floor, name="clean_floor", no_grad=True)
+
+
+def host_logging(metrics):
+    # eager host code between steps: plain attribute access, no syncs
+    return {k: v for k, v in metrics.items()}

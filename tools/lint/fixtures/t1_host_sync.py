@@ -1,0 +1,40 @@
+"""T1 fixture: host syncs inside traced regions (and one eager warning)."""
+import jax
+import numpy as np
+
+
+class BadBlock:
+    def hybrid_forward(self, F, x):
+        host = x.asnumpy()            # T1 error: sync inside hybrid_forward
+        return host.sum()
+
+
+def bad_step(params, batch):
+    loss = params * batch
+    print(float(loss))                # T1 error: float() on traced value
+    return loss
+
+
+bad_step_jit = jax.jit(bad_step)
+
+
+def bad_scan_body(carry, x):
+    y = carry + x
+    np.asarray(y)                     # T1 error: concretizes the tracer
+    return y, y
+
+
+def fused(xs):
+    return jax.lax.scan(bad_scan_body, 0.0, xs)
+
+
+def eager_glue(arr):
+    return arr.asnumpy()              # T1 warning: blocking fetch, eager
+
+
+def suppressed_sync(params):
+    def inner(p):
+        v = p.asnumpy()  # mxlint: disable=T1
+        return v
+
+    return jax.jit(inner)(params)

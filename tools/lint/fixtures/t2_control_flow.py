@@ -1,0 +1,28 @@
+"""T2 fixture: python control flow on traced values in traced regions."""
+import jax
+
+
+class BadBlock:
+    def hybrid_forward(self, F, x):
+        if x > 0:                     # T2 error: branch on traced value
+            return x
+        return -x
+
+
+def bad_loss(w, target):
+    while w < target:                 # T2 error: while on traced value
+        w = w * 2
+    assert w > 0                      # T2 error: assert on traced value
+    return w
+
+
+bad_loss_jit = jax.jit(bad_loss)
+
+
+class GoodBlock:
+    def hybrid_forward(self, F, x, axis=0):
+        if axis is None:              # ok: identity check on config param
+            return x
+        if len(x.shape) == 2:         # ok: static shape metadata
+            return x
+        return x

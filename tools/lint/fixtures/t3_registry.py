@@ -1,0 +1,41 @@
+"""T3 fixture: registry inconsistencies at static registration sites."""
+import jax.numpy as jnp
+
+from mxnet_tpu.ops.registry import apply_op, defop, make_exporter
+
+_export = make_exporter(__import__(__name__))
+
+
+def fix_argmax(a, axis=None):
+    """Index of the maximum (non-differentiable)."""
+    return apply_op(lambda x: jnp.argmax(x, axis=axis), a, name="fix_argmax")
+
+
+_export(fix_argmax, name="fix_argmax")  # T3: nondiff but no no_grad marker
+
+
+def fix_undocumented(a):
+    return apply_op(lambda x: x * 2, a, name="fix_undocumented")
+
+
+_export(fix_undocumented, name="fix_undocumented")  # T3: no docstring
+
+
+def fix_dup(a):
+    """First registration."""
+    return a
+
+
+def fix_dup2(a):
+    """Second registration stealing the same name."""
+    return a
+
+
+_export(fix_dup, name="fix_dup")
+_export(fix_dup2, name="fix_dup")       # T3: duplicate name
+
+
+@defop("fix_sign", no_grad=True)
+def fix_sign(a):
+    """Sign of each element (marked no_grad: clean)."""
+    return apply_op(lambda x: jnp.sign(x), a, name="fix_sign")

@@ -1,0 +1,33 @@
+"""T4 fixture: host nondeterminism baked into traced regions."""
+import random
+import time
+
+import jax
+import numpy as np
+
+
+def bad_dropout(x):
+    mask = np.random.rand(*x.shape)   # T4 error: trace-time constant mask
+    return x * (mask > 0.5)
+
+
+bad_dropout_jit = jax.jit(bad_dropout)
+
+
+class NoisyBlock:
+    def hybrid_forward(self, F, x):
+        jitter = random.random()      # T4 error: stdlib random in trace
+        stamp = time.time()           # T4 error: wall clock in trace
+        return x + jitter + stamp
+
+
+def good_dropout(x, key):
+    mask = jax.random.bernoulli(key, 0.5, x.shape)  # ok: keyed PRNG
+    return x * mask
+
+
+good_dropout_jit = jax.jit(good_dropout)
+
+
+def eager_logger(msg):
+    return f"{time.time()} {msg}"     # ok: host code, not traced

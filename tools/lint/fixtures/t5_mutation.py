@@ -1,0 +1,29 @@
+"""T5 fixture: in-place numpy mutation of jax-backed buffers."""
+import jax
+import numpy as np
+
+
+def clobber_weights(param, idx, val):
+    host = param.asnumpy()
+    host[idx] = val                   # T5 error: writes a host copy only
+    host[idx] += 1                    # T5 error: augmented host mutation
+    return host
+
+
+def clobber_fresh_view(param):
+    param.asnumpy()[0] = 0.0          # T5 error: write into fresh view
+    jax.device_get(param)[1] = 1.0    # T5 error: same via device_get
+    np.copyto(param.asnumpy(), 0.0)   # T5 error: copyto into host view
+    return param
+
+
+def fill_view(param):
+    view = jax.device_get(param)
+    view.fill(0.0)                    # T5 error: mutator on host view
+    return view
+
+
+def good_update(param, idx, val):
+    fresh = np.array(param.asnumpy())  # explicit copy: mutation is fine
+    fresh[idx] = val                   # ok: fresh is a real copy
+    return fresh

@@ -1,0 +1,286 @@
+"""Traced-region ("hot path") inference.
+
+The analyzer must know which function bodies execute *inside a jax
+trace*: a host sync there stalls (or breaks) the whole fused program,
+while the same call in eager glue code is merely a normal blocking
+fetch.  Tracing in this codebase enters through a small set of doors:
+
+  * ``hybrid_forward`` bodies (CachedOp traces them — gluon/block.py),
+  * functions handed to ``jax.jit`` / ``jax.vjp`` / ``jax.grad`` /
+    ``jax.value_and_grad`` / ``jax.checkpoint`` / ``lax.scan`` /
+    ``lax.cond`` / ``lax.while_loop`` / ``lax.fori_loop`` ...,
+  * functions decorated with those transforms,
+  * pure bodies handed to ``apply_op`` (ops/registry.py — every op's
+    inner function runs under trace whenever the op is jitted or vjp'd),
+  * anything those functions call *within the same module* (one-module
+    call-graph closure: cross-module reachability is the registry's and
+    the runtime's problem, and chasing it statically would drown the
+    report in speculative paths).
+
+Lexical nesting inherits hotness: a ``def body(...)`` inside a traced
+``k_steps`` is itself traced.
+"""
+from __future__ import annotations
+
+import ast
+
+from .core import dotted_name, last_name
+
+#: function-def names that are traced by construction
+HOT_DEF_NAMES = {"hybrid_forward"}
+
+#: last component of a dotted callable that *enters* a trace when handed
+#: a function (jax.jit, lax.scan, registry.apply_op, ...)
+TRACE_ENTRY_NAMES = {
+    "jit", "vmap", "pmap", "grad", "value_and_grad", "vjp", "jvp",
+    "checkpoint", "remat", "custom_vjp", "custom_jvp",
+    "scan", "cond", "while_loop", "fori_loop", "switch", "associative_scan",
+    "apply_op",
+}
+
+#: decorators that make the decorated def a traced region
+HOT_DECORATOR_NAMES = TRACE_ENTRY_NAMES - {"apply_op"}
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+def _is_trace_entry(func_expr) -> bool:
+    """Is ``func_expr`` (the .func of a Call) a trace-entering callable?"""
+    if isinstance(func_expr, ast.Call):
+        # partial(jax.jit, ...)(f) / functools.partial(jax.jit, ...)
+        if last_name(func_expr.func) == "partial" and func_expr.args:
+            return _is_trace_entry(func_expr.args[0])
+        return False
+    name = last_name(func_expr)
+    if name not in TRACE_ENTRY_NAMES:
+        return False
+    dotted = dotted_name(func_expr)
+    if "." not in dotted:
+        return True  # from jax import jit; from ..ops.registry import apply_op
+    head = dotted.split(".", 1)[0]
+    return head in ("jax", "lax", "jnp", "registry", "functools", "self") or \
+        "jax" in dotted or "lax" in dotted or name == "apply_op"
+
+
+class FunctionIndex:
+    """Per-module index: every function/lambda node, its qualname, its
+    parent chain, and the set of nodes whose bodies are traced."""
+
+    def __init__(self, tree: ast.AST):
+        self.tree = tree
+        self.parents = {}          # id(node) -> parent node
+        self.func_qualnames = {}   # id(func node) -> qualname
+        self.by_name = {}          # bare name -> [func nodes]
+        self._index()
+        self.hot = self._infer_hot()
+
+    # -- construction --------------------------------------------------------
+    def _index(self):
+        stack = [(self.tree, None, "")]
+        while stack:
+            node, parent, prefix = stack.pop()
+            if parent is not None:
+                self.parents[id(node)] = parent
+            if isinstance(node, _FUNC_NODES):
+                name = getattr(node, "name", "<lambda>")
+                qual = f"{prefix}.{name}" if prefix else name
+                self.func_qualnames[id(node)] = qual
+                self.by_name.setdefault(name, []).append(node)
+                child_prefix = qual
+            elif isinstance(node, ast.ClassDef):
+                child_prefix = f"{prefix}.{node.name}" if prefix \
+                    else node.name
+            else:
+                child_prefix = prefix
+            for child in ast.iter_child_nodes(node):
+                stack.append((child, node, child_prefix))
+
+    # -- hot inference -------------------------------------------------------
+    def _decorator_hot(self, node) -> bool:
+        for deco in getattr(node, "decorator_list", ()):
+            target = deco.func if isinstance(deco, ast.Call) else deco
+            if isinstance(target, ast.Call):  # @partial(jax.jit, ...)
+                if _is_trace_entry(target):
+                    return True
+                continue
+            if last_name(target) in HOT_DECORATOR_NAMES and \
+                    ("jax" in dotted_name(target) or
+                     "." not in dotted_name(target)):
+                return True
+        return False
+
+    def _infer_hot(self):
+        hot = set()
+        # 1. roots by name / decorator
+        for name, nodes in self.by_name.items():
+            for node in nodes:
+                if name in HOT_DEF_NAMES or self._decorator_hot(node):
+                    hot.add(id(node))
+        # 2. roots by being handed to a trace entry
+        for call in ast.walk(self.tree):
+            if not isinstance(call, ast.Call) or \
+                    not _is_trace_entry(call.func):
+                continue
+            candidates = list(call.args) + [kw.value for kw in call.keywords]
+            for arg in candidates:
+                if isinstance(arg, ast.Lambda):
+                    hot.add(id(arg))
+                elif isinstance(arg, (ast.Name, ast.Attribute)):
+                    for fn in self.by_name.get(last_name(arg), ()):
+                        hot.add(id(fn))
+        # 3. same-module call-graph closure
+        node_by_id = {id(n): n for nodes in self.by_name.values()
+                      for n in nodes}
+        changed = True
+        while changed:
+            changed = False
+            for fid in list(hot):
+                node = node_by_id.get(fid)
+                if node is None:
+                    continue
+                for callee in self._called_names(node):
+                    for fn in self.by_name.get(callee, ()):
+                        if id(fn) not in hot:
+                            hot.add(id(fn))
+                            changed = True
+        return hot
+
+    def _called_names(self, func_node):
+        """Bare names of same-module callables invoked from ``func_node``
+        (``foo(...)``, ``self.foo(...)``, ``cls.foo(...)``)."""
+        out = set()
+        for call in ast.walk(func_node):
+            if not isinstance(call, ast.Call):
+                continue
+            f = call.func
+            if isinstance(f, ast.Name):
+                out.add(f.id)
+            elif isinstance(f, ast.Attribute) and \
+                    isinstance(f.value, ast.Name) and \
+                    f.value.id in ("self", "cls"):
+                out.add(f.attr)
+        return out
+
+    # -- queries -------------------------------------------------------------
+    def enclosing_function(self, node):
+        cur = self.parents.get(id(node))
+        while cur is not None:
+            if isinstance(cur, _FUNC_NODES):
+                return cur
+            cur = self.parents.get(id(cur))
+        return None
+
+    def qualname_of(self, node) -> str:
+        fn = node if isinstance(node, _FUNC_NODES) \
+            else self.enclosing_function(node)
+        if fn is None:
+            return "<module>"
+        return self.func_qualnames.get(id(fn), "<module>")
+
+    def in_traced_region(self, node) -> bool:
+        """True if any lexically-enclosing function is hot."""
+        cur = node if isinstance(node, _FUNC_NODES) \
+            else self.enclosing_function(node)
+        while cur is not None:
+            if id(cur) in self.hot:
+                return True
+            cur = self.enclosing_function(cur)
+        return False
+
+
+# ---------------------------------------------------------------------------
+# Taint: which local names in a traced function derive from traced values
+# ---------------------------------------------------------------------------
+
+#: attribute reads that yield static (python-level) values even on traced
+#: arrays — branching on these is fine
+SAFE_ATTRS = {"shape", "ndim", "dtype", "size", "name", "stype", "context",
+              "itemsize"}
+
+#: calls whose result is a static python value regardless of arguments
+SAFE_CALLS = {"len", "isinstance", "issubclass", "type", "getattr",
+              "hasattr", "callable", "str", "repr", "id", "issubdtype",
+              "dtype", "format"}
+
+
+def function_taint(func_node) -> set:
+    """Names in ``func_node`` that (conservatively) hold traced values:
+    parameters without defaults (minus self/cls/F) plus anything assigned
+    from an expression involving a tainted name.  Config-style parameters
+    (those *with* defaults) are presumed static — branching on ``axis`` or
+    ``normalization`` retraces at most, it cannot fail inside the trace."""
+    args = func_node.args
+    tainted = set()
+    positional = list(args.posonlyargs) + list(args.args)
+    n_defaults = len(args.defaults)
+    no_default = positional[:len(positional) - n_defaults] if n_defaults \
+        else positional
+    for a in no_default:
+        if a.arg not in ("self", "cls", "F"):
+            tainted.add(a.arg)
+    if args.vararg is not None:
+        tainted.add(args.vararg.arg)
+
+    # forward pass over the body in statement order
+    for node in ast.walk(func_node):
+        targets = []
+        value = None
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets, value = [node.target], node.value
+        elif isinstance(node, ast.AugAssign):
+            targets, value = [node.target], node.value
+        elif isinstance(node, ast.NamedExpr):
+            targets, value = [node.target], node.value
+        elif isinstance(node, (ast.For, ast.AsyncFor)):
+            targets, value = [node.target], node.iter
+        if value is None:
+            continue
+        names = _target_names(targets)
+        if expr_tainted(value, tainted):
+            tainted.update(names)
+    return tainted
+
+
+def _target_names(targets):
+    out = []
+    for t in targets:
+        for n in ast.walk(t):
+            if isinstance(n, ast.Name):
+                out.append(n.id)
+    return out
+
+
+def expr_tainted(expr, tainted: set) -> bool:
+    """Does ``expr`` depend on a tainted name in a way that would force a
+    concrete value out of a tracer?"""
+    if isinstance(expr, ast.Constant):
+        return False
+    if isinstance(expr, ast.Name):
+        return expr.id in tainted
+    if isinstance(expr, ast.Attribute):
+        if expr.attr in SAFE_ATTRS:
+            return False
+        return expr_tainted(expr.value, tainted)
+    if isinstance(expr, ast.Call):
+        if last_name(expr.func) in SAFE_CALLS:
+            return False
+        parts = [expr.func] + list(expr.args) + \
+            [kw.value for kw in expr.keywords]
+        return any(expr_tainted(p, tainted) for p in parts)
+    if isinstance(expr, ast.Compare):
+        # ``x is None`` / ``mode == "valid"``: identity checks and
+        # comparisons against string constants are config dispatch
+        if all(isinstance(op, (ast.Is, ast.IsNot)) for op in expr.ops):
+            return False
+        operands = [expr.left] + list(expr.comparators)
+        if any(isinstance(o, ast.Constant) and isinstance(o.value, str)
+               for o in operands):
+            return False
+        return any(expr_tainted(o, tainted) for o in operands)
+    if isinstance(expr, ast.Subscript):
+        return expr_tainted(expr.value, tainted) or \
+            expr_tainted(expr.slice, tainted)
+    return any(expr_tainted(child, tainted)
+               for child in ast.iter_child_nodes(expr))

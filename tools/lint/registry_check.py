@@ -1,0 +1,62 @@
+"""Runtime half of the T3 rule.
+
+elemwise.py / output_ops.py register ops from tables inside loops, so a
+static scan cannot see those names.  This module imports the real
+registry (cpu backend, import-only — no device work) and checks the
+invariants the static pass cannot:
+
+  * no registration ever overwrote another (duplicate names/aliases),
+  * every public op is callable,
+  * every public op carries a docstring.
+"""
+from __future__ import annotations
+
+import os
+
+from .core import Violation, SEVERITY_ERROR, SEVERITY_WARNING
+
+REGISTRY_PATH = "mxnet_tpu/ops/registry.py"
+
+
+def run_registry_check():
+    """Import mxnet_tpu and validate the live registry.  Returns a list
+    of Violations (empty when healthy).  Import failures surface as a
+    single E0 violation rather than crashing the linter."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    try:
+        import mxnet_tpu  # noqa: F401  (imports populate the registry)
+        from mxnet_tpu.ops import registry
+    except Exception as e:  # pragma: no cover - environment-dependent
+        return [Violation(
+            rule="E0", severity=SEVERITY_ERROR, path=REGISTRY_PATH,
+            line=0, col=0, context="<import>",
+            message=f"could not import mxnet_tpu for the runtime "
+                    f"registry check: {e}")]
+
+    violations = []
+
+    def emit(message, severity=SEVERITY_ERROR, context="<registry>"):
+        violations.append(Violation(
+            rule="T3", severity=severity, path=REGISTRY_PATH, line=0,
+            col=0, context=context, message=message))
+
+    for name, prev, new in registry.duplicate_registrations():
+        emit(f"op name {name!r} registered twice (by {prev!r} then "
+             f"{new!r}) — the later registration shadows the earlier",
+             context=name)
+
+    for name in registry.list_ops():
+        fn = registry.get_op(name)
+        if not callable(fn):
+            emit(f"registry entry {name!r} is not callable", context=name)
+            continue
+        meta = registry.op_meta(name)
+        canonical = meta.get("canonical", name)
+        if name != canonical:
+            continue  # docstring lives on the canonical registration
+        if name.startswith("_"):
+            continue  # private/internal helper ops
+        if not (getattr(fn, "__doc__", None) or "").strip():
+            emit(f"op {name!r} has no docstring", severity=SEVERITY_WARNING,
+                 context=name)
+    return violations

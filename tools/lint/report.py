@@ -1,0 +1,47 @@
+"""Human and JSON reporters for mxlint."""
+from __future__ import annotations
+
+import json
+from collections import Counter
+
+from .rules import RULES
+
+
+def render_human(new, waived, stale, out):
+    for v in new:
+        out.write(f"{v.path}:{v.line}:{v.col + 1}: "
+                  f"{v.rule} [{v.severity}] {v.message}"
+                  f"  (in {v.context})\n")
+        if v.source:
+            out.write(f"    {v.source}\n")
+    by_rule = Counter(v.rule for v in new)
+    if new:
+        out.write("\n")
+        for rule in sorted(by_rule):
+            desc = RULES.get(rule, "tool error")
+            out.write(f"  {rule}: {by_rule[rule]:>3}  {desc}\n")
+        out.write(f"\nmxlint: {len(new)} new violation"
+                  f"{'s' if len(new) != 1 else ''}"
+                  f" ({len(waived)} waived by baseline)\n")
+    else:
+        out.write(f"mxlint: clean ({len(waived)} waived by baseline)\n")
+    if stale:
+        out.write(f"note: {len(stale)} baseline waiver"
+                  f"{'s' if len(stale) != 1 else ''} no longer match — "
+                  "debt was fixed; run --update-baseline to prune.\n")
+
+
+def render_json(new, waived, stale, out):
+    payload = {
+        "new": [v.to_dict() for v in new],
+        "waived": [v.to_dict() for v in waived],
+        "stale_waivers": list(stale),
+        "summary": {
+            "new": len(new),
+            "waived": len(waived),
+            "stale": len(stale),
+            "by_rule": dict(Counter(v.rule for v in new)),
+        },
+    }
+    json.dump(payload, out, indent=2)
+    out.write("\n")

@@ -1,0 +1,544 @@
+"""mxlint rule families.
+
+T1  host-sync calls (``asnumpy``/``.item()``/``np.asarray``/
+    ``jax.device_get``/``block_until_ready``/``float()``...) — errors
+    inside traced regions, warnings for unambiguous syncs anywhere else.
+T2  python ``if``/``while``/``assert`` on traced values inside traced
+    regions (the trace either fails to concretize or silently bakes one
+    branch into every execution).
+T3  op-registry consistency: registrations must be unique, documented,
+    and ops whose pure body is non-differentiable must carry an explicit
+    ``no_grad=True`` marker (mxnet_tpu/ops/registry.py) instead of
+    silently producing garbage cotangents.
+T4  nondeterminism inside traced regions: host ``time.*`` or
+    ``random``/``np.random`` calls get baked in as trace-time constants —
+    every execution replays the same "random" numbers.
+T5  in-place numpy mutation of jax-backed buffers (``x.asnumpy()[i] = v``
+    mutates a host copy — or a read-only view — never device memory).
+"""
+from __future__ import annotations
+
+import ast
+
+from .core import (Violation, SEVERITY_ERROR, SEVERITY_WARNING, dotted_name,
+                   last_name)
+from .hotpath import FunctionIndex, function_taint, expr_tainted
+
+RULES = {
+    "T1": "host-sync call reachable from a traced hot path",
+    "T2": "python control flow on a traced value",
+    "T3": "op-registry inconsistency (docstring / duplicate / grad path)",
+    "T4": "host nondeterminism inside a traced region",
+    "T5": "in-place numpy mutation of a jax-backed buffer",
+}
+
+# --- T1 ---------------------------------------------------------------------
+
+#: method-style syncs: ``x.asnumpy()``, ``x.item()``, ...
+SYNC_METHODS = {"asnumpy", "asscalar", "item", "tolist",
+                "block_until_ready", "wait_to_read", "wait_to_write"}
+
+#: syncs unambiguous enough to warn about even in eager glue code
+SYNC_METHODS_ANYWHERE = {"asnumpy", "asscalar", "item",
+                         "block_until_ready"}
+
+#: function-style syncs, matched on dotted name
+SYNC_FUNCS_ANYWHERE = {"jax.device_get"}
+SYNC_FUNCS_TRACED = {"np.asarray", "numpy.asarray", "onp.asarray",
+                     "_np.asarray", "np.array", "numpy.array",
+                     "jax.device_get"}
+
+#: builtins that force a tracer to a host scalar
+SCALAR_BUILTINS = {"float", "int", "bool"}
+
+
+# --- T4 ---------------------------------------------------------------------
+
+_TIME_LAST = {"time", "perf_counter", "monotonic", "process_time",
+              "time_ns", "perf_counter_ns", "now", "utcnow", "today"}
+_NP_RANDOM_PREFIXES = ("np.random.", "numpy.random.", "onp.random.",
+                       "_np.random.")
+
+
+def _is_nondet_call(dotted: str) -> bool:
+    if not dotted:
+        return False
+    if dotted.startswith(_NP_RANDOM_PREFIXES):
+        return True
+    if dotted.startswith("random."):
+        return True  # stdlib random (jax.random is keyed => deterministic)
+    if dotted.startswith(("time.", "datetime.")) and \
+            dotted.rsplit(".", 1)[-1] in _TIME_LAST:
+        return True
+    return False
+
+
+# --- T3 ---------------------------------------------------------------------
+
+#: jnp/lax calls whose output carries no useful cotangent: an op whose
+#: pure body *returns* one of these needs an explicit no_grad marker
+NONDIFF_CALLS = {"argmax", "argmin", "argsort", "sign", "floor", "ceil",
+                 "round", "rint", "trunc", "searchsorted", "nonzero",
+                 "logical_not", "logical_and", "logical_or", "logical_xor",
+                 "isnan", "isinf", "isfinite", "equal", "not_equal",
+                 "greater", "greater_equal", "less", "less_equal",
+                 "one_hot", "bincount", "sort_key_val"}
+
+#: wrappers transparent to differentiability: ``nondiff(...).astype(...)``
+#: is still nondiff
+_TRANSPARENT_WRAPPERS = {"astype", "reshape", "moveaxis", "swapaxes",
+                         "transpose", "squeeze", "expand_dims", "ravel"}
+
+
+class Registration:
+    """One static ``@defop`` / ``_export`` site."""
+
+    __slots__ = ("name", "aliases", "no_grad", "func_node", "path", "line",
+                 "col", "dynamic")
+
+    def __init__(self, name, aliases, no_grad, func_node, path, line, col,
+                 dynamic=False):
+        self.name = name
+        self.aliases = aliases
+        self.no_grad = no_grad
+        self.func_node = func_node
+        self.path = path
+        self.line = line
+        self.col = col
+        self.dynamic = dynamic
+
+
+def _const_str(node):
+    return node.value if isinstance(node, ast.Constant) and \
+        isinstance(node.value, str) else None
+
+
+def _const_str_tuple(node):
+    if isinstance(node, (ast.Tuple, ast.List)):
+        vals = [_const_str(e) for e in node.elts]
+        if all(v is not None for v in vals):
+            return tuple(vals)
+    return None
+
+
+def _kw(call, name):
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def collect_registrations(src, index: FunctionIndex):
+    """Find every static op-registration site in a file.
+
+    Handles both exporter idioms in this codebase:
+      * registry-style  ``_export(fn, name="x", aliases=(...), no_grad=True)``
+        and the ``@defop("x", aliases=..., no_grad=...)`` decorator;
+      * elemwise-style  ``_export("x", fn, aliases, no_grad=True)``
+        (string first).
+    Registrations whose name is computed (a loop variable) are recorded as
+    ``dynamic`` and left to the runtime registry check.
+    """
+    regs = []
+    decorator_calls = set()
+    for node in ast.walk(src.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for deco in node.decorator_list:
+                if isinstance(deco, ast.Call) and \
+                        last_name(deco.func) == "defop":
+                    decorator_calls.add(id(deco))
+                    name = None
+                    if deco.args:
+                        name = _const_str(deco.args[0])
+                    kw_name = _kw(deco, "name")
+                    if kw_name is not None:
+                        name = _const_str(kw_name)
+                    regs.append(_make_reg(name or node.name, deco, node,
+                                          src.path))
+                elif last_name(deco) == "defop":
+                    regs.append(Registration(node.name, (), False, node,
+                                             src.path, node.lineno,
+                                             node.col_offset))
+        if not isinstance(node, ast.Call) or id(node) in decorator_calls:
+            continue
+        if last_name(node.func) not in ("_export", "_export_fn", "defop"):
+            continue
+        if not node.args:
+            continue
+        first = node.args[0]
+        if _const_str(first) is not None:
+            # elemwise-style: _export(name, fn, aliases=...)
+            fn_node = node.args[1] if len(node.args) > 1 else None
+            regs.append(_make_reg(_const_str(first), node,
+                                  _resolve_func(fn_node, index), src.path,
+                                  alias_pos=2))
+        elif isinstance(first, (ast.Name, ast.Lambda, ast.Attribute)):
+            name_expr = _kw(node, "name")
+            if name_expr is None and len(node.args) > 1:
+                name_expr = node.args[1]
+            if name_expr is not None:
+                name = _const_str(name_expr)
+                dynamic = name is None
+            else:
+                name = last_name(first) if not isinstance(first, ast.Lambda) \
+                    else None
+                dynamic = name is None
+            regs.append(_make_reg(name, node,
+                                  _resolve_func(first, index), src.path,
+                                  dynamic=dynamic))
+        else:
+            # _export(_scalar_op(_name, _fn), name=_name): fully dynamic
+            regs.append(Registration(None, (), False, None, src.path,
+                                     node.lineno, node.col_offset,
+                                     dynamic=True))
+    return regs
+
+
+def _make_reg(name, call, func_node, path, alias_pos=None, dynamic=False):
+    aliases = ()
+    alias_expr = _kw(call, "aliases")
+    if alias_expr is None and alias_pos is not None and \
+            len(call.args) > alias_pos:
+        alias_expr = call.args[alias_pos]
+    if alias_expr is not None:
+        aliases = _const_str_tuple(alias_expr) or ()
+    ng_expr = _kw(call, "no_grad")
+    no_grad = isinstance(ng_expr, ast.Constant) and ng_expr.value is True
+    return Registration(name, aliases, no_grad, func_node, path,
+                        call.lineno, call.col_offset, dynamic=dynamic)
+
+
+def _resolve_func(node, index: FunctionIndex):
+    if isinstance(node, ast.Lambda):
+        return node
+    if isinstance(node, (ast.Name, ast.Attribute)):
+        cands = index.by_name.get(last_name(node), ())
+        if len(cands) == 1:
+            return cands[0]
+    return None
+
+
+def _returns_nondiff(expr, func_node, _depth=0) -> bool:
+    """Does ``expr`` (a return value) derive directly from a
+    non-differentiable primitive?  Unwraps dtype/layout-transparent
+    wrappers and follows one level of local assignment."""
+    if _depth > 4 or expr is None:
+        return False
+    if isinstance(expr, ast.Compare):
+        return True
+    if isinstance(expr, ast.Call):
+        name = last_name(expr.func)
+        if name in NONDIFF_CALLS:
+            return True
+        if name in _TRANSPARENT_WRAPPERS and \
+                isinstance(expr.func, ast.Attribute):
+            return _returns_nondiff(expr.func.value, func_node, _depth + 1)
+        return False
+    if isinstance(expr, ast.Name):
+        assigned = None
+        for n in ast.walk(func_node):
+            if isinstance(n, ast.Assign) and any(
+                    isinstance(t, ast.Name) and t.id == expr.id
+                    for t in n.targets):
+                assigned = n.value
+        return _returns_nondiff(assigned, func_node, _depth + 1)
+    if isinstance(expr, ast.Attribute):
+        return _returns_nondiff(expr.value, func_node, _depth + 1)
+    return False
+
+
+def _pure_bodies(func_node, index: FunctionIndex):
+    """Inner callables handed to ``apply_op`` inside an op wrapper — the
+    functions that actually trace."""
+    out = []
+    for call in ast.walk(func_node):
+        if isinstance(call, ast.Call) and \
+                last_name(call.func) == "apply_op" and call.args:
+            inner = call.args[0]
+            if isinstance(inner, ast.Lambda):
+                out.append(inner)
+            elif isinstance(inner, ast.Name):
+                resolved = _resolve_func(inner, index)
+                if resolved is not None and resolved is not func_node:
+                    out.append(resolved)
+    return out
+
+
+def _all_returns_nondiff(fn) -> bool:
+    if isinstance(fn, ast.Lambda):
+        return _returns_nondiff(fn.body, fn)
+    returns = [n for n in ast.walk(fn) if isinstance(n, ast.Return)
+               and n.value is not None]
+    if not returns:
+        return False
+    return all(_returns_nondiff(r.value, fn) for r in returns)
+
+
+# ---------------------------------------------------------------------------
+# Per-file rule driver
+# ---------------------------------------------------------------------------
+
+class FileChecker:
+    """Runs T1/T2/T4/T5 over one parsed file and collects T3
+    registrations for the cross-file pass."""
+
+    def __init__(self, src, enabled=None):
+        self.src = src
+        self.enabled = enabled
+        self.index = FunctionIndex(src.tree)
+        self.violations = []
+        self.registrations = []
+        self._taint_cache = {}
+
+    def _on(self, rule):
+        return self.enabled is None or rule in self.enabled
+
+    def run(self):
+        if self._on("T3"):
+            self.registrations = collect_registrations(self.src, self.index)
+        t5_taint = self._t5_taint() if self._on("T5") else {}
+        for node in ast.walk(self.src.tree):
+            hot = self.index.in_traced_region(node)
+            if isinstance(node, ast.Call):
+                if self._on("T1"):
+                    self._check_t1(node, hot)
+                if self._on("T4") and hot:
+                    self._check_t4(node)
+                if self._on("T5"):
+                    self._check_t5_mutator_call(node, t5_taint)
+            elif isinstance(node, (ast.If, ast.While, ast.Assert)) and hot:
+                if self._on("T2"):
+                    self._check_t2(node)
+            elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                if self._on("T5"):
+                    self._check_t5_store(node, t5_taint)
+        return self.violations
+
+    def _emit(self, rule, severity, node, message):
+        line = getattr(node, "lineno", 0)
+        if self.src.is_suppressed(rule, line):
+            return
+        self.violations.append(Violation(
+            rule=rule, severity=severity, path=self.src.path, line=line,
+            col=getattr(node, "col_offset", 0),
+            context=self.index.qualname_of(node), message=message,
+            source=self.src.line_text(line)))
+
+    # -- T1 ------------------------------------------------------------------
+    def _check_t1(self, call, hot):
+        func = call.func
+        dotted = dotted_name(func)
+        if isinstance(func, ast.Attribute):
+            meth = func.attr
+            if hot and meth in SYNC_METHODS:
+                self._emit("T1", SEVERITY_ERROR, call,
+                           f".{meth}() forces a host sync inside a traced "
+                           "hot path")
+                return
+            if not hot and meth in SYNC_METHODS_ANYWHERE:
+                self._emit("T1", SEVERITY_WARNING, call,
+                           f".{meth}() blocks on the dispatch queue; keep "
+                           "it out of per-step loops or waiver it")
+                return
+        if hot and dotted in SYNC_FUNCS_TRACED:
+            self._emit("T1", SEVERITY_ERROR, call,
+                       f"{dotted}() on a traced value concretizes the "
+                       "tracer (host sync) inside a hot path")
+            return
+        if not hot and dotted in SYNC_FUNCS_ANYWHERE:
+            self._emit("T1", SEVERITY_WARNING, call,
+                       f"{dotted}() is a blocking device->host transfer")
+            return
+        if hot and isinstance(func, ast.Name) and \
+                func.id in SCALAR_BUILTINS and len(call.args) == 1 and \
+                not isinstance(call.args[0], ast.Constant):
+            fn_node = self.index.enclosing_function(call)
+            taint = self._taint_for(fn_node)
+            if fn_node is not None and expr_tainted(call.args[0], taint):
+                self._emit("T1", SEVERITY_ERROR, call,
+                           f"{func.id}() on a traced value forces a host "
+                           "sync / concretization inside a hot path")
+
+    # -- T2 ------------------------------------------------------------------
+    def _taint_for(self, fn_node):
+        if fn_node is None:
+            return set()
+        key = id(fn_node)
+        if key not in self._taint_cache:
+            if isinstance(fn_node, ast.Lambda):
+                taint = {a.arg for a in fn_node.args.args}
+            else:
+                taint = function_taint(fn_node)
+            self._taint_cache[key] = taint
+        return self._taint_cache[key]
+
+    def _check_t2(self, node, ):
+        fn_node = self.index.enclosing_function(node)
+        if fn_node is None:
+            return
+        taint = self._taint_for(fn_node)
+        test = node.test
+        if expr_tainted(test, taint):
+            kind = {ast.If: "if", ast.While: "while",
+                    ast.Assert: "assert"}[type(node)]
+            self._emit("T2", SEVERITY_ERROR, node,
+                       f"python `{kind}` on a traced value inside a traced "
+                       "region — use lax.cond/jnp.where or hoist the check "
+                       "out of the trace")
+
+    # -- T4 ------------------------------------------------------------------
+    def _check_t4(self, call):
+        dotted = dotted_name(call.func)
+        if _is_nondet_call(dotted):
+            self._emit("T4", SEVERITY_ERROR, call,
+                       f"{dotted}() inside a traced region is evaluated "
+                       "once at trace time and baked in as a constant — "
+                       "thread a jax PRNG key / pass timestamps as inputs")
+
+    # -- T5 ------------------------------------------------------------------
+    def _t5_taint(self):
+        """Names assigned from host views of device buffers."""
+        taint = set()
+        for node in ast.walk(self.src.tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            if _is_host_view(node.value):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        taint.add(t.id)
+        return taint
+
+    def _check_t5_store(self, node, taint):
+        target = node.targets[0] if isinstance(node, ast.Assign) \
+            else node.target
+        root = _subscript_root(target)
+        if root is None:
+            return
+        if isinstance(root, ast.Name) and root.id in taint:
+            self._emit("T5", SEVERITY_ERROR, node,
+                       f"in-place mutation of `{root.id}`, a host view of "
+                       "a jax-backed buffer — the write never reaches "
+                       "device memory (copy first, or build a new array)")
+        elif _is_host_view(root):
+            self._emit("T5", SEVERITY_ERROR, node,
+                       "subscript-assign into a fresh host view of a "
+                       "jax-backed buffer — the write is discarded")
+
+    def _check_t5_mutator_call(self, call, taint):
+        func = call.func
+        if isinstance(func, ast.Attribute) and \
+                func.attr in ("fill", "put", "itemset", "resize",
+                              "setfield", "partition"):
+            base = func.value
+            if isinstance(base, ast.Name) and base.id in taint:
+                self._emit("T5", SEVERITY_ERROR, call,
+                           f"`.{func.attr}()` mutates `{base.id}`, a host "
+                           "view of a jax-backed buffer")
+            elif _is_host_view(base):
+                self._emit("T5", SEVERITY_ERROR, call,
+                           f"`.{func.attr}()` mutates a fresh host view "
+                           "of a jax-backed buffer")
+        if dotted_name(func) in ("np.copyto", "numpy.copyto") and call.args:
+            dst = call.args[0]
+            if (isinstance(dst, ast.Name) and dst.id in taint) or \
+                    _is_host_view(dst):
+                self._emit("T5", SEVERITY_ERROR, call,
+                           "np.copyto into a host view of a jax-backed "
+                           "buffer — the write never reaches the device")
+
+
+def _subscript_root(target):
+    """For ``a[i]`` / ``a[i][j]`` / ``a.flat[i]`` return the base
+    expression ``a``; None if the target is a bare name/attribute."""
+    if not isinstance(target, ast.Subscript):
+        return None
+    base = target.value
+    while isinstance(base, ast.Subscript):
+        base = base.value
+    if isinstance(base, ast.Attribute) and base.attr == "flat":
+        base = base.value
+    return base
+
+
+def _is_host_view(expr) -> bool:
+    """``x.asnumpy()`` / ``jax.device_get(x)`` / ``np.asarray(x._data)``."""
+    if not isinstance(expr, ast.Call):
+        return False
+    func = expr.func
+    if isinstance(func, ast.Attribute) and func.attr == "asnumpy":
+        return True
+    dotted = dotted_name(func)
+    if dotted == "jax.device_get":
+        return True
+    if dotted in ("np.asarray", "numpy.asarray", "onp.asarray") and \
+            expr.args and isinstance(expr.args[0], ast.Attribute) and \
+            expr.args[0].attr == "_data":
+        return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# Cross-file T3 finalization
+# ---------------------------------------------------------------------------
+
+def check_registrations(all_regs, sources):
+    """Duplicate / docstring / grad-path checks over every static
+    registration collected in the run."""
+    violations = []
+    by_src = {s.path: s for s in sources}
+
+    def emit(reg, message, severity=SEVERITY_ERROR, context=None):
+        src = by_src.get(reg.path)
+        if src is not None and src.is_suppressed("T3", reg.line):
+            return
+        violations.append(Violation(
+            rule="T3", severity=severity, path=reg.path, line=reg.line,
+            col=reg.col, context=context or (reg.name or "<dynamic>"),
+            message=message,
+            source=src.line_text(reg.line) if src else ""))
+
+    seen = {}
+    for reg in all_regs:
+        if reg.dynamic or reg.name is None:
+            continue
+        for name in (reg.name,) + tuple(reg.aliases):
+            prev = seen.get(name)
+            if prev is not None and (prev.path, prev.line) != \
+                    (reg.path, reg.line):
+                emit(reg, f"op name {name!r} already registered at "
+                          f"{prev.path}:{prev.line} — duplicate "
+                          "registration shadows the original",
+                     context=name)
+            else:
+                seen[name] = reg
+        fn = reg.func_node
+        if fn is None:
+            continue
+        if not reg.name.startswith("_"):
+            doc = ast.get_docstring(fn) if not isinstance(fn, ast.Lambda) \
+                else None
+            if isinstance(fn, ast.Lambda):
+                emit(reg, f"op {reg.name!r} is registered as a bare lambda "
+                          "— give it a named, documented wrapper",
+                     severity=SEVERITY_WARNING)
+            elif not doc:
+                emit(reg, f"op {reg.name!r} has no docstring",
+                     severity=SEVERITY_WARNING)
+        if not reg.no_grad and not isinstance(fn, ast.Lambda):
+            from .hotpath import FunctionIndex as _FI  # local index reuse
+            src = by_src.get(reg.path)
+            index = getattr(src, "_mxlint_index", None)
+            if index is None and src is not None:
+                index = _FI(src.tree)
+                src._mxlint_index = index
+            bodies = _pure_bodies(fn, index) if index is not None else []
+            for body in bodies:
+                if _all_returns_nondiff(body):
+                    emit(reg, f"op {reg.name!r} returns a "
+                              "non-differentiable value but is not "
+                              "marked no_grad=True — mark it (or wire a "
+                              "custom vjp) so autograd skips the vjp "
+                              "trace instead of emitting garbage "
+                              "cotangents")
+                    break
+    return violations
