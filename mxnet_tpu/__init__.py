@@ -65,6 +65,7 @@ _LAZY = {
     "image": ".image",
     "parallel": ".parallel",
     "profiler": ".profiler",
+    "telemetry": ".telemetry",
     "monitor": ".monitor",
     "visualization": ".visualization",
     "viz": ".visualization",

@@ -31,6 +31,7 @@ masks are fresh per call (reference: ``FResourceRequest kParallelRandom``).
 from __future__ import annotations
 
 import re
+import sys
 import threading
 from collections import OrderedDict
 
@@ -38,6 +39,7 @@ import numpy as np
 
 from ..base import MXNetError
 from .. import autograd as ag
+from .. import telemetry
 from ..context import Context, current_context
 from ..ndarray import NDArray
 from .parameter import (Parameter, ParameterDict,
@@ -132,6 +134,13 @@ class _trace_guard:
 # ---------------------------------------------------------------------------
 # Block
 # ---------------------------------------------------------------------------
+
+def _active_profiler():
+    """The profiler module iff loaded AND running (Block.__call__ stays
+    hook-free otherwise — same contract as ops.registry._profiler_mod)."""
+    prof = sys.modules.get("mxnet_tpu.profiler")
+    return prof if prof is not None and prof.is_running() else None
+
 
 class Block:
     """Base class of all layers and models (reference: ``gluon.Block``)."""
@@ -333,7 +342,15 @@ class Block:
         # tuple() so a hook may detach itself mid-iteration (one-shot hooks)
         for hook in tuple(self._forward_pre_hooks.values()):
             hook(self, args)
-        out = self.forward(*args)
+        prof = _active_profiler()
+        if prof is None:
+            out = self.forward(*args)
+        else:
+            # profiler.Scope: ops (and telemetry spans) dispatched inside
+            # are prefixed with the block's name path ("net0:dense0:dot")
+            # instead of the anonymous default
+            with prof.Scope(prof.current_scope_prefix() + self._name):
+                out = self.forward(*args)
         for hook in tuple(self._forward_hooks.values()):
             hook(self, args, out)
         return out
@@ -390,6 +407,7 @@ class _CachedGraph:
         self.remat = remat
         self.struct = None
         self.aux_idx = ()
+        self._compiled = set()  # dispatch modes that already paid compile
         self._fwd = jax.jit(self._pure)
         self._fwd_rec = jax.jit(self._record_fwd)
         self._bwd = jax.jit(lambda vjp, cots: vjp(cots))
@@ -448,11 +466,21 @@ class _CachedGraph:
                 for a in args))
         # publish the operands' platform for platform-conditional ops
         # traced inside this graph (see registry.dispatch_platform)
-        with dispatch_platform(platform_of_raws(in_raws + p_raws)):
+        mode = "fwd_rec" if recording else "fwd"
+        first = mode not in self._compiled
+        # the first dispatch per mode runs trace+compile synchronously
+        # before returning, so its wall-time IS the compile cost; replay
+        # wall-time is the async enqueue of the cached executable
+        with telemetry.span("cachedop.compile" if first
+                            else "cachedop.replay"), \
+                dispatch_platform(platform_of_raws(in_raws + p_raws)):
             if recording:
                 outs, auxs, vjp = self._fwd_rec(p_raws, in_raws, key)
             else:
                 outs, auxs = self._fwd(p_raws, in_raws, key)
+        if first:
+            self._compiled.add(mode)
+            telemetry.count("cachedop.compile")
         for i, raw in zip(self.aux_idx, auxs):
             p_handles[i]._data = raw
         nd_outs = [NDArray(r) for r in outs]
@@ -526,9 +554,17 @@ class CachedOp:
                tuple((p.shape, str(np.dtype(p.dtype))) for p in params))
         g = self._graphs.get(sig)
         if g is None:
-            g = _CachedGraph(self.block, params, training,
-                             remat=bool(self.flags.get("remat", False)))
+            # a new (shapes, dtypes, mode, platform) signature: this call
+            # will trace + compile — the compile-churn signal BENCH
+            # regressions need attributed (retracing every step means an
+            # unstable signature, e.g. unpadded dynamic batch sizes)
+            telemetry.count("cachedop.cache_miss")
+            with telemetry.span("cachedop.build"):
+                g = _CachedGraph(self.block, params, training,
+                                 remat=bool(self.flags.get("remat", False)))
             self._graphs[sig] = g
+        else:
+            telemetry.count("cachedop.cache_hit")
         return g.run(args)
 
 

@@ -46,6 +46,7 @@ import numpy as np
 
 from .. import autograd as ag
 from .. import optimizer as opt
+from .. import telemetry
 from ..base import MXNetError
 from ..ndarray import NDArray
 from .block import _trace_guard
@@ -286,7 +287,9 @@ class FusedTrainStep:
                tuple((b.shape, str(b.dtype)) for b in batch))
         fn = self._jit_cache.get(sig)
         if fn is None:
-            fn = self._build(tuple(mp_flags))
+            telemetry.count("step_fusion.cache_miss")
+            with telemetry.span("step_fusion.build"):
+                fn = self._build(tuple(mp_flags))
             self._jit_cache[sig] = fn
 
         from .. import random as mxrand
@@ -306,13 +309,20 @@ class FusedTrainStep:
 
         snapshot = None if sig in self._validated_sigs else \
             self._snapshot()
+        telemetry.gauge("step_fusion.steps_per_execution", self.k)
+        telemetry.count("step_fusion.steps", self.k)
         try:
             # publish the operands' platform so platform-conditional ops
             # (pallas flash) route correctly inside the fused trace even
             # in a mixed-platform process
             from ..ops.registry import dispatch_platform, platform_of_raws
 
-            with dispatch_platform(platform_of_raws(w_raws)):
+            # first execution per signature traces + compiles the K-step
+            # program (and hard-syncs for validation); steady state is a
+            # single async replay dispatch per K steps
+            with telemetry.span("step_fusion.compile" if snapshot is not None
+                                else "step_fusion.replay"), \
+                    dispatch_platform(platform_of_raws(w_raws)):
                 (new_w, new_m, new_s, new_aux, _new_t), losses = fn(
                     w_raws, m_raws, s_raws, aux_raws, t_v, key, lr_v,
                     wd_v, consts, stacked if stacked else None)
@@ -335,6 +345,7 @@ class FusedTrainStep:
                 # would add a device->host transfer to the stall).
                 losses.block_until_ready()  # mxlint: allow=T1
                 self._validated_sigs.add(sig)
+                telemetry.count("step_fusion.compile")
             return NDArray(losses)
         except Exception:
             if snapshot is not None:

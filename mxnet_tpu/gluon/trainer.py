@@ -17,6 +17,7 @@ from __future__ import annotations
 from ..base import MXNetError
 from .parameter import Parameter, ParameterDict
 from .. import optimizer as opt
+from .. import telemetry
 
 __all__ = ["Trainer"]
 
@@ -154,16 +155,17 @@ class Trainer:
     def step(self, batch_size, ignore_stale_grad=False):
         """Allreduce gradients and apply one optimizer update, scaling
         gradients by 1/batch_size (reference: ``Trainer.step``)."""
-        # rescale is set BEFORE kvstore init: update_on_kvstore ships a
-        # pickled optimizer copy to the (possibly remote) server, so it must
-        # already carry the right rescale_grad at that point
-        self._optimizer.rescale_grad = self._scale / batch_size
-        if not self._kv_initialized:
-            self._init_kvstore()
-        if self._update_on_kvstore:
-            self._sync_kvstore_hparams()
-        self._allreduce_grads()
-        self._update(ignore_stale_grad)
+        with telemetry.span("trainer.step"):
+            # rescale is set BEFORE kvstore init: update_on_kvstore ships a
+            # pickled optimizer copy to the (possibly remote) server, so it
+            # must already carry the right rescale_grad at that point
+            self._optimizer.rescale_grad = self._scale / batch_size
+            if not self._kv_initialized:
+                self._init_kvstore()
+            if self._update_on_kvstore:
+                self._sync_kvstore_hparams()
+            self._allreduce_grads()
+            self._update(ignore_stale_grad)
 
     def allreduce_grads(self):
         if not self._kv_initialized:
@@ -177,18 +179,28 @@ class Trainer:
     def _allreduce_grads(self):
         if self._kvstore is None:
             return
-        reducer = getattr(self._kvstore, "allreduce_grads", None)
-        if reducer is not None:
-            # dist_tpu_sync: psum over the mesh (mxnet_tpu/parallel)
-            reducer([p for p in self._params if p.grad_req != "null"])
-            return
-        if self._update_on_kvstore:
-            return  # push happens in _update
-        for i, param in enumerate(self._params):
-            if param.grad_req != "null":
-                self._kvstore.init(i, param.grad())
-                self._kvstore.push(i, param.grad())
-                self._kvstore.pull(i, param.grad())
+        with telemetry.span("trainer.allreduce"):
+            reducer = getattr(self._kvstore, "allreduce_grads", None)
+            if telemetry.is_enabled() and reducer is None:
+                # gradient payload the push/pull path aggregates; stores
+                # with their own reducer (dist_tpu_sync) count the same
+                # payload as kvstore.allreduce_bytes — never both
+                telemetry.count("trainer.allreduce_bytes", sum(
+                    telemetry.nbytes_of(p._data.grad)
+                    for p in self._params
+                    if p.grad_req != "null" and p._data is not None and
+                    p._data.grad is not None))
+            if reducer is not None:
+                # dist_tpu_sync: psum over the mesh (mxnet_tpu/parallel)
+                reducer([p for p in self._params if p.grad_req != "null"])
+                return
+            if self._update_on_kvstore:
+                return  # push happens in _update
+            for i, param in enumerate(self._params):
+                if param.grad_req != "null":
+                    self._kvstore.init(i, param.grad())
+                    self._kvstore.push(i, param.grad())
+                    self._kvstore.pull(i, param.grad())
 
     def update(self, batch_size, ignore_stale_grad=False):
         if not self._kv_initialized:
@@ -200,6 +212,10 @@ class Trainer:
         self._update(ignore_stale_grad)
 
     def _update(self, ignore_stale_grad=False):
+        with telemetry.span("trainer.update"):
+            self._update_impl(ignore_stale_grad)
+
+    def _update_impl(self, ignore_stale_grad=False):
         if not self._update_on_kvstore and self._try_fused_update():
             return
         for i, param in enumerate(self._params):
@@ -282,7 +298,9 @@ class Trainer:
                tuple((w.shape, str(w.dtype)) for w in weights),
                tuple(len(s) for s in states))
         fn = self._fused_cache.get(sig)
-        if fn is None:
+        compiling = fn is None
+        if compiling:
+            telemetry.count("trainer.fused_cache_miss")
             flags = tuple(mp_flags)
 
             def fused(w_raws, m_raws, g_raws, s_raws, lr_v, wd_v, t_v):
@@ -303,8 +321,12 @@ class Trainer:
         lr_v = jnp.asarray(lrs, jnp.float32)
         wd_v = jnp.asarray(wds, jnp.float32)
         t_v = jnp.asarray(ts, jnp.int32)
-        new_w, new_m, new_s = fn(w_raws, m_raws, g_raws, s_raws, lr_v,
-                                 wd_v, t_v)
+        # first dispatch per signature pays trace+compile synchronously;
+        # replays are a single async dispatch
+        with telemetry.span("trainer.fused_compile" if compiling
+                            else "trainer.fused_update"):
+            new_w, new_m, new_s = fn(w_raws, m_raws, g_raws, s_raws, lr_v,
+                                     wd_v, t_v)
         opt._commit_param_updates(self, live, mp_flags, masters,
                                   new_w, new_m, new_s)
         return True

@@ -27,6 +27,7 @@ import numpy as np
 
 from ..base import MXNetError
 from ..ndarray import NDArray
+from .. import telemetry
 
 __all__ = ["KVStore", "create"]
 
@@ -69,7 +70,14 @@ class KVStore:
         """Aggregate value(s) into the store; with an updater installed the
         stored weight is updated in place (reference ``update_on_kvstore``
         server-side optimizer, SURVEY §3.4)."""
+        with telemetry.span("kvstore.push"):
+            self._push_impl(key, value, priority)
+
+    def _push_impl(self, key, value, priority=0):
         keys, values = _pairs(key, value)
+        if telemetry.is_enabled():
+            telemetry.count("kvstore.push_bytes",
+                            sum(telemetry.nbytes_of(v) for v in values))
         for k, v in zip(keys, values):
             k = self._key(k)
             if k not in self._store:
@@ -89,7 +97,14 @@ class KVStore:
                 self._store[k] = merged
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        with telemetry.span("kvstore.pull"):
+            self._pull_impl(key, out, priority, ignore_sparse)
+
+    def _pull_impl(self, key, out=None, priority=0, ignore_sparse=True):
         keys, outs = _pairs(key, out)
+        if telemetry.is_enabled():
+            telemetry.count("kvstore.pull_bytes",
+                            sum(telemetry.nbytes_of(o) for o in outs))
         for k, o in zip(keys, outs):
             k = self._key(k)
             if k not in self._store:

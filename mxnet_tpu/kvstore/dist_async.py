@@ -52,6 +52,7 @@ import numpy as np
 
 from ..base import MXNetError
 from ..ndarray import NDArray
+from .. import telemetry
 
 __all__ = ["AsyncPSKVStore", "PSServer", "serve_forever"]
 
@@ -544,20 +545,32 @@ class AsyncPSKVStore:
         """Non-blocking: enqueue and return (async PS contract)."""
         from . import _merge, _pairs
 
-        keys, values = _pairs(key, value)
-        for k, v in zip(keys, values):
-            merged = _compress_merged(self._compression, self._residuals,
-                                      self._key(k), _merge(v)) \
-                if self._compression is not None else _merge(v)
-            self._enqueue("push", self._key(k), _to_wire(merged))
+        with telemetry.span("kvstore.push"):
+            keys, values = _pairs(key, value)
+            if telemetry.is_enabled():
+                telemetry.count(
+                    "kvstore.push_bytes",
+                    sum(telemetry.nbytes_of(v) for v in values))
+            for k, v in zip(keys, values):
+                merged = _compress_merged(self._compression, self._residuals,
+                                          self._key(k), _merge(v)) \
+                    if self._compression is not None else _merge(v)
+                self._enqueue("push", self._key(k), _to_wire(merged))
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         """Blocking; reflects this worker's completed pushes (per-worker
         FIFO), may be stale w.r.t. other workers — dist_async semantics."""
+        with telemetry.span("kvstore.pull"):
+            self.wait_all()
+            self._pull_impl(key, out)
+
+    def _pull_impl(self, key, out):
         from . import _assign, _pairs
 
-        self.wait_all()
         keys, outs = _pairs(key, out)
+        if telemetry.is_enabled():
+            telemetry.count("kvstore.pull_bytes",
+                            sum(telemetry.nbytes_of(o) for o in outs))
         for k, o in zip(keys, outs):
             stored = _from_wire(self._rpc("pull", self._key(k)))
             for target in (o if isinstance(o, (list, tuple)) else [o]):

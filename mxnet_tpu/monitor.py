@@ -98,19 +98,59 @@ class Monitor:
         if not self.activated:
             return []
         self.activated = False
-        # also record matching parameters/grads queued by stat hooks
-        res = []
-        for step, name, arr in self.queue:
-            try:
-                s = str(arr.asnumpy().ravel()[:1][0]) \
-                    if hasattr(arr, "asnumpy") else str(arr)
-            except Exception as e:  # stat on in-graph array mid-trace
-                s = f"<unreadable: {e}>"
-            res.append((step, name, s))
+        stats = self._gather_stats([arr for _, _, arr in self.queue])
+        res = [(step, name, s)
+               for (step, name, _), s in zip(self.queue, stats)]
         if self.sort:
             res.sort(key=lambda t: t[1])
         self.queue = []
         return res
+
+    @staticmethod
+    def _gather_stats(arrs):
+        """Stringify queued stats with ONE device→host transfer for all
+        NDArray entries (stats stay on device until here — per-entry
+        ``asnumpy`` would sync once per monitored layer)."""
+        import numpy as np
+
+        out = [None] * len(arrs)
+        raws, slots = [], []
+        for i, arr in enumerate(arrs):
+            raw = getattr(arr, "_data", None)
+            if hasattr(arr, "asnumpy") and raw is not None:
+                raws.append(raw)
+                slots.append(i)
+            elif hasattr(arr, "asnumpy"):
+                try:
+                    out[i] = str(  # mxlint: allow=T1 (no raw buffer)
+                        arr.asnumpy().ravel()[:1][0])
+                except Exception as e:  # stat on in-graph array mid-trace
+                    out[i] = f"<unreadable: {e}>"
+            else:
+                out[i] = str(arr)
+        if raws:
+            try:
+                import jax
+
+                from . import telemetry
+
+                telemetry.count("host_sync")
+                hosts = jax.device_get(raws)  # mxlint: allow=T1
+            except Exception:
+                hosts = None  # tracer in queue: fall back per entry
+            for j, i in enumerate(slots):
+                if hosts is not None:
+                    try:
+                        out[i] = str(np.asarray(hosts[j]).ravel()[:1][0])
+                    except Exception as e:
+                        out[i] = f"<unreadable: {e}>"
+                else:
+                    try:
+                        out[i] = str(  # mxlint: allow=T1 (fallback)
+                            arrs[i].asnumpy().ravel()[:1][0])
+                    except Exception as e:
+                        out[i] = f"<unreadable: {e}>"
+        return out
 
     def toc_print(self):
         for step, name, stat in self.toc():

@@ -26,6 +26,7 @@ import numpy as np
 
 from ..base import MXNetError, resolve_dtype
 from ..context import Context, current_context
+from .. import telemetry
 
 
 def _ctx_from_raw(raw) -> Context:
@@ -147,6 +148,7 @@ class NDArray:
     def asnumpy(self) -> np.ndarray:
         """Blocking device→host copy (reference: ``WaitToRead`` + copy,
         src/ndarray/ndarray.cc:?)."""
+        telemetry.count("host_sync")
         return np.asarray(self._data)
 
     def asscalar(self):
@@ -159,6 +161,7 @@ class NDArray:
 
     def wait_to_read(self):
         """Block until the value is computed (engine ``WaitForVar`` analog)."""
+        telemetry.count("host_sync")
         try:
             self._data.block_until_ready()
         except AttributeError:

@@ -42,6 +42,7 @@ import numpy as np
 from ..base import MXNetError
 from ..context import Context
 from ..ndarray import NDArray
+from .. import telemetry
 
 __all__ = ["initialize", "make_mesh", "set_mesh", "current_mesh",
            "mesh_scope", "shard_batch", "replicate", "shard_param",
@@ -355,8 +356,18 @@ class TPUSyncKVStore:
     # enabled, quantize BEFORE the cross-host hop (per-param residual),
     # exactly what the reference's compressed worker→server hop delivers.
     def allreduce_grads(self, params):
+        with telemetry.span("kvstore.allreduce"):
+            return self._allreduce_grads_impl(params)
+
+    def _allreduce_grads_impl(self, params):
         import jax
 
+        if telemetry.is_enabled():
+            telemetry.count(
+                "kvstore.allreduce_bytes",
+                sum(telemetry.nbytes_of(g)
+                    for p in params
+                    for g in {id(g): g for g in p.list_grad()}.values()))
         if self._compression is not None:
             for p in params:
                 # list_grad repeats the SAME handle per ctx — dedupe so

@@ -113,6 +113,31 @@ def record_op_event(name, dur_s, cat="operator"):
         a[3] = max(a[3], dur_s * 1e3)
 
 
+def record_span_event(name, start_s, dur_s, cat="telemetry", args=None):
+    """Mirror a completed telemetry span into the chrome-trace buffer
+    (and the aggregate table) so trainer-phase spans and op-dispatch
+    events share one timeline.  ``start_s`` is the span's
+    ``time.perf_counter()`` entry stamp — same timebase as ``_t0``."""
+    if _state != "run":
+        return
+    with _lock:
+        if _t0 is None:
+            return
+        event = {
+            "name": name, "cat": cat, "ph": "X",
+            "ts": (start_s - _t0) * 1e6, "dur": dur_s * 1e6,
+            "pid": os.getpid(), "tid": threading.get_ident() % 100000,
+        }
+        if args:
+            event["args"] = {k: str(v) for k, v in args.items()}
+        _events.append(event)
+        a = _agg[name]
+        a[0] += 1
+        a[1] += dur_s * 1e3
+        a[2] = min(a[2], dur_s * 1e3)
+        a[3] = max(a[3], dur_s * 1e3)
+
+
 def dump(finished=True, profile_process="worker"):
     """Write chrome://tracing JSON to ``filename`` (reference
     ``profiler.dump``).  ``finished=True`` ends the profile: the event
@@ -130,18 +155,33 @@ def dump(finished=True, profile_process="worker"):
 
 
 def dumps(reset=False, format="table"):
-    """Aggregate per-op stats as a text table (reference
-    ``profiler.dumps`` with ``aggregate_stats=True``)."""
+    """Aggregate per-event stats (reference ``profiler.dumps`` with
+    ``aggregate_stats=True``).  ``format="table"`` (default) returns the
+    fixed-width text table; ``format="json"`` returns a JSON object
+    string mapping event name -> {count, total_ms, min_ms, max_ms,
+    avg_ms} for machine consumption."""
+    if format not in ("table", "json"):
+        raise MXNetError(
+            f"unknown dumps format {format!r}; expected 'table' or 'json'")
     with _lock:
         rows = sorted(_agg.items(), key=lambda kv: -kv[1][1])
-        out = [f"{'Name':<40}{'Total Count':>12}{'Total(ms)':>12}"
-               f"{'Min(ms)':>10}{'Max(ms)':>10}{'Avg(ms)':>10}"]
-        for name, (n, tot, mn, mx) in rows:
-            out.append(f"{name[:39]:<40}{n:>12}{tot:>12.3f}{mn:>10.3f}"
-                       f"{mx:>10.3f}{tot / max(n, 1):>10.3f}")
+        if format == "json":
+            payload = {
+                name: {"count": n, "total_ms": tot, "min_ms": mn,
+                       "max_ms": mx, "avg_ms": tot / max(n, 1)}
+                for name, (n, tot, mn, mx) in rows}
+            out = json.dumps(payload)
+        else:
+            lines = [f"{'Name':<40}{'Total Count':>12}{'Total(ms)':>12}"
+                     f"{'Min(ms)':>10}{'Max(ms)':>10}{'Avg(ms)':>10}"]
+            for name, (n, tot, mn, mx) in rows:
+                lines.append(f"{name[:39]:<40}{n:>12}{tot:>12.3f}"
+                             f"{mn:>10.3f}{mx:>10.3f}"
+                             f"{tot / max(n, 1):>10.3f}")
+            out = "\n".join(lines)
         if reset:
             _agg.clear()  # aggregate stats only; dump() still sees events
-    return "\n".join(out)
+    return out
 
 
 class Scope:

@@ -1,0 +1,386 @@
+"""Training telemetry: spans, counters, gauges and per-step records.
+
+Reference analog: ``src/profiler/profiler.{h,cc}`` wraps every engine
+operation in profiler events (SURVEY §5).  This subsystem is the
+TPU-native equivalent one level up — at the phases that dominate a fused
+TPU training step: trainer phases (``trainer.step`` /
+``trainer.allreduce`` / ``trainer.update``), CachedOp compile-cache
+behavior, step-fusion build/replay, kvstore push/pull/allreduce, and the
+host-sync count on ``asnumpy``/``wait_to_read``.  Per-op dispatch events
+remain the profiler's job (``ops.registry.apply_op``); both layers land
+in ONE chrome trace.
+
+Design constraints (load-bearing — every hot path in the runtime calls
+into this module on every step):
+
+* **Near-zero cost when disabled.**  The disabled path of every public
+  recorder is a single module-global boolean check and an immediate
+  return: no lock, no allocation (``span()`` hands back a shared
+  singleton null context manager), no ``sys.modules`` probing.  The
+  tier-1 suite guards this (``tests/test_telemetry.py``).
+* **Thread-safe when enabled.**  Counters/gauges/phase accumulation
+  take one module lock; span nesting state is thread-local.
+* **Host-side only.**  Recording never touches device buffers, never
+  syncs, and is legal inside traced regions (``tools/lint`` knows this
+  — telemetry/profiler recording calls are exempt from the hot-path
+  rules; see docs/lint.md).
+
+Two sinks:
+
+* the profiler's chrome-trace event buffer — when ``profiler`` is
+  running, every completed span is mirrored as a ``ph="X"`` event, so
+  trainer-phase spans and per-op dispatch events render on one timeline
+  (open ``profile.json`` in chrome://tracing or Perfetto);
+* a JSONL structured-log sink (``enable(jsonl_path=...)``) emitting one
+  record per ``step_begin()``/``step_end()`` pair: step wall-time,
+  per-phase breakdown, per-step counter deltas, examples/sec, compile
+  count, host-sync count and allreduce bytes.  Schema in
+  docs/observability.md.
+
+Typical use::
+
+    from mxnet_tpu import telemetry
+
+    telemetry.enable(jsonl_path="train_telemetry.jsonl")
+    for batch in loader:
+        with telemetry.step(examples=batch_size):
+            with autograd.record():
+                loss = loss_fn(net(x), y)
+            loss.backward()
+            trainer.step(batch_size)
+    telemetry.disable()
+
+Env autostart (mirrors ``MXNET_PROFILER_AUTOSTART``):
+``MXNET_TELEMETRY=1`` enables at import, with
+``MXNET_TELEMETRY_JSONL`` naming the structured-log path.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+
+from .sinks import JsonlSink, read_jsonl  # noqa: F401  (re-exported)
+
+__all__ = ["enable", "disable", "is_enabled", "span", "count", "gauge",
+           "step", "step_begin", "step_end", "counters", "gauges",
+           "phases", "reset", "current_span", "JsonlSink", "read_jsonl"]
+
+# -- state -------------------------------------------------------------------
+# _enabled is read unlocked on every recorder's fast path; it is only
+# ever flipped under _lock, and python attribute stores are atomic, so
+# the worst case is one recording racing an enable/disable boundary.
+
+_enabled = False
+_lock = threading.Lock()
+_counters = {}        # cumulative: name -> number
+_gauges = {}          # last-value: name -> number
+_step_counters = {}   # deltas since step_begin
+_step_phases = {}     # span name -> accumulated seconds since step_begin
+_step_idx = 0
+_step_t0 = None
+_step_wall = None
+_sinks = []
+_tls = threading.local()
+
+
+def _span_stack():
+    stack = getattr(_tls, "spans", None)
+    if stack is None:
+        stack = _tls.spans = []
+    return stack
+
+
+def _active_profiler():
+    """The profiler module iff it is imported AND running — the same
+    contract as ``ops.registry._profiler_mod``: spans mirror into the
+    chrome trace only when the user is actually profiling."""
+    prof = sys.modules.get("mxnet_tpu.profiler")
+    return prof if prof is not None and prof.is_running() else None
+
+
+# -- spans -------------------------------------------------------------------
+
+class _NullSpan:
+    """Shared no-op context manager handed out while telemetry is
+    disabled: ``span()`` must not allocate on the disabled path."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def annotate(self, **attrs):
+        return self
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _Span:
+    """A live timed region.  Duration lands in the current step's phase
+    breakdown under ``name`` (accumulated across entries, so a span
+    entered once per param still yields one phase row), and is mirrored
+    into the profiler's chrome-trace buffer when profiling."""
+
+    __slots__ = ("name", "attrs", "t0", "_wall0")
+
+    def __init__(self, name, attrs):
+        self.name = name
+        self.attrs = attrs
+
+    def annotate(self, **attrs):
+        if self.attrs is None:
+            self.attrs = {}
+        self.attrs.update(attrs)
+        return self
+
+    def __enter__(self):
+        _span_stack().append(self)
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        dur = time.perf_counter() - self.t0
+        stack = _span_stack()
+        if stack and stack[-1] is self:
+            stack.pop()
+        if _enabled:
+            with _lock:
+                _step_phases[self.name] = \
+                    _step_phases.get(self.name, 0.0) + dur
+        prof = _active_profiler()
+        if prof is not None:
+            prof.record_span_event(
+                prof.current_scope_prefix() + self.name, self.t0, dur,
+                cat="telemetry", args=self.attrs)
+        return False
+
+
+def span(name, attrs=None):
+    """Context manager timing a named phase.  ``attrs`` (an optional
+    dict) rides into the chrome-trace event's ``args``.  Disabled ->
+    shared null singleton, zero allocation."""
+    if not _enabled:
+        return _NULL_SPAN
+    return _Span(name, attrs)
+
+
+def current_span():
+    """Innermost live span on this thread (None outside any span)."""
+    stack = getattr(_tls, "spans", None)
+    return stack[-1] if stack else None
+
+
+# -- counters / gauges -------------------------------------------------------
+
+def count(name, n=1):
+    """Increment counter ``name`` by ``n`` (cumulative + per-step)."""
+    if not _enabled:
+        return
+    with _lock:
+        _counters[name] = _counters.get(name, 0) + n
+        _step_counters[name] = _step_counters.get(name, 0) + n
+
+
+def gauge(name, value):
+    """Record the latest value of gauge ``name``."""
+    if not _enabled:
+        return
+    with _lock:
+        _gauges[name] = value
+
+
+def counters():
+    """Snapshot of cumulative counters."""
+    with _lock:
+        return dict(_counters)
+
+
+def gauges():
+    """Snapshot of gauges."""
+    with _lock:
+        return dict(_gauges)
+
+
+def phases():
+    """Snapshot of the current step's phase seconds."""
+    with _lock:
+        return dict(_step_phases)
+
+
+# -- step records ------------------------------------------------------------
+
+#: per-step counters summed into the record's ``compile_count`` field:
+#: every "this step paid a trace+compile" signal across the stack
+_COMPILE_COUNTERS = ("cachedop.compile", "step_fusion.compile",
+                     "trainer.fused_cache_miss")
+
+#: per-step counters summed into ``allreduce_bytes`` — the gradient
+#: payload the step moved (or had XLA move in-jit) for aggregation
+_ALLREDUCE_BYTE_COUNTERS = ("kvstore.allreduce_bytes",
+                            "trainer.allreduce_bytes")
+
+
+def step_begin():
+    """Open a step window: phase/counter deltas reset, wall clock
+    starts.  No-op while disabled."""
+    global _step_idx, _step_t0, _step_wall
+    if not _enabled:
+        return
+    with _lock:
+        _step_counters.clear()
+        _step_phases.clear()
+        _step_idx += 1
+        _step_t0 = time.perf_counter()
+        _step_wall = time.time()
+
+
+def step_end(examples=None, **extra):
+    """Close the step window and emit one structured record to every
+    sink.  ``examples`` (items consumed this step) turns into
+    ``examples_per_sec``; ``extra`` keys land verbatim in the record.
+    Returns the record dict (None while disabled / without step_begin)."""
+    if not _enabled:
+        return None
+    with _lock:
+        if _step_t0 is None:
+            return None
+        dur = time.perf_counter() - _step_t0
+        sc = dict(_step_counters)
+        record = {
+            "step": _step_idx,
+            "wall_time": _step_wall,
+            "step_ms": dur * 1e3,
+            "phases_ms": {k: v * 1e3 for k, v in _step_phases.items()},
+            "counters": sc,
+            "gauges": dict(_gauges),
+            "host_sync": sc.get("host_sync", 0),
+            "cachedop_cache_hit": sc.get("cachedop.cache_hit", 0),
+            "cachedop_cache_miss": sc.get("cachedop.cache_miss", 0),
+            "compile_count": sum(sc.get(k, 0) for k in _COMPILE_COUNTERS),
+            "allreduce_bytes": sum(sc.get(k, 0)
+                                   for k in _ALLREDUCE_BYTE_COUNTERS),
+        }
+        if examples is not None and dur > 0:
+            record["examples"] = examples
+            record["examples_per_sec"] = examples / dur
+        record.update(extra)
+        sinks = list(_sinks)
+    for s in sinks:
+        s.emit(record)
+    return record
+
+
+class _StepScope:
+    __slots__ = ("examples", "extra", "record")
+
+    def __init__(self, examples, extra):
+        self.examples = examples
+        self.extra = extra
+        self.record = None
+
+    def __enter__(self):
+        step_begin()
+        return self
+
+    def __exit__(self, exc_type, *exc):
+        if exc_type is None:
+            self.record = step_end(examples=self.examples, **self.extra)
+        return False
+
+
+def step(examples=None, **extra):
+    """``with telemetry.step(examples=batch_size):`` — step_begin on
+    entry, step_end (record emitted) on clean exit.  The emitted record
+    is available as ``scope.record`` after the block."""
+    if not _enabled:
+        return _NULL_SPAN
+    return _StepScope(examples, extra)
+
+
+# -- lifecycle ---------------------------------------------------------------
+
+def enable(jsonl_path=None, append=False):
+    """Turn recording on.  ``jsonl_path`` attaches a structured-log sink
+    writing one JSON line per step record (truncates unless ``append``).
+    Idempotent: re-enabling resets counters and swaps sinks."""
+    global _enabled
+    with _lock:
+        _reset_locked()
+        for s in _sinks:
+            s.close()
+        _sinks.clear()
+        if jsonl_path is not None:
+            _sinks.append(JsonlSink(jsonl_path, append=append))
+    _enabled = True
+
+
+def disable():
+    """Turn recording off and close sinks.  Instrumented call sites fall
+    back to the near-zero path immediately."""
+    global _enabled
+    _enabled = False
+    with _lock:
+        for s in _sinks:
+            s.close()
+        _sinks.clear()
+
+
+def is_enabled():
+    return _enabled
+
+
+def add_sink(sink):
+    """Attach an extra sink object (anything with ``emit(record)`` and
+    ``close()``) — e.g. an in-memory list collector for tests/tools."""
+    with _lock:
+        _sinks.append(sink)
+
+
+def reset():
+    """Zero counters/gauges/step state without touching sinks."""
+    with _lock:
+        _reset_locked()
+
+
+def _reset_locked():
+    global _step_idx, _step_t0, _step_wall
+    _counters.clear()
+    _gauges.clear()
+    _step_counters.clear()
+    _step_phases.clear()
+    _step_idx = 0
+    _step_t0 = None
+    _step_wall = None
+
+
+# -- helpers for instrumented sites -----------------------------------------
+
+def nbytes_of(value):
+    """Host-side payload size of an NDArray / sparse NDArray / raw array
+    / list of those — shape×itemsize arithmetic only, never a sync."""
+    if isinstance(value, (list, tuple)):
+        return sum(nbytes_of(v) for v in value)
+    data = getattr(value, "data", None)
+    if data is not None and hasattr(value, "indices"):
+        # sparse: count the materialized payload (values + indices)
+        total = nbytes_of(data) + nbytes_of(value.indices)
+        indptr = getattr(value, "indptr", None)
+        return total + (nbytes_of(indptr) if indptr is not None else 0)
+    raw = getattr(value, "_data", value)
+    try:
+        size = 1
+        for s in raw.shape:
+            size *= int(s)
+        return size * raw.dtype.itemsize
+    except Exception:
+        return 0
+
+
+if os.environ.get("MXNET_TELEMETRY", "0") == "1":
+    enable(jsonl_path=os.environ.get("MXNET_TELEMETRY_JSONL"))

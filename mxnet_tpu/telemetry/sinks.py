@@ -1,0 +1,86 @@
+"""Telemetry sinks: where per-step structured records go.
+
+The JSONL sink is the trajectory-analysis surface: one self-contained
+JSON object per line, so a regression in the BENCH trajectory can be
+attributed (compile churn vs. comms vs. host sync) by diffing two runs'
+logs with nothing fancier than ``jq``.  Schema documented in
+docs/observability.md; every record carries at least ``step``,
+``step_ms``, ``phases_ms``, ``counters``, ``host_sync``,
+``cachedop_cache_hit``/``cachedop_cache_miss``, ``compile_count`` and
+``allreduce_bytes``.
+
+The chrome-trace sink is not a class here: completed spans are mirrored
+straight into ``profiler``'s event buffer (see ``telemetry._Span``), so
+there is exactly one trace file and one timebase for op events and
+phase spans.
+"""
+from __future__ import annotations
+
+import json
+import threading
+
+
+class JsonlSink:
+    """Append one JSON line per step record to ``path``.
+
+    Writes are line-buffered and flushed per record — a crashed run
+    keeps every completed step, which is the whole point of a
+    structured flight recorder.  Thread-safe: concurrent ``step_end``
+    calls (multi-threaded input pipelines driving their own steps)
+    serialize on a sink-local lock rather than the telemetry module
+    lock, keeping file I/O out of the recording critical section.
+    """
+
+    def __init__(self, path, append=False):
+        self.path = path
+        self._lock = threading.Lock()
+        self._f = open(path, "a" if append else "w", encoding="utf-8")
+
+    def emit(self, record):
+        line = json.dumps(record, default=_json_default)
+        with self._lock:
+            if self._f is None:
+                return
+            self._f.write(line + "\n")
+            self._f.flush()
+
+    def close(self):
+        with self._lock:
+            if self._f is not None:
+                self._f.close()
+                self._f = None
+
+
+class ListSink:
+    """In-memory sink for tests and tooling: records accumulate on
+    ``.records``."""
+
+    def __init__(self):
+        self.records = []
+
+    def emit(self, record):
+        self.records.append(record)
+
+    def close(self):
+        pass
+
+
+def _json_default(obj):
+    """Best-effort coercion for numpy scalars and other number-likes
+    that land in counters/gauges; never raises out of the sink."""
+    try:
+        return float(obj)
+    except Exception:
+        return repr(obj)
+
+
+def read_jsonl(path):
+    """Parse a JSONL telemetry log back into a list of record dicts
+    (skipping blank lines) — the analysis-side inverse of JsonlSink."""
+    records = []
+    with open(path, "r", encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                records.append(json.loads(line))
+    return records

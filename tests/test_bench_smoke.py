@@ -126,6 +126,77 @@ def test_dryrun_multichip_inprocess_smoke(monkeypatch, capfd):
     assert "dryrun_multichip(2)" in out and "OK" in out, out
 
 
+def test_telemetry_disabled_step_overhead():
+    """Telemetry instrumentation rides the trainer/CachedOp/kvstore hot
+    path; disabled it must be within noise of the seed path.  Compare
+    the shipped (instrumented, telemetry off) step loop against the same
+    loop with every recorder stubbed to a bare no-op — best-of-repeats
+    to shed scheduler noise; the generous ratio bound catches a lock or
+    allocation sneaking onto the disabled path, not microsecond drift."""
+    import time
+
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon, nd, telemetry
+
+    telemetry.disable()
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(16, activation="relu"))
+    net.add(gluon.nn.Dense(4))
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.05})
+    rng = np.random.RandomState(0)
+    x = nd.array(rng.randn(8, 6).astype(np.float32))
+    y = nd.array(rng.randint(0, 4, (8,)))
+
+    def steps(n):
+        for _ in range(n):
+            with autograd.record():
+                loss = loss_fn(net(x), y)
+            loss.backward()
+            trainer.step(8)
+        loss.wait_to_read()
+
+    def best_of(repeats, n):
+        best = float("inf")
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            steps(n)
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    steps(3)  # pay trace+compile before any timing
+    instrumented = best_of(3, 20)
+
+    class _Null:
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *exc):
+            return False
+
+    null = _Null()
+    noop = lambda *a, **k: None  # noqa: E731
+    saved = {name: getattr(telemetry, name)
+             for name in ("span", "count", "gauge", "is_enabled")}
+    try:
+        telemetry.span = lambda *a, **k: null
+        telemetry.count = noop
+        telemetry.gauge = noop
+        telemetry.is_enabled = lambda: False
+        steps(3)
+        stubbed = best_of(3, 20)
+    finally:
+        for name, fn in saved.items():
+            setattr(telemetry, name, fn)
+
+    assert instrumented < stubbed * 3 + 0.01, (instrumented, stubbed)
+
+
 @pytest.mark.slow
 def test_graft_entry_compiles():
     """entry() returns (fn, args) that jit-lowers (what the driver

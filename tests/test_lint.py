@@ -90,6 +90,17 @@ def test_t5_flags_host_view_mutation():
     assert "good_update" not in contexts
 
 
+def test_recording_calls_allowed_in_hot_paths():
+    vs = _analyze("t6_recording.py")
+    contexts = {v.context for v in vs}
+    # recording helper + telemetry/profiler calls must NOT flag, even
+    # though instrumented_step is jitted and count() reads the clock
+    assert "count" not in contexts
+    assert "instrumented_step" not in contexts
+    # a direct wall-clock read in a traced body still flags
+    assert any(v.rule == "T4" and v.context == "bad_timed" for v in vs)
+
+
 def test_clean_fixture_has_no_violations():
     assert _analyze("clean.py") == []
 

@@ -1,0 +1,437 @@
+"""Telemetry subsystem: spans, counters, step records, the two sinks
+(profiler chrome-trace + JSONL structured log), and the near-zero
+disabled path.
+
+Acceptance shape (ISSUE 2): a hybridized + step-fused training run with
+telemetry enabled must produce (a) a chrome trace where trainer-phase
+spans and op-dispatch events share one timeline and (b) a JSONL log
+whose per-step records carry step_ms, the phase breakdown, CachedOp
+cache hits/misses, the host-sync count and allreduce bytes — while
+disabled telemetry adds no measurable overhead to the step loop.
+"""
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd, telemetry
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.telemetry.sinks import ListSink
+
+BATCH = 4
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    telemetry.disable()
+    telemetry.reset()
+    yield
+    telemetry.disable()
+    telemetry.reset()
+
+
+def _net(units=(8, 4), in_dim=6):
+    net = gluon.nn.HybridSequential()
+    for u in units[:-1]:
+        net.add(gluon.nn.Dense(u, activation="relu"))
+    net.add(gluon.nn.Dense(units[-1]))
+    net.initialize(mx.init.Xavier())
+    net(nd.ones((1, in_dim)))  # resolve deferred shapes
+    return net
+
+
+# --- disabled path ----------------------------------------------------------
+
+def test_disabled_span_is_shared_null_singleton():
+    assert not telemetry.is_enabled()
+    s = telemetry.span("trainer.step")
+    assert s is telemetry.span("anything.else")
+    with s as inner:
+        assert inner is s
+
+
+class _PoisonLock:
+    def __enter__(self):
+        raise AssertionError("disabled telemetry path took the lock")
+
+    def __exit__(self, *exc):
+        return False
+
+    acquire = __enter__
+
+
+def test_disabled_recorders_never_lock_or_record(monkeypatch):
+    """The disabled fast path is one boolean test — no lock, no state."""
+    monkeypatch.setattr(telemetry, "_lock", _PoisonLock())
+    telemetry.count("cachedop.cache_miss", 3)
+    telemetry.gauge("g", 1.0)
+    telemetry.step_begin()
+    assert telemetry.step_end(examples=8) is None
+    with telemetry.span("x"):
+        pass
+    with telemetry.step():
+        pass
+    monkeypatch.undo()
+    assert telemetry.counters() == {}
+    assert telemetry.gauges() == {}
+
+
+def test_disabled_overhead_bounded():
+    """1e4 disabled span+count pairs must be effectively free (generous
+    absolute bound: catches an accidental lock/allocation regression,
+    not scheduler noise)."""
+    t0 = time.perf_counter()
+    for _ in range(10_000):
+        with telemetry.span("trainer.step"):
+            telemetry.count("host_sync")
+    assert time.perf_counter() - t0 < 0.5
+
+
+# --- spans / counters / step records ----------------------------------------
+
+def test_span_nesting_and_phase_accumulation():
+    telemetry.enable()
+    with telemetry.span("outer") as outer:
+        assert telemetry.current_span() is outer
+        with telemetry.span("inner") as inner:
+            assert telemetry.current_span() is inner
+            time.sleep(0.002)
+        assert telemetry.current_span() is outer
+    assert telemetry.current_span() is None
+    # re-entering a span name accumulates (per-param spans -> one row)
+    with telemetry.span("inner"):
+        pass
+    ph = telemetry.phases()
+    assert set(ph) == {"outer", "inner"}
+    assert ph["outer"] >= ph["inner"] > 0
+
+
+def test_counter_aggregation_cumulative_vs_per_step():
+    telemetry.enable()
+    sink = ListSink()
+    telemetry.add_sink(sink)
+
+    telemetry.step_begin()
+    telemetry.count("cachedop.cache_miss")
+    telemetry.count("host_sync", 2)
+    r1 = telemetry.step_end()
+    telemetry.step_begin()
+    telemetry.count("host_sync")
+    r2 = telemetry.step_end()
+
+    assert r1["step"] == 1 and r2["step"] == 2
+    # per-step deltas reset at step_begin
+    assert r1["counters"]["host_sync"] == 2
+    assert r2["counters"]["host_sync"] == 1
+    assert "cachedop.cache_miss" not in r2["counters"]
+    # cumulative view keeps the running totals
+    assert telemetry.counters()["host_sync"] == 3
+    assert sink.records == [r1, r2]
+
+
+def test_span_thread_safety():
+    telemetry.enable()
+    errs = []
+
+    def worker(name):
+        try:
+            for _ in range(200):
+                with telemetry.span(name) as s:
+                    assert telemetry.current_span() is s
+                telemetry.count(name)
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    threads = [threading.Thread(target=worker, args=(f"t{i}",))
+               for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    counters = telemetry.counters()
+    assert all(counters[f"t{i}"] == 200 for i in range(4))
+
+
+def test_host_sync_counter_on_asnumpy_and_wait():
+    telemetry.enable()
+    a = nd.array([1.0, 2.0])
+    a.asnumpy()
+    a.wait_to_read()
+    (a + a).asnumpy()
+    assert telemetry.counters()["host_sync"] == 3
+
+
+def test_nbytes_of_never_syncs():
+    a = nd.ones((8, 4))
+    assert telemetry.nbytes_of(a) == 8 * 4 * a.dtype.itemsize
+    assert telemetry.nbytes_of([a, a]) == 2 * telemetry.nbytes_of(a)
+    assert telemetry.nbytes_of(object()) == 0
+
+
+# --- JSONL sink -------------------------------------------------------------
+
+def test_jsonl_schema(tmp_path):
+    path = str(tmp_path / "telemetry.jsonl")
+    telemetry.enable(jsonl_path=path)
+    for i in range(3):
+        with telemetry.step(examples=BATCH, epoch=0):
+            with telemetry.span("trainer.step"):
+                telemetry.count("host_sync")
+    telemetry.disable()
+
+    records = telemetry.read_jsonl(path)
+    assert len(records) == 3
+    for i, rec in enumerate(records):
+        assert rec["step"] == i + 1
+        for key in ("wall_time", "step_ms", "phases_ms", "counters",
+                    "gauges", "host_sync", "cachedop_cache_hit",
+                    "cachedop_cache_miss", "compile_count",
+                    "allreduce_bytes"):
+            assert key in rec, key
+        assert rec["step_ms"] > 0
+        assert rec["phases_ms"]["trainer.step"] > 0
+        assert rec["host_sync"] == 1
+        assert rec["epoch"] == 0  # extra kwargs land verbatim
+        assert rec["examples"] == BATCH
+        assert rec["examples_per_sec"] > 0
+    # each line is independently parseable (flight-recorder property)
+    with open(path) as f:
+        for line in f:
+            json.loads(line)
+
+
+def test_jsonl_append_mode(tmp_path):
+    path = str(tmp_path / "t.jsonl")
+    telemetry.enable(jsonl_path=path)
+    with telemetry.step():
+        pass
+    telemetry.disable()
+    telemetry.enable(jsonl_path=path, append=True)
+    with telemetry.step():
+        pass
+    telemetry.disable()
+    assert [r["step"] for r in telemetry.read_jsonl(path)] == [1, 1]
+
+
+# --- profiler bridge (satellites 1 + 2) -------------------------------------
+
+def test_profiler_dumps_json_format():
+    from mxnet_tpu import profiler
+
+    profiler.set_state("run")
+    try:
+        (nd.ones((2, 2)) + 1).asnumpy()  # dispatch at least one op
+    finally:
+        profiler.set_state("stop")
+    payload = json.loads(profiler.dumps(format="json"))
+    assert payload, "aggregate table must not be empty"
+    row = next(iter(payload.values()))
+    assert set(row) == {"count", "total_ms", "min_ms", "max_ms", "avg_ms"}
+    # table stays the default; unknown formats are rejected
+    assert "Total Count" in profiler.dumps()
+    with pytest.raises(MXNetError):
+        profiler.dumps(format="yaml")
+    profiler.dumps(reset=True)
+
+
+def test_chrome_trace_shares_timeline_with_op_events(tmp_path):
+    """Acceptance (a): telemetry spans and op-dispatch events land in ONE
+    traceEvents list, on one clock."""
+    from mxnet_tpu import profiler
+
+    trace = str(tmp_path / "profile.json")
+    profiler.set_config(filename=trace)
+    profiler.dump(finished=True)  # flush any prior events/epoch
+    telemetry.enable()
+    profiler.set_state("run")
+    try:
+        net = _net()
+        net.hybridize()
+        x = nd.ones((BATCH, 6))
+        with telemetry.span("trainer.step", attrs={"batch": BATCH}):
+            net(x).asnumpy()
+    finally:
+        profiler.dump(finished=True)
+        telemetry.disable()
+    events = json.load(open(trace))["traceEvents"]
+    cats = {e.get("cat") for e in events}
+    assert "telemetry" in cats and "operator" in cats, cats
+    span_evt = next(e for e in events if e.get("cat") == "telemetry"
+                    and e["name"].endswith("trainer.step"))
+    # one timebase: ops dispatched inside the span nest within it
+    in_span = [e for e in events if e.get("cat") == "operator" and
+               span_evt["ts"] <= e["ts"] <=
+               span_evt["ts"] + span_evt["dur"]]
+    assert in_span, (span_evt,
+                     [e["ts"] for e in events if e.get("cat") == "operator"])
+    assert span_evt["args"]["batch"] == str(BATCH)
+
+
+def test_block_scope_prefixes_op_events(tmp_path):
+    """Satellite 2: Block.__call__ wraps forward in profiler.Scope, so op
+    events carry the block name path."""
+    from mxnet_tpu import profiler
+
+    trace = str(tmp_path / "scoped.json")
+    profiler.set_config(filename=trace)
+    net = _net()
+    profiler.set_state("run")
+    try:
+        net(nd.ones((2, 6))).wait_to_read()
+    finally:
+        profiler.dump(finished=True)
+    events = json.load(open(trace))["traceEvents"]
+    prefixed = [e["name"] for e in events
+                if e.get("cat") == "operator" and ":" in e["name"]]
+    assert prefixed, [e["name"] for e in events][:10]
+    # name path includes the child dense block, not just the container
+    assert any("dense" in n for n in prefixed), prefixed[:10]
+
+
+# --- instrumented subsystems ------------------------------------------------
+
+def test_kvstore_push_pull_instrumented():
+    telemetry.enable()
+    kv = mx.kv.create("local")
+    v = nd.ones((16,))
+    kv.init("w", v)
+    telemetry.step_begin()
+    kv.push("w", nd.ones((16,)))
+    out = nd.zeros((16,))
+    kv.pull("w", out)
+    rec = telemetry.step_end()
+    assert rec["phases_ms"]["kvstore.push"] > 0
+    assert rec["phases_ms"]["kvstore.pull"] > 0
+    nbytes = 16 * v.dtype.itemsize
+    assert rec["counters"]["kvstore.push_bytes"] == nbytes
+    assert rec["counters"]["kvstore.pull_bytes"] == nbytes
+
+
+def test_e2e_hybridized_trainer_jsonl(tmp_path):
+    """Acceptance (b): a hybridized training loop over dist_tpu_sync
+    yields per-step records with phase breakdown, cache hit/miss,
+    host-sync count and allreduce bytes."""
+    path = str(tmp_path / "train.jsonl")
+    net = _net()
+    net.hybridize()
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.05},
+                            kvstore="dist_tpu_sync")
+    rng = np.random.RandomState(0)
+    telemetry.enable(jsonl_path=path)
+    for _ in range(3):
+        x = nd.array(rng.randn(BATCH, 6).astype(np.float32))
+        y = nd.array(rng.randint(0, 4, (BATCH,)))
+        with telemetry.step(examples=BATCH):
+            with autograd.record():
+                loss = loss_fn(net(x), y)
+            loss.backward()
+            trainer.step(BATCH)
+            loss.asnumpy()  # the eager logging sync every real loop has
+    telemetry.disable()
+
+    records = telemetry.read_jsonl(path)
+    assert len(records) == 3
+    first, later = records[0], records[1:]
+    for key in ("trainer.step", "trainer.allreduce", "trainer.update"):
+        assert first["phases_ms"].get(key, 0) > 0, (key, first["phases_ms"])
+    # step 1 traces (miss + compile); steady state replays from cache
+    assert first["cachedop_cache_miss"] >= 1
+    assert first["compile_count"] >= 1
+    for rec in later:
+        assert rec["cachedop_cache_hit"] >= 1
+        assert rec["cachedop_cache_miss"] == 0
+        assert rec["compile_count"] == 0
+    grad_bytes = sum(telemetry.nbytes_of(p.grad())
+                     for p in net.collect_params().values())
+    for rec in records:
+        assert rec["host_sync"] >= 1
+        assert rec["allreduce_bytes"] == grad_bytes
+        assert rec["step_ms"] > 0 and rec["examples_per_sec"] > 0
+    # compile-heavy step 1 dominates the steady-state steps
+    assert first["step_ms"] > later[0]["step_ms"]
+
+
+def test_e2e_step_fusion_build_compile_replay():
+    """Step-fusion telemetry: build + compile on the first execution,
+    replay afterwards, steps-per-execution gauge."""
+    k = 2
+    net = _net()
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.05})
+    fused = gluon.FusedTrainStep(
+        net, trainer, lambda n, x, y: loss_fn(n(x), y),
+        steps_per_execution=k, batch_size=BATCH, stacked_inputs=True)
+    rng = np.random.RandomState(1)
+    xs = nd.array(rng.randn(k, BATCH, 6).astype(np.float32))
+    ys = nd.array(rng.randint(0, 4, (k, BATCH)))
+
+    telemetry.enable()
+    sink = ListSink()
+    telemetry.add_sink(sink)
+    with telemetry.step(examples=k * BATCH):
+        fused(xs, ys)
+    with telemetry.step(examples=k * BATCH):
+        fused(xs, ys)
+    telemetry.disable()
+
+    first, second = sink.records
+    assert first["counters"]["step_fusion.cache_miss"] == 1
+    assert first["phases_ms"]["step_fusion.build"] > 0
+    assert first["phases_ms"]["step_fusion.compile"] > 0
+    assert first["compile_count"] >= 1
+    assert second["counters"].get("step_fusion.cache_miss", 0) == 0
+    assert second["phases_ms"]["step_fusion.replay"] > 0
+    assert "step_fusion.compile" not in second["phases_ms"]
+    assert second["gauges"]["step_fusion.steps_per_execution"] == k
+    assert first["counters"]["step_fusion.steps"] == k
+
+
+def test_monitor_toc_single_batched_sync():
+    """Satellite 3: Monitor.toc syncs its whole queue in ONE device_get
+    instead of one asnumpy per monitored layer."""
+    from mxnet_tpu.monitor import Monitor
+
+    net = _net()
+    net(nd.ones((2, 6)))  # init before monitoring
+    mon = Monitor(interval=1, pattern=".*")
+    mon.install(net)
+    telemetry.enable()
+    mon.tic()
+    net(nd.ones((2, 6)))
+    rows = mon.toc()
+    mon.uninstall()
+    assert rows, "monitor recorded no stats"
+    assert all(isinstance(s, str) and not s.startswith("<unreadable")
+               for _, _, s in rows), rows
+    assert telemetry.counters().get("host_sync", 0) == 1
+
+
+def test_env_autostart(tmp_path):
+    """MXNET_TELEMETRY=1 enables at import; MXNET_TELEMETRY_JSONL names
+    the log (mirrors MXNET_PROFILER_AUTOSTART)."""
+    import subprocess
+    import sys
+    import os
+
+    path = str(tmp_path / "auto.jsonl")
+    env = dict(os.environ)
+    env.update(MXNET_TELEMETRY="1", MXNET_TELEMETRY_JSONL=path,
+               JAX_PLATFORMS="cpu")
+    code = (
+        "from mxnet_tpu import telemetry\n"
+        "assert telemetry.is_enabled()\n"
+        "with telemetry.step():\n"
+        "    pass\n"
+        "telemetry.disable()\n"
+    )
+    r = subprocess.run([sys.executable, "-c", code], env=env,
+                       capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert len(telemetry.read_jsonl(path)) == 1

@@ -1,0 +1,45 @@
+"""T6 fixture: recording/observability calls in hot paths.
+
+Telemetry and profiler instrumentation is allowed in (host-side) hot
+dispatch code — the recording fast path reads host clocks by design and
+never executes inside a trace.  The analyzer must (a) not propagate
+hotness into same-module recording helpers, and (b) not flag
+``telemetry.*`` / ``prof.*`` calls themselves, while (c) still flagging
+a direct wall-clock read in a traced body.
+"""
+import time
+
+import jax
+
+from mxnet_tpu import telemetry
+from mxnet_tpu import profiler as prof
+
+_PHASES = {}
+
+
+def count(name, n=1):
+    # same-module recording helper: the perf_counter read is the point —
+    # hotness must NOT leak in through the bare-name call below
+    _PHASES[name] = (_PHASES.get(name, 0.0) + n, time.perf_counter())
+
+
+def instrumented_step(params, batch):
+    count("step")                      # ok: recording helper, exempted
+    telemetry.count("step_fusion.steps")   # ok: telemetry module call
+    prof.record_op_event("step", 0.0)      # ok: profiler module call
+
+    def loss_fn(p):
+        return ((p * batch) ** 2).sum()
+
+    return jax.value_and_grad(loss_fn)(params)
+
+
+instrumented_step_jit = jax.jit(instrumented_step)
+
+
+def bad_timed(params):
+    stamp = time.perf_counter()       # T4 error: wall clock in trace
+    return params * stamp
+
+
+bad_timed_jit = jax.jit(bad_timed)

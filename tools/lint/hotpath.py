@@ -41,6 +41,16 @@ TRACE_ENTRY_NAMES = {
 #: decorators that make the decorated def a traced region
 HOT_DECORATOR_NAMES = TRACE_ENTRY_NAMES - {"apply_op"}
 
+#: observability/recording callees that never run inside a trace: the
+#: telemetry/profiler fast path reads host clocks by design, and CachedOp's
+#: ``_trace_guard`` keeps instrumentation out of traced replays — so a call
+#: to one of these must not propagate hotness into a same-module recording
+#: helper (whose ``time.perf_counter`` would then false-positive as T4)
+RECORDING_SAFE_CALLEES = {
+    "span", "count", "gauge", "mark", "step_begin", "step_end",
+    "record_op_event", "record_span_event", "current_scope_prefix",
+}
+
 _FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
 
 
@@ -154,11 +164,13 @@ class FunctionIndex:
                 continue
             f = call.func
             if isinstance(f, ast.Name):
-                out.add(f.id)
+                if f.id not in RECORDING_SAFE_CALLEES:
+                    out.add(f.id)
             elif isinstance(f, ast.Attribute) and \
                     isinstance(f.value, ast.Name) and \
                     f.value.id in ("self", "cls"):
-                out.add(f.attr)
+                if f.attr not in RECORDING_SAFE_CALLEES:
+                    out.add(f.attr)
         return out
 
     # -- queries -------------------------------------------------------------

@@ -51,6 +51,16 @@ SYNC_FUNCS_TRACED = {"np.asarray", "numpy.asarray", "onp.asarray",
 #: builtins that force a tracer to a host scalar
 SCALAR_BUILTINS = {"float", "int", "bool"}
 
+#: dotted heads naming the observability layer: ``telemetry.count(...)`` /
+#: ``prof.record_span_event(...)`` never sync and never run inside a trace
+#: (spans enter the trace path only via _trace_guard-stripped replays), so
+#: T1/T4 skip them outright
+RECORDING_HEADS = {"telemetry", "profiler", "prof"}
+
+
+def _is_recording_call(dotted: str) -> bool:
+    return bool(dotted) and dotted.split(".", 1)[0] in RECORDING_HEADS
+
 
 # --- T4 ---------------------------------------------------------------------
 
@@ -328,6 +338,8 @@ class FileChecker:
     def _check_t1(self, call, hot):
         func = call.func
         dotted = dotted_name(func)
+        if _is_recording_call(dotted):
+            return
         if isinstance(func, ast.Attribute):
             meth = func.attr
             if hot and meth in SYNC_METHODS:
@@ -389,6 +401,8 @@ class FileChecker:
     # -- T4 ------------------------------------------------------------------
     def _check_t4(self, call):
         dotted = dotted_name(call.func)
+        if _is_recording_call(dotted):
+            return
         if _is_nondet_call(dotted):
             self._emit("T4", SEVERITY_ERROR, call,
                        f"{dotted}() inside a traced region is evaluated "
