@@ -46,6 +46,7 @@ import numpy as np
 
 from .. import autograd as ag
 from .. import optimizer as opt
+from .. import sanitizer as _san
 from .. import telemetry
 from ..base import MXNetError
 from ..ndarray import NDArray
@@ -327,6 +328,14 @@ class FusedTrainStep:
                     w_raws, m_raws, s_raws, aux_raws, t_v, key, lr_v,
                     wd_v, consts, stacked if stacked else None)
 
+            if _san._enabled:
+                # weights/masters/states/aux were donated at dispatch;
+                # poison the old buffers so stale views raise with this
+                # site.  The commit below rebinds every live holder to
+                # the result buffers, which clears the poison for them.
+                _san.donate(self._donated_raws(w_raws, m_raws, s_raws,
+                                               aux_raws),
+                            self._donation_site())
             opt._commit_param_updates(trainer, self._live, mp_flags,
                                       masters, new_w, new_m, new_s)
             for i in self._live:
@@ -350,7 +359,25 @@ class FusedTrainStep:
         except Exception:
             if snapshot is not None:
                 self._restore(snapshot)
+            elif _san._enabled:
+                # steady state: the signature was validated, so the
+                # program was compiled and the failure happened at (or
+                # after) dispatch — the donated buffers are gone and the
+                # model is poisoned exactly as documented above.  Record
+                # it so every later read names this site instead of
+                # XLA's deleted-array error.
+                _san.donate(self._donated_raws(w_raws, m_raws, s_raws,
+                                               aux_raws),
+                            self._donation_site() + " [failed execution]")
             raise
+
+    def _donated_raws(self, w_raws, m_raws, s_raws, aux_raws):
+        return w_raws + m_raws + \
+            tuple(r for ss in s_raws for r in ss) + aux_raws
+
+    def _donation_site(self):
+        return ("FusedTrainStep.__call__ (gluon/step_fusion.py, "
+                f"K={self.k} fused train step, donate_argnums=(0, 1, 2, 3))")
 
     # -- first-call safety ---------------------------------------------------
     def _snapshot(self):

@@ -17,6 +17,7 @@ from __future__ import annotations
 from ..base import MXNetError
 from .parameter import Parameter, ParameterDict
 from .. import optimizer as opt
+from .. import sanitizer as _san
 from .. import telemetry
 
 __all__ = ["Trainer"]
@@ -327,6 +328,15 @@ class Trainer:
                             else "trainer.fused_update"):
             new_w, new_m, new_s = fn(w_raws, m_raws, g_raws, s_raws, lr_v,
                                      wd_v, t_v)
+        if _san._enabled:
+            # the dispatch donated the old weight/master/state buffers;
+            # poison them so any stale view (a detach() taken before the
+            # step) fails with this site.  _commit_param_updates rebinds
+            # the live holders to the result buffers, clearing them.
+            _san.donate(
+                w_raws + m_raws + tuple(r for ss in s_raws for r in ss),
+                "Trainer._try_fused_update (gluon/trainer.py, fused "
+                "multi-tensor update, donate_argnums=(0, 1, 3))")
         opt._commit_param_updates(self, live, mp_flags, masters,
                                   new_w, new_m, new_s)
         return True

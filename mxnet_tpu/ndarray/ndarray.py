@@ -27,6 +27,7 @@ import numpy as np
 from ..base import MXNetError, resolve_dtype
 from ..context import Context, current_context
 from .. import telemetry
+from .. import sanitizer as _san
 
 
 def _ctx_from_raw(raw) -> Context:
@@ -81,6 +82,8 @@ def _to_raw(value, dtype=None, ctx=None):
 
     if isinstance(value, NDArray):
         raw = value._data
+        if _san._enabled:
+            _san.check(raw, "wrap")
         if dtype is not None and np.dtype(dtype) != raw.dtype:
             raw = raw.astype(dtype)
         if ctx is not None:
@@ -148,6 +151,8 @@ class NDArray:
     def asnumpy(self) -> np.ndarray:
         """Blocking device→host copy (reference: ``WaitToRead`` + copy,
         src/ndarray/ndarray.cc:?)."""
+        if _san._enabled:
+            _san.check(self._data, "asnumpy")
         telemetry.count("host_sync")
         return np.asarray(self._data)
 
@@ -161,6 +166,8 @@ class NDArray:
 
     def wait_to_read(self):
         """Block until the value is computed (engine ``WaitForVar`` analog)."""
+        if _san._enabled:
+            _san.check(self._data, "wait_to_read")
         telemetry.count("host_sync")
         try:
             self._data.block_until_ready()
@@ -194,6 +201,8 @@ class NDArray:
         if other.shape != self.shape:
             raise MXNetError(
                 f"copyto shape mismatch {self.shape} vs {other.shape}")
+        if _san._enabled:
+            _san.check(self._data, "copyto")
         import jax
 
         other._data = jax.device_put(
@@ -219,7 +228,18 @@ class NDArray:
     def asnative(self):
         """The raw jax.Array (TPU-native escape hatch; analog of DLPack
         interop, reference src/ndarray/ndarray.cc:? ``ToDLPack``)."""
+        if _san._enabled:
+            _san.check(self._data, "asnative")
         return self._data
+
+    @property
+    def _donated(self):
+        """Donation-poison flag (``MXNET_SANITIZE_DONATION=1``): the site
+        string of the jitted call this array's buffer was donated to, or
+        None while the buffer is live.  Set by the donating dispatch
+        paths (trainer/step_fusion/optimizer), cleared when the holder
+        is rebound to a fresh result buffer."""
+        return _san.site_of(self._data)
 
     # -- autograd ------------------------------------------------------------
     def attach_grad(self, grad_req="write", stype=None):

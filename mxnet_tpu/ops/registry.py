@@ -24,6 +24,7 @@ from __future__ import annotations
 from typing import Any, Callable, Dict
 
 from .. import autograd as ag
+from .. import sanitizer as _san
 
 # Global op registry: name -> python callable operating on NDArrays.
 # (Reference: nnvm's dmlc::Registry of Op objects; here ops are plain
@@ -202,6 +203,13 @@ def apply_op(fun: Callable, *nd_args, name: str = ""):
     from ..ndarray import NDArray
 
     raws = [a._data for a in nd_args]
+    if _san._enabled:
+        # donation sanitizer: a stale operand (buffer donated by a fused
+        # trainer/step-fusion/optimizer dispatch) fails HERE with the
+        # donation site instead of XLA's generic deleted-array error.
+        # Tracers (re-trace under jit/vjp) never hit the registry.
+        for r in raws:
+            _san.check(r, f"operand of {name or 'op'!r}")
     from .. import amp as _amp
 
     if _amp.is_active():

@@ -23,10 +23,15 @@ import numpy as np
 
 from ..base import MXNetError
 from ..ndarray import NDArray
+from .. import sanitizer as _san
 
 __all__ = ["Optimizer", "SGD", "NAG", "Adam", "AdamW", "LAMB", "RMSProp",
            "AdaGrad", "AdaDelta", "Ftrl", "Signum", "SignSGD", "LARS",
            "create", "register", "Test", "Updater", "get_updater"]
+
+#: donation-sanitizer site tag for the per-param jitted update
+_PER_PARAM_SITE = ("Optimizer._update_impl (mxnet_tpu/optimizer, %s "
+                   "per-param update, donate_argnums=(0, 2))")
 
 
 def _f32(x):
@@ -183,11 +188,11 @@ class Optimizer:
             g = g + wd * w
         return g
 
-    def _jitted(self, key, fn):
+    def _jitted(self, key, fn, donate=()):
         import jax
 
         if key not in self._jit_cache:
-            self._jit_cache[key] = jax.jit(fn)
+            self._jit_cache[key] = jax.jit(fn, donate_argnums=donate)
         return self._jit_cache[key]
 
     def update(self, index, weight, grad, state):
@@ -219,15 +224,22 @@ class Optimizer:
         if isinstance(grad, sp.BaseSparseNDArray):
             grad = grad.tostype("default")
 
+        # weight/master and state buffers are donated (argnums 0 and 2):
+        # the update is in place on device, matching the fused
+        # multi-tensor path's donation contract.  Grads stay read-only.
         if multi_precision:
             master, sub_state = state
             step = self._jitted(
                 ("mp", weight.shape, str(weight.dtype)),
                 lambda mw, g, ss, lr_, wd_, t_: self._step(
-                    mw, _f32(g), ss, lr_, wd_, t_))
+                    mw, _f32(g), ss, lr_, wd_, t_),
+                donate=(0, 2))
             states = tuple(s._data for s in _flatten_state(sub_state))
+            old = (master._data,) + states
             new_w, new_states = step(master._data, grad._data, states,
                                      lr, wd, t)
+            if _san._enabled:
+                _san.donate(old, _PER_PARAM_SITE % type(self).__name__)
             master._data = new_w
             weight._data = new_w.astype(weight.dtype)
             _commit_state(sub_state, new_states)
@@ -235,10 +247,14 @@ class Optimizer:
             step = self._jitted(
                 ("sp", weight.shape, str(weight.dtype)),
                 lambda w, g, ss, lr_, wd_, t_: self._step(
-                    w, g, ss, lr_, wd_, t_))
+                    w, g, ss, lr_, wd_, t_),
+                donate=(0, 2))
             states = tuple(s._data for s in _flatten_state(state))
+            old = (weight._data,) + states
             new_w, new_states = step(weight._data, grad._data, states,
                                      lr, wd, t)
+            if _san._enabled:
+                _san.donate(old, _PER_PARAM_SITE % type(self).__name__)
             weight._data = new_w
             _commit_state(state, new_states)
 

@@ -1,0 +1,138 @@
+"""Runtime buffer-donation sanitizer (``MXNET_SANITIZE_DONATION=1``).
+
+The hot paths donate their parameter/optimizer-state buffers to XLA
+(``jax.jit(..., donate_argnums=...)`` in ``gluon/trainer.py``,
+``gluon/step_fusion.py`` and the per-param update in ``optimizer``):
+after the donating call dispatches, the old device buffers are dead and
+any NDArray still holding one is a stale view.  Reading it today fails
+with XLA's generic "Array has been deleted" (backends that honour
+donation) or silently returns stale data (backends that ignore it).
+This module upgrades that to a *precise*, deterministic error naming
+the donating call site — the dependency-engine discipline the MXNet
+blueprint enforced at runtime (SURVEY §2.1), recovered as a sanitizer.
+
+Design (same contract as telemetry's null path — near-zero when off):
+
+* ``_enabled`` is a module global read unlocked on every fast path;
+  every public recorder/checker starts with ``if not _enabled: return``.
+  Callers in per-op code guard with ``if sanitizer._enabled:`` so the
+  disabled cost is one attribute load and a falsy branch.
+* Donation is tracked **per raw buffer**, not per NDArray handle: the
+  donating call paths register the raw ``jax.Array`` objects they
+  donated (``donate(raws, site)``) keyed by ``id`` with a weakref
+  guarding against id reuse, so *every* NDArray sharing that buffer —
+  including ``detach()``/``_alias()`` views created before the call —
+  is poisoned.  ``NDArray._donated`` surfaces the poison flag.
+* Rebinding clears the poison by construction: the donating paths
+  commit fresh result buffers into the same NDArray holders
+  (``optimizer._commit_param_updates`` / ``_commit_state``), and a
+  fresh buffer has no registry entry.  No clearing pass is needed and
+  stale *aliases* stay poisoned — exactly the reads that are wrong.
+
+Static counterpart: ``tools/lint`` rules T6 (use-after-donation) and
+T7 (donation aliasing) prove the same contract at review time; this
+sanitizer catches what escapes the analyzer (dynamic call chains,
+user-held views) at run time.  See docs/lint.md and
+docs/observability.md.
+"""
+from __future__ import annotations
+
+import os
+import weakref
+
+from .base import MXNetError
+
+__all__ = ["DonatedBufferError", "is_enabled", "enable", "disable",
+           "donate", "site_of", "check", "reset"]
+
+
+class DonatedBufferError(MXNetError):
+    """A device buffer was read after being donated to a jitted call."""
+
+
+def _env_on() -> bool:
+    return os.environ.get("MXNET_SANITIZE_DONATION", "").strip().lower() \
+        not in ("", "0", "false", "off", "no")
+
+
+#: fast-path flag: read unlocked everywhere, flipped only by
+#: enable()/disable().  Import-time autostart mirrors MXNET_TELEMETRY.
+_enabled = _env_on()
+
+#: id(raw jax.Array) -> (weakref-or-None, site str).  The weakref both
+#: auto-evicts entries when the dead buffer's python handle goes away
+#: and guards the id against reuse by a new allocation.
+_donated = {}
+
+
+def is_enabled() -> bool:
+    return _enabled
+
+
+def enable():
+    """Turn the sanitizer on (tests; production uses the env var)."""
+    global _enabled
+    _enabled = True
+
+
+def disable():
+    global _enabled
+    _enabled = False
+    _donated.clear()
+
+
+def reset():
+    """Forget every recorded donation (keeps the enabled state)."""
+    _donated.clear()
+
+
+def donate(raws, site: str):
+    """Record that the buffers in ``raws`` were donated at ``site``.
+
+    Called by the donating dispatch paths right after handing the raw
+    arrays to a ``donate_argnums`` jitted callable.  ``None`` entries
+    (absent masters) are skipped; non-weakref-able objects (tracers
+    under nested tracing) are registered without the reuse guard.
+    """
+    if not _enabled:
+        return
+    for raw in raws:
+        if raw is None:
+            continue
+        key = id(raw)
+        try:
+            ref = weakref.ref(raw, lambda _r, _k=key: _donated.pop(_k, None))
+        except TypeError:
+            ref = None
+        _donated[key] = (ref, site)
+
+
+def site_of(raw):
+    """The donation site string for ``raw``, or None if it is live."""
+    entry = _donated.get(id(raw))
+    if entry is None:
+        return None
+    ref, site = entry
+    if ref is not None and ref() is not raw:
+        # the donated buffer was collected and its id recycled by a new,
+        # live array — drop the stale entry
+        _donated.pop(id(raw), None)
+        return None
+    return site
+
+
+def check(raw, op: str = "read"):
+    """Raise DonatedBufferError if ``raw`` was donated.
+
+    Callers guard with ``if sanitizer._enabled:`` so the disabled path
+    never even enters this function.
+    """
+    site = site_of(raw)
+    if site is not None:
+        raise DonatedBufferError(
+            f"NDArray {op}: buffer used after donation at {site}. "
+            "The buffer was handed to XLA via donate_argnums and is no "
+            "longer valid; re-read the value from its owner (e.g. "
+            "param.data()) after the donating call, or .copy() the array "
+            "before it.  (Detected by MXNET_SANITIZE_DONATION=1; see "
+            "docs/lint.md T6/T7 for the donation contract.)")

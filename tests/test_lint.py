@@ -105,6 +105,61 @@ def test_clean_fixture_has_no_violations():
     assert _analyze("clean.py") == []
 
 
+def test_t6_flags_use_after_donation():
+    vs = _rule(_analyze("t6_donation.py"), "T6")
+    contexts = [v.context for v in vs]
+    # every donating-binding shape seeds one true positive
+    assert "local_binding_read_after" in contexts
+    assert "loop_carried" in contexts
+    assert "branch_partial_rebind" in contexts
+    assert "Stepper.run" in contexts
+    assert "factory_read_after" in contexts
+    assert "inline_read_after" in contexts
+    # messages name the donating call and position for triage
+    msg = next(v.message for v in vs
+               if v.context == "local_binding_read_after")
+    assert "donate" in msg and "position 0" in msg
+
+
+def test_t6_false_positive_traps_stay_quiet():
+    vs = _rule(_analyze("t6_donation.py"), "T6")
+    contexts = {v.context for v in vs}
+    for clean in ("local_binding_rebound", "read_before_call",
+                  "loop_rebound", "branch_full_rebind",
+                  "Stepper.run_clean", "sanitizer_handoff"):
+        assert clean not in contexts, sorted(contexts)
+
+
+def test_t7_flags_donation_aliasing():
+    vs = _rule(_analyze("t7_donation.py"), "T7")
+    contexts = [v.context for v in vs]
+    assert "same_name_donated_and_read" in contexts
+    assert "same_name_double_donation" in contexts
+    assert "view_aliases_parent" in contexts
+    assert "member_aliases_container" in contexts
+    assert "closure_captures_donated" in contexts
+    assert contexts.count("unpack_aliases") == 2  # both members flag
+
+
+def test_t7_false_positive_traps_stay_quiet():
+    vs = _rule(_analyze("t7_donation.py"), "T7")
+    contexts = {v.context for v in vs}
+    for clean in ("distinct_elements_ok", "fresh_math_ok", "copy_ok",
+                  "closure_clean"):
+        assert clean not in contexts, sorted(contexts)
+
+
+def test_t6_t7_clean_on_real_donation_sites():
+    # the real donating call sites (fused trainer update, K-step fusion,
+    # per-param optimizer update, llama decode cache) follow the
+    # donation contract: rebind-from-results + sanitizer handoff only
+    vs = analyze_paths(
+        ["mxnet_tpu/gluon/trainer.py", "mxnet_tpu/gluon/step_fusion.py",
+         "mxnet_tpu/optimizer/__init__.py", "mxnet_tpu/models/llama.py"],
+        REPO, rules={"T6", "T7"})
+    assert vs == [], [v.to_dict() for v in vs]
+
+
 # --- baseline gate ----------------------------------------------------------
 
 def test_baseline_waives_known_and_gates_new(tmp_path):
@@ -153,8 +208,41 @@ def test_cli_fails_on_seeded_fixtures_with_json():
     assert r.returncode == 1
     payload = json.loads(r.stdout)
     by_rule = payload["summary"]["by_rule"]
-    for rule in ("T1", "T2", "T3", "T4", "T5"):
+    for rule in ("T1", "T2", "T3", "T4", "T5", "T6", "T7"):
         assert by_rule.get(rule, 0) > 0, f"{rule} missing from {by_rule}"
+
+
+def test_cli_sarif_format():
+    r = _run_cli(FIXTURES, "--no-baseline", "--no-registry",
+                 "--format", "sarif")
+    assert r.returncode == 1  # exit code still gates
+    sarif = json.loads(r.stdout)
+    assert sarif["version"] == "2.1.0"
+    run = sarif["runs"][0]
+    assert run["tool"]["driver"]["name"] == "mxlint"
+    rule_ids = {rl["id"] for rl in run["tool"]["driver"]["rules"]}
+    assert {"T1", "T2", "T3", "T4", "T5", "T6", "T7"} <= rule_ids
+    results = run["results"]
+    assert results and all(r_["ruleId"] in rule_ids for r_ in results)
+    loc = results[0]["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"].startswith("tools/lint/fixtures")
+    assert loc["region"]["startLine"] >= 1
+    assert all("partialFingerprints" in r_ for r_ in results)
+
+
+def test_cli_sarif_marks_waived_as_unchanged(tmp_path):
+    # waived violations appear with baselineState=unchanged, new without
+    fixture = os.path.join(FIXTURES, "t6_donation.py")
+    base = str(tmp_path / "b.json")
+    r = _run_cli(fixture, "--no-registry", "--baseline", base,
+                 "--update-baseline")
+    assert r.returncode == 0
+    r = _run_cli(fixture, "--no-registry", "--baseline", base,
+                 "--format", "sarif")
+    assert r.returncode == 0
+    results = json.loads(r.stdout)["runs"][0]["results"]
+    assert results
+    assert all(r_.get("baselineState") == "unchanged" for r_ in results)
 
 
 # --- live registry invariants ----------------------------------------------

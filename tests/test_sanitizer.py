@@ -1,0 +1,255 @@
+"""Donation-sanitizer tests (``MXNET_SANITIZE_DONATION=1``): stale
+views of buffers donated by the fused trainer update, the K-step fused
+program, and the per-param optimizer update must raise a precise
+use-after-donation error naming the donating site; rebinding through
+the owner clears the poison; disabled, the hooks must stay within
+noise of a stub (telemetry-style null-path bound)."""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import autograd, gluon, nd, sanitizer  # noqa: E402
+from mxnet_tpu.sanitizer import DonatedBufferError  # noqa: E402
+
+
+@pytest.fixture
+def san():
+    """Enable the sanitizer for one test, restore the ambient state."""
+    was = sanitizer.is_enabled()
+    sanitizer.enable()
+    sanitizer.reset()
+    yield sanitizer
+    if not was:
+        sanitizer.disable()
+
+
+def _mlp():
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(16, activation="relu"), gluon.nn.Dense(4))
+    net.initialize(mx.init.Xavier())
+    return net
+
+
+def _backward(net, loss_fn, x, y):
+    with autograd.record():
+        loss = loss_fn(net(x), y)
+    loss.backward()
+
+
+def _data(batch=8, dim=6, classes=4, seed=0):
+    rng = np.random.RandomState(seed)
+    return (nd.array(rng.randn(batch, dim).astype(np.float32)),
+            nd.array(rng.randint(0, classes, (batch,))))
+
+
+# --- trainer fused multi-tensor update --------------------------------------
+
+def test_stale_view_after_fused_trainer_step_raises(san):
+    net = _mlp()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 1e-3})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    x, y = _data()
+    _backward(net, loss_fn, x, y)
+
+    param = next(iter(net.collect_params().values()))
+    stale = param.data().detach()  # shares the pre-step raw buffer
+    trainer.step(8)
+
+    with pytest.raises(DonatedBufferError) as ei:
+        stale.asnumpy()
+    msg = str(ei.value)
+    assert "used after donation" in msg
+    assert "Trainer._try_fused_update" in msg
+    assert "donate_argnums" in msg
+
+
+def test_stale_view_poisons_op_dispatch_too(san):
+    net = _mlp()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 1e-3})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    x, y = _data()
+    _backward(net, loss_fn, x, y)
+    stale = next(iter(net.collect_params().values())).data().detach()
+    trainer.step(8)
+    with pytest.raises(DonatedBufferError, match="operand"):
+        _ = stale + 1
+
+
+def test_rebind_clears_poison_and_donated_property(san):
+    net = _mlp()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 1e-3})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    x, y = _data()
+    _backward(net, loss_fn, x, y)
+
+    param = next(iter(net.collect_params().values()))
+    stale = param.data().detach()
+    trainer.step(8)
+
+    # the stale alias is poisoned and says where the buffer died ...
+    assert stale._donated is not None
+    assert "Trainer._try_fused_update" in stale._donated
+    # ... but the live holder was rebound to the result buffer: clean
+    fresh = param.data()
+    assert fresh._donated is None
+    assert np.isfinite(fresh.asnumpy()).all()
+
+    # the cleared handle survives further training untouched
+    _backward(net, loss_fn, x, y)
+    trainer.step(8)
+    assert param.data()._donated is None
+
+
+# --- K-step fused program (FusedTrainStep) ----------------------------------
+
+def test_stale_view_after_fused_train_step_raises(san):
+    net = _mlp()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.05})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    x, y = _data()
+    _backward(net, loss_fn, x, y)  # materialize grads/states for fusing
+    trainer.step(8)
+
+    step = gluon.FusedTrainStep(
+        net, trainer, lambda n, a, b: loss_fn(n(a), b),
+        steps_per_execution=2, batch_size=8, stacked_inputs=False)
+    param = next(iter(net.collect_params().values()))
+    stale = param.data().detach()
+    step(x, y)
+
+    with pytest.raises(DonatedBufferError) as ei:
+        stale.wait_to_read()
+    assert "FusedTrainStep.__call__" in str(ei.value)
+    # the live weights read fine after the K-step commit
+    assert np.isfinite(param.data().asnumpy()).all()
+
+
+# --- per-param optimizer update ---------------------------------------------
+
+def test_stale_view_after_per_param_update_raises(san):
+    opt = mx.optimizer.create("adam", learning_rate=1e-3)
+    weight = nd.array(np.random.RandomState(1).randn(8, 4)
+                      .astype(np.float32))
+    grad = nd.array(np.random.RandomState(2).randn(8, 4)
+                    .astype(np.float32))
+    state = opt.create_state(0, weight)
+    stale = weight.detach()
+
+    opt.update(0, weight, grad, state)
+
+    with pytest.raises(DonatedBufferError) as ei:
+        stale.asnumpy()
+    assert "Optimizer._update_impl" in str(ei.value)
+    # the weight holder itself was rebound to the fresh result
+    assert weight._donated is None
+    assert np.isfinite(weight.asnumpy()).all()
+
+
+# --- env-var wiring ---------------------------------------------------------
+
+def test_env_var_enables_sanitizer_in_subprocess():
+    code = """
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd, sanitizer
+
+assert sanitizer.is_enabled(), "MXNET_SANITIZE_DONATION=1 must autostart"
+net = gluon.nn.HybridSequential()
+net.add(gluon.nn.Dense(8), gluon.nn.Dense(4))
+net.initialize(mx.init.Xavier())
+trainer = gluon.Trainer(net.collect_params(), "adam",
+                        {"learning_rate": 1e-3})
+loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+x = nd.array(np.random.randn(4, 6).astype(np.float32))
+y = nd.array(np.random.randint(0, 4, (4,)))
+with autograd.record():
+    loss = loss_fn(net(x), y)
+loss.backward()
+stale = next(iter(net.collect_params().values())).data().detach()
+trainer.step(4)
+try:
+    stale.asnumpy()
+except sanitizer.DonatedBufferError as e:
+    assert "used after donation" in str(e)
+    print("SANITIZER_OK")
+"""
+    env = dict(os.environ)
+    env["MXNET_SANITIZE_DONATION"] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       text=True, env=env, cwd=REPO, timeout=600)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "SANITIZER_OK" in r.stdout
+
+
+def test_disabled_by_default_and_registry_empty():
+    # the ambient test process runs without MXNET_SANITIZE_DONATION:
+    # hooks must not record anything and _donated must read None
+    if sanitizer.is_enabled():
+        pytest.skip("suite running with sanitizer force-enabled")
+    x = nd.array([1.0, 2.0])
+    assert x._donated is None
+    assert sanitizer.site_of(x._data) is None
+
+
+# --- disabled-mode overhead --------------------------------------------------
+
+def test_sanitizer_disabled_step_overhead():
+    """Same null-path bound as telemetry: the shipped step loop (hooks
+    present, sanitizer off) must stay within a generous ratio of the
+    loop with every sanitizer entry point stubbed to a no-op — catches
+    a registry lookup or lock sneaking onto the disabled path."""
+    import time
+
+    from mxnet_tpu import sanitizer as san
+
+    assert not san.is_enabled()
+    net = _mlp()
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.05})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    x, y = _data()
+
+    def steps(n):
+        for _ in range(n):
+            _backward(net, loss_fn, x, y)
+            trainer.step(8)
+        next(iter(net.collect_params().values())).data().wait_to_read()
+
+    def best_of(repeats, n):
+        best = float("inf")
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            steps(n)
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    steps(3)  # trace+compile outside the timed region
+    hooked = best_of(3, 20)
+
+    noop = lambda *a, **k: None  # noqa: E731
+    saved = {name: getattr(san, name)
+             for name in ("donate", "check", "site_of")}
+    try:
+        for name in saved:
+            setattr(san, name, noop)
+        steps(3)
+        stubbed = best_of(3, 20)
+    finally:
+        for name, fn in saved.items():
+            setattr(san, name, fn)
+
+    assert hooked < stubbed * 3 + 0.01, (hooked, stubbed)

@@ -32,25 +32,53 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 _DTYPE_BYTES = {
-    "pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "bf16": 2,
-    "f16": 2, "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8,
-    "f64": 8, "c64": 8, "c128": 16,
+    "pred": 1, "s4": 0.5, "u4": 0.5, "s8": 1, "u8": 1, "s16": 2,
+    "u16": 2, "bf16": 2, "f16": 2, "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8, "c128": 16,
+    "f8e4m3": 1, "f8e4m3fn": 1, "f8e4m3b11fnuz": 1, "f8e4m3fnuz": 1,
+    "f8e5m2": 1, "f8e5m2fnuz": 1, "f8e3m4": 1,
 }
 
-_SHAPE_RE = re.compile(r"\b(pred|[suf]\d+|bf16|c64|c128)\[([\d,]*)\]")
+#: f8 variants first: ``[suf]\d+`` would stop at "f8" and miss the
+#: exponent/mantissa suffix before the shape bracket
+_SHAPE_RE = re.compile(
+    r"\b(pred|f8e\w+|[suf]\d+|bf16|c64|c128)\[([\d,]*)\]")
 
 
 def shape_bytes(type_str):
     """Total bytes of every array shape mentioned in an HLO type string
-    (handles tuples by summing members)."""
+    (handles tuples by summing members).  Unknown dtypes charge 0 bytes
+    instead of crashing the walk: an exotic type in one instruction
+    should skew the breakdown, not kill it."""
     total = 0
     for dt, dims in _SHAPE_RE.findall(type_str):
         n = 1
         for d in dims.split(","):
             if d:
                 n *= int(d)
-        total += n * _DTYPE_BYTES[dt]
-    return total
+        total += n * _DTYPE_BYTES.get(dt, 0)
+    return int(total)
+
+
+def _split_result_type(rest):
+    """Split an HLO instruction's result type from the op that follows.
+
+    Tuple result types — ``(f32[8,128]{1,0}, s32[])`` — contain spaces
+    and nest, so ``rest.split(" ", 1)`` truncates them after the first
+    member; scan balanced parens instead so the whole type reaches
+    ``shape_bytes``.  Returns ``(type_str, remainder)``."""
+    if rest.startswith("("):
+        depth = 0
+        for i, ch in enumerate(rest):
+            if ch == "(":
+                depth += 1
+            elif ch == ")":
+                depth -= 1
+                if depth == 0:
+                    return rest[:i + 1], rest[i + 1:].lstrip()
+        return rest, ""
+    parts = rest.split(" ", 1)
+    return parts[0], parts[1] if len(parts) > 1 else ""
 
 
 def entry_breakdown(hlo):
@@ -69,26 +97,26 @@ def entry_breakdown(hlo):
         if not mm:
             continue
         name, rest = mm.groups()
-        type_str = rest.split(" ", 1)[0]
+        type_str, after = _split_result_type(rest)
         sizes[name] = shape_bytes(type_str)
-        lines.append((name, rest))
+        lines.append((name, type_str, after))
     rows = []
-    for name, rest in lines:
-        op_m = re.match(r"[^ ]+ ([\w\-]+)\(", rest)
+    for name, type_str, after in lines:
+        op_m = re.match(r"([\w\-]+)\(", after)
         op = op_m.group(1) if op_m else "?"
         if op in ("parameter", "constant", "tuple", "get-tuple-element",
                   "bitcast"):
             continue
-        operands = re.findall(r"%([\w.\-]+)", rest)
+        operands = re.findall(r"%([\w.\-]+)", after)
         nbytes = sizes.get(name, 0) + sum(
             sizes.get(o, 0) for o in set(operands) if o != name)
-        cyc_m = re.search(r'"estimated_cycles":"(\d+)"', rest)
+        cyc_m = re.search(r'"estimated_cycles":"(\d+)"', after)
         rows.append({
             "name": name,
             "op": op,
             "bytes": nbytes,
             "est_cycles": int(cyc_m.group(1)) if cyc_m else None,
-            "result": rest.split(" ", 1)[0][:60],
+            "result": type_str[:60],
         })
     rows.sort(key=lambda r: -r["bytes"])
     return rows
@@ -131,12 +159,16 @@ def main():
                     tpu_estimated_cycles_sum=0, tpu_estimated_fusions=0)
 
     mfu_audit._cost = capturing_cost
-    # silence _emit's print (we produce our own JSON)
+    # silence _emit's print (we produce our own JSON); restore it in the
+    # finally so importing this module in-process (tests, notebooks)
+    # doesn't leave mfu_audit permanently muted
+    orig_emit = mfu_audit._emit
     mfu_audit._emit = lambda *a, **k: None
     try:
         getattr(mfu_audit, f"audit_{workload}")()
     finally:
         mfu_audit._cost = orig_cost
+        mfu_audit._emit = orig_emit
 
     from _tpu_topology import assert_tpu_hlo
 

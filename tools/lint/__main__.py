@@ -12,7 +12,7 @@ import sys
 from .analyzer import analyze_paths
 from .baseline import load_baseline, save_baseline, apply_baseline
 from .registry_check import run_registry_check
-from .report import render_human, render_json
+from .report import render_human, render_json, render_sarif
 from .rules import RULES
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
@@ -30,8 +30,12 @@ def main(argv=None):
     ap.add_argument("paths", nargs="*", default=None,
                     help="files/directories to analyze "
                          "(default: mxnet_tpu)")
+    ap.add_argument("--format", choices=("human", "json", "sarif"),
+                    default=None, dest="format",
+                    help="report format (default: human); sarif emits "
+                         "SARIF 2.1.0 for CI code-scanning annotation")
     ap.add_argument("--json", action="store_true", dest="as_json",
-                    help="emit a JSON report instead of human output")
+                    help="alias for --format json")
     ap.add_argument("--baseline", default=DEFAULT_BASELINE,
                     help="baseline (waiver) file "
                          "(default: tools/lint/baseline.json)")
@@ -76,9 +80,12 @@ def main(argv=None):
     baseline = {} if args.no_baseline else load_baseline(args.baseline)
     new, waived, stale = apply_baseline(violations, baseline)
 
+    fmt = args.format or ("json" if args.as_json else "human")
     out = sys.stdout
-    if args.as_json:
+    if fmt == "json":
         render_json(new, waived, stale, out)
+    elif fmt == "sarif":
+        render_sarif(new, waived, stale, out)
     else:
         render_human(new, waived, stale, out)
     return 1 if new else 0

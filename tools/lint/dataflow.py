@@ -1,0 +1,760 @@
+"""Def-use / alias dataflow for buffer-donation rules (T6/T7).
+
+``jax.jit(fn, donate_argnums=...)`` invalidates the donated input
+buffers at dispatch: any later read of a donated array surfaces as
+XLA's cryptic "Array has been deleted", usually far from the call that
+donated it.  These rules catch the two static shapes of that bug:
+
+T6  use-after-donation: a binding passed at a donated position of a
+    donating call is *read* in a later statement before being rebound.
+    Rebinding (``w = step(w, g)``) clears the poison; so does ``del``.
+T7  donation aliasing: the same array — or a view/member of the same
+    parent container — reaches one call at both a donated and another
+    position, or the donated callee *closes over* the array it is
+    handed for donation.  XLA donates the underlying buffer, so the
+    "other" reference dies with it.
+
+Donating callables are resolved per module:
+
+  * direct bindings        ``fn = jax.jit(f, donate_argnums=(0,))``
+  * attribute bindings     ``self._step = jax.jit(self._impl, ...)``
+  * factory functions      ``def _build(...): return jax.jit(k_steps,
+    donate_argnums=(0, 1, 2, 3))`` — call sites of ``_build`` produce
+    donating bindings; factories may also thread the argnums through a
+    parameter (``def _jitted(self, key, fn, donate=()): ...
+    jax.jit(fn, donate_argnums=donate)``), resolved from each call
+    site's ``donate=`` argument
+  * inline calls           ``jax.jit(f, donate_argnums=(0,))(x)``
+
+The per-function scan is statement-ordered and branch-aware: ``if``
+arms are scanned independently and merged (a name stays poisoned
+unless *every* arm rebinds it); loop bodies are scanned twice so a
+donation at the bottom of an iteration poisons a read at the top of
+the next; ``except`` handlers inherit the poison of the guarded body
+(the donating dispatch may have happened before the raise).
+
+Reads that occur as arguments to the runtime donation sanitizer
+(``_san.donate(...)`` / ``sanitizer.*``, see mxnet_tpu/sanitizer.py)
+are exempt: handing the just-donated handles to the poison registry is
+the one legitimate post-donation use.
+
+Known precision limits (documented in docs/lint.md): attribute-rooted
+bindings are tracked by attribute name only; ``donate_argnames`` is
+not resolved; container concatenation (``w_raws + m_raws``) does not
+propagate alias roots (array ``+`` allocates, tuple ``+`` shares —
+statically indistinguishable, so we choose the quiet side).
+"""
+from __future__ import annotations
+
+import ast
+
+from .core import Violation, SEVERITY_ERROR, dotted_name, last_name
+
+#: dotted heads naming the runtime donation sanitizer: reads inside
+#: these calls are the poison-registry handoff, not buffer uses
+SANITIZER_HEADS = {"_san", "sanitizer"}
+
+#: callables that enter a donating trace when given donate_argnums
+_JIT_NAMES = {"jit", "pjit"}
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+#: alias-path index meaning "the whole container, or an unknown part"
+_WHOLE = "*"
+
+
+class Donating:
+    """A resolved donating callable: which call-arg positions are
+    donated, and enough about the wrapped function for messages and
+    the closure-capture check."""
+
+    __slots__ = ("argnums", "param_names", "label", "callee", "line")
+
+    def __init__(self, argnums, param_names, label, callee, line):
+        self.argnums = argnums          # donated *call-arg* positions
+        self.param_names = param_names  # pos -> wrapped-fn param name
+        self.label = label              # for messages
+        self.callee = callee            # wrapped func ast node or None
+        self.line = line                # jit(...) line
+
+
+def _const_argnums(expr):
+    """(0,) / [0, 2] / 0 as a tuple of ints, else None."""
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, int) \
+            and not isinstance(expr.value, bool):
+        return (expr.value,)
+    if isinstance(expr, (ast.Tuple, ast.List)):
+        vals = []
+        for e in expr.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, int) \
+                    and not isinstance(e.value, bool):
+                vals.append(e.value)
+            else:
+                return None
+        return tuple(vals)
+    return None
+
+
+def _kw(call, name):
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def _is_donating_jit(call) -> bool:
+    if not isinstance(call, ast.Call):
+        return False
+    if last_name(call.func) not in _JIT_NAMES:
+        return False
+    return _kw(call, "donate_argnums") is not None
+
+
+def _positional_params(fn_node, skip_self):
+    args = fn_node.args
+    names = [a.arg for a in args.posonlyargs] + [a.arg for a in args.args]
+    if skip_self and names and names[0] in ("self", "cls"):
+        names = names[1:]
+    return names
+
+
+def _param_default(fn_node, name):
+    """Default expression for parameter ``name``, or None."""
+    args = fn_node.args
+    positional = list(args.posonlyargs) + list(args.args)
+    n_def = len(args.defaults)
+    for a, d in zip(positional[len(positional) - n_def:], args.defaults):
+        if a.arg == name:
+            return d
+    for a, d in zip(args.kwonlyargs, args.kw_defaults):
+        if a.arg == name and d is not None:
+            return d
+    return None
+
+
+class _Resolver:
+    """Module-wide table of donating bindings."""
+
+    def __init__(self, src, index):
+        self.src = src
+        self.index = index
+        self.local = {}      # (id(scope) | None, name) -> Donating
+        self.attrs = {}      # attribute name -> Donating
+        self.inline = {}     # id(outer Call) -> Donating
+        self.factories = {}  # id(func def) -> factory spec dict
+        self._collect_jits()
+        self._collect_factory_calls()
+
+    @property
+    def any(self):
+        return bool(self.local or self.attrs or self.inline)
+
+    # -- collection ----------------------------------------------------------
+    def _collect_jits(self):
+        for call in ast.walk(self.src.tree):
+            if not _is_donating_jit(call):
+                continue
+            argnums_expr = _kw(call, "donate_argnums")
+            wrapped = call.args[0] if call.args else None
+            enclosing = self.index.enclosing_function(call)
+            argnums = _const_argnums(argnums_expr)
+
+            if argnums is None and isinstance(argnums_expr, ast.Name) and \
+                    enclosing is not None and not \
+                    isinstance(enclosing, ast.Lambda) and \
+                    argnums_expr.id in _positional_params(enclosing, False):
+                # factory threading argnums through a parameter
+                # (optimizer._jitted): resolved per call site
+                fn_param = wrapped.id if isinstance(wrapped, ast.Name) and \
+                    wrapped.id in _positional_params(enclosing, False) \
+                    else None
+                self.factories[id(enclosing)] = {
+                    "func": enclosing,
+                    "argnums": ("param", argnums_expr.id),
+                    "fn_param": fn_param,
+                    "fixed_target": None if fn_param else
+                    self._resolve_target(wrapped),
+                    "line": call.lineno,
+                }
+                continue
+            if argnums is None:
+                continue  # computed argnums: not statically resolvable
+
+            target, param_names, bound = self._resolve_target(wrapped)
+            don = Donating(argnums, self._donated_param_names(
+                argnums, param_names), self._label(wrapped), target,
+                call.lineno)
+            self._bind(call, enclosing, don)
+
+    def _bind(self, call, enclosing, don):
+        parent = self.index.parents.get(id(call))
+        if isinstance(parent, ast.Call) and parent.func is call:
+            self.inline[id(parent)] = don
+        elif isinstance(parent, ast.Assign):
+            for t in parent.targets:
+                if isinstance(t, ast.Name):
+                    self.local[(self._scope_key(enclosing), t.id)] = don
+                elif isinstance(t, ast.Attribute):
+                    self.attrs[t.attr] = don
+        elif isinstance(parent, ast.Return) and enclosing is not None and \
+                not isinstance(enclosing, ast.Lambda):
+            self.factories[id(enclosing)] = {
+                "func": enclosing,
+                "argnums": don.argnums,
+                "fn_param": None,
+                "fixed_target": None,
+                "line": don.line,
+                "donating": don,
+            }
+
+    def _collect_factory_calls(self):
+        if not self.factories:
+            return
+        factory_by_name = {}
+        for spec in self.factories.values():
+            factory_by_name[spec["func"].name] = spec
+        for call in ast.walk(self.src.tree):
+            if not isinstance(call, ast.Call):
+                continue
+            fname = last_name(call.func)
+            spec = factory_by_name.get(fname)
+            if spec is None:
+                continue
+            cands = self.index.by_name.get(fname, ())
+            if not any(c is spec["func"] for c in cands):
+                continue
+            don = self._resolve_factory_call(call, spec)
+            if don is None:
+                continue
+            enclosing = self.index.enclosing_function(call)
+            self._bind(call, enclosing, don)
+
+    def _resolve_factory_call(self, call, spec):
+        factory = spec["func"]
+        bound_call = isinstance(call.func, ast.Attribute)
+        params = _positional_params(factory, bound_call)
+
+        def arg_for(pname):
+            kw = _kw(call, pname)
+            if kw is not None:
+                return kw
+            try:
+                pos = params.index(pname)
+            except ValueError:
+                return None
+            if pos < len(call.args):
+                return call.args[pos]
+            return _param_default(factory, pname)
+
+        argnums = spec["argnums"]
+        if isinstance(argnums, tuple) and argnums and \
+                argnums[0] == "param":
+            argnums = _const_argnums(arg_for(argnums[1]))
+            if argnums is None:
+                return None
+        if spec.get("donating") is not None:
+            base = spec["donating"]
+            return Donating(base.argnums, base.param_names,
+                            f"{factory.name}(...)", base.callee, call.lineno)
+        if spec["fn_param"] is not None:
+            wrapped = arg_for(spec["fn_param"])
+            target, param_names, _ = self._resolve_target(wrapped)
+            return Donating(argnums, self._donated_param_names(
+                argnums, param_names), f"{factory.name}(...)", target,
+                call.lineno)
+        target, param_names, _ = spec["fixed_target"] or (None, None, False)
+        return Donating(argnums, self._donated_param_names(
+            argnums, param_names or []), f"{factory.name}(...)", target,
+            call.lineno)
+
+    # -- helpers -------------------------------------------------------------
+    def _resolve_target(self, wrapped):
+        """-> (func ast node or None, positional param names, bound?)"""
+        if isinstance(wrapped, ast.Lambda):
+            return wrapped, _positional_params(wrapped, False), False
+        if isinstance(wrapped, ast.Attribute):
+            cands = self.index.by_name.get(wrapped.attr, ())
+            if len(cands) == 1 and not isinstance(cands[0], ast.Lambda):
+                # jit(self.meth): jax sees the *bound* signature
+                return cands[0], _positional_params(cands[0], True), True
+            return None, [], True
+        if isinstance(wrapped, ast.Name):
+            cands = self.index.by_name.get(wrapped.id, ())
+            if len(cands) == 1:
+                return cands[0], _positional_params(cands[0], False), False
+        return None, [], False
+
+    @staticmethod
+    def _donated_param_names(argnums, param_names):
+        out = {}
+        for n in argnums:
+            if 0 <= n < len(param_names):
+                out[n] = param_names[n]
+        return out
+
+    @staticmethod
+    def _label(wrapped):
+        name = dotted_name(wrapped) or (
+            "<lambda>" if isinstance(wrapped, ast.Lambda) else "<fn>")
+        return f"jit({name})"
+
+    def _scope_key(self, enclosing):
+        return id(enclosing) if enclosing is not None else None
+
+    # -- lookup --------------------------------------------------------------
+    def lookup(self, call, enclosing):
+        """Donating spec for ``call`` (an ast.Call), or None."""
+        don = self.inline.get(id(call))
+        if don is not None:
+            return don
+        f = call.func
+        if isinstance(f, ast.Name):
+            scope = enclosing
+            while True:
+                don = self.local.get((self._scope_key(scope), f.id))
+                if don is not None:
+                    return don
+                if scope is None:
+                    return None
+                scope = self.index.enclosing_function(scope)
+        if isinstance(f, ast.Attribute):
+            return self.attrs.get(f.attr)
+        return None
+
+
+# ---------------------------------------------------------------------------
+# Alias roots: (root, index) access paths per function, flow-insensitive
+# ---------------------------------------------------------------------------
+
+class _Aliases:
+    """name -> set of (root, index) pairs.  ``index`` is a constant
+    subscript/unpack position, or ``_WHOLE`` for the whole container
+    (or an unknown part of it).  Two paths can alias iff the roots
+    match and the indices are compatible (equal, or either whole)."""
+
+    def __init__(self, fn_node):
+        self.assigns = {}
+        self._memo = {}
+        if fn_node is not None:
+            self._collect(fn_node)
+
+    def _collect(self, fn_node):
+        body = fn_node.body if not isinstance(fn_node, ast.Lambda) else []
+        stack = list(body) if isinstance(body, list) else [body]
+        while stack:
+            node = stack.pop()
+            if isinstance(node, _FUNC_NODES):
+                continue  # different scope
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    self._record(t, node.value)
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                self._record(node.target, node.value)
+            stack.extend(ast.iter_child_nodes(node))
+
+    def _record(self, target, value):
+        if isinstance(target, ast.Name):
+            self.assigns.setdefault(target.id, set()).update(
+                self.expr_paths(value))
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            # a, b = state: positional const indices keep distinct
+            # elements of one parent from aliasing each other
+            for i, elt in enumerate(target.elts):
+                if not isinstance(elt, ast.Name):
+                    continue
+                paths = set()
+                for root, idx in self.expr_paths(value):
+                    paths.add((root, i) if idx == _WHOLE else (root, _WHOLE))
+                self.assigns.setdefault(elt.id, set()).update(paths)
+
+    def expr_paths(self, expr):
+        """Alias paths a *view-forming* expression shares with existing
+        bindings; fresh allocations (math, .copy(), most calls) return
+        no paths."""
+        if isinstance(expr, ast.Name):
+            return {(expr.id, _WHOLE)}
+        if isinstance(expr, ast.Attribute):
+            base = expr.value
+            if isinstance(base, ast.Name) and base.id in ("self", "cls"):
+                return {(dotted_name(expr), _WHOLE)}
+            return self.expr_paths(base)
+        if isinstance(expr, ast.Subscript):
+            base_paths = self.expr_paths(expr.value)
+            if isinstance(expr.slice, ast.Constant) and \
+                    isinstance(expr.slice.value, int):
+                return {(root, expr.slice.value) if idx == _WHOLE
+                        else (root, _WHOLE) for root, idx in base_paths}
+            return {(root, _WHOLE) for root, idx in base_paths}
+        if isinstance(expr, ast.Starred):
+            return self.expr_paths(expr.value)
+        if isinstance(expr, (ast.Tuple, ast.List)):
+            out = set()
+            for e in expr.elts:
+                out |= {(root, _WHOLE) for root, _ in self.expr_paths(e)}
+            return out
+        if isinstance(expr, ast.IfExp):
+            return self.expr_paths(expr.body) | self.expr_paths(expr.orelse)
+        if isinstance(expr, (ast.GeneratorExp, ast.ListComp, ast.SetComp)):
+            return self._comp_paths(expr)
+        if isinstance(expr, ast.Call):
+            if last_name(expr.func) in ("tuple", "list") and \
+                    len(expr.args) == 1:
+                inner = expr.args[0]
+                if isinstance(inner, (ast.GeneratorExp, ast.ListComp)):
+                    return self._comp_paths(inner)
+                if isinstance(inner, ast.Name):
+                    return {(inner.id, _WHOLE)}
+            return set()
+        return set()
+
+    def _comp_paths(self, comp):
+        if len(comp.generators) != 1:
+            return set()
+        it = comp.generators[0].iter
+        if isinstance(it, (ast.Name, ast.Attribute)):
+            return {(root, _WHOLE) for root, _ in self.expr_paths(it)}
+        return set()
+
+    def roots_of(self, expr):
+        """Transitive alias paths of a call argument."""
+        out = set()
+        stack = list(self.expr_paths(expr))
+        while stack:
+            root, idx = stack.pop()
+            if (root, idx) in out:
+                continue
+            out.add((root, idx))
+            for proot, pidx in self.assigns.get(root, ()):
+                # composing through another binding loses the index
+                stack.append((proot, pidx if idx == _WHOLE else _WHOLE))
+        return out
+
+
+def _paths_overlap(a, b):
+    for root1, i1 in a:
+        for root2, i2 in b:
+            if root1 == root2 and (i1 == _WHOLE or i2 == _WHOLE or i1 == i2):
+                return True
+    return False
+
+
+def _free_names(fn_node):
+    """Names ``fn_node`` reads but does not bind — closure captures."""
+    bound = set(_positional_params(fn_node, False))
+    args = fn_node.args
+    if args.vararg:
+        bound.add(args.vararg.arg)
+    if args.kwarg:
+        bound.add(args.kwarg.arg)
+    bound.update(a.arg for a in args.kwonlyargs)
+    reads = set()
+    body = fn_node.body if isinstance(fn_node.body, list) else [fn_node.body]
+    for stmt in body:
+        for n in ast.walk(stmt):
+            if isinstance(n, ast.Name):
+                if isinstance(n.ctx, ast.Load):
+                    reads.add(n.id)
+                else:
+                    bound.add(n.id)
+            elif isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                bound.add(n.name)
+            elif isinstance(n, ast.comprehension):
+                for t in ast.walk(n.target):
+                    if isinstance(t, ast.Name):
+                        bound.add(t.id)
+    return reads - bound
+
+
+# ---------------------------------------------------------------------------
+# Statement-ordered scan
+# ---------------------------------------------------------------------------
+
+class _Donation:
+    __slots__ = ("label", "line", "pos", "param")
+
+    def __init__(self, label, line, pos, param):
+        self.label = label
+        self.line = line
+        self.pos = pos
+        self.param = param
+
+
+class _Scanner:
+    def __init__(self, src, index, resolver, enabled):
+        self.src = src
+        self.index = index
+        self.resolver = resolver
+        self.enabled = enabled
+        self.violations = []
+        self._seen = set()
+
+    def _on(self, rule):
+        return self.enabled is None or rule in self.enabled
+
+    def run(self):
+        funcs = [n for nodes in self.index.by_name.values() for n in nodes
+                 if not isinstance(n, ast.Lambda)]
+        self._scan_block(self.src.tree.body, {}, None)
+        for fn in funcs:
+            self._scan_block(fn.body, {}, fn)
+        return self.violations
+
+    # -- emit ----------------------------------------------------------------
+    def _emit(self, rule, node, message):
+        line = getattr(node, "lineno", 0)
+        key = (rule, line, getattr(node, "col_offset", 0), message)
+        if key in self._seen:
+            return
+        self._seen.add(key)
+        if self.src.is_suppressed(rule, line):
+            return
+        self.violations.append(Violation(
+            rule=rule, severity=SEVERITY_ERROR, path=self.src.path,
+            line=line, col=getattr(node, "col_offset", 0),
+            context=self.index.qualname_of(node), message=message,
+            source=self.src.line_text(line)))
+
+    # -- block / branch scanning --------------------------------------------
+    def _scan_block(self, stmts, state, scope):
+        for stmt in stmts:
+            self._scan_stmt(stmt, state, scope)
+
+    def _scan_stmt(self, stmt, state, scope):
+        if isinstance(stmt, ast.If):
+            self._check_expr(stmt.test, state, scope)
+            s_true, s_false = dict(state), dict(state)
+            self._scan_block(stmt.body, s_true, scope)
+            self._scan_block(stmt.orelse, s_false, scope)
+            self._merge_into(state, s_true, s_false)
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._check_expr(stmt.iter, state, scope)
+            self._scan_loop(stmt.body, state, scope,
+                            clear=_store_names([stmt.target]))
+            self._scan_block(stmt.orelse, state, scope)
+        elif isinstance(stmt, ast.While):
+            self._check_expr(stmt.test, state, scope)
+            self._scan_loop(stmt.body, state, scope, clear=())
+            self._scan_block(stmt.orelse, state, scope)
+        elif isinstance(stmt, ast.Try):
+            s_body = dict(state)
+            self._scan_block(stmt.body, s_body, scope)
+            outs = [s_body]
+            for handler in stmt.handlers:
+                # the donating dispatch may precede the raise: handlers
+                # inherit the body's poison
+                s_h = dict(state)
+                self._merge_into(s_h, s_h, s_body)
+                self._scan_block(handler.body, s_h, scope)
+                outs.append(s_h)
+            s_else = dict(s_body)
+            self._scan_block(stmt.orelse, s_else, scope)
+            outs.append(s_else)
+            self._merge_into(state, *outs)
+            self._scan_block(stmt.finalbody, state, scope)
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            cleared = []
+            for item in stmt.items:
+                self._check_expr(item.context_expr, state, scope)
+                if item.optional_vars is not None:
+                    cleared.extend(_store_names([item.optional_vars]))
+            for name in cleared:
+                state.pop(name, None)
+            self._scan_block(stmt.body, state, scope)
+        elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for deco in stmt.decorator_list:
+                self._check_expr(deco, state, scope)
+            # body is a separate scope, scanned on its own
+        elif isinstance(stmt, ast.ClassDef):
+            self._scan_block(stmt.body, state, scope)
+        else:
+            self._scan_simple(stmt, state, scope)
+
+    def _scan_loop(self, body, state, scope, clear):
+        # two passes: the second sees the first's out-state, so a
+        # donation at the bottom of an iteration flags an un-rebound
+        # read at the top of the next (loop-carried use-after-donation)
+        s1 = dict(state)
+        for name in clear:
+            s1.pop(name, None)
+        self._scan_block(body, s1, scope)
+        s2 = dict(state)
+        self._merge_into(s2, s2, s1)
+        for name in clear:
+            s2.pop(name, None)
+        self._scan_block(body, s2, scope)
+        self._merge_into(state, state, s2)
+
+    @staticmethod
+    def _merge_into(state, *branches):
+        merged = {}
+        for b in branches:
+            merged.update(b)
+        state.clear()
+        state.update(merged)
+
+    # -- simple statements ---------------------------------------------------
+    def _scan_simple(self, stmt, state, scope):
+        if isinstance(stmt, ast.AugAssign):
+            # ``w += 1`` reads w before rebinding it
+            for name in _store_names([stmt.target]):
+                self._check_read_name(name, stmt.target, state)
+        self._check_expr(stmt, state, scope)
+        for call, don in self._donating_calls(stmt, scope):
+            if self._on("T7"):
+                self._check_t7(call, don, scope)
+            if self._on("T6"):
+                self._mark_donated(call, don, state)
+        for name in _assigned_names(stmt):
+            state.pop(name, None)
+
+    def _donating_calls(self, stmt, scope):
+        out = []
+        for node in _walk_executed(stmt):
+            if isinstance(node, ast.Call):
+                don = self.resolver.lookup(node, scope)
+                if don is not None:
+                    out.append((node, don))
+        return out
+
+    def _mark_donated(self, call, don, state):
+        for pos in don.argnums:
+            if pos >= len(call.args):
+                continue
+            arg = call.args[pos]
+            if isinstance(arg, ast.Name):
+                state[arg.id] = _Donation(don.label, call.lineno, pos,
+                                          don.param_names.get(pos))
+
+    # -- T6: reads of poisoned names ----------------------------------------
+    def _check_expr(self, node, state, scope):
+        if not self._on("T6") or not state:
+            return
+        for n in _walk_executed(node, skip_sanitizer=True):
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load):
+                self._check_read_name(n.id, n, state)
+
+    def _check_read_name(self, name, node, state):
+        d = state.get(name)
+        if d is None:
+            return
+        param = f" (param `{d.param}`)" if d.param else ""
+        self._emit(
+            "T6", node,
+            f"`{name}` is read after being donated to {d.label} at line "
+            f"{d.line} (donate_argnums position {d.pos}{param}) — the "
+            "buffer was invalidated at dispatch; rebind it from the "
+            "call's results or .copy() before the donating call")
+
+    # -- T7: aliasing at the donating call site -----------------------------
+    def _check_t7(self, call, don, scope):
+        aliases = _Aliases(scope)
+        n = len(call.args)
+        paths = [aliases.roots_of(a) for a in call.args]
+        names = [a.id if isinstance(a, ast.Name) else None
+                 for a in call.args]
+        donated = [p for p in don.argnums if p < n]
+        for p in donated:
+            for q in range(n):
+                if q == p or (q in donated and q < p):
+                    continue
+                kind = "donated" if q in donated else "non-donated"
+                if names[p] is not None and names[p] == names[q]:
+                    self._emit(
+                        "T7", call,
+                        f"`{names[p]}` is passed to {don.label} at donated "
+                        f"position {p} and {kind} position {q} — XLA "
+                        "donates the underlying buffer, leaving the other "
+                        "reference dangling")
+                elif paths[p] and paths[q] and \
+                        _paths_overlap(paths[p], paths[q]):
+                    self._emit(
+                        "T7", call,
+                        f"argument at donated position {p} and {kind} "
+                        f"position {q} of {don.label} are views/members of "
+                        "the same parent — donating one invalidates the "
+                        "buffer the other still references")
+        # closure capture: the callee reads the very array it donates
+        callee = don.callee
+        if callee is None:
+            return
+        callee_scope = self.index.enclosing_function(callee)
+        if callee_scope is not scope and callee_scope is not None:
+            return  # different scopes: same name != same object
+        free = _free_names(callee)
+        for p in donated:
+            nm = names[p]
+            if nm is not None and nm in free:
+                self._emit(
+                    "T7", call,
+                    f"`{nm}` is donated at position {p} of {don.label} but "
+                    "also captured by the jitted function's closure — the "
+                    "closed-over reference dies with the donated buffer "
+                    "(pass it as an argument instead)")
+
+
+# ---------------------------------------------------------------------------
+# AST walking helpers
+# ---------------------------------------------------------------------------
+
+def _walk_executed(node, skip_sanitizer=False):
+    """Walk ``node`` skipping nested function bodies (they execute
+    later, under their own scan) and, optionally, arguments of
+    sanitizer calls (``_san.donate(...)``)."""
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        if n is not node and isinstance(n, _FUNC_NODES):
+            # decorators/defaults still execute here
+            for deco in getattr(n, "decorator_list", ()):
+                stack.append(deco)
+            stack.extend(getattr(n.args, "defaults", ()))
+            stack.extend(d for d in getattr(n.args, "kw_defaults", ())
+                         if d is not None)
+            continue
+        if skip_sanitizer and isinstance(n, ast.Call):
+            head = dotted_name(n.func).split(".", 1)[0]
+            if head in SANITIZER_HEADS:
+                continue
+        yield n
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def _store_names(targets):
+    out = []
+    for t in targets:
+        for n in ast.walk(t):
+            if isinstance(n, ast.Name):
+                out.append(n.id)
+    return out
+
+
+def _assigned_names(stmt):
+    """Names rebound (or deleted) by a simple statement."""
+    out = []
+    if isinstance(stmt, ast.Assign):
+        out.extend(_store_names(stmt.targets))
+    elif isinstance(stmt, ast.AnnAssign):
+        out.extend(_store_names([stmt.target]))
+    elif isinstance(stmt, ast.AugAssign):
+        out.extend(_store_names([stmt.target]))
+    elif isinstance(stmt, ast.Delete):
+        for t in stmt.targets:
+            if isinstance(t, ast.Name):
+                out.append(t.id)
+    for n in ast.walk(stmt):
+        if isinstance(n, ast.NamedExpr) and isinstance(n.target, ast.Name):
+            out.append(n.target.id)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Entry point
+# ---------------------------------------------------------------------------
+
+def check_donation(src, index, enabled=None):
+    """Run T6/T7 over one parsed file.  ``enabled`` limits which of the
+    two families report (None = both)."""
+    resolver = _Resolver(src, index)
+    if not resolver.any:
+        return []
+    return _Scanner(src, index, resolver, enabled).run()

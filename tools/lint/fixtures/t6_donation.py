@@ -1,0 +1,114 @@
+"""T6 fixture: use-after-donation.  Seeds true positives for every
+donating-binding shape (local, attribute, factory, inline) plus
+false-positive traps that must stay quiet."""
+import jax
+
+
+def _update(w, g):
+    return w - 0.01 * g
+
+
+# -- local binding -----------------------------------------------------------
+
+def local_binding_read_after(w, g):
+    step = jax.jit(_update, donate_argnums=(0,))
+    new_w = step(w, g)
+    total = w.sum()                   # T6 error: w was donated above
+    return new_w, total
+
+
+def local_binding_rebound(w, g):
+    step = jax.jit(_update, donate_argnums=(0,))
+    w = step(w, g)                    # rebinds w: poison cleared
+    return w.sum()                    # ok
+
+
+def read_before_call(w, g):
+    step = jax.jit(_update, donate_argnums=(0,))
+    norm = w.sum()                    # ok: read precedes the donation
+    return step(w, g), norm
+
+
+# -- loop-carried ------------------------------------------------------------
+
+def loop_carried(w, grads):
+    step = jax.jit(_update, donate_argnums=(0,))
+    out = None
+    for g in grads:
+        out = step(w, g)              # T6 error: w donated by the
+        #                               previous iteration, never rebound
+    return out
+
+
+def loop_rebound(w, grads):
+    step = jax.jit(_update, donate_argnums=(0,))
+    for g in grads:
+        w = step(w, g)                # ok: rebound every iteration
+    return w
+
+
+# -- branch merge ------------------------------------------------------------
+
+def branch_partial_rebind(w, g, flag):
+    step = jax.jit(_update, donate_argnums=(0,))
+    out = step(w, g)
+    if flag:
+        w = out                       # only one arm rebinds
+    return w.sum()                    # T6 error: other arm left w dead
+
+
+def branch_full_rebind(w, g, flag):
+    step = jax.jit(_update, donate_argnums=(0,))
+    out = step(w, g)
+    if flag:
+        w = out
+    else:
+        w = out * 1.0
+    return w.sum()                    # ok: every arm rebinds w
+
+
+# -- attribute binding -------------------------------------------------------
+
+class Stepper:
+    def __init__(self):
+        self._step = jax.jit(self._impl, donate_argnums=(1,))
+
+    def _impl(self, w, state, x):
+        return w @ x, state + 1
+
+    def run(self, w, state, x):
+        out, new_state = self._step(w, state, x)
+        stale = state + 0             # T6 error: state donated at pos 1
+        return out, new_state, stale
+
+    def run_clean(self, w, state, x):
+        out, state = self._step(w, state, x)
+        return out, state + 0         # ok: rebound in the same statement
+
+
+# -- factory binding ---------------------------------------------------------
+
+def _make_step():
+    return jax.jit(_update, donate_argnums=(0,))
+
+
+def factory_read_after(w, g):
+    step = _make_step()
+    new_w = step(w, g)
+    return new_w, w * 2               # T6 error: w donated via factory
+
+
+# -- inline call -------------------------------------------------------------
+
+def inline_read_after(w, g):
+    new_w = jax.jit(_update, donate_argnums=(0,))(w, g)
+    return new_w + w                  # T6 error: inline donation
+
+
+# -- sanitizer exemption -----------------------------------------------------
+
+def sanitizer_handoff(w, g, _san):
+    step = jax.jit(_update, donate_argnums=(0,))
+    new_w = step(w, g)
+    _san.donate((w,), "fixture site")  # ok: poison-registry handoff
+    return new_w

@@ -1,0 +1,86 @@
+"""T7 fixture: donation aliasing.  Same array (or views/members of the
+same parent) reaching a donating call at donated + other positions,
+and closure capture of a donated array.  Ends with clean shapes that
+must not report."""
+import jax
+
+
+def _axpy(w, g):
+    return w + g
+
+
+def _combine(a, b, c):
+    return a + b * c
+
+
+# -- same name at two positions ----------------------------------------------
+
+def same_name_donated_and_read(w):
+    step = jax.jit(_axpy, donate_argnums=(0,))
+    return step(w, w)                 # T7 error: w donated at 0, read at 1
+
+
+def same_name_double_donation(a):
+    both = jax.jit(_axpy, donate_argnums=(0, 1))
+    return both(a, a)                 # T7 error: one buffer donated twice
+
+
+# -- views / members of the same parent --------------------------------------
+
+def view_aliases_parent(w):
+    step = jax.jit(_axpy, donate_argnums=(0,))
+    row = w[0]
+    return step(w, row)               # T7 error: row is a view of w
+
+
+def member_aliases_container(params):
+    step = jax.jit(_axpy, donate_argnums=(0,))
+    raws = tuple(p.data for p in params)
+    first = params[0]
+    return step(raws, first)          # T7 error: first is a member of the
+    #                                   container raws was built from
+
+
+def distinct_elements_ok(params):
+    step = jax.jit(_axpy, donate_argnums=(0,))
+    return step(params[0], params[1])  # ok: distinct constant indices
+
+
+def fresh_math_ok(w):
+    step = jax.jit(_axpy, donate_argnums=(0,))
+    doubled = w * 2                   # fresh allocation, not a view
+    return step(w, doubled)           # ok
+
+
+def copy_ok(w):
+    step = jax.jit(_axpy, donate_argnums=(0,))
+    saved = w.copy()                  # explicit copy breaks aliasing
+    return step(w, saved)             # ok
+
+
+# -- closure capture ---------------------------------------------------------
+
+def closure_captures_donated(w, g):
+    def body(x):
+        return x + w                  # closes over w ...
+
+    step = jax.jit(body, donate_argnums=(0,))
+    return step(w)                    # T7 error: ... and w is donated
+
+
+def closure_clean(w, g):
+    def body_clean(x):
+        return x + g                  # closes over g, not the donated w
+
+    step = jax.jit(body_clean, donate_argnums=(0,))
+    return step(w)                    # ok
+
+
+# -- three-arg mixed ---------------------------------------------------------
+
+def unpack_aliases(state):
+    step = jax.jit(_combine, donate_argnums=(0,))
+    master, extra = state
+    whole = state
+    return step(whole, master, extra)  # T7 errors: master and extra are
+    #                                    members of the donated whole

@@ -1,4 +1,4 @@
-"""Human and JSON reporters for mxlint."""
+"""Human, JSON, and SARIF reporters for mxlint."""
 from __future__ import annotations
 
 import json
@@ -42,6 +42,54 @@ def render_json(new, waived, stale, out):
             "stale": len(stale),
             "by_rule": dict(Counter(v.rule for v in new)),
         },
+    }
+    json.dump(payload, out, indent=2)
+    out.write("\n")
+
+
+def render_sarif(new, waived, stale, out):
+    """SARIF 2.1.0 for CI code-scanning annotation.  New violations
+    become results; baseline-waived ones are included with
+    ``baselineState: "unchanged"`` so scanners can show waived debt
+    without failing the run."""
+    rules = [{
+        "id": rid,
+        "shortDescription": {"text": desc},
+        "helpUri": "docs/lint.md",
+    } for rid, desc in sorted(RULES.items())]
+
+    def result(v, baseline_state=None):
+        r = {
+            "ruleId": v.rule,
+            "level": "error" if v.severity == "error" else "warning",
+            "message": {"text": f"{v.message}  (in {v.context})"},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": v.path,
+                                         "uriBaseId": "SRCROOT"},
+                    "region": {"startLine": max(v.line, 1),
+                               "startColumn": v.col + 1},
+                },
+            }],
+            "partialFingerprints": {"mxlint/v1": v.fingerprint()},
+        }
+        if baseline_state is not None:
+            r["baselineState"] = baseline_state
+        return r
+
+    payload = {
+        "$schema": "https://raw.githubusercontent.com/oasis-tcs/"
+                   "sarif-spec/master/Schemata/sarif-schema-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "mxlint",
+                "informationUri": "docs/lint.md",
+                "rules": rules,
+            }},
+            "results": [result(v) for v in new] +
+                       [result(v, "unchanged") for v in waived],
+        }],
     }
     json.dump(payload, out, indent=2)
     out.write("\n")

@@ -15,6 +15,12 @@ T4  nondeterminism inside traced regions: host ``time.*`` or
     every execution replays the same "random" numbers.
 T5  in-place numpy mutation of jax-backed buffers (``x.asnumpy()[i] = v``
     mutates a host copy — or a read-only view — never device memory).
+T6  use-after-donation: a binding passed at a donated position of a
+    ``jax.jit(..., donate_argnums=...)`` call is read after the call
+    before being rebound (tools/lint/dataflow.py).
+T7  donation aliasing: the same array — or a view/member of the same
+    parent — reaches a donating call at both a donated and another
+    position, or is captured by the donated callee's closure.
 """
 from __future__ import annotations
 
@@ -22,6 +28,7 @@ import ast
 
 from .core import (Violation, SEVERITY_ERROR, SEVERITY_WARNING, dotted_name,
                    last_name)
+from .dataflow import check_donation
 from .hotpath import FunctionIndex, function_taint, expr_tainted
 
 RULES = {
@@ -30,6 +37,8 @@ RULES = {
     "T3": "op-registry inconsistency (docstring / duplicate / grad path)",
     "T4": "host nondeterminism inside a traced region",
     "T5": "in-place numpy mutation of a jax-backed buffer",
+    "T6": "use of a buffer after it was donated to a jitted call",
+    "T7": "aliased array reaches a donating call (donation aliasing)",
 }
 
 # --- T1 ---------------------------------------------------------------------
@@ -306,6 +315,9 @@ class FileChecker:
     def run(self):
         if self._on("T3"):
             self.registrations = collect_registrations(self.src, self.index)
+        if self._on("T6") or self._on("T7"):
+            self.violations.extend(check_donation(
+                self.src, self.index, enabled=self.enabled))
         t5_taint = self._t5_taint() if self._on("T5") else {}
         for node in ast.walk(self.src.tree):
             hot = self.index.in_traced_region(node)
