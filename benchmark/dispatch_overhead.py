@@ -1,0 +1,203 @@
+#!/usr/bin/env python
+"""Per-op imperative dispatch overhead: eager vs bulked vs hybridized.
+
+The reference engine's imperative-mode lever is op bulking
+(``MXNET_ENGINE_BULK_SIZE_*``): consecutive async ops are grouped into
+one scheduled unit so per-op dispatch cost is paid once per segment.
+This harness measures what our deferred-dispatch port (engine.py op
+bulking) buys over plain eager dispatch, and how close it gets to the
+hybridized (CachedOp, fully jitted) ceiling.
+
+Workloads:
+
+* ``chain64`` — a 64-op elementwise chain on a small tensor, the
+  dispatch-bound worst case: eager pays 64 unjitted jax calls + handle
+  wrapping per iteration, bulked replays ONE cached jit-compiled
+  segment, hybridized replays one CachedOp graph.
+* ``mlp_sgd`` — a small-MLP SGD step (forward+backward under
+  ``autograd.record`` + trainer update).  Recording forces eager
+  dispatch inside the tape by design, so bulking is expected to be
+  ~neutral here — it is included to show the off/on delta on a real
+  training step, not to win it.
+
+Methodology: per mode, ``warmup`` iterations (compile/caches), then
+best-of-``BENCH_REPEATS`` timed windows of ``iters`` iterations, one
+host sync per iteration.  Reported unit is µs per op (chain) / ms per
+step (MLP).
+
+Run: ``JAX_PLATFORMS=cpu python benchmark/dispatch_overhead.py``
+(dispatch overhead is a host-side quantity; CPU numbers are the
+contract).  ``BENCH_DISPATCH_OUT=path`` writes the JSON there too.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+CHAIN_OPS = 64
+CHAIN_ITERS = int(os.environ.get("BENCH_CHAIN_ITERS", 30))
+MLP_ITERS = int(os.environ.get("BENCH_MLP_ITERS", 20))
+REPEATS = int(os.environ.get("BENCH_REPEATS", 3))
+WARMUP = 3
+
+
+def _chain_body(x):
+    # 64 elementwise ops, 4 per unrolled line; constants vary per line so
+    # XLA cannot collapse the chain into fewer fused scalars than the
+    # dispatch sequence implies
+    for i in range(CHAIN_OPS // 4):
+        x = x + (0.5 + i)
+        x = x * 1.001
+        x = x - (0.25 + i)
+        x = x / 1.002
+    return x
+
+
+def _time_windows(run_iter, iters, repeats):
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            run_iter()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def bench_chain():
+    import numpy as np
+
+    from mxnet_tpu import engine, gluon, nd
+
+    x = nd.array(np.random.RandomState(0).randn(64, 64).astype(np.float32))
+
+    def eager_iter():
+        _chain_body(x).wait_to_read()
+
+    def bulked_iter():
+        with engine.bulk(CHAIN_OPS + 8):
+            _chain_body(x).wait_to_read()
+
+    class Chain(gluon.HybridBlock):
+        def hybrid_forward(self, F, t):
+            return _chain_body(t)
+
+    hybrid = Chain()
+    hybrid.initialize()
+    hybrid.hybridize()
+
+    def hybrid_iter():
+        hybrid(x).wait_to_read()
+
+    out = {}
+    ref = _chain_body(x).asnumpy()
+    for mode, it in (("eager", eager_iter), ("bulked", bulked_iter),
+                     ("hybridized", hybrid_iter)):
+        for _ in range(WARMUP):
+            it()
+        best = _time_windows(it, CHAIN_ITERS, REPEATS)
+        out[mode] = best / (CHAIN_ITERS * CHAIN_OPS) * 1e6  # µs/op
+    # per-op bit-identity is the bulking contract (tests/test_engine_bulk.py
+    # sweeps the registry); across a fused 64-op chain XLA may contract
+    # mul+add into fma — report the deviation, same class as hybridize()
+    with engine.bulk(CHAIN_OPS + 8):
+        bulked = _chain_body(x).asnumpy()
+    chain_maxdiff = float(np.abs(ref - bulked).max())
+    per_op_identical = all(
+        np.array_equal(np.asarray(f(x).asnumpy()), _bulked_once(f, x))
+        for f in (lambda t: t + 0.5, lambda t: t * 1.001,
+                  lambda t: t - 0.25, lambda t: t / 1.002))
+    return out, per_op_identical, chain_maxdiff
+
+
+def _bulked_once(f, x):
+    from mxnet_tpu import engine
+
+    with engine.bulk(8):
+        return f(x).asnumpy()
+
+
+def bench_mlp_sgd():
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd as ag
+    from mxnet_tpu import engine, gluon, nd
+
+    def build():
+        mx.random.seed(0)
+        net = gluon.nn.HybridSequential()
+        net.add(gluon.nn.Dense(64, activation="relu"),
+                gluon.nn.Dense(64, activation="relu"),
+                gluon.nn.Dense(10))
+        net.initialize(mx.init.Xavier())
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 1e-3})
+        return net, trainer
+
+    rs = np.random.RandomState(1)
+    xb = nd.array(rs.randn(32, 64).astype(np.float32))
+    yb = nd.array(rs.randn(32, 10).astype(np.float32))
+
+    def step(net, trainer):
+        with ag.record():
+            out = net(xb)
+            loss = ((out - yb) ** 2).mean()
+        loss.backward()
+        trainer.step(32)
+        loss.wait_to_read()
+
+    out = {}
+    for mode in ("eager", "bulked", "hybridized"):
+        net, trainer = build()
+        if mode == "hybridized":
+            net.hybridize()
+
+        if mode == "bulked":
+            def it(net=net, trainer=trainer):
+                with engine.bulk(16):
+                    step(net, trainer)
+        else:
+            def it(net=net, trainer=trainer):
+                step(net, trainer)
+
+        for _ in range(WARMUP):
+            it()
+        best = _time_windows(it, MLP_ITERS, REPEATS)
+        out[mode] = best / MLP_ITERS * 1e3  # ms/step
+    return out
+
+
+def main():
+    chain, per_op_identical, chain_maxdiff = bench_chain()
+    mlp = bench_mlp_sgd()
+    from mxnet_tpu import engine
+
+    record = {
+        "metric": "chain64_dispatch_usec_per_op",
+        "value": round(chain["bulked"], 3),
+        "unit": "usec/op",
+        "aggregation": f"best_of_{REPEATS}_windows",
+        "chain64_usec_per_op": {k: round(v, 3) for k, v in chain.items()},
+        "chain64_bulked_speedup_vs_eager":
+            round(chain["eager"] / chain["bulked"], 2),
+        "per_op_bulked_identical_to_eager": per_op_identical,
+        "chain64_bulked_max_abs_diff_vs_eager": chain_maxdiff,
+        "mlp_sgd_ms_per_step": {k: round(v, 3) for k, v in mlp.items()},
+        "segment_cache": engine.segment_cache_stats(),
+        "chain_ops": CHAIN_OPS,
+        "platform": os.environ.get("JAX_PLATFORMS", "default"),
+    }
+    line = json.dumps(record)
+    print(line)
+    out_path = os.environ.get("BENCH_DISPATCH_OUT")
+    if out_path:
+        with open(out_path, "w") as f:
+            f.write(line + "\n")
+
+
+if __name__ == "__main__":
+    main()

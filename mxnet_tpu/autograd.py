@@ -58,8 +58,20 @@ def is_training() -> bool:
     return _STATE.training
 
 
+def _flush_on_record(prev, new):
+    # Entering a recording region is a bulk-flush boundary: pending deferred
+    # ops must land as real buffers before the tape starts observing inputs,
+    # so tape semantics are identical to eager dispatch.
+    if new and not prev:
+        from . import engine as _engine
+
+        if _engine._bulk_on:
+            _engine.flush("record")
+
+
 def set_recording(is_record: bool) -> bool:
     prev, _STATE.recording = _STATE.recording, bool(is_record)
+    _flush_on_record(prev, _STATE.recording)
     return prev
 
 
@@ -77,6 +89,7 @@ class _RecordingStateScope:
         self._prev = (_STATE.recording, _STATE.training)
         if self._rec is not None:
             _STATE.recording = self._rec
+            _flush_on_record(self._prev[0], self._rec)
         if self._train is not None:
             _STATE.training = self._train
         return self

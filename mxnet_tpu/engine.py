@@ -1,4 +1,4 @@
-"""Execution-engine debug levers.
+"""Execution engine: engine-type levers + deferred imperative dispatch.
 
 Reference: ``src/engine/`` — ``MXNET_ENGINE_TYPE`` selects
 ``ThreadedEnginePerDevice`` (default), ``ThreadedEngine`` or
@@ -15,20 +15,60 @@ TPU analog: XLA's async dispatch plays the threaded engine's role, and
   (``jax.block_until_ready``), so device errors surface at the op that
   caused them instead of a later sync point;
 - the Trainer's fused multi-tensor optimizer update falls back to
-  per-parameter eager updates.
+  per-parameter eager updates;
+- op bulking (below) is bypassed entirely.
 
 Select with ``MXT_ENGINE_TYPE=NaiveEngine`` (``MXNET_ENGINE_TYPE`` is
 honoured too) or :func:`set_engine_type` at runtime.
+
+Op bulking (deferred imperative dispatch)
+-----------------------------------------
+
+The reference engine's biggest imperative-mode lever is op bulking
+(``MXNET_ENGINE_BULK_SIZE_*``, ``Imperative`` bulk scopes): consecutive
+async ops are grouped into ONE scheduled unit so the per-op dispatch
+cost is paid once per segment.  The TPU-native replica lives here:
+
+* with bulking on (``MXT_ENGINE_BULK=1`` or ``with engine.bulk(n):``),
+  ``apply_op`` does not execute — it appends the dispatch to a
+  thread-local pending *segment* and hands back NDArrays whose raw
+  value is a :class:`_PendingArray` placeholder (shape/dtype known via
+  ``jax.eval_shape``, data not yet computed);
+* the segment flushes as ONE ``jax.jit``-compiled callable.  Compiled
+  segments live in an LRU cache keyed by the (op-name sequence,
+  closure attrs, wiring, input shapes/dtypes) signature, so a
+  steady-state training loop replays compiled segments with no
+  retracing;
+* flush triggers: the segment reaching the bulk size, a host sync
+  (``asnumpy``/``wait_to_read``/``item``/``__getitem__`` on a pending
+  array — any read of ``NDArray._data``), an ``autograd.record()``
+  boundary, a CachedOp / FusedTrainStep / kvstore dispatch, and the
+  explicit :func:`flush`;
+* recording forces eager dispatch (tape semantics are untouched),
+  NaiveEngine bypasses bulking, and the donation sanitizer's checks
+  run at flush against the segment's real input buffers.
+
+Off by default; the disabled cost in ``apply_op`` is one module-global
+boolean test (telemetry-style).  See docs/engine.md for the full flush
+contract.
 """
 from __future__ import annotations
 
 import contextlib
 import os
+import threading
+import types
+from collections import OrderedDict
+
+import numpy as np
 
 from .base import MXNetError
+from . import telemetry
 
 __all__ = ["engine_type", "set_engine_type", "is_naive", "bulk",
-           "set_bulk_size"]
+           "set_bulk_size", "bulk_size", "set_bulk_enabled", "bulk_enabled",
+           "flush", "pending_ops", "segment_cache_stats",
+           "clear_segment_cache"]
 
 _TYPES = ("ThreadedEnginePerDevice", "ThreadedEngine", "NaiveEngine")
 _type = None
@@ -62,23 +102,578 @@ def is_naive():
 
 # --- reference python/mxnet/engine.py bulk hooks ----------------------------
 
-_bulk_size = 15  # reference default (MXNET_ENGINE_BULK_SIZE_*)
+def _env_int(name, default):
+    try:
+        return int(os.environ.get(name, default))
+    except (TypeError, ValueError):
+        return default
+
+
+def _env_flag(name) -> bool:
+    return os.environ.get(name, "").strip().lower() \
+        not in ("", "0", "false", "off", "no")
+
+
+#: reference defaults: MXNET_ENGINE_BULK_SIZE seeds the generic budget,
+#: the _IN_TRAIN/_IN_INFER variants pick per-mode budgets (consulted via
+#: autograd.is_training() at dispatch time)
+_bulk_size = _env_int("MXNET_ENGINE_BULK_SIZE", 15)
+_bulk_size_train = _env_int("MXNET_ENGINE_BULK_SIZE_IN_TRAIN", _bulk_size)
+_bulk_size_infer = _env_int("MXNET_ENGINE_BULK_SIZE_IN_INFER", _bulk_size)
+
+#: process-wide default for deferred dispatch (thread scopes override)
+_bulk_default = _env_flag("MXT_ENGINE_BULK")
+_bulk_scopes = 0  # number of live bulk() scopes across all threads
+
+#: THE fast-path flag: apply_op's disabled path is one read of this
+#: module global and a falsy branch — same contract as telemetry._enabled
+_bulk_on = _bulk_default
+
+
+def _update_bulk_on():
+    global _bulk_on
+    _bulk_on = bool(_bulk_default or _bulk_scopes > 0)
 
 
 def set_bulk_size(size):
-    """Reference tunes how many async ops the engine groups; XLA's jit IS
-    the bulking mechanism here, so this records and returns the previous
-    value for API compatibility."""
-    global _bulk_size
+    """Set how many deferred ops a pending segment may hold before it
+    auto-flushes (the reference's ``MXNET_ENGINE_BULK_SIZE``).  Sets the
+    generic budget and both the train/infer variants; returns the
+    previous generic value.  A size ≤ 1 disables deferral even when
+    bulking is enabled."""
+    global _bulk_size, _bulk_size_train, _bulk_size_infer
     prev = _bulk_size
-    _bulk_size = int(size)
+    _bulk_size = _bulk_size_train = _bulk_size_infer = int(size)
     return prev
+
+
+def bulk_size():
+    """The effective segment budget for the current mode."""
+    return _effective_bulk_size()
+
+
+def _effective_bulk_size():
+    from . import autograd as ag
+
+    return _bulk_size_train if ag.is_training() else _bulk_size_infer
+
+
+def set_bulk_enabled(flag):
+    """Process-wide default for deferred dispatch (the runtime analog of
+    ``MXT_ENGINE_BULK=1``).  Returns the previous default.  Disabling
+    flushes this thread's pending segment."""
+    global _bulk_default
+    prev = _bulk_default
+    _bulk_default = bool(flag)
+    _update_bulk_on()
+    if not _bulk_default:
+        flush("explicit")
+    return prev
+
+
+def bulk_enabled():
+    """Is deferred dispatch enabled for the calling thread?"""
+    e = _TLS.enabled
+    return _bulk_default if e is None else e
 
 
 @contextlib.contextmanager
 def bulk(size):
-    prev = set_bulk_size(size)
+    """``with engine.bulk(n):`` — enable deferred dispatch on this thread
+    with segment budget ``n`` for the scope (the reference's
+    ``Imperative`` bulk scope).  The pending segment flushes on exit, and
+    the previous size/enable state is restored.  ``bulk(0)``/``bulk(1)``
+    disables deferral in the scope."""
+    global _bulk_scopes, _bulk_size, _bulk_size_train, _bulk_size_infer
+    prev_sizes = (_bulk_size, _bulk_size_train, _bulk_size_infer)
+    prev_enabled = _TLS.enabled
+    set_bulk_size(size)
+    _TLS.enabled = int(size) > 1
+    _bulk_scopes += 1
+    _update_bulk_on()
     try:
         yield
     finally:
-        set_bulk_size(prev)
+        flush("explicit")
+        _bulk_scopes -= 1
+        _TLS.enabled = prev_enabled
+        _bulk_size, _bulk_size_train, _bulk_size_infer = prev_sizes
+        _update_bulk_on()
+
+
+# --- deferred imperative dispatch -------------------------------------------
+
+class _BulkTLS(threading.local):
+    def __init__(self):
+        self.enabled = None   # None → inherit the process default
+        self.segment = None   # the thread's pending _Segment
+        self.flushing = False
+
+
+_TLS = _BulkTLS()
+
+
+class _PendingArray:
+    """Placeholder raw value of an NDArray produced by a deferred op.
+
+    Exposes the aval surface NDArray's cheap properties read
+    (``shape``/``dtype``/``ndim``) without computing anything; any code
+    path that needs the real buffer goes through ``NDArray._data``,
+    which materializes via :func:`_materialize`."""
+
+    __slots__ = ("_segment", "_slot", "shape", "dtype", "weak_type")
+
+    def __init__(self, segment, slot, shape, dtype, weak_type):
+        self._segment = segment
+        self._slot = slot
+        self.shape = shape
+        self.dtype = dtype
+        self.weak_type = weak_type
+
+    @property
+    def ndim(self):
+        return len(self.shape)
+
+    def __repr__(self):  # pragma: no cover - debug aid
+        return (f"<pending {'x'.join(map(str, self.shape))} {self.dtype} "
+                f"slot={self._slot}>")
+
+
+class _SegOp:
+    """One deferred dispatch: the pure fun, its input wiring and the
+    output slot range it fills."""
+
+    __slots__ = ("fun", "in_refs", "base", "n_out", "single", "name", "key",
+                 "lift", "lifted")
+
+    def __init__(self, fun, in_refs, base, n_out, single, name, key,
+                 lift, lifted):
+        self.fun = fun
+        self.in_refs = in_refs   # tuple of ("e", ext_idx) | ("s", slot)
+        self.base = base
+        self.n_out = n_out
+        self.single = single
+        self.name = name
+        # replay-safety signature: (fun code+closure key, wiring, name)
+        self.key = key
+        self.lift = lift         # closure cell indices lifted to runtime args
+        self.lifted = lifted     # their values at dispatch time
+
+
+class _Segment:
+    """The thread-local pending op segment (one engine bulk)."""
+
+    __slots__ = ("ops", "ext", "ext_ids", "slots", "results", "error",
+                 "_lock")
+
+    def __init__(self):
+        self.ops = []
+        self.ext = []        # external (materialized) input raws, deduped
+        self.ext_ids = {}    # id(raw) -> index into ext
+        self.slots = 0       # total output slots produced so far
+        self.results = None  # list of raws per slot once executed
+        self.error = None
+        self._lock = threading.Lock()
+
+    def execute(self, reason):
+        with self._lock:
+            if self.results is not None or self.error is not None:
+                return
+            self._execute_locked(reason)
+
+    def _execute_locked(self, reason):
+        from . import sanitizer as _san
+
+        n_ops = len(self.ops)
+        telemetry.count("engine.bulk_flush")
+        telemetry.count("engine.bulk_flush." + reason)
+        telemetry.gauge("engine.bulk_segment_ops", n_ops)
+        if _san._enabled:
+            # donation checks run at flush, against the segment's real
+            # input buffers (pending intermediates have no buffer yet)
+            for raw in self.ext:
+                _san.check(raw, "bulk segment input")
+        key = (tuple(op.key for op in self.ops),
+               tuple((tuple(r.shape), str(np.dtype(r.dtype)),
+                      bool(getattr(r, "weak_type", False)))
+                     for r in self.ext))
+        entry = _cache_lookup(key)
+        if entry is None:
+            entry = _CompiledSegment(_build_segment_fn(self.ops, self.slots))
+            _cache_insert(key, entry)
+        first = not entry.executed
+        scalars = tuple(v for op in self.ops for v in op.lifted)
+        prev_flushing = _TLS.flushing
+        _TLS.flushing = True
+        try:
+            with telemetry.span("engine.bulk_compile" if first
+                                else "engine.bulk_replay"):
+                res = entry.jfn(scalars, *self.ext)
+        except MXNetError:
+            self.error = True
+            raise
+        except Exception as e:
+            self.error = True
+            names = ", ".join(op.name or "op" for op in self.ops[:8])
+            raise MXNetError(
+                f"bulked segment of {n_ops} ops ({names}{', ...' if n_ops > 8 else ''}) "
+                f"failed at flush ({reason}): {e}") from e
+        finally:
+            _TLS.flushing = prev_flushing
+            if self.error is not None:
+                self.ops = ()
+                self.ext = ()
+                self.ext_ids = None
+        if first:
+            entry.executed = True
+            telemetry.count("engine.bulk_compile")
+        self.results = list(res)
+        self.ops = ()
+        self.ext = ()
+        self.ext_ids = None
+
+
+class _CompiledSegment:
+    __slots__ = ("jfn", "executed")
+
+    def __init__(self, jfn):
+        self.jfn = jfn
+        self.executed = False
+
+
+def _with_cells(fun, lift, values):
+    """A copy of ``fun`` whose closure cells at indices ``lift`` hold
+    ``values`` instead of their originals.  Fresh cells + FunctionType:
+    the original closure (possibly shared across threads) is untouched."""
+    cells = list(fun.__closure__)
+    for i, v in zip(lift, values):
+        cells[i] = types.CellType(v)
+    g = types.FunctionType(fun.__code__, fun.__globals__, fun.__name__,
+                           fun.__defaults__, tuple(cells))
+    g.__kwdefaults__ = fun.__kwdefaults__
+    return g
+
+
+def _build_segment_fn(ops, n_slots):
+    """One jit-compiled callable replaying the whole segment: lifted
+    scalar attrs + external raws in, every op-output slot out.
+
+    Numerics contract: every op is bit-identical to its eager dispatch —
+    float closure attrs are *runtime arguments* (``op.lift``), not trace
+    constants, because eager per-primitive dispatch passes scalars as
+    compiled-executable arguments while XLA rewrites e.g. division by an
+    embedded constant into multiplication by its reciprocal (last ulp
+    differs).  Value-independence also means a segment replays across
+    attr changes (a decaying learning rate keeps its compiled segment).
+    ACROSS ops inside one segment, XLA's backend may still contract a
+    mul feeding an add into an fma (it ignores optimization_barrier when
+    duplicating cheap producers into consumer fusions), so a multi-op
+    chain can differ from eager in the last ulp — the same class of
+    difference ``hybridize()`` exhibits; see docs/engine.md."""
+    import jax
+
+    ops = tuple(ops)
+
+    def seg_fn(scalars, *ext):
+        vals = [None] * n_slots
+        pos = 0
+        for op in ops:
+            args = [ext[i] if kind == "e" else vals[i]
+                    for kind, i in op.in_refs]
+            fun = op.fun
+            # op.lift is static host metadata (the per-op lifted-cell
+            # indices), fixed per segment signature — never a traced value.
+            if op.lift:  # mxlint: disable=T2
+                k = len(op.lift)
+                fun = _with_cells(fun, op.lift, scalars[pos:pos + k])
+                pos += k
+            r = fun(*args)
+            rt = (r,) if op.single else tuple(r)
+            for j in range(op.n_out):
+                vals[op.base + j] = rt[j]
+        return tuple(vals)
+
+    return jax.jit(seg_fn)
+
+
+# --- segment cache (LRU) ----------------------------------------------------
+
+_SEG_CACHE = OrderedDict()
+_SEG_CACHE_MAX = max(1, _env_int("MXT_ENGINE_SEGMENT_CACHE", 256))
+_seg_stats = {"hit": 0, "miss": 0}
+
+
+def _cache_lookup(key):
+    entry = _SEG_CACHE.get(key)
+    if entry is None:
+        _seg_stats["miss"] += 1
+        telemetry.count("engine.bulk_segment_cache_miss")
+        return None
+    _SEG_CACHE.move_to_end(key)
+    _seg_stats["hit"] += 1
+    telemetry.count("engine.bulk_segment_cache_hit")
+    return entry
+
+
+def _cache_insert(key, entry):
+    _SEG_CACHE[key] = entry
+    while len(_SEG_CACHE) > _SEG_CACHE_MAX:
+        _SEG_CACHE.popitem(last=False)
+
+
+def segment_cache_stats():
+    """{"hit": n, "miss": n, "size": n} for the compiled-segment cache."""
+    return dict(_seg_stats, size=len(_SEG_CACHE))
+
+
+def clear_segment_cache():
+    """Drop every compiled segment (tests / memory pressure)."""
+    _SEG_CACHE.clear()
+    _seg_stats["hit"] = _seg_stats["miss"] = 0
+
+
+# --- fun signature keying ---------------------------------------------------
+# A deferred fun is usually a FRESH closure per call (``lambda a: jf(a, c)``
+# built inside an op wrapper), so identity cannot key the cache.  The stable
+# identity is the lambda's code object (a compile-time constant of its
+# enclosing function) plus the VALUES in its closure cells — the analog of
+# the reference keying bulked segments by op + dmlc::Parameter attrs.  Only
+# conservatively-immutable closure values are admitted; anything else
+# (device/numpy arrays, mutable objects) makes the op non-deferrable and it
+# falls back to eager dispatch.
+
+_IMMUTABLE_TYPES = (type(None), bool, int, float, complex, str, bytes,
+                    np.dtype, np.generic, type)
+
+
+class _Unkeyable(Exception):
+    pass
+
+
+def _key_component(v):
+    if isinstance(v, _IMMUTABLE_TYPES):
+        return v
+    if isinstance(v, tuple):
+        return tuple(_key_component(x) for x in v)
+    if isinstance(v, frozenset):
+        return frozenset(_key_component(x) for x in v)
+    if isinstance(v, slice):
+        # slices are unhashable before 3.12; canonicalize
+        return ("__slice__", _key_component(v.start), _key_component(v.stop),
+                _key_component(v.step))
+    if callable(v):
+        # functions/jnp ufuncs: behavior is fixed, identity is the key
+        try:
+            hash(v)
+        except TypeError:
+            raise _Unkeyable from None
+        return v
+    raise _Unkeyable
+
+
+def _fun_key(fun):
+    """``(key, lift)`` — a hashable signature of ``fun``'s computation
+    plus the closure cell indices holding float attrs (lifted to runtime
+    scalar arguments; their VALUES stay out of the key so a segment
+    replays across attr changes).  None when the fun cannot be keyed
+    soundly (array-valued closures, exotic callables)."""
+    code = getattr(fun, "__code__", None)
+    if code is None:
+        try:
+            hash(fun)
+        except TypeError:
+            return None
+        return fun, ()  # C-level callable: identity IS the behavior
+    lift = []
+    try:
+        cells = []
+        for i, cell in enumerate(getattr(fun, "__closure__", None) or ()):
+            try:
+                v = cell.cell_contents
+            except ValueError:
+                return None
+            if type(v) is float:
+                lift.append(i)
+                cells.append(("__scalar__", "weak_f"))
+            elif isinstance(v, np.floating):
+                lift.append(i)
+                cells.append(("__scalar__", np.dtype(type(v)).str))
+            else:
+                cells.append(_key_component(v))
+        defaults = tuple(_key_component(d)
+                         for d in (getattr(fun, "__defaults__", None) or ()))
+    except _Unkeyable:
+        return None
+    return (code, tuple(cells), defaults), tuple(lift)
+
+
+# --- output-aval inference --------------------------------------------------
+# eval_shape is paid once per (fun signature, input avals); steady-state
+# deferral is a dict hit.
+
+_AVAL_CACHE = {}
+_AVAL_CACHE_MAX = 8192
+
+
+def _out_avals(fun, fkey, lift, lifted, in_avals):
+    """((shape, dtype, weak) per output, single) or None if the fun cannot
+    be abstractly evaluated (concrete-value control flow — including a
+    lifted float attr steering python branches, non-array outputs) —
+    such ops dispatch eagerly."""
+    import jax
+
+    akey = (fkey, in_avals)
+    if akey in _AVAL_CACHE:
+        return _AVAL_CACHE[akey]
+    try:
+        structs = [jax.ShapeDtypeStruct(s, d, weak_type=w)
+                   for s, d, w in in_avals]
+        if lift:
+            sc = tuple(
+                jax.ShapeDtypeStruct((), np.float32, weak_type=True)
+                if type(v) is float
+                else jax.ShapeDtypeStruct((), np.dtype(type(v)))
+                for v in lifted)
+            out = jax.eval_shape(
+                lambda s, *a: _with_cells(fun, lift, s)(*a), sc, *structs)
+        else:
+            out = jax.eval_shape(fun, *structs)
+        single = not isinstance(out, (tuple, list))
+        outs_t = (out,) if single else tuple(out)
+        avals = tuple(
+            (tuple(o.shape), np.dtype(o.dtype),
+             bool(getattr(o, "weak_type", False)))
+            for o in outs_t)
+        res = (avals, single)
+    except Exception:
+        res = None
+    if len(_AVAL_CACHE) >= _AVAL_CACHE_MAX:
+        _AVAL_CACHE.clear()
+    _AVAL_CACHE[akey] = res
+    return res
+
+
+# --- defer / flush / materialize --------------------------------------------
+
+def maybe_defer(fun, nd_args, name):
+    """Append the dispatch to the pending segment instead of executing.
+
+    Returns ``(single, raw_values)`` — raw values are `_PendingArray`
+    placeholders (or real raws when the append triggered a size flush) —
+    or None when the op must dispatch eagerly (recording, NaiveEngine,
+    amp/profiler active, tracer operands, unkeyable closures...).
+    Callers reach this only behind the ``_bulk_on`` fast-path flag.
+    """
+    import jax
+
+    from . import autograd as ag
+
+    if _TLS.flushing or not bulk_enabled():
+        return None
+    size = _effective_bulk_size()
+    if size <= 1 or is_naive() or ag.is_recording():
+        return None
+    from . import amp as _amp
+
+    if _amp.is_active():
+        return None
+    from .ops.registry import _profiler_mod
+
+    if _profiler_mod() is not None:
+        return None  # per-op profiler events need real per-op timing
+    keyed = _fun_key(fun)
+    if keyed is None:
+        return None
+    fkey, lift = keyed
+    lifted = tuple(fun.__closure__[i].cell_contents for i in lift) \
+        if lift else ()
+
+    seg = _TLS.segment
+    if seg is None or seg.results is not None or seg.error is not None:
+        seg = _TLS.segment = _Segment()
+    in_refs = []
+    in_avals = []
+    new_ext = 0
+    for a in nd_args:
+        raw = a._raw
+        if raw.__class__ is _PendingArray:
+            if raw._segment is seg:
+                in_refs.append(("s", raw._slot))
+                in_avals.append((raw.shape, raw.dtype, raw.weak_type))
+                continue
+            raw = _materialize(raw)  # older, already-executed segment
+            a._raw = raw
+        if isinstance(raw, jax.core.Tracer):
+            # inside someone else's trace (CachedOp deferred-init pass,
+            # vjp re-trace): deferral would leak tracers out of the trace
+            if new_ext:
+                del seg.ext[-new_ext:]
+                for r in list(seg.ext_ids):
+                    if seg.ext_ids[r] >= len(seg.ext):
+                        del seg.ext_ids[r]
+            return None
+        idx = seg.ext_ids.get(id(raw))
+        if idx is None:
+            idx = len(seg.ext)
+            seg.ext.append(raw)
+            seg.ext_ids[id(raw)] = idx
+            new_ext += 1
+        in_refs.append(("e", idx))
+        in_avals.append((tuple(raw.shape), np.dtype(raw.dtype),
+                         bool(getattr(raw, "weak_type", False))))
+    info = _out_avals(fun, fkey, lift, lifted, tuple(in_avals))
+    if info is None:
+        if new_ext:
+            del seg.ext[-new_ext:]
+            for r in list(seg.ext_ids):
+                if seg.ext_ids[r] >= len(seg.ext):
+                    del seg.ext_ids[r]
+        return None
+    avals, single = info
+    in_refs = tuple(in_refs)
+    base = seg.slots
+    seg.slots += len(avals)
+    seg.ops.append(_SegOp(fun, in_refs, base, len(avals), single, name,
+                          (fkey, in_refs, name), lift, lifted))
+    if len(seg.ops) >= size:
+        _TLS.segment = None
+        seg.execute("size")
+        return single, tuple(seg.results[base + j]
+                             for j in range(len(avals)))
+    return single, tuple(
+        _PendingArray(seg, base + j, sh, dt, wk)
+        for j, (sh, dt, wk) in enumerate(avals))
+
+
+def flush(reason="explicit"):
+    """Execute this thread's pending segment (no-op when empty).  Every
+    NDArray holding a pending placeholder resolves to its computed buffer
+    on next access.  Returns the number of ops flushed."""
+    seg = _TLS.segment
+    if seg is None:
+        return 0
+    _TLS.segment = None
+    n = len(seg.ops)
+    seg.execute(reason)
+    return n
+
+
+def pending_ops():
+    """Ops sitting in this thread's pending segment (0 when idle)."""
+    seg = _TLS.segment
+    return len(seg.ops) if seg is not None else 0
+
+
+def _materialize(pending, reason="host_sync"):
+    """Resolve a `_PendingArray` to its computed raw buffer, executing its
+    segment if that has not happened yet (counted as a ``reason`` flush)."""
+    seg = pending._segment
+    if seg.results is None:
+        if seg is _TLS.segment:
+            _TLS.segment = None
+        seg.execute(reason)
+    if seg.error is not None:
+        raise MXNetError(
+            "reading an NDArray whose bulked segment failed to execute; "
+            "see the original flush error above")
+    return seg.results[pending._slot]

@@ -521,6 +521,12 @@ class CachedOp:
         return list(self.block.collect_params().values())
 
     def __call__(self, *args):
+        from .. import engine as _engine
+
+        if _engine._bulk_on:
+            # compiled-graph dispatch boundary: inputs/params must be real
+            # buffers before tracing or replaying the cached graph
+            _engine.flush("dispatch")
         params = self._param_list()
         if any(p._data is None for p in params):
             # deferred init pending → one shape-resolution pass, then build

@@ -248,6 +248,11 @@ class FusedTrainStep:
     def __call__(self, *batch):
         import jax.numpy as jnp
 
+        from .. import engine as _engine
+
+        if _engine._bulk_on:
+            _engine.flush("dispatch")
+
         trainer = self.trainer
         optzr = trainer._optimizer
         optzr.rescale_grad = trainer._scale / self.batch_size

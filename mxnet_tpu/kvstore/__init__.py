@@ -70,6 +70,12 @@ class KVStore:
         """Aggregate value(s) into the store; with an updater installed the
         stored weight is updated in place (reference ``update_on_kvstore``
         server-side optimizer, SURVEY §3.4)."""
+        from .. import engine as _engine
+
+        if _engine._bulk_on:
+            # kvstore dispatch boundary: gradients must be real buffers
+            # before aggregation/update (they may alias donated storage)
+            _engine.flush("dispatch")
         with telemetry.span("kvstore.push"):
             self._push_impl(key, value, priority)
 
@@ -97,6 +103,10 @@ class KVStore:
                 self._store[k] = merged
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        from .. import engine as _engine
+
+        if _engine._bulk_on:
+            _engine.flush("dispatch")
         with telemetry.span("kvstore.pull"):
             self._pull_impl(key, out, priority, ignore_sparse)
 

@@ -544,7 +544,10 @@ class AsyncPSKVStore:
     def push(self, key, value, priority=0):
         """Non-blocking: enqueue and return (async PS contract)."""
         from . import _merge, _pairs
+        from .. import engine as _engine
 
+        if _engine._bulk_on:
+            _engine.flush("dispatch")
         with telemetry.span("kvstore.push"):
             keys, values = _pairs(key, value)
             if telemetry.is_enabled():
@@ -560,6 +563,10 @@ class AsyncPSKVStore:
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         """Blocking; reflects this worker's completed pushes (per-worker
         FIFO), may be stale w.r.t. other workers — dist_async semantics."""
+        from .. import engine as _engine
+
+        if _engine._bulk_on:
+            _engine.flush("dispatch")
         with telemetry.span("kvstore.pull"):
             self.wait_all()
             self._pull_impl(key, out)

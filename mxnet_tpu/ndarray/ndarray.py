@@ -26,8 +26,13 @@ import numpy as np
 
 from ..base import MXNetError, resolve_dtype
 from ..context import Context, current_context
+from .. import engine as _engine
 from .. import telemetry
 from .. import sanitizer as _san
+
+#: placeholder class for buffers pending in a deferred engine segment
+#: (bound once: the _data fast path is a single class-identity test)
+_Pending = _engine._PendingArray
 
 
 def _ctx_from_raw(raw) -> Context:
@@ -102,28 +107,48 @@ def _to_raw(value, dtype=None, ctx=None):
 class NDArray:
     """A tensor handle with MXNet NDArray semantics over ``jax.Array``."""
 
-    __slots__ = ("_data", "_node", "_oidx", "_req_grad", "_grad", "_grad_req",
+    __slots__ = ("_raw", "_node", "_oidx", "_req_grad", "_grad", "_grad_req",
                  "__weakref__")
 
     # make numpy defer to us: NDArray.__radd__ etc. win over np.ndarray ops
     __array_priority__ = 100.0
 
     def __init__(self, data, ctx=None, dtype=None):
-        self._data = _to_raw(data, dtype=dtype, ctx=ctx)
+        self._raw = _to_raw(data, dtype=dtype, ctx=ctx)
         self._node = None
         self._oidx = 0
         self._req_grad = False
         self._grad = None
         self._grad_req = "null"
 
+    # -- the raw handle ------------------------------------------------------
+    # ``_data`` is the pending-handle state of the deferred engine: while
+    # this array's producing op sits in a pending bulk segment, ``_raw``
+    # holds a placeholder and ANY ``_data`` read — every host sync and
+    # every dispatch path in the tree goes through one — materializes by
+    # flushing the segment.  The non-pending cost is one class-identity
+    # test.  See mxnet_tpu/engine.py and docs/engine.md.
+
+    @property
+    def _data(self):
+        raw = self._raw
+        if raw.__class__ is _Pending:
+            raw = _engine._materialize(raw)
+            self._raw = raw
+        return raw
+
+    @_data.setter
+    def _data(self, value):
+        self._raw = value
+
     # -- basic properties ----------------------------------------------------
     @property
     def shape(self):
-        return tuple(self._data.shape)
+        return tuple(self._raw.shape)
 
     @property
     def dtype(self):
-        return np.dtype(self._data.dtype)
+        return np.dtype(self._raw.dtype)
 
     @property
     def size(self):
@@ -131,11 +156,11 @@ class NDArray:
 
     @property
     def ndim(self):
-        return self._data.ndim
+        return self._raw.ndim
 
     @property
     def context(self) -> Context:
-        return _ctx_from_raw(self._data)
+        return _ctx_from_raw(self._raw)
 
     ctx = context
 
@@ -272,7 +297,7 @@ class NDArray:
 
     def detach(self):
         out = NDArray.__new__(NDArray)
-        out._data = self._data
+        out._raw = self._raw  # placeholder moves without materializing
         out._node, out._oidx = None, 0
         out._req_grad, out._grad, out._grad_req = False, None, "null"
         return out
@@ -287,7 +312,7 @@ class NDArray:
         """Snapshot handle used to break self-reference when an in-place op
         is recorded (the reference versions engine vars instead)."""
         out = NDArray.__new__(NDArray)
-        out._data = self._data
+        out._raw = self._raw  # placeholder moves without materializing
         out._node, out._oidx = self._node, self._oidx
         out._req_grad, out._grad, out._grad_req = (
             self._req_grad, self._grad, self._grad_req)
@@ -317,7 +342,7 @@ class NDArray:
 
     def _inplace(self, other, jf, name):
         out = self._alias()._binary(other, jf, name)
-        self._data, self._node, self._oidx = out._data, out._node, out._oidx
+        self._raw, self._node, self._oidx = out._raw, out._node, out._oidx
         return self
 
     def __add__(self, o):
@@ -486,6 +511,11 @@ class NDArray:
     def __getitem__(self, key):
         from ..ops.registry import apply_op
 
+        if self._raw.__class__ is _Pending:
+            # indexing a pending array is a sync point of the deferred
+            # engine (the flush contract, docs/engine.md); the getitem
+            # itself may then start a fresh segment
+            _engine.flush("host_sync")
         rkey = NDArray._raw_key(key)
         return apply_op(lambda a: a[rkey], self, name="getitem")
 
@@ -506,8 +536,8 @@ class NDArray:
                     value, name="setitem_full")
             else:
                 out = NDArray(jnp.full(shape, value, dt))
-            self._data, self._node, self._oidx = (
-                out._data, out._node, out._oidx)
+            self._raw, self._node, self._oidx = (
+                out._raw, out._node, out._oidx)
             return
         rkey = NDArray._raw_key(key)
         if isinstance(value, NDArray):
@@ -518,7 +548,7 @@ class NDArray:
             out = apply_op(
                 lambda a: a.at[rkey].set(jnp.asarray(value).astype(a.dtype)),
                 self._alias(), name="setitem")
-        self._data, self._node, self._oidx = out._data, out._node, out._oidx
+        self._raw, self._node, self._oidx = out._raw, out._node, out._oidx
 
     def __len__(self):
         if not self.shape:

@@ -197,10 +197,30 @@ def apply_op(fun: Callable, *nd_args, name: str = ""):
     ``fun`` must be traceable jax code closed over any non-array attributes
     (the analog of the reference's dmlc ``Parameter`` struct being bound at
     op-construction time).  Returns NDArray or tuple of NDArrays.
+
+    With op bulking on (``MXT_ENGINE_BULK=1`` / ``engine.bulk(n)``) the
+    dispatch is *deferred*: it joins the thread's pending segment and the
+    returned NDArrays hold pending placeholders until the segment flushes
+    as one jit-compiled unit (mxnet_tpu/engine.py).  The disabled path is
+    the single ``_bulk_on`` boolean test below.
     """
     import jax
 
     from ..ndarray import NDArray
+    from .. import engine as _engine
+
+    if _engine._bulk_on:
+        deferred = _engine.maybe_defer(fun, nd_args, name)
+        if deferred is not None:
+            single, vals = deferred
+            nd_outs = []
+            for v in vals:
+                o = NDArray.__new__(NDArray)
+                o._raw = v
+                o._node, o._oidx = None, 0
+                o._req_grad, o._grad, o._grad_req = False, None, "null"
+                nd_outs.append(o)
+            return nd_outs[0] if single else tuple(nd_outs)
 
     raws = [a._data for a in nd_args]
     if _san._enabled:
@@ -278,7 +298,9 @@ def commit_out(out, result):
     the result stays attached to the autograd graph."""
     if out is None:
         return result
-    out._data = result._data
+    # copy the handle slot directly: a pending placeholder moves to ``out``
+    # without forcing a flush
+    out._raw = result._raw
     out._node = result._node
     out._oidx = result._oidx
     return out

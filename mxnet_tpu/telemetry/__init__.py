@@ -218,7 +218,7 @@ def phases():
 #: per-step counters summed into the record's ``compile_count`` field:
 #: every "this step paid a trace+compile" signal across the stack
 _COMPILE_COUNTERS = ("cachedop.compile", "step_fusion.compile",
-                     "trainer.fused_cache_miss")
+                     "trainer.fused_cache_miss", "engine.bulk_compile")
 
 #: per-step counters summed into ``allreduce_bytes`` — the gradient
 #: payload the step moved (or had XLA move in-jit) for aggregation
@@ -262,6 +262,7 @@ def step_end(examples=None, **extra):
             "host_sync": sc.get("host_sync", 0),
             "cachedop_cache_hit": sc.get("cachedop.cache_hit", 0),
             "cachedop_cache_miss": sc.get("cachedop.cache_miss", 0),
+            "bulk_flush": sc.get("engine.bulk_flush", 0),
             "compile_count": sum(sc.get(k, 0) for k in _COMPILE_COUNTERS),
             "allreduce_bytes": sum(sc.get(k, 0)
                                    for k in _ALLREDUCE_BYTE_COUNTERS),

@@ -1,0 +1,442 @@
+"""Deferred imperative dispatch (engine op bulking) tests.
+
+The contract under test (docs/engine.md): with bulking on, ``apply_op``
+appends to a thread-local pending segment that flushes as ONE
+jit-compiled callable; every flush trigger (size, host sync, record
+boundary, CachedOp/kvstore dispatch, explicit) resolves pending handles;
+each op's result is bit-identical to its eager dispatch; the segment
+cache replays compiled segments; NaiveEngine and the disabled path
+bypass deferral entirely.
+"""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd as ag
+from mxnet_tpu import engine, gluon, nd, telemetry
+from mxnet_tpu.engine import _PendingArray
+
+
+@pytest.fixture(autouse=True)
+def _clean_engine():
+    engine.flush()
+    engine.clear_segment_cache()
+    prev = engine.set_bulk_size(15)
+    yield
+    engine.flush()
+    engine.set_bulk_size(prev)
+
+
+def _pending(a):
+    return a._raw.__class__ is _PendingArray
+
+
+def _arr(shape=(3, 4), seed=0, positive=False):
+    data = np.random.RandomState(seed).randn(*shape).astype(np.float32)
+    if positive:
+        data = np.abs(data) + 0.5
+    return nd.array(data)
+
+
+# --- numerical identity across the op sweep ---------------------------------
+
+SWEEP = [
+    ("add", lambda a, b: a + b),
+    ("add_scalar", lambda a, b: a + 1.25),
+    ("radd_scalar", lambda a, b: 1.25 + a),
+    ("sub", lambda a, b: a - b),
+    ("rsub_scalar", lambda a, b: 2.5 - a),
+    ("mul", lambda a, b: a * b),
+    ("mul_scalar", lambda a, b: a * 1.001),
+    ("div", lambda a, b: a / (b + 3.0)),
+    ("div_scalar", lambda a, b: a / 1.002),
+    ("rdiv_scalar", lambda a, b: 1.7 / (a + 3.0)),
+    ("pow_scalar", lambda a, b: (a + 3.0) ** 1.5),
+    ("neg", lambda a, b: -a),
+    ("exp", lambda a, b: nd.exp(a)),
+    ("log", lambda a, b: nd.log(a + 3.0)),
+    ("sqrt", lambda a, b: nd.sqrt(a + 3.0)),
+    ("rsqrt", lambda a, b: nd.rsqrt(a + 3.0)),
+    ("tanh", lambda a, b: nd.tanh(a)),
+    ("sigmoid", lambda a, b: nd.sigmoid(a)),
+    ("relu", lambda a, b: nd.relu(a)),
+    ("abs", lambda a, b: nd.abs(a)),
+    ("square", lambda a, b: nd.square(a)),
+    ("floor", lambda a, b: nd.floor(a)),
+    ("sign", lambda a, b: nd.sign(a)),
+    ("maximum", lambda a, b: nd.maximum(a, b)),
+    ("minimum", lambda a, b: nd.minimum(a, b)),
+    ("clip", lambda a, b: nd.clip(a, -0.5, 0.5)),
+    ("sum", lambda a, b: nd.sum(a)),
+    ("sum_axis", lambda a, b: nd.sum(a, axis=1)),
+    ("mean", lambda a, b: nd.mean(a, axis=0)),
+    ("max", lambda a, b: nd.max(a, axis=1)),
+    ("dot", lambda a, b: nd.dot(a, b.T)),
+    ("reshape", lambda a, b: a.reshape((4, 3))),
+    ("transpose", lambda a, b: nd.transpose(a)),
+    ("softmax", lambda a, b: nd.softmax(a, axis=-1)),
+    ("norm", lambda a, b: nd.norm(a)),
+]
+
+
+@pytest.mark.parametrize("name,fn", SWEEP, ids=[n for n, _ in SWEEP])
+def test_bulked_bit_identical_to_eager(name, fn):
+    a, b = _arr(seed=1), _arr(seed=2)
+    ref = fn(a, b).asnumpy()
+    with engine.bulk(8):
+        got = fn(a, b).asnumpy()
+    assert np.array_equal(ref, got), f"{name}: bulked != eager"
+    assert ref.dtype == got.dtype
+
+
+def test_chained_segment_matches_eager():
+    a, b = _arr(seed=3), _arr(seed=4)
+    ref = nd.tanh(nd.relu(a * b) + a).sum(axis=0).asnumpy()
+    with engine.bulk(16):
+        out = nd.tanh(nd.relu(a * b) + a).sum(axis=0)
+        assert _pending(out)
+        got = out.asnumpy()
+    np.testing.assert_allclose(ref, got, rtol=0, atol=0)
+
+
+def test_scalar_attr_change_replays_cached_segment():
+    # float attrs are runtime args: new value, same compiled segment
+    a = _arr(seed=5)
+    engine.clear_segment_cache()
+    with engine.bulk(8):
+        r1 = (a * 2.5 + 0.1).asnumpy()
+    with engine.bulk(8):
+        r2 = (a * 3.5 + 0.7).asnumpy()
+    stats = engine.segment_cache_stats()
+    assert stats["miss"] == 1 and stats["hit"] == 1
+    # mul+add fused in ONE segment may fma-contract (docs/engine.md):
+    # values match eager to the last ulp, not necessarily bitwise
+    np.testing.assert_allclose(r1, ((a * 2.5) + 0.1).asnumpy(), rtol=1e-6)
+    np.testing.assert_allclose(r2, ((a * 3.5) + 0.7).asnumpy(), rtol=1e-6)
+
+
+# --- flush triggers ---------------------------------------------------------
+
+def test_flush_on_asnumpy():
+    a = _arr()
+    with engine.bulk(8):
+        c = a + 1.0
+        assert _pending(c) and engine.pending_ops() == 1
+        c.asnumpy()
+        assert engine.pending_ops() == 0
+        assert not _pending(c)
+
+
+def test_flush_on_wait_to_read():
+    a = _arr()
+    with engine.bulk(8):
+        c = a * 2.0
+        assert _pending(c)
+        c.wait_to_read()
+        assert engine.pending_ops() == 0
+
+
+def test_flush_on_item():
+    a = nd.array(np.float32([[41.0]]))
+    with engine.bulk(8):
+        c = a + 1.0
+        assert _pending(c)
+        assert c.item() == 42.0
+        assert engine.pending_ops() == 0
+
+
+def test_flush_on_getitem():
+    a = _arr()
+    with engine.bulk(8):
+        c = a + 1.0
+        assert _pending(c)
+        seg = c._raw._segment
+        row = c[0]
+        # the producing segment flushed (the slicing op itself may be
+        # deferred into a fresh segment — it is just another op)
+        assert seg.results is not None
+        np.testing.assert_array_equal(row.asnumpy(), (a + 1.0).asnumpy()[0])
+
+
+def test_flush_on_bulk_size():
+    a = _arr()
+    with engine.bulk(3):
+        c = a + 1.0
+        c = c * 2.0
+        assert engine.pending_ops() == 2
+        c = c - 3.0  # third op hits the budget: segment executes
+        assert engine.pending_ops() == 0
+        assert not _pending(c)
+    np.testing.assert_array_equal(
+        c.asnumpy(), ((a + 1.0) * 2.0 - 3.0).asnumpy())
+
+
+def test_flush_on_record_boundary_and_grads_match():
+    a = _arr()
+    w = nd.array(np.ones((3, 4), np.float32))
+    w.attach_grad()
+    # eager reference gradient
+    with ag.record():
+        (w * (a + 1.0)).sum().backward()
+    ref_grad = w.grad.asnumpy()
+
+    w2 = nd.array(np.ones((3, 4), np.float32))
+    w2.attach_grad()
+    with engine.bulk(16):
+        pre = a + 1.0
+        assert _pending(pre)
+        with ag.record():
+            # entering record flushed the pending segment; the handle
+            # resolves to the computed buffer on its next read
+            assert engine.pending_ops() == 0
+            assert pre._raw._segment.results is not None
+            loss = (w2 * pre).sum()
+            # recording dispatches eagerly: nothing re-enters the segment
+            assert engine.pending_ops() == 0
+        loss.backward()
+    np.testing.assert_array_equal(ref_grad, w2.grad.asnumpy())
+
+
+def test_pause_does_not_flush():
+    a = _arr()
+    with engine.bulk(8):
+        c = a + 1.0
+        with ag.pause():
+            assert engine.pending_ops() == 1
+        assert _pending(c)
+
+
+def test_explicit_flush_returns_count():
+    a = _arr()
+    with engine.bulk(8):
+        _ = a + 1.0
+        _ = a * 2.0
+        assert engine.flush() == 2
+        assert engine.flush() == 0
+
+
+def test_flush_on_cachedop_dispatch():
+    net = gluon.nn.Dense(4)
+    net.initialize()
+    x = _arr((2, 3))
+    net(x)  # shape-resolve eagerly
+    net.hybridize()
+    net(x)
+    with engine.bulk(8):
+        y = x + 1.0
+        assert _pending(y)
+        net(x)  # CachedOp dispatch is a flush boundary
+        assert engine.pending_ops() == 0
+
+
+def test_flush_on_kvstore_dispatch():
+    kv = mx.kv.create("local")
+    kv.init("w", nd.zeros((3, 4)))
+    g = _arr(seed=7)
+    with engine.bulk(8):
+        scaled = g * 0.5
+        assert _pending(scaled)
+        kv.push("w", scaled)
+        assert engine.pending_ops() == 0
+    out = nd.zeros((3, 4))
+    kv.pull("w", out=out)
+    np.testing.assert_array_equal(out.asnumpy(), (g * 0.5).asnumpy())
+
+
+# --- sanitizer through a deferred segment -----------------------------------
+
+def test_sanitizer_stale_read_through_deferred_segment():
+    from mxnet_tpu import sanitizer
+
+    sanitizer.enable()
+    try:
+        a = _arr()
+        raw = a._data
+        sanitizer.donate([raw], "test_donating_site")
+        with engine.bulk(8):
+            c = a + 1.0  # consumes the donated buffer
+            assert _pending(c)
+            with pytest.raises(sanitizer.DonatedBufferError,
+                               match="test_donating_site"):
+                engine.flush()
+    finally:
+        sanitizer.reset()
+        sanitizer.disable()
+        engine._TLS.segment = None
+
+
+# --- bypasses ---------------------------------------------------------------
+
+def test_naive_engine_bypasses_bulking():
+    prev = engine.engine_type()
+    engine.set_engine_type("NaiveEngine")
+    try:
+        a = _arr()
+        with engine.bulk(8):
+            c = a + 1.0
+            assert not _pending(c)
+            assert engine.pending_ops() == 0
+    finally:
+        engine.set_engine_type(prev)
+
+
+def test_bulk_size_one_disables_deferral():
+    a = _arr()
+    with engine.bulk(1):
+        c = a + 1.0
+        assert not _pending(c)
+
+
+def test_disabled_path_never_reaches_maybe_defer(monkeypatch):
+    # the off path must be ONE boolean test in apply_op: poison
+    # maybe_defer and prove it is not consulted
+    assert not engine._bulk_on
+
+    def boom(*a, **k):  # pragma: no cover - must not run
+        raise AssertionError("maybe_defer called with bulking off")
+
+    monkeypatch.setattr(engine, "maybe_defer", boom)
+    a = _arr()
+    np.testing.assert_array_equal(
+        (a + 1.0).asnumpy(), a.asnumpy() + 1.0)
+
+
+def test_recording_forces_eager_inside_bulk():
+    a = _arr()
+    with engine.bulk(8):
+        with ag.record():
+            c = a + 1.0
+            assert not _pending(c)
+
+
+# --- cache accounting -------------------------------------------------------
+
+def test_segment_cache_hit_miss_accounting():
+    a = _arr(seed=8)
+    engine.clear_segment_cache()
+    with engine.bulk(8):
+        (nd.tanh(a) + a).asnumpy()
+    s1 = engine.segment_cache_stats()
+    assert (s1["miss"], s1["hit"], s1["size"]) == (1, 0, 1)
+    with engine.bulk(8):
+        (nd.tanh(a) + a).asnumpy()
+    s2 = engine.segment_cache_stats()
+    assert (s2["miss"], s2["hit"]) == (1, 1)
+    # different shape -> different signature -> miss
+    b = _arr((5, 2), seed=9)
+    with engine.bulk(8):
+        (nd.tanh(b) + b).asnumpy()
+    s3 = engine.segment_cache_stats()
+    assert s3["miss"] == 2 and s3["size"] == 2
+
+
+def test_cross_segment_pending_input_materializes():
+    a = _arr(seed=10)
+    with engine.bulk(2):
+        c = a + 1.0           # segment 1, pending
+        d = c * 2.0           # hits budget: segment 1 executes
+        e = d - 0.5           # segment 2, consumes executed result
+        assert _pending(e)
+        got = e.asnumpy()
+    np.testing.assert_array_equal(
+        got, ((a + 1.0) * 2.0 - 0.5).asnumpy())
+
+
+# --- env vars + telemetry ---------------------------------------------------
+
+def _run_py(code, **env):
+    full = dict(os.environ, JAX_PLATFORMS="cpu", **env)
+    return subprocess.run([sys.executable, "-c", code], env=full,
+                          capture_output=True, text=True)
+
+
+def test_env_bulk_size_honoured_at_startup():
+    r = _run_py(
+        "from mxnet_tpu import engine;"
+        "assert engine._bulk_size == 7, engine._bulk_size;"
+        "assert engine.bulk_size() == 7",
+        MXNET_ENGINE_BULK_SIZE="7")
+    assert r.returncode == 0, r.stderr
+
+
+def test_env_bulk_size_train_infer_variants():
+    r = _run_py(
+        "from mxnet_tpu import engine, autograd as ag;"
+        "assert engine.bulk_size() == 5;"   # infer mode by default
+        "ag.set_training(True);"
+        "assert engine.bulk_size() == 9",
+        MXNET_ENGINE_BULK_SIZE_IN_TRAIN="9",
+        MXNET_ENGINE_BULK_SIZE_IN_INFER="5")
+    assert r.returncode == 0, r.stderr
+
+
+def test_env_bulk_enable_flag():
+    r = _run_py(
+        "import numpy as np;"
+        "from mxnet_tpu import engine, nd;"
+        "assert engine.bulk_enabled() and engine._bulk_on;"
+        "a = nd.array(np.ones((2, 2), np.float32));"
+        "c = a + 1.0;"
+        "from mxnet_tpu.engine import _PendingArray;"
+        "assert c._raw.__class__ is _PendingArray;"
+        "assert (c.asnumpy() == 2).all()",
+        MXT_ENGINE_BULK="1")
+    assert r.returncode == 0, r.stderr
+
+
+def test_telemetry_flush_reasons_and_step_record():
+    telemetry.enable()
+    try:
+        a = _arr(seed=11)
+        telemetry.step_begin()
+        with engine.bulk(8):
+            (a + 1.0).asnumpy()          # host_sync flush
+            _ = a * 2.0
+            engine.flush()               # explicit flush
+        rec = telemetry.step_end()
+        sc = rec["counters"]
+        assert rec["bulk_flush"] == sc["engine.bulk_flush"] >= 2
+        assert sc["engine.bulk_flush.host_sync"] >= 1
+        assert sc["engine.bulk_flush.explicit"] >= 1
+        assert sc["engine.bulk_compile"] >= 1
+        assert rec["gauges"]["engine.bulk_segment_ops"] >= 1
+        # segment compiles count into the step's compile_count
+        assert rec["compile_count"] >= sc["engine.bulk_compile"]
+    finally:
+        telemetry.disable()
+
+
+def test_telemetry_size_and_record_reasons():
+    telemetry.enable()
+    try:
+        a = _arr(seed=12)
+        telemetry.step_begin()
+        with engine.bulk(2):
+            c = a + 1.0
+            c = c * 2.0                  # size flush
+            _ = a - 1.0
+            with ag.record():            # record flush
+                pass
+        rec = telemetry.step_end()
+        sc = rec["counters"]
+        assert sc["engine.bulk_flush.size"] >= 1
+        assert sc["engine.bulk_flush.record"] >= 1
+    finally:
+        telemetry.disable()
+
+
+# --- scope state ------------------------------------------------------------
+
+def test_bulk_scope_restores_sizes_and_enable():
+    engine.set_bulk_size(30)
+    assert not engine.bulk_enabled()
+    with engine.bulk(5):
+        assert engine.bulk_enabled()
+        assert engine.bulk_size() == 5
+    assert engine.bulk_size() == 30
+    assert not engine.bulk_enabled()
+    assert not engine._bulk_on

@@ -50,6 +50,15 @@ def test_t1_inline_suppression():
     assert not any(v.context.startswith("suppressed_sync") for v in vs)
 
 
+def test_t1_engine_flush_is_a_sync_site():
+    vs = _rule(_analyze("t1_engine_flush.py"), "T1")
+    # flush() inside a jitted function is a hard error...
+    assert any(v.severity == "error" and v.context == "bad_jitted_step"
+               and "engine.flush" in v.message for v in vs)
+    # ...but an eager segment boundary is legitimate use
+    assert not any(v.context == "eager_boundary" for v in vs)
+
+
 def test_t2_flags_control_flow_on_traced_values():
     vs = _rule(_analyze("t2_control_flow.py"), "T2")
     kinds = {(v.context, v.message.split("`")[1]) for v in vs}

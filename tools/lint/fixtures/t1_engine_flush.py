@@ -1,0 +1,19 @@
+"""T1 fixture: engine.flush() is a bulk-segment sync site in traced regions."""
+import jax
+
+from mxnet_tpu import engine
+
+
+def eager_boundary(a, b):
+    c = a + b
+    engine.flush()                    # fine: eager glue, explicit boundary
+    return c
+
+
+def bad_jitted_step(params, batch):
+    loss = params * batch
+    engine.flush()                    # T1 error: sync site inside a trace
+    return loss
+
+
+bad_jitted_step_jit = jax.jit(bad_jitted_step)

@@ -55,7 +55,13 @@ SYNC_METHODS_ANYWHERE = {"asnumpy", "asscalar", "item",
 SYNC_FUNCS_ANYWHERE = {"jax.device_get"}
 SYNC_FUNCS_TRACED = {"np.asarray", "numpy.asarray", "onp.asarray",
                      "_np.asarray", "np.array", "numpy.array",
-                     "jax.device_get"}
+                     "jax.device_get",
+                     # engine.flush() executes the thread's pending bulk
+                     # segment — a host-side sync site (docs/engine.md);
+                     # inside a traced region it is at best a no-op and at
+                     # worst hides a real sync the eager path would hit
+                     "engine.flush", "_engine.flush",
+                     "mxnet_tpu.engine.flush"}
 
 #: builtins that force a tracer to a host scalar
 SCALAR_BUILTINS = {"float", "int", "bool"}
