@@ -171,9 +171,54 @@ def bench_mlp_sgd():
     return out
 
 
+def observability_columns():
+    """Re-run a short hybridized mlp_sgd window under telemetry and pull
+    the memory/cost columns (PR 5) from the last step record: the step's
+    device-memory high-water mark and the compiled-artifact flops the
+    step executed.  Timed loops above run uninstrumented."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd as ag
+    from mxnet_tpu import gluon, nd, telemetry
+    from mxnet_tpu.telemetry.sinks import ListSink
+
+    telemetry.enable()
+    sink = ListSink()
+    telemetry.add_sink(sink)
+    try:
+        mx.random.seed(0)
+        net = gluon.nn.HybridSequential()
+        net.add(gluon.nn.Dense(64, activation="relu"),
+                gluon.nn.Dense(64, activation="relu"),
+                gluon.nn.Dense(10))
+        net.initialize(mx.init.Xavier())
+        net.hybridize()
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 1e-3})
+        rs = np.random.RandomState(1)
+        xb = nd.array(rs.randn(32, 64).astype(np.float32))
+        yb = nd.array(rs.randn(32, 10).astype(np.float32))
+        for _ in range(3):
+            with telemetry.step():
+                with ag.record():
+                    out = net(xb)
+                    loss = ((out - yb) ** 2).mean()
+                loss.backward()
+                trainer.step(32)
+                loss.wait_to_read()
+        last = sink.records[-1]
+        return {"peak_live_bytes": last.get("peak_live_bytes"),
+                "model_flops": last.get("model_flops")}
+    finally:
+        telemetry.disable()
+        telemetry.reset()
+
+
 def main():
     chain, per_op_identical, chain_maxdiff = bench_chain()
     mlp = bench_mlp_sgd()
+    obs = observability_columns()
     from mxnet_tpu import engine
 
     record = {
@@ -188,6 +233,8 @@ def main():
         "chain64_bulked_max_abs_diff_vs_eager": chain_maxdiff,
         "mlp_sgd_ms_per_step": {k: round(v, 3) for k, v in mlp.items()},
         "segment_cache": engine.segment_cache_stats(),
+        "mlp_sgd_peak_live_bytes": obs["peak_live_bytes"],
+        "mlp_sgd_model_flops": obs["model_flops"],
         "chain_ops": CHAIN_OPS,
         "platform": os.environ.get("JAX_PLATFORMS", "default"),
     }

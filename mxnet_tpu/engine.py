@@ -64,6 +64,8 @@ import numpy as np
 
 from .base import MXNetError
 from . import telemetry
+from .telemetry import costs as _costs
+from .telemetry import memwatch as _mw
 
 __all__ = ["engine_type", "set_engine_type", "is_naive", "bulk",
            "set_bulk_size", "bulk_size", "set_bulk_enabled", "bulk_enabled",
@@ -303,6 +305,11 @@ class _Segment:
             _cache_insert(key, entry)
         first = not entry.executed
         scalars = tuple(v for op in self.ops for v in op.lifted)
+        if _costs._enabled:
+            # cost registry shares the segment-cache key, so a replayed
+            # segment attributes its flops without re-analysis
+            _costs.note("engine_bulk", key, entry.jfn,
+                        (scalars,) + tuple(self.ext))
         prev_flushing = _TLS.flushing
         _TLS.flushing = True
         try:
@@ -315,6 +322,8 @@ class _Segment:
         except Exception as e:
             self.error = True
             names = ", ".join(op.name or "op" for op in self.ops[:8])
+            if _mw._enabled:
+                _mw.annotate_oom(e, context=f"bulk segment flush ({reason})")
             raise MXNetError(
                 f"bulked segment of {n_ops} ops ({names}{', ...' if n_ops > 8 else ''}) "
                 f"failed at flush ({reason}): {e}") from e

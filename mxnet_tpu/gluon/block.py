@@ -40,6 +40,8 @@ import numpy as np
 from ..base import MXNetError
 from .. import autograd as ag
 from .. import telemetry
+from ..telemetry import costs as _costs
+from ..telemetry import memwatch as _mw
 from ..context import Context, current_context
 from ..ndarray import NDArray
 from .parameter import (Parameter, ParameterDict,
@@ -471,24 +473,49 @@ class _CachedGraph:
         # the first dispatch per mode runs trace+compile synchronously
         # before returning, so its wall-time IS the compile cost; replay
         # wall-time is the async enqueue of the cached executable
-        with telemetry.span("cachedop.compile" if first
-                            else "cachedop.replay"), \
-                dispatch_platform(platform_of_raws(in_raws + p_raws)):
-            if recording:
-                outs, auxs, vjp = self._fwd_rec(p_raws, in_raws, key)
-            else:
-                outs, auxs = self._fwd(p_raws, in_raws, key)
+        try:
+            with telemetry.span("cachedop.compile" if first
+                                else "cachedop.replay"), \
+                    dispatch_platform(platform_of_raws(in_raws + p_raws)):
+                if recording:
+                    outs, auxs, vjp = self._fwd_rec(p_raws, in_raws, key)
+                else:
+                    outs, auxs = self._fwd(p_raws, in_raws, key)
+        except Exception as exc:
+            if _mw._enabled:
+                _mw.annotate_oom(
+                    exc, context=f"CachedOp forward ({self.block.name})")
+            raise
         if first:
             self._compiled.add(mode)
             telemetry.count("cachedop.compile")
+        if _costs._enabled:
+            # keyed per compiled specialization (graph identity + dispatch
+            # mode — graphs are one per CachedOp signature), so replays hit
+            # the registry without re-analysis
+            _costs.note("cachedop", (id(self), mode),
+                        self._fwd_rec if recording else self._fwd,
+                        (p_raws, in_raws, key))
         for i, raw in zip(self.aux_idx, auxs):
             p_handles[i]._data = raw
         nd_outs = [NDArray(r) for r in outs]
         if recording:
             bwd = self._bwd
+            graph_id = id(self)
+            block_name = self.block.name
 
             def node_vjp(cots):
-                p_cots, in_cots = bwd(vjp, tuple(cots))
+                try:
+                    p_cots, in_cots = bwd(vjp, tuple(cots))
+                except Exception as exc:
+                    if _mw._enabled:
+                        _mw.annotate_oom(
+                            exc,
+                            context=f"CachedOp backward ({block_name})")
+                    raise
+                if _costs._enabled:
+                    _costs.note("cachedop_bwd", (graph_id, "bwd"), bwd,
+                                (vjp, tuple(cots)))
                 return tuple(p_cots) + tuple(in_cots)
 
             node = ag.Node(node_vjp, list(p_handles) + list(args),

@@ -26,6 +26,7 @@ import numpy as np
 from ..base import MXNetError, resolve_dtype
 from ..context import Context, current_context
 from ..ndarray import NDArray
+from ..telemetry import memwatch as _mw
 from .. import initializer as init_mod
 
 
@@ -150,6 +151,12 @@ class Parameter:
         self._deferred_init = None
         if self._grad_req != "null":
             self._data.attach_grad(self._grad_req)
+        if _mw._enabled:
+            # label the holders so the OOM post-mortem names buffers by
+            # parameter path even after optimizer updates rebind them
+            _mw.adopt(arr, self.name)
+            if arr._grad is not None:
+                _mw.adopt(arr._grad, self.name + ".grad")
 
     def _finish_deferred_init(self, shape):
         """Complete a deferred init once the shape is known (reference:

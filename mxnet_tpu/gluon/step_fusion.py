@@ -48,6 +48,8 @@ from .. import autograd as ag
 from .. import optimizer as opt
 from .. import sanitizer as _san
 from .. import telemetry
+from ..telemetry import costs as _costs
+from ..telemetry import memwatch as _mw
 from ..base import MXNetError
 from ..ndarray import NDArray
 from .block import _trace_guard
@@ -317,6 +319,12 @@ class FusedTrainStep:
             self._snapshot()
         telemetry.gauge("step_fusion.steps_per_execution", self.k)
         telemetry.count("step_fusion.steps", self.k)
+        if _costs._enabled:
+            # registered BEFORE the donating dispatch: lower() reads only
+            # avals, so the (about-to-be-donated) buffers are never touched
+            _costs.note("step_fusion", (id(self), sig), fn,
+                        (w_raws, m_raws, s_raws, aux_raws, t_v, key, lr_v,
+                         wd_v, consts, stacked if stacked else None))
         try:
             # publish the operands' platform so platform-conditional ops
             # (pallas flash) route correctly inside the fused trace even
@@ -341,6 +349,11 @@ class FusedTrainStep:
                 _san.donate(self._donated_raws(w_raws, m_raws, s_raws,
                                                aux_raws),
                             self._donation_site())
+            if _mw._enabled:
+                # the device freed the donated buffers at dispatch even
+                # though python aliases may linger — release them now
+                _mw.donated(self._donated_raws(w_raws, m_raws, s_raws,
+                                               aux_raws))
             opt._commit_param_updates(trainer, self._live, mp_flags,
                                       masters, new_w, new_m, new_s)
             for i in self._live:
@@ -361,7 +374,7 @@ class FusedTrainStep:
                 self._validated_sigs.add(sig)
                 telemetry.count("step_fusion.compile")
             return NDArray(losses)
-        except Exception:
+        except Exception as exc:
             if snapshot is not None:
                 self._restore(snapshot)
             elif _san._enabled:
@@ -374,6 +387,8 @@ class FusedTrainStep:
                 _san.donate(self._donated_raws(w_raws, m_raws, s_raws,
                                                aux_raws),
                             self._donation_site() + " [failed execution]")
+            if _mw._enabled:
+                _mw.annotate_oom(exc, context="FusedTrainStep dispatch")
             raise
 
     def _donated_raws(self, w_raws, m_raws, s_raws, aux_raws):

@@ -19,6 +19,8 @@ from .parameter import Parameter, ParameterDict
 from .. import optimizer as opt
 from .. import sanitizer as _san
 from .. import telemetry
+from ..telemetry import costs as _costs
+from ..telemetry import memwatch as _mw
 
 __all__ = ["Trainer"]
 
@@ -322,12 +324,26 @@ class Trainer:
         lr_v = jnp.asarray(lrs, jnp.float32)
         wd_v = jnp.asarray(wds, jnp.float32)
         t_v = jnp.asarray(ts, jnp.int32)
+        if _costs._enabled:
+            # registered BEFORE the donating dispatch (lower() reads avals
+            # only); keyed by the fused-jit cache signature so replays hit
+            _costs.note("trainer_fused", (id(self), sig), fn,
+                        (w_raws, m_raws, g_raws, s_raws, lr_v, wd_v, t_v))
         # first dispatch per signature pays trace+compile synchronously;
         # replays are a single async dispatch
-        with telemetry.span("trainer.fused_compile" if compiling
-                            else "trainer.fused_update"):
-            new_w, new_m, new_s = fn(w_raws, m_raws, g_raws, s_raws, lr_v,
-                                     wd_v, t_v)
+        try:
+            with telemetry.span("trainer.fused_compile" if compiling
+                                else "trainer.fused_update"):
+                new_w, new_m, new_s = fn(w_raws, m_raws, g_raws, s_raws,
+                                         lr_v, wd_v, t_v)
+        except Exception as exc:
+            if _mw._enabled:
+                _mw.annotate_oom(exc, context="Trainer fused update")
+            raise
+        if _mw._enabled:
+            # the device freed the donated buffers at dispatch
+            _mw.donated(
+                w_raws + m_raws + tuple(r for ss in s_raws for r in ss))
         if _san._enabled:
             # the dispatch donated the old weight/master/state buffers;
             # poison them so any stale view (a detach() taken before the

@@ -28,6 +28,7 @@ from ..base import MXNetError, resolve_dtype
 from ..context import Context, current_context
 from .. import engine as _engine
 from .. import telemetry
+from ..telemetry import memwatch as _mw
 from .. import sanitizer as _san
 
 #: placeholder class for buffers pending in a deferred engine segment
@@ -115,6 +116,8 @@ class NDArray:
 
     def __init__(self, data, ctx=None, dtype=None):
         self._raw = _to_raw(data, dtype=dtype, ctx=ctx)
+        if _mw._enabled:
+            _mw.track(self._raw)
         self._node = None
         self._oidx = 0
         self._req_grad = False
@@ -135,11 +138,15 @@ class NDArray:
         if raw.__class__ is _Pending:
             raw = _engine._materialize(raw)
             self._raw = raw
+            if _mw._enabled:
+                _mw.track(raw)
         return raw
 
     @_data.setter
     def _data(self, value):
         self._raw = value
+        if _mw._enabled:
+            _mw.track(value)
 
     # -- basic properties ----------------------------------------------------
     @property
@@ -179,7 +186,12 @@ class NDArray:
         if _san._enabled:
             _san.check(self._data, "asnumpy")
         telemetry.count("host_sync")
-        return np.asarray(self._data)
+        try:
+            return np.asarray(self._data)
+        except Exception as exc:
+            if _mw._enabled:
+                _mw.annotate_oom(exc, context="asnumpy")
+            raise
 
     def asscalar(self):
         if self.size != 1:
@@ -198,6 +210,10 @@ class NDArray:
             self._data.block_until_ready()
         except AttributeError:
             pass
+        except Exception as exc:
+            if _mw._enabled:
+                _mw.annotate_oom(exc, context="wait_to_read")
+            raise
         return self
 
     wait_to_write = wait_to_read

@@ -25,6 +25,7 @@ from typing import Any, Callable, Dict
 
 from .. import autograd as ag
 from .. import sanitizer as _san
+from ..telemetry import memwatch as _mw
 
 # Global op registry: name -> python callable operating on NDArrays.
 # (Reference: nnvm's dmlc::Registry of Op objects; here ops are plain
@@ -212,6 +213,8 @@ def apply_op(fun: Callable, *nd_args, name: str = ""):
     if _engine._bulk_on:
         deferred = _engine.maybe_defer(fun, nd_args, name)
         if deferred is not None:
+            # outputs are pending placeholders here; the ledger picks the
+            # real buffers up when ``NDArray._data`` materializes the flush
             single, vals = deferred
             nd_outs = []
             for v in vals:
@@ -260,6 +263,9 @@ def apply_op(fun: Callable, *nd_args, name: str = ""):
             try:
                 jax.block_until_ready(outs)
             except Exception as e:
+                if _mw._enabled:
+                    _mw.annotate_oom(
+                        e, context=f"NaiveEngine op {name or 'op'!r}")
                 raise MXNetError(
                     f"operator {name or 'op'!r} failed under NaiveEngine "
                     f"(synchronous) dispatch: {e}") from e

@@ -24,6 +24,8 @@ import numpy as np
 from ..base import MXNetError
 from ..ndarray import NDArray
 from .. import sanitizer as _san
+from ..telemetry import costs as _costs
+from ..telemetry import memwatch as _mw
 
 __all__ = ["Optimizer", "SGD", "NAG", "Adam", "AdamW", "LAMB", "RMSProp",
            "AdaGrad", "AdaDelta", "Ftrl", "Signum", "SignSGD", "LARS",
@@ -236,10 +238,17 @@ class Optimizer:
                 donate=(0, 2))
             states = tuple(s._data for s in _flatten_state(sub_state))
             old = (master._data,) + states
+            if _costs._enabled:
+                _costs.note(
+                    "optimizer_update",
+                    (id(self), "mp", weight.shape, str(weight.dtype)),
+                    step, (master._data, grad._data, states, lr, wd, t))
             new_w, new_states = step(master._data, grad._data, states,
                                      lr, wd, t)
             if _san._enabled:
                 _san.donate(old, _PER_PARAM_SITE % type(self).__name__)
+            if _mw._enabled:
+                _mw.donated(old)
             master._data = new_w
             weight._data = new_w.astype(weight.dtype)
             _commit_state(sub_state, new_states)
@@ -251,10 +260,17 @@ class Optimizer:
                 donate=(0, 2))
             states = tuple(s._data for s in _flatten_state(state))
             old = (weight._data,) + states
+            if _costs._enabled:
+                _costs.note(
+                    "optimizer_update",
+                    (id(self), "sp", weight.shape, str(weight.dtype)),
+                    step, (weight._data, grad._data, states, lr, wd, t))
             new_w, new_states = step(weight._data, grad._data, states,
                                      lr, wd, t)
             if _san._enabled:
                 _san.donate(old, _PER_PARAM_SITE % type(self).__name__)
+            if _mw._enabled:
+                _mw.donated(old)
             weight._data = new_w
             _commit_state(state, new_states)
 

@@ -138,6 +138,26 @@ def record_span_event(name, start_s, dur_s, cat="telemetry", args=None):
         a[3] = max(a[3], dur_s * 1e3)
 
 
+def record_counter_event(name, values, ts_s=None):
+    """Chrome-trace counter sample (``"ph": "C"``): Perfetto/chrome
+    render one stacked counter track per ``name``, with one series per
+    key of ``values`` (a dict series-name -> number).  Used by
+    ``telemetry.memwatch`` to plot live device bytes alongside the span
+    timeline.  ``ts_s`` is an optional ``time.perf_counter()`` stamp."""
+    if _state != "run":
+        return
+    with _lock:
+        if _t0 is None:
+            return
+        stamp = time.perf_counter() if ts_s is None else ts_s
+        _events.append({
+            "name": name, "cat": "memory", "ph": "C",
+            "ts": (stamp - _t0) * 1e6,
+            "pid": os.getpid(), "tid": threading.get_ident() % 100000,
+            "args": {k: float(v) for k, v in values.items()},
+        })
+
+
 def dump(finished=True, profile_process="worker"):
     """Write chrome://tracing JSON to ``filename`` (reference
     ``profiler.dump``).  ``finished=True`` ends the profile: the event

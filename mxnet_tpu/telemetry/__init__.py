@@ -62,10 +62,13 @@ import threading
 import time
 
 from .sinks import JsonlSink, read_jsonl  # noqa: F401  (re-exported)
+from . import costs    # noqa: F401  (compiled-cost registry submodule)
+from . import memwatch  # noqa: F401  (live-buffer ledger submodule)
 
 __all__ = ["enable", "disable", "is_enabled", "span", "count", "gauge",
            "step", "step_begin", "step_end", "counters", "gauges",
-           "phases", "reset", "current_span", "JsonlSink", "read_jsonl"]
+           "phases", "reset", "current_span", "JsonlSink", "read_jsonl",
+           "costs", "memwatch"]
 
 # -- state -------------------------------------------------------------------
 # _enabled is read unlocked on every recorder's fast path; it is only
@@ -238,6 +241,11 @@ def step_begin():
         _step_idx += 1
         _step_t0 = time.perf_counter()
         _step_wall = time.time()
+        idx = _step_idx
+    if memwatch._enabled:
+        # reset the live-memory peak watermark to the current level so
+        # ``peak_live_bytes`` is a per-step high-water mark
+        memwatch.step_mark(idx)
 
 
 def step_end(examples=None, **extra):
@@ -270,6 +278,17 @@ def step_end(examples=None, **extra):
         if examples is not None and dur > 0:
             record["examples"] = examples
             record["examples_per_sec"] = examples / dur
+        if memwatch._enabled:
+            record["live_bytes"] = memwatch.live_bytes()
+            record["peak_live_bytes"] = memwatch.peak_live_bytes()
+            record["live_bytes_by_device"] = memwatch.live_bytes_by_device()
+        if costs._enabled:
+            model_flops = sc.get("cost.model_flops", 0.0)
+            record["model_flops"] = model_flops
+            record["bytes_accessed"] = sc.get("cost.bytes_accessed", 0.0)
+            peak = costs.peak_flops()
+            record["mfu"] = (model_flops / (dur * peak)) \
+                if peak and dur > 0 else None
         record.update(extra)
         sinks = list(_sinks)
     for s in sinks:
@@ -306,10 +325,14 @@ def step(examples=None, **extra):
 
 # -- lifecycle ---------------------------------------------------------------
 
-def enable(jsonl_path=None, append=False):
+def enable(jsonl_path=None, append=False, memory=True, cost=True):
     """Turn recording on.  ``jsonl_path`` attaches a structured-log sink
     writing one JSON line per step record (truncates unless ``append``).
-    Idempotent: re-enabling resets counters and swaps sinks."""
+    Idempotent: re-enabling resets counters and swaps sinks.  ``memory``
+    / ``cost`` also switch on the live-buffer ledger (``memwatch``) and
+    the compiled-cost registry (``costs``) — on by default so
+    ``MXNET_TELEMETRY=1`` records ``live_bytes``/``model_flops``/``mfu``
+    without further setup."""
     global _enabled
     with _lock:
         _reset_locked()
@@ -319,6 +342,10 @@ def enable(jsonl_path=None, append=False):
         if jsonl_path is not None:
             _sinks.append(JsonlSink(jsonl_path, append=append))
     _enabled = True
+    if memory:
+        memwatch.enable()
+    if cost:
+        costs.enable()
 
 
 def disable():
@@ -326,6 +353,8 @@ def disable():
     back to the near-zero path immediately."""
     global _enabled
     _enabled = False
+    memwatch.disable()
+    costs.disable()
     with _lock:
         for s in _sinks:
             s.close()

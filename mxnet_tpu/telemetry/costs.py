@@ -1,0 +1,288 @@
+"""Compiled-cost observability: the executable cost registry.
+
+The offline tools (``tools/mfu_audit.py``, ``tools/bytes_breakdown.py``)
+answer "what fraction of peak FLOPs" by re-lowering workloads after the
+fact.  This module makes the same numbers first-class *runtime*
+telemetry: every compile site — CachedOp graphs, ``FusedTrainStep``,
+engine bulk segments, the trainer's fused multi-tensor update, the
+optimizer's per-param jitted updates — calls :func:`note` with its jit
+object, its concrete arguments and **the same signature that keys its
+own compile cache**.  The first sighting of a signature pays one
+``lower().compile()`` to harvest XLA's ``cost_analysis()`` (flops,
+bytes accessed) and ``memory_analysis()`` (output/temp/argument bytes,
+donation/alias savings); every later sighting is a dict hit that
+attributes the artifact's flops and bytes to the current telemetry step
+— replays are never re-analyzed.
+
+``telemetry.step_end`` folds the per-step accumulation into the JSONL
+record as ``model_flops`` / ``bytes_accessed`` / ``mfu``, where MFU is
+measured against :func:`peak_flops` — an explicit
+:func:`set_peak_flops`, the ``MXNET_PEAK_FLOPS`` env var, or the
+built-in per-device-kind peak table (bf16 dense TFLOP/s per chip).
+
+:func:`dump` writes the registry as JSON; both offline tools accept it
+via ``--from-registry`` so post-hoc audits reuse the runtime's numbers
+instead of re-parsing HLO text.
+
+Cost discipline: hooks are ``if _costs._enabled: ...`` — one
+module-global boolean when off.  Analysis failures (backends without
+``memory_analysis``, un-lowerable argument trees) are recorded on the
+entry and never raised into training.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+
+__all__ = ["enable", "disable", "is_enabled", "note", "get", "snapshot",
+           "dump", "top_artifacts", "stats", "set_peak_flops",
+           "peak_flops", "device_kind"]
+
+#: THE fast-path flag: every compile-site hook is ``if _costs._enabled``
+_enabled = False
+_lock = threading.Lock()
+_registry = {}                      # (kind, key) -> _Artifact
+_stats = {"analyzed": 0, "hits": 0, "errors": 0}
+_peak_flops_override = None
+
+#: bf16 dense peak FLOP/s per chip, matched by lowercase substring of
+#: ``jax.devices()[0].device_kind`` (first match wins — keep the more
+#: specific generations first)
+_PEAK_FLOPS_TABLE = (
+    ("v6e", 918e12),
+    ("v6", 918e12),
+    ("v5p", 459e12),
+    ("v5 lite", 197e12),
+    ("v5e", 197e12),
+    ("v5litepod", 197e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+)
+
+
+class _Artifact:
+    """One compiled executable's analysis, keyed by its cache signature."""
+
+    __slots__ = ("kind", "key", "flops", "bytes_accessed", "output_bytes",
+                 "temp_bytes", "argument_bytes", "alias_bytes",
+                 "generated_code_bytes", "executions", "error")
+
+    def __init__(self, kind, key):
+        self.kind = kind
+        self.key = key
+        self.flops = 0.0
+        self.bytes_accessed = 0.0
+        self.output_bytes = 0
+        self.temp_bytes = 0
+        self.argument_bytes = 0
+        self.alias_bytes = 0
+        self.generated_code_bytes = 0
+        self.executions = 0
+        self.error = None
+
+    def as_dict(self):
+        return {
+            "kind": self.kind,
+            "key": _key_str(self.key),
+            "flops": self.flops,
+            "bytes_accessed": self.bytes_accessed,
+            "output_bytes": self.output_bytes,
+            "temp_bytes": self.temp_bytes,
+            "argument_bytes": self.argument_bytes,
+            "alias_bytes": self.alias_bytes,
+            "generated_code_bytes": self.generated_code_bytes,
+            "executions": self.executions,
+            "error": self.error,
+        }
+
+
+def _key_str(key, limit=300):
+    text = repr(key)
+    return text if len(text) <= limit else text[:limit] + "..."
+
+
+def _analyze(kind, key, jfn, args):
+    """lower+compile at the concrete args' avals and harvest the
+    analyses.  jax caches lowering/compilation per (fn, avals), so when
+    the site just executed the same signature this is cheap; either way
+    it is paid once per registry key."""
+    art = _Artifact(kind, key)
+    try:
+        compiled = jfn.lower(*args).compile()
+    except Exception as e:  # un-lowerable args / backend quirks
+        art.error = f"{type(e).__name__}: {e}"[:300]
+        return art
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        art.flops = max(0.0, float(ca.get("flops", 0.0) or 0.0))
+        art.bytes_accessed = max(0.0, float(
+            ca.get("bytes accessed", ca.get("bytes_accessed", 0.0)) or 0.0))
+    except Exception as e:
+        art.error = f"cost_analysis: {type(e).__name__}: {e}"[:300]
+    try:
+        ma = compiled.memory_analysis()
+        if ma is not None:
+            art.output_bytes = int(ma.output_size_in_bytes)
+            art.temp_bytes = int(ma.temp_size_in_bytes)
+            art.argument_bytes = int(ma.argument_size_in_bytes)
+            art.alias_bytes = int(ma.alias_size_in_bytes)
+            art.generated_code_bytes = int(ma.generated_code_size_in_bytes)
+    except Exception:
+        pass  # memory_analysis is best-effort off-TPU
+    return art
+
+
+def note(kind, key, jfn, args):
+    """Register-or-attribute one execution of a compiled artifact.
+
+    ``key`` must be the site's own cache-signature (hashable); ``jfn``
+    the ``jax.jit`` object it cached; ``args`` the concrete call
+    arguments (used for avals only — values are never read, so donated
+    buffers are safe).  First sighting analyzes; replays attribute the
+    stored flops/bytes to the current telemetry step without
+    re-analysis.  Returns the registry entry (None when disabled or the
+    key is unhashable)."""
+    if not _enabled:
+        return None
+    rk = (kind, key)
+    try:
+        art = _registry.get(rk)
+    except TypeError:
+        return None
+    if art is None:
+        art = _analyze(kind, key, jfn, args)
+        with _lock:
+            existing = _registry.get(rk)
+            if existing is None:
+                _registry[rk] = art
+                _stats["analyzed"] += 1
+                if art.error is not None:
+                    _stats["errors"] += 1
+            else:
+                art = existing
+                _stats["hits"] += 1
+    else:
+        with _lock:
+            _stats["hits"] += 1
+    with _lock:
+        art.executions += 1
+    from mxnet_tpu import telemetry as _t
+
+    if art.flops:
+        _t.count("cost.model_flops", art.flops)
+    if art.bytes_accessed:
+        _t.count("cost.bytes_accessed", art.bytes_accessed)
+    return art
+
+
+def get(kind, key):
+    """The registry entry for ``(kind, key)`` or None."""
+    try:
+        return _registry.get((kind, key))
+    except TypeError:
+        return None
+
+
+def snapshot():
+    """All registry entries as JSON-ready dicts."""
+    with _lock:
+        arts = list(_registry.values())
+    return [a.as_dict() for a in arts]
+
+
+def top_artifacts(n=10, by="temp_bytes"):
+    """Top ``n`` entries ranked by ``by`` (e.g. ``temp_bytes`` for the
+    OOM post-mortem, ``flops`` for hot-program listings)."""
+    rows = snapshot()
+    rows.sort(key=lambda r: -(r.get(by) or 0))
+    return rows[:n]
+
+
+def stats():
+    """{"analyzed": n, "hits": n, "errors": n, "size": n}."""
+    with _lock:
+        return dict(_stats, size=len(_registry))
+
+
+def dump(path=None):
+    """The registry as a JSON-ready dict (written to ``path`` when
+    given) — the ``--from-registry`` input of ``tools/mfu_audit.py`` and
+    ``tools/bytes_breakdown.py``."""
+    payload = {
+        "version": 1,
+        "device_kind": device_kind(),
+        "peak_flops": peak_flops(),
+        "stats": stats(),
+        "entries": snapshot(),
+    }
+    if path is not None:
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(payload, f, indent=2)
+    return payload
+
+
+# -- peak-FLOPs table ---------------------------------------------------------
+
+def device_kind():
+    try:
+        import jax
+
+        return jax.devices()[0].device_kind
+    except Exception:
+        return None
+
+
+def set_peak_flops(value):
+    """Explicitly configure the peak FLOP/s used for MFU (None resets to
+    env/table detection).  Returns the previous override."""
+    global _peak_flops_override
+    prev = _peak_flops_override
+    _peak_flops_override = float(value) if value is not None else None
+    return prev
+
+
+def peak_flops():
+    """Peak FLOP/s for MFU: explicit override, else ``MXNET_PEAK_FLOPS``,
+    else the per-device-kind table; None when unknown (e.g. cpu) — MFU
+    is then reported as null rather than against a made-up peak."""
+    if _peak_flops_override is not None:
+        return _peak_flops_override
+    env = os.environ.get("MXNET_PEAK_FLOPS")
+    if env:
+        try:
+            return float(env)
+        except ValueError:
+            pass
+    kind = device_kind()
+    if kind:
+        lowered = kind.lower()
+        for marker, value in _PEAK_FLOPS_TABLE:
+            if marker in lowered:
+                return value
+    return None
+
+
+# -- lifecycle ----------------------------------------------------------------
+
+def enable():
+    """Turn the registry on (clears prior entries)."""
+    global _enabled
+    with _lock:
+        _registry.clear()
+        _stats.update(analyzed=0, hits=0, errors=0)
+    _enabled = True
+
+
+def disable():
+    """Turn the registry off.  Entries are kept so ``dump()`` after a
+    run still sees the artifacts; the next ``enable()`` clears them."""
+    global _enabled
+    _enabled = False
+
+
+def is_enabled():
+    return _enabled

@@ -1,0 +1,408 @@
+"""Device-memory observability: the live-buffer ledger + OOM post-mortem.
+
+On TPUs the question that kills runs is "why did I OOM" — and the
+runtime is the only layer that can answer it, because only the runtime
+sees every raw-buffer bind.  This module keeps a **live-buffer ledger**:
+every NDArray raw-buffer bind (creation, op results, materialized bulk
+segments, rebinds through ``NDArray._data``) registers the buffer here,
+and release is automatic — a ``weakref`` callback on the raw
+``jax.Array`` fires when the buffer's python handle is collected, which
+on an immutable-functional runtime IS the device-memory ground truth.
+Donation consumption (the trainer/step-fusion/optimizer
+``donate_argnums`` dispatch paths) releases buffers *early*, because the
+device frees them at dispatch even while stale python aliases linger.
+
+Accounting is shape×itemsize arithmetic only — tracking a buffer never
+syncs, never touches device data (the same contract as
+``telemetry.nbytes_of``).  Ledger state:
+
+* ``live_bytes()`` / ``live_bytes_by_device()`` — current gauge;
+* ``peak_live_bytes()`` — high-water mark since the last
+  ``step_mark()`` (telemetry's ``step_begin`` resets it), the per-step
+  watermark in the JSONL record;
+* while the profiler runs, every ledger update mirrors a chrome-trace
+  counter sample (``"ph": "C"``) so Perfetto renders a live-memory
+  track alongside the span timeline.
+
+The **OOM post-mortem** half: dispatch/sync sites call
+:func:`annotate_oom` from their except paths (behind the one-boolean
+``_enabled`` flag).  If the exception smells like an XLA allocation
+failure (``RESOURCE_EXHAUSTED`` & friends), a ranked report of live
+buffers (size, dtype, owning parameter/block name path, age in steps)
+plus the top compiled artifacts by temp bytes (from
+``telemetry.costs``) is written to disk and an :class:`OOMError` naming
+the report file is raised in place of XLA's generic error.
+
+Cost discipline: identical to ``telemetry``/``sanitizer`` — every hook
+in the runtime is ``if _mw._enabled: ...``, one module-global boolean
+test when off; no lock, no allocation.  ``telemetry.enable()`` /
+``MXNET_TELEMETRY=1`` turns the ledger on with the rest of telemetry.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+import weakref
+
+from ..base import MXNetError
+
+__all__ = ["enable", "disable", "is_enabled", "track", "donated", "adopt",
+           "live_bytes", "live_bytes_by_device", "peak_live_bytes",
+           "step_mark", "ledger_size", "snapshot", "write_postmortem",
+           "annotate_oom", "looks_like_oom", "OOMError"]
+
+#: THE fast-path flag: every runtime hook is ``if _mw._enabled: ...``
+_enabled = False
+_lock = threading.Lock()
+_ledger = {}            # id(raw) -> _Entry
+_live_total = 0
+_live_by_device = {}    # device label -> bytes
+_peak_total = 0
+_peak_by_device = {}
+_step_idx = 0           # pushed by telemetry.step_begin via step_mark()
+_named = []             # [(weakref(NDArray holder), name)] — owner labels
+_report_path = None
+
+# concrete-array / tracer classes, resolved once at first enable() so the
+# disabled path never imports jax
+_ARRAY_CLS = None
+_TRACER_CLS = None
+
+
+class OOMError(MXNetError):
+    """An XLA allocation failure, re-raised with the post-mortem path."""
+
+
+class _Entry:
+    __slots__ = ("nbytes", "shape", "dtype", "device", "owner",
+                 "birth_step", "ref")
+
+
+def _ensure_classes():
+    global _ARRAY_CLS, _TRACER_CLS
+    if _ARRAY_CLS is None:
+        import jax
+        import jax.core
+
+        _ARRAY_CLS = jax.Array
+        _TRACER_CLS = jax.core.Tracer
+
+
+def _nbytes(raw):
+    size = 1
+    for s in raw.shape:
+        size *= int(s)
+    import numpy as np
+
+    return size * np.dtype(raw.dtype).itemsize
+
+
+def _device_label(raw):
+    try:
+        dev = raw.device  # Device for single-device arrays, else Sharding
+    except Exception:
+        return "unknown"
+    plat = getattr(dev, "platform", None)
+    if plat is not None:
+        return f"{plat}:{getattr(dev, 'id', 0)}"
+    try:  # Sharding: label by the participating device set
+        devs = sorted(dev.device_set, key=lambda d: d.id)
+        return f"{devs[0].platform}:{','.join(str(d.id) for d in devs)}"
+    except Exception:
+        return "unknown"
+
+
+def _scope_owner():
+    prof = sys.modules.get("mxnet_tpu.profiler")
+    if prof is None:
+        return None
+    prefix = prof.current_scope_prefix()
+    return prefix.rstrip(":") if prefix else None
+
+
+def _mirror_counter(total, by_device):
+    prof = sys.modules.get("mxnet_tpu.profiler")
+    if prof is not None and prof.is_running():
+        series = {"total": total}
+        series.update(by_device)
+        prof.record_counter_event("memwatch.live_bytes", series)
+
+
+def _add_locked(e):
+    global _live_total, _peak_total
+    _live_total += e.nbytes
+    cur = _live_by_device.get(e.device, 0) + e.nbytes
+    _live_by_device[e.device] = cur
+    if _live_total > _peak_total:
+        _peak_total = _live_total
+    if cur > _peak_by_device.get(e.device, 0):
+        _peak_by_device[e.device] = cur
+
+
+def _sub_locked(e):
+    global _live_total
+    _live_total -= e.nbytes
+    cur = _live_by_device.get(e.device, 0) - e.nbytes
+    if cur > 0:
+        _live_by_device[e.device] = cur
+    else:
+        _live_by_device.pop(e.device, None)
+
+
+# -- the ledger ---------------------------------------------------------------
+
+def track(raw, owner=None):
+    """Register a raw device buffer in the ledger (idempotent per
+    buffer: shared handles — ``detach()``/``_alias()`` — count once).
+    Placeholders (pending bulk segments), tracers and non-arrays are
+    ignored; accounting is shape×itemsize, never a sync.  Release is
+    automatic via a weakref callback when the buffer is collected."""
+    if not _enabled:
+        return
+    if not isinstance(raw, _ARRAY_CLS) or isinstance(raw, _TRACER_CLS):
+        return
+    key = id(raw)
+    with _lock:
+        e = _ledger.get(key)
+        if e is not None:
+            if e.ref() is raw:
+                if owner is not None and e.owner is None:
+                    e.owner = owner
+                return
+            # id reuse: the registered buffer died without its callback
+            # having run yet — evict the stale entry first
+            del _ledger[key]
+            _sub_locked(e)
+        e = _Entry()
+        try:
+            e.nbytes = _nbytes(raw)
+            e.shape = tuple(int(s) for s in raw.shape)
+            e.dtype = str(raw.dtype)
+            e.device = _device_label(raw)
+        except Exception:
+            return
+        e.owner = owner if owner is not None else _scope_owner()
+        e.birth_step = _step_idx
+
+        def _cb(ref, _key=key):
+            with _lock:
+                dead = _ledger.get(_key)
+                if dead is not None and dead.ref is ref:
+                    del _ledger[_key]
+                    _sub_locked(dead)
+                total, by_dev = _live_total, dict(_live_by_device)
+            _mirror_counter(total, by_dev)
+
+        e.ref = weakref.ref(raw, _cb)
+        _ledger[key] = e
+        _add_locked(e)
+        total, by_dev = _live_total, dict(_live_by_device)
+    _mirror_counter(total, by_dev)
+
+
+def donated(raws):
+    """Donation consumption: the dispatch that just ran handed these
+    buffers to a ``donate_argnums`` jitted call, so the device frees
+    them NOW even though python aliases may linger.  Releases them from
+    the ledger early; the eventual GC callback finds nothing (entry
+    identity is checked, so a reused id never double-releases)."""
+    if not _enabled:
+        return
+    with _lock:
+        changed = False
+        for raw in raws:
+            e = _ledger.get(id(raw))
+            if e is None or e.ref() is not raw:
+                continue
+            del _ledger[id(raw)]
+            _sub_locked(e)
+            changed = True
+        total, by_dev = _live_total, dict(_live_by_device)
+    if changed:
+        _mirror_counter(total, by_dev)
+
+
+def adopt(holder, name):
+    """Label an NDArray *holder* (not a buffer) with a stable owner name
+    — parameters register their data/grad handles so the post-mortem can
+    name buffers by parameter path across rebinds (optimizer updates
+    rebind ``_raw``; the holder identity survives)."""
+    if not _enabled:
+        return
+    try:
+        ref = weakref.ref(holder)
+    except TypeError:
+        return
+    with _lock:
+        _named.append((ref, name))
+
+
+def step_mark(step_idx):
+    """Reset the per-step peak watermark to the current live level
+    (called from ``telemetry.step_begin``)."""
+    global _peak_total, _step_idx
+    if not _enabled:
+        return
+    with _lock:
+        _step_idx = step_idx
+        _peak_total = _live_total
+        _peak_by_device.clear()
+        _peak_by_device.update(_live_by_device)
+
+
+def live_bytes():
+    """Current tracked device bytes (sum over devices)."""
+    with _lock:
+        return _live_total
+
+
+def live_bytes_by_device():
+    with _lock:
+        return dict(_live_by_device)
+
+
+def peak_live_bytes():
+    """High-water mark of ``live_bytes`` since the last step_mark()."""
+    with _lock:
+        return _peak_total
+
+
+def ledger_size():
+    with _lock:
+        return len(_ledger)
+
+
+def snapshot():
+    """Ranked (largest first) list of live-buffer dicts — the post-mortem
+    body, also useful interactively.  Owner names resolve through the
+    registered holders at snapshot time, so a parameter rebound since
+    bind still reports its parameter path."""
+    with _lock:
+        owners = _resolve_owners_locked()
+        rows = []
+        for key, e in _ledger.items():
+            rows.append({
+                "nbytes": e.nbytes,
+                "shape": list(e.shape),
+                "dtype": e.dtype,
+                "device": e.device,
+                "owner": owners.get(key, e.owner),
+                "age_steps": max(0, _step_idx - e.birth_step),
+            })
+    rows.sort(key=lambda r: -r["nbytes"])
+    return rows
+
+
+def _resolve_owners_locked():
+    owners = {}
+    alive = []
+    for ref, name in _named:
+        holder = ref()
+        if holder is None:
+            continue
+        alive.append((ref, name))
+        try:
+            owners[id(holder._raw)] = name
+        except Exception:
+            pass
+    _named[:] = alive
+    return owners
+
+
+# -- OOM post-mortem ----------------------------------------------------------
+
+_OOM_MARKERS = ("RESOURCE_EXHAUSTED", "Resource exhausted",
+                "Out of memory", "out of memory",
+                "Failed to allocate", "failed to allocate",
+                "Allocation failure", "OOM")
+
+
+def looks_like_oom(exc):
+    """Does this exception look like an XLA/device allocation failure?"""
+    text = f"{type(exc).__name__}: {exc}"
+    return any(m in text for m in _OOM_MARKERS)
+
+
+def write_postmortem(path=None, context="", error=""):
+    """Dump the ranked live-buffer report + the top compiled artifacts
+    by temp bytes to ``path`` (default: ``MXNET_MEMWATCH_REPORT`` or
+    ``memwatch_oom_<pid>.json`` in the cwd).  Returns the path."""
+    from . import costs as _costs
+
+    if path is None:
+        path = _report_path or os.environ.get(
+            "MXNET_MEMWATCH_REPORT") or f"memwatch_oom_{os.getpid()}.json"
+    buffers = snapshot()
+    with _lock:
+        report = {
+            "context": context,
+            "error": error,
+            "wall_time": time.time(),
+            "step": _step_idx,
+            "live_bytes": _live_total,
+            "peak_live_bytes": _peak_total,
+            "live_bytes_by_device": dict(_live_by_device),
+            "n_live_buffers": len(_ledger),
+        }
+    report["buffers"] = buffers
+    report["top_artifacts_by_temp_bytes"] = \
+        _costs.top_artifacts(n=10, by="temp_bytes")
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(report, f, indent=2)
+    return path
+
+
+def annotate_oom(exc, context=""):
+    """Called from dispatch/sync except paths (behind the ``_enabled``
+    flag): if ``exc`` is an allocation failure, write the post-mortem
+    and raise :class:`OOMError` naming the report file; otherwise
+    return so the caller re-raises the original."""
+    if not _enabled or not looks_like_oom(exc):
+        return
+    try:
+        path = write_postmortem(context=context, error=str(exc))
+    except Exception:
+        return  # never let reporting mask the original failure
+    raise OOMError(
+        f"device allocation failure during {context or 'dispatch'}: {exc}\n"
+        f"memwatch post-mortem (ranked live buffers + top compiled "
+        f"artifacts by temp bytes) written to {path}") from exc
+
+
+# -- lifecycle ----------------------------------------------------------------
+
+def enable(report_path=None):
+    """Turn the ledger on (clears prior state).  Buffers bound while
+    disabled are not tracked retroactively — enable before building the
+    model for an exact ledger."""
+    global _enabled, _report_path
+    _ensure_classes()
+    with _lock:
+        _clear_locked()
+        _report_path = report_path
+    _enabled = True
+
+
+def disable():
+    global _enabled
+    _enabled = False
+    with _lock:
+        _clear_locked()
+
+
+def is_enabled():
+    return _enabled
+
+
+def _clear_locked():
+    global _live_total, _peak_total, _step_idx
+    _ledger.clear()
+    _live_by_device.clear()
+    _peak_by_device.clear()
+    _named.clear()
+    _live_total = 0
+    _peak_total = 0
+    _step_idx = 0

@@ -74,13 +74,37 @@ def _json_default(obj):
         return repr(obj)
 
 
+class JsonlRecords(list):
+    """``read_jsonl``'s result: a plain list of record dicts (backward
+    compatible with every indexing/iteration call site) plus a
+    ``truncated`` attribute — True when the log ended mid-record (a
+    crashed run's final partial write was skipped)."""
+
+    truncated = False
+
+
 def read_jsonl(path):
     """Parse a JSONL telemetry log back into a list of record dicts
-    (skipping blank lines) — the analysis-side inverse of JsonlSink."""
-    records = []
+    (skipping blank lines) — the analysis-side inverse of JsonlSink.
+
+    A truncated FINAL line (the writer died mid-record) is tolerated:
+    the complete records are returned with ``.truncated = True`` instead
+    of raising ``json.JSONDecodeError``.  Corruption anywhere else in
+    the file still raises — that is data loss, not a crash artifact."""
+    records = JsonlRecords()
     with open(path, "r", encoding="utf-8") as f:
-        for line in f:
-            line = line.strip()
-            if line:
-                records.append(json.loads(line))
+        lines = f.readlines()
+    stripped = [ln.strip() for ln in lines]
+    last_nonblank = max((i for i, ln in enumerate(stripped) if ln),
+                        default=-1)
+    for i, line in enumerate(stripped):
+        if not line:
+            continue
+        try:
+            records.append(json.loads(line))
+        except json.JSONDecodeError:
+            if i == last_nonblank:
+                records.truncated = True
+                break
+            raise
     return records

@@ -110,6 +110,20 @@ def test_recording_calls_allowed_in_hot_paths():
     assert any(v.rule == "T4" and v.context == "bad_timed" for v in vs)
 
 
+def test_memwatch_hooks_allowed_in_hot_paths():
+    vs = _analyze("t6_memwatch.py")
+    contexts = {v.context for v in vs}
+    # memwatch/costs hooks (track/donated/note) and the same-module
+    # ledger helper must not flag in dispatch hot paths, and handing
+    # just-donated handles to _mw.donated must not trip T6
+    assert "dispatch" not in contexts
+    assert "track" not in contexts
+    assert not any(v.rule == "T6" for v in vs)
+    # a real host sync next to the hooks still flags
+    assert any(v.rule == "T1" and v.context == "bad_synced_dispatch"
+               for v in vs)
+
+
 def test_clean_fixture_has_no_violations():
     assert _analyze("clean.py") == []
 

@@ -1,0 +1,455 @@
+"""Memory & cost observability (ISSUE 5): the live-buffer ledger, the
+executable cost registry, per-step MFU/memory JSONL fields, and the OOM
+post-mortem.
+
+Acceptance shape: a hybridized + fused train loop under telemetry emits
+JSONL steps whose ``live_bytes`` matches the sum over reachable NDArray
+buffers (exact, shape×itemsize), whose ``model_flops`` matches the
+compiled artifacts' ``cost_analysis()``, with ZERO device syncs from
+recording (the ``host_sync`` counter in the same record stays 0); an
+injected allocation failure produces a post-mortem naming the largest
+live buffer by parameter path; the disabled path stays one
+module-global boolean per hook.
+"""
+import gc
+import json
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, engine, gluon, nd, telemetry
+from mxnet_tpu.telemetry import costs, memwatch
+from mxnet_tpu.telemetry.sinks import ListSink
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+BATCH = 4
+IN_DIM = 6
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    telemetry.disable()
+    telemetry.reset()
+    costs.set_peak_flops(None)
+    yield
+    telemetry.disable()
+    telemetry.reset()
+    costs.set_peak_flops(None)
+
+
+def _net(units=(8, 4), in_dim=IN_DIM):
+    net = gluon.nn.HybridSequential()
+    for u in units[:-1]:
+        net.add(gluon.nn.Dense(u, activation="relu"))
+    net.add(gluon.nn.Dense(units[-1]))
+    net.initialize(mx.init.Xavier())
+    net(nd.ones((1, in_dim)))  # resolve deferred shapes
+    return net
+
+
+def _nbytes(raw):
+    n = 1
+    for s in raw.shape:
+        n *= int(s)
+    return n * np.dtype(raw.dtype).itemsize
+
+
+def _train_steps(net, trainer, loss_fn, x, y, n):
+    for _ in range(n):
+        with telemetry.step():
+            with autograd.record():
+                loss = loss_fn(net(x), y)
+            loss.backward()
+            trainer.step(BATCH)
+        del loss
+        gc.collect()
+
+
+# --- the ledger --------------------------------------------------------------
+
+def test_ledger_matches_reachable_buffers_exactly():
+    """live_bytes == the shape×itemsize sum over every reachable NDArray
+    buffer, with shared handles counted once."""
+    telemetry.enable()
+    net = _net()
+    net.hybridize()
+    x = nd.ones((BATCH, IN_DIM))
+    out = net(x)
+    gc.collect()  # shape-resolution intermediates die -> weakrefs fire
+    reachable = {}
+    for p in net.collect_params().values():
+        reachable[id(p.data()._data)] = p.data()._data
+        if p.grad_req != "null":
+            reachable[id(p.grad()._data)] = p.grad()._data
+    for a in (x, out):
+        reachable[id(a._data)] = a._data
+    assert memwatch.ledger_size() == len(reachable)
+    assert memwatch.live_bytes() == sum(
+        _nbytes(r) for r in reachable.values())
+    # a detached alias shares the buffer: ledger must not double count
+    before = memwatch.live_bytes()
+    alias = out.detach()
+    assert memwatch.live_bytes() == before
+    del alias
+
+
+def test_no_leak_across_train_steps():
+    """Steady-state training neither leaks nor loses ledger entries:
+    live_bytes after step 10 == after step 3."""
+    telemetry.enable()
+    net = _net()
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.01, "momentum": 0.9})
+    loss_fn = gluon.loss.L2Loss()
+    x = nd.ones((BATCH, IN_DIM))
+    y = nd.ones((BATCH, 4))
+    _train_steps(net, trainer, loss_fn, x, y, 3)
+    at3 = memwatch.live_bytes()
+    n3 = memwatch.ledger_size()
+    _train_steps(net, trainer, loss_fn, x, y, 7)
+    assert memwatch.live_bytes() == at3
+    assert memwatch.ledger_size() == n3
+
+
+def test_peak_watermark_under_bulking():
+    """The per-step peak keeps the high-water mark even after the
+    intermediates of a bulked segment are collected."""
+    telemetry.enable()
+    x = nd.ones((64, 64))
+    gc.collect()
+    memwatch.step_mark(1)
+    base = memwatch.live_bytes()
+    with engine.bulk(8):
+        y = x + 1.0
+        z = y * 2.0
+        w = z - 3.0
+    for a in (y, z, w):  # materialize -> the ledger sees the buffers
+        a.wait_to_read()
+    grown = memwatch.live_bytes()
+    assert grown >= base + 3 * _nbytes(x._data)
+    del y, z, w, a
+    gc.collect()
+    assert memwatch.live_bytes() == base
+    assert memwatch.peak_live_bytes() >= grown  # watermark survives
+
+
+def test_donation_releases_old_buffers_early():
+    """A donating optimizer update releases the old weight/state buffers
+    from the ledger at dispatch, even while a python alias lingers."""
+    telemetry.enable()
+    w = nd.ones((32, 32))
+    g = nd.ones((32, 32))
+    optzr = mx.optimizer.SGD(learning_rate=0.1, momentum=0.9)
+    state = optzr.create_state(0, w)
+    gc.collect()
+    before = memwatch.live_bytes()
+    old_raw = w._data  # keep the donated buffer's python handle alive
+    optzr.update(0, w, g, state)
+    gc.collect()
+    # old buffers were donated (released early) and the new results were
+    # tracked: same shapes, so the ledger balances exactly — it would
+    # read `before + nbytes(old_raw)` if donation were not accounted
+    assert memwatch.live_bytes() == before
+    assert id(old_raw) not in memwatch._ledger
+
+
+# --- the cost registry -------------------------------------------------------
+
+def test_cost_registry_hit_on_cachedop_replay():
+    """First dispatch per compiled graph analyzes once; replays are
+    registry hits that still bump the execution count."""
+    telemetry.enable()
+    net = _net()
+    net.hybridize()
+    x = nd.ones((BATCH, IN_DIM))
+    net(x)
+    s0 = costs.stats()
+    assert s0["analyzed"] >= 1
+    net(x)
+    s1 = costs.stats()
+    assert s1["analyzed"] == s0["analyzed"]  # replay never re-analyzes
+    assert s1["hits"] == s0["hits"] + 1
+    arts = [a for a in costs.snapshot() if a["kind"] == "cachedop"]
+    assert len(arts) == 1
+    assert arts[0]["executions"] == 2
+    assert arts[0]["error"] is None
+    assert arts[0]["flops"] > 0
+
+
+def test_registry_covers_fused_trainer_and_backward():
+    telemetry.enable()
+    net = _net()
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.01})
+    loss_fn = gluon.loss.L2Loss()
+    _train_steps(net, trainer, loss_fn, nd.ones((BATCH, IN_DIM)),
+                 nd.ones((BATCH, 4)), 2)
+    kinds = {a["kind"] for a in costs.snapshot()}
+    assert {"cachedop", "cachedop_bwd", "trainer_fused"} <= kinds
+
+
+def test_registry_covers_engine_bulk_segments():
+    telemetry.enable()
+    x = nd.ones((8, 8))
+    with engine.bulk(4):
+        y = (x + 1.0) * 2.0
+    y.wait_to_read()
+    assert any(a["kind"] == "engine_bulk" for a in costs.snapshot())
+
+
+# --- per-step JSONL fields ---------------------------------------------------
+
+def test_e2e_jsonl_memory_and_cost_fields():
+    """The acceptance loop: hybridized + fused training emits records
+    with live_bytes/peak_live_bytes/model_flops/mfu populated, zero
+    host syncs, and model_flops equal to the executed artifacts'
+    cost_analysis() sum."""
+    telemetry.enable()
+    costs.set_peak_flops(1e12)
+    sink = ListSink()
+    telemetry.add_sink(sink)
+    net = _net()
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.01})
+    loss_fn = gluon.loss.L2Loss()
+    x = nd.ones((BATCH, IN_DIM))
+    y = nd.ones((BATCH, 4))
+    _train_steps(net, trainer, loss_fn, x, y, 3)
+    execs_before = {(a["kind"], a["key"]): a["executions"]
+                    for a in costs.snapshot()}
+    _train_steps(net, trainer, loss_fn, x, y, 1)
+    last = sink.records[-1]
+    # the record was cut while the step's loss scalar was still alive,
+    # so it can only be >= the post-gc ledger total
+    assert last["live_bytes"] >= memwatch.live_bytes() > 0
+    assert last["peak_live_bytes"] >= last["live_bytes"]
+    assert last["live_bytes_by_device"]
+    # model_flops == sum of cost_analysis() flops over the artifacts the
+    # step actually executed (execution-count delta), exactly
+    expected = sum(
+        a["flops"] * (a["executions"] -
+                      execs_before.get((a["kind"], a["key"]), 0))
+        for a in costs.snapshot())
+    assert last["model_flops"] == pytest.approx(expected)
+    assert last["model_flops"] > 0
+    assert last["bytes_accessed"] > 0
+    dur_s = last["step_ms"] / 1e3
+    assert last["mfu"] == pytest.approx(
+        last["model_flops"] / (dur_s * 1e12), rel=1e-6)
+    # recording added ZERO device syncs
+    assert last["host_sync"] == 0
+
+
+def test_mfu_null_without_peak():
+    telemetry.enable()
+    sink = ListSink()
+    telemetry.add_sink(sink)
+    if costs.peak_flops() is not None:
+        pytest.skip("host has a detectable peak-FLOPs entry")
+    with telemetry.step():
+        nd.ones((2, 2)) + 1.0
+    assert sink.records[-1]["mfu"] is None
+
+
+def test_profiler_counter_track(tmp_path):
+    """Ledger updates mirror chrome-trace counter samples while the
+    profiler runs — the Perfetto live-memory track."""
+    from mxnet_tpu import profiler
+
+    telemetry.enable()
+    path = str(tmp_path / "trace.json")
+    profiler.set_config(profile_all=True, filename=path)
+    profiler.set_state("run")
+    a = nd.ones((16, 16))
+    a.wait_to_read()
+    profiler.dump(finished=True)
+    events = json.load(open(path))["traceEvents"]
+    counters = [e for e in events if e.get("ph") == "C" and
+                e["name"] == "memwatch.live_bytes"]
+    assert counters
+    assert counters[-1]["args"]["total"] > 0
+    del a
+
+
+# --- OOM post-mortem ---------------------------------------------------------
+
+def test_oom_postmortem_names_largest_buffer(tmp_path):
+    report = str(tmp_path / "oom.json")
+    telemetry.enable()
+    memwatch.enable(report_path=report)  # re-enable with a report path
+    net = _net(units=(16, 4))
+    net.hybridize()
+    x = nd.ones((BATCH, IN_DIM))
+    net(x)  # build the compiled graph
+    g = list(net._cached_op._graphs.values())[0]
+
+    def boom(*a, **k):
+        raise RuntimeError(
+            "RESOURCE_EXHAUSTED: Out of memory allocating 99999 bytes")
+
+    g._fwd = boom
+    g._compiled.add("fwd")
+    with pytest.raises(memwatch.OOMError) as ei:
+        net(x)
+    assert report in str(ei.value)  # the raised error names the file
+    assert isinstance(ei.value.__cause__, RuntimeError)
+    rep = json.load(open(report))
+    assert rep["live_bytes"] == memwatch.live_bytes()
+    assert rep["n_live_buffers"] == memwatch.ledger_size()
+    buffers = rep["buffers"]
+    assert buffers == sorted(buffers, key=lambda b: -b["nbytes"])
+    # the largest live buffer is the big dense weight, named by its
+    # parameter path
+    params = net.collect_params()
+    largest = max(params.values(), key=lambda p: np.prod(p.shape))
+    assert buffers[0]["owner"] in (largest.name, largest.name + ".grad")
+    assert buffers[0]["nbytes"] == int(np.prod(largest.shape)) * 4
+    assert "top_artifacts_by_temp_bytes" in rep
+
+
+def test_non_oom_errors_pass_through():
+    telemetry.enable()
+    with pytest.raises(ValueError):
+        try:
+            raise ValueError("shape mismatch")
+        except ValueError as e:
+            memwatch.annotate_oom(e, context="test")  # returns silently
+            raise
+
+
+# --- offline tools: --from-registry ------------------------------------------
+
+def test_tools_from_registry_agrees_with_lowering(tmp_path):
+    """The runtime registry's numbers equal what the offline tools'
+    fallback (lower+compile+cost_analysis) computes for the same
+    compiled program on a small model."""
+    from tools.mfu_audit import load_registry, registry_report
+    from tools.bytes_breakdown import registry_breakdown
+
+    telemetry.enable()
+    net = _net()
+    net.hybridize()
+    x = nd.ones((BATCH, IN_DIM))
+    net(x)
+    net(x)
+    art = [a for a in costs.snapshot() if a["kind"] == "cachedop"][0]
+
+    # the fallback path: re-lower the same jit at the same avals, as the
+    # offline audit does, and price it independently
+    from mxnet_tpu import random as mxrand
+
+    g = list(net._cached_op._graphs.values())[0]
+    p_raws = [p.data()._data for p in net.collect_params().values()]
+    ca = g._fwd.lower(p_raws, [x._data], mxrand.next_key()) \
+        .compile().cost_analysis()
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0]
+    assert art["flops"] == pytest.approx(float(ca["flops"]))
+
+    path = str(tmp_path / "COSTS.json")
+    costs.dump(path)
+    payload = load_registry(path)
+    assert payload is not None
+    rep = registry_report(payload, step_time_s=None)
+    assert rep["per_kind"]["cachedop"]["flops_per_execution"] == \
+        pytest.approx(float(ca["flops"]))
+    assert rep["flops_per_step"] == pytest.approx(sum(
+        a["flops"] for a in costs.snapshot()))
+    bd = registry_breakdown(payload, top=5)
+    assert bd["n_artifacts"] == len(costs.snapshot())
+    assert bd["top"][0]["bytes"] == max(
+        a["bytes_accessed"] for a in costs.snapshot())
+
+
+def test_tools_from_registry_fallback_on_missing_dump(tmp_path):
+    from tools.mfu_audit import load_registry
+
+    assert load_registry(str(tmp_path / "nope.json")) is None
+    bad = tmp_path / "bad.json"
+    bad.write_text("{not json")
+    assert load_registry(str(bad)) is None
+    empty = tmp_path / "empty.json"
+    empty.write_text(json.dumps({"entries": []}))
+    assert load_registry(str(empty)) is None
+
+
+# --- read_jsonl truncation tolerance -----------------------------------------
+
+def test_read_jsonl_tolerates_truncated_final_line(tmp_path):
+    p = tmp_path / "crashed.jsonl"
+    with open(p, "w") as f:
+        f.write(json.dumps({"step": 0, "step_ms": 1.0}) + "\n")
+        f.write(json.dumps({"step": 1, "step_ms": 1.1}) + "\n")
+        f.write('{"step": 2, "step_m')  # writer died mid-record
+    records = telemetry.read_jsonl(str(p))
+    assert [r["step"] for r in records] == [0, 1]
+    assert records.truncated is True
+
+    clean = tmp_path / "clean.jsonl"
+    with open(clean, "w") as f:
+        f.write(json.dumps({"step": 0}) + "\n")
+    ok = telemetry.read_jsonl(str(clean))
+    assert [r["step"] for r in ok] == [0]
+    assert ok.truncated is False
+
+    # corruption mid-file is data loss, not a crash artifact: still raise
+    corrupt = tmp_path / "corrupt.jsonl"
+    with open(corrupt, "w") as f:
+        f.write('{"step": 0, "ste\n')
+        f.write(json.dumps({"step": 1}) + "\n")
+    with pytest.raises(json.JSONDecodeError):
+        telemetry.read_jsonl(str(corrupt))
+
+
+# --- disabled path -----------------------------------------------------------
+
+class _PoisonLock:
+    def __enter__(self):
+        raise AssertionError("disabled recorder acquired the lock")
+
+    def __exit__(self, *exc):
+        return False
+
+    acquire = __enter__
+
+
+def test_disabled_hooks_never_lock_or_record(monkeypatch):
+    """Disabled memwatch/costs hooks are one boolean test — no lock, no
+    allocation, no state."""
+    assert not memwatch._enabled and not costs._enabled
+    size_before = costs.stats()["size"]  # entries survive disable() by design
+    monkeypatch.setattr(memwatch, "_lock", _PoisonLock())
+    monkeypatch.setattr(costs, "_lock", _PoisonLock())
+    raw = nd.ones((4,))._data
+    memwatch.track(raw)
+    memwatch.donated((raw,))
+    memwatch.adopt(nd.ones((1,)), "x")
+    memwatch.step_mark(7)
+    memwatch.annotate_oom(RuntimeError("RESOURCE_EXHAUSTED"), "test")
+    assert costs.note("k", 1, None, ()) is None
+    monkeypatch.undo()
+    assert memwatch.ledger_size() == 0
+    assert costs.stats()["size"] == size_before
+
+
+def test_disabled_overhead_bounded():
+    """Matches test_telemetry's guard: 1e4 disabled hook invocations
+    must be effectively free (generous absolute bound — catches an
+    accidental lock/allocation regression, not scheduler noise)."""
+    raw = nd.ones((4,))._data
+    t0 = time.perf_counter()
+    for _ in range(10_000):
+        memwatch.track(raw)
+        memwatch.donated((raw,))
+        costs.note("k", 1, None, ())
+    assert time.perf_counter() - t0 < 0.5

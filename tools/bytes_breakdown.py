@@ -19,6 +19,15 @@ Usage:
 Prints one JSON object: total bytes (instruction-walk vs cost_analysis
 cross-check) and the TOP instructions by bytes with their shapes and
 estimated cycles, so a bandwidth fix can be judged before it's written.
+
+Runtime-registry mode: ``telemetry.costs.dump("COSTS.json")`` from an
+instrumented run holds every executed artifact's bytes already;
+
+    python tools/bytes_breakdown.py --from-registry COSTS.json
+
+ranks those artifacts by ``bytes_accessed`` (with output/temp/argument
+splits from ``memory_analysis``) instead of re-lowering and walking HLO
+text.  A missing/empty dump falls back to the HLO-walk path above.
 """
 from __future__ import annotations
 
@@ -122,9 +131,49 @@ def entry_breakdown(hlo):
     return rows
 
 
+def registry_breakdown(payload, top=30):
+    """Ranked artifact rows from a runtime cost-registry dump — the
+    per-compiled-program analog of the per-instruction HLO walk."""
+    rows = []
+    for e in payload.get("entries", []):
+        rows.append({
+            "kind": e["kind"],
+            "key": e.get("key", "")[:80],
+            "bytes": float(e.get("bytes_accessed", 0.0) or 0.0),
+            "output_bytes": e.get("output_bytes", 0),
+            "temp_bytes": e.get("temp_bytes", 0),
+            "argument_bytes": e.get("argument_bytes", 0),
+            "flops": e.get("flops", 0.0),
+            "executions": e.get("executions", 0),
+        })
+    rows.sort(key=lambda r: -r["bytes"])
+    total = sum(r["bytes"] for r in rows)
+    return {
+        "source": "runtime cost registry",
+        "device_kind": payload.get("device_kind"),
+        "registry_bytes_accessed": total,
+        "n_artifacts": len(rows),
+        "top": [dict(r, gbytes=round(r["bytes"] / 1e9, 3))
+                for r in rows[:top]],
+    }
+
+
 def main():
-    workload = sys.argv[1] if len(sys.argv) > 1 else "bert_base"
+    argv = list(sys.argv[1:])
     top = int(os.environ.get("TOP", "30"))
+    if "--from-registry" in argv:
+        i = argv.index("--from-registry")
+        path = argv[i + 1] if i + 1 < len(argv) else "COSTS.json"
+        import mfu_audit
+
+        payload = mfu_audit.load_registry(path)
+        if payload is not None:
+            print(json.dumps(registry_breakdown(payload, top), indent=1))
+            return
+        print(f"registry dump {path!r} missing or empty; falling back "
+              "to the HLO-walk path", file=sys.stderr)
+        del argv[i:i + 2]
+    workload = argv[0] if argv else "bert_base"
     os.environ["AUDIT_PLATFORM"] = "tpu_topology"
     os.environ.setdefault("THROUGHPUT", "1")  # not used here
 

@@ -35,8 +35,11 @@ the next; ``except`` handlers inherit the poison of the guarded body
 
 Reads that occur as arguments to the runtime donation sanitizer
 (``_san.donate(...)`` / ``sanitizer.*``, see mxnet_tpu/sanitizer.py)
-are exempt: handing the just-donated handles to the poison registry is
-the one legitimate post-donation use.
+or the memwatch ledger (``_mw.donated(...)``, see
+mxnet_tpu/telemetry/memwatch.py) are exempt: handing the just-donated
+handles to the poison registry / releasing them from the live-buffer
+ledger are the legitimate post-donation uses — both read only ``id()``
+and shape metadata, never the device buffer.
 
 Known precision limits (documented in docs/lint.md): attribute-rooted
 bindings are tracked by attribute name only; ``donate_argnames`` is
@@ -50,9 +53,10 @@ import ast
 
 from .core import Violation, SEVERITY_ERROR, dotted_name, last_name
 
-#: dotted heads naming the runtime donation sanitizer: reads inside
-#: these calls are the poison-registry handoff, not buffer uses
-SANITIZER_HEADS = {"_san", "sanitizer"}
+#: dotted heads naming the runtime donation sanitizer and the memwatch
+#: ledger: reads inside these calls are the poison-registry handoff /
+#: ledger release of just-donated handles, not buffer uses
+SANITIZER_HEADS = {"_san", "sanitizer", "_mw", "memwatch"}
 
 #: callables that enter a donating trace when given donate_argnums
 _JIT_NAMES = {"jit", "pjit"}

@@ -48,7 +48,12 @@ HOT_DECORATOR_NAMES = TRACE_ENTRY_NAMES - {"apply_op"}
 #: helper (whose ``time.perf_counter`` would then false-positive as T4)
 RECORDING_SAFE_CALLEES = {
     "span", "count", "gauge", "mark", "step_begin", "step_end",
-    "record_op_event", "record_span_event", "current_scope_prefix",
+    "record_op_event", "record_span_event", "record_counter_event",
+    "current_scope_prefix",
+    # memwatch/costs observability hooks (PR 5): shape×itemsize ledger
+    # arithmetic and registry bookkeeping — never a device sync, and
+    # guarded by one-boolean flags outside traces
+    "track", "donated", "adopt", "step_mark", "annotate_oom", "note",
 }
 
 _FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)

@@ -70,7 +70,12 @@ SCALAR_BUILTINS = {"float", "int", "bool"}
 #: ``prof.record_span_event(...)`` never sync and never run inside a trace
 #: (spans enter the trace path only via _trace_guard-stripped replays), so
 #: T1/T4 skip them outright
-RECORDING_HEADS = {"telemetry", "profiler", "prof"}
+RECORDING_HEADS = {"telemetry", "profiler", "prof",
+                   # memory/cost observability (telemetry.memwatch /
+                   # telemetry.costs, conventionally imported as _mw /
+                   # _costs): ledger and registry updates are host-side
+                   # arithmetic behind one-boolean flags — never a sync
+                   "memwatch", "costs", "_mw", "_costs"}
 
 
 def _is_recording_call(dotted: str) -> bool:

@@ -26,6 +26,16 @@ Usage (each workload isolated in its own process — AMP is global state):
     python tools/mfu_audit.py all               # subprocess per workload,
                                                 # writes MFU_AUDIT_r04.json
 
+Runtime-registry mode: a run with ``MXNET_TELEMETRY=1`` (or
+``telemetry.costs.enable()``) already holds every compiled artifact's
+``cost_analysis()``; ``telemetry.costs.dump("COSTS.json")`` writes it and
+
+    python tools/mfu_audit.py --from-registry COSTS.json
+
+audits from the runtime's own numbers — no re-lowering, and the flops
+are those of the artifacts that actually executed.  A missing/empty/
+unreadable dump falls back to the lowering path above.
+
 Throughput inputs default to the round-3 driver artifacts; override with
 e.g. ``THROUGHPUT=5151.48`` (samples/sec) per run.  ``AUDIT_PLATFORM=cpu``
 lowers on the CPU backend (identical dominant FLOPs; transcendental
@@ -381,8 +391,96 @@ WORKLOADS = {
 }
 
 
+# -- runtime-registry mode ---------------------------------------------------
+
+def load_registry(path):
+    """Parse a ``telemetry.costs.dump()`` JSON file; None when the file
+    is missing, unreadable or holds no analyzed entries (the caller then
+    falls back to the lowering path)."""
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            payload = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(payload, dict) or not payload.get("entries"):
+        return None
+    return payload
+
+
+def registry_report(payload, throughput=None, step_time_s=None):
+    """Audit record from a runtime cost-registry dump: per-kind flops /
+    bytes totals (execution-weighted and per-execution), MFU against the
+    dump's peak when a measured ``throughput`` (steps/sec) or
+    ``step_time_s`` is supplied.
+
+    ``flops_per_step`` sums ONE execution of every train-step-resident
+    kind (cachedop fwd/bwd, fused updates, bulk segments) — the same
+    "one full step" the lowering path prices; ``total_flops`` weights by
+    recorded execution counts (the whole run's compute)."""
+    per_kind = {}
+    for e in payload.get("entries", []):
+        k = per_kind.setdefault(e["kind"], {
+            "artifacts": 0, "executions": 0, "flops_per_execution": 0.0,
+            "bytes_per_execution": 0.0, "total_flops": 0.0,
+            "total_bytes_accessed": 0.0, "errors": 0})
+        k["artifacts"] += 1
+        k["executions"] += e.get("executions", 0)
+        k["flops_per_execution"] += e.get("flops", 0.0) or 0.0
+        k["bytes_per_execution"] += e.get("bytes_accessed", 0.0) or 0.0
+        k["total_flops"] += (e.get("flops", 0.0) or 0.0) * \
+            e.get("executions", 0)
+        k["total_bytes_accessed"] += \
+            (e.get("bytes_accessed", 0.0) or 0.0) * e.get("executions", 0)
+        if e.get("error"):
+            k["errors"] += 1
+    flops_per_step = sum(k["flops_per_execution"] for k in
+                         per_kind.values())
+    rec = {
+        "source": "runtime cost registry",
+        "device_kind": payload.get("device_kind"),
+        "peak_flops": payload.get("peak_flops"),
+        "per_kind": per_kind,
+        "flops_per_step": flops_per_step,
+        "bytes_accessed_per_step": sum(
+            k["bytes_per_execution"] for k in per_kind.values()),
+        "total_flops": sum(k["total_flops"] for k in per_kind.values()),
+        "total_bytes_accessed": sum(
+            k["total_bytes_accessed"] for k in per_kind.values()),
+    }
+    peak = payload.get("peak_flops")
+    if step_time_s is None and throughput:
+        step_time_s = 1.0 / float(throughput)
+    if peak and step_time_s:
+        rec["step_time_s"] = step_time_s
+        rec["achieved_flops_per_sec"] = flops_per_step / step_time_s
+        rec["mfu"] = round(flops_per_step / step_time_s / peak, 4)
+    return rec
+
+
+def _main_from_registry(path):
+    payload = load_registry(path)
+    if payload is None:
+        print(f"registry dump {path!r} missing or empty; falling back "
+              "to the lowering path", file=sys.stderr)
+        return False
+    thr = os.environ.get("THROUGHPUT")
+    step_s = os.environ.get("STEP_TIME_S")
+    rec = registry_report(payload,
+                          throughput=float(thr) if thr else None,
+                          step_time_s=float(step_s) if step_s else None)
+    print(json.dumps(rec, indent=1))
+    return True
+
+
 def main():
-    which = sys.argv[1] if len(sys.argv) > 1 else "all"
+    argv = list(sys.argv[1:])
+    if "--from-registry" in argv:
+        i = argv.index("--from-registry")
+        path = argv[i + 1] if i + 1 < len(argv) else "COSTS.json"
+        if _main_from_registry(path):
+            return
+        del argv[i:i + 2]  # fallback: audit by lowering
+    which = argv[0] if argv else "all"
     if which != "all":
         WORKLOADS[which]()
         return
