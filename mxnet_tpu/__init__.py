@@ -84,6 +84,7 @@ _LAZY = {
     "model": ".model",
     "predictor": ".predictor",
     "checkpoint": ".checkpoint",
+    "elastic": ".elastic",
 }
 
 

@@ -6,31 +6,50 @@ plus manual restart, and a torn checkpoint (killed mid-write) silently
 breaks the restart.  This module goes further, TPU-first (preemptible TPU
 jobs make this a first-class need):
 
-- **Atomic**: each checkpoint is staged in ``<dir>/.tmp-<step>`` and
-  ``os.rename``d to ``<dir>/ckpt-<step>`` (atomic on POSIX) — a crash at
-  any point leaves either the previous complete checkpoint or a stray tmp
-  dir that resume ignores.
+- **Atomic**: each checkpoint is staged in ``<dir>/.tmp-<step>-…-<pid>``
+  and ``os.rename``d to ``<dir>/ckpt-<step>`` (atomic on POSIX) — a crash
+  at any point leaves either the previous complete checkpoint or a stray
+  tmp dir that resume sweeps.
 - **Complete**: weights (``save_parameters`` — reference-compatible
   .params container), Trainer/optimizer state (``Trainer.save_states``),
   the framework RNG position, and a user ``extra`` dict, tied together by
   a ``manifest.json`` carrying the global step.
 - **Resumable**: ``resume(dir, net, trainer)`` loads the NEWEST complete
   checkpoint and returns its step (0 when none) — the standard
-  "restart-the-job, call resume, continue the loop" pattern.
+  "restart-the-job, call resume, continue the loop" pattern.  A torn
+  newest checkpoint (truncated manifest, missing member file) falls back
+  to the previous complete one instead of wedging the restart.
+- **Async** (``save_checkpoint_async`` / ``AsyncCheckpointer``): the
+  device→host snapshot happens synchronously (cheap copies, span
+  ``ckpt.snapshot``); serialization + fsync + atomic rename run on a
+  background writer thread (span ``ckpt.write``) so the train loop keeps
+  stepping while bytes hit disk.  The staging protocol is unchanged, so
+  a crash mid-async-write still leaves the previous complete checkpoint.
+
+Preemption drain (``drain_checkpoint_and_exit``): flush in-flight async
+writes, cut a final sync checkpoint, and exit with the distinct
+"preempted" code ``tools/launch.py`` maps to a graceful relaunch — see
+docs/fault_tolerance.md.
 """
 from __future__ import annotations
 
 import json
 import os
+import queue
 import shutil
+import sys
+import threading
 import time
+import warnings
 
 import numpy as np
 
 from .base import MXNetError
+from . import telemetry
 
-__all__ = ["save_checkpoint", "latest_checkpoint", "resume",
-           "prune_checkpoints"]
+__all__ = ["save_checkpoint", "save_checkpoint_async", "AsyncCheckpointer",
+           "async_checkpointer", "wait_async", "latest_checkpoint",
+           "resume", "prune_checkpoints", "drain_checkpoint_and_exit"]
 
 _PREFIX = "ckpt-"
 
@@ -59,6 +78,118 @@ def _fsync_tree(root):
         _fsync_dir(dirpath)
 
 
+def _tree_bytes(root):
+    """Total file bytes under ``root`` (for the ``ckpt.bytes`` counter)."""
+    total = 0
+    for dirpath, _dirnames, filenames in os.walk(root):
+        for fn in filenames:
+            try:
+                total += os.path.getsize(os.path.join(dirpath, fn))
+            except OSError:
+                pass
+    return total
+
+
+# -- snapshot / write / commit ------------------------------------------------
+# Every (non-collective) save is the same three phases.  The sync path
+# runs them back-to-back; the async path runs snapshot on the caller and
+# write+commit on the writer thread.
+
+_STAGE_LOCK = threading.Lock()
+_STAGE_SEQ = 0
+
+
+class _Snapshot:
+    """Host-buffer image of one checkpoint: everything the writer thread
+    needs, with no live references to device arrays."""
+
+    __slots__ = ("step", "params", "rng", "manifest")
+
+
+def _stage_snapshot(ckpt_dir, step, net, trainer, extra, sharded):
+    """Create the staging dir and capture ALL state — device→host param
+    copies, trainer/optimizer state (written straight into the staging
+    dir; its expensive part is the device→host copy anyway), and the RNG
+    key.  After this returns, the model/trainer may keep training: the
+    snapshot is immutable host memory."""
+    from . import random as mx_random
+
+    global _STAGE_SEQ
+
+    step = int(step)
+    os.makedirs(ckpt_dir, exist_ok=True)
+    with _STAGE_LOCK:
+        _STAGE_SEQ += 1
+        seq = _STAGE_SEQ
+    # pid last (the sweeper's liveness probe parses it); seq keeps two
+    # in-flight saves of the same step in this process from colliding
+    tmp = os.path.join(ckpt_dir, f".tmp-{step}-{seq}-{os.getpid()}")
+    if os.path.exists(tmp):
+        shutil.rmtree(tmp)
+    os.makedirs(tmp)
+    snap = _Snapshot()
+    snap.step = step
+    try:
+        with telemetry.span("ckpt.snapshot"):
+            if sharded:
+                # orbax owns its own (device-resident, sharded) write; it
+                # lands in the staging dir and commits with the rename
+                _save_params_sharded(os.path.join(tmp, "model.orbax"), net)
+                snap.params = None
+            else:
+                # same member set/order as Block.save_parameters, copied
+                # to host instead of written — byte-identical .params
+                snap.params = {
+                    key: val.data().asnumpy()
+                    for key, val in net._collect_params_with_prefix().items()
+                    if val._data is not None or val._deferred_init is None}
+            if trainer is not None:
+                trainer.save_states(os.path.join(tmp, "trainer.states"))
+            rng = mx_random._STATE.key
+            snap.rng = np.asarray(rng) if rng is not None else None
+            snap.manifest = {"step": step, "time": time.time(),
+                             "has_trainer": trainer is not None,
+                             "sharded": bool(sharded),
+                             "extra": extra or {}}
+    except BaseException:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+    return tmp, snap
+
+
+def _write_snapshot(tmp, snap):
+    """Serialize host buffers into the staging dir and make them durable.
+    Pure host I/O — never touches a device buffer."""
+    from .serialization import save_ndarrays
+
+    if snap.params is not None:
+        save_ndarrays(os.path.join(tmp, "model.params"), snap.params)
+    if snap.rng is not None:
+        np.save(os.path.join(tmp, "rng.npy"), snap.rng)
+    with open(os.path.join(tmp, "manifest.json"), "w") as f:
+        json.dump(snap.manifest, f)
+        f.flush()
+        os.fsync(f.fileno())
+    # durability, not just atomicity: fsync every payload file and
+    # directory (recursively — the orbax payload is a tree) so a power
+    # loss after the rename can't surface a manifest-bearing checkpoint
+    # with truncated payloads
+    _fsync_tree(tmp)
+
+
+def _commit_stage(ckpt_dir, tmp, step):
+    """Atomic publish: staging dir → ``ckpt-<step>``, rename persisted."""
+    final = os.path.join(ckpt_dir, f"{_PREFIX}{step}")
+    if telemetry.is_enabled():
+        telemetry.count("ckpt.bytes", _tree_bytes(tmp))
+    if os.path.exists(final):
+        shutil.rmtree(final)  # re-checkpoint of the same step
+    os.rename(tmp, final)
+    _fsync_dir(ckpt_dir)  # persist the rename itself
+    telemetry.count("ckpt.save")
+    return final
+
+
 def save_checkpoint(ckpt_dir, step, net, trainer=None, extra=None,
                     keep=None, sharded=False):
     """Write ``<ckpt_dir>/ckpt-<step>`` atomically.  Returns its path.
@@ -79,45 +210,15 @@ def save_checkpoint(ckpt_dir, step, net, trainer=None, extra=None,
     """
     import jax
 
-    from . import random as mx_random
-
-    step = int(step)
-    os.makedirs(ckpt_dir, exist_ok=True)
-    final = os.path.join(ckpt_dir, f"{_PREFIX}{step}")
     if sharded and jax.process_count() > 1:
-        return _save_checkpoint_multihost(ckpt_dir, final, step, net,
-                                          trainer, extra, keep)
-    tmp = os.path.join(ckpt_dir, f".tmp-{step}-{os.getpid()}")
-    if os.path.exists(tmp):
-        shutil.rmtree(tmp)
-    os.makedirs(tmp)
+        return _save_checkpoint_multihost(
+            ckpt_dir, os.path.join(ckpt_dir, f"{_PREFIX}{int(step)}"),
+            int(step), net, trainer, extra, keep)
+    tmp, snap = _stage_snapshot(ckpt_dir, step, net, trainer, extra, sharded)
     try:
-        if sharded:
-            _save_params_sharded(os.path.join(tmp, "model.orbax"), net)
-        else:
-            net.save_parameters(os.path.join(tmp, "model.params"))
-        manifest = {"step": step, "time": time.time(),
-                    "has_trainer": trainer is not None,
-                    "sharded": bool(sharded),
-                    "extra": extra or {}}
-        if trainer is not None:
-            trainer.save_states(os.path.join(tmp, "trainer.states"))
-        rng = mx_random._STATE.key
-        if rng is not None:
-            np.save(os.path.join(tmp, "rng.npy"), np.asarray(rng))
-        with open(os.path.join(tmp, "manifest.json"), "w") as f:
-            json.dump(manifest, f)
-            f.flush()
-            os.fsync(f.fileno())
-        # durability, not just atomicity: fsync every payload file and
-        # directory (recursively — the orbax payload is a tree) so a
-        # power loss after the rename can't surface a manifest-bearing
-        # checkpoint with truncated payloads
-        _fsync_tree(tmp)
-        if os.path.exists(final):
-            shutil.rmtree(final)  # re-checkpoint of the same step
-        os.rename(tmp, final)
-        _fsync_dir(ckpt_dir)  # persist the rename itself
+        with telemetry.span("ckpt.write"):
+            _write_snapshot(tmp, snap)
+            final = _commit_stage(ckpt_dir, tmp, snap.step)
     except BaseException:
         shutil.rmtree(tmp, ignore_errors=True)
         raise
@@ -125,6 +226,208 @@ def save_checkpoint(ckpt_dir, step, net, trainer=None, extra=None,
         prune_checkpoints(ckpt_dir, keep)
     return final
 
+
+# -- async checkpointing ------------------------------------------------------
+
+class CheckpointTicket:
+    """Handle for one in-flight async checkpoint write."""
+
+    __slots__ = ("step", "_event", "_path", "_error")
+
+    def __init__(self, step):
+        self.step = step
+        self._event = threading.Event()
+        self._path = None
+        self._error = None
+
+    def done(self):
+        return self._event.is_set()
+
+    def result(self, timeout=None):
+        """Block until the write commits; return the checkpoint path or
+        re-raise the writer's error."""
+        if not self._event.wait(timeout):
+            raise MXNetError(
+                f"async checkpoint for step {self.step} still in flight "
+                f"after {timeout}s")
+        if self._error is not None:
+            raise self._error
+        return self._path
+
+
+class AsyncCheckpointer:
+    """Overlapped checkpointing: ``save()`` returns as soon as the
+    device→host snapshot is captured; serialization + fsync + atomic
+    rename happen on a single background writer thread, in submission
+    order.  The atomic ``.tmp-*`` → ``ckpt-<step>`` protocol is shared
+    with the sync path, so a crash mid-async-write (even of the writer
+    thread itself) leaves the previous complete checkpoint loadable and
+    an orphaned staging dir that ``resume``/``prune_checkpoints`` sweep.
+
+    ``max_pending`` bounds host memory: a ``save()`` beyond the bound
+    blocks on the oldest in-flight write (backpressure, not data loss).
+    Writer errors re-raise on that save's ``ticket.result()``, on
+    ``wait()``, and on the NEXT ``save()`` — a fire-and-forget training
+    loop still fails loudly when the disk does."""
+
+    def __init__(self, max_pending=2):
+        self._max_pending = max(1, int(max_pending))
+        self._queue = queue.Queue()
+        self._pending = []          # tickets not yet known-done
+        self._errors = []           # writer errors not yet re-raised
+        self._lock = threading.Lock()
+        self._thread = None
+
+    # -- public surface ------------------------------------------------------
+    def save(self, ckpt_dir, step, net, trainer=None, extra=None,
+             keep=None, sharded=False):
+        """Snapshot synchronously, enqueue the write, return a
+        :class:`CheckpointTicket`."""
+        import jax
+
+        if sharded and jax.process_count() > 1:
+            raise MXNetError(
+                "multi-host sharded checkpoints are a collective write; "
+                "call save_checkpoint(sharded=True) on every process")
+        self._raise_pending_error()
+        self._backpressure()
+        tmp, snap = _stage_snapshot(ckpt_dir, step, net, trainer, extra,
+                                    sharded)
+        ticket = CheckpointTicket(snap.step)
+        with self._lock:
+            self._pending.append(ticket)
+        self._queue.put((ckpt_dir, tmp, snap, keep, ticket))
+        self._ensure_thread()
+        return ticket
+
+    def wait(self, timeout=None):
+        """Block until every issued write committed; re-raise the first
+        writer error if any write failed."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        for ticket in self._drain_done():
+            left = None if deadline is None \
+                else max(0.0, deadline - time.monotonic())
+            if not ticket._event.wait(left):
+                raise MXNetError(
+                    f"async checkpoint for step {ticket.step} still in "
+                    f"flight after {timeout}s")
+        self._raise_pending_error()
+
+    def pending(self):
+        """Number of snapshots not yet committed to disk."""
+        return len(self._drain_done())
+
+    def close(self):
+        """Drain outstanding writes and stop the writer thread."""
+        self.wait()
+        with self._lock:
+            thread, self._thread = self._thread, None
+        if thread is not None:
+            self._queue.put(None)
+            thread.join()
+
+    # -- internals -----------------------------------------------------------
+    def _ensure_thread(self):
+        with self._lock:
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._worker, name="mxt-ckpt-writer", daemon=True)
+                self._thread.start()
+
+    def _drain_done(self):
+        with self._lock:
+            self._pending = [t for t in self._pending if not t.done()]
+            return list(self._pending)
+
+    def _backpressure(self):
+        while True:
+            live = self._drain_done()
+            if len(live) < self._max_pending:
+                return
+            live[0]._event.wait()
+
+    def _raise_pending_error(self):
+        with self._lock:
+            if not self._errors:
+                return
+            err = self._errors.pop(0)
+        raise MXNetError(
+            f"a previous async checkpoint write failed: {err}") from err
+
+    def _worker(self):
+        while True:
+            item = self._queue.get()
+            if item is None:
+                return
+            ckpt_dir, tmp, snap, keep, ticket = item
+            try:
+                t0 = time.perf_counter()
+                with telemetry.span("ckpt.write"):
+                    _write_snapshot(tmp, snap)
+                    path = _commit_stage(ckpt_dir, tmp, snap.step)
+                # wall-time the writer spent while the train loop kept
+                # running — the overlap an equivalent sync save would
+                # have added to the step
+                telemetry.count("ckpt.async_overlap_ms",
+                                (time.perf_counter() - t0) * 1e3)
+                if keep is not None:
+                    prune_checkpoints(ckpt_dir, keep)
+                ticket._path = path
+            except BaseException as exc:  # surfaced via ticket/wait/save
+                shutil.rmtree(tmp, ignore_errors=True)
+                ticket._error = exc
+                with self._lock:
+                    self._errors.append(exc)
+            finally:
+                ticket._event.set()
+
+
+_DEFAULT_ASYNC = None
+_DEFAULT_ASYNC_LOCK = threading.Lock()
+
+
+def async_checkpointer():
+    """The process-wide default :class:`AsyncCheckpointer`."""
+    global _DEFAULT_ASYNC
+    with _DEFAULT_ASYNC_LOCK:
+        if _DEFAULT_ASYNC is None:
+            _DEFAULT_ASYNC = AsyncCheckpointer()
+        return _DEFAULT_ASYNC
+
+
+def save_checkpoint_async(ckpt_dir, step, net, trainer=None, extra=None,
+                          keep=None, sharded=False):
+    """``save_checkpoint`` with the write overlapped on the default
+    background writer.  Returns a :class:`CheckpointTicket`."""
+    return async_checkpointer().save(ckpt_dir, step, net, trainer,
+                                     extra=extra, keep=keep, sharded=sharded)
+
+
+def wait_async(timeout=None):
+    """Flush the default async writer (no-op when never used)."""
+    with _DEFAULT_ASYNC_LOCK:
+        ckpt = _DEFAULT_ASYNC
+    if ckpt is not None:
+        ckpt.wait(timeout)
+
+
+def drain_checkpoint_and_exit(ckpt_dir, step, net, trainer=None, extra=None,
+                              keep=None):
+    """The preemption-drain tail: flush in-flight async writes, cut a
+    final SYNC checkpoint at ``step``, and exit with the distinct
+    "preempted" code (``gluon.trainer.PREEMPTED_EXIT_CODE``) that
+    ``tools/launch.py`` maps to a graceful-relaunch instead of a crash.
+    Call it when ``gluon.trainer.drain_requested()`` turns true after a
+    step completes; see docs/fault_tolerance.md."""
+    from .gluon import trainer as _trainer_mod
+
+    wait_async()
+    save_checkpoint(ckpt_dir, step, net, trainer, extra=extra, keep=keep)
+    telemetry.count("trainer.drain_checkpoint")
+    sys.exit(_trainer_mod.PREEMPTED_EXIT_CODE)
+
+
+# -- multi-host sharded save --------------------------------------------------
 
 def _save_checkpoint_multihost(ckpt_dir, final, step, net, trainer, extra,
                                keep):
@@ -197,6 +500,7 @@ def _save_checkpoint_multihost(ckpt_dir, final, step, net, trainer, extra,
                 json.dump(manifest, f)
         _atomic("manifest.json", _write_manifest)
         _fsync_dir(final)
+        telemetry.count("ckpt.save")
         if keep is not None:
             prune_checkpoints(ckpt_dir, keep)
     multihost_utils.sync_global_devices(f"mxt_ckpt_done_{step}")
@@ -256,6 +560,8 @@ def _restore_params_sharded(path, net):
         p.data()._data = tree[name]
 
 
+# -- discovery / resume -------------------------------------------------------
+
 def _complete_checkpoints(ckpt_dir):
     """[(step, path)] for complete (manifest-bearing) checkpoints."""
     if not os.path.isdir(ckpt_dir):
@@ -274,53 +580,13 @@ def _complete_checkpoints(ckpt_dir):
     return sorted(out)
 
 
-def latest_checkpoint(ckpt_dir):
-    """Path of the newest complete checkpoint, or None."""
-    ckpts = _complete_checkpoints(ckpt_dir)
-    return ckpts[-1][1] if ckpts else None
-
-
-def resume(ckpt_dir, net, trainer=None, ctx=None):
-    """Load the newest complete checkpoint into ``net`` (+``trainer``).
-    Returns ``(step, extra)`` — ``(0, {})`` when nothing to resume."""
-    from . import random as mx_random
-
-    path = latest_checkpoint(ckpt_dir)
-    if path is None:
-        return 0, {}
-    with open(os.path.join(path, "manifest.json")) as f:
-        manifest = json.load(f)
-    if manifest.get("sharded"):
-        _restore_params_sharded(os.path.join(path, "model.orbax"), net)
-    else:
-        net.load_parameters(os.path.join(path, "model.params"), ctx=ctx)
-    if trainer is not None:
-        ts = os.path.join(path, "trainer.states")
-        if not os.path.exists(ts):
-            raise MXNetError(
-                f"checkpoint {path!r} has no trainer state; pass "
-                "trainer=None or re-checkpoint with the trainer")
-        trainer.load_states(ts)
-    rng_file = os.path.join(path, "rng.npy")
-    if os.path.exists(rng_file):
-        import jax
-
-        key = np.load(rng_file)
-        mx_random._STATE.key = jax.numpy.asarray(key)
-    return int(manifest["step"]), manifest.get("extra", {})
-
-
-def prune_checkpoints(ckpt_dir, keep=3):
-    """Delete all but the newest ``keep`` complete checkpoints (and any
-    stale tmp dirs)."""
-    ckpts = _complete_checkpoints(ckpt_dir)
-    for _step, path in ckpts[:-keep] if keep > 0 else ckpts:
-        shutil.rmtree(path, ignore_errors=True)
+def _sweep_stale_tmp(ckpt_dir):
+    """Remove orphaned ``.tmp-*`` staging dirs left by a crash mid-save.
+    A tmp dir may be another process's LIVE staging area (names are
+    pid-suffixed): only sweep it when that pid is gone."""
     for name in os.listdir(ckpt_dir) if os.path.isdir(ckpt_dir) else []:
         if not name.startswith(".tmp-"):
             continue
-        # a tmp dir may be another process's LIVE staging area (names are
-        # pid-suffixed): only sweep it when that pid is gone
         try:
             pid = int(name.rsplit("-", 1)[-1])
             os.kill(pid, 0)
@@ -331,3 +597,80 @@ def prune_checkpoints(ckpt_dir, keep=3):
             alive = True  # exists, owned elsewhere
         if not alive:
             shutil.rmtree(os.path.join(ckpt_dir, name), ignore_errors=True)
+
+
+def latest_checkpoint(ckpt_dir):
+    """Path of the newest complete checkpoint, or None."""
+    ckpts = _complete_checkpoints(ckpt_dir)
+    return ckpts[-1][1] if ckpts else None
+
+
+class _ResumeContractError(MXNetError):
+    """A checkpoint that is COMPLETE but cannot satisfy this resume call
+    (e.g. it carries no trainer state and the caller passed a trainer).
+    Not a torn checkpoint — falling back would silently resume without
+    the requested state, so this propagates."""
+
+
+def _load_checkpoint(path, net, trainer, ctx):
+    from . import random as mx_random
+
+    with open(os.path.join(path, "manifest.json")) as f:
+        manifest = json.load(f)
+    step = int(manifest["step"])
+    if trainer is not None and not manifest.get("has_trainer"):
+        raise _ResumeContractError(
+            f"checkpoint {path!r} has no trainer state; pass "
+            "trainer=None or re-checkpoint with the trainer")
+    if manifest.get("sharded"):
+        _restore_params_sharded(os.path.join(path, "model.orbax"), net)
+    else:
+        net.load_parameters(os.path.join(path, "model.params"), ctx=ctx)
+    if trainer is not None:
+        trainer.load_states(os.path.join(path, "trainer.states"))
+    rng_file = os.path.join(path, "rng.npy")
+    if os.path.exists(rng_file):
+        import jax
+
+        key = np.load(rng_file)
+        mx_random._STATE.key = jax.numpy.asarray(key)
+    return step, manifest.get("extra", {})
+
+
+def resume(ckpt_dir, net, trainer=None, ctx=None):
+    """Load the newest complete checkpoint into ``net`` (+``trainer``).
+    Returns ``(step, extra)`` — ``(0, {})`` when nothing to resume.
+
+    Robust against torn state: a checkpoint whose manifest is corrupt or
+    truncated, or whose member files are missing/unreadable (crash or
+    partial copy after the rename), is skipped with a warning and resume
+    falls back to the previous complete checkpoint.  Orphaned ``.tmp-*``
+    staging dirs from crashed saves are swept on the way in.  Raises
+    only when every complete checkpoint is torn (restarting silently
+    from scratch would destroy the job's progress)."""
+    _sweep_stale_tmp(ckpt_dir)
+    torn = []
+    for _step, path in reversed(_complete_checkpoints(ckpt_dir)):
+        try:
+            return _load_checkpoint(path, net, trainer, ctx)
+        except _ResumeContractError:
+            raise
+        except Exception as exc:  # torn member/manifest: fall back
+            torn.append((path, exc))
+            warnings.warn(
+                f"checkpoint {path!r} is torn ({exc!r}); falling back to "
+                "the previous complete checkpoint")
+    if torn:
+        raise MXNetError(
+            f"every checkpoint in {ckpt_dir!r} is torn; newest error: "
+            f"{torn[0][1]}") from torn[0][1]
+    return 0, {}
+
+
+def prune_checkpoints(ckpt_dir, keep=3):
+    """Delete all but the newest ``keep`` complete checkpoints (and any
+    stale tmp dirs)."""
+    ckpts = _complete_checkpoints(ckpt_dir)
+    for _step, path in ckpts[:-keep] if keep > 0 else ckpts:
+        shutil.rmtree(path, ignore_errors=True)
+    _sweep_stale_tmp(ckpt_dir)

@@ -1,0 +1,121 @@
+"""Elastic data-parallel resize: deterministic shard (re)assignment.
+
+Reference posture (SURVEY §2.3 D10/D11): the dmlc tracker launches a
+FIXED worker set; a resized job is a new job, and the data pipeline's
+``rank/num_workers`` split silently re-deals every sample.  Production
+pods get preempted and resized, so this module makes the data→rank
+assignment a pure function of ``(seed, step)`` plus the CURRENT world
+size — the missing piece that lets a training job shrink or grow between
+checkpoints without changing the math:
+
+- the **global batch** for a step is identical at every world size
+  (``global_batch_indices`` never looks at rank or world size), so the
+  summed gradient the optimizer sees is the same set of examples no
+  matter how many workers computed it;
+- each rank takes a deterministic contiguous slice of that batch
+  (``shard_indices``), so a resumed job at world size W reproduces a
+  fresh run at W from the same checkpoint step-for-step;
+- nothing is stateful: there is no sampler object to checkpoint — the
+  checkpointed ``step`` IS the data-pipeline position.
+
+``tests/test_elastic.py`` proves the 2→1→2 contract end-to-end under
+``tools/launch.py``; ``docs/fault_tolerance.md`` documents the
+semantics.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from .base import MXNetError
+
+__all__ = ["global_batch_indices", "shard_indices", "shard_for_step",
+           "world_info"]
+
+
+def _step_rng(seed, step):
+    """An independent numpy Generator per (seed, step).
+
+    ``SeedSequence(seed).spawn`` semantics via ``spawn_key``: streams for
+    different steps are statistically independent, and the mapping is a
+    stable function of the two integers (no global RNG state involved —
+    an elastic restart cannot perturb it)."""
+    seed = int(seed)
+    step = int(step)
+    if step < 0:
+        raise MXNetError(f"step must be >= 0, got {step}")
+    return np.random.Generator(np.random.PCG64(
+        np.random.SeedSequence(entropy=seed, spawn_key=(step,))))
+
+
+def global_batch_indices(dataset_size, batch_size, step, seed=0,
+                         shuffle=True):
+    """The step's GLOBAL batch as dataset indices — a pure function of
+    ``(seed, step)``, identical at every world size.
+
+    ``shuffle=True`` (default) draws ``batch_size`` distinct indices per
+    step (sampling without replacement within the batch, fresh per
+    step); ``shuffle=False`` walks the dataset sequentially with
+    wraparound, the classic epoch order."""
+    dataset_size = int(dataset_size)
+    batch_size = int(batch_size)
+    if batch_size <= 0 or dataset_size <= 0:
+        raise MXNetError("dataset_size and batch_size must be positive")
+    if not shuffle:
+        start = int(step) * batch_size
+        return (start + np.arange(batch_size)) % dataset_size
+    if batch_size > dataset_size:
+        raise MXNetError(
+            f"batch_size {batch_size} > dataset_size {dataset_size} "
+            "(shuffle=True samples without replacement within a batch)")
+    return _step_rng(seed, step).choice(dataset_size, size=batch_size,
+                                        replace=False)
+
+
+def shard_indices(indices, world_size, rank):
+    """This rank's contiguous slice of a global batch.
+
+    The global batch size must divide evenly by ``world_size`` so every
+    resize keeps ``trainer.step(global_batch)`` normalization exact —
+    elastic jobs pick a global batch divisible by every world size they
+    may run at (e.g. a multiple of the max)."""
+    world_size = int(world_size)
+    rank = int(rank)
+    if not 0 <= rank < world_size:
+        raise MXNetError(f"rank {rank} out of range for world {world_size}")
+    n = len(indices)
+    if n % world_size:
+        raise MXNetError(
+            f"global batch of {n} does not divide evenly over "
+            f"{world_size} workers — elastic resize would change the "
+            "per-step math; pick a global batch divisible by every "
+            "world size the job may run at")
+    per = n // world_size
+    return indices[rank * per:(rank + 1) * per]
+
+
+def shard_for_step(dataset_size, batch_size, step, world_size, rank,
+                   seed=0, shuffle=True):
+    """``shard_indices(global_batch_indices(...))`` in one call — the
+    per-step data assignment an elastic training loop feeds its rank."""
+    return shard_indices(
+        global_batch_indices(dataset_size, batch_size, step, seed=seed,
+                             shuffle=shuffle),
+        world_size, rank)
+
+
+def world_info():
+    """``(rank, world_size)`` of the current process.
+
+    Prefers the live jax process group (after ``parallel.initialize``);
+    falls back to the launcher's ``MXT_PROCESS_ID``/``MXT_NUM_PROCESSES``
+    env contract, then to a single-process ``(0, 1)``."""
+    from . import parallel
+
+    if parallel.is_initialized():
+        import jax
+
+        return jax.process_index(), jax.process_count()
+    return (int(os.environ.get("MXT_PROCESS_ID", "0")),
+            int(os.environ.get("MXT_NUM_PROCESSES", "1")))

@@ -14,6 +14,9 @@ XLA program with collectives on ICI.
 """
 from __future__ import annotations
 
+import signal
+import threading
+
 from ..base import MXNetError
 from .parameter import Parameter, ParameterDict
 from .. import optimizer as opt
@@ -22,7 +25,96 @@ from .. import telemetry
 from ..telemetry import costs as _costs
 from ..telemetry import memwatch as _mw
 
-__all__ = ["Trainer"]
+__all__ = ["Trainer", "PREEMPTED_EXIT_CODE", "install_preemption_handler",
+           "drain_requested", "drain_consensus", "request_drain",
+           "reset_drain"]
+
+
+# -- preemption drain ---------------------------------------------------------
+# Cloud schedulers deliver SIGTERM, wait a grace period, then SIGKILL.
+# The reference loses the in-flight interval of work (do_checkpoint is
+# epoch-grained and SIGTERM default-kills python).  Here SIGTERM only
+# sets a flag; the training loop polls ``drain_requested()`` after each
+# completed step, cuts a final checkpoint, and exits with
+# ``PREEMPTED_EXIT_CODE`` so tools/launch.py can tell a graceful drain
+# from a crash (see checkpoint.drain_checkpoint_and_exit and
+# docs/fault_tolerance.md).
+
+#: BSD EX_TEMPFAIL: "transient failure, retry later" — the drain path's
+#: exit status.  tools/launch.py mirrors the value (it stays stdlib-only)
+#: and maps it to a backoff relaunch that does NOT consume the crash
+#: restart budget.
+PREEMPTED_EXIT_CODE = 75
+
+_DRAIN = threading.Event()
+
+# signals the user armed — parallel.initialize re-installs the handler
+# for these after the distributed handshake (jax.distributed.initialize
+# registers XLA's own preemption notifier on SIGTERM, silently replacing
+# any handler armed earlier)
+_ARMED_SIGNUMS = []
+
+
+def install_preemption_handler(signums=(signal.SIGTERM,)):
+    """Arm the graceful-drain contract: the given signals set the drain
+    flag (and count ``trainer.drain_signal``) instead of killing the
+    process.  Must run on the MAIN thread (a ``signal.signal``
+    requirement) before training starts.  Returns the drain event.
+
+    Safe to call before OR after ``parallel.initialize`` — initialize
+    re-arms it, because ``jax.distributed.initialize`` installs XLA's
+    preemption notifier over the process SIGTERM handler."""
+
+    def _on_signal(_signum, _frame):
+        _DRAIN.set()
+        telemetry.count("trainer.drain_signal")
+
+    for signum in signums:
+        signal.signal(signum, _on_signal)
+    _ARMED_SIGNUMS[:] = list(signums)
+    return _DRAIN
+
+
+def _rearm_preemption_handler():
+    """Called by ``parallel.initialize`` after the jax.distributed
+    handshake to win back the signal(s) from XLA's notifier."""
+    if _ARMED_SIGNUMS:
+        install_preemption_handler(tuple(_ARMED_SIGNUMS))
+
+
+def drain_requested():
+    """True once a drain signal arrived — poll after each completed step."""
+    return _DRAIN.is_set()
+
+
+def drain_consensus():
+    """True iff ANY rank has ``drain_requested()`` — collectively agreed.
+
+    A real preemption TERMs one VM, not the whole group; the signalled
+    rank alone leaving the step loop would strand its peers inside the
+    next gradient allreduce.  Polling THIS after each step instead makes
+    every rank learn of the drain at the same step boundary (the flag
+    rides a tiny host-vector psum, itself a synchronization point), so
+    the group exits together and the drain checkpoint is consistent.
+    Single-process it degenerates to ``drain_requested()`` at no cost."""
+    local = _DRAIN.is_set()
+    from .. import parallel
+    if not parallel.is_initialized():
+        return local
+    import numpy as np
+
+    return parallel.process_sum_hostvec(
+        np.array([1.0 if local else 0.0]))[0] > 0
+
+
+def request_drain():
+    """Programmatic drain (tests, in-process schedulers)."""
+    _DRAIN.set()
+
+
+def reset_drain():
+    """Clear the drain flag (a new run in the same process)."""
+    _DRAIN.clear()
 
 
 class Trainer:

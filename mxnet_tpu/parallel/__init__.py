@@ -44,28 +44,68 @@ from ..context import Context
 from ..ndarray import NDArray
 from .. import telemetry
 
-__all__ = ["initialize", "make_mesh", "set_mesh", "current_mesh",
-           "mesh_scope", "shard_batch", "replicate", "shard_param",
-           "with_sharding", "TPUSyncKVStore", "all_sum",
+__all__ = ["initialize", "is_initialized", "make_mesh", "set_mesh",
+           "current_mesh", "mesh_scope", "shard_batch", "replicate",
+           "shard_param", "with_sharding", "TPUSyncKVStore", "all_sum",
            "ring_attention", "ulysses_attention", "pipeline_apply",
            "pipeline_train_1f1b"]
 
 
 _STATE = threading.local()
 
+# process-group state: True once jax.distributed.initialize succeeded in
+# THIS process (single-process runs never set it)
+_INITIALIZED = False
+
+
+def is_initialized():
+    """True when this process joined a multi-process group via
+    ``initialize`` (drain consensus and other collective helpers use it
+    to fall back to local behavior in single-process runs)."""
+    return _INITIALIZED
+
 
 def initialize(coordinator_address=None, num_processes=None, process_id=None,
-               local_device_ids=None):
+               local_device_ids=None, init_retries=None, init_timeout=None,
+               init_backoff=None):
     """Multi-host bootstrap (reference: tools/launch.py + ps-lite Postoffice
     handshake via DMLC_PS_ROOT_URI, SURVEY §3.4).  Call once per host before
     any jax computation; no-op for single-process runs.
 
     ``tools/launch.py`` sets ``MXT_COORDINATOR``/``MXT_NUM_PROCESSES``/
     ``MXT_PROCESS_ID`` — picked up here when args are omitted (the analog
-    of the DMLC_* env contract)."""
+    of the DMLC_* env contract).
+
+    Elastic re-formation: a relaunched (possibly RESIZED) group re-forms
+    over the same coordinator address, and transient bind/connect
+    failures are routine right after a preemption (the dead group's
+    socket lingers in TIME_WAIT, ranks arrive seconds apart under the
+    launcher's backoff jitter).  The handshake therefore retries
+    ``init_retries`` times (env ``MXT_INIT_RETRIES``, default 3) with
+    exponential backoff starting at ``init_backoff`` seconds
+    (``MXT_INIT_BACKOFF``, default 1.0); ``init_timeout``
+    (``MXT_INIT_TIMEOUT``) bounds each barrier wait so a half-formed
+    group fails fast instead of wedging until the cluster default.
+
+    A relaunch under the launcher also surfaces WHY the previous group
+    died: ``launcher.restart.<reason>`` telemetry (counter + gauge, so
+    it rides every per-step JSONL record) from ``MXT_RESTART_REASON``."""
     import os
+    import time as _time
 
     import jax
+
+    reason = os.environ.get("MXT_RESTART_REASON")
+    if reason:
+        # near-zero when telemetry is off (count/gauge no-op on a flag)
+        telemetry.count(f"launcher.restart.{reason}")
+        telemetry.gauge("launcher.attempt",
+                        int(os.environ.get("MXT_LAUNCH_ATTEMPT", "0")))
+        for key, env in (("launcher.restart.crash", "MXT_RESTART_CRASHES"),
+                         ("launcher.restart.preempted",
+                          "MXT_RESTART_PREEMPTIONS")):
+            if env in os.environ:
+                telemetry.gauge(key, int(os.environ[env]))
 
     coordinator_address = coordinator_address or \
         os.environ.get("MXT_COORDINATOR")
@@ -75,10 +115,46 @@ def initialize(coordinator_address=None, num_processes=None, process_id=None,
         process_id = int(os.environ["MXT_PROCESS_ID"])
     if coordinator_address is None:
         return  # single-process
-    jax.distributed.initialize(
-        coordinator_address=coordinator_address,
-        num_processes=num_processes, process_id=process_id,
-        local_device_ids=local_device_ids)
+    if "cpu" in os.environ.get("JAX_PLATFORMS", ""):
+        try:  # loopback lane: the plain CPU backend has no cross-process
+            # collectives — route them through gloo (no-op if unavailable)
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception:
+            pass
+    if init_retries is None:
+        init_retries = int(os.environ.get("MXT_INIT_RETRIES", "3"))
+    if init_backoff is None:
+        init_backoff = float(os.environ.get("MXT_INIT_BACKOFF", "1.0"))
+    if init_timeout is None and "MXT_INIT_TIMEOUT" in os.environ:
+        init_timeout = int(os.environ["MXT_INIT_TIMEOUT"])
+    kwargs = {}
+    if init_timeout is not None:
+        kwargs["initialization_timeout"] = init_timeout
+    global _INITIALIZED
+    for attempt in range(init_retries + 1):
+        try:
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes, process_id=process_id,
+                local_device_ids=local_device_ids, **kwargs)
+            _INITIALIZED = True
+            # jax.distributed.initialize just installed XLA's preemption
+            # notifier on SIGTERM; give the graceful-drain handler (if
+            # the app armed one) the signal back
+            import sys as _sys
+            _tr = _sys.modules.get("mxnet_tpu.gluon.trainer")
+            if _tr is not None:
+                _tr._rearm_preemption_handler()
+            return
+        except Exception:
+            try:  # a half-initialized client blocks the retry
+                jax.distributed.shutdown()
+            except Exception:
+                pass
+            if attempt >= init_retries:
+                raise
+            telemetry.count("parallel.init_retry")
+            _time.sleep(init_backoff * (2 ** attempt))
 
 
 def make_mesh(shape=None, axis_names=None, devices=None):
@@ -319,7 +395,10 @@ def _process_psum(n):
     from jax.sharding import PartitionSpec
 
     pmesh = jax.sharding.Mesh(onp.asarray(devs), ("dp",))
-    fn = jax.jit(jax.shard_map(
+    shard_map = getattr(jax, "shard_map", None)
+    if shard_map is None:  # pre-0.6 jax keeps it under experimental
+        from jax.experimental.shard_map import shard_map
+    fn = jax.jit(shard_map(
         lambda x: jax.lax.psum(x, "dp"), mesh=pmesh,
         in_specs=PartitionSpec("dp", None),
         out_specs=PartitionSpec("dp", None)))

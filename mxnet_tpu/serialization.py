@@ -140,6 +140,11 @@ def save_ndarrays(fname, data):
     out += struct.pack("<QQ", _LIST_MAGIC, 0)
     out += struct.pack("<Q", len(arrays))
     for arr in arrays:
+        if isinstance(arr, np.ndarray):
+            # already a host buffer (async-checkpoint snapshots): write it
+            # directly — wrapping in NDArray would device_put it back
+            _write_ndarray(out, arr)
+            continue
         if not isinstance(arr, NDArray):
             arr = NDArray(arr)
         _write_ndarray(out, arr.asnumpy())

@@ -1,0 +1,108 @@
+"""Loopback training worker for the preemption/elastic/chaos suites.
+
+NOT a test module — tests launch this under ``tools/launch.py`` (and
+``tools/chaos.py``) with the env contract below.  One worker serves all
+three suites because the training loop IS the contract under test: an
+elastic, preemption-safe loop that any rank count can resume.
+
+  REPO_ROOT     repo checkout (sys.path bootstrap)
+  CKPT_DIR      checkpoint directory shared across (re)launches
+  TOTAL_STEPS   train until this global step
+  OUT_FILE      prefix; final params land at OUT_FILE<rank>.npy
+  LOSS_FILE     rank 0 appends "step loss" per step (elastic oracle)
+  CKPT_MODE     "async" (default) or "sync" rank-0 checkpoints
+  STEP_SLEEP    seconds to sleep per step (widens the chaos window)
+  MARKER_FILE / MARKER_AFTER_STEP
+                rank 0 touches MARKER_FILE after completing that step
+                (lets a test synchronize its signal with progress)
+
+The loop demonstrates the full robustness contract:
+  * data comes from ``mxnet_tpu.elastic`` — a pure function of
+    (seed, step, world, rank), so any world size replays the same
+    global batch sequence;
+  * rank 0 checkpoints every step (async by default);
+  * every rank polls ``drain_consensus()`` after each step — SIGTERM on
+    ANY subset of ranks drains the whole group at one step boundary,
+    rank 0 cuts the final checkpoint, everyone exits PREEMPTED_EXIT_CODE.
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.environ["REPO_ROOT"])
+os.environ.pop("XLA_FLAGS", None)
+import jax
+
+jax.config.update("jax_platforms", os.environ.get("JAX_PLATFORMS", "cpu"))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, checkpoint, elastic, gluon, nd, parallel
+from mxnet_tpu.gluon import trainer as trainer_mod
+
+trainer_mod.install_preemption_handler()
+parallel.initialize()
+rank, world = jax.process_index(), jax.process_count()
+
+mx.random.seed(42)
+net = gluon.nn.Dense(3, use_bias=True)
+net.initialize(mx.init.Xavier())
+net(nd.ones((1, 5)))
+trainer = gluon.Trainer(net.collect_params(), "sgd",
+                        {"learning_rate": 0.1, "momentum": 0.9},
+                        kvstore="dist_tpu_sync")
+
+ckpt_dir = os.environ["CKPT_DIR"]
+total = int(os.environ["TOTAL_STEPS"])
+loss_file = os.environ.get("LOSS_FILE")
+step_sleep = float(os.environ.get("STEP_SLEEP", "0"))
+ckpt_async = os.environ.get("CKPT_MODE", "async") != "sync"
+marker = os.environ.get("MARKER_FILE")
+marker_step = int(os.environ.get("MARKER_AFTER_STEP", "-1"))
+
+start, _ = checkpoint.resume(ckpt_dir, net, trainer)
+if start:
+    print(f"rank {rank}: resumed from step {start} (world={world})",
+          flush=True)
+
+DATA = np.random.RandomState(0).randn(64, 5).astype(np.float32)
+BATCH = 8
+
+for step in range(start, total):
+    idx = elastic.shard_for_step(len(DATA), BATCH, step, world, rank,
+                                 seed=5)
+    x = nd.array(DATA[idx])
+    with autograd.record():
+        loss = (net(x) ** 2).sum()
+    loss.backward()
+    trainer.step(BATCH)
+    gloss = parallel.process_sum_hostvec(
+        np.asarray([float(loss.asnumpy())], dtype=np.float64))[0]
+    if rank == 0:
+        if loss_file:
+            with open(loss_file, "a") as f:
+                f.write(f"{step} {gloss:.9e}\n")
+        if ckpt_async:
+            checkpoint.save_checkpoint_async(ckpt_dir, step + 1, net,
+                                             trainer)
+        else:
+            checkpoint.save_checkpoint(ckpt_dir, step + 1, net, trainer)
+        if marker and step == marker_step:
+            with open(marker, "w") as f:
+                f.write(str(step))
+    if step_sleep:
+        time.sleep(step_sleep)
+    if trainer_mod.drain_consensus():
+        print(f"rank {rank}: draining at step {step + 1}", flush=True)
+        if rank == 0:
+            checkpoint.drain_checkpoint_and_exit(ckpt_dir, step + 1, net,
+                                                 trainer)
+        sys.exit(trainer_mod.PREEMPTED_EXIT_CODE)
+
+if rank == 0:
+    checkpoint.wait_async()
+np.save(os.environ["OUT_FILE"] + str(rank) + ".npy",
+        np.concatenate([net.weight.data().asnumpy().ravel(),
+                        net.bias.data().asnumpy().ravel()]))
+print(f"rank {rank}: done at step {total}", flush=True)

@@ -1,0 +1,84 @@
+"""Chaos lane (round 6 tentpole, layer 4): tools/chaos.py drives a real
+2-rank loopback job while killing random ranks with a mixed
+SIGTERM/SIGKILL schedule.  The job must survive on its own — launcher
+failure detection + backoff relaunch + checkpoint resume (and, for
+SIGTERM, the consensus drain path) — and finish with parameters
+byte-identical to an undisturbed run.
+
+This is the tier-1 smoke of the chaos story; the heavier scenarios
+(fault-specific assertions, drain byte-identity with a launcher-level
+SIGTERM) live in tests/test_fault_injection.py, and the TPU lane in
+tests_tpu/test_tpu_chaos.py.
+"""
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+WORKER = os.path.join(REPO, "tests", "_preempt_worker.py")
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _run(cmd, env, timeout=420):
+    proc = subprocess.Popen(cmd, env=env, start_new_session=True,
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True)
+    try:
+        log, _ = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+        raise
+    return proc.returncode, log
+
+
+@pytest.mark.skipif(sys.platform != "linux", reason="loopback group")
+def test_chaos_mixed_signals_survives_byte_identically(tmp_path):
+    d = str(tmp_path)
+    env = dict(os.environ)
+    env.update(REPO_ROOT=REPO, CKPT_DIR=d + "/ck", TOTAL_STEPS="12",
+               OUT_FILE=d + "/out_", STEP_SLEEP="0.25",
+               MXT_LAUNCH_PLATFORM="cpu")
+    summary_file = d + "/chaos.json"
+    # seed 3's schedule delivers one SIGKILL and one SIGTERM — both
+    # recovery paths (crash relaunch, consensus drain) in one run
+    rc, log = _run(
+        [sys.executable, os.path.join(REPO, "tools", "chaos.py"),
+         "-n", "2", "--kills", "2", "--mix", "mixed", "--seed", "3",
+         "--min-delay", "1.0", "--max-delay", "2.5",
+         "--max-restarts", "6", "--backoff-base", "0.1",
+         "--coordinator", f"127.0.0.1:{_free_port()}",
+         "--summary", summary_file,
+         "--", sys.executable, WORKER], env)
+    assert rc == 0, log[-3000:]
+    with open(summary_file) as f:
+        summary = json.load(f)
+    assert summary["survived"]
+    assert len(summary["injections"]) >= 1, summary
+    assert sum(summary["restarts"].values()) >= 1, summary
+    assert {i["signal"] for i in summary["injections"]} <= \
+        {"SIGTERM", "SIGKILL"}
+
+    # undisturbed oracle, same world size and step count
+    env_o = dict(env, CKPT_DIR=d + "/cko", OUT_FILE=d + "/oracle_",
+                 STEP_SLEEP="0")
+    rc2, log2 = _run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", "2", "--coordinator", f"127.0.0.1:{_free_port()}",
+         sys.executable, WORKER], env_o)
+    assert rc2 == 0, log2[-3000:]
+    for rank in (0, 1):
+        got = np.load(d + f"/out_{rank}.npy")
+        want = np.load(d + f"/oracle_{rank}.npy")
+        assert got.tobytes() == want.tobytes(), \
+            f"rank {rank} diverged after chaos"

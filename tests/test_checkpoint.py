@@ -148,6 +148,266 @@ def test_estimator_fault_tolerant_handler(tmp_path):
     assert h3.resumed_epoch == 2 and h3._epoch == 3
 
 
+# --- async checkpointing (round 6) ------------------------------------------
+
+def _trainer(net):
+    return gluon.Trainer(net.collect_params(), "sgd",
+                         {"learning_rate": 0.1, "momentum": 0.9})
+
+
+def test_async_save_byte_identical_to_sync(tmp_path):
+    """The overlapped writer must produce EXACTLY the bytes the sync
+    path produces — same members, same container encoding — so a drain
+    or chaos resume can't tell which path wrote its checkpoint."""
+    net = _net()
+    tr = _trainer(net)
+    _step(net, tr, 0)
+    p_sync = checkpoint.save_checkpoint(str(tmp_path / "sync"), 1, net, tr,
+                                        extra={"epoch": 1})
+    ticket = checkpoint.save_checkpoint_async(str(tmp_path / "async"), 1,
+                                              net, tr, extra={"epoch": 1})
+    p_async = ticket.result(60)
+    assert ticket.done() and ticket.step == 1
+    for member in ("model.params", "trainer.states", "rng.npy"):
+        with open(os.path.join(p_sync, member), "rb") as a, \
+                open(os.path.join(p_async, member), "rb") as b:
+            assert a.read() == b.read(), member
+    step, extra = checkpoint.resume(str(tmp_path / "async"), _net())
+    assert step == 1 and extra == {"epoch": 1}
+
+
+def test_async_save_returns_before_write(tmp_path, monkeypatch):
+    """save() must come back after the (synchronous) snapshot even while
+    the write is stalled — the overlap claim, proven with a gated writer
+    rather than a timing assertion."""
+    import threading
+
+    gate = threading.Event()
+    real_write = checkpoint._write_snapshot
+
+    def gated(tmp, snap):
+        gate.wait(60)
+        real_write(tmp, snap)
+
+    monkeypatch.setattr(checkpoint, "_write_snapshot", gated)
+    net = _net()
+    ckpt = checkpoint.AsyncCheckpointer()
+    try:
+        ticket = ckpt.save(str(tmp_path / "c"), 1, net)  # returns gated
+        assert not ticket.done()
+        assert checkpoint.latest_checkpoint(str(tmp_path / "c")) is None
+        gate.set()
+        path = ticket.result(60)
+        assert path.endswith("ckpt-1")
+    finally:
+        gate.set()
+        ckpt.close()
+
+
+def test_async_backpressure_blocks_at_max_pending(tmp_path, monkeypatch):
+    """max_pending bounds host snapshots: the save PAST the bound waits
+    for the oldest write instead of queueing unboundedly."""
+    import threading
+
+    gate = threading.Event()
+    real_write = checkpoint._write_snapshot
+    monkeypatch.setattr(checkpoint, "_write_snapshot",
+                        lambda tmp, snap: (gate.wait(60),
+                                           real_write(tmp, snap)))
+    net = _net()
+    ckpt = checkpoint.AsyncCheckpointer(max_pending=1)
+    try:
+        ckpt.save(str(tmp_path / "c"), 1, net)
+        done = threading.Event()
+
+        def second():
+            ckpt.save(str(tmp_path / "c"), 2, net)
+            done.set()
+
+        t = threading.Thread(target=second, daemon=True)
+        t.start()
+        assert not done.wait(0.3), "save #2 ignored the pending bound"
+        gate.set()
+        assert done.wait(60)
+        t.join()
+        ckpt.wait(60)
+    finally:
+        gate.set()
+        ckpt.close()
+    assert checkpoint.latest_checkpoint(str(tmp_path / "c")).endswith("ckpt-2")
+
+
+def test_async_writer_crash_leaves_prior_checkpoint_loadable(
+        tmp_path, monkeypatch):
+    """Satellite (c): kill the writer mid-write.  The failed step's
+    staging dir is cleaned up, the error surfaces loudly (ticket AND the
+    next save), and the previous complete checkpoint still resumes."""
+    from mxnet_tpu.base import MXNetError
+
+    ckpt_dir = str(tmp_path / "c")
+    net = _net()
+    tr = _trainer(net)
+    _step(net, tr, 0)
+    checkpoint.save_checkpoint(ckpt_dir, 1, net, tr)
+
+    def boom(tmp, snap):
+        raise OSError("disk gone")
+
+    monkeypatch.setattr(checkpoint, "_write_snapshot", boom)
+    ckpt = checkpoint.AsyncCheckpointer()
+    ticket = ckpt.save(ckpt_dir, 2, net, tr)
+    with pytest.raises(OSError, match="disk gone"):
+        ticket.result(60)
+    with pytest.raises(MXNetError, match="previous async checkpoint"):
+        ckpt.save(ckpt_dir, 3, net, tr)  # fire-and-forget still fails loudly
+    assert not [n for n in os.listdir(ckpt_dir) if n.startswith(".tmp-")]
+
+    net2 = _net()
+    tr2 = _trainer(net2)
+    tr2._init_kvstore()
+    step, _ = checkpoint.resume(ckpt_dir, net2, tr2)
+    assert step == 1
+    assert_almost_equal(net2.weight.data(), net.weight.data(),
+                        rtol=0, atol=0)
+
+
+def test_async_counters_land_in_step_record(tmp_path):
+    """Tentpole telemetry: ckpt.save / ckpt.bytes / ckpt.async_overlap_ms
+    ride the per-step JSONL record, with the write overlapping the open
+    step window (the background span lands in the CURRENT step)."""
+    from mxnet_tpu import telemetry
+
+    path = str(tmp_path / "t.jsonl")
+    telemetry.enable(jsonl_path=path)
+    try:
+        net = _net()
+        tr = _trainer(net)
+        with telemetry.step():
+            _step(net, tr, 0)
+            t = checkpoint.save_checkpoint_async(str(tmp_path / "c"), 1,
+                                                 net, tr)
+            t.result(60)
+    finally:
+        telemetry.disable()
+    rec = telemetry.read_jsonl(path)[0]
+    assert rec["ckpt_saves"] == 1
+    assert rec["ckpt_bytes"] > 0
+    assert rec["ckpt_async_overlap_ms"] > 0
+    assert rec["phases_ms"].get("ckpt.snapshot", 0) > 0
+    assert rec["phases_ms"].get("ckpt.write", 0) > 0
+
+
+# --- preemption drain (round 6) ---------------------------------------------
+
+def test_drain_checkpoint_and_exit(tmp_path):
+    """request_drain → drain_checkpoint_and_exit flushes the async
+    writer, cuts a final sync checkpoint, and exits with the preemption
+    status the launcher budgets separately."""
+    from mxnet_tpu.gluon import trainer as trainer_mod
+
+    ckpt_dir = str(tmp_path / "c")
+    net = _net()
+    tr = _trainer(net)
+    _step(net, tr, 0)
+    checkpoint.save_checkpoint_async(ckpt_dir, 1, net, tr)
+    trainer_mod.request_drain()
+    try:
+        assert trainer_mod.drain_requested()
+        assert trainer_mod.drain_consensus()  # single-process degenerate
+        with pytest.raises(SystemExit) as e:
+            checkpoint.drain_checkpoint_and_exit(ckpt_dir, 2, net, tr)
+        assert e.value.code == trainer_mod.PREEMPTED_EXIT_CODE == 75
+    finally:
+        trainer_mod.reset_drain()
+    assert checkpoint.latest_checkpoint(ckpt_dir).endswith("ckpt-2")
+    step, _ = checkpoint.resume(ckpt_dir, _net())
+    assert step == 2
+
+
+# --- torn-state hardening (round 6 satellites a+b) --------------------------
+
+def test_resume_sweeps_stale_tmp_keeps_live_writer(tmp_path):
+    """Orphaned .tmp-* staging dirs (pid dead) are swept on resume; a
+    LIVE writer's staging dir — same format, our own pid — is left
+    alone."""
+    ckpt_dir = str(tmp_path / "c")
+    net = _net()
+    checkpoint.save_checkpoint(ckpt_dir, 1, net)
+    dead = os.path.join(ckpt_dir, ".tmp-7-0-999999")   # no such pid
+    live = os.path.join(ckpt_dir, f".tmp-8-0-{os.getpid()}")
+    legacy = os.path.join(ckpt_dir, ".tmp-9-123456")   # old 2-part name
+    for d in (dead, live, legacy):
+        os.makedirs(d)
+    step, _ = checkpoint.resume(ckpt_dir, _net())
+    assert step == 1
+    assert not os.path.exists(dead)
+    assert not os.path.exists(legacy)
+    assert os.path.exists(live)
+    os.rmdir(live)
+    checkpoint.save_checkpoint(ckpt_dir, 2, net)
+    os.makedirs(dead)
+    checkpoint.prune_checkpoints(ckpt_dir, keep=1)     # sweeps too
+    assert not os.path.exists(dead)
+
+
+def test_resume_falls_back_on_torn_manifest(tmp_path):
+    """A checkpoint whose manifest is corrupt (torn at the byte level,
+    PAST the atomic-rename completeness check) must not kill the job:
+    resume warns and falls back to the previous complete checkpoint."""
+    ckpt_dir = str(tmp_path / "c")
+    net = _net()
+    tr = _trainer(net)
+    _step(net, tr, 0)
+    checkpoint.save_checkpoint(ckpt_dir, 1, net, tr)
+    _step(net, tr, 1)
+    checkpoint.save_checkpoint(ckpt_dir, 2, net, tr)
+    with open(os.path.join(ckpt_dir, "ckpt-2", "manifest.json"), "w") as f:
+        f.write('{"step": 2, "has_tr')  # truncated mid-key
+    net2 = _net()
+    with pytest.warns(UserWarning, match="torn"):
+        step, _ = checkpoint.resume(ckpt_dir, net2)
+    assert step == 1
+
+
+def test_resume_falls_back_on_missing_member(tmp_path):
+    ckpt_dir = str(tmp_path / "c")
+    net = _net()
+    checkpoint.save_checkpoint(ckpt_dir, 1, net)
+    checkpoint.save_checkpoint(ckpt_dir, 2, net)
+    os.remove(os.path.join(ckpt_dir, "ckpt-2", "model.params"))
+    with pytest.warns(UserWarning, match="torn"):
+        step, _ = checkpoint.resume(ckpt_dir, _net())
+    assert step == 1
+
+
+def test_resume_every_checkpoint_torn_raises(tmp_path):
+    from mxnet_tpu.base import MXNetError
+
+    ckpt_dir = str(tmp_path / "c")
+    net = _net()
+    checkpoint.save_checkpoint(ckpt_dir, 1, net)
+    os.remove(os.path.join(ckpt_dir, "ckpt-1", "model.params"))
+    with pytest.warns(UserWarning, match="torn"):
+        with pytest.raises(MXNetError, match="torn"):
+            checkpoint.resume(ckpt_dir, _net())
+
+
+def test_resume_contract_error_is_not_swallowed(tmp_path):
+    """A COMPLETE checkpoint that can't satisfy the caller (saved without
+    trainer state, resumed with a trainer) is a caller bug, not a torn
+    checkpoint — it must raise, not silently fall back."""
+    from mxnet_tpu.base import MXNetError
+
+    ckpt_dir = str(tmp_path / "c")
+    net = _net()
+    checkpoint.save_checkpoint(ckpt_dir, 1, net)   # no trainer state
+    net2 = _net()
+    tr2 = _trainer(net2)
+    tr2._init_kvstore()
+    with pytest.raises(MXNetError, match="trainer"):
+        checkpoint.resume(ckpt_dir, net2, tr2)
+
+
 def test_sharded_checkpoint_roundtrip_preserves_sharding(tmp_path):
     """sharded=True routes weights through orbax/tensorstore: values AND
     dp/tp shardings survive resume without a host-side gather."""

@@ -1,0 +1,143 @@
+"""Elastic data-parallel resize (round 6 tentpole, layer 3).
+
+The contract: the global batch at step ``k`` is a pure function of
+(seed, step) — NEVER of world size — and each rank takes a contiguous
+slice.  Growing or shrinking the group between (re)launches therefore
+replays the exact same global batch sequence, so a 2→1→2-worker run
+resumed from checkpoints follows the same parameter trajectory as a
+fresh run at ANY fixed size.
+
+Unit tests pin the sharding algebra; the integration test drives an
+actual resize through tools/launch.py + checkpoint.resume.
+"""
+import os
+import signal
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from mxnet_tpu import elastic
+from mxnet_tpu.base import MXNetError
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+WORKER = os.path.join(REPO, "tests", "_preempt_worker.py")
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+# --- unit: the sharding algebra ---------------------------------------------
+
+def test_global_batch_is_deterministic_and_step_dependent():
+    a = elastic.global_batch_indices(100, 8, step=3, seed=7)
+    b = elastic.global_batch_indices(100, 8, step=3, seed=7)
+    c = elastic.global_batch_indices(100, 8, step=4, seed=7)
+    d = elastic.global_batch_indices(100, 8, step=3, seed=8)
+    assert (a == b).all()
+    assert not (a == c).all() or not (a == d).all()
+    assert len(a) == 8 and a.min() >= 0 and a.max() < 100
+    assert len(set(a.tolist())) == 8  # without-replacement draw
+
+
+def test_shards_partition_the_global_batch():
+    """Any world size slices the SAME global batch: concatenating the
+    rank shards in rank order reproduces it exactly."""
+    for step in (0, 1, 17):
+        full = elastic.global_batch_indices(64, 8, step, seed=5)
+        for world in (1, 2, 4, 8):
+            parts = [elastic.shard_indices(full, world, r)
+                     for r in range(world)]
+            assert (np.concatenate(parts) == full).all(), (step, world)
+            assert all(len(p) == 8 // world for p in parts)
+
+
+def test_shard_for_step_matches_manual_slicing():
+    got = elastic.shard_for_step(64, 8, step=2, world_size=2, rank=1,
+                                 seed=5)
+    full = elastic.global_batch_indices(64, 8, step=2, seed=5)
+    assert (got == full[4:]).all()
+
+
+def test_sequential_mode_wraps_around():
+    idx = elastic.global_batch_indices(10, 4, step=2, shuffle=False)
+    assert idx.tolist() == [8, 9, 0, 1]
+
+
+def test_indivisible_batch_raises():
+    with pytest.raises(MXNetError, match="divide"):
+        elastic.shard_indices(np.arange(8), world_size=3, rank=0)
+    with pytest.raises(MXNetError):
+        elastic.global_batch_indices(64, 8, step=-1)
+
+
+def test_world_info_reads_launcher_env(monkeypatch):
+    monkeypatch.setenv("MXT_PROCESS_ID", "1")
+    monkeypatch.setenv("MXT_NUM_PROCESSES", "4")
+    assert elastic.world_info() == (1, 4)
+
+
+# --- integration: 2 → 1 → 2 resize through real launches --------------------
+
+def _launch(n, ckpt, total, out, loss, port, timeout=300):
+    env = dict(os.environ)
+    env.update(REPO_ROOT=REPO, CKPT_DIR=ckpt, TOTAL_STEPS=str(total),
+               OUT_FILE=out, LOSS_FILE=loss, MXT_LAUNCH_PLATFORM="cpu")
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", str(n), "--coordinator", f"127.0.0.1:{port}",
+         sys.executable, WORKER],
+        env=env, start_new_session=True, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True)
+    try:
+        log, _ = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+        raise
+    assert proc.returncode == 0, log[-3000:]
+    return log
+
+
+def _losses(path):
+    """step → loss, keeping the LAST occurrence (steps between the last
+    checkpoint and a fault are re-trained and re-logged on resume)."""
+    out = {}
+    with open(path) as f:
+        for line in f:
+            step, loss = line.split()
+            out[int(step)] = float(loss)
+    return [out[k] for k in sorted(out)]
+
+
+@pytest.mark.skipif(sys.platform != "linux", reason="loopback group")
+def test_elastic_resize_2_1_2_matches_fixed_size_runs(tmp_path):
+    """Acceptance: train 2 workers → resume with 1 → resume with 2
+    again; per-step losses match FRESH fixed-size runs (both sizes) and
+    the final params match the oracle."""
+    total = 6
+    d = str(tmp_path)
+    seg = [("a", 2, 2), ("b", 1, 4), ("c", 2, 6)]  # (tag, world, until)
+    for tag, world, until in seg:
+        log = _launch(world, d + "/ck", until, f"{d}/seg_{tag}_",
+                      f"{d}/loss_resized", _free_port())
+        if tag != "a":
+            assert "resumed from step" in log, log[-2000:]
+
+    _launch(2, d + "/ck2", total, f"{d}/o2_", f"{d}/loss_w2", _free_port())
+    _launch(1, d + "/ck1", total, f"{d}/o1_", f"{d}/loss_w1", _free_port())
+
+    resized = _losses(f"{d}/loss_resized")
+    for oracle_file in ("loss_w2", "loss_w1"):
+        oracle = _losses(f"{d}/{oracle_file}")
+        assert len(resized) == len(oracle) == total
+        np.testing.assert_allclose(resized, oracle, rtol=1e-5,
+                                   err_msg=oracle_file)
+
+    final = np.load(f"{d}/seg_c_0.npy")
+    np.testing.assert_allclose(final, np.load(f"{d}/o2_0.npy"), rtol=1e-5)
+    np.testing.assert_allclose(final, np.load(f"{d}/o1_0.npy"), rtol=1e-5)

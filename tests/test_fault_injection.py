@@ -156,6 +156,63 @@ def test_rank_failure_without_restart_fails_fast(tmp_path):
     assert time.time() - t0 < 180, "launcher wedged on the dead rank"
 
 
+@pytest.mark.skipif(sys.platform != "linux", reason="loopback group")
+def test_sigterm_drain_exits_75_and_resumes_byte_identically(tmp_path):
+    """Satellite (c) + tentpole layer 2: SIGTERM on the LAUNCHER forwards
+    to every rank; the ranks finish their in-flight step, rank 0 cuts a
+    drain checkpoint, everyone exits PREEMPTED_EXIT (75) and the
+    launcher returns it without burning a crash restart.  A relaunch
+    resumes from the drain checkpoint and ends byte-identical to an
+    uninterrupted run."""
+    worker = os.path.join(REPO, "tests", "_preempt_worker.py")
+    marker = str(tmp_path / "mark")
+    env = dict(os.environ)
+    env.update(REPO_ROOT=REPO, CKPT_DIR=str(tmp_path / "ck"),
+               TOTAL_STEPS="10", OUT_FILE=str(tmp_path / "out_"),
+               STEP_SLEEP="0.3", MARKER_FILE=marker,
+               MARKER_AFTER_STEP="1", MXT_LAUNCH_PLATFORM="cpu")
+
+    def launch(n=2, extra_env=None):
+        e = dict(env, **(extra_env or {}))
+        return subprocess.Popen(
+            [sys.executable, os.path.join(TOOLS, "launch.py"), "-n",
+             str(n), "--coordinator", f"127.0.0.1:{_free_port()}",
+             sys.executable, worker],
+            env=e, start_new_session=True, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True)
+
+    proc = launch()
+    t0 = time.time()
+    while not os.path.exists(marker):
+        assert proc.poll() is None, proc.communicate()[0][-3000:]
+        assert time.time() - t0 < 180, "no training progress"
+        time.sleep(0.05)
+    os.kill(proc.pid, signal.SIGTERM)          # "preemption notice"
+    log, _ = proc.communicate(timeout=180)
+    assert proc.returncode == 75, (proc.returncode, log[-3000:])
+    assert "draining at step" in log, log[-3000:]
+
+    proc2 = launch(extra_env={"STEP_SLEEP": "0"})
+    log2, _ = proc2.communicate(timeout=180)
+    assert proc2.returncode == 0, log2[-3000:]
+    assert "resumed from step" in log2, log2[-3000:]
+
+    env_o = dict(env, CKPT_DIR=str(tmp_path / "cko"),
+                 OUT_FILE=str(tmp_path / "oracle_"), STEP_SLEEP="0",
+                 MARKER_FILE=str(tmp_path / "mark2"))
+    proc3 = launch(extra_env={"CKPT_DIR": env_o["CKPT_DIR"],
+                              "OUT_FILE": env_o["OUT_FILE"],
+                              "STEP_SLEEP": "0",
+                              "MARKER_FILE": env_o["MARKER_FILE"]})
+    log3, _ = proc3.communicate(timeout=180)
+    assert proc3.returncode == 0, log3[-3000:]
+    for rank in (0, 1):
+        got = np.load(str(tmp_path / f"out_{rank}.npy"))
+        want = np.load(str(tmp_path / f"oracle_{rank}.npy"))
+        assert got.tobytes() == want.tobytes(), \
+            f"rank {rank} diverged after drain+resume"
+
+
 def test_dist_async_worker_killed_mid_push_server_survives(monkeypatch):
     """Torn-frame injection: a worker dies mid-push leaving a TRUNCATED
     frame on the socket.  The server must discard the partial frame,

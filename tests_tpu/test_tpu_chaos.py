@@ -1,0 +1,75 @@
+"""Chaos lane on the real chip (round 6 tentpole, layer 4).
+
+The tier-1 chaos story (tests/test_chaos.py) runs 2 CPU loopback ranks;
+this lane replays it against a real TPU backend — single process (a
+host owns all local chips), chaos injected by tools/chaos.py under
+tools/launch.py, checkpoints on local disk.  What it adds over the CPU
+lane: the drain/kill/resume cycle with actual device buffers behind the
+NDArray handles (device→host snapshot, device_put on resume) and the
+XLA preemption-notifier interaction fixed in parallel.initialize.
+
+Run with:  MXT_TEST_TPU=1 python -m pytest tests_tpu/test_tpu_chaos.py -q
+"""
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+
+import numpy as np
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+WORKER = os.path.join(REPO, "tests", "_preempt_worker.py")
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _run(cmd, env, timeout=600):
+    proc = subprocess.Popen(cmd, env=env, start_new_session=True,
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True)
+    try:
+        log, _ = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+        raise
+    return proc.returncode, log
+
+
+def test_tpu_chaos_mixed_signals_survives(tmp_path):
+    d = str(tmp_path)
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)  # worker boots the TPU backend
+    env.update(REPO_ROOT=REPO, CKPT_DIR=d + "/ck", TOTAL_STEPS="12",
+               OUT_FILE=d + "/out_", STEP_SLEEP="0.5",
+               MXT_LAUNCH_PLATFORM="tpu")
+    summary_file = d + "/chaos.json"
+    rc, log = _run(
+        [sys.executable, os.path.join(REPO, "tools", "chaos.py"),
+         "-n", "1", "--kills", "2", "--mix", "mixed", "--seed", "3",
+         "--min-delay", "4.0", "--max-delay", "8.0",
+         "--max-restarts", "6", "--backoff-base", "0.1",
+         "--coordinator", f"127.0.0.1:{_free_port()}",
+         "--summary", summary_file,
+         "--", sys.executable, WORKER], env)
+    assert rc == 0, log[-3000:]
+    with open(summary_file) as f:
+        summary = json.load(f)
+    assert summary["survived"]
+    assert len(summary["injections"]) >= 1, summary
+
+    env_o = dict(env, CKPT_DIR=d + "/cko", OUT_FILE=d + "/oracle_",
+                 STEP_SLEEP="0")
+    rc2, log2 = _run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", "1", "--coordinator", f"127.0.0.1:{_free_port()}",
+         sys.executable, WORKER], env_o)
+    assert rc2 == 0, log2[-3000:]
+    got = np.load(d + "/out_0.npy")
+    want = np.load(d + "/oracle_0.npy")
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)

@@ -1,0 +1,191 @@
+#!/usr/bin/env python
+"""Chaos harness: kill random ranks mid-run under the local launcher.
+
+Preemption on TPU pods is not a unit test — it is a SIGTERM (graceful
+drain window) or a straight SIGKILL (spot reclaim, OOM-killer, kernel
+panic) landing on an arbitrary worker at an arbitrary moment.  This
+harness reproduces exactly that against ``tools/launch.py``'s local
+loopback topology: it arms a background "monkey" on every (re)spawned
+group which, after a seeded random delay, signals a seeded random rank.
+The launcher's babysitting loop (reap → backoff → relaunch) and the
+ranks' resume path (``mxnet_tpu.checkpoint.resume``) are then expected
+to carry the job to completion as if nothing happened.
+
+The schedule is DETERMINISTIC given ``--seed``: delays, victim ranks
+and the SIGTERM/SIGKILL choice all come from one ``random.Random``, so
+a chaos failure reproduces with the same command line.
+
+Usage (the ``--`` separates harness flags from the training command):
+
+    python tools/chaos.py -n 2 --kills 3 --mix mixed --seed 7 \
+        --max-restarts 8 -- python train.py --epochs 2
+
+Exit status is the group's final status (0 = the run survived the
+chaos); a JSON summary of every injection and the launcher's restart
+counts goes to stdout (or ``--summary FILE``).
+
+Stdlib-only, like the launcher it drives (never imports mxnet_tpu/jax:
+the ranks own the accelerator runtime, the harness only owns signals).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import signal
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import launch  # noqa: E402  (sibling module; stdlib-only)
+
+_SIGNALS = {"term": (signal.SIGTERM,),
+            "kill": (signal.SIGKILL,),
+            "mixed": (signal.SIGTERM, signal.SIGKILL)}
+
+
+class ChaosMonkey:
+    """Injects up to ``kills`` signals into live groups, one per spawn.
+
+    ``arm(procs)`` plugs into ``launch.launch_local(on_spawn=...)``:
+    each call cancels the previous timer (that group is already dead)
+    and starts a new one against the fresh group.  One injection per
+    group maximum — the launcher must observe the failure and relaunch
+    before the monkey strikes again, which is exactly the recovery
+    cadence of real preemption."""
+
+    def __init__(self, kills, mix="mixed", min_delay=1.0, max_delay=4.0,
+                 seed=0):
+        self.budget = kills
+        self.signals = _SIGNALS[mix]
+        self.min_delay = min_delay
+        self.max_delay = max_delay
+        self.rng = random.Random(seed)
+        self.injections = []
+        self._timer = None
+        self._lock = threading.Lock()
+        self._t0 = time.time()
+
+    def arm(self, procs):
+        with self._lock:
+            if self._timer is not None:
+                self._timer.cancel()
+            if self.budget <= 0:
+                return
+            delay = self.rng.uniform(self.min_delay, self.max_delay)
+            victim = self.rng.randrange(len(procs))
+            sig = self.signals[self.rng.randrange(len(self.signals))]
+            self._timer = threading.Timer(
+                delay, self._strike, (procs, victim, sig))
+            self._timer.daemon = True
+            self._timer.start()
+
+    def _strike(self, procs, victim, sig):
+        with self._lock:
+            if self.budget <= 0:
+                return
+            p = procs[victim]
+            if p.poll() is not None:
+                return  # group already dying on its own; keep the budget
+            try:
+                p.send_signal(sig)
+            except OSError:
+                return
+            self.budget -= 1
+            self.injections.append({
+                "t": round(time.time() - self._t0, 3),
+                "rank": victim,
+                "pid": p.pid,
+                "signal": signal.Signals(sig).name,
+            })
+            print(f"chaos.py: sent {signal.Signals(sig).name} to rank "
+                  f"{victim} (pid {p.pid})", file=sys.stderr)
+
+    def disarm(self):
+        with self._lock:
+            if self._timer is not None:
+                self._timer.cancel()
+                self._timer = None
+
+
+def run_chaos(n, cmd, kills=2, mix="mixed", min_delay=1.0, max_delay=4.0,
+              seed=0, coordinator="127.0.0.1:12721", max_restarts=8,
+              max_preemptions=64, backoff_base=0.2, backoff_cap=5.0):
+    """Run ``cmd`` across ``n`` loopback ranks with chaos injection.
+
+    Returns ``(rc, summary_dict)``.  The backoff default is shorter
+    than the launcher's production default — chaos runs live in test
+    lanes where wall-clock matters and the coordinator port is local."""
+    monkey = ChaosMonkey(kills, mix=mix, min_delay=min_delay,
+                         max_delay=max_delay, seed=seed)
+    stats = {}
+    try:
+        rc = launch.launch_local(
+            n, cmd, coordinator=coordinator, max_restarts=max_restarts,
+            max_preemptions=max_preemptions, backoff_base=backoff_base,
+            backoff_cap=backoff_cap, on_spawn=monkey.arm, stats=stats)
+    finally:
+        monkey.disarm()
+    summary = {
+        "rc": rc,
+        "survived": rc == 0,
+        "injections": monkey.injections,
+        "kills_remaining": monkey.budget,
+        "restarts": stats.get("restarts", {}),
+        "seed": seed,
+        "mix": mix,
+        "num_workers": n,
+    }
+    return rc, summary
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("-n", "--num-workers", type=int, required=True)
+    p.add_argument("--kills", type=int, default=2,
+                   help="total signals to inject (one per group spawn)")
+    p.add_argument("--mix", default="mixed",
+                   choices=sorted(_SIGNALS),
+                   help="term = graceful drains only, kill = hard kills "
+                        "only, mixed = coin-flip per injection")
+    p.add_argument("--min-delay", type=float, default=1.0,
+                   help="earliest injection after a (re)spawn, seconds")
+    p.add_argument("--max-delay", type=float, default=4.0)
+    p.add_argument("--seed", type=int, default=0,
+                   help="chaos schedule seed (delays, victims, signals)")
+    p.add_argument("--coordinator", default="127.0.0.1:12721")
+    p.add_argument("--max-restarts", type=int, default=8)
+    p.add_argument("--max-preemptions", type=int, default=64)
+    p.add_argument("--backoff-base", type=float, default=0.2)
+    p.add_argument("--backoff-cap", type=float, default=5.0)
+    p.add_argument("--summary", default=None,
+                   help="write the JSON summary here instead of stdout")
+    p.add_argument("command", nargs=argparse.REMAINDER)
+    args = p.parse_args(argv)
+    cmd = args.command
+    if cmd and cmd[0] == "--":
+        cmd = cmd[1:]
+    if not cmd:
+        p.error("no command given (separate it with --)")
+    rc, summary = run_chaos(
+        args.num_workers, cmd, kills=args.kills, mix=args.mix,
+        min_delay=args.min_delay, max_delay=args.max_delay,
+        seed=args.seed, coordinator=args.coordinator,
+        max_restarts=args.max_restarts,
+        max_preemptions=args.max_preemptions,
+        backoff_base=args.backoff_base, backoff_cap=args.backoff_cap)
+    text = json.dumps(summary, indent=2)
+    if args.summary:
+        with open(args.summary, "w") as f:
+            f.write(text + "\n")
+    else:
+        print(text)
+    sys.exit(rc)
+
+
+if __name__ == "__main__":
+    main()

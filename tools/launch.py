@@ -34,13 +34,59 @@ from __future__ import annotations
 
 import argparse
 import os
+import random
 import secrets
 import shlex
+import signal
 import subprocess
 import sys
+import time
+
+#: exit status of a graceful preemption drain — mirrors
+#: ``mxnet_tpu.gluon.trainer.PREEMPTED_EXIT_CODE`` (BSD EX_TEMPFAIL; the
+#: launcher stays stdlib-only, so the value is duplicated, pinned by
+#: tests/test_fault_injection.py).  A rank exiting with it was NOT a
+#: crash: it finished its step and wrote a drain checkpoint, so the
+#: relaunch consumes the (larger) preemption budget, not max_restarts.
+PREEMPTED_EXIT = 75
+
+# current group + drain flag, visible to the SIGTERM forwarder: when the
+# LAUNCHER is preempted it must pass the drain signal down and then exit
+# with the preemption status itself instead of relaunching
+_live_procs = []
+_draining = False
 
 
-def _spawn_group(n, cmd, coordinator, ps_secret, attempt):
+def _forward_drain(_signum=None, _frame=None):
+    global _draining
+    _draining = True
+    for p in list(_live_procs):
+        if p.poll() is None:
+            try:
+                p.send_signal(signal.SIGTERM)
+            except OSError:
+                pass
+
+
+def install_drain_forwarder():
+    """SIGTERM on the launcher → SIGTERM every rank (graceful drain),
+    then exit with the group's status once the ranks finish draining."""
+    signal.signal(signal.SIGTERM, _forward_drain)
+
+
+def _backoff_delay(restart_idx, base, cap, _rand=random.random):
+    """Exponential backoff with full-range jitter: restart ``i`` sleeps
+    uniform(0.5, 1.0) × min(cap, base·2^i) seconds, so a preemption storm
+    across many jobs doesn't synchronize their relaunches (the fixed
+    instant-restart loop hammered the coordinator port while the old
+    group's socket was still in TIME_WAIT)."""
+    if base <= 0:
+        return 0.0
+    return min(cap, base * (2 ** restart_idx)) * (0.5 + _rand() / 2)
+
+
+def _spawn_group(n, cmd, coordinator, ps_secret, attempt, reason=None,
+                 restarts=None):
     procs = []
     try:
         for rank in range(n):
@@ -54,6 +100,14 @@ def _spawn_group(n, cmd, coordinator, ps_secret, attempt):
                 # loopback test topology runs every process on CPU
                 "JAX_PLATFORMS": env.get("MXT_LAUNCH_PLATFORM", "cpu"),
             })
+            if reason is not None:
+                # why the previous group ended — ranks surface it as
+                # launcher.restart.<reason> telemetry (parallel.initialize)
+                env["MXT_RESTART_REASON"] = reason
+            if restarts:
+                env["MXT_RESTART_CRASHES"] = str(restarts.get("crash", 0))
+                env["MXT_RESTART_PREEMPTIONS"] = \
+                    str(restarts.get("preempted", 0))
             procs.append(subprocess.Popen(cmd, env=env))
     except OSError:
         # partial group (EMFILE/EAGAIN mid-spawn): reap what spawned or
@@ -109,25 +163,63 @@ def _wait_group(procs, poll_s=0.2):
         time.sleep(poll_s)
 
 
-def launch_local(n, cmd, coordinator="127.0.0.1:12721", max_restarts=0):
+def launch_local(n, cmd, coordinator="127.0.0.1:12721", max_restarts=0,
+                 max_preemptions=64, backoff_base=1.0, backoff_cap=30.0,
+                 on_spawn=None, stats=None):
     """Fork n local ranks and babysit them.
 
     On any rank's nonzero exit the whole group is reaped (failure
-    detection).  ``max_restarts`` > 0 then relaunches the full group —
-    ranks are expected to resume from their latest checkpoint
-    (mxnet_tpu.checkpoint.resume), which
+    detection), then relaunched — ranks are expected to resume from
+    their latest checkpoint (mxnet_tpu.checkpoint.resume), which
     tests/test_fault_injection.py proves reconverges to the
-    uninterrupted run."""
+    uninterrupted run.  The exit status picks the budget: a graceful
+    drain (``PREEMPTED_EXIT``) consumes ``max_preemptions``, anything
+    else consumes ``max_restarts`` — preemptions are routine and should
+    not burn the crash budget.  Relaunches back off exponentially with
+    jitter (``backoff_base``/``backoff_cap``); a SIGTERM on the launcher
+    itself drains the ranks (install_drain_forwarder) and returns
+    without relaunching.
+
+    ``on_spawn(procs)`` is called after every (re)spawn — the chaos
+    harness's injection point (tools/chaos.py); ``stats`` (a dict)
+    accumulates per-reason restart counts for the caller."""
+    global _draining
     ps_secret = os.environ.get("MXT_PS_SECRET") or secrets.token_hex(16)
-    attempt = 0
+    restarts = {"crash": 0, "preempted": 0}
+    if stats is not None:
+        stats["restarts"] = restarts
+    reason = None
     while True:
-        procs = _spawn_group(n, cmd, coordinator, ps_secret, attempt)
+        procs = _spawn_group(n, cmd, coordinator, ps_secret,
+                             attempt=restarts["crash"] +
+                             restarts["preempted"],
+                             reason=reason, restarts=restarts)
+        _live_procs[:] = procs
+        if _draining:
+            _forward_drain()  # SIGTERM raced the spawn: drain this group
+        if on_spawn is not None:
+            on_spawn(procs)
         rc = _wait_group(procs)
-        if rc == 0 or attempt >= max_restarts:
+        _live_procs[:] = []
+        if rc == 0:
+            return 0
+        if _draining:
+            return rc  # the launcher itself was preempted: no relaunch
+        reason = "preempted" if rc == PREEMPTED_EXIT else "crash"
+        budget = max_preemptions if reason == "preempted" else max_restarts
+        if restarts[reason] >= budget:
+            print(f"launch.py: group failed (rc={rc}, {reason}); "
+                  f"{reason} budget exhausted ({restarts[reason]}/{budget})",
+                  file=sys.stderr)
             return rc
-        attempt += 1
-        print(f"launch.py: group failed (rc={rc}); "
-              f"restart {attempt}/{max_restarts}", file=sys.stderr)
+        restarts[reason] += 1
+        delay = _backoff_delay(restarts[reason] - 1, backoff_base,
+                               backoff_cap)
+        print(f"launch.py: group failed (rc={rc}, {reason}); "
+              f"restart {restarts[reason]}/{budget} "
+              f"after {delay:.2f}s backoff", file=sys.stderr)
+        if delay:
+            time.sleep(delay)
 
 
 def emit_ssh(hosts, n, cmd, coordinator):
@@ -191,8 +283,18 @@ def main(argv=None):
                         "instead of spawning")
     p.add_argument("--max-restarts", type=int, default=0,
                    help="local launcher: relaunch the whole group up to "
-                        "this many times after a rank failure (ranks "
+                        "this many times after a rank CRASH (ranks "
                         "resume from their latest checkpoint)")
+    p.add_argument("--max-preemptions", type=int, default=64,
+                   help="local launcher: separate relaunch budget for "
+                        "graceful preemption drains (rank exit code "
+                        f"{PREEMPTED_EXIT})")
+    p.add_argument("--backoff-base", type=float, default=1.0,
+                   help="local launcher: first-relaunch backoff seconds "
+                        "(doubles per consecutive restart, jittered; "
+                        "0 disables)")
+    p.add_argument("--backoff-cap", type=float, default=30.0,
+                   help="local launcher: max backoff seconds")
     p.add_argument("command", nargs=argparse.REMAINDER)
     args = p.parse_args(argv)
     if not args.command:
@@ -200,9 +302,13 @@ def main(argv=None):
     if args.launcher == "local":
         if args.dry_run:
             p.error("--dry-run only applies to --launcher ssh")
+        install_drain_forwarder()
         sys.exit(launch_local(args.num_workers, args.command,
                               args.coordinator,
-                              max_restarts=args.max_restarts))
+                              max_restarts=args.max_restarts,
+                              max_preemptions=args.max_preemptions,
+                              backoff_base=args.backoff_base,
+                              backoff_cap=args.backoff_cap))
     if args.max_restarts:
         p.error("--max-restarts only applies to --launcher local")
     hosts = ["localhost"]
