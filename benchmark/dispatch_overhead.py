@@ -1,29 +1,35 @@
 #!/usr/bin/env python
-"""Per-op imperative dispatch overhead: eager vs bulked vs hybridized.
+"""Per-op dispatch overhead: eager vs bulked vs bulked+async vs hybridized.
 
-The reference engine's imperative-mode lever is op bulking
-(``MXNET_ENGINE_BULK_SIZE_*``): consecutive async ops are grouped into
-one scheduled unit so per-op dispatch cost is paid once per segment.
-This harness measures what our deferred-dispatch port (engine.py op
-bulking) buys over plain eager dispatch, and how close it gets to the
-hybridized (CachedOp, fully jitted) ceiling.
+The reference engine's imperative-mode levers are op bulking
+(``MXNET_ENGINE_BULK_SIZE_*``) and the ThreadedEngine's off-thread
+execution: consecutive async ops are grouped into one scheduled unit and
+the host thread never blocks on dispatch.  This harness measures what
+our deferred-dispatch port (engine.py op bulking) and its async tier
+(PR 7: background executor thread, cross-flush stitching, interned
+call-site keys, record-path ``cached_vjp``) buy over plain eager
+dispatch, and how close they get to the hybridized (CachedOp, fully
+jitted) ceiling.
 
 Workloads:
 
 * ``chain64`` — a 64-op elementwise chain on a small tensor, the
   dispatch-bound worst case: eager pays 64 unjitted jax calls + handle
   wrapping per iteration, bulked replays ONE cached jit-compiled
-  segment, hybridized replays one CachedOp graph.
+  segment, bulked_async size-flushes the full chain onto the worker
+  thread, hybridized replays one CachedOp graph.
 * ``mlp_sgd`` — a small-MLP SGD step (forward+backward under
-  ``autograd.record`` + trainer update).  Recording forces eager
-  dispatch inside the tape by design, so bulking is expected to be
-  ~neutral here — it is included to show the off/on delta on a real
-  training step, not to win it.
+  ``autograd.record`` + trainer update).  Recording keeps per-op
+  dispatch for tape structure; the async tier's interned-site replay
+  cache (jitted forward + recompute-vjp per call site) replaces the
+  per-op ``jax.vjp`` trace, which is where the eager training step
+  spends almost all of its time.
 
 Methodology: per mode, ``warmup`` iterations (compile/caches), then
 best-of-``BENCH_REPEATS`` timed windows of ``iters`` iterations, one
 host sync per iteration.  Reported unit is µs per op (chain) / ms per
-step (MLP).
+step (MLP).  Segment-stitch and key-intern hit counts accumulated over
+the async lanes are reported next to the segment cache stats.
 
 Run: ``JAX_PLATFORMS=cpu python benchmark/dispatch_overhead.py``
 (dispatch overhead is a host-side quantity; CPU numbers are the
@@ -81,6 +87,21 @@ def bench_chain():
         with engine.bulk(CHAIN_OPS + 8):
             _chain_body(x).wait_to_read()
 
+    def bulked_async_iter():
+        # bulk size == chain length: the whole chain size-flushes onto
+        # the async worker as one segment; wait_to_read synchronizes on
+        # the worker's completion event instead of executing inline
+        with engine.bulk(CHAIN_OPS):
+            _chain_body(x).wait_to_read()
+
+    def bulked_async_stitched_iter():
+        # bulk size == chain/4: four consecutive size-flushed segments
+        # per iteration, each stitched onto the previous one's in-flight
+        # output — the cross-flush linking path, paying one worker
+        # handoff per window on a chain with zero device work to overlap
+        with engine.bulk(CHAIN_OPS // 4):
+            _chain_body(x).wait_to_read()
+
     class Chain(gluon.HybridBlock):
         def hybrid_forward(self, F, t):
             return _chain_body(t)
@@ -94,11 +115,19 @@ def bench_chain():
 
     out = {}
     ref = _chain_body(x).asnumpy()
-    for mode, it in (("eager", eager_iter), ("bulked", bulked_iter),
-                     ("hybridized", hybrid_iter)):
-        for _ in range(WARMUP):
-            it()
-        best = _time_windows(it, CHAIN_ITERS, REPEATS)
+    for mode, it, use_async in (
+            ("eager", eager_iter, False),
+            ("bulked", bulked_iter, False),
+            ("bulked_async", bulked_async_iter, True),
+            ("bulked_async_stitched", bulked_async_stitched_iter, True),
+            ("hybridized", hybrid_iter, False)):
+        prev = engine.set_async_enabled(use_async)
+        try:
+            for _ in range(WARMUP):
+                it()
+            best = _time_windows(it, CHAIN_ITERS, REPEATS)
+        finally:
+            engine.set_async_enabled(prev)
         out[mode] = best / (CHAIN_ITERS * CHAIN_OPS) * 1e6  # µs/op
     # per-op bit-identity is the bulking contract (tests/test_engine_bulk.py
     # sweeps the registry); across a fused 64-op chain XLA may contract
@@ -107,17 +136,22 @@ def bench_chain():
         bulked = _chain_body(x).asnumpy()
     chain_maxdiff = float(np.abs(ref - bulked).max())
     per_op_identical = all(
-        np.array_equal(np.asarray(f(x).asnumpy()), _bulked_once(f, x))
+        np.array_equal(np.asarray(f(x).asnumpy()), _bulked_once(f, x, a))
+        for a in (False, True)
         for f in (lambda t: t + 0.5, lambda t: t * 1.001,
                   lambda t: t - 0.25, lambda t: t / 1.002))
     return out, per_op_identical, chain_maxdiff
 
 
-def _bulked_once(f, x):
+def _bulked_once(f, x, use_async=False):
     from mxnet_tpu import engine
 
-    with engine.bulk(8):
-        return f(x).asnumpy()
+    prev = engine.set_async_enabled(use_async)
+    try:
+        with engine.bulk(8):
+            return f(x).asnumpy()
+    finally:
+        engine.set_async_enabled(prev)
 
 
 def bench_mlp_sgd():
@@ -151,12 +185,12 @@ def bench_mlp_sgd():
         loss.wait_to_read()
 
     out = {}
-    for mode in ("eager", "bulked", "hybridized"):
+    for mode in ("eager", "bulked", "bulked_async", "hybridized"):
         net, trainer = build()
         if mode == "hybridized":
             net.hybridize()
 
-        if mode == "bulked":
+        if mode in ("bulked", "bulked_async"):
             def it(net=net, trainer=trainer):
                 with engine.bulk(16):
                     step(net, trainer)
@@ -164,9 +198,16 @@ def bench_mlp_sgd():
             def it(net=net, trainer=trainer):
                 step(net, trainer)
 
-        for _ in range(WARMUP):
-            it()
-        best = _time_windows(it, MLP_ITERS, REPEATS)
+        # bulked_async turns on the worker thread AND the record-path
+        # replay cache (interned jitted forward + recompute-vjp per call
+        # site) — the per-op jax.vjp trace is the eager step's main cost
+        prev = engine.set_async_enabled(mode == "bulked_async")
+        try:
+            for _ in range(WARMUP):
+                it()
+            best = _time_windows(it, MLP_ITERS, REPEATS)
+        finally:
+            engine.set_async_enabled(prev)
         out[mode] = best / MLP_ITERS * 1e3  # ms/step
     return out
 
@@ -221,18 +262,31 @@ def main():
     obs = observability_columns()
     from mxnet_tpu import engine
 
+    astats = engine.async_stats()
+    istats = engine.key_intern_stats()
     record = {
         "metric": "chain64_dispatch_usec_per_op",
-        "value": round(chain["bulked"], 3),
+        "value": round(chain["bulked_async"], 3),
         "unit": "usec/op",
         "aggregation": f"best_of_{REPEATS}_windows",
         "chain64_usec_per_op": {k: round(v, 3) for k, v in chain.items()},
         "chain64_bulked_speedup_vs_eager":
             round(chain["eager"] / chain["bulked"], 2),
+        "chain64_async_speedup_vs_eager":
+            round(chain["eager"] / chain["bulked_async"], 2),
         "per_op_bulked_identical_to_eager": per_op_identical,
         "chain64_bulked_max_abs_diff_vs_eager": chain_maxdiff,
         "mlp_sgd_ms_per_step": {k: round(v, 3) for k, v in mlp.items()},
+        "mlp_bulked_async_over_hybridized":
+            round(mlp["bulked_async"] / mlp["hybridized"], 3),
         "segment_cache": engine.segment_cache_stats(),
+        "engine_async": {
+            "submitted": astats["submitted"],
+            "stitched_segments": astats["stitched_segments"],
+            "stitched_inputs": astats["stitched_inputs"],
+            "max_queue_depth": astats["max_queue_depth"],
+        },
+        "key_intern": istats,
         "mlp_sgd_peak_live_bytes": obs["peak_live_bytes"],
         "mlp_sgd_model_flops": obs["model_flops"],
         "chain_ops": CHAIN_OPS,

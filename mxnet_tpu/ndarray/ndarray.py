@@ -31,6 +31,28 @@ from .. import telemetry
 from ..telemetry import memwatch as _mw
 from .. import sanitizer as _san
 
+# hot-path refs bound on first arithmetic dispatch: the operator dunders
+# run once per imperative op, and a per-call ``import jax.numpy`` /
+# relative import costs ~1 us each — real money at bulked dispatch rates
+_jnp = None
+_apply_op = None
+_sparse_mod = None
+_sparse_base = ()  # isinstance-safe placeholder until _bind_arith runs
+
+
+def _bind_arith():
+    global _jnp, _apply_op, _sparse_mod, _sparse_base
+    import jax.numpy as jnp
+
+    from ..ops.registry import apply_op
+    from . import sparse
+
+    _apply_op = apply_op
+    _sparse_mod = sparse
+    _sparse_base = sparse.BaseSparseNDArray
+    _jnp = jnp
+    return jnp
+
 #: placeholder class for buffers pending in a deferred engine segment
 #: (bound once: the _data fast path is a single class-identity test)
 _Pending = _engine._PendingArray
@@ -336,20 +358,21 @@ class NDArray:
 
     # -- arithmetic ----------------------------------------------------------
     def _binary(self, other, jf, name, reflected=False):
-        from ..ops.registry import apply_op
-
-        from . import sparse as _sp
-
-        if isinstance(other, _sp.BaseSparseNDArray):
+        apply_op = _apply_op
+        if apply_op is None:
+            _bind_arith()
+            apply_op = _apply_op
+        if isinstance(other, NDArray):
+            if reflected:
+                return apply_op(lambda a, b: jf(b, a), self, other, name=name)
+            return apply_op(lambda a, b: jf(a, b), self, other, name=name)
+        if isinstance(other, _sparse_base):
+            _sp = _sparse_mod
             canon = {"add": "add", "sub": "subtract", "mul": "multiply",
                      "div": "divide"}.get(name, name)
             if reflected:
                 return _sp.dispatch_binary(canon, jf, other, self)
             return _sp.dispatch_binary(canon, jf, self, other)
-        if isinstance(other, NDArray):
-            if reflected:
-                return apply_op(lambda a, b: jf(b, a), self, other, name=name)
-            return apply_op(lambda a, b: jf(a, b), self, other, name=name)
         c = other
 
         if reflected:
@@ -362,61 +385,61 @@ class NDArray:
         return self
 
     def __add__(self, o):
-        import jax.numpy as jnp
+        jnp = _jnp or _bind_arith()
 
         return self._binary(o, jnp.add, "add")
 
     __radd__ = __add__
 
     def __sub__(self, o):
-        import jax.numpy as jnp
+        jnp = _jnp or _bind_arith()
 
         return self._binary(o, jnp.subtract, "sub")
 
     def __rsub__(self, o):
-        import jax.numpy as jnp
+        jnp = _jnp or _bind_arith()
 
         return self._binary(o, jnp.subtract, "rsub", reflected=True)
 
     def __mul__(self, o):
-        import jax.numpy as jnp
+        jnp = _jnp or _bind_arith()
 
         return self._binary(o, jnp.multiply, "mul")
 
     __rmul__ = __mul__
 
     def __truediv__(self, o):
-        import jax.numpy as jnp
+        jnp = _jnp or _bind_arith()
 
         return self._binary(o, jnp.divide, "div")
 
     def __rtruediv__(self, o):
-        import jax.numpy as jnp
+        jnp = _jnp or _bind_arith()
 
         return self._binary(o, jnp.divide, "rdiv", reflected=True)
 
     def __floordiv__(self, o):
-        import jax.numpy as jnp
+        jnp = _jnp or _bind_arith()
 
         return self._binary(o, jnp.floor_divide, "floordiv")
 
     def __mod__(self, o):
-        import jax.numpy as jnp
+        jnp = _jnp or _bind_arith()
 
         return self._binary(o, jnp.mod, "mod")
 
     def __rmod__(self, o):
-        import jax.numpy as jnp
+        jnp = _jnp or _bind_arith()
 
         return self._binary(o, jnp.mod, "rmod", reflected=True)
 
     def __pow__(self, o):
-        import jax.numpy as jnp
+        jnp = _jnp or _bind_arith()
 
         return self._binary(o, jnp.power, "pow")
 
     def __rpow__(self, o):
-        import jax.numpy as jnp
+        jnp = _jnp or _bind_arith()
 
         return self._binary(o, jnp.power, "rpow", reflected=True)
 
@@ -426,71 +449,70 @@ class NDArray:
         return dot(self, o)
 
     def __neg__(self):
-        from ..ops.registry import apply_op
-
+        apply_op = _apply_op or (_bind_arith() and _apply_op)
         return apply_op(lambda a: -a, self, name="neg")
 
     def __abs__(self):
         from ..ops.registry import apply_op
-        import jax.numpy as jnp
+        jnp = _jnp or _bind_arith()
 
         return apply_op(jnp.abs, self, name="abs")
 
     def __iadd__(self, o):
-        import jax.numpy as jnp
+        jnp = _jnp or _bind_arith()
 
         return self._inplace(o, jnp.add, "iadd")
 
     def __isub__(self, o):
-        import jax.numpy as jnp
+        jnp = _jnp or _bind_arith()
 
         return self._inplace(o, jnp.subtract, "isub")
 
     def __imul__(self, o):
-        import jax.numpy as jnp
+        jnp = _jnp or _bind_arith()
 
         return self._inplace(o, jnp.multiply, "imul")
 
     def __itruediv__(self, o):
-        import jax.numpy as jnp
+        jnp = _jnp or _bind_arith()
 
         return self._inplace(o, jnp.divide, "idiv")
 
     # -- comparisons (elementwise 0/1 arrays in the operand dtype, matching
     #    the reference's comparison ops) --------------------------------------
     def _cmp(self, o, jf, name):
-        import jax.numpy as jnp
+        jnp = _jnp or _bind_arith()
 
         dt = self.dtype if self.dtype != np.bool_ else np.float32
         return self._binary(o, lambda a, b: jf(a, b).astype(dt), name)
 
     def __eq__(self, o):
-        import jax.numpy as jnp
+        jnp = _jnp or _bind_arith()
 
         return self._cmp(o, jnp.equal, "eq")
 
     def __ne__(self, o):
-        import jax.numpy as jnp
+        jnp = _jnp or _bind_arith()
 
         return self._cmp(o, jnp.not_equal, "ne")
 
     def __gt__(self, o):
-        import jax.numpy as jnp
+        jnp = _jnp or _bind_arith()
 
         return self._cmp(o, jnp.greater, "gt")
 
     def __ge__(self, o):
-        import jax.numpy as jnp
+        jnp = _jnp or _bind_arith()
 
         return self._cmp(o, jnp.greater_equal, "ge")
 
     def __lt__(self, o):
-        import jax.numpy as jnp
+        jnp = _jnp or _bind_arith()
 
         return self._cmp(o, jnp.less, "lt")
 
     def __le__(self, o):
-        import jax.numpy as jnp
+        jnp = _jnp or _bind_arith()
 
         return self._cmp(o, jnp.less_equal, "le")
 

@@ -181,6 +181,21 @@ def _profiler_mod():
 
 _NO_META = {"no_grad": False}
 
+# hot-path module refs, bound once on first dispatch (apply_op runs per
+# op — per-call relative imports cost ~1 µs each on the deferred path)
+_ENG = None
+_NDA = None
+
+
+def _bind_dispatch_refs():
+    global _ENG, _NDA
+    from .. import engine
+    from ..ndarray import NDArray
+
+    _NDA = NDArray
+    _ENG = engine
+    return engine
+
 
 def _zero_vjp(n_inputs: int):
     """Tape vjp for no_grad ops: all-None cotangents (autograd skips
@@ -205,10 +220,10 @@ def apply_op(fun: Callable, *nd_args, name: str = ""):
     as one jit-compiled unit (mxnet_tpu/engine.py).  The disabled path is
     the single ``_bulk_on`` boolean test below.
     """
-    import jax
-
-    from ..ndarray import NDArray
-    from .. import engine as _engine
+    _engine = _ENG
+    if _engine is None:
+        _engine = _bind_dispatch_refs()
+    NDArray = _NDA
 
     if _engine._bulk_on:
         deferred = _engine.maybe_defer(fun, nd_args, name)
@@ -216,14 +231,22 @@ def apply_op(fun: Callable, *nd_args, name: str = ""):
             # outputs are pending placeholders here; the ledger picks the
             # real buffers up when ``NDArray._data`` materializes the flush
             single, vals = deferred
+            new = NDArray.__new__
+            if single:
+                o = new(NDArray)
+                o._raw = vals[0]
+                o._node, o._oidx = None, 0
+                o._req_grad, o._grad, o._grad_req = False, None, "null"
+                return o
             nd_outs = []
             for v in vals:
-                o = NDArray.__new__(NDArray)
+                o = new(NDArray)
                 o._raw = v
                 o._node, o._oidx = None, 0
                 o._req_grad, o._grad, o._grad_req = False, None, "null"
                 nd_outs.append(o)
-            return nd_outs[0] if single else tuple(nd_outs)
+            return tuple(nd_outs)
+    import jax
 
     raws = [a._data for a in nd_args]
     if _san._enabled:
@@ -246,12 +269,15 @@ def apply_op(fun: Callable, *nd_args, name: str = ""):
         t0 = time.perf_counter()
     with dispatch_platform(platform_of_raws(raws)):
         if recording and not no_grad_op:
-            outs, vjp = jax.vjp(fun, *raws)
+            cached = (_engine.cached_vjp(fun, raws, name)
+                      if _engine._bulk_on and _engine._async_on else None)
+            if cached is not None:
+                outs, vjp = cached
+            else:
+                outs, vjp = jax.vjp(fun, *raws)
         else:
             outs = fun(*raws)
             vjp = None
-    from .. import engine as _engine
-
     if _engine.is_naive():
         # NaiveEngine: synchronous dispatch — device errors surface HERE,
         # at the op that caused them, with this op's name in the stack.

@@ -11,6 +11,7 @@ bypass deferral entirely.
 import os
 import subprocess
 import sys
+import time
 
 import numpy as np
 import pytest
@@ -168,11 +169,32 @@ def test_flush_on_bulk_size():
         c = a + 1.0
         c = c * 2.0
         assert engine.pending_ops() == 2
-        c = c - 3.0  # third op hits the budget: segment executes
+        c = c - 3.0  # third op hits the budget: segment flushes
         assert engine.pending_ops() == 0
-        assert not _pending(c)
+        # async tier: a size flush SUBMITS the segment (result is still a
+        # placeholder until materialized); sync mode executes it inline
+        if engine.async_enabled():
+            assert c._raw._segment.submitted
+        else:
+            assert not _pending(c)
     np.testing.assert_array_equal(
         c.asnumpy(), ((a + 1.0) * 2.0 - 3.0).asnumpy())
+
+
+def test_flush_on_bulk_size_sync_mode():
+    prev = engine.set_async_enabled(False)
+    try:
+        a = _arr()
+        with engine.bulk(3):
+            c = a + 1.0
+            c = c * 2.0
+            c = c - 3.0  # third op hits the budget: executes inline
+            assert engine.pending_ops() == 0
+            assert not _pending(c)
+        np.testing.assert_array_equal(
+            c.asnumpy(), ((a + 1.0) * 2.0 - 3.0).asnumpy())
+    finally:
+        engine.set_async_enabled(prev)
 
 
 def test_flush_on_record_boundary_and_grads_match():
@@ -440,3 +462,283 @@ def test_bulk_scope_restores_sizes_and_enable():
     assert engine.bulk_size() == 30
     assert not engine.bulk_enabled()
     assert not engine._bulk_on
+
+
+# --- async tier --------------------------------------------------------------
+# Size-flushed segments run on the background executor thread; the caller
+# keeps appending.  Errors are captured per-segment and re-raised at the
+# next materialization point naming the originating op; flush() is a
+# deterministic drain; MXNET_ENGINE_ASYNC=0 restores sync bulking exactly.
+
+
+@pytest.fixture
+def async_on():
+    prev = engine.set_async_enabled(True)
+    yield
+    engine._TLS.segment = None
+    engine.set_async_enabled(prev)
+
+
+@pytest.mark.parametrize("name,fn", SWEEP, ids=[n for n, _ in SWEEP])
+def test_async_bulked_bit_identical_to_eager(name, fn, async_on):
+    a, b = _arr(seed=1), _arr(seed=2)
+    ref = fn(a, b).asnumpy()
+    with engine.bulk(2):
+        got = fn(a, b).asnumpy()
+    assert np.array_equal(ref, got), f"{name}: async bulked != eager"
+    assert ref.dtype == got.dtype
+
+
+def test_async_cross_flush_stitching_matches_eager(async_on, monkeypatch):
+    # slow the worker's segment build so consumers always catch producers
+    # in flight: every cross-segment ref takes the stitch path
+    real = engine._build_segment_fn
+
+    def slow(*a, **k):
+        time.sleep(0.01)
+        return real(*a, **k)
+
+    monkeypatch.setattr(engine, "_build_segment_fn", slow)
+    engine.clear_segment_cache()
+
+    def chain(x):
+        # add-then-div per step: no mul+add adjacency, so XLA cannot
+        # fma-contract the fused segment and bit-identity to eager holds
+        for i in range(12):
+            x = x + (0.5 + i)
+            x = x / 1.01
+        return x
+
+    a = _arr(seed=20)
+    ref = chain(a).asnumpy()
+    before = engine.async_stats()
+    with engine.bulk(4):
+        got = chain(a).asnumpy()
+    after = engine.async_stats()
+    np.testing.assert_array_equal(ref, got)
+    assert after["submitted"] > before["submitted"]
+    assert after["stitched_segments"] > before["stitched_segments"]
+    assert after["stitched_inputs"] > before["stitched_inputs"]
+
+
+def test_async_worker_exception_names_op_at_materialization(
+        async_on, monkeypatch):
+    def boom(ops, n_slots, keep):
+        raise RuntimeError("injected kernel failure")
+
+    monkeypatch.setattr(engine, "_build_segment_fn", boom)
+    engine.clear_segment_cache()
+    a = _arr(seed=21)
+    with engine.bulk(2):
+        c = nd.tanh(a)
+        c = c * 2.0  # size flush: submits to the worker, which fails
+        # dispatch continued past the failure; the captured exception
+        # surfaces here, at the materialization point, naming the op
+        with pytest.raises(mx.MXNetError, match="tanh"):
+            c.asnumpy()
+        with pytest.raises(mx.MXNetError, match="injected kernel failure"):
+            engine._materialize(c._raw)
+
+
+def test_async_flush_is_deterministic_drain(async_on, monkeypatch):
+    real = engine._build_segment_fn
+
+    def slow(*a, **k):
+        time.sleep(0.01)
+        return real(*a, **k)
+
+    monkeypatch.setattr(engine, "_build_segment_fn", slow)
+    engine.clear_segment_cache()
+    a = _arr(seed=22)
+    with engine.bulk(2):
+        c = a + 1.0
+        c = c * 2.0          # submit 1
+        d = c - 3.0
+        d = d / 2.0          # submit 2, stitched onto 1
+        assert engine._TLS.inflight
+        engine.flush()
+        # after flush() every submitted segment has executed: no waits
+        # left, reads below resolve without touching the worker
+        assert not engine._TLS.inflight
+        assert c._raw._segment.results is not None
+        assert d._raw._segment.results is not None
+    np.testing.assert_array_equal(
+        d.asnumpy(), ((a.asnumpy() + 1.0) * 2.0 - 3.0) / 2.0)
+
+
+def test_sanitizer_stale_read_through_async_segment(async_on):
+    from mxnet_tpu import sanitizer
+
+    sanitizer.enable()
+    try:
+        a = _arr()
+        sanitizer.donate([a._data], "async_donating_site")
+        with engine.bulk(2):
+            c = a + 1.0      # consumes the donated buffer
+            c = c * 2.0      # size flush: donation check runs on the worker
+            with pytest.raises(sanitizer.DonatedBufferError,
+                               match="async_donating_site"):
+                c.asnumpy()
+    finally:
+        sanitizer.reset()
+        sanitizer.disable()
+
+
+def test_async_interleaved_record_pause_grads_match():
+    # same program under sync and async bulking; async engages the
+    # record-path replay cache (cached_vjp), grads must agree
+    a = _arr(seed=23)
+
+    def run(use_async):
+        prev = engine.set_async_enabled(use_async)
+        try:
+            w = nd.array(np.ones((3, 4), np.float32))
+            w.attach_grad()
+            with engine.bulk(4):
+                pre = a * 0.5 + 1.0
+                with ag.record():
+                    y = w * pre
+                    with ag.pause():
+                        _ = (y + 1.0).sum().asnumpy()  # untracked read
+                    loss = nd.tanh(y).sum()
+                loss.backward()
+                engine.flush()
+            return w.grad.asnumpy()
+        finally:
+            engine.set_async_enabled(prev)
+
+    np.testing.assert_allclose(run(False), run(True), rtol=1e-6, atol=1e-7)
+
+
+def test_async_queue_backpressure_bounds_depth(async_on, monkeypatch):
+    # slow every worker-side execution so submissions outpace the worker
+    # and the bounded queue pushes back on the caller
+    real = engine._cache_lookup
+
+    def slow(key):
+        time.sleep(0.003)
+        return real(key)
+
+    monkeypatch.setattr(engine, "_cache_lookup", slow)
+    submitted0 = engine.async_stats()["submitted"]
+    a = _arr(seed=24)
+    with engine.bulk(2):
+        x = a
+        for _ in range(30):
+            x = x + 1.0
+            x = x * 1.0  # size flush each iteration
+        got = x.asnumpy()
+    stats = engine.async_stats()
+    assert stats["submitted"] - submitted0 >= 30
+    assert stats["max_queue_depth"] <= engine._ASYNC_QUEUE_MAX + 1
+    assert engine._EXEC.q.qsize() == 0
+    ref = a.asnumpy()
+    for _ in range(30):  # sequential, same op order as the chain
+        ref = (ref + np.float32(1.0)) * np.float32(1.0)
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_shutdown_async_drains_and_restarts_lazily(async_on):
+    a = _arr(seed=25)
+    with engine.bulk(2):
+        c = a + 1.0
+        c = c * 2.0
+    engine.shutdown_async()
+    assert not engine._TLS.inflight
+    assert c._raw._segment.results is not None
+    np.testing.assert_array_equal(c.asnumpy(), (a.asnumpy() + 1.0) * 2.0)
+    # the executor thread restarts on the next async submit
+    with engine.bulk(2):
+        d = a - 1.0
+        d = d * 3.0
+    np.testing.assert_array_equal(d.asnumpy(), (a.asnumpy() - 1.0) * 3.0)
+    t = engine._EXEC._thread
+    assert t is not None and t.is_alive()
+
+
+def test_segment_cache_stats_thread_safe_under_async_load(async_on):
+    # caller-side stats reads and clears race the worker's LRU inserts;
+    # all of them hold the segment lock, so this must never corrupt the
+    # cache or miscount
+    a = _arr(seed=26)
+    with engine.bulk(2):
+        x = a
+        for i in range(30):
+            x = x + 1.0
+            x = x * 1.0
+            s = engine.segment_cache_stats()
+            assert s["size"] >= 0 and s["hit"] >= 0 and s["miss"] >= 0
+            if i % 10 == 5:
+                engine.clear_segment_cache()
+        got = x.asnumpy()
+    ref = a.asnumpy()
+    for _ in range(30):
+        ref = (ref + np.float32(1.0)) * np.float32(1.0)
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_async_wait_accounted_in_telemetry(async_on, monkeypatch):
+    real = engine._build_segment_fn
+
+    def slow(*a, **k):
+        time.sleep(0.01)
+        return real(*a, **k)
+
+    monkeypatch.setattr(engine, "_build_segment_fn", slow)
+    engine.clear_segment_cache()
+    telemetry.enable()
+    try:
+        a = _arr(seed=27)
+        telemetry.step_begin()
+        with engine.bulk(2):
+            c = a + 1.0
+            c = c * 2.0      # submit; worker is slowed
+            c.asnumpy()      # caller stalls on the worker: wait accounted
+        rec = telemetry.step_end()
+        assert rec["bulk_async_wait_ms"] > 0
+        assert rec["gauges"]["engine.async_queue_depth"] >= 1
+    finally:
+        telemetry.disable()
+
+
+def test_env_async_disabled_restores_sync_bulking():
+    r = _run_py(
+        "import numpy as np\n"
+        "from mxnet_tpu import engine, nd\n"
+        "from mxnet_tpu.engine import _PendingArray\n"
+        "assert not engine.async_enabled()\n"
+        "a = nd.array(np.ones((2, 2), np.float32))\n"
+        "with engine.bulk(2):\n"
+        "    c = a + 1.0\n"
+        "    c = c * 2.0  # size flush executes inline in sync mode\n"
+        "    assert engine.pending_ops() == 0\n"
+        "    assert c._raw.__class__ is not _PendingArray\n"
+        "assert engine.async_stats()['submitted'] == 0\n"
+        "assert engine._EXEC._thread is None\n"
+        "assert (c.asnumpy() == 4).all()\n",
+        MXNET_ENGINE_ASYNC="0")
+    assert r.returncode == 0, r.stderr
+
+
+def test_env_async_queue_size_honoured():
+    r = _run_py(
+        "from mxnet_tpu import engine\n"
+        "assert engine._ASYNC_QUEUE_MAX == 3, engine._ASYNC_QUEUE_MAX\n"
+        "assert engine._EXEC.q.maxsize == 3\n",
+        MXNET_ENGINE_ASYNC_QUEUE="3")
+    assert r.returncode == 0, r.stderr
+
+
+def test_naive_engine_bypasses_async(async_on):
+    prev = engine.engine_type()
+    engine.set_engine_type("NaiveEngine")
+    try:
+        before = engine.async_stats()["submitted"]
+        a = _arr(seed=28)
+        with engine.bulk(2):
+            c = a + 1.0
+            c = c * 2.0
+            assert not _pending(c)
+        assert engine.async_stats()["submitted"] == before
+    finally:
+        engine.set_engine_type(prev)

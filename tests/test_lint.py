@@ -59,6 +59,20 @@ def test_t1_engine_flush_is_a_sync_site():
     assert not any(v.context == "eager_boundary" for v in vs)
 
 
+def test_t1_async_materialization_points_are_sync_sites():
+    vs = _rule(_analyze("t1_engine_async.py"), "T1")
+    # wait_to_read (worker-event wait) inside a jitted fn is an error
+    assert any(v.severity == "error" and v.context == "bad_jitted_wait"
+               and "wait_to_read" in v.message for v in vs)
+    # ticket-style .result() join inside a jitted fn is an error
+    assert any(v.severity == "error" and v.context == "bad_jitted_ticket"
+               and "result" in v.message for v in vs)
+    # eager drains / ticket joins are legitimate use — .result() must
+    # not warn in eager glue (checkpoint drain paths rely on it)
+    assert not any(v.context == "eager_drain" for v in vs)
+    assert not any(v.context == "eager_ticket_join" for v in vs)
+
+
 def test_t2_flags_control_flow_on_traced_values():
     vs = _rule(_analyze("t2_control_flow.py"), "T2")
     kinds = {(v.context, v.message.split("`")[1]) for v in vs}

@@ -1,0 +1,40 @@
+"""T1 fixture: async-engine materialization points are sync sites.
+
+With the async engine tier (``MXNET_ENGINE_ASYNC``) a size-flushed
+segment runs on the worker thread; ``wait_to_read`` blocks the caller
+on the worker's completion event and ticket-style ``.result()`` waits
+join background work.  Both are host syncs: harmless as eager glue,
+T1 findings inside a traced region.
+"""
+import jax
+
+from mxnet_tpu import engine
+
+
+def eager_drain(a, b):
+    c = a + b
+    c.wait_to_read()                  # fine: eager glue, explicit barrier
+    engine.flush()                    # fine: drains the async queue too
+    return c
+
+
+def eager_ticket_join(ticket, x):
+    y = x * 2
+    ticket.result()                   # fine: joining a background save
+    return y
+
+
+def bad_jitted_wait(params, batch):
+    loss = params * batch
+    loss.wait_to_read()               # T1 error: worker-event wait in trace
+    return loss
+
+
+def bad_jitted_ticket(params, ticket):
+    out = params + 1
+    ticket.result()                   # T1 error: future join inside a trace
+    return out
+
+
+bad_jitted_wait_jit = jax.jit(bad_jitted_wait)
+bad_jitted_ticket_jit = jax.jit(bad_jitted_ticket)

@@ -43,9 +43,17 @@ RULES = {
 
 # --- T1 ---------------------------------------------------------------------
 
-#: method-style syncs: ``x.asnumpy()``, ``x.item()``, ...
+#: method-style syncs: ``x.asnumpy()``, ``x.item()``, ...  With the
+#: async engine tier (PR 7) ``wait_to_read`` may block on the worker
+#: thread's completion event rather than the device — still a host
+#: sync.  ``result`` covers ticket-style waits (async checkpoint
+#: tickets, executor futures): joining one inside a traced region
+#: serializes the trace on host progress.  It is deliberately NOT in
+#: SYNC_METHODS_ANYWHERE — ``ticket.result()`` in eager glue
+#: (checkpoint.py drain paths) is the intended usage.
 SYNC_METHODS = {"asnumpy", "asscalar", "item", "tolist",
-                "block_until_ready", "wait_to_read", "wait_to_write"}
+                "block_until_ready", "wait_to_read", "wait_to_write",
+                "result"}
 
 #: syncs unambiguous enough to warn about even in eager glue code
 SYNC_METHODS_ANYWHERE = {"asnumpy", "asscalar", "item",
