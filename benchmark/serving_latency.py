@@ -1,0 +1,231 @@
+#!/usr/bin/env python
+"""Serving latency under load: closed-loop and Poisson open-loop lanes.
+
+The round-8 tentpole claim: the continuous-batching server
+(``mxnet_tpu.serving.InferenceServer``) holds its compiled-signature
+count to the pow2 bucket grid while aggregating concurrent requests
+into dynamic batches — so tail latency under load is paid in queueing
+and batching, not recompilation.
+
+Two lanes against an in-process server over a position-wise nnvm
+predictor (every (batch, length) row an independent gemm row):
+
+* **closed_loop** — ``BENCH_SERVING_CLIENTS`` threads each submitting
+  ``BENCH_SERVING_REQUESTS / clients`` mixed-length requests
+  back-to-back (throughput-bound: offered load tracks service rate).
+* **open_loop** — one dispatcher submitting ``BENCH_SERVING_REQUESTS``
+  requests at Poisson arrivals (seeded exponential gaps at
+  ``BENCH_SERVING_RATE`` req/s), futures collected at the end
+  (latency-bound: offered load is independent of service rate, queue
+  waits show up honestly).
+
+Every request's ``serving.request`` telemetry record is captured via a
+ListSink; per lane the artifact reports p50/p90/p99 total latency,
+queue-wait percentiles, the batch-size distribution, throughput, and
+the predictor's compile-cache stats (signatures must stay within the
+bucket grid's ceiling).
+
+Run: ``JAX_PLATFORMS=cpu python benchmark/serving_latency.py``
+Artifact: SERVING_LATENCY_r08.json (override MXT_SERVING_LATENCY_OUT).
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+REQUESTS = int(os.environ.get("BENCH_SERVING_REQUESTS", 64))
+CLIENTS = int(os.environ.get("BENCH_SERVING_CLIENTS", 4))
+RATE = float(os.environ.get("BENCH_SERVING_RATE", 200.0))  # req/s, open loop
+MAX_BATCH = int(os.environ.get("BENCH_SERVING_MAX_BATCH", 8))
+MAX_LENGTH = int(os.environ.get("BENCH_SERVING_MAX_LEN", 64))
+SEED = int(os.environ.get("BENCH_SERVING_SEED", 0))
+IN_DIM = 8
+HIDDEN = 8
+
+
+def _build_predictor(workdir):
+    """Position-wise nnvm chain (FullyConnected flatten=False): padded
+    batches are bit-identical to unpadded rows, so the bench measures
+    scheduling, not numerics."""
+    from mxnet_tpu import nd, serialization
+    import mxnet_tpu.symbol as sym
+    from mxnet_tpu.predictor import Predictor
+
+    data = sym.Variable("data")
+    w = sym.Variable("fc_weight")
+    b = sym.Variable("fc_bias")
+    out = sym.FullyConnected(data, w, b, num_hidden=HIDDEN, flatten=False,
+                             name="fc")
+    out = sym.Activation(out, act_type="relu")
+    rs = np.random.RandomState(7)
+    prefix = os.path.join(workdir, "posw")
+    out.save(f"{prefix}-symbol.json")
+    serialization.save_ndarrays(f"{prefix}-0000.params", {
+        "arg:fc_weight": nd.array(rs.randn(HIDDEN, IN_DIM)
+                                  .astype(np.float32)),
+        "arg:fc_bias": nd.array(rs.randn(HIDDEN).astype(np.float32))})
+    return Predictor(f"{prefix}-symbol.json", f"{prefix}-0000.params")
+
+
+def _percentiles(values, ps=(50, 90, 99)):
+    if not values:
+        return {f"p{p}": None for p in ps}
+    xs = sorted(values)
+    n = len(xs)
+    out = {}
+    for p in ps:
+        rank = max(0, min(n - 1, -(-p * n // 100) - 1))  # nearest-rank
+        out[f"p{p}"] = round(xs[rank], 3)
+    return out
+
+
+def _lane_summary(recs, wall_s, rejected):
+    total = [r["total_ms"] for r in recs]
+    waits = [r["queue_wait_ms"] for r in recs]
+    sizes = {}
+    for r in recs:
+        sizes[str(r["batch_size"])] = sizes.get(str(r["batch_size"]), 0) + 1
+    return {
+        "completed": len(recs),
+        "rejected": rejected,
+        "wall_s": round(wall_s, 4),
+        "throughput_req_per_s": round(len(recs) / wall_s, 2),
+        "total_ms": _percentiles(total),
+        "queue_wait_ms": _percentiles(waits),
+        "queue_wait_ms_mean": round(sum(waits) / max(1, len(waits)), 3),
+        "batch_size_dist": dict(sorted(sizes.items(), key=lambda kv:
+                                       int(kv[0]))),
+        "buckets_seen": sorted({tuple(r["bucket"]) for r in recs}),
+    }
+
+
+def _workload(n, rng):
+    """Mixed-length inputs spanning the length-bucket grid."""
+    lens = rng.randint(2, MAX_LENGTH + 1, size=n)
+    return [rng.randn(l, IN_DIM).astype(np.float32) for l in lens]
+
+
+def _make_server(pred):
+    from mxnet_tpu import serving
+
+    cfg = serving.ServerConfig(max_batch=MAX_BATCH, max_length=MAX_LENGTH,
+                               min_batch=1, min_length=8,
+                               queue_capacity=max(64, REQUESTS),
+                               output_length_axis=0, batch_window_ms=2.0,
+                               summary_every=max(16, REQUESTS // 2))
+    return serving.InferenceServer(pred, cfg)
+
+
+def _run_lane(pred, lane):
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.telemetry.sinks import ListSink
+
+    rng = np.random.RandomState(SEED + (1 if lane == "open_loop" else 0))
+    inputs = _workload(REQUESTS, rng)
+    telemetry.enable(memory=False, cost=False)
+    sink = ListSink()
+    telemetry.add_sink(sink)
+    srv = _make_server(pred)
+    try:
+        with srv:
+            # warmup: touch every length bucket once so steady-state
+            # latency excludes first-compile time (compile counts are
+            # still reported from cache stats)
+            for l in sorted({srv.config.policy.length_bucket(len(x))
+                             for x in inputs}):
+                srv.infer(np.zeros((l, IN_DIM), np.float32), timeout=120.0)
+            sink.records.clear()
+            t0 = time.perf_counter()
+            if lane == "closed_loop":
+                _closed_loop(srv, inputs)
+            else:
+                _open_loop(srv, inputs, rng)
+            wall = time.perf_counter() - t0
+        stats = srv.stats()
+    finally:
+        telemetry.disable()
+        telemetry.reset()
+    recs = [r for r in sink.records if r.get("record") == "serving.request"]
+    out = _lane_summary(recs, wall, stats["rejected"])
+    out["batches"] = stats["batches"]
+    out["cache"] = stats["cache"]
+    return out
+
+
+def _closed_loop(srv, inputs):
+    shards = [inputs[i::CLIENTS] for i in range(CLIENTS)]
+
+    def client(shard):
+        for x in shard:
+            srv.infer(x, timeout=300.0)
+
+    threads = [threading.Thread(target=client, args=(s,)) for s in shards]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+
+def _open_loop(srv, inputs, rng):
+    gaps = rng.exponential(1.0 / RATE, size=len(inputs))
+    futures = []
+    for x, gap in zip(inputs, gaps):
+        time.sleep(gap)
+        futures.append(srv.submit(x))
+    for f in futures:
+        f.result(timeout=300.0)
+
+
+def main():
+    workdir = tempfile.mkdtemp(prefix="serving_bench_")
+    try:
+        pred = _build_predictor(workdir)
+        lanes = {lane: _run_lane(pred, lane)
+                 for lane in ("closed_loop", "open_loop")}
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+    from mxnet_tpu import serving
+
+    ceiling = len(serving.BucketPolicy(
+        max_batch=MAX_BATCH, max_length=MAX_LENGTH,
+        min_batch=1, min_length=8).signatures())
+    sigs = max(l["cache"]["signatures"] for l in lanes.values())
+    record = {
+        "metric": "serving_open_loop_p99_ms",
+        "value": lanes["open_loop"]["total_ms"]["p99"],
+        "unit": "ms",
+        "requests_per_lane": REQUESTS,
+        "clients": CLIENTS,
+        "open_loop_rate_req_per_s": RATE,
+        "bucket_config": {"max_batch": MAX_BATCH, "max_length": MAX_LENGTH,
+                          "signature_ceiling": ceiling},
+        "lanes": lanes,
+        "acceptance": {
+            "signatures_within_ceiling": sigs <= ceiling,
+            "batched": any(int(k) > 1 for l in lanes.values()
+                           for k in l["batch_size_dist"]),
+            "no_rejections": all(l["rejected"] == 0 for l in lanes.values()),
+        },
+        "platform": os.environ.get("JAX_PLATFORMS", "default"),
+    }
+    line = json.dumps(record, indent=2, default=str)
+    print(line)
+    out_path = os.environ.get(
+        "MXT_SERVING_LATENCY_OUT",
+        os.path.join(os.path.dirname(__file__), "..",
+                     "SERVING_LATENCY_r08.json"))
+    with open(out_path, "w") as f:
+        f.write(line + "\n")
+
+
+if __name__ == "__main__":
+    main()

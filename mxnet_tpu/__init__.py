@@ -85,6 +85,7 @@ _LAZY = {
     "predictor": ".predictor",
     "checkpoint": ".checkpoint",
     "elastic": ".elastic",
+    "serving": ".serving",
 }
 
 

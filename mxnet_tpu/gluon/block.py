@@ -542,6 +542,18 @@ class CachedOp:
                           static_shape=static_shape, **flags)
         self._graphs = {}
         self._params = None
+        self._hits = 0
+        self._misses = 0
+
+    def cache_stats(self):
+        """Per-instance signature-cache counters: ``{"hits", "misses",
+        "signatures"}``.  The global ``cachedop.cache_hit/miss``
+        telemetry counters aggregate across every CachedOp; this is the
+        per-block view a serving bucketing policy is verified against
+        (each miss is one trace+compile — a bounded ``signatures`` count
+        under mixed traffic means the bucketing held)."""
+        return {"hits": self._hits, "misses": self._misses,
+                "signatures": len(self._graphs)}
 
     def _param_list(self):
         # stable ordering: collect_params is ordered by construction
@@ -592,12 +604,14 @@ class CachedOp:
             # regressions need attributed (retracing every step means an
             # unstable signature, e.g. unpadded dynamic batch sizes)
             telemetry.count("cachedop.cache_miss")
+            self._misses += 1
             with telemetry.span("cachedop.build"):
                 g = _CachedGraph(self.block, params, training,
                                  remat=bool(self.flags.get("remat", False)))
             self._graphs[sig] = g
         else:
             telemetry.count("cachedop.cache_hit")
+            self._hits += 1
         return g.run(args)
 
 

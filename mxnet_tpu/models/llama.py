@@ -452,7 +452,10 @@ class LlamaDecoder:
 
     def _attend(self, q, k, v, mask):
         """Scores in f32 accumulation (matches _sdpa_ref), masked
-        softmax, context.  q (B,H,Q,D); k/v (B,Hkv,T,D); mask (Q,T)."""
+        softmax, context.  q (B,H,Q,D); k/v (B,Hkv,T,D); mask (Q,T)
+        shared across the batch, or already broadcastable to
+        (B,H,Q,T) — the per-slot serving step masks each batch row at
+        its own cache length."""
         import jax
         import jax.numpy as jnp
 
@@ -464,7 +467,9 @@ class LlamaDecoder:
         scores = jnp.einsum("bhqd,bhtd->bhqt", q, k,
                             preferred_element_type=jnp.float32)
         scores = scores / jnp.sqrt(jnp.float32(cfg.head_dim))
-        scores = jnp.where(mask[None, None], scores, -jnp.inf)
+        if mask.ndim == 2:
+            mask = mask[None, None]
+        scores = jnp.where(mask, scores, -jnp.inf)
         attn = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
         return jnp.einsum("bhqt,bhtd->bhqd", attn, v)
 
@@ -512,6 +517,56 @@ class LlamaDecoder:
         x = self._rms(x, w["norm"], cfg.rms_eps)
         return x @ w["head"].T, new_caches
 
+    def _step_slots_impl(self, w, caches, ids_t, pos):
+        """Per-slot decode step for continuous-batching serving:
+        ids_t (S,) int32, pos (S,) int32 → (logits (S, V), caches).
+
+        Unlike ``_step_impl`` (one shared scalar position — a
+        homogeneous batch decoded in lockstep), every cache slot here
+        carries its OWN position: RoPE tables are gathered per slot,
+        each slot's K/V row is written at its own ``pos`` (vmapped
+        dynamic_update_slice), and the causal mask is per-slot
+        (``t <= pos[s]``).  That is the core of continuous batching —
+        requests admitted at different times decode in one program.
+        Vacant slots run with pos=0/ids=0: their garbage K/V write lands
+        in their own slot row only and admission's prefill scatter
+        replaces the whole slot cache, so they never perturb live
+        slots."""
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        cfg = self.cfg
+        hd = cfg.head_dim
+        s = ids_t.shape[0]
+        pos = jnp.asarray(pos, jnp.int32)
+        cos = self._cos[pos][:, None, None, :]      # (S,1,1,hd/2)
+        sin = self._sin[pos][:, None, None, :]
+        x = w["emb"][ids_t]                         # (S, H)
+        new_caches = []
+        mask = (jnp.arange(self.max_len)[None, :]
+                <= pos[:, None])[:, None, None, :]  # (S,1,1,T)
+        z = jnp.zeros((), jnp.int32)
+        upd = jax.vmap(
+            lambda c, u, p: lax.dynamic_update_slice(c, u, (z, p, z)))
+        for L, (kc, vc) in zip(w["layers"], caches):
+
+            def ctx_fn(h, L=L, kc=kc, vc=vc):
+                q = (h @ L["q"].T).reshape(s, cfg.num_heads, 1, hd)
+                k = (h @ L["k"].T).reshape(s, cfg.num_kv_heads, 1, hd)
+                v = (h @ L["v"].T).reshape(s, cfg.num_kv_heads, 1, hd)
+                q = _apply_rope(q, cos, sin)
+                k = _apply_rope(k, cos, sin)
+                kc2 = upd(kc, k, pos)
+                vc2 = upd(vc, v, pos)
+                new_caches.append((kc2, vc2))
+                ctx = self._attend(q, kc2, vc2, mask)
+                return ctx.reshape(s, cfg.num_heads * hd) @ L["o"].T
+
+            x = self._layer(L, x, ctx_fn)
+        x = self._rms(x, w["norm"], cfg.rms_eps)
+        return x @ w["head"].T, new_caches
+
     def _prefill_impl(self, w, ids, t0):
         """Batched full-sequence prompt pass over PADDED ids (B, Lp) with
         the true prompt length ``t0`` traced: caches get K/V written at
@@ -553,7 +608,15 @@ class LlamaDecoder:
                     .reshape(b, lp, cfg.num_heads * hd) @ L["o"].T
 
             x = self._layer(L, x, ctx_fn)
-        x_last = jnp.take(x, jnp.asarray(t0, jnp.int32) - 1, axis=1)
+        t0v = jnp.asarray(t0, jnp.int32)
+        if t0v.ndim == 0:
+            x_last = jnp.take(x, t0v - 1, axis=1)
+        else:
+            # per-row true lengths (B,): serving admits prompts of
+            # different lengths in one padded prefill, each row gathers
+            # its own last real position
+            x_last = jnp.take_along_axis(
+                x, (t0v - 1)[:, None, None], axis=1)[:, 0]
         x_last = self._rms(x_last, w["norm"], cfg.rms_eps)
         return caches, x_last @ w["head"].T
 

@@ -99,6 +99,7 @@ class Predictor:
                 pass
         self._inputs = {}
         self._outputs = None
+        self._seen_signatures = 0
 
     # -- input materialisation ------------------------------------------------
     def _tmp(self):
@@ -213,6 +214,7 @@ class Predictor:
         """``MXPredForward``: run the bound graph on the staged (or
         keyword-passed) inputs."""
         from . import autograd
+        from . import telemetry
 
         for k, v in inputs.items():
             self.set_input(k, v)
@@ -220,11 +222,59 @@ class Predictor:
         if missing:
             raise MXNetError(f"inputs not set: {missing}")
         args = [self._inputs[n] for n in self._input_names]
-        with autograd.pause():
+        with autograd.pause(), telemetry.span("predictor.forward"):
             out = self._block(*args)
         self._outputs = list(out) if isinstance(out, (list, tuple)) \
             else [out]
+        self._note_signature(args)
         return self._outputs
+
+    # -- compile-cache observability ------------------------------------------
+    def cache_stats(self):
+        """Per-signature compile-cache counters for the bound graph:
+        ``{"hits", "misses", "signatures"}``.  One miss = one
+        trace+compile of a new (input shapes/dtypes, mode, platform)
+        signature; a serving layer's bucketing policy is verified by
+        asserting ``signatures`` stays bounded under mixed traffic.
+        All-zero when the block runs un-hybridized (imperative
+        fallback)."""
+        cop = getattr(self._block, "_cached_op", None)
+        if cop is None:
+            return {"hits": 0, "misses": 0, "signatures": 0}
+        return cop.cache_stats()
+
+    def _note_signature(self, args):
+        """Post-forward bookkeeping: count ``predictor.compile`` /
+        ``predictor.cache_hit`` telemetry from the CachedOp cache delta,
+        and register a new signature's compiled graph in the cost
+        registry under kind ``"predictor"`` (registration only — the
+        CachedOp site already attributes per-execution flops)."""
+        from . import telemetry
+        from .telemetry import costs as _costs
+
+        cop = getattr(self._block, "_cached_op", None)
+        if cop is None:
+            return
+        n = len(cop._graphs)
+        if n <= self._seen_signatures:
+            telemetry.count("predictor.cache_hit")
+            return
+        self._seen_signatures = n
+        telemetry.count("predictor.compile")
+        if _costs._enabled and cop._graphs:
+            # dict is insertion-ordered: the newest graph is the one this
+            # forward just compiled
+            g = next(reversed(cop._graphs.values()))
+            try:
+                import jax
+
+                p_raws = [p.data()._data for p in g.params]
+                in_raws = [a._data for a in args]
+                _costs.note("predictor", (id(self), n), g._fwd,
+                            (p_raws, in_raws, jax.random.PRNGKey(0)),
+                            attribute=False)
+            except Exception:
+                pass  # registry entries are best-effort observability
 
     def get_output(self, index=0):
         """``MXPredGetOutput``."""

@@ -1,0 +1,43 @@
+"""Continuous-batching inference serving on the predictor path.
+
+Reference: the C predict API (SURVEY §3.5) is the reference's serving
+surface; this package is the server ON TOP of it — the north star's
+"heavy traffic from millions of users" entry point.  Architecture
+(docs/serving.md):
+
+    clients → RequestQueue (bounded; full → ServerOverloadedError)
+            → scheduler thread: group by length bucket, pad to the
+              power-of-two (batch, length) grid     [bucketing.py]
+            → Predictor / gluon block / llama decode engine
+            → demux to per-request Futures + telemetry records
+
+Stateless models get dynamic batching (:class:`InferenceServer`);
+llama decode gets TRUE continuous batching (:class:`GenerativeServer`):
+a sliced KV cache (``kv_cache.KVCacheManager`` + one per-slot-position
+compiled step) where requests are admitted into free slots and evicted
+on completion BETWEEN decode steps, so a late request joins an
+in-flight batch without restarting anyone.
+
+Quick start::
+
+    from mxnet_tpu import serving
+
+    srv = serving.InferenceServer(predictor,
+                                  serving.ServerConfig(max_batch=8))
+    with srv:
+        out = srv.infer(x)          # sync
+        fut = srv.submit(x2)        # async -> concurrent.futures.Future
+        out2 = fut.result()
+"""
+from .protocol import (Request, ServerClosedError,     # noqa: F401
+                       ServerOverloadedError)
+from .bucketing import BucketPolicy, pad_batch, pow2_bucket  # noqa: F401
+from .kv_cache import KVCacheManager                   # noqa: F401
+from .scheduler import BatchScheduler, RequestQueue    # noqa: F401
+from .server import (GenerativeServer, InferenceServer,  # noqa: F401
+                     ServerConfig)
+
+__all__ = ["Request", "ServerOverloadedError", "ServerClosedError",
+           "BucketPolicy", "pow2_bucket", "pad_batch", "KVCacheManager",
+           "RequestQueue", "BatchScheduler", "ServerConfig",
+           "InferenceServer", "GenerativeServer"]

@@ -1,0 +1,365 @@
+"""Continuous-batching decode engine for the llama generative path.
+
+The engine owns one static-shape KV cache per layer —
+``(num_slots, Hkv, max_len, head_dim)`` — and exactly THREE compiled
+program families, all shape-stable under arbitrary request traffic:
+
+* **step** — ``LlamaDecoder._step_slots_impl`` over all slots at once,
+  every slot at its OWN position (vector ``pos``): one signature, ever.
+  Vacant slots decode garbage at row 0 of their own slot; nobody reads
+  it.
+* **prefill** — the decoder's batched prompt pass at one
+  (admit_bucket, prompt_bucket) shape per bucket pair, with per-row
+  true lengths (vector ``t0``), returning each admitted prompt's first
+  token and its full-length cache rows.
+* **scatter** — writes the prefilled rows into the admitted slot
+  indices of the live cache.  Vacant rows carry slot index
+  ``num_slots``: out-of-bounds scatter indices DROP in XLA, so padding
+  never touches a live slot.
+
+Between any two step calls the scheduler may admit new requests
+(prefill + scatter) or evict finished ones — the continuous-batching
+join point.  Weights are frozen at engine build; ``int8=True`` stores
+them as per-output-channel symmetric int8 (scale = max|row|/127) and
+dequantizes in-kernel — the weight-only quantization the int8 MXU
+pricing in ``INT8_TOPOLOGY_r05.json`` motivates.
+
+The scheduler half (:class:`GenerativeScheduler`) runs the admit/step/
+evict loop on one background thread, with the same queue, telemetry
+and backpressure contract as the stateless :class:`~.scheduler.
+BatchScheduler`.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+from .. import telemetry
+from .bucketing import BucketPolicy, pad_batch
+from .kv_cache import KVCacheManager
+from .protocol import ServerClosedError
+from .scheduler import _materialize
+
+__all__ = ["LlamaServingEngine", "GenerativeScheduler"]
+
+#: matmul weights that the int8 option quantizes (per-output-channel);
+#: embeddings and the RMSNorm scales stay in the load dtype
+_QUANT_KEYS = ("q", "k", "v", "o", "gate", "up", "down")
+_LAYER_KEYS = ("ln_in", "q", "k", "v", "o", "ln_post", "gate", "up",
+               "down")
+
+
+def _quantize_mat(m):
+    """Per-output-channel symmetric int8: rows of the (out, in) weight
+    each get scale = max|row| / 127."""
+    import jax.numpy as jnp
+
+    m32 = m.astype(jnp.float32)
+    scale = jnp.maximum(jnp.max(jnp.abs(m32), axis=1, keepdims=True)
+                        / 127.0, 1e-8)
+    q8 = jnp.clip(jnp.round(m32 / scale), -127, 127).astype(jnp.int8)
+    return {"q8": q8, "scale": scale.astype(jnp.float32)}
+
+
+def _quantize_tree(w):
+    layers = []
+    for L in w["layers"]:
+        layers.append({k: _quantize_mat(L[k]) if k in _QUANT_KEYS
+                       else L[k] for k in _LAYER_KEYS})
+    return dict(layers=layers, emb=w["emb"], norm=w["norm"],
+                head=_quantize_mat(w["head"]))
+
+
+def _dequantize_tree(w):
+    """Inverse of ``_quantize_tree`` inside the jit: int8 → f32 rows ×
+    scales at trace time, so XLA sees ordinary dense matmuls (and on
+    int8-capable MXUs can fuse the dequant into the gemm)."""
+    def dq(leaf):
+        if isinstance(leaf, dict):
+            return leaf["q8"].astype(leaf["scale"].dtype) * leaf["scale"]
+        return leaf
+
+    layers = []
+    for L in w["layers"]:
+        layers.append({k: dq(L[k]) for k in _LAYER_KEYS})
+    return dict(layers=layers, emb=w["emb"], norm=w["norm"],
+                head=dq(w["head"]))
+
+
+class LlamaServingEngine:
+    """Device-side half of continuous batching for a LlamaForCausalLM."""
+
+    def __init__(self, net, max_len=None, num_slots=4, int8=False):
+        import jax
+        import jax.numpy as jnp
+        from ..models.llama import LlamaDecoder
+
+        self.max_len = int(max_len or net.config.max_seq_len)
+        self.num_slots = int(num_slots)
+        self.int8 = bool(int8)
+        dec = LlamaDecoder(net, self.max_len)
+        self._dec = dec
+        w = dec._weights()
+        self._w = _quantize_tree(w) if self.int8 else w
+        deq = _dequantize_tree if self.int8 else (lambda t: t)
+        cfg = net.config
+        shape = (self.num_slots, cfg.num_kv_heads, self.max_len,
+                 cfg.head_dim)
+        dt = w["emb"].dtype
+        self._caches = [(jnp.zeros(shape, dt), jnp.zeros(shape, dt))
+                        for _ in range(cfg.num_layers)]
+        # host mirrors: last emitted token + next write position per slot
+        self._last = np.zeros(self.num_slots, np.int32)
+        self._pos = np.zeros(self.num_slots, np.int32)
+        self.steps = 0
+        self._signatures = set()
+
+        def _step_fn(wq, caches, ids, pos):
+            logits, caches = dec._step_slots_impl(deq(wq), caches, ids,
+                                                  pos)
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32), caches
+
+        def _prefill_fn(wq, ids, t0):
+            caches, logits = dec._prefill_impl(deq(wq), ids, t0)
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32), caches
+
+        def _scatter_fn(caches, rows, slots):
+            return [(kc.at[slots].set(nk), vc.at[slots].set(nv))
+                    for (kc, vc), (nk, nv) in zip(caches, rows)]
+
+        self._step = jax.jit(_step_fn, donate_argnums=(1,))
+        self._prefill = jax.jit(_prefill_fn)
+        self._scatter = jax.jit(_scatter_fn, donate_argnums=(0,))
+
+    # -- observability --------------------------------------------------------
+    def _note(self, key):
+        if key not in self._signatures:
+            self._signatures.add(key)
+            telemetry.count("serving.engine_compile")
+
+    def compiled_signatures(self):
+        """Every (program, *bucket) shape this engine has compiled."""
+        return sorted(self._signatures)
+
+    # -- transitions ----------------------------------------------------------
+    def admit(self, prompts_pad, t0s, slots):
+        """Prefill ``prompts_pad`` (kb, lp) with true lengths ``t0s``
+        (kb,) and scatter the resulting cache rows into ``slots`` (kb,)
+        — vacant padding rows carry slot index ``num_slots`` and are
+        dropped by XLA's out-of-bounds scatter rule.  Returns each
+        row's first generated token (kb,) on host."""
+        import jax.numpy as jnp
+
+        kb, lp = prompts_pad.shape
+        self._note(("prefill", kb, lp))
+        toks, rows = self._prefill(self._w, jnp.asarray(prompts_pad),
+                                   jnp.asarray(t0s, jnp.int32))
+        caches = self._caches
+        caches = self._scatter(caches, rows, jnp.asarray(slots, jnp.int32))
+        self._caches = caches
+        first = _materialize([toks])[0]
+        for i, s in enumerate(slots):
+            if s < self.num_slots:
+                self._last[s] = first[i]
+                self._pos[s] = t0s[i]
+        return first
+
+    def step(self, active):
+        """One decode step over ALL slots; returns the (num_slots,)
+        next-token vector on host and advances the ``active`` slots'
+        mirrors.  Vacant slots run at pos 0 with token 0 — their output
+        is never read and their garbage K/V write stays in their own
+        slot row."""
+        import jax.numpy as jnp
+
+        self._note(("step",))
+        caches = self._caches
+        toks, caches = self._step(self._w, caches,
+                                  jnp.asarray(self._last),
+                                  jnp.asarray(self._pos))
+        self._caches = caches
+        self.steps += 1
+        out = _materialize([toks])[0]
+        for s in active:
+            self._last[s] = out[s]
+            self._pos[s] += 1
+        return out
+
+    def clear_slot(self, slot):
+        self._last[slot] = 0
+        self._pos[slot] = 0
+
+
+class GenerativeScheduler:
+    """Admit/step/evict loop: continuous batching over the engine.
+
+    Requests carry ``prompt_ids`` + ``max_new_tokens``.  Admission
+    happens between decode steps whenever slots are free — a late
+    request joins the in-flight batch without stopping anyone else's
+    decode (its ``joined_step``/``done_step`` land in the request
+    record, which is how the tier-1 late-join test proves it).
+    """
+
+    def __init__(self, engine, queue, policy=None, summary_every=16,
+                 poll_s=0.02):
+        self.engine = engine
+        self.queue = queue
+        self.policy = policy or BucketPolicy(
+            max_batch=engine.num_slots, max_length=engine.max_len,
+            min_batch=1, min_length=8)
+        self.mgr = KVCacheManager(engine.num_slots, engine.max_len)
+        self.summary_every = int(summary_every)
+        self.poll_s = float(poll_s)
+        self.completed = 0
+        self.failed = 0
+        self.batches = 0
+        self._seqs = {}       # slot -> (request, [generated tokens])
+        self._stop = threading.Event()
+        self._thread = None
+
+    # -- lifecycle ------------------------------------------------------------
+    def start(self):
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(target=self._loop,
+                                        name="mxt-serving-decode",
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self, drain=True):
+        self._stop.set()
+        self.queue.close()
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        if drain:
+            while self._seqs or len(self.queue):
+                self._admit_pending()
+                if not self._seqs:
+                    break
+                self._decode_step()
+        for r in self.queue.take_group(lambda r: 0, 1 << 30):
+            r.future.set_exception(
+                ServerClosedError("server stopped before execution"))
+
+    # -- the loop -------------------------------------------------------------
+    def _loop(self):
+        while not self._stop.is_set():
+            admitted = self._admit_pending()
+            if self._seqs:
+                self._decode_step()
+            elif not admitted:
+                self.queue.wait_for_item(self.poll_s)
+
+    def _prompt_bucket(self, req):
+        return self.policy.length_bucket(len(req.prompt_ids))
+
+    def _admit_pending(self):
+        """Admit queued requests into free slots (one prompt-length
+        bucket group per call, the FIFO head's)."""
+        free = self.mgr.free_slots()
+        if not free or not len(self.queue):
+            return False
+        group = self.queue.take_group(
+            self._prompt_bucket, min(free, self.policy.max_batch))
+        if not group:
+            return False
+        t_start = time.perf_counter()
+        lb = self._prompt_bucket(group[0])
+        kb = self.policy.batch_bucket(len(group))
+        try:
+            prompts = pad_batch([np.asarray(r.prompt_ids, np.int32)
+                                 for r in group], kb, lb)
+            t0s = np.full(kb, len(group[0].prompt_ids), np.int32)
+            slots = np.full(kb, self.engine.num_slots, np.int32)
+            for i, r in enumerate(group):
+                t0s[i] = len(r.prompt_ids)
+                slot = self.mgr.admit(r.id, t0s[i], r.max_new_tokens,
+                                      step=self.engine.steps)
+                slots[i] = slot
+                r.slot = int(slot)
+                r.joined_step = self.engine.steps
+                r.t_start = t_start
+                r.bucket = (kb, lb)
+                r.batch_size = len(group)
+            first = self.engine.admit(prompts, t0s, slots)
+        except Exception as exc:
+            for r in group:
+                if r.slot is not None and r.slot in self.mgr._active:
+                    self.mgr.evict(r.slot)
+                r.future.set_exception(exc)
+            self.failed += len(group)
+            telemetry.count("serving.failed", len(group))
+            return False
+        t_first = time.perf_counter()
+        for i, r in enumerate(group):
+            r.t_first = t_first
+            self._seqs[r.slot] = (r, [int(first[i])])
+            if self.mgr.consume(r.slot):
+                self._finish(r.slot)
+        telemetry.count("serving.admitted", len(group))
+        return True
+
+    def _decode_step(self):
+        active = self.mgr.active_slots()
+        try:
+            toks = self.engine.step(active)
+        except Exception as exc:
+            for slot in list(active):
+                req, _ = self._seqs.pop(slot)
+                self.mgr.evict(slot)
+                self.engine.clear_slot(slot)
+                req.future.set_exception(exc)
+            self.failed += len(active)
+            telemetry.count("serving.failed", len(active))
+            return
+        self.batches += 1
+        telemetry.hist("serving.batch_size", len(active))
+        for slot in active:
+            self.mgr.advance(slot)   # the step wrote K/V at slot's pos
+            _, tokens = self._seqs[slot]
+            tokens.append(int(toks[slot]))
+            if self.mgr.consume(slot):
+                self._finish(slot)
+
+    def _finish(self, slot):
+        req, tokens = self._seqs.pop(slot)
+        self.mgr.evict(slot)
+        self.engine.clear_slot(slot)
+        req.t_done = time.perf_counter()
+        req.done_step = self.engine.steps
+        n = req.max_new_tokens
+        req.future.set_result(np.concatenate(
+            [np.asarray(req.prompt_ids, np.int32),
+             np.asarray(tokens[:n], np.int32)]))
+        self._account(req)
+
+    def _account(self, req):
+        self.completed += 1
+        telemetry.count("serving.completed")
+        rec = req.record()
+        if rec["queue_wait_ms"] is not None:
+            telemetry.hist("serving.queue_wait_ms", rec["queue_wait_ms"])
+        if rec["total_ms"] is not None:
+            telemetry.hist("serving.total_ms", rec["total_ms"])
+        if rec.get("ttft_ms") is not None:
+            telemetry.hist("serving.ttft_ms", rec["ttft_ms"])
+        telemetry.emit(rec)
+        if self.summary_every and self.completed % self.summary_every == 0:
+            self.emit_summary()
+
+    def emit_summary(self):
+        telemetry.emit({
+            "record": "serving.latency",
+            "completed": self.completed,
+            "failed": self.failed,
+            "batches": self.batches,
+            "rejected": self.queue.rejected,
+            "queue_wait_ms": telemetry.hist_summary("serving.queue_wait_ms"),
+            "total_ms": telemetry.hist_summary("serving.total_ms"),
+            "ttft_ms": telemetry.hist_summary("serving.ttft_ms"),
+            "batch_size": telemetry.hist_summary("serving.batch_size"),
+            "kv_cache": self.mgr.stats(),
+        })

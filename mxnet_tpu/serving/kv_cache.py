@@ -1,0 +1,127 @@
+"""Sliced KV-cache slot accounting for continuous-batching decode.
+
+The decode engine holds ONE static-shape cache per layer —
+``(num_slots, Hkv, max_len, head_dim)`` — compiled into a single step
+program (``LlamaDecoder._step_slots_impl``).  A "slice" is one slot row
+of that cache.  This manager is the host-side ledger deciding which
+slot each request owns and when the slot returns to the free list:
+
+* ``admit`` — claim a free slot for a request between decode steps
+  (the continuous-batching join point).  Returns None when every slot
+  is busy; the scheduler leaves the request queued.
+* ``advance`` — bump the slot's position after a decode step; reports
+  completion when the token budget is spent.
+* ``evict`` — release the slot (sequence finished or request failed);
+  the slot is immediately reusable by the next admission.
+
+Invariants (tier-1 tested): free ∪ active = all slots, free ∩ active =
+∅, a slot is never admitted twice without an evict in between, and
+positions never exceed ``max_len``.  Device-side slot contents are the
+engine's problem — admission's prefill scatter overwrites the whole
+slot row, so stale K/V from the previous tenant is unreachable.
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+
+__all__ = ["KVCacheManager", "SlotState"]
+
+
+class SlotState:
+    """One occupied slot's bookkeeping."""
+
+    __slots__ = ("request_id", "pos", "remaining", "joined_step")
+
+    def __init__(self, request_id, pos, remaining, joined_step):
+        self.request_id = request_id
+        self.pos = pos              # next cache row the step writes
+        self.remaining = remaining  # tokens still owed to the request
+        self.joined_step = joined_step
+
+
+class KVCacheManager:
+    """Fixed-capacity slot ledger (``num_slots`` concurrent sequences)."""
+
+    def __init__(self, num_slots, max_len):
+        if num_slots < 1:
+            raise MXNetError("num_slots must be >= 1")
+        self.num_slots = int(num_slots)
+        self.max_len = int(max_len)
+        self._free = list(range(self.num_slots - 1, -1, -1))  # pop() -> 0 first
+        self._active = {}           # slot -> SlotState
+        self._admits = 0
+        self._evictions = 0
+        self._peak_occupancy = 0
+
+    # -- queries --------------------------------------------------------------
+    def free_slots(self):
+        return len(self._free)
+
+    def active_slots(self):
+        """Occupied slot ids, ascending."""
+        return sorted(self._active)
+
+    def state(self, slot):
+        return self._active[slot]
+
+    def stats(self):
+        return {"admits": self._admits, "evictions": self._evictions,
+                "occupancy": len(self._active),
+                "peak_occupancy": self._peak_occupancy,
+                "num_slots": self.num_slots}
+
+    # -- transitions ----------------------------------------------------------
+    def admit(self, request_id, prompt_len, max_new_tokens, step=0):
+        """Claim a slot for a prefilled request: position starts at
+        ``prompt_len`` (the first decode write lands there).  Returns
+        the slot id, or None when the cache is at capacity."""
+        if prompt_len + max_new_tokens > self.max_len:
+            raise MXNetError(
+                f"sequence budget {prompt_len}+{max_new_tokens} exceeds "
+                f"cache max_len {self.max_len}")
+        if not self._free:
+            return None
+        slot = self._free.pop()
+        self._active[slot] = SlotState(request_id, prompt_len,
+                                       max_new_tokens, step)
+        self._admits += 1
+        self._peak_occupancy = max(self._peak_occupancy, len(self._active))
+        return slot
+
+    def advance(self, slot):
+        """One decode step wrote ``slot``'s K/V at its current position:
+        bump the write cursor.  (The prefill-produced first token never
+        advances — its K/V lands with the next step's write.)"""
+        st = self._active[slot]
+        st.pos += 1
+        if st.pos > self.max_len:
+            raise MXNetError(f"slot {slot} overran max_len {self.max_len}")
+
+    def consume(self, slot):
+        """One output token was emitted for ``slot``'s request.  Returns
+        True when the token budget is exhausted (caller evicts)."""
+        st = self._active[slot]
+        st.remaining -= 1
+        return st.remaining <= 0
+
+    def evict(self, slot):
+        """Release ``slot`` back to the free list."""
+        if slot not in self._active:
+            raise MXNetError(f"slot {slot} is not active")
+        del self._active[slot]
+        self._free.append(slot)
+        self._evictions += 1
+
+    def check(self):
+        """Assert the ledger invariants (used by tests and debug)."""
+        free = set(self._free)
+        active = set(self._active)
+        if free & active:
+            raise MXNetError(f"slots both free and active: {free & active}")
+        if free | active != set(range(self.num_slots)):
+            raise MXNetError("slot ledger lost track of slots")
+        for slot, st in self._active.items():
+            if not 0 <= st.pos <= self.max_len:
+                raise MXNetError(f"slot {slot} position {st.pos} out of "
+                                 f"range [0, {self.max_len}]")
+        return True

@@ -1,0 +1,100 @@
+"""Request/response protocol for the serving layer.
+
+Reference: the C predict API (``c_predict_api.h``, SURVEY §3.5) is a
+single-session, caller-threaded surface — one Predictor, one request at
+a time.  The serving subsystem puts a queue/scheduler in front of it,
+so the protocol objects here carry what the C API's stack frame used to
+carry implicitly: identity, timing, and a completion handle.
+
+A :class:`Request` is one unit of admitted work.  Its ``future`` (a
+``concurrent.futures.Future``) is the caller's completion handle —
+``future.result(timeout)`` in client glue is the intended wait point
+(the same contract as async-checkpoint tickets; see docs/lint.md on why
+``.result()`` is legal in eager glue but an error inside traced code).
+
+Backpressure is explicit: a full queue raises
+:class:`ServerOverloadedError` at submit time instead of buying
+unbounded latency.  Clients treat it like HTTP 503 — back off and
+retry.
+"""
+from __future__ import annotations
+
+import itertools
+import time
+from concurrent.futures import Future
+
+from ..base import MXNetError
+
+__all__ = ["Request", "ServerOverloadedError", "ServerClosedError"]
+
+
+class ServerOverloadedError(MXNetError):
+    """The bounded request queue is full: the server sheds load at
+    admission instead of queueing into unbounded latency.  Retry with
+    backoff, or raise ``queue_capacity``."""
+
+
+class ServerClosedError(MXNetError):
+    """Submit after ``stop()`` (or before ``start()``)."""
+
+
+_ids = itertools.count(1)
+
+
+class Request:
+    """One in-flight inference request.
+
+    ``inputs`` maps input name → host numpy array for ONE example —
+    the length-bucketed axis is ``length_axis`` (batch dim added by the
+    scheduler).  Generative requests carry ``prompt_ids`` (1-D int32)
+    and ``max_new_tokens`` instead.
+
+    Timing fields are filled in as the request moves through the
+    pipeline and land verbatim in the per-request telemetry record:
+    ``t_submit`` → ``t_start`` (dequeued into a batch; the delta is
+    ``queue_wait_ms``) → ``t_first`` (generative: first token emitted;
+    delta from submit is ``ttft_ms``) → ``t_done``.
+    """
+
+    __slots__ = ("id", "inputs", "length", "prompt_ids", "max_new_tokens",
+                 "future", "t_submit", "t_start", "t_first", "t_done",
+                 "batch_size", "bucket", "slot", "joined_step",
+                 "done_step")
+
+    def __init__(self, inputs=None, length=None, prompt_ids=None,
+                 max_new_tokens=None):
+        self.id = next(_ids)
+        self.inputs = inputs
+        self.length = length
+        self.prompt_ids = prompt_ids
+        self.max_new_tokens = max_new_tokens
+        self.future = Future()
+        self.t_submit = time.perf_counter()
+        self.t_start = None
+        self.t_first = None
+        self.t_done = None
+        self.batch_size = None
+        self.bucket = None
+        self.slot = None
+        self.joined_step = None
+        self.done_step = None
+
+    def record(self, kind="serving.request"):
+        """The per-request JSONL record (emitted on completion)."""
+        rec = {
+            "record": kind,
+            "request_id": self.id,
+            "bucket": self.bucket,
+            "batch_size": self.batch_size,
+            "queue_wait_ms": (self.t_start - self.t_submit) * 1e3
+            if self.t_start is not None else None,
+            "total_ms": (self.t_done - self.t_submit) * 1e3
+            if self.t_done is not None else None,
+        }
+        if self.t_first is not None:
+            rec["ttft_ms"] = (self.t_first - self.t_submit) * 1e3
+        if self.slot is not None:
+            rec["slot"] = self.slot
+            rec["joined_step"] = self.joined_step
+            rec["done_step"] = self.done_step
+        return rec

@@ -1,0 +1,264 @@
+"""Request queue and continuous-batching scheduler loop.
+
+The dataflow: client threads ``put()`` requests into a bounded
+:class:`RequestQueue` (full → :class:`ServerOverloadedError`, the
+backpressure contract); one scheduler thread repeatedly takes the
+FIFO-head-compatible group of pending requests (same length bucket, up
+to the batch-bucket ceiling), pads them into one compiled-signature
+shape (``bucketing.pad_batch``), runs the model, and demultiplexes the
+batch output back to per-request futures.
+
+Host-sync discipline: the ONE place this module blocks on device
+results is :func:`_materialize` — by design, at the batch boundary,
+after the whole batch was dispatched.  ``tools/lint`` exempts that def
+from the eager T1 warning (``MATERIALIZE_DEFS`` in tools/lint/rules.py,
+mirroring the async-checkpoint ``ticket.result()`` treatment); syncs
+added anywhere else in the serving path still get flagged.
+
+Every completed request emits a ``serving.request`` JSONL record and
+feeds the rolling latency histograms; every ``summary_every``
+completions the scheduler emits a ``serving.latency`` summary record
+with p50/p90/p99 over the recent window (telemetry.hist_summary).
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+from .. import telemetry
+from .bucketing import pad_batch
+from .protocol import ServerClosedError, ServerOverloadedError
+
+__all__ = ["RequestQueue", "BatchScheduler"]
+
+
+def _materialize(arrays):
+    """THE designated result-materialization point: batch outputs →
+    host numpy, one sync per batch after full dispatch.  Keep every
+    device->host wait in the serving path inside this function — it is
+    the serving scheduler's lint-sanctioned sync site."""
+    out = []
+    for a in arrays:
+        if hasattr(a, "asnumpy"):
+            out.append(a.asnumpy())
+        else:
+            out.append(np.asarray(a))
+    return out
+
+
+class RequestQueue:
+    """Thread-safe bounded FIFO with bucket-aware group take."""
+
+    def __init__(self, capacity=64):
+        self.capacity = int(capacity)
+        self._items = []
+        self._cond = threading.Condition()
+        self._closed = False
+        self._rejected = 0
+
+    def __len__(self):
+        with self._cond:
+            return len(self._items)
+
+    @property
+    def rejected(self):
+        return self._rejected
+
+    def put(self, req):
+        with self._cond:
+            if self._closed:
+                raise ServerClosedError("server is not accepting requests")
+            if len(self._items) >= self.capacity:
+                self._rejected += 1
+                telemetry.count("serving.rejected")
+                raise ServerOverloadedError(
+                    f"request queue full ({self.capacity} pending); "
+                    "retry with backoff")
+            self._items.append(req)
+            self._cond.notify_all()
+
+    def close(self):
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+
+    def wait_for_item(self, timeout):
+        """Block until an item is queued (True) or timeout/closed."""
+        with self._cond:
+            if not self._items:
+                self._cond.wait(timeout)
+            return bool(self._items)
+
+    def take_group(self, key_fn, max_n):
+        """Pop the FIFO head plus every queued request sharing its
+        ``key_fn`` value (the length bucket), up to ``max_n``, keeping
+        everything else in order.  Empty queue → []."""
+        with self._cond:
+            if not self._items:
+                return []
+            head_key = key_fn(self._items[0])
+            taken, rest = [], []
+            for r in self._items:
+                if len(taken) < max_n and key_fn(r) == head_key:
+                    taken.append(r)
+                else:
+                    rest.append(r)
+            self._items = rest
+            return taken
+
+
+class BatchScheduler:
+    """The dynamic-batching loop for stateless (single forward) models.
+
+    ``runner(batch_inputs)`` takes a dict name → stacked numpy array of
+    one padded bucket shape and returns the model outputs (NDArrays or
+    arrays); the server layer builds it around a Predictor or a gluon
+    block.  ``output_length_axis`` (optional) names the per-example
+    output axis to trim back to the request's true length at demux —
+    None for pooled outputs (classifiers) whose shape has no length
+    axis.
+    """
+
+    def __init__(self, runner, policy, queue, length_axis=0,
+                 output_length_axis=None, batch_window_ms=2.0,
+                 summary_every=32, poll_s=0.05):
+        self.runner = runner
+        self.policy = policy
+        self.queue = queue
+        self.length_axis = int(length_axis)
+        self.output_length_axis = output_length_axis
+        self.batch_window_s = float(batch_window_ms) * 1e-3
+        self.summary_every = int(summary_every)
+        self.poll_s = float(poll_s)
+        self.batches = 0
+        self.completed = 0
+        self.failed = 0
+        self._stop = threading.Event()
+        self._thread = None
+
+    # -- lifecycle ------------------------------------------------------------
+    def start(self):
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(target=self._loop,
+                                        name="mxt-serving-sched",
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self, drain=True):
+        """Stop the loop; with ``drain`` (default) queued requests are
+        served first, otherwise they fail with ServerClosedError."""
+        self._stop.set()
+        self.queue.close()
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        leftovers = self.queue.take_group(lambda r: 0, 1 << 30)
+        if drain and leftovers:
+            for group in self._regroup(leftovers):
+                self._serve_batch(group)
+        else:
+            for r in leftovers:
+                r.future.set_exception(
+                    ServerClosedError("server stopped before execution"))
+
+    def _regroup(self, reqs):
+        groups = {}
+        for r in reqs:
+            groups.setdefault(self._bucket_key(r), []).append(r)
+        return [g[i:i + self.policy.max_batch]
+                for g in groups.values()
+                for i in range(0, len(g), self.policy.max_batch)]
+
+    # -- the loop -------------------------------------------------------------
+    def _bucket_key(self, req):
+        return self.policy.length_bucket(req.length)
+
+    def _loop(self):
+        while not self._stop.is_set():
+            if not self.queue.wait_for_item(self.poll_s):
+                continue
+            if self.batch_window_s > 0:
+                # dwell briefly so concurrent submitters land in ONE
+                # batch instead of head-of-line singletons
+                time.sleep(self.batch_window_s)
+            group = self.queue.take_group(self._bucket_key,
+                                          self.policy.max_batch)
+            if group:
+                self._serve_batch(group)
+
+    def _serve_batch(self, group):
+        t_start = time.perf_counter()
+        lb = self._bucket_key(group[0])
+        bb = self.policy.batch_bucket(len(group))
+        for r in group:
+            r.t_start = t_start
+            r.bucket = (bb, lb)
+            r.batch_size = len(group)
+        try:
+            names = list(group[0].inputs)
+            batch = {
+                name: pad_batch([r.inputs[name] for r in group], bb, lb,
+                                axis=self.length_axis)
+                for name in names}
+            with telemetry.span("serving.batch",
+                                {"batch": bb, "length": lb}):
+                outs = self.runner(batch)
+            outs = _materialize(outs if isinstance(outs, (list, tuple))
+                                else [outs])
+        except Exception as exc:
+            self.failed += len(group)
+            telemetry.count("serving.failed", len(group))
+            for r in group:
+                r.future.set_exception(exc)
+            return
+        self.batches += 1
+        t_done = time.perf_counter()
+        telemetry.count("serving.batches")
+        telemetry.hist("serving.batch_size", len(group))
+        for i, r in enumerate(group):
+            r.t_done = t_done
+            r.future.set_result(self._demux(outs, i, r.length))
+            self._account(r)
+
+    def _demux(self, outs, i, length):
+        picked = []
+        for o in outs:
+            row = o[i]
+            if self.output_length_axis is not None:
+                row = np.take(row, np.arange(length),
+                              axis=self.output_length_axis)
+            picked.append(row)
+        return picked if len(picked) > 1 else picked[0]
+
+    def _account(self, req):
+        """Per-request telemetry: histograms + JSONL record + rolling
+        summary every ``summary_every`` completions."""
+        self.completed += 1
+        telemetry.count("serving.completed")
+        rec = req.record()
+        if rec["queue_wait_ms"] is not None:
+            telemetry.hist("serving.queue_wait_ms", rec["queue_wait_ms"])
+        if rec["total_ms"] is not None:
+            telemetry.hist("serving.total_ms", rec["total_ms"])
+        if rec.get("ttft_ms") is not None:
+            telemetry.hist("serving.ttft_ms", rec["ttft_ms"])
+        telemetry.emit(rec)
+        if self.summary_every and self.completed % self.summary_every == 0:
+            self.emit_summary()
+
+    def emit_summary(self):
+        """Emit the rolling ``serving.latency`` percentile record."""
+        telemetry.emit({
+            "record": "serving.latency",
+            "completed": self.completed,
+            "failed": self.failed,
+            "batches": self.batches,
+            "rejected": self.queue.rejected,
+            "queue_wait_ms": telemetry.hist_summary("serving.queue_wait_ms"),
+            "total_ms": telemetry.hist_summary("serving.total_ms"),
+            "ttft_ms": telemetry.hist_summary("serving.ttft_ms"),
+            "batch_size": telemetry.hist_summary("serving.batch_size"),
+        })

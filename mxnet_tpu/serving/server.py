@@ -1,0 +1,235 @@
+"""The user-facing serving surface: configs, servers, lifecycle.
+
+Two server classes over one contract (bounded queue → scheduler thread
+→ per-request futures, docs/serving.md):
+
+* :class:`InferenceServer` — stateless models (one forward per
+  request): a ``Predictor`` (the MXPredCreate surface), a hybridized
+  gluon block (e.g. BERT), or any callable.  Dynamic batching with
+  power-of-two batch/length buckets.
+* :class:`GenerativeServer` — ``LlamaForCausalLM`` decode with the
+  sliced KV cache: requests join and leave the in-flight decode batch
+  between steps (continuous batching).
+
+``ServerConfig(int8=True)`` applies weight quantization at load time:
+gluon blocks go through ``contrib.quantization.quantize_net`` (needs
+``calib_data``); the llama engine uses weight-only per-channel int8.
+
+Synchronous convenience: ``server.infer(...)`` / ``server.generate(...)``
+submit and wait (the future's ``result()`` is the sanctioned eager wait,
+same contract as async-checkpoint tickets).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import telemetry
+from ..base import MXNetError
+from .bucketing import BucketPolicy
+from .protocol import Request, ServerClosedError
+from .scheduler import BatchScheduler, RequestQueue
+
+__all__ = ["ServerConfig", "InferenceServer", "GenerativeServer"]
+
+
+class ServerConfig:
+    """Knobs shared by both servers (defaults are test-scale).
+
+    ``max_batch``/``max_length`` bound the bucket grid — the compiled-
+    signature ceiling is ``len(batch_buckets) × len(length_buckets)``.
+    ``queue_capacity`` bounds admission (beyond it, submit raises
+    ``ServerOverloadedError``).  ``length_axis`` names the bucketed
+    axis of each request's input arrays; ``output_length_axis`` (may be
+    None) the per-example output axis to trim back at demux.
+    ``num_slots`` (generative) is the KV-cache capacity = max
+    concurrent sequences; ``int8`` switches on load-time weight
+    quantization."""
+
+    def __init__(self, max_batch=8, max_length=128, min_batch=1,
+                 min_length=8, queue_capacity=64, batch_window_ms=2.0,
+                 summary_every=32, length_axis=0, output_length_axis=None,
+                 num_slots=4, max_new_tokens=32, int8=False,
+                 calib_data=None):
+        self.policy = BucketPolicy(max_batch=max_batch,
+                                   max_length=max_length,
+                                   min_batch=min_batch,
+                                   min_length=min_length)
+        self.queue_capacity = int(queue_capacity)
+        self.batch_window_ms = float(batch_window_ms)
+        self.summary_every = int(summary_every)
+        self.length_axis = int(length_axis)
+        self.output_length_axis = output_length_axis
+        self.num_slots = int(num_slots)
+        self.max_new_tokens = int(max_new_tokens)
+        self.int8 = bool(int8)
+        self.calib_data = calib_data
+
+
+class _ServerBase:
+    """start/stop/context-manager scaffolding shared by both servers."""
+
+    def __init__(self, config):
+        self.config = config or ServerConfig()
+        self.queue = RequestQueue(self.config.queue_capacity)
+        self._running = False
+
+    def start(self):
+        self._sched.start()
+        self._running = True
+        return self
+
+    def stop(self, drain=True):
+        """Graceful by default: queued work is served before exit."""
+        if not self._running:
+            return
+        self._running = False
+        self._sched.stop(drain=drain)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    def _submit(self, req):
+        if not self._running:
+            raise ServerClosedError("server is not running; call start()")
+        self.queue.put(req)
+        return req.future
+
+
+class InferenceServer(_ServerBase):
+    """Dynamic-batching server for stateless models.
+
+    ``model`` may be a ``Predictor``, a gluon block, or a callable
+    taking a dict of stacked numpy arrays and returning outputs.
+    ``input_names`` orders multi-input models (defaults to the
+    Predictor's own input names, or ``["data"]``).
+    """
+
+    def __init__(self, model, config=None, input_names=None):
+        super().__init__(config)
+        self.model = model
+        self._predictor = model if hasattr(model, "forward") and \
+            hasattr(model, "input_names") else None
+        if input_names is None:
+            input_names = self._predictor.input_names \
+                if self._predictor is not None else ["data"]
+        self.input_names = list(input_names)
+        if self.config.int8 and self._predictor is None and \
+                hasattr(model, "collect_params"):
+            from ..contrib.quantization import quantize_net
+
+            if self.config.calib_data is None:
+                raise MXNetError(
+                    "int8 block serving needs config.calib_data for "
+                    "calibration")
+            self.model = quantize_net(model,
+                                      calib_data=self.config.calib_data,
+                                      calib_mode="naive")
+        self._sched = BatchScheduler(
+            self._run_batch, self.config.policy, self.queue,
+            length_axis=self.config.length_axis,
+            output_length_axis=self.config.output_length_axis,
+            batch_window_ms=self.config.batch_window_ms,
+            summary_every=self.config.summary_every)
+
+    def _run_batch(self, batch):
+        """One padded bucket through the model (scheduler thread)."""
+        from .. import ndarray as nd
+
+        if self._predictor is not None:
+            return self._predictor.forward(**batch)
+        if callable(self.model) and not hasattr(self.model,
+                                                "collect_params"):
+            return self.model(batch)
+        args = [nd.array(batch[n]) for n in self.input_names]
+        out = self.model(*args)
+        return out if isinstance(out, (list, tuple)) else [out]
+
+    # -- client surface -------------------------------------------------------
+    def submit(self, inputs, length=None):
+        """Async: one example's inputs (array, or dict name → array) →
+        a Future resolving to the demuxed output(s).  ``length`` is the
+        true size of the bucketed axis (defaults to the first input's
+        ``length_axis`` extent)."""
+        if not isinstance(inputs, dict):
+            inputs = {self.input_names[0]: inputs}
+        inputs = {k: np.asarray(v) for k, v in inputs.items()}
+        if length is None:
+            length = inputs[self.input_names[0]] \
+                .shape[self.config.length_axis]
+        req = Request(inputs=inputs, length=int(length))
+        return self._submit(req)
+
+    def infer(self, inputs, length=None, timeout=60.0):
+        """Sync: submit + wait."""
+        return self.submit(inputs, length=length).result(timeout)
+
+    def stats(self):
+        """Server + compile-cache counters (the bucketing-policy
+        verification surface)."""
+        out = {
+            "completed": self._sched.completed,
+            "failed": self._sched.failed,
+            "batches": self._sched.batches,
+            "rejected": self.queue.rejected,
+            "pending": len(self.queue),
+            "signature_ceiling": len(self.config.policy.signatures()),
+        }
+        if self._predictor is not None:
+            out["cache"] = self._predictor.cache_stats()
+        elif hasattr(self.model, "_cached_op") and \
+                self.model._cached_op is not None:
+            out["cache"] = self.model._cached_op.cache_stats()
+        return out
+
+
+class GenerativeServer(_ServerBase):
+    """Continuous-batching decode server for ``LlamaForCausalLM``."""
+
+    def __init__(self, net, config=None):
+        super().__init__(config)
+        from .generative import GenerativeScheduler, LlamaServingEngine
+
+        self.engine = LlamaServingEngine(
+            net, max_len=self.config.policy.max_length,
+            num_slots=self.config.num_slots, int8=self.config.int8)
+        self._sched = GenerativeScheduler(
+            self.engine, self.queue, policy=self.config.policy,
+            summary_every=self.config.summary_every)
+
+    # -- client surface -------------------------------------------------------
+    def submit(self, prompt_ids, max_new_tokens=None):
+        """Async: 1-D prompt token ids → Future resolving to the full
+        sequence (prompt + generated), greedy decode."""
+        prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
+        n = int(max_new_tokens or self.config.max_new_tokens)
+        if n < 1:
+            raise MXNetError("max_new_tokens must be >= 1")
+        if len(prompt) + n > self.engine.max_len:
+            raise MXNetError(
+                f"prompt {len(prompt)} + {n} new tokens exceeds the "
+                f"engine's max_len {self.engine.max_len}")
+        req = Request(prompt_ids=prompt, max_new_tokens=n)
+        req.length = len(prompt)
+        return self._submit(req)
+
+    def generate(self, prompt_ids, max_new_tokens=None, timeout=120.0):
+        """Sync: submit + wait for the full sequence."""
+        return self.submit(prompt_ids, max_new_tokens).result(timeout)
+
+    def stats(self):
+        out = {
+            "completed": self._sched.completed,
+            "failed": self._sched.failed,
+            "decode_steps": self.engine.steps,
+            "rejected": self.queue.rejected,
+            "pending": len(self.queue),
+            "kv_cache": self._sched.mgr.stats(),
+            "compiled_signatures": self.engine.compiled_signatures(),
+        }
+        telemetry.gauge("serving.kv_occupancy",
+                        self._sched.mgr.stats()["occupancy"])
+        return out

@@ -66,6 +66,7 @@ from . import costs    # noqa: F401  (compiled-cost registry submodule)
 from . import memwatch  # noqa: F401  (live-buffer ledger submodule)
 
 __all__ = ["enable", "disable", "is_enabled", "span", "count", "gauge",
+           "hist", "hist_summary", "hists", "emit",
            "step", "step_begin", "step_end", "counters", "gauges",
            "phases", "reset", "current_span", "JsonlSink", "read_jsonl",
            "costs", "memwatch"]
@@ -79,6 +80,7 @@ _enabled = False
 _lock = threading.Lock()
 _counters = {}        # cumulative: name -> number
 _gauges = {}          # last-value: name -> number
+_hists = {}           # rolling reservoir: name -> _Reservoir
 _step_counters = {}   # deltas since step_begin
 _step_phases = {}     # span name -> accumulated seconds since step_begin
 _step_idx = 0
@@ -196,6 +198,106 @@ def gauge(name, value):
         return
     with _lock:
         _gauges[name] = value
+
+
+# -- rolling histograms ------------------------------------------------------
+
+#: default reservoir capacity — large enough for a stable p99 over the
+#: recent window, small enough that a hot serving loop never notices
+HIST_CAPACITY = 1024
+
+
+class _Reservoir:
+    """Bounded ring buffer over the most recent ``cap`` observations.
+
+    A sliding window (not a probabilistic sample): serving latency
+    summaries must reflect *recent* load, and a deterministic window
+    keeps the tier-1 assertions exact.  ``total``/``count`` track the
+    all-time stream so throughput math survives the window rolling."""
+
+    __slots__ = ("cap", "values", "idx", "count", "total", "vmin", "vmax")
+
+    def __init__(self, cap):
+        self.cap = int(cap)
+        self.values = []
+        self.idx = 0
+        self.count = 0
+        self.total = 0.0
+        self.vmin = None
+        self.vmax = None
+
+    def add(self, v):
+        v = float(v)
+        if len(self.values) < self.cap:
+            self.values.append(v)
+        else:
+            self.values[self.idx] = v
+            self.idx = (self.idx + 1) % self.cap
+        self.count += 1
+        self.total += v
+        self.vmin = v if self.vmin is None else min(self.vmin, v)
+        self.vmax = v if self.vmax is None else max(self.vmax, v)
+
+    def summary(self, percentiles):
+        vals = sorted(self.values)
+        n = len(vals)
+        if not n:
+            return None
+        out = {
+            "count": self.count,
+            "window": n,
+            "mean": self.total / self.count,
+            "min": self.vmin,
+            "max": self.vmax,
+        }
+        for p in percentiles:
+            # nearest-rank on the sorted window: exact, no interpolation
+            rank = max(0, min(n - 1, -(-int(p) * n // 100) - 1))
+            out[f"p{int(p)}"] = vals[rank]
+        return out
+
+
+def hist(name, value, cap=HIST_CAPACITY):
+    """Record one observation into rolling histogram ``name`` (e.g. a
+    per-request latency in ms).  Keeps only the most recent ``cap``
+    values; summarize with :func:`hist_summary`."""
+    if not _enabled:
+        return
+    with _lock:
+        r = _hists.get(name)
+        if r is None:
+            r = _hists[name] = _Reservoir(cap)
+        r.add(value)
+
+
+def hist_summary(name, percentiles=(50, 90, 99)):
+    """Percentile summary of histogram ``name`` over its rolling window:
+    ``{count, window, mean, min, max, p50, p90, p99}`` (None when the
+    histogram has no observations)."""
+    with _lock:
+        r = _hists.get(name)
+        return r.summary(percentiles) if r is not None else None
+
+
+def hists(percentiles=(50, 90, 99)):
+    """Summaries of every live histogram, name -> summary dict."""
+    with _lock:
+        names = list(_hists)
+    return {n: hist_summary(n, percentiles) for n in names}
+
+
+def emit(record):
+    """Write one arbitrary structured record to every attached sink —
+    the escape hatch for subsystems whose records are not step-shaped
+    (serving emits per-request and rolling ``serving.latency`` records
+    through this).  Returns the record (None while disabled)."""
+    if not _enabled:
+        return None
+    with _lock:
+        sinks = list(_sinks)
+    for s in sinks:
+        s.emit(record)
+    return record
 
 
 def counters():
@@ -386,6 +488,7 @@ def _reset_locked():
     global _step_idx, _step_t0, _step_wall
     _counters.clear()
     _gauges.clear()
+    _hists.clear()
     _step_counters.clear()
     _step_phases.clear()
     _step_idx = 0

@@ -136,7 +136,7 @@ def _analyze(kind, key, jfn, args):
     return art
 
 
-def note(kind, key, jfn, args):
+def note(kind, key, jfn, args, attribute=True):
     """Register-or-attribute one execution of a compiled artifact.
 
     ``key`` must be the site's own cache-signature (hashable); ``jfn``
@@ -144,8 +144,12 @@ def note(kind, key, jfn, args):
     arguments (used for avals only — values are never read, so donated
     buffers are safe).  First sighting analyzes; replays attribute the
     stored flops/bytes to the current telemetry step without
-    re-analysis.  Returns the registry entry (None when disabled or the
-    key is unhashable)."""
+    re-analysis.  ``attribute=False`` registers the artifact in the
+    registry without counting an execution or attributing flops — for
+    wrapper sites (e.g. the Predictor) whose inner compile site already
+    attributes per-execution, so dump()/top_artifacts() see the wrapper
+    kind but model_flops is not double-counted.  Returns the registry
+    entry (None when disabled or the key is unhashable)."""
     if not _enabled:
         return None
     rk = (kind, key)
@@ -168,6 +172,8 @@ def note(kind, key, jfn, args):
     else:
         with _lock:
             _stats["hits"] += 1
+    if not attribute:
+        return art
     with _lock:
         art.executions += 1
     from mxnet_tpu import telemetry as _t

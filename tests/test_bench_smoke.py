@@ -126,6 +126,38 @@ def test_dryrun_multichip_inprocess_smoke(monkeypatch, capfd):
     assert "dryrun_multichip(2)" in out and "OK" in out, out
 
 
+def test_serving_latency_bench_emits_artifact(tmp_path):
+    """benchmark/serving_latency.py at toy load must produce the
+    SERVING_LATENCY artifact with both lanes, percentile blocks, and a
+    passing signature-ceiling acceptance — a silent break loses the
+    round-8 serving numbers."""
+    out = tmp_path / "serving_latency.json"
+    env = dict(os.environ)
+    env.update(JAX_PLATFORMS="cpu", BENCH_SERVING_REQUESTS="8",
+               BENCH_SERVING_CLIENTS="2", BENCH_SERVING_RATE="500",
+               BENCH_SERVING_MAX_BATCH="4", BENCH_SERVING_MAX_LEN="16",
+               MXT_SERVING_LATENCY_OUT=str(out))
+    env.pop("XLA_FLAGS", None)
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "benchmark",
+                                      "serving_latency.py")],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=600)
+    assert r.returncode == 0, r.stderr[-2000:]
+    rec = json.loads(out.read_text())
+    assert rec["metric"] == "serving_open_loop_p99_ms"
+    assert rec["value"] > 0
+    for lane in ("closed_loop", "open_loop"):
+        ln = rec["lanes"][lane]
+        assert ln["completed"] == 8
+        assert ln["total_ms"]["p50"] <= ln["total_ms"]["p99"]
+        assert ln["queue_wait_ms"]["p99"] is not None
+        assert ln["throughput_req_per_s"] > 0
+        assert sum(ln["batch_size_dist"].values()) == 8
+        assert 1 <= ln["cache"]["signatures"] <= \
+            rec["bucket_config"]["signature_ceiling"]
+    assert rec["acceptance"]["signatures_within_ceiling"]
+
+
 def test_telemetry_disabled_step_overhead():
     """Telemetry instrumentation rides the trainer/CachedOp/kvstore hot
     path; disabled it must be within noise of the seed path.  Compare

@@ -73,6 +73,21 @@ def test_t1_async_materialization_points_are_sync_sites():
     assert not any(v.context == "eager_ticket_join" for v in vs)
 
 
+def test_t1_serving_materialize_def_is_exempt():
+    vs = _rule(_analyze("t1_serving.py"), "T1")
+    # the designated materialization def carries no eager warning
+    assert not any(v.context == "_materialize" for v in vs)
+    assert not any(v.context == "scheduler_demux" for v in vs)
+    # the same sync outside the designated def still warns
+    assert any(v.severity == "warning" and v.context == "leaky_sync"
+               and "asnumpy" in v.message for v in vs)
+    # and inside a traced region it is an error, exemption or not
+    assert any(v.severity == "error"
+               and v.context == "bad_traced_materialize" for v in vs)
+    assert any(v.severity == "error"
+               and v.context == "_hot_materialize" for v in vs)
+
+
 def test_t2_flags_control_flow_on_traced_values():
     vs = _rule(_analyze("t2_control_flow.py"), "T2")
     kinds = {(v.context, v.message.split("`")[1]) for v in vs}

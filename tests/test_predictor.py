@@ -113,3 +113,49 @@ def test_input_validation(tmp_path):
         pred.set_input("nope", x)
     with pytest.raises(mx.MXNetError):
         pred.forward()  # nothing staged
+
+
+def test_cache_stats_and_compile_registration(tmp_path):
+    """Per-signature compile cache stats: misses only on new
+    (batch, length) signatures, hits on replays, and the telemetry
+    counters/cost registry see each compile exactly once."""
+    import mxnet_tpu.symbol as sym
+    from mxnet_tpu import serialization, telemetry
+    from mxnet_tpu.telemetry import costs
+
+    data = sym.Variable("data")
+    w = sym.Variable("fc_weight")
+    b = sym.Variable("fc_bias")
+    out = sym.FullyConnected(data, w, b, num_hidden=4, flatten=False,
+                             name="fc")
+    rs = np.random.RandomState(2)
+    prefix = str(tmp_path / "stats")
+    out.save(f"{prefix}-symbol.json")
+    serialization.save_ndarrays(f"{prefix}-0000.params", {
+        "arg:fc_weight": nd.array(rs.randn(4, 6).astype(np.float32)),
+        "arg:fc_bias": nd.array(rs.randn(4).astype(np.float32))})
+    pred = Predictor(f"{prefix}-symbol.json", f"{prefix}-0000.params")
+    assert pred.cache_stats() == {"hits": 0, "misses": 0, "signatures": 0}
+
+    telemetry.enable(memory=False)
+    try:
+        pred.predict(rs.randn(2, 6).astype(np.float32))      # miss
+        pred.predict(rs.randn(2, 6).astype(np.float32))      # hit
+        pred.predict(rs.randn(8, 6).astype(np.float32))      # miss
+        pred.predict(rs.randn(2, 3, 6).astype(np.float32))   # miss
+        pred.predict(rs.randn(8, 6).astype(np.float32))      # hit
+        st = pred.cache_stats()
+        assert st["signatures"] == 3
+        assert st["misses"] == 3
+        assert st["hits"] == 2
+        c = telemetry.counters()
+        assert c["predictor.compile"] == 3
+        assert c["predictor.cache_hit"] == 2
+        # each signature registered with the cost registry once, and
+        # WITHOUT per-execution attribution (the CachedOp inside is the
+        # single source of truth for executed flops)
+        ent = [e for e in costs.snapshot() if e["kind"] == "predictor"]
+        assert len(ent) == 3
+        assert all(e["executions"] == 0 for e in ent)
+    finally:
+        telemetry.disable()

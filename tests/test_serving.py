@@ -1,0 +1,341 @@
+"""Serving subsystem: bucketing math, KV-slot invariants, backpressure,
+and the continuous-batching acceptance paths (multi-client bit-identity
+under <=4 compiled signatures; a late generative request joining an
+in-flight decode batch)."""
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, serialization, serving, telemetry
+from mxnet_tpu.predictor import Predictor
+from mxnet_tpu.serving import (BucketPolicy, KVCacheManager, RequestQueue,
+                               ServerConfig, ServerOverloadedError,
+                               pad_batch, pow2_bucket)
+from mxnet_tpu.serving.protocol import Request, ServerClosedError
+from mxnet_tpu.telemetry.sinks import ListSink
+
+
+# --- bucketing math ----------------------------------------------------------
+
+def test_pow2_bucket_selection():
+    assert pow2_bucket(1, 1, 64) == 1
+    assert pow2_bucket(3, 1, 64) == 4
+    assert pow2_bucket(4, 1, 64) == 4
+    assert pow2_bucket(5, 1, 64) == 8
+    assert pow2_bucket(33, 1, 64) == 64
+    assert pow2_bucket(2, 8, 64) == 8      # clamped to the floor
+    with pytest.raises(mx.MXNetError):
+        pow2_bucket(65, 1, 64)             # over the ceiling rejects
+
+
+def test_bucket_policy_signature_space():
+    p = BucketPolicy(max_batch=4, max_length=64, min_batch=1, min_length=8)
+    assert p.batch_buckets() == [1, 2, 4]
+    assert p.length_buckets() == [8, 16, 32, 64]
+    assert len(p.signatures()) == 12
+    assert p.batch_bucket(3) == 4
+    assert p.length_bucket(17) == 32
+    # every bucketed shape is a member of the enumerated space
+    for n in range(1, 5):
+        for l in range(1, 65):
+            assert (p.batch_bucket(n), p.length_bucket(l)) \
+                in p.signatures()
+
+
+def test_pad_batch_shapes_and_errors():
+    exs = [np.ones((3, 5)), 2 * np.ones((7, 5))]
+    b = pad_batch(exs, 4, 8)
+    assert b.shape == (4, 8, 5)
+    assert np.array_equal(b[0, :3], exs[0])
+    assert np.array_equal(b[1, :7], exs[1])
+    assert (b[0, 3:] == 0).all()           # length padding is zeros
+    assert np.array_equal(b[2], b[0])      # vacant rows repeat row 0
+    with pytest.raises(mx.MXNetError):
+        pad_batch(exs, 1, 8)               # too many examples
+    with pytest.raises(mx.MXNetError):
+        pad_batch(exs, 4, 4)               # length over bucket
+    with pytest.raises(mx.MXNetError):
+        pad_batch([], 4, 8)
+
+
+# --- a shape-polymorphic position-wise model for bit-identity tests ----------
+
+def _positionwise_predictor(tmp_path, in_dim=6, hidden=5):
+    """nnvm FullyConnected(flatten=False) chain: every (batch, length)
+    row is an independent gemm row, so padded forwards are bit-identical
+    to unpadded ones on the real rows."""
+    import mxnet_tpu.symbol as sym
+
+    data = sym.Variable("data")
+    w = sym.Variable("fc_weight")
+    b = sym.Variable("fc_bias")
+    out = sym.FullyConnected(data, w, b, num_hidden=hidden, flatten=False,
+                             name="fc")
+    out = sym.Activation(out, act_type="relu")
+    rs = np.random.RandomState(7)
+    wv = rs.randn(hidden, in_dim).astype(np.float32)
+    bv = rs.randn(hidden).astype(np.float32)
+    prefix = str(tmp_path / "posw")
+    out.save(f"{prefix}-symbol.json")
+    serialization.save_ndarrays(f"{prefix}-0000.params", {
+        "arg:fc_weight": nd.array(wv), "arg:fc_bias": nd.array(bv)})
+    pred = Predictor(f"{prefix}-symbol.json", f"{prefix}-0000.params")
+    oracle = lambda x: np.maximum(x @ wv.T + bv, 0.0)  # noqa: E731
+    return pred, oracle
+
+
+def test_padding_bit_identity_vs_unpadded_oracle(tmp_path):
+    """The demuxed rows of a padded, bucketed batch forward are
+    BIT-identical to each request's own unbatched forward."""
+    pred, _ = _positionwise_predictor(tmp_path)
+    rs = np.random.RandomState(3)
+    exs = [rs.randn(l, 6).astype(np.float32) for l in (3, 7, 5)]
+    batch = pad_batch(exs, 4, 8)
+    padded = pred.predict(batch).asnumpy()
+    for i, x in enumerate(exs):
+        solo = pred.predict(x[None]).asnumpy()[0]
+        assert np.array_equal(padded[i, :len(x)], solo)
+
+
+# --- KV cache slot ledger ----------------------------------------------------
+
+def test_kv_cache_admit_evict_invariants():
+    m = KVCacheManager(3, 32)
+    s = [m.admit(i, 4, 8) for i in range(3)]
+    assert sorted(s) == [0, 1, 2]
+    assert m.admit(9, 4, 8) is None        # at capacity: admission defers
+    assert m.free_slots() == 0
+    m.check()
+    m.advance(s[0])
+    assert m.state(s[0]).pos == 5
+    assert not m.consume(s[0])
+    for _ in range(7):
+        done = m.consume(s[0])
+    assert done                             # budget of 8 spent
+    m.evict(s[0])
+    m.check()
+    assert m.free_slots() == 1
+    with pytest.raises(mx.MXNetError):
+        m.evict(s[0])                       # double evict
+    with pytest.raises(mx.MXNetError):
+        m.admit(9, 30, 8)                   # 30+8 > max_len 32
+
+
+def test_kv_cache_slot_reuse():
+    m = KVCacheManager(2, 64)
+    a = m.admit(1, 4, 4)
+    b = m.admit(2, 4, 4)
+    m.evict(a)
+    c = m.admit(3, 8, 4)
+    assert c == a                           # freed slot is reused
+    assert m.state(c).request_id == 3
+    assert m.state(c).pos == 8              # fresh position, no leakage
+    m.evict(b)
+    m.evict(c)
+    m.check()
+    st = m.stats()
+    assert st["admits"] == 3 and st["evictions"] == 3
+    assert st["peak_occupancy"] == 2 and st["occupancy"] == 0
+
+
+# --- backpressure ------------------------------------------------------------
+
+def test_bounded_queue_backpressure():
+    q = RequestQueue(capacity=2)
+    q.put(Request(inputs={}, length=1))
+    q.put(Request(inputs={}, length=1))
+    with pytest.raises(ServerOverloadedError):
+        q.put(Request(inputs={}, length=1))
+    assert q.rejected == 1
+    q.close()
+    with pytest.raises(ServerClosedError):
+        q.put(Request(inputs={}, length=1))
+
+
+def test_submit_requires_running_server(tmp_path):
+    pred, _ = _positionwise_predictor(tmp_path)
+    srv = serving.InferenceServer(pred, ServerConfig(max_batch=2))
+    with pytest.raises(ServerClosedError):
+        srv.submit(np.zeros((4, 6), np.float32))
+
+
+# --- telemetry rolling histograms -------------------------------------------
+
+def test_telemetry_rolling_histogram():
+    telemetry.enable(memory=False, cost=False)
+    try:
+        for v in range(1, 101):
+            telemetry.hist("t.lat", float(v), cap=10)
+        s = telemetry.hist_summary("t.lat")
+        # window keeps only the last 10 of 100 observations
+        assert s["count"] == 100 and s["window"] == 10
+        assert s["p50"] == 95.0 and s["p99"] == 100.0
+        assert s["min"] == 1.0 and s["max"] == 100.0
+        assert "t.lat" in telemetry.hists()
+        assert telemetry.hist_summary("absent") is None
+    finally:
+        telemetry.disable()
+    # disabled -> no-op, no state
+    telemetry.hist("t.off", 1.0)
+    assert telemetry.hist_summary("t.off") is None
+
+
+def test_telemetry_emit_to_sinks():
+    telemetry.enable(memory=False, cost=False)
+    sink = ListSink()
+    telemetry.add_sink(sink)
+    try:
+        rec = telemetry.emit({"record": "x", "v": 1})
+        assert rec == {"record": "x", "v": 1}
+        assert sink.records == [rec]
+    finally:
+        telemetry.disable()
+    assert telemetry.emit({"record": "y"}) is None
+
+
+# --- the acceptance paths ----------------------------------------------------
+
+def test_multi_client_continuous_batching_end_to_end(tmp_path):
+    """Concurrent mixed-length clients; <=4 compiled signatures
+    (predictor cache stats), bit-identical results, per-request JSONL
+    records and a rolling serving.latency summary."""
+    pred, oracle = _positionwise_predictor(tmp_path)
+    telemetry.enable(memory=False, cost=False)
+    sink = ListSink()
+    telemetry.add_sink(sink)
+    cfg = ServerConfig(max_batch=4, max_length=16, min_batch=2,
+                       min_length=8, output_length_axis=0,
+                       batch_window_ms=10.0, summary_every=4)
+    srv = serving.InferenceServer(pred, cfg)
+    rs = np.random.RandomState(11)
+    lengths = [3, 5, 9, 7, 12, 4, 8, 15, 2, 6, 11, 16]
+    inputs = [rs.randn(l, 6).astype(np.float32) for l in lengths]
+    results = [None] * len(inputs)
+
+    def client(i):
+        results[i] = srv.infer(inputs[i], timeout=60.0)
+
+    try:
+        with srv:
+            threads = [threading.Thread(target=client, args=(i,))
+                       for i in range(len(inputs))]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        stats = srv.stats()
+    finally:
+        telemetry.disable()
+
+    # bit-identity: every demuxed result equals the unbatched oracle
+    for x, got in zip(inputs, results):
+        assert got.shape == (len(x), 5)
+        assert np.array_equal(got, oracle(x))
+    # bucketing held: two length buckets x two batch buckets at most
+    assert 1 <= stats["cache"]["signatures"] <= 4
+    assert stats["cache"]["misses"] == stats["cache"]["signatures"]
+    assert stats["completed"] == len(inputs)
+    # dynamic batching actually batched (not all head-of-line singletons)
+    assert stats["batches"] < len(inputs)
+    # JSONL stream: per-request records with the span fields
+    recs = [r for r in sink.records if r.get("record") == "serving.request"]
+    assert len(recs) == len(inputs)
+    for r in recs:
+        assert r["queue_wait_ms"] >= 0.0
+        assert r["total_ms"] > 0.0
+        assert r["batch_size"] >= 1
+        assert tuple(r["bucket"]) in {(b, l) for b, l
+                                      in cfg.policy.signatures()}
+    assert any(r["batch_size"] > 1 for r in recs)
+    # rolling latency summary landed with percentiles
+    sums = [r for r in sink.records if r.get("record") == "serving.latency"]
+    assert sums
+    last = sums[-1]
+    assert last["total_ms"]["p50"] <= last["total_ms"]["p99"]
+    assert last["batch_size"]["max"] > 1
+
+
+def test_generative_late_join_and_parity():
+    """A late request joins the in-flight decode batch (continuous
+    batching) and both results match the offline generate() oracle
+    token for token."""
+    from mxnet_tpu.models.llama import llama_tiny
+
+    net = llama_tiny()
+    net.initialize()
+    telemetry.enable(memory=False, cost=False)
+    sink = ListSink()
+    telemetry.add_sink(sink)
+    rs = np.random.RandomState(0)
+    p1 = rs.randint(1, 250, size=5)
+    p2 = rs.randint(1, 250, size=9)
+    cfg = ServerConfig(max_batch=2, max_length=64, min_length=8,
+                       num_slots=2, summary_every=2)
+    srv = serving.GenerativeServer(net, cfg)
+    try:
+        with srv:
+            f1 = srv.submit(p1, max_new_tokens=40)
+            # wait until request 1 is actually decoding, then join late
+            deadline = time.time() + 60
+            while srv.engine.steps < 2 and time.time() < deadline:
+                time.sleep(0.01)
+            assert srv.engine.steps >= 2
+            f2 = srv.submit(p2, max_new_tokens=4)
+            r1 = f1.result(120)
+            r2 = f2.result(120)
+        stats = srv.stats()
+    finally:
+        telemetry.disable()
+
+    o1 = net.generate(nd.array(p1[None]), 40).asnumpy()[0]
+    o2 = net.generate(nd.array(p2[None]), 4).asnumpy()[0]
+    assert np.array_equal(r1, o1)
+    assert np.array_equal(r2, o2)
+
+    recs = {r["request_id"]: r for r in sink.records
+            if r.get("record") == "serving.request"}
+    assert len(recs) == 2
+    r1rec = min(recs.values(), key=lambda r: r["request_id"])
+    r2rec = max(recs.values(), key=lambda r: r["request_id"])
+    # the late request was admitted AFTER decode began and finished
+    # BEFORE the long request: it joined the in-flight batch
+    assert r2rec["joined_step"] >= 2
+    assert r2rec["done_step"] < r1rec["done_step"]
+    assert r1rec["ttft_ms"] > 0 and r2rec["ttft_ms"] > 0
+    # both sequences shared slots concurrently
+    assert stats["kv_cache"]["peak_occupancy"] == 2
+    assert stats["kv_cache"]["occupancy"] == 0
+    # one step signature ever, prefill per prompt bucket
+    sigs = stats["compiled_signatures"]
+    assert sigs.count(("step",)) == 1
+    assert len([s for s in sigs if s[0] == "prefill"]) <= 2
+    # rolling summary carries ttft percentiles for generative traffic
+    sums = [r for r in sink.records if r.get("record") == "serving.latency"]
+    assert sums and sums[-1]["ttft_ms"] is not None
+
+
+def test_generative_int8_load_option():
+    """int8 weight quantization at load time: the engine decodes and
+    honors shapes (no parity claim vs fp32)."""
+    from mxnet_tpu.models.llama import llama_tiny
+
+    net = llama_tiny()
+    net.initialize()
+    rs = np.random.RandomState(1)
+    prompt = rs.randint(1, 250, size=6)
+    cfg = ServerConfig(max_batch=2, max_length=64, min_length=8,
+                       num_slots=2, int8=True)
+    srv = serving.GenerativeServer(net, cfg)
+    assert srv.engine.int8
+    # weights really are int8 on device
+    q = srv.engine._w["layers"][0]["q"]
+    assert str(q["q8"].dtype) == "int8"
+    with srv:
+        out = srv.generate(prompt, max_new_tokens=5)
+    assert out.shape == (len(prompt) + 5,)
+    assert np.array_equal(out[:len(prompt)], prompt)
+    assert (out < net.config.vocab_size).all()

@@ -1,0 +1,43 @@
+"""T1 fixture: serving's designated materialization def vs stray syncs.
+
+The serving scheduler materializes a whole dispatched batch at ONE
+demux point — a def named ``_materialize`` (``MATERIALIZE_DEFS``).
+Sync methods inside that def are sanctioned (no eager warning); the
+same calls anywhere else in serving glue still warn, and inside a
+traced region they are errors regardless of the def's name.
+"""
+import jax
+
+
+def _materialize(arrays):
+    out = []
+    for a in arrays:
+        out.append(a.asnumpy())       # fine: THE designated sync point
+    return out
+
+
+def scheduler_demux(outs, reqs):
+    host = _materialize(outs)         # fine: sanctioned helper call
+    for r, h in zip(reqs, host):
+        r.future.set_result(h)
+
+
+def leaky_sync(out):
+    return out.asnumpy()              # T1 warning: sync outside the
+                                      # designated materialization def
+
+
+def bad_traced_materialize(w, x):
+    y = w * x
+    return y.asnumpy()                # T1 error: sync inside a trace
+
+
+def _hot_materialize(arrays):
+    # the exemption covers EAGER warnings only: any traced sync is an
+    # error no matter how materialize-ish the def's name is
+    first = arrays[0]
+    return first.asnumpy()            # T1 error: traced sync
+
+
+bad_traced_jit = jax.jit(bad_traced_materialize)
+hot_materialize_jit = jax.jit(_hot_materialize)

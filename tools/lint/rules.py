@@ -59,6 +59,17 @@ SYNC_METHODS = {"asnumpy", "asscalar", "item", "tolist",
 SYNC_METHODS_ANYWHERE = {"asnumpy", "asscalar", "item",
                          "block_until_ready"}
 
+#: designated result-materialization defs: a function carrying one of
+#: these names IS the module's sanctioned batch-boundary sync point
+#: (the serving scheduler's ``_materialize`` — one device->host wait
+#: per dispatched batch, at demux; see docs/serving.md).  Sync methods
+#: inside such a def skip the eager T1 warning — the same shape as the
+#: PR 7 ``ticket.result()`` treatment (intentional eager waits stay
+#: legal) but scoped by enclosing-def name instead of method name.
+#: Inside a TRACED region the error still fires: naming a hot function
+#: ``_materialize`` buys nothing.
+MATERIALIZE_DEFS = {"_materialize"}
+
 #: function-style syncs, matched on dotted name
 SYNC_FUNCS_ANYWHERE = {"jax.device_get"}
 SYNC_FUNCS_TRACED = {"np.asarray", "numpy.asarray", "onp.asarray",
@@ -379,6 +390,10 @@ class FileChecker:
                            "hot path")
                 return
             if not hot and meth in SYNC_METHODS_ANYWHERE:
+                fn_node = self.index.enclosing_function(call)
+                if fn_node is not None and \
+                        getattr(fn_node, "name", None) in MATERIALIZE_DEFS:
+                    return  # sanctioned batch-boundary sync point
                 self._emit("T1", SEVERITY_WARNING, call,
                            f".{meth}() blocks on the dispatch queue; keep "
                            "it out of per-step loops or waiver it")
