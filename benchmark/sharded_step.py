@@ -1,0 +1,223 @@
+#!/usr/bin/env python
+"""Sharded-train-step lane: dp-only vs dp×tp through the partition engine.
+
+The question this artifact answers: does ``Trainer(...,
+partition_rules=...)`` actually buy per-device memory — same model, same
+global batch, one compile per signature — when the mesh gains a ``tp``
+axis?  Two models run through a STOCK ``gluon.Trainer``:
+
+* ``mlp`` — stacked Dense layers, explicit col/row rule table built
+  from the parameter names (the engine's literal-table path);
+* ``llama_tiny`` — ``models.llama.llama_tiny()`` under the built-in
+  ``"llama"`` family rules (the one-line-swap path).
+
+Each model runs twice on the SAME 8 virtual devices: mesh ``{dp: 8}``
+(rules degrade to full replication — the engine drops the absent ``tp``
+axis) and mesh ``{dp: 4, tp: 2}``.  Per lane the harness records step
+times, the compile-cache miss counters (steady-state steps must replay:
+0 misses after warmup), the placement summary, and memwatch's
+per-device live/peak bytes — physical bytes per device, so replication
+shows up 8× and a tp-sharded weight once per shard.
+
+CPU-mesh validation run (exactly what ``tests/test_bench_smoke.py``
+does)::
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    BENCH_PLATFORM=cpu python benchmark/sharded_step.py
+
+Artifact: SHARDED_STEP_r09.json (override MXT_SHARDED_STEP_OUT).
+Acceptance: for each model, dp×tp per-device peak live bytes < dp-only.
+"""
+from __future__ import annotations
+
+import gc
+import json
+import os
+import re
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+STEPS = int(os.environ.get("BENCH_STEPS", "6"))
+WARMUP = int(os.environ.get("BENCH_WARMUP", "2"))
+
+_MISS_COUNTERS = ("trainer.fused_cache_miss", "step_fusion.cache_miss",
+                  "cachedop.cache_miss")
+
+
+def _build_mlp():
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+    from mxnet_tpu.gluon import loss as gloss, nn
+
+    hidden, layers, batch = 256, 4, 32
+    net = nn.HybridSequential()
+    with net.name_scope():
+        for _ in range(layers):
+            net.add(nn.Dense(hidden, activation="relu"))
+        net.add(nn.Dense(16))
+    net.initialize(mx.init.Xavier())
+    net(nd.ones((1, hidden)))
+    net.hybridize(static_alloc=True)
+    # explicit rule table from the live parameter names: hidden weights
+    # column-sharded, the head row-sharded, everything else replicated
+    ws = [p.name for p in net.collect_params().values()
+          if p.name.endswith("weight")]
+    rules = [(rf"^{re.escape(w)}$", ("tp", None)) for w in ws[:-1]]
+    rules += [(rf"^{re.escape(ws[-1])}$", (None, "tp")), (r".*", ())]
+    loss_fn = gloss.L2Loss()
+    x = mx.random.uniform(shape=(batch, hidden))
+    y = mx.random.uniform(shape=(batch, 16))
+
+    def step_fn(net, trainer, batches, autograd):
+        x, y = batches
+        with autograd.record():
+            loss = loss_fn(net(x), y)
+        loss.backward()
+        trainer.step(x.shape[0])
+        return loss
+
+    return net, rules, (x, y), step_fn
+
+
+def _build_llama_tiny():
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+    from mxnet_tpu.models import llama
+
+    batch, seq = 8, 32
+    net = llama.llama_tiny()
+    net.initialize(mx.init.Xavier())
+    ids = nd.array(
+        mx.random.uniform(0, 256, shape=(batch, seq)).asnumpy().astype("int32"))
+    net(ids)
+    net.hybridize(static_alloc=True)
+    labels = nd.array(
+        mx.random.uniform(0, 256, shape=(batch, seq)).asnumpy().astype("int32"))
+
+    def step_fn(net, trainer, batches, autograd):
+        ids, labels = batches
+        with autograd.record():
+            lg = net(ids)
+            loss = nd.softmax_cross_entropy(
+                lg.reshape((-1, 256)), labels.reshape((-1,))).mean()
+        loss.backward()
+        trainer.step(ids.shape[0])
+        return loss
+
+    return net, "llama", (ids, labels), step_fn
+
+
+def _run_lane(build, mesh_axes):
+    from mxnet_tpu import autograd, gluon, nd, parallel, telemetry
+    from mxnet_tpu.telemetry import memwatch
+
+    telemetry.enable()
+    memwatch.enable()
+    try:
+        net, rules, batches, step_fn = build()
+        mesh = parallel.make_mesh(mesh_axes)
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.01},
+                                partition_rules=rules, mesh=mesh)
+        batches = tuple(parallel.shard_batch(b, mesh) for b in batches)
+        miss_warmup = miss_steady = 0
+        times = []
+        for i in range(WARMUP + STEPS):
+            with telemetry.step(examples=batches[0].shape[0]) as scope:
+                loss = step_fn(net, trainer, batches, autograd)
+                loss.wait_to_read()
+                nd.waitall()
+            misses = sum(scope.record["counters"].get(k, 0)
+                         for k in _MISS_COUNTERS)
+            if i < WARMUP:
+                miss_warmup += misses
+            else:
+                miss_steady += misses
+                times.append(scope.record["step_ms"])
+        peaks = memwatch.peak_live_bytes_by_device()
+        record = {
+            "mesh": dict(mesh_axes),
+            "steps": STEPS,
+            "warmup": WARMUP,
+            "final_loss": float(loss.mean().asscalar()),
+            "step_ms_median": round(statistics.median(times), 3),
+            "compile_miss_warmup": miss_warmup,
+            "compile_miss_steady": miss_steady,
+            "placement": trainer.placement.summary(),
+            "live_bytes_by_device": memwatch.live_bytes_by_device(),
+            "peak_live_bytes_by_device": peaks,
+            "per_device_peak_max": max(peaks.values()) if peaks else 0,
+        }
+    finally:
+        memwatch.disable()
+        telemetry.disable()
+        parallel.set_mesh(None)
+        gc.collect()
+    return record
+
+
+def main():
+    plat = os.environ.get("BENCH_PLATFORM")
+    if plat:
+        import jax
+
+        jax.config.update("jax_platforms", plat)
+    import jax
+
+    import mxnet_tpu as mx
+
+    n = jax.device_count()
+    if n < 8:
+        raise SystemExit(f"sharded_step needs >= 8 devices, have {n} "
+                         "(set XLA_FLAGS=--xla_force_host_platform_"
+                         "device_count=8)")
+    mx.random.seed(0)
+    t0 = time.time()
+    lanes = {}
+    for model, build in (("mlp", _build_mlp),
+                         ("llama_tiny", _build_llama_tiny)):
+        lanes[model] = {
+            "dp8": _run_lane(build, {"dp": 8}),
+            "dp4xtp2": _run_lane(build, {"dp": 4, "tp": 2}),
+        }
+    acceptance = {}
+    for model, pair in lanes.items():
+        acceptance[model] = {
+            "compile_once": all(p["compile_miss_steady"] == 0
+                                for p in pair.values()),
+            "tp_shards_params": pair["dp4xtp2"]["placement"]
+            ["sharded_params"] > 0,
+            "tp_peak_below_dp_only": pair["dp4xtp2"]["per_device_peak_max"]
+            < pair["dp8"]["per_device_peak_max"],
+        }
+    record = {
+        "metric": "sharded_step_per_device_peak_ratio",
+        "value": round(
+            lanes["llama_tiny"]["dp4xtp2"]["per_device_peak_max"]
+            / max(1, lanes["llama_tiny"]["dp8"]["per_device_peak_max"]), 4),
+        "unit": "dp4xtp2 peak / dp8 peak (llama_tiny, per-device bytes)",
+        "n_devices": n,
+        "lanes": lanes,
+        "acceptance": acceptance,
+        "wall_sec": round(time.time() - t0, 1),
+        "platform": os.environ.get("JAX_PLATFORMS",
+                                   plat or "default"),
+    }
+    line = json.dumps(record, indent=2, default=str)
+    print(line)
+    out_path = os.environ.get(
+        "MXT_SHARDED_STEP_OUT",
+        os.path.join(os.path.dirname(__file__), "..",
+                     "SHARDED_STEP_r09.json"))
+    with open(out_path, "w") as f:
+        f.write(line + "\n")
+    bad = {m: a for m, a in acceptance.items() if not all(a.values())}
+    if bad:
+        raise SystemExit(f"acceptance failed: {bad}")
+
+
+if __name__ == "__main__":
+    main()

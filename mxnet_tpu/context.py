@@ -118,8 +118,21 @@ def cpu(device_id: int = 0) -> Context:
     return Context("cpu", device_id)
 
 
-def tpu(device_id: int = 0) -> Context:
-    """TPU context — the capability the north star adds to the reference."""
+def tpu(device_id: int = 0, mesh=None) -> Context:
+    """TPU context — the capability the north star adds to the reference.
+
+    ``mesh`` activates a device mesh for the process in the same call
+    (``mx.tpu(mesh={'dp': 4, 'tp': 2})``): a dict builds one via
+    ``parallel.make_mesh``, a ``jax.sharding.Mesh`` is used as-is.
+    Parameters initialized afterwards are born replicated over it, and
+    ``Trainer(..., partition_rules=...)`` / ``parallel.shard_batch``
+    pick it up without further wiring."""
+    if mesh is not None:
+        from . import parallel  # deferred: parallel imports context
+
+        if isinstance(mesh, dict):
+            mesh = parallel.make_mesh(mesh)
+        parallel.set_mesh(mesh)
     return Context("tpu", device_id)
 
 

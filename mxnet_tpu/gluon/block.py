@@ -595,8 +595,16 @@ class CachedOp:
         plat = platform_of_raws([a._data for a in args])
         if plat is None:
             plat = current_dispatch_platform()
+        from .. import parallel
+
+        mesh = parallel.current_mesh()
+        # the active mesh joins the specialization: a graph traced for a
+        # dp×tp layout bakes GSPMD collectives a single-device replay
+        # cannot reuse (and vice versa), so layouts never share a slot
+        mesh_sig = None if mesh is None else tuple(mesh.shape.items())
         sig = (tuple((a.shape, str(a.dtype)) for a in args), training, plat,
-               tuple((p.shape, str(np.dtype(p.dtype))) for p in params))
+               tuple((p.shape, str(np.dtype(p.dtype))) for p in params),
+               mesh_sig)
         g = self._graphs.get(sig)
         if g is None:
             # a new (shapes, dtypes, mode, platform) signature: this call

@@ -193,7 +193,23 @@ class Parameter:
             raise MXNetError(
                 f"set_data shape mismatch for {self.name!r}: "
                 f"{data.shape} vs {self.shape}")
-        self._data._data = data.astype(self.dtype, copy=False)._data
+        new_raw = data.astype(self.dtype, copy=False)._data
+        old_raw = self._data._data
+        # a mesh-placed parameter keeps its NamedSharding across loads
+        # (checkpoint restore paths route through here with host arrays;
+        # rebinding bare would collapse a TP layout back to one device)
+        sharding = getattr(old_raw, "sharding", None)
+        if sharding is not None and \
+                getattr(new_raw, "shape", None) == old_raw.shape:
+            try:
+                import jax
+                from jax.sharding import NamedSharding
+
+                if isinstance(sharding, NamedSharding):
+                    new_raw = jax.device_put(new_raw, sharding)
+            except Exception:
+                pass  # best-effort: an unplaceable load stays unsharded
+        self._data._data = new_raw
         self.shape = data.shape
 
     # -- access --------------------------------------------------------------

@@ -290,9 +290,16 @@ class FusedTrainStep:
                     raise MXNetError(
                         f"stacked_inputs=True requires every batch leaf "
                         f"to lead with K={self.k}, got shape {b.shape}")
+        from .. import parallel
+
+        mesh = parallel.current_mesh()
+        # same shapes under a different mesh are a different program
+        # (GSPMD collectives, per-device tiling) — key the compile cache
+        # and the cost registry per mesh
+        mesh_sig = None if mesh is None else tuple(mesh.shape.items())
         sig = (type(optzr).__name__, float(optzr.rescale_grad),
                tuple(mp_flags),
-               tuple((b.shape, str(b.dtype)) for b in batch))
+               tuple((b.shape, str(b.dtype)) for b in batch), mesh_sig)
         fn = self._jit_cache.get(sig)
         if fn is None:
             telemetry.count("step_fusion.cache_miss")

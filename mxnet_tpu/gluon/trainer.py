@@ -120,7 +120,7 @@ def reset_drain():
 class Trainer:
     def __init__(self, params, optimizer, optimizer_params=None,
                  kvstore="device", compression_params=None,
-                 update_on_kvstore=None):
+                 update_on_kvstore=None, partition_rules=None, mesh=None):
         if isinstance(params, (dict, ParameterDict)):
             params = list(params.values())
         if not isinstance(params, (list, tuple)):
@@ -133,6 +133,39 @@ class Trainer:
                 raise MXNetError(f"element {i} is not a Parameter")
             self._param2idx[param.name] = i
             self._params.append(param)
+        # GSPMD entry point: partition_rules (a parallel.PartitionRules,
+        # a family name like "llama"/"mixtral", or an ordered
+        # (regex, spec) table) places every initialized parameter — and
+        # its grad — with NamedSharding over the mesh at construction.
+        # Optimizer state and multi-precision masters inherit the layout
+        # when _init_states builds them (both follow weight._data.
+        # sharding), so the whole optimizer trains in the TP/EP layout
+        # with no further user code.  mesh= may be a Mesh or a
+        # {'dp': 4, 'tp': 2} dict; it becomes the process mesh when none
+        # is active so shard_batch and late param inits see it.
+        self._partition_rules = None
+        self._mesh = None
+        self._placement = None
+        if partition_rules is not None or mesh is not None:
+            from .. import parallel
+
+            if isinstance(mesh, dict):
+                mesh = parallel.make_mesh(mesh)
+            mesh = mesh if mesh is not None else parallel.current_mesh()
+            if mesh is None:
+                raise MXNetError(
+                    "Trainer(partition_rules=...) needs a device mesh: "
+                    "pass mesh= or activate one (mx.tpu(mesh=...) / "
+                    "parallel.set_mesh)")
+            if parallel.current_mesh() is None:
+                parallel.set_mesh(mesh)
+            self._mesh = mesh
+            rules = parallel.as_rules(partition_rules) \
+                if partition_rules is not None else \
+                parallel.PartitionRules(((r".*", ()),))  # mesh-only: DP
+            self._partition_rules = rules
+            self._placement = parallel.place_params(
+                self._params, rules, mesh=mesh)
         self._compression_params = compression_params
         self._contexts = self._check_contexts()
         optimizer_params = optimizer_params or {}
@@ -242,6 +275,12 @@ class Trainer:
     @property
     def optimizer(self):
         return self._optimizer
+
+    @property
+    def placement(self):
+        """The partition-rules :class:`parallel.partition.Coverage`
+        report from construction (None without partition_rules/mesh)."""
+        return self._placement
 
     def set_learning_rate(self, lr):
         self._optimizer.set_learning_rate(lr)
@@ -388,10 +427,19 @@ class Trainer:
             wds.append(optzr._get_wd(i))
             ts.append(optzr._index_update_count[i])
 
+        from .. import parallel
+
+        mesh = self._mesh if self._mesh is not None \
+            else parallel.current_mesh()
+        # the mesh is part of the compile signature: the same shapes
+        # lower to different programs (collectives, per-device tiles)
+        # under different meshes, and the cost registry keys one
+        # artifact per (signature, mesh)
+        mesh_sig = None if mesh is None else tuple(mesh.shape.items())
         sig = (type(optzr).__name__, float(optzr.rescale_grad),
                tuple(mp_flags),
                tuple((w.shape, str(w.dtype)) for w in weights),
-               tuple(len(s) for s in states))
+               tuple(len(s) for s in states), mesh_sig)
         fn = self._fused_cache.get(sig)
         compiling = fn is None
         if compiling:

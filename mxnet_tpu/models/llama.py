@@ -1087,43 +1087,30 @@ def llama_pipeline_train_step(net, input_ids, labels, n_microbatches,
 
 def llama_param_pspecs(net, mesh, tp_axis="tp", ep_axis="ep"):
     """{param_name (structural): partition-spec tuple} for the megatron
-    TP/EP layout over ``mesh`` — the single source of the sharding rules,
-    used by :func:`shard_llama` (placement of real arrays) AND by the
-    abstract 8B lowering proof (ShapeDtypeStruct shardings with no
-    memory).  Params not listed are replicated (spec ``()``)."""
-    has_tp = mesh is not None and tp_axis in mesh.shape
-    has_ep = mesh is not None and ep_axis in mesh.shape
-    names = {id(p): n for n, p in
-             net._collect_params_with_prefix().items()}
-    col = (tp_axis, None)
-    row = (None, tp_axis)
-    specs = {}
+    TP/EP layout over ``mesh`` — used by :func:`shard_llama` (placement
+    of real arrays) AND by the abstract 8B lowering proof
+    (ShapeDtypeStruct shardings with no memory).  Params not listed are
+    replicated (spec ``()``).
 
-    def put(p, spec):
-        specs[names[id(p)]] = spec
+    The rules themselves live in the partition engine
+    (``parallel.partition.MIXTRAL_RULES`` — the llama table plus the
+    MoE expert-bank rows, which match nothing on a dense net); this
+    function just resolves them against the net's parameter paths and
+    ``mesh``, renaming the canonical 'tp'/'ep' axes when asked."""
+    from ..parallel import partition as _pt
 
-    from .moe import MoEMLP, moe_param_specs
-
-    for layer in net.model.layers:
-        attn, mlp = layer.self_attn, layer.mlp
-        if has_tp:
-            for p in (attn.q_proj.weight, attn.k_proj.weight,
-                      attn.v_proj.weight):
-                put(p, col)
-            put(attn.o_proj.weight, row)
-        if isinstance(mlp, MoEMLP):
-            for p, spec in moe_param_specs(
-                    mlp, ep_axis=ep_axis if has_ep else None,
-                    tp_axis=tp_axis if has_tp else None).items():
-                put(p, spec)
-        elif has_tp:
-            for p in (mlp.gate_proj.weight, mlp.up_proj.weight):
-                put(p, col)
-            put(mlp.down_proj.weight, row)
-    if has_tp:
-        put(net.model.embed_tokens.weight, col)
-        if not net._cfg.tie_embeddings:
-            put(net.lm_head.weight, col)
+    rename = {"tp": tp_axis, "ep": ep_axis}
+    rules = _pt.PartitionRules(
+        [(pat, tuple(rename.get(a, a) if isinstance(a, str) else a
+                     for a in spec))
+         for pat, spec in _pt.MIXTRAL_RULES])
+    shapes = {name: p.shape
+              for name, p in net._collect_params_with_prefix().items()}
+    specs = rules.specs(shapes, mesh)
+    if net._cfg.tie_embeddings:
+        # the tied head reads the embedding matrix; its own (dead)
+        # weight stays replicated exactly as the hand-rolled table did
+        specs.pop("lm_head.weight", None)
     return specs
 
 

@@ -48,7 +48,9 @@ __all__ = ["initialize", "is_initialized", "make_mesh", "set_mesh",
            "current_mesh", "mesh_scope", "shard_batch", "replicate",
            "shard_param", "with_sharding", "TPUSyncKVStore", "all_sum",
            "ring_attention", "ulysses_attention", "pipeline_apply",
-           "pipeline_train_1f1b"]
+           "pipeline_train_1f1b", "PartitionRules", "as_rules",
+           "place_params", "stacked_spec", "LLAMA_RULES", "MIXTRAL_RULES",
+           "FAMILY_RULES", "last_placement"]
 
 
 _STATE = threading.local()
@@ -528,3 +530,6 @@ class TPUSyncKVStore:
 
 from .ring import ring_attention, ulysses_attention  # noqa: E402
 from .pipeline import pipeline_apply, pipeline_train_1f1b  # noqa: E402
+from .partition import (PartitionRules, as_rules, place_params,  # noqa: E402
+                        stacked_spec, LLAMA_RULES, MIXTRAL_RULES,
+                        FAMILY_RULES, last_placement)

@@ -1,0 +1,318 @@
+"""Partition-rule engine: named meshes + ordered regex rules → GSPMD layout.
+
+The reference distributes by enumerating devices (`kvstore dist modes,
+SURVEY §3.4`); on TPU the layout IS the program, so the user-facing
+surface is a *rule table*: an ordered list of ``(regex, partition-spec)``
+pairs matched against Gluon parameter paths, first match wins (the
+t5x/EasyLM ``match_partition_rules`` shape — SNIPPETS.md [3]).  The same
+table drives
+
+  * real placement at ``Trainer(..., partition_rules=...)`` init
+    (:func:`place_params` — parameters, grads; optimizer state and
+    multi-precision masters inherit the layout because
+    ``optimizer._state_zeros`` and the master-copy cast both follow
+    ``weight._data.sharding``), and
+  * abstract placement in the HBM-fit lowering proofs
+    (:meth:`PartitionRules.specs` over ``(name, shape)`` pairs with no
+    memory — ``tools/scale_proof.py``).
+
+Rules are matched against BOTH naming schemes Gluon produces — the
+structural dotted path (``model.layers.0.self_attn.q_proj.weight``) and
+the flat prefixed name (``..._attn_q_weight``) — so the built-in family
+tables use separator-tolerant patterns (``(^|[._])`` boundaries).
+
+Matching discipline (the sharp edges are explicit, not silent):
+
+  * first-match-wins over the ordered table;
+  * scalars always replicate;
+  * a matching rule whose non-empty spec length differs from the param
+    rank is SKIPPED (recorded as a rank-skip) and matching continues —
+    this is what lets the mixtral table put the 3-D expert-bank rule
+    ``(gate|up)_weight → (ep, tp, None)`` ahead of the dense 2-D column
+    rule without the flat name ``mlp_gate_weight`` colliding;
+  * axis names absent from the target mesh (or of size 1) resolve to
+    ``None`` — the llama table degrades to pure replication on a
+    dp-only mesh, matching the historical ``has_tp`` behavior;
+  * a sharded dim must divide evenly; an indivisible axis resolves to
+    ``None`` and is reported, never raised mid-init;
+  * unmatched params replicate by default (``on_unmatched="replicate"``)
+    or raise (``on_unmatched="error"``); either way
+    :meth:`PartitionRules.coverage` reports them, plus any rule no
+    param ever used — the runtime complement of mxlint's static T8.
+"""
+from __future__ import annotations
+
+import re
+
+from ..base import MXNetError
+
+__all__ = ["PartitionRules", "as_rules", "place_params", "stacked_spec",
+           "LLAMA_RULES", "MIXTRAL_RULES", "FAMILY_RULES",
+           "last_placement"]
+
+#: Megatron TP layout for dense llama-family transformers.  Weights are
+#: stored (out, in): q/k/v/gate/up split the output dim (column
+#: parallel), o/down split the input dim (row parallel), embed/lm_head
+#: split the vocab dim.  Terminal catch-all replicates the rest
+#: (norms, biases) explicitly.
+LLAMA_RULES = (
+    (r"(^|[._])(q|k|v|gate|up)(_proj)?[._]weight$", ("tp", None)),
+    (r"(^|[._])(o|down)(_proj)?[._]weight$", (None, "tp")),
+    (r"(^|[._])embed(_tokens)?[._]weight$", ("tp", None)),
+    (r"(^|[._])lm_head[._]weight$", ("tp", None)),
+    (r".*", ()),
+)
+
+#: Mixtral = llama + MoE expert banks.  The 3-D bank rules come FIRST:
+#: the flat names ``moe_gate_weight``/``moe_down_weight`` also match the
+#: dense 2-D rules below, and only the rank guard + ordering routes the
+#: (E, I, H) banks to the expert layout (mirrors
+#: ``models.moe.moe_param_specs``: banks split over ep, intra-expert
+#: over tp; the tiny router replicates).
+MIXTRAL_RULES = (
+    (r"(^|[._])router[._]?weight$", ()),
+    (r"(^|[._])(gate|up)_weight$", ("ep", "tp", None)),
+    (r"(^|[._])down_weight$", ("ep", None, "tp")),
+) + LLAMA_RULES
+
+FAMILY_RULES = {"llama": LLAMA_RULES, "mixtral": MIXTRAL_RULES}
+
+#: most recent place_params summary — telemetry.step_end folds it into
+#: the per-step JSONL record (mesh_shape / sharded_params /
+#: replicated_params) without importing this module eagerly
+_LAST_PLACEMENT = None
+
+
+def last_placement():
+    """The most recent :func:`place_params` summary dict (or None):
+    ``{"mesh_shape": {...}, "sharded_params": n, "replicated_params": n}``."""
+    return _LAST_PLACEMENT
+
+
+class Coverage:
+    """Placement coverage report — what matched, what fell through.
+
+    ``matched``   {name: (pattern, resolved_spec)} for sharded params
+    ``replicated``[names] resolved to full replication (catch-all,
+                  axis-dropped, or unmatched under ``replicate`` mode)
+    ``unmatched`` [names] no rule matched at all
+    ``rank_skips``[(name, pattern)] rules skipped by the rank guard
+    ``dropped``   [(name, axis, reason)] spec axes resolved to None
+                  ("absent", "size1", "indivisible")
+    ``unused``    [patterns] rules no param ever selected
+    """
+
+    def __init__(self):
+        self.matched = {}
+        self.replicated = []
+        self.unmatched = []
+        self.rank_skips = []
+        self.dropped = []
+        self.unused = []
+        self.mesh_shape = {}
+
+    @property
+    def sharded_params(self):
+        return len(self.matched)
+
+    @property
+    def replicated_params(self):
+        return len(self.replicated)
+
+    def summary(self):
+        return {"mesh_shape": dict(self.mesh_shape),
+                "sharded_params": self.sharded_params,
+                "replicated_params": self.replicated_params}
+
+    def render(self):
+        lines = [f"mesh={self.mesh_shape} sharded={self.sharded_params} "
+                 f"replicated={self.replicated_params}"]
+        for name, (pat, spec) in sorted(self.matched.items()):
+            lines.append(f"  shard {name}: {spec}  [{pat}]")
+        for name in self.unmatched:
+            lines.append(f"  UNMATCHED {name} (replicated)")
+        for pat in self.unused:
+            lines.append(f"  UNUSED rule {pat!r}")
+        for name, axis, why in self.dropped:
+            lines.append(f"  dropped axis {axis!r} on {name} ({why})")
+        return "\n".join(lines)
+
+
+class PartitionRules:
+    """Ordered ``(regex, spec)`` table mapping parameter paths to
+    partition specs.  Specs are tuples of mesh-axis names / ``None`` per
+    dim (nested tuples allowed for multi-axis dims); ``()`` replicates.
+    """
+
+    def __init__(self, rules, on_unmatched="replicate"):
+        if on_unmatched not in ("replicate", "error"):
+            raise MXNetError(
+                f"on_unmatched must be 'replicate' or 'error', "
+                f"got {on_unmatched!r}")
+        self.on_unmatched = on_unmatched
+        self.rules = []
+        for pattern, spec in rules:
+            try:
+                rx = re.compile(pattern)
+            except re.error as e:
+                raise MXNetError(
+                    f"invalid partition-rule regex {pattern!r}: {e}")
+            self.rules.append((pattern, rx, tuple(spec)))
+        if not self.rules:
+            raise MXNetError("empty partition-rule table")
+
+    @classmethod
+    def for_family(cls, family, on_unmatched="replicate"):
+        """Built-in table by model-family name ('llama', 'mixtral')."""
+        try:
+            rules = FAMILY_RULES[family]
+        except KeyError:
+            raise MXNetError(
+                f"unknown model family {family!r}; "
+                f"known: {sorted(FAMILY_RULES)}")
+        return cls(rules, on_unmatched=on_unmatched)
+
+    # -- matching -------------------------------------------------------------
+
+    def match(self, name, shape=None, coverage=None):
+        """First rule matching ``name`` (rank-compatible with ``shape``):
+        ``(pattern, spec)``; ``(None, None)`` when nothing matches."""
+        if shape is not None and len(shape) == 0:
+            return None, ()  # scalars always replicate
+        for pattern, rx, spec in self.rules:
+            if rx.search(name) is None:
+                continue
+            if shape is not None and spec and len(spec) != len(shape):
+                if coverage is not None:
+                    coverage.rank_skips.append((name, pattern))
+                continue
+            return pattern, spec
+        return None, None
+
+    def resolve(self, spec, mesh, shape=None, name="?", coverage=None):
+        """Ground ``spec`` against ``mesh``: axes absent from the mesh,
+        of size 1, or not dividing the dim evenly become ``None``."""
+        if spec is None:
+            return None
+        axes = dict(mesh.shape) if mesh is not None else {}
+
+        def keep(axis, dim):
+            why = None
+            if axis not in axes:
+                why = "absent"
+            elif axes[axis] <= 1:
+                why = "size1"
+            elif dim is not None and dim % axes[axis] != 0:
+                why = "indivisible"
+            if why is not None and coverage is not None:
+                coverage.dropped.append((name, axis, why))
+            return why is None
+
+        out = []
+        for i, axis in enumerate(spec):
+            dim = shape[i] if shape is not None else None
+            if axis is None:
+                out.append(None)
+            elif isinstance(axis, (tuple, list)):
+                kept = tuple(a for a in axis if keep(a, dim))
+                out.append(kept if kept else None)
+            else:
+                out.append(axis if keep(axis, dim) else None)
+        return tuple(out)
+
+    def specs(self, named_shapes, mesh, coverage=None):
+        """Resolved specs for ``{name: shape}`` (or ``(name, shape)``
+        pairs) against ``mesh``: ``{name: spec}`` with only actually-
+        sharded entries; fills ``coverage`` when given.  Raises under
+        ``on_unmatched='error'`` for any name no rule matched."""
+        cov = coverage if coverage is not None else Coverage()
+        if mesh is not None:
+            cov.mesh_shape = dict(mesh.shape)
+        items = named_shapes.items() if hasattr(named_shapes, "items") \
+            else named_shapes
+        used = set()
+        out = {}
+        for name, shape in items:
+            pattern, spec = self.match(name, shape, coverage=cov)
+            if spec is None:
+                cov.unmatched.append(name)
+                cov.replicated.append(name)
+                continue
+            if pattern is not None:
+                used.add(pattern)
+            resolved = self.resolve(spec, mesh, shape, name=name,
+                                    coverage=cov)
+            if any(a is not None for a in resolved):
+                cov.matched[name] = (pattern, resolved)
+                out[name] = resolved
+            else:
+                cov.replicated.append(name)
+        cov.unused = [p for p, _rx, _s in self.rules if p not in used]
+        if cov.unmatched and self.on_unmatched == "error":
+            raise MXNetError(
+                "partition rules matched no rule for: "
+                + ", ".join(sorted(cov.unmatched)))
+        return out
+
+    def coverage(self, named_shapes, mesh):
+        """Dry-run ``specs`` and return the :class:`Coverage` report."""
+        cov = Coverage()
+        self.specs(named_shapes, mesh, coverage=cov)
+        return cov
+
+
+def as_rules(rules):
+    """Coerce to :class:`PartitionRules`: pass through an instance, look
+    up a family name, or wrap an ``(regex, spec)`` iterable."""
+    if rules is None:
+        return None
+    if isinstance(rules, PartitionRules):
+        return rules
+    if isinstance(rules, str):
+        return PartitionRules.for_family(rules)
+    return PartitionRules(rules)
+
+
+def stacked_spec(spec, stack_axes=1):
+    """Spec for a scan-stacked bank of per-layer params: the leading
+    stack dim(s) replicate, the per-layer spec shifts right — the shape
+    ``tools/scale_proof.py`` lowers its (L, ...) operands with."""
+    return (None,) * stack_axes + tuple(spec or ())
+
+
+def place_params(params, rules, mesh=None, on_unmatched=None):
+    """Place initialized Gluon parameters (data AND grad buffers) with
+    ``NamedSharding`` per the rule table; everything the rules do not
+    shard is explicitly replicated over the mesh.  Optimizer state and
+    multi-precision masters created afterwards follow the weights'
+    placement for free.  Returns the :class:`Coverage` report and
+    records its summary for telemetry (:func:`last_placement`)."""
+    import jax
+
+    from . import current_mesh, _named_sharding, _pspec
+
+    mesh = mesh if mesh is not None else current_mesh()
+    if mesh is None:
+        raise MXNetError("place_params needs a mesh; pass mesh= or call "
+                         "parallel.set_mesh / mx.tpu(mesh=...) first")
+    rules = as_rules(rules)
+    if on_unmatched is not None:
+        rules = PartitionRules(
+            [(p, s) for p, _rx, s in rules.rules], on_unmatched=on_unmatched)
+    if hasattr(params, "items"):
+        named = list(params.items())
+    else:
+        named = [(p.name, p) for p in params]
+    live = [(n, p) for n, p in named if getattr(p, "_data", None) is not None]
+    cov = Coverage()
+    specs = rules.specs([(n, p.shape) for n, p in live], mesh, coverage=cov)
+    for name, p in live:
+        spec = specs.get(name, ())
+        sharding = _named_sharding(mesh, _pspec(*spec))
+        data = p._data
+        data._data = jax.device_put(data._data, sharding)
+        if data.grad is not None:
+            data.grad._data = jax.device_put(data.grad._data, sharding)
+    global _LAST_PLACEMENT
+    _LAST_PLACEMENT = cov.summary()
+    return cov

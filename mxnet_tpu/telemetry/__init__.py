@@ -395,6 +395,24 @@ def step_end(examples=None, **extra):
             peak = costs.peak_flops()
             record["mfu"] = (model_flops / (dur * peak)) \
                 if peak and dur > 0 else None
+        # sharding context: only probed when the parallel layer was
+        # actually imported (sys.modules — never triggers the import)
+        pl = sys.modules.get("mxnet_tpu.parallel")
+        if pl is not None:
+            try:
+                mesh = pl.current_mesh()
+                if mesh is not None:
+                    record["mesh_shape"] = dict(mesh.shape)
+                placement = pl.last_placement()
+                if placement is not None:
+                    record.setdefault("mesh_shape",
+                                      placement["mesh_shape"])
+                    record["sharded_params"] = \
+                        placement["sharded_params"]
+                    record["replicated_params"] = \
+                        placement["replicated_params"]
+            except Exception:
+                pass  # telemetry never raises into training
         record.update(extra)
         sinks = list(_sinks)
     for s in sinks:

@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import json
 import os
+import sys
 import threading
 
 __all__ = ["enable", "disable", "is_enabled", "note", "get", "snapshot",
@@ -67,11 +68,13 @@ class _Artifact:
 
     __slots__ = ("kind", "key", "flops", "bytes_accessed", "output_bytes",
                  "temp_bytes", "argument_bytes", "alias_bytes",
-                 "generated_code_bytes", "executions", "error")
+                 "generated_code_bytes", "executions", "error",
+                 "mesh_shape")
 
     def __init__(self, kind, key):
         self.kind = kind
         self.key = key
+        self.mesh_shape = _current_mesh_shape()
         self.flops = 0.0
         self.bytes_accessed = 0.0
         self.output_bytes = 0
@@ -95,12 +98,26 @@ class _Artifact:
             "generated_code_bytes": self.generated_code_bytes,
             "executions": self.executions,
             "error": self.error,
+            "mesh_shape": self.mesh_shape,
         }
 
 
 def _key_str(key, limit=300):
     text = repr(key)
     return text if len(text) <= limit else text[:limit] + "..."
+
+
+def _current_mesh_shape():
+    """The active device mesh as {axis: size}, or None.  Probed via
+    ``sys.modules`` so an unimported parallel layer stays unimported."""
+    pl = sys.modules.get("mxnet_tpu.parallel")
+    if pl is None:
+        return None
+    try:
+        mesh = pl.current_mesh()
+        return dict(mesh.shape) if mesh is not None else None
+    except Exception:
+        return None
 
 
 def _analyze(kind, key, jfn, args):

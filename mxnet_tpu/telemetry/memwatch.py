@@ -51,6 +51,7 @@ from ..base import MXNetError
 
 __all__ = ["enable", "disable", "is_enabled", "track", "donated", "adopt",
            "live_bytes", "live_bytes_by_device", "peak_live_bytes",
+           "peak_live_bytes_by_device",
            "step_mark", "ledger_size", "snapshot", "write_postmortem",
            "annotate_oom", "looks_like_oom", "OOMError"]
 
@@ -77,8 +78,8 @@ class OOMError(MXNetError):
 
 
 class _Entry:
-    __slots__ = ("nbytes", "shape", "dtype", "device", "owner",
-                 "birth_step", "ref")
+    __slots__ = ("nbytes", "shape", "dtype", "device", "per_device",
+                 "owner", "birth_step", "ref")
 
 
 def _ensure_classes():
@@ -115,6 +116,33 @@ def _device_label(raw):
         return "unknown"
 
 
+def _per_device_bytes(raw, nbytes):
+    """{device label: PHYSICAL bytes} for one array.  A replicated
+    array occupies its full size on every device; a sharded array one
+    shard per device (``sharding.shard_shape``).  This is the per-device
+    truth the HBM-fit question needs — summing the map over devices
+    exceeds the array's logical ``nbytes`` whenever anything is
+    replicated, by design."""
+    try:
+        sharding = raw.sharding
+        devs = sorted(sharding.device_set, key=lambda d: d.id)
+    except Exception:
+        return {_device_label(raw): nbytes}
+    if len(devs) <= 1:
+        return {_device_label(raw): nbytes}
+    try:
+        shard_shape = sharding.shard_shape(tuple(raw.shape))
+        per = 1
+        for s in shard_shape:
+            per *= int(s)
+        import numpy as np
+
+        per *= np.dtype(raw.dtype).itemsize
+    except Exception:
+        per = nbytes // len(devs)
+    return {f"{d.platform}:{d.id}": per for d in devs}
+
+
 def _scope_owner():
     prof = sys.modules.get("mxnet_tpu.profiler")
     if prof is None:
@@ -134,22 +162,24 @@ def _mirror_counter(total, by_device):
 def _add_locked(e):
     global _live_total, _peak_total
     _live_total += e.nbytes
-    cur = _live_by_device.get(e.device, 0) + e.nbytes
-    _live_by_device[e.device] = cur
+    for dev, b in e.per_device.items():
+        cur = _live_by_device.get(dev, 0) + b
+        _live_by_device[dev] = cur
+        if cur > _peak_by_device.get(dev, 0):
+            _peak_by_device[dev] = cur
     if _live_total > _peak_total:
         _peak_total = _live_total
-    if cur > _peak_by_device.get(e.device, 0):
-        _peak_by_device[e.device] = cur
 
 
 def _sub_locked(e):
     global _live_total
     _live_total -= e.nbytes
-    cur = _live_by_device.get(e.device, 0) - e.nbytes
-    if cur > 0:
-        _live_by_device[e.device] = cur
-    else:
-        _live_by_device.pop(e.device, None)
+    for dev, b in e.per_device.items():
+        cur = _live_by_device.get(dev, 0) - b
+        if cur > 0:
+            _live_by_device[dev] = cur
+        else:
+            _live_by_device.pop(dev, None)
 
 
 # -- the ledger ---------------------------------------------------------------
@@ -182,6 +212,7 @@ def track(raw, owner=None):
             e.shape = tuple(int(s) for s in raw.shape)
             e.dtype = str(raw.dtype)
             e.device = _device_label(raw)
+            e.per_device = _per_device_bytes(raw, e.nbytes)
         except Exception:
             return
         e.owner = owner if owner is not None else _scope_owner()
@@ -268,6 +299,14 @@ def peak_live_bytes():
     """High-water mark of ``live_bytes`` since the last step_mark()."""
     with _lock:
         return _peak_total
+
+
+def peak_live_bytes_by_device():
+    """Per-device high-water marks since the last step_mark() — the
+    number that decides HBM fit under a sharded layout (the sum hides
+    replication; the per-device peak does not)."""
+    with _lock:
+        return dict(_peak_by_device)
 
 
 def ledger_size():

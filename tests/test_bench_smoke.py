@@ -158,6 +158,39 @@ def test_serving_latency_bench_emits_artifact(tmp_path):
     assert rec["acceptance"]["signatures_within_ceiling"]
 
 
+def test_sharded_step_bench_emits_artifact(tmp_path):
+    """benchmark/sharded_step.py on the 8-device CPU mesh must emit the
+    SHARDED_STEP artifact with both models x both meshes, zero
+    steady-state compile misses, and the per-device-peak win for dp×tp —
+    the round-9 evidence that partition_rules buys memory, not just
+    placement metadata."""
+    out = tmp_path / "sharded_step.json"
+    env = dict(os.environ)
+    env.update(BENCH_PLATFORM="cpu", BENCH_STEPS="3", BENCH_WARMUP="1",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8",
+               MXT_SHARDED_STEP_OUT=str(out))
+    env.pop("JAX_PLATFORMS", None)
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "benchmark",
+                                      "sharded_step.py")],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=600)
+    assert r.returncode == 0, r.stderr[-2000:]
+    rec = json.loads(out.read_text())
+    assert rec["metric"] == "sharded_step_per_device_peak_ratio"
+    assert 0 < rec["value"] < 1
+    for model in ("mlp", "llama_tiny"):
+        pair = rec["lanes"][model]
+        for lane in pair.values():
+            assert lane["compile_miss_steady"] == 0
+            assert lane["compile_miss_warmup"] > 0
+            assert len(lane["peak_live_bytes_by_device"]) == 8
+        assert pair["dp4xtp2"]["placement"]["sharded_params"] > 0
+        assert pair["dp8"]["placement"]["sharded_params"] == 0
+        assert pair["dp4xtp2"]["per_device_peak_max"] < \
+            pair["dp8"]["per_device_peak_max"]
+        assert all(rec["acceptance"][model].values())
+
+
 def test_telemetry_disabled_step_overhead():
     """Telemetry instrumentation rides the trainer/CachedOp/kvstore hot
     path; disabled it must be within noise of the seed path.  Compare

@@ -201,6 +201,38 @@ def test_t7_false_positive_traps_stay_quiet():
         assert clean not in contexts, sorted(contexts)
 
 
+def test_t8_flags_rule_table_hazards():
+    vs = _rule(_analyze("t8_partition.py"), "T8")
+    msgs = [(v.severity, v.message) for v in vs]
+    assert any(s == "error" and "does not compile" in m for s, m in msgs)
+    assert any(s == "error" and "unreachable" in m for s, m in msgs)
+    assert any(s == "error" and "duplicate pattern" in m for s, m in msgs)
+    # the Trainer(partition_rules=NAME) site resolves the module-level
+    # table and flags the silent-replicate fallback
+    assert any(s == "warning" and "silently replicate" in m
+               for s, m in msgs)
+    assert len(vs) == 4, [v.to_dict() for v in vs]
+
+
+def test_t8_negatives_stay_quiet():
+    vs = _rule(_analyze("t8_partition.py"), "T8")
+    lines = {v.line for v in vs}
+    src = open(os.path.join(FIXTURES, "t8_partition.py")).read()
+    good_line = src[:src.index("GOOD = ")].count("\n") + 1
+    policy_line = src[:src.index("return place_params")].count("\n") + 1
+    assert good_line not in lines       # terminal catch-all is clean
+    assert policy_line not in lines     # on_unmatched= policy is clean
+
+
+def test_t8_engine_and_builtin_tables_clean():
+    # the engine's own family tables and every in-tree consumer must
+    # pass the rule they taught the linter
+    vs = analyze_paths(
+        ["mxnet_tpu/parallel/partition.py", "mxnet_tpu/gluon/trainer.py",
+         "mxnet_tpu/models/llama.py"], REPO, rules={"T8"})
+    assert vs == [], [v.to_dict() for v in vs]
+
+
 def test_t6_t7_clean_on_real_donation_sites():
     # the real donating call sites (fused trainer update, K-step fusion,
     # per-param optimizer update, llama decode cache) follow the
@@ -260,7 +292,7 @@ def test_cli_fails_on_seeded_fixtures_with_json():
     assert r.returncode == 1
     payload = json.loads(r.stdout)
     by_rule = payload["summary"]["by_rule"]
-    for rule in ("T1", "T2", "T3", "T4", "T5", "T6", "T7"):
+    for rule in ("T1", "T2", "T3", "T4", "T5", "T6", "T7", "T8"):
         assert by_rule.get(rule, 0) > 0, f"{rule} missing from {by_rule}"
 
 
@@ -273,7 +305,7 @@ def test_cli_sarif_format():
     run = sarif["runs"][0]
     assert run["tool"]["driver"]["name"] == "mxlint"
     rule_ids = {rl["id"] for rl in run["tool"]["driver"]["rules"]}
-    assert {"T1", "T2", "T3", "T4", "T5", "T6", "T7"} <= rule_ids
+    assert {"T1", "T2", "T3", "T4", "T5", "T6", "T7", "T8"} <= rule_ids
     results = run["results"]
     assert results and all(r_["ruleId"] in rule_ids for r_ in results)
     loc = results[0]["locations"][0]["physicalLocation"]

@@ -1,0 +1,328 @@
+"""Partition-rule engine tests: rule matching/ordering/coverage, the
+dp×tp-equals-single-device oracle through a stock ``gluon.Trainer``,
+sharding-preserving checkpoint round trips, and elastic data assignment
+under an active mesh.  Everything runs on the conftest's 8 virtual CPU
+devices and stays in the tier-1 fast lane — tiny models, few compiles."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd, parallel, telemetry
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.parallel import partition as pt
+
+
+# --- rule matching / ordering / coverage ------------------------------------
+
+def test_first_match_wins_and_scalars_replicate():
+    rules = pt.PartitionRules((
+        (r"weight$", ("tp", None)),
+        (r"0_weight$", (None, "tp")),   # shadowed for *_0_weight too
+        (r".*", ()),
+    ))
+    assert rules.match("dense0_weight", (8, 4)) == (r"weight$", ("tp", None))
+    assert rules.match("scale", ()) == (None, ())   # scalar: replicate
+    assert rules.match("bias", (8,)) == (r".*", ())
+
+
+def test_unmatched_without_catch_all():
+    rules = pt.PartitionRules(((r"weight$", ("tp", None)),))
+    assert rules.match("running_mean", (8,)) == (None, None)
+    mesh = parallel.make_mesh({"dp": 4, "tp": 2})
+    cov = rules.coverage({"weight": (8, 4), "running_mean": (8,)}, mesh)
+    assert cov.unmatched == ["running_mean"]
+    err_rules = pt.PartitionRules(((r"weight$", ("tp", None)),),
+                                  on_unmatched="error")
+    with pytest.raises(MXNetError, match="running_mean"):
+        err_rules.specs({"running_mean": (8,)}, mesh)
+
+
+def test_invalid_regex_and_empty_table_raise():
+    with pytest.raises(MXNetError, match="invalid partition-rule regex"):
+        pt.PartitionRules(((r"(q|k", ("tp", None)),))
+    with pytest.raises(MXNetError, match="empty"):
+        pt.PartitionRules(())
+    with pytest.raises(MXNetError, match="on_unmatched"):
+        pt.PartitionRules(((r".*", ()),), on_unmatched="warn")
+    with pytest.raises(MXNetError, match="unknown model family"):
+        pt.PartitionRules.for_family("gpt17")
+
+
+def test_rank_guard_routes_flat_moe_names():
+    """The 3-D expert-bank rule precedes the dense 2-D rule; the rank
+    guard is what keeps the flat dense name from taking the bank spec."""
+    rules = pt.PartitionRules.for_family("mixtral")
+    mesh = parallel.make_mesh({"dp": 2, "ep": 2, "tp": 2})
+    cov = pt.Coverage()
+    specs = rules.specs({
+        "moe_gate_weight": (4, 16, 8),    # (E, I, H) expert bank
+        "mlp_gate_weight": (16, 8),       # dense 2-D, same suffix
+        "router_weight": (4, 8),
+        "ln_in_weight": (8,),
+    }, mesh, coverage=cov)
+    assert specs["moe_gate_weight"] == ("ep", "tp", None)
+    assert specs["mlp_gate_weight"] == ("tp", None)
+    assert ("mlp_gate_weight",
+            r"(^|[._])(gate|up)_weight$") in cov.rank_skips
+    assert "router_weight" not in specs      # explicitly replicated
+    assert "ln_in_weight" not in specs       # norms replicate
+
+
+def test_structural_and_flat_names_take_the_same_layout():
+    rules = pt.PartitionRules.for_family("llama")
+    mesh = parallel.make_mesh({"dp": 4, "tp": 2})
+    pairs = [("model.layers.0.self_attn.q_proj.weight",
+              "model_layers_0_attn_q_weight", (8, 8)),
+             ("model.layers.0.mlp.down_proj.weight",
+              "model_layers_0_mlp_down_weight", (8, 16)),
+             ("model.embed_tokens.weight", "model_embed_weight", (32, 8))]
+    for dotted, flat, shape in pairs:
+        specs = rules.specs({dotted: shape, flat: shape}, mesh)
+        assert specs[dotted] == specs[flat], (dotted, flat)
+
+
+def test_resolve_drops_absent_size1_indivisible():
+    rules = pt.PartitionRules.for_family("llama")
+    dp_only = parallel.make_mesh({"dp": 8})
+    cov = pt.Coverage()
+    specs = rules.specs({"q_weight": (8, 8)}, dp_only, coverage=cov)
+    assert specs == {}                       # degrades to replication
+    assert ("q_weight", "tp", "absent") in cov.dropped
+    mesh = parallel.make_mesh({"dp": 4, "tp": 2})
+    cov = pt.Coverage()
+    specs = rules.specs({"q_weight": (7, 8)}, mesh, coverage=cov)
+    assert specs == {}
+    assert ("q_weight", "tp", "indivisible") in cov.dropped
+
+
+def test_coverage_reports_unused_rules_and_summary():
+    rules = pt.PartitionRules.for_family("llama")
+    mesh = parallel.make_mesh({"dp": 4, "tp": 2})
+    cov = rules.coverage({"q_weight": (8, 8), "norm_weight": (8,)}, mesh)
+    assert r"(^|[._])lm_head[._]weight$" in cov.unused
+    assert cov.summary() == {"mesh_shape": {"dp": 4, "tp": 2},
+                             "sharded_params": 1, "replicated_params": 1}
+    assert "shard q_weight" in cov.render()
+
+
+def test_stacked_spec_and_as_rules():
+    assert pt.stacked_spec(("tp", None)) == (None, "tp", None)
+    assert pt.stacked_spec((), stack_axes=2) == (None, None)
+    assert pt.as_rules(None) is None
+    r = pt.PartitionRules(((r".*", ()),))
+    assert pt.as_rules(r) is r
+    assert pt.as_rules("llama").rules[0][0] == pt.LLAMA_RULES[0][0]
+    assert pt.as_rules([(r".*", ())]).rules[0][2] == ()
+
+
+# --- dp×tp step == single-device oracle through stock Trainer ---------------
+
+_HIDDEN, _OUT, _BATCH, _STEPS = 32, 8, 16, 4
+
+
+def _mlp():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(_HIDDEN, activation="relu"), nn.Dense(_OUT))
+    net.initialize(mx.init.Xavier())
+    net(nd.ones((1, _HIDDEN)))
+    net.hybridize(static_alloc=True)
+    return net
+
+
+def _mlp_rules(net):
+    ws = [p.name for p in net.collect_params().values()
+          if p.name.endswith("weight")]
+    return [(rf"^{ws[0]}$", ("tp", None)), (rf"^{ws[1]}$", (None, "tp")),
+            (r".*", ())]
+
+
+def _train(net, trainer, x, y, loss_fn, steps):
+    losses = []
+    for _ in range(steps):
+        with autograd.record():
+            loss = loss_fn(net(x), y).mean()
+        loss.backward()
+        trainer.step(_BATCH)
+        losses.append(float(loss.asscalar()))
+    return losses
+
+
+def test_dp_tp_step_matches_single_device_oracle(tmp_path):
+    from mxnet_tpu import sanitizer
+
+    loss_fn = gluon.loss.L2Loss()
+    xs = onp.random.RandomState(0).randn(_BATCH, _HIDDEN).astype("float32")
+    ys = onp.random.RandomState(1).randn(_BATCH, _OUT).astype("float32")
+
+    # oracle: single device, no mesh
+    parallel.set_mesh(None)
+    oracle = _mlp()
+    oracle.save_parameters(str(tmp_path / "init.params"))
+    otr = gluon.Trainer(oracle.collect_params(), "sgd",
+                        {"learning_rate": 0.1})
+    oracle_losses = _train(oracle, otr, nd.array(xs), nd.array(ys),
+                           loss_fn, _STEPS)
+    oracle_params = {name: p.data().asnumpy() for name, p in
+                     oracle._collect_params_with_prefix().items()}
+
+    # same init, dp4×tp2 mesh, stock Trainer with partition_rules; the
+    # donation sanitizer rides along: the sharded fused update must not
+    # read donated buffers
+    mesh = parallel.make_mesh({"dp": 4, "tp": 2})
+    telemetry.enable()
+    sanitizer.enable()
+    try:
+        net = _mlp()
+        net.load_parameters(str(tmp_path / "init.params"))
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.1},
+                                partition_rules=_mlp_rules(net), mesh=mesh)
+        assert trainer.placement.summary()["sharded_params"] == 2
+        x = parallel.shard_batch(nd.array(xs), mesh)
+        y = parallel.shard_batch(nd.array(ys), mesh)
+        miss_per_step = []
+        sharded_losses = []
+        for _ in range(_STEPS):
+            with telemetry.step(examples=_BATCH) as scope:
+                with autograd.record():
+                    loss = loss_fn(net(x), y).mean()
+                loss.backward()
+                trainer.step(_BATCH)
+                nd.waitall()
+            sharded_losses.append(float(loss.asscalar()))
+            miss_per_step.append(
+                scope.record["counters"].get("trainer.fused_cache_miss", 0))
+        sharded_params = {name: p.data().asnumpy() for name, p in
+                          net._collect_params_with_prefix().items()}
+        import jax
+
+        w0 = net.collect_params().values()
+        shardings = [p.data()._data.sharding for p in w0
+                     if p.name.endswith("weight")]
+        assert all(isinstance(s, jax.sharding.NamedSharding)
+                   for s in shardings)
+    finally:
+        sanitizer.reset()
+        sanitizer.disable()
+        telemetry.disable()
+        parallel.set_mesh(None)
+
+    onp.testing.assert_allclose(sharded_losses, oracle_losses,
+                                rtol=1e-5, atol=1e-6)
+    for name in oracle_params:
+        onp.testing.assert_allclose(sharded_params[name],
+                                    oracle_params[name],
+                                    rtol=1e-5, atol=1e-6, err_msg=name)
+    # one fused-update compile, every later step replays from the cache
+    assert sum(miss_per_step) == miss_per_step[0] >= 1, miss_per_step
+    assert all(m == 0 for m in miss_per_step[1:]), miss_per_step
+
+
+def test_trainer_mesh_only_means_pure_dp():
+    mesh = parallel.make_mesh({"dp": 8})
+    try:
+        net = _mlp()
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.1}, mesh=mesh)
+        s = trainer.placement.summary()
+        assert s["sharded_params"] == 0 and s["replicated_params"] == 4
+    finally:
+        parallel.set_mesh(None)
+
+
+def test_trainer_partition_rules_without_mesh_raises():
+    parallel.set_mesh(None)
+    net = _mlp()
+    with pytest.raises(MXNetError, match="mesh"):
+        gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1},
+                      partition_rules=[(r".*", ())])
+
+
+# --- checkpoint round trip preserves shardings ------------------------------
+
+def test_checkpoint_roundtrip_preserves_shardings(tmp_path):
+    import jax
+
+    from mxnet_tpu import checkpoint
+
+    mesh = parallel.make_mesh({"dp": 4, "tp": 2})
+    try:
+        net = _mlp()
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.1},
+                                partition_rules=_mlp_rules(net), mesh=mesh)
+        first_w = next(p for p in net.collect_params().values()
+                       if p.name.endswith("weight"))
+        spec_before = first_w.data()._data.sharding.spec
+        saved = {name: p.data().asnumpy() for name, p in
+                 net._collect_params_with_prefix().items()}
+        checkpoint.save_checkpoint(str(tmp_path), 7, net, trainer)
+
+        # perturb, then resume: values restore AND placement survives the
+        # set_data path (no silent collapse to single-device)
+        for p in net.collect_params().values():
+            p.set_data(p.data() + 1.0)
+        step, _extra = checkpoint.resume(str(tmp_path), net, trainer)
+        assert step == 7
+        for name, p in net._collect_params_with_prefix().items():
+            onp.testing.assert_allclose(p.data().asnumpy(), saved[name],
+                                        rtol=1e-6, err_msg=name)
+        sh = first_w.data()._data.sharding
+        assert isinstance(sh, jax.sharding.NamedSharding)
+        assert sh.spec == spec_before
+        assert sh.mesh.shape == mesh.shape
+    finally:
+        parallel.set_mesh(None)
+
+
+def test_set_data_respects_existing_sharding():
+    import jax
+
+    mesh = parallel.make_mesh({"dp": 4, "tp": 2})
+    try:
+        net = _mlp()
+        parallel.place_params(net.collect_params(), _mlp_rules(net),
+                              mesh=mesh)
+        w = next(p for p in net.collect_params().values()
+                 if p.name.endswith("weight"))
+        spec = w.data()._data.sharding.spec
+        w.set_data(nd.ones(w.shape))
+        sh = w.data()._data.sharding
+        assert isinstance(sh, jax.sharding.NamedSharding)
+        assert sh.spec == spec
+        onp.testing.assert_allclose(w.data().asnumpy(),
+                                    onp.ones(w.shape, "float32"))
+    finally:
+        parallel.set_mesh(None)
+
+
+# --- elastic data assignment is layout-independent --------------------------
+
+def test_elastic_shard_for_step_unchanged_under_mesh():
+    from mxnet_tpu import elastic
+
+    base = [elastic.shard_for_step(103, 16, s, 4, 1) for s in range(3)]
+    mesh = parallel.make_mesh({"dp": 4, "tp": 2})
+    try:
+        parallel.set_mesh(mesh)
+        under = [elastic.shard_for_step(103, 16, s, 4, 1) for s in range(3)]
+    finally:
+        parallel.set_mesh(None)
+    for a, b in zip(base, under):
+        onp.testing.assert_array_equal(a, b)
+
+
+# --- placement telemetry -----------------------------------------------------
+
+def test_place_params_records_last_placement():
+    mesh = parallel.make_mesh({"dp": 4, "tp": 2})
+    try:
+        net = _mlp()
+        cov = parallel.place_params(net.collect_params(), _mlp_rules(net),
+                                    mesh=mesh)
+        assert cov.summary() == parallel.last_placement()
+        assert parallel.last_placement()["sharded_params"] == 2
+    finally:
+        parallel.set_mesh(None)

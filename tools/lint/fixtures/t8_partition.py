@@ -1,0 +1,51 @@
+"""T8 fixture: partition-rule tables with static hazards.
+
+Never imported — analyzed as source only (the mxnet_tpu import below
+resolves nothing at lint time)."""
+from mxnet_tpu import gluon, parallel
+from mxnet_tpu.parallel import PartitionRules, place_params
+
+# T8 error: pattern cannot compile — the rule can never match
+BROKEN = PartitionRules((
+    (r"(q|k|v_weight$", ("tp", None)),
+    (r".*", ()),
+))
+
+# T8 error: rules after the catch-all are dead under first-match-wins
+SHADOWED = PartitionRules((
+    (r".*", ()),
+    (r"(^|[._])q_weight$", ("tp", None)),
+))
+
+# T8 error: duplicate pattern — the second copy never fires
+DUPLICATE = PartitionRules((
+    (r"(^|[._])q_weight$", ("tp", None)),
+    (r"(^|[._])q_weight$", (None, "tp")),
+    (r".*", ()),
+))
+
+# T8 warning: tp specs but no terminal catch-all and no on_unmatched=
+# policy — every unmatched parameter silently replicates
+SILENT_TABLE = (
+    (r"(^|[._])(q|k|v)_weight$", ("tp", None)),
+    (r"(^|[._])o_weight$", (None, "tp")),
+)
+
+
+def silent_replicate_trainer(net, mesh):
+    return gluon.Trainer(net.collect_params(), "sgd",
+                         partition_rules=SILENT_TABLE, mesh=mesh)
+
+
+# ok: terminal catch-all makes the replicate fallback explicit
+GOOD = PartitionRules((
+    (r"(^|[._])(q|k|v)_weight$", ("tp", None)),
+    (r".*", ()),
+))
+
+
+def good_explicit_policy(params, mesh):
+    # ok: no catch-all, but the silent fallback is disabled outright
+    return place_params(params, (
+        (r"(^|[._])(q|k|v)_weight$", ("tp", None)),
+    ), mesh=mesh, on_unmatched="error")

@@ -21,10 +21,18 @@ T6  use-after-donation: a binding passed at a donated position of a
 T7  donation aliasing: the same array — or a view/member of the same
     parent — reaches a donating call at both a donated and another
     position, or is captured by the donated callee's closure.
+T8  partition-rule sanity: literal rule tables handed to
+    ``PartitionRules`` / ``Trainer(partition_rules=...)`` /
+    ``place_params`` with a pattern that cannot compile, a rule
+    statically unreachable (after a catch-all, or a duplicate pattern
+    under first-match-wins), or model-axis specs with no terminal
+    catch-all — unmatched parameters then silently replicate, which on
+    a mesh with a model axis is a memory regression that trains fine.
 """
 from __future__ import annotations
 
 import ast
+import re
 
 from .core import (Violation, SEVERITY_ERROR, SEVERITY_WARNING, dotted_name,
                    last_name)
@@ -39,6 +47,7 @@ RULES = {
     "T5": "in-place numpy mutation of a jax-backed buffer",
     "T6": "use of a buffer after it was donated to a jitted call",
     "T7": "aliased array reaches a donating call (donation aliasing)",
+    "T8": "partition-rule sanity (dead rule / silent replicate)",
 }
 
 # --- T1 ---------------------------------------------------------------------
@@ -323,6 +332,50 @@ def _all_returns_nondiff(fn) -> bool:
     return all(_returns_nondiff(r.value, fn) for r in returns)
 
 
+# --- T8 ---------------------------------------------------------------------
+
+#: regexes that match every parameter path — a rule after one of these
+#: is dead under first-match-wins
+_CATCH_ALL_PATTERNS = {"", ".*", ".+", "^.*", ".*$", "^.*$", "^.+$"}
+
+#: spec axis names that shard the MODEL (vs the batch): a table using
+#: these must say what happens to everything else
+_MODEL_AXES = {"tp", "ep", "mp", "sp", "model", "expert", "tensor"}
+
+
+def _literal_rule_table(node, src):
+    """``node`` as a literal ((pattern, spec), ...) rule table, following
+    one level of module-scope Name assignment.  Returns a list of
+    (pattern_str_or_None, spec_elements_or_None, ast_node) entries, or
+    None when the expression is not a literal table (dynamic tables are
+    the engine's problem at runtime, not the linter's)."""
+    if isinstance(node, ast.Name):
+        assigned = None
+        for stmt in src.tree.body:
+            if isinstance(stmt, ast.Assign) and any(
+                    isinstance(t, ast.Name) and t.id == node.id
+                    for t in stmt.targets):
+                assigned = stmt.value
+        node = assigned
+    if not isinstance(node, (ast.Tuple, ast.List)):
+        return None
+    entries = []
+    for elt in node.elts:
+        if not isinstance(elt, (ast.Tuple, ast.List)) or \
+                len(elt.elts) != 2:
+            return None  # not a (pattern, spec) table after all
+        pat = _const_str(elt.elts[0])
+        spec_node = elt.elts[1]
+        spec = None
+        if isinstance(spec_node, (ast.Tuple, ast.List)):
+            vals = [e.value for e in spec_node.elts
+                    if isinstance(e, ast.Constant)]
+            if len(vals) == len(spec_node.elts):
+                spec = vals
+        entries.append((pat, spec, elt))
+    return entries
+
+
 # ---------------------------------------------------------------------------
 # Per-file rule driver
 # ---------------------------------------------------------------------------
@@ -358,6 +411,8 @@ class FileChecker:
                     self._check_t4(node)
                 if self._on("T5"):
                     self._check_t5_mutator_call(node, t5_taint)
+                if self._on("T8"):
+                    self._check_t8(node)
             elif isinstance(node, (ast.If, ast.While, ast.Assert)) and hot:
                 if self._on("T2"):
                     self._check_t2(node)
@@ -454,6 +509,63 @@ class FileChecker:
                        f"{dotted}() inside a traced region is evaluated "
                        "once at trace time and baked in as a constant — "
                        "thread a jax PRNG key / pass timestamps as inputs")
+
+    # -- T8 ------------------------------------------------------------------
+    def _check_t8(self, call):
+        """Static sanity on LITERAL partition-rule tables at the sites
+        that consume them."""
+        name = last_name(call.func)
+        table_expr = None
+        if name == "PartitionRules" and call.args:
+            table_expr = call.args[0]
+        elif name == "place_params" and len(call.args) > 1:
+            table_expr = call.args[1]
+        if table_expr is None:
+            kw = _kw(call, "partition_rules") or _kw(call, "rules")
+            table_expr = kw
+        if table_expr is None:
+            return
+        entries = _literal_rule_table(table_expr, self.src)
+        if not entries:
+            return
+        seen, dead_after = {}, None
+        uses_model_axis = False
+        for pat, spec, node in entries:
+            if pat is None:
+                continue  # computed pattern: runtime's problem
+            try:
+                re.compile(pat)
+            except re.error as e:
+                self._emit("T8", SEVERITY_ERROR, node,
+                           f"partition rule pattern {pat!r} does not "
+                           f"compile ({e}) — it can never match a "
+                           "parameter")
+                continue
+            if dead_after is not None:
+                self._emit("T8", SEVERITY_ERROR, node,
+                           f"rule {pat!r} is unreachable: it follows the "
+                           f"catch-all {dead_after!r} and first match "
+                           "wins — reorder the table")
+            elif pat in seen:
+                self._emit("T8", SEVERITY_ERROR, node,
+                           f"duplicate pattern {pat!r}: first match wins, "
+                           "this rule never fires — merge or reorder")
+            seen[pat] = node
+            if pat.strip("$^") in ("", ".*", ".+") or \
+                    pat in _CATCH_ALL_PATTERNS:
+                dead_after = dead_after or pat
+            if spec and any(a in _MODEL_AXES for a in spec
+                            if isinstance(a, str)):
+                uses_model_axis = True
+        has_catch_all = dead_after is not None
+        explicit_policy = _kw(call, "on_unmatched") is not None
+        if uses_model_axis and not has_catch_all and not explicit_policy:
+            self._emit("T8", SEVERITY_WARNING, call,
+                       "rule table shards model axes but has no terminal "
+                       "catch-all and no on_unmatched= policy: unmatched "
+                       "parameters silently replicate over the mesh — add "
+                       "an explicit ('.*', ()) fallback or "
+                       "on_unmatched='error'")
 
     # -- T5 ------------------------------------------------------------------
     def _t5_taint(self):

@@ -17,12 +17,13 @@ Two workloads:
   fixed-capacity dispatch.  MIXTRAL_LOWER_r04.json.
 
 No parameter array is ever materialized: parameters enter the jitted
-step as ``jax.ShapeDtypeStruct`` avals sharded by the SAME rule tables
-the real placement path uses (``llama_param_pspecs`` /
-``moe_param_specs``), so what compiles here is exactly what would run
-on the slice.  The artifact records XLA's per-device memory analysis
+step as ``jax.ShapeDtypeStruct`` avals sharded by the SAME partition
+engine the real placement path uses (``parallel.PartitionRules`` family
+tables — what ``Trainer(..., partition_rules=...)`` and ``shard_llama``
+place with), so what compiles here is exactly what would run on the
+slice.  The artifact records XLA's per-device memory analysis
 (argument/temp/output bytes), the post-SPMD collective counts, and the
-old byte math alongside for comparison.
+rule-coverage report of the placement.
 
 Run: ``python tools/scale_proof.py llama8b32|mixtral [out.json]``
 (self-contained: forces the virtual CPU device count before jax init).
@@ -262,10 +263,20 @@ def main():
     batch = per_chip_batch * dp
 
     params, shapes, shells, n_params = _shell_params(net)
-    pspecs = llama.llama_param_pspecs(net, mesh)
+    # the partition ENGINE derives every spec — the same family table
+    # Trainer(partition_rules=...) places real arrays with; no specs
+    # are hand-rolled in this tool
+    from mxnet_tpu.parallel import partition as pt
+
+    family = "mixtral" if which == "mixtral" else "llama"
+    rules = pt.PartitionRules.for_family(family)
+    coverage = pt.Coverage()
+    pspecs = rules.specs(shapes, mesh, coverage=coverage)
+    if cfg.tie_embeddings:
+        pspecs.pop("lm_head.weight", None)
     # abstract step arguments: non-layer params by name, plus ONE
     # layer-stacked (L, ...) entry per layer-0 parameter (scan operand);
-    # stacking adds a leading unsharded axis to the layer-0 pspec
+    # stacking shifts the layer-0 pspec right of an unsharded stack axis
     n_layers = cfg.num_layers
     abs_shapes, abs_specs = {}, {}
     for name, shp in shapes.items():
@@ -275,7 +286,7 @@ def main():
             sfx = name[len(LAYER0_PREFIX):]
             abs_shapes["stacked_layers." + sfx] = (n_layers,) + shp
             abs_specs["stacked_layers." + sfx] = \
-                (None,) + tuple(pspecs.get(name, ()))
+                pt.stacked_spec(pspecs.get(name, ()))
         else:
             abs_shapes[name] = shp
             abs_specs[name] = tuple(pspecs.get(name, ()))
@@ -486,6 +497,8 @@ def main():
                    "experts_per_tok": cfg.num_experts_per_tok,
                    "attn_mode": "flash"},
         "n_params": n_params,
+        "partition_rules": family,
+        "partition_coverage": coverage.summary(),
         "mesh": spec["mesh"],
         "n_devices": spec["n_devices"],
         "global_batch_x_seq": [batch, seq],
