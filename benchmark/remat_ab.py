@@ -1,0 +1,235 @@
+#!/usr/bin/env python
+"""Remat-tier A/B lane: the auto policy vs forced tiers, same math.
+
+The question this artifact answers: does the auto-remat policy
+(``mxnet_tpu.memory.policy``) actually buy step time over the
+historical blanket per-layer ``jax.checkpoint`` — without changing a
+single loss bit?  Two models run each tier of the ladder
+(``none`` / ``dots`` / ``layer``) plus ``auto``:
+
+* ``mlp`` — stacked Dense layers via ``hybridize(remat=<tier>)`` (the
+  generic whole-graph checkpoint path);
+* ``llama_tiny`` — ``scan_layers=True`` decoder stack via
+  ``set_remat(<tier>)`` (per-decoder-layer checkpoint inside the scan).
+
+Per lane the harness records step times, compile-cache miss counters
+(steady state must replay: 0 misses after warmup), memwatch per-device
+peaks, the cost registry's XLA temp bytes (artifacts are stamped with
+the remat tier they compiled under), and the FULL loss trajectory —
+remat recomputes, it must never renumber.
+
+CPU validation run (exactly what ``tests/test_bench_smoke.py`` does)::
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    BENCH_PLATFORM=cpu python benchmark/remat_ab.py
+
+Artifact: REMAT_AB_r10.json (override MXT_REMAT_AB_OUT).
+Acceptance: loss trajectories bit-identical across every tier; compile
+once per lane; with BENCH_STEPS >= 6, the auto tier's median step is
+no slower than forced per-layer remat (auto picks the cheapest tier
+that fits — with headroom that is "none", which skips the backward
+recompute "layer" pays).
+"""
+from __future__ import annotations
+
+import gc
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+STEPS = int(os.environ.get("BENCH_STEPS", "6"))
+WARMUP = int(os.environ.get("BENCH_WARMUP", "2"))
+
+TIERS = ("none", "dots", "layer", "auto")
+
+_MISS_COUNTERS = ("trainer.fused_cache_miss", "cachedop.cache_miss")
+
+
+def _build_mlp(tier):
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+    from mxnet_tpu.gluon import loss as gloss, nn
+
+    hidden, layers, batch = 512, 6, 64
+    mx.random.seed(7)
+    net = nn.HybridSequential()
+    with net.name_scope():
+        for _ in range(layers):
+            net.add(nn.Dense(hidden, activation="relu"))
+        net.add(nn.Dense(16))
+    net.initialize(mx.init.Xavier())
+    net(nd.ones((1, hidden)))
+    net.hybridize(static_alloc=True, remat=tier)
+    loss_fn = gloss.L2Loss()
+    x = mx.random.uniform(shape=(batch, hidden))
+    y = mx.random.uniform(shape=(batch, 16))
+
+    def step_fn(net, trainer, batches, autograd):
+        x, y = batches
+        with autograd.record():
+            loss = loss_fn(net(x), y)
+        loss.backward()
+        trainer.step(x.shape[0])
+        return loss
+
+    return net, (x, y), step_fn
+
+
+def _build_llama_tiny(tier):
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+    from mxnet_tpu.models import llama
+
+    batch, seq = 8, 32
+    mx.random.seed(7)
+    net = llama.llama_tiny(scan_layers=True)
+    net.initialize(mx.init.Xavier())
+    net.set_remat(tier)
+    ids = nd.array(mx.random.uniform(
+        0, 256, shape=(batch, seq)).asnumpy().astype("int32"))
+    labels = nd.array(mx.random.uniform(
+        0, 256, shape=(batch, seq)).asnumpy().astype("int32"))
+    net(ids)
+    net.hybridize(static_alloc=True)
+
+    def step_fn(net, trainer, batches, autograd):
+        ids, labels = batches
+        with autograd.record():
+            lg = net(ids)
+            loss = nd.softmax_cross_entropy(
+                lg.reshape((-1, 256)), labels.reshape((-1,))).mean()
+        loss.backward()
+        trainer.step(ids.shape[0])
+        return loss
+
+    return net, (ids, labels), step_fn
+
+
+def _run_lane(build, tier):
+    from mxnet_tpu import autograd, gluon, nd, telemetry
+    from mxnet_tpu.memory import policy as mem_policy
+    from mxnet_tpu.telemetry import costs, memwatch
+
+    telemetry.enable()
+    costs.enable()
+    memwatch.enable()
+    mem_policy.reset()
+    try:
+        net, batches, step_fn = build(tier)
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.01})
+        miss_warmup = miss_steady = 0
+        times, losses = [], []
+        last_policy_field = None
+        for i in range(WARMUP + STEPS):
+            with telemetry.step(examples=batches[0].shape[0]) as scope:
+                loss = step_fn(net, trainer, batches, autograd)
+                loss.wait_to_read()
+                nd.waitall()
+            losses.append(float(loss.mean().asscalar()))
+            last_policy_field = scope.record.get("remat_policy")
+            misses = sum(scope.record["counters"].get(k, 0)
+                         for k in _MISS_COUNTERS)
+            if i < WARMUP:
+                miss_warmup += misses
+            else:
+                miss_steady += misses
+                times.append(scope.record["step_ms"])
+        peaks = memwatch.peak_live_bytes_by_device()
+        # the compiled graphs' XLA footprint, stamped with the tier they
+        # compiled under.  The backward's ARGUMENT bytes carry the saved
+        # activations (the vjp residuals) — the number remat shrinks.
+        temps = [e["temp_bytes"] for e in costs.snapshot()
+                 if e["kind"] in ("cachedop", "cachedop_bwd")]
+        bwd_args = [e["argument_bytes"] for e in costs.snapshot()
+                    if e["kind"] == "cachedop_bwd"]
+        pol = mem_policy.last_policy()
+        record = {
+            "tier": tier,
+            "resolved_tier": pol["tier"] if pol else tier,
+            "policy_mode": pol["mode"] if pol else None,
+            "steps": STEPS,
+            "warmup": WARMUP,
+            "loss_trajectory": losses,
+            "step_ms_median": round(statistics.median(times), 3),
+            "compile_miss_warmup": miss_warmup,
+            "compile_miss_steady": miss_steady,
+            "remat_policy_jsonl_field": last_policy_field,
+            "graph_temp_bytes_max": max(temps) if temps else 0,
+            "bwd_residual_bytes_max": max(bwd_args) if bwd_args else 0,
+            "peak_live_bytes_by_device": peaks,
+        }
+    finally:
+        memwatch.disable()
+        costs.disable()
+        telemetry.disable()
+        gc.collect()
+    return record
+
+
+def main():
+    plat = os.environ.get("BENCH_PLATFORM")
+    if plat:
+        import jax
+
+        jax.config.update("jax_platforms", plat)
+
+    import mxnet_tpu as mx
+    import mxnet_tpu.memory  # noqa: F401  (turns on the JSONL fields)
+
+    mx.random.seed(0)
+    t0 = time.time()
+    lanes = {}
+    for model, build in (("mlp", _build_mlp),
+                         ("llama_tiny", _build_llama_tiny)):
+        lanes[model] = {t: _run_lane(build, t) for t in TIERS}
+    acceptance = {}
+    for model, by_tier in lanes.items():
+        ref = by_tier["layer"]["loss_trajectory"]
+        acceptance[model] = {
+            "compile_once": all(r["compile_miss_steady"] == 0
+                                for r in by_tier.values()),
+            # remat recomputes; it must never renumber: every tier's
+            # trajectory is BIT-identical to the forced-layer lane
+            "loss_bit_identical_across_tiers": all(
+                r["loss_trajectory"] == ref for r in by_tier.values()),
+            "auto_resolved_concrete_tier":
+                by_tier["auto"]["resolved_tier"] in ("none", "dots",
+                                                     "layer"),
+        }
+        if STEPS >= 6:  # timing claims need real steps, not the smoke run
+            acceptance[model]["auto_not_slower_than_layer"] = (
+                by_tier["auto"]["step_ms_median"]
+                <= by_tier["layer"]["step_ms_median"])
+    record = {
+        "metric": "remat_auto_vs_layer_step_ratio",
+        "value": round(
+            lanes["llama_tiny"]["auto"]["step_ms_median"]
+            / max(1e-9, lanes["llama_tiny"]["layer"]["step_ms_median"]),
+            4),
+        "unit": "auto median step / forced-layer median step (llama_tiny)",
+        "tiers": list(TIERS),
+        "lanes": lanes,
+        "acceptance": acceptance,
+        "wall_sec": round(time.time() - t0, 1),
+        "platform": os.environ.get("JAX_PLATFORMS", plat or "default"),
+    }
+    line = json.dumps(record, indent=2, default=str)
+    print(line)
+    out_path = os.environ.get(
+        "MXT_REMAT_AB_OUT",
+        os.path.join(os.path.dirname(__file__), "..",
+                     "REMAT_AB_r10.json"))
+    with open(out_path, "w") as f:
+        f.write(line + "\n")
+    bad = {m: a for m, a in acceptance.items() if not all(a.values())}
+    if bad:
+        raise SystemExit(f"acceptance failed: {bad}")
+
+
+if __name__ == "__main__":
+    main()

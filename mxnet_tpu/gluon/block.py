@@ -403,10 +403,15 @@ class _CachedGraph:
     def __init__(self, block, params, training, remat=False):
         import jax
 
+        from ..memory import policy as _mem_policy
+
         self.block = block
         self.params = params
         self.training = training
-        self.remat = remat
+        # a remat TIER ("none" / "dots" / "layer"; bools accepted for
+        # compatibility) — "auto" is resolved by CachedOp before the
+        # graph is built, so a tier is concrete here
+        self.remat = _mem_policy.normalize(remat)
         self.struct = None
         self.aux_idx = ()
         self._compiled = set()  # dispatch modes that already paid compile
@@ -444,12 +449,14 @@ class _CachedGraph:
     def _record_fwd(self, p_raws, in_raws, key):
         import jax
 
-        fn = lambda p, x: self._pure(p, x, key)  # noqa: E731
-        if self.remat:
-            # activation checkpointing: backward recomputes the forward
-            # instead of holding every intermediate in HBM — the standard
-            # TPU trade of FLOPs for memory (enables much larger batches)
-            fn = jax.checkpoint(fn)
+        from ..memory.policy import checkpoint_wrap
+
+        # activation checkpointing per the resolved tier: backward
+        # recomputes (all of, or the non-dot parts of) the forward
+        # instead of holding every intermediate in HBM — the standard
+        # TPU trade of FLOPs for memory (enables much larger batches)
+        fn = checkpoint_wrap(lambda p, x: self._pure(p, x, key),
+                             self.remat)
         outs, vjp, auxs = jax.vjp(fn, list(p_raws), list(in_raws),
                                   has_aux=True)
         return outs, auxs, vjp
@@ -495,7 +502,7 @@ class _CachedGraph:
             # the registry without re-analysis
             _costs.note("cachedop", (id(self), mode),
                         self._fwd_rec if recording else self._fwd,
-                        (p_raws, in_raws, key))
+                        (p_raws, in_raws, key), remat=self.remat)
         for i, raw in zip(self.aux_idx, auxs):
             p_handles[i]._data = raw
         nd_outs = [NDArray(r) for r in outs]
@@ -503,6 +510,7 @@ class _CachedGraph:
             bwd = self._bwd
             graph_id = id(self)
             block_name = self.block.name
+            remat_tier = self.remat
 
             def node_vjp(cots):
                 try:
@@ -515,7 +523,7 @@ class _CachedGraph:
                     raise
                 if _costs._enabled:
                     _costs.note("cachedop_bwd", (graph_id, "bwd"), bwd,
-                                (vjp, tuple(cots)))
+                                (vjp, tuple(cots)), remat=remat_tier)
                 return tuple(p_cots) + tuple(in_cots)
 
             node = ag.Node(node_vjp, list(p_handles) + list(args),
@@ -558,6 +566,29 @@ class CachedOp:
     def _param_list(self):
         # stable ordering: collect_params is ordered by construction
         return list(self.block.collect_params().values())
+
+    def _resolve_remat(self, params, args, mesh, training):
+        """The remat tier this graph compiles with.  ``remat="auto"``
+        asks the planner for the cheapest tier that fits the device
+        budget (margin via ``remat_margin=``); a concrete tier (or the
+        historical bool) passes through.  Resolved once per cache miss
+        — the decision is stable per compile signature."""
+        from ..memory import policy as _mem_policy
+
+        tier = _mem_policy.normalize(self.flags.get("remat", False))
+        if tier != "auto":
+            if tier != "none":
+                _mem_policy.record_policy(tier, "forced")
+            return tier
+        batch_b = sum(
+            int(np.prod(a.shape)) * np.dtype(a.dtype).itemsize
+            for a in args)
+        tier, _plan = _mem_policy.auto_tier(
+            {f"p{i}": (p.shape, p.dtype) for i, p in enumerate(params)},
+            mesh=mesh, batch_bytes=batch_b,
+            margin=self.flags.get("remat_margin"))
+        telemetry.count(f"cachedop.remat_auto.{tier}")
+        return tier
 
     def __call__(self, *args):
         from .. import engine as _engine
@@ -614,8 +645,8 @@ class CachedOp:
             telemetry.count("cachedop.cache_miss")
             self._misses += 1
             with telemetry.span("cachedop.build"):
-                g = _CachedGraph(self.block, params, training,
-                                 remat=bool(self.flags.get("remat", False)))
+                tier = self._resolve_remat(params, args, mesh, training)
+                g = _CachedGraph(self.block, params, training, remat=tier)
             self._graphs[sig] = g
         else:
             telemetry.count("cachedop.cache_hit")

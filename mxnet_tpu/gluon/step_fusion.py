@@ -57,6 +57,21 @@ from .block import _trace_guard
 __all__ = ["FusedTrainStep"]
 
 
+def _mem_policy_tier():
+    """The last-selected remat tier, or None — probed via sys.modules so
+    the memory package stays unimported unless the user opted in."""
+    import sys
+
+    mem = sys.modules.get("mxnet_tpu.memory")
+    if mem is None:
+        return None
+    try:
+        pol = mem.policy.last_policy()
+        return pol["tier"] if pol is not None else None
+    except Exception:
+        return None
+
+
 class FusedTrainStep:
     """Compile ``steps_per_execution`` trainer steps into one dispatch.
 
@@ -329,9 +344,11 @@ class FusedTrainStep:
         if _costs._enabled:
             # registered BEFORE the donating dispatch: lower() reads only
             # avals, so the (about-to-be-donated) buffers are never touched
+            pol = _mem_policy_tier()
             _costs.note("step_fusion", (id(self), sig), fn,
                         (w_raws, m_raws, s_raws, aux_raws, t_v, key, lr_v,
-                         wd_v, consts, stacked if stacked else None))
+                         wd_v, consts, stacked if stacked else None),
+                        remat=pol)
         try:
             # publish the operands' platform so platform-conditional ops
             # (pallas flash) route correctly inside the fused trace even

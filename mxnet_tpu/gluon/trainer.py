@@ -120,7 +120,8 @@ def reset_drain():
 class Trainer:
     def __init__(self, params, optimizer, optimizer_params=None,
                  kvstore="device", compression_params=None,
-                 update_on_kvstore=None, partition_rules=None, mesh=None):
+                 update_on_kvstore=None, partition_rules=None, mesh=None,
+                 offload=None):
         if isinstance(params, (dict, ParameterDict)):
             params = list(params.values())
         if not isinstance(params, (list, tuple)):
@@ -177,6 +178,16 @@ class Trainer:
         self._kvstore = None
         self._update_on_kvstore = None
         self._fused_cache = {}  # sig -> jitted multi-tensor update
+        # offload="host": optimizer state + f32 masters live in host
+        # memory between steps (mxnet_tpu.memory.offload); the update
+        # donates transient device copies, so the donation contract and
+        # sanitizer are unchanged.  Frees n_state x params (+ masters)
+        # of HBM for configs near the budget wall.
+        if offload not in (None, "host"):
+            raise MXNetError(
+                f'offload must be None or "host", got {offload!r}')
+        self._offload = offload
+        self._offload_prefetched = {}
 
     def _check_contexts(self):
         contexts = None
@@ -211,6 +222,70 @@ class Trainer:
                 self._optimizer.create_state_multi_precision(
                     i, param.data())
             self._states_initialized[i] = True
+            if self._offload == "host":
+                from ..memory import offload as _mem_offload
+
+                for arr in self._offloaded_ndarrays(i):
+                    _mem_offload.stash(arr)
+
+    def _offloaded_ndarrays(self, i):
+        """The host-resident NDArrays of param i's optimizer state: the
+        f32 master (multi-precision) plus every flattened state
+        tensor."""
+        import numpy as np
+
+        st = self._states[i]
+        if st is None:
+            return []
+        param = self._params[i]
+        use_mp = self._optimizer.multi_precision and \
+            np.dtype(param.dtype).name in ("float16", "bfloat16")
+        arrs = []
+        if use_mp and isinstance(st, tuple) and len(st) == 2:
+            master, sub = st
+            arrs.append(master)
+            arrs.extend(opt._flatten_state(sub))
+        else:
+            arrs.extend(opt._flatten_state(st))
+        return arrs
+
+    def _prefetch_offloaded(self):
+        """Kick off async H2D of every host-stashed state buffer at the
+        TOP of the step, so the copies overlap the gradient allreduce
+        instead of serializing before the fused update."""
+        if self._offload != "host":
+            return
+        from ..memory import offload as _mem_offload
+
+        cache = {}
+        for i, param in enumerate(self._params):
+            if param.grad_req == "null" or not self._states_initialized[i]:
+                continue
+            for arr in self._offloaded_ndarrays(i):
+                cache[id(arr)] = _mem_offload.fetch(arr)
+        self._offload_prefetched = cache
+
+    def _fetch_offloaded(self, arr):
+        """The prefetched device copy of a host-stashed NDArray's
+        buffer, or a fresh H2D fetch (first step: states were created
+        after the prefetch point)."""
+        raw = self._offload_prefetched.pop(id(arr), None)
+        if raw is not None:
+            return raw
+        from ..memory import offload as _mem_offload
+
+        return _mem_offload.fetch(arr)
+
+    def _stash_offloaded(self, live):
+        """Move the freshly committed state buffers back to host (D2H,
+        async) after the update; the replaced host copies are released
+        from the accounting."""
+        from ..memory import offload as _mem_offload
+
+        for i in live:
+            for arr in self._offloaded_ndarrays(i):
+                _mem_offload.release(arr)
+                _mem_offload.stash(arr)
 
     def _init_kvstore(self):
         config = self._kvstore_params
@@ -298,8 +373,10 @@ class Trainer:
                 self._init_kvstore()
             if self._update_on_kvstore:
                 self._sync_kvstore_hparams()
+            self._prefetch_offloaded()
             self._allreduce_grads()
             self._update(ignore_stale_grad)
+            self._offload_prefetched = {}
 
     def allreduce_grads(self):
         if not self._kv_initialized:
@@ -365,8 +442,23 @@ class Trainer:
                 self._kvstore.pull(i, param.data())
                 continue
             self._init_states(i)
-            self._optimizer.update_multi_precision(
-                i, param.data(), param.grad(), self._states[i])
+            if self._offload == "host":
+                # eager fallback: rebind the host-resident optimizer
+                # tensors to device copies for the in-place update, then
+                # send the results back to host
+                from ..memory import offload as _mem_offload
+                offed = self._offloaded_ndarrays(i)
+                for arr in offed:
+                    raw = self._fetch_offloaded(arr)
+                    _mem_offload.release(arr)
+                    arr._data = raw
+                self._optimizer.update_multi_precision(
+                    i, param.data(), param.grad(), self._states[i])
+                for arr in offed:
+                    _mem_offload.stash(arr)
+            else:
+                self._optimizer.update_multi_precision(
+                    i, param.data(), param.grad(), self._states[i])
 
     # -- fused multi-tensor update -------------------------------------------
     # The reference fuses optimizer updates across params into single
@@ -458,9 +550,18 @@ class Trainer:
         import jax.numpy as jnp
 
         w_raws = tuple(w._data for w in weights)
-        m_raws = tuple(m._data for m in masters if m is not None)
+        if self._offload == "host":
+            # state/masters are host-resident: feed (prefetched) device
+            # copies to the donating jit — the donated buffers are the
+            # transients, never the host originals
+            m_raws = tuple(self._fetch_offloaded(m)
+                           for m in masters if m is not None)
+            s_raws = tuple(tuple(self._fetch_offloaded(s) for s in ss)
+                           for ss in states)
+        else:
+            m_raws = tuple(m._data for m in masters if m is not None)
+            s_raws = tuple(tuple(s._data for s in ss) for ss in states)
         g_raws = tuple(g._data for g in grads)
-        s_raws = tuple(tuple(s._data for s in ss) for ss in states)
         lr_v = jnp.asarray(lrs, jnp.float32)
         wd_v = jnp.asarray(wds, jnp.float32)
         t_v = jnp.asarray(ts, jnp.int32)
@@ -495,6 +596,10 @@ class Trainer:
                 "multi-tensor update, donate_argnums=(0, 1, 3))")
         opt._commit_param_updates(self, live, mp_flags, masters,
                                   new_w, new_m, new_s)
+        if self._offload == "host":
+            # holders now point at the fresh device results; move the
+            # optimizer side back to host for the inter-step window
+            self._stash_offloaded(live)
         return True
 
     # -- state persistence (reference: Trainer.save_states/load_states) ------

@@ -1,0 +1,49 @@
+"""Memory as a managed budget: planner + auto-remat + host offload.
+
+PR 5 made memory *observable* (the memwatch ledger and the
+per-signature XLA memory analysis in ``telemetry.costs``); this package
+makes it *actionable* — the policy layer of MXNet 1.x's graph-executor
+memory planner, rebuilt for the XLA world:
+
+- :mod:`.planner` — pre-dispatch per-device peak prediction and
+  fit/no-fit verdicts against the device budget (15.75 GiB on v5e);
+- :mod:`.policy` — the remat tier ladder (none → dots → layer) and the
+  auto policy that picks the cheapest tier that fits;
+- :mod:`.offload` — host-resident optimizer state behind
+  ``Trainer(offload="host")``;
+- :mod:`.lowering` — the offline AOT-lowering engine (extracted from
+  ``tools/scale_proof.py``, which now consumes it).
+
+``telemetry.step_end`` and ``memwatch.write_postmortem`` probe this
+module via ``sys.modules`` — importing it is what turns on the JSONL
+fields and the OOM prescription; nothing here runs on the step hot
+path otherwise.  See docs/memory.md.
+"""
+from . import lowering, offload, planner, policy
+from .planner import (Plan, budget_bytes, last_plan, plan_from_artifact,
+                      plan_model, prescribe, set_budget)
+from .policy import TIERS, auto_tier, checkpoint_wrap, select_tier
+
+__all__ = [
+    "Plan", "TIERS", "auto_tier", "budget_bytes", "checkpoint_wrap",
+    "last_plan", "lowering", "offload", "plan_from_artifact",
+    "plan_model", "planner", "policy", "prescribe", "select_tier",
+    "set_budget", "telemetry_fields",
+]
+
+
+def telemetry_fields():
+    """The per-step JSONL fields this package contributes (probed by
+    ``telemetry.step_end``; keys appear only once the corresponding
+    mechanism has actually been used)."""
+    out = {}
+    pol = policy.last_policy()
+    if pol is not None:
+        out["remat_policy"] = pol["tier"]
+    plan = planner.last_plan()
+    if plan is not None:
+        out["predicted_peak_bytes"] = plan.predicted_peak_bytes
+    off = offload.resident_bytes()
+    if off:
+        out["offload_bytes"] = off
+    return out

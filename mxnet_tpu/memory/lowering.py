@@ -1,0 +1,215 @@
+"""Offline AOT-lowering engine behind the HBM planner's cold path.
+
+Extracted from ``tools/scale_proof.py`` (which now consumes this module
+— the same library-extraction move PR 9 made for partition rules): the
+shell-parameter trick, the scan-over-stacked-layers remat forward, the
+XLA memory-analysis harvest, the XLA:CPU bf16-upcast correction, and
+the fit-verdict construction.  ``tools/scale_proof.py`` remains the CLI
+that turns these into committed ``*_LOWER_*.json`` artifacts; the
+planner (:mod:`mxnet_tpu.memory.planner`) calls the same functions when
+a cold signature needs a real lowering, and reads the committed
+artifacts back when offline TPU lowering is unavailable (libtpu holds a
+process-wide lockfile and is absent on CI).
+
+Nothing here materializes a parameter array: parameters enter the
+jitted step as ``jax.ShapeDtypeStruct`` avals sharded by the SAME
+partition engine the real placement path uses.
+"""
+import glob
+import os
+import re
+
+#: v5e usable-HBM budget the topology compiler enforces (observed:
+#: "Used 15.78G of 15.75G hbm" RESOURCE_EXHAUSTED on overflow).
+TPU_BUDGET_GIB = 15.75
+
+LAYER0_PREFIX = "model.layers.0."
+
+
+def shell_params(net):
+    """Replace every Parameter's storage with an empty shell handle:
+    tracing swaps tracers into ``._data`` so no real array is needed
+    (the CachedOp handle-swap trick, gluon/block.py _CachedGraph).
+    Returns ``(params, shapes, shells, n_params)``."""
+    import numpy as np
+
+    from ..ndarray import NDArray
+
+    params = net._collect_params_with_prefix()
+    shapes, shells = {}, {}
+    for name, p in params.items():
+        shape = tuple(int(s) for s in (p.shape or ()))
+        assert shape and all(s > 0 for s in shape), \
+            f"{name} shape not fully declared: {p.shape}"
+        shapes[name] = shape
+        a = NDArray.__new__(NDArray)
+        a._data = None
+        a._node = None
+        a._oidx = 0
+        a._req_grad = False
+        a._grad = None
+        a._grad_req = "null"
+        p._data = a
+        shells[name] = a
+    n_params = sum(int(np.prod(s)) for s in shapes.values())
+    return params, shapes, shells, n_params
+
+
+def remat_forward(net, shells, p_raws, ids_r, head=True,
+                  remat="layer", act_sharding=None):
+    """embed -> lax.scan(checkpoint_wrap(layer)) -> norm -> head.
+
+    Same math as ``LlamaModel.hybrid_forward`` + ``_lm_head``, shaped
+    the way a production TPU trainer compiles it (r4 memory findings):
+
+    - **scan over stacked layer params** (p_raws carries ONE (L, ...)
+      array per layer parameter; the layer-0 Block is the template,
+      handle-swapped per iteration — the pipeline machinery's trick).
+      A python layer loop gave XLA one copy of every per-layer buffer
+      (collective buffers included): ~1 GiB x L of temp that scan
+      eliminates by construction, and L x faster tracing.
+    - **the remat tier wraps the scan body** (``policy.checkpoint_wrap``
+      — "layer" keeps only the (L, B, T, H) layer-boundary stack for
+      the backward; "dots" saves matmul outputs; "none" saves all).
+    - **one-hot MATMUL embedding lookup**: the transpose of a gather
+      over the vocab-sharded table is a scatter-add that GSPMD lowers
+      by materializing the FULL f32 table per device (measured 2
+      GiB/device on 8B); as a matmul, lookup AND gradient are ordinary
+      sharded contractions.
+    - ``act_sharding`` pins the residual stream (P('dp', None, None))
+      at the scan boundary so GSPMD can't replicate it over dp.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from ..ndarray import NDArray
+    from .policy import checkpoint_wrap
+
+    def pin(x):
+        if act_sharding is not None:
+            return jax.lax.with_sharding_constraint(x, act_sharding)
+        return x
+
+    for name, sh in shells.items():
+        if not name.startswith("model.layers."):
+            sh._data = p_raws[name]
+    table = p_raws["model.embed_tokens.weight"]
+    onehot = jax.nn.one_hot(ids_r, table.shape[0], dtype=table.dtype)
+    h = pin(jnp.einsum("btv,vh->bth", onehot, table))
+
+    template = net.model.layers[0]
+    suffixes = [n[len(LAYER0_PREFIX):] for n in shells
+                if n.startswith(LAYER0_PREFIX)]
+
+    def apply_layer(pslice, hr):
+        for sfx in suffixes:
+            shells[LAYER0_PREFIX + sfx]._data = pslice[sfx]
+        return pin(template(NDArray(hr))._data)
+
+    wrapped = checkpoint_wrap(apply_layer, remat)
+
+    def body(hr, pslice):
+        return wrapped(pslice, hr), ()
+
+    stacked = {sfx: p_raws["stacked_layers." + sfx] for sfx in suffixes}
+    h, _ = lax.scan(body, h, stacked)
+
+    h = net.model.norm(NDArray(h))._data
+    if not head:
+        return h
+    if net._cfg.tie_embeddings:
+        return h @ p_raws["model.embed_tokens.weight"].T
+    return net.lm_head(NDArray(h))._data
+
+
+def cpu_upcast_artifact_bytes(n_layers, dump_dir):
+    """Sum the preallocated-temp slots that are f32 CONVERTS of bf16
+    layer-stacked arrays (shape leading dim == n_layers, producer a
+    convert fusion) in the dumped buffer assignment — the XLA:CPU
+    bf16-dot upcast artifact quantified in the fit verdict.  Returns
+    (bytes, [slot descriptions])."""
+    files = glob.glob(os.path.join(dump_dir, "*buffer-assignment.txt"))
+    if not files:
+        return 0, []
+    txt = open(max(files, key=os.path.getmtime)).read()
+    m = re.search(r"allocation \d+: size \d+, preallocated-temp:(.*?)"
+                  r"(?=\nallocation |\Z)", txt, re.S)
+    if not m:
+        return 0, []
+    slots = {}
+    for name, sz, off, shape in re.findall(
+            r"value: <\d+ ([\w.\-]+) @0> \(size=(\d+),offset=(\d+)\): "
+            r"(\S+)", m.group(1)):
+        slots.setdefault((int(off), int(sz)), []).append((name, shape))
+    total, picked = 0, []
+    for (off, sz), vals in slots.items():
+        for name, shape in vals:
+            if re.match(rf"f32\[{n_layers},", shape) and "convert" in name:
+                total += sz
+                picked.append(f"{shape} {name} ({sz / 2**20:.0f} MB)")
+                break
+    return total, picked
+
+
+def harvest_memory(compiled):
+    """XLA ``memory_analysis()`` of a compiled executable as a plain
+    dict of the five per-device ``*_size_in_bytes`` figures (the keys
+    every committed ``xla_memory_analysis_per_device`` block carries)."""
+    mem = {}
+    try:
+        ma = compiled.memory_analysis()
+        for k in ("argument_size_in_bytes", "output_size_in_bytes",
+                  "alias_size_in_bytes", "temp_size_in_bytes",
+                  "generated_code_size_in_bytes"):
+            v = getattr(ma, k, None)
+            if v is not None:
+                mem[k] = int(v)
+    except Exception as e:
+        mem["unavailable"] = str(e)
+    return mem
+
+
+def fit_verdict(mem, backend, cpu_artifact_b=0, cpu_artifact_slots=()):
+    """The fit-verdict block of a lowering artifact, byte-identical in
+    shape to what scale_proof has committed since r4.
+
+    TPU backend: the STRONGEST signal is that the compile SUCCEEDED at
+    all — the topology compiler enforces the device's usable HBM budget
+    (15.75 GiB on v5e) and fails RESOURCE_EXHAUSTED when the scheduled
+    program exceeds it; args+temp is a supplementary upper bound.
+
+    CPU backend: args+temp resident, minus the XLA:CPU bf16-upcast
+    artifact (f32 LICM-hoisted converts of bf16 stacks a TPU lowering
+    never materializes), against a raw 16 GiB budget.
+    """
+    if "argument_size_in_bytes" not in mem:
+        return {}
+    args_b = mem["argument_size_in_bytes"]
+    temp_b = mem.get("temp_size_in_bytes", 0)
+    resident = args_b + temp_b
+    if backend == "tpu":
+        return {
+            "fits_hbm_compiler_enforced": True,
+            "compiler_enforced_budget_gib": TPU_BUDGET_GIB,
+            "resident_bytes_per_device_args_plus_temp": resident,
+            "resident_gib_per_device_upper_bound": round(
+                resident / 2 ** 30, 2),
+            "upper_bound_note": "args+temp, ignores donation aliasing "
+                                "— the compiler's own scheduler fit is "
+                                "the load-bearing verdict",
+        }
+    corrected = resident - cpu_artifact_b
+    return {
+        "resident_bytes_per_device_args_plus_temp": resident,
+        "resident_gib_per_device": round(resident / 2 ** 30, 2),
+        "cpu_bf16_upcast_artifact_bytes": cpu_artifact_b,
+        "cpu_bf16_upcast_artifact_gib": round(
+            cpu_artifact_b / 2 ** 30, 2),
+        "cpu_bf16_upcast_artifact_slots": list(cpu_artifact_slots),
+        "resident_gib_corrected_for_cpu_artifact": round(
+            corrected / 2 ** 30, 2),
+        "hbm_budget_gib": 16.0,
+        "fits_16gib_raw_cpu_analysis": bool(resident < 16 * 2 ** 30),
+        "fits_16gib_corrected": bool(corrected < 16 * 2 ** 30),
+    }

@@ -1,0 +1,125 @@
+"""Host-offloaded optimizer state: momentum / f32 masters in host RAM.
+
+``Trainer(..., offload="host")`` keeps the per-parameter optimizer
+state and multi-precision masters OFF the accelerator between steps:
+
+- at init (and after every commit) state buffers live in host memory —
+  on TPU via the ``pinned_host`` memory kind of the array's own
+  sharding (layout-preserving, so H2D is a straight DMA); on backends
+  without memory kinds (CPU CI) the movement degenerates to a
+  same-device copy but the contract — fetch, donate the fetched copy,
+  stash the result back — is exercised identically;
+- at step time the trainer FETCHES device copies (async ``device_put``,
+  overlappable with grad allreduce), feeds those to the donating fused
+  update exactly as device-resident state would be fed (donation
+  contract and sanitizer unchanged — the donated buffers are the
+  transient device copies), and STASHES the fresh state back to host
+  without blocking the step.
+
+The module keeps byte counters (`offload_bytes` in the per-step JSONL
+rides :func:`resident_bytes`) and per-step H2D/D2H traffic lands in the
+telemetry counters ``offload.h2d_bytes`` / ``offload.d2h_bytes``.
+"""
+
+_resident_bytes = 0     # bytes currently parked in host memory
+_h2d_total = 0
+_d2h_total = 0
+
+
+def resident_bytes():
+    """Optimizer-state bytes currently host-resident (0 when no
+    offloading trainer is live)."""
+    return _resident_bytes
+
+
+def stats():
+    return {"resident_bytes": _resident_bytes,
+            "h2d_bytes_total": _h2d_total, "d2h_bytes_total": _d2h_total}
+
+
+def _nbytes(raw):
+    import numpy as np
+
+    return int(np.prod(raw.shape)) * np.dtype(raw.dtype).itemsize
+
+
+def _host_sharding(raw):
+    """The array's own sharding re-homed to host memory, or None when
+    the backend has no addressable host memory kind (CPU CI)."""
+    try:
+        sh = raw.sharding.with_memory_kind("pinned_host")
+        # probe: device_put below raises on backends that advertise the
+        # kind but cannot transfer to it
+        return sh
+    except Exception:
+        return None
+
+
+def _count(name, n):
+    try:
+        from .. import telemetry
+
+        telemetry.count(name, n)
+    except Exception:
+        pass
+
+
+def stash(arr):
+    """Move an NDArray's buffer to host memory in place (D2H, async).
+    Returns the NDArray; a backend without host memory kinds keeps the
+    buffer where it is (copy elided) but still books it as
+    host-resident so the accounting is backend-independent."""
+    global _resident_bytes, _d2h_total
+    import jax
+
+    raw = arr._data
+    host = _host_sharding(raw)
+    if host is not None:
+        try:
+            raw = jax.device_put(raw, host)
+        except Exception:
+            pass
+    arr._data = raw
+    n = _nbytes(raw)
+    _resident_bytes += n
+    _d2h_total += n
+    _count("offload.d2h_bytes", n)
+    return arr
+
+
+def fetch(arr):
+    """Device copy of a host-stashed NDArray's buffer (H2D, async).
+    Returns the RAW device array — the caller feeds it to a donating
+    jitted call; the NDArray keeps its host buffer until the fresh
+    result is stashed over it."""
+    global _h2d_total
+    import jax
+
+    raw = arr._data
+    n = _nbytes(raw)
+    _h2d_total += n
+    _count("offload.h2d_bytes", n)
+    try:
+        sharding = raw.sharding
+        kind = getattr(sharding, "memory_kind", None)
+        if kind and kind != "device":
+            return jax.device_put(raw, sharding.with_memory_kind("device"))
+    except Exception:
+        pass
+    # no memory kinds (CPU CI): an explicit copy keeps the donation
+    # contract honest — the donated buffer is the transient copy, never
+    # the host-resident original
+    return jax.device_put(raw, raw.sharding)
+
+
+def release(arr):
+    """Book an offloaded NDArray's buffer as no longer host-resident
+    (called when a fresh result replaces it)."""
+    global _resident_bytes
+    _resident_bytes = max(0, _resident_bytes - _nbytes(arr._data))
+
+
+def reset():
+    """Drop all counters (tests)."""
+    global _resident_bytes, _h2d_total, _d2h_total
+    _resident_bytes = _h2d_total = _d2h_total = 0

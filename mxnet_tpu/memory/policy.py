@@ -1,0 +1,118 @@
+"""Auto-remat policy: a small tier ladder picked by the planner.
+
+Three tiers trade recompute FLOPs for activation memory:
+
+- ``"none"``   — save every activation; zero recompute (cheapest step).
+- ``"dots"``   — ``jax.checkpoint_policies.dots_saveable``: matmul
+  outputs survive to the backward, elementwise chains recompute.
+- ``"layer"``  — full ``jax.checkpoint`` at the natural block boundary
+  (the per-decoder-layer scan body for llama; the whole traced graph
+  for a generic hybridized block): only boundary activations survive.
+
+``"auto"`` asks the planner for the cheapest tier that fits the device
+budget with a configurable margin — models with headroom stop paying
+blanket recompute.  Every remat decision in the tree flows through
+:func:`checkpoint_wrap`; hand-rolled ``jax.checkpoint`` in model code
+is an mxlint T9 violation.
+"""
+
+TIERS = ("none", "dots", "layer")
+
+#: historical/bool spellings accepted at every remat surface
+_ALIASES = {
+    None: "none", False: "none", True: "layer",
+    "full": "layer", "per_layer": "layer", "per-layer": "layer",
+    "dots_saveable": "dots",
+}
+
+#: default headroom the auto policy insists on below the device budget
+DEFAULT_MARGIN = 0.10
+
+#: last auto-policy decision, for telemetry's ``remat_policy`` field
+#: and the OOM prescription: {"tier", "mode", "predicted_peak_bytes"}
+_last_policy = None
+
+
+def normalize(tier):
+    """Canonical tier name for any accepted spelling ("auto" passes
+    through); raises on garbage rather than silently not remat-ing."""
+    t = _ALIASES.get(tier, tier)
+    if t == "auto" or t in TIERS:
+        return t
+    raise ValueError(
+        f"unknown remat tier {tier!r}: expected one of {TIERS + ('auto',)}")
+
+
+def checkpoint_wrap(fn, tier):
+    """Wrap ``fn`` per the (normalized) tier — the ONE sanctioned
+    ``jax.checkpoint`` site for model code."""
+    t = normalize(tier)
+    if t == "auto":
+        raise ValueError("resolve 'auto' via select_tier()/auto_tier() "
+                         "before wrapping")
+    if t == "none":
+        return fn
+    import jax
+
+    if t == "dots":
+        return jax.checkpoint(
+            fn, policy=jax.checkpoint_policies.dots_saveable)
+    return jax.checkpoint(fn)
+
+
+def record_policy(tier, mode, plan=None):
+    """Note the decision (telemetry reads it back via
+    ``memory.telemetry_fields``)."""
+    global _last_policy
+    _last_policy = {
+        "tier": tier, "mode": mode,
+        "predicted_peak_bytes": (
+            int(plan.predicted_peak_bytes) if plan is not None else None),
+    }
+    return _last_policy
+
+
+def last_policy():
+    return _last_policy
+
+
+def reset():
+    """Forget the last decision (benchmark/test lane isolation)."""
+    global _last_policy
+    _last_policy = None
+
+
+def select_tier(plan_for_tier, margin=None, record=True):
+    """Cheapest tier whose plan fits with ``margin`` headroom below the
+    budget; escalates up the ladder, settling on "layer" (the most
+    memory-frugal tier) even when nothing fits — the plan's ``fits``
+    flag carries the bad news.  ``plan_for_tier(tier) -> Plan``.
+    Returns ``(tier, plan)``."""
+    margin = DEFAULT_MARGIN if margin is None else margin
+    tier, plan = None, None
+    for tier in TIERS:
+        plan = plan_for_tier(tier)
+        if plan.predicted_peak_bytes <= plan.budget_bytes * (1 - margin):
+            break
+    if record:
+        record_policy(tier, "auto", plan)
+    return tier, plan
+
+
+def auto_tier(params, mesh=None, rules=None, optimizer=None,
+              batch_bytes=0, activation_hint=None, budget=None,
+              margin=None, record=True):
+    """Resolve "auto" for a concrete model: plan each tier with the
+    analytic planner and return ``(tier, plan)`` via
+    :func:`select_tier`.  ``params`` as accepted by
+    :func:`planner.plan_model`; ``activation_hint`` (bytes at tier
+    "none") scales down the ladder when the caller measured it."""
+    from . import planner
+
+    def plan_for(tier):
+        return planner.plan_model(
+            params, mesh=mesh, rules=rules, optimizer=optimizer,
+            batch_bytes=batch_bytes, remat=tier,
+            activation_hint=activation_hint, budget=budget)
+
+    return select_tier(plan_for, margin=margin, record=record)

@@ -164,13 +164,17 @@ class LlamaAttention(HybridBlock):
         self._rope_cache = {}
 
     def _rope(self, t):
+        # cache the NUMPY tables, never device arrays: jnp.asarray
+        # under an active trace stages a constant owned by THAT trace,
+        # and caching it leaks a stale tracer into the next retrace
+        # (e.g. when the scan machinery rebuilds for a new remat tier)
         if t not in self._rope_cache:
-            import jax.numpy as jnp
+            self._rope_cache[t] = _rope_tables(t, self._cfg.head_dim,
+                                               self._cfg.rope_theta)
+        import jax.numpy as jnp
 
-            cos, sin = _rope_tables(t, self._cfg.head_dim,
-                                    self._cfg.rope_theta)
-            self._rope_cache[t] = (jnp.asarray(cos), jnp.asarray(sin))
-        return self._rope_cache[t]
+        cos, sin = self._rope_cache[t]
+        return jnp.asarray(cos), jnp.asarray(sin)
 
     def hybrid_forward(self, F, x, **params):
         from ..ops.registry import apply_op
@@ -311,6 +315,19 @@ class LlamaForCausalLM(HybridBlock):
     def hybrid_forward(self, F, input_ids):
         h = self.model(input_ids)
         return _lm_head(self, h)
+
+    def set_remat(self, tier):
+        """Set the decoder-stack remat tier ("none" / "dots" / "layer"
+        / "auto"; see ``mxnet_tpu.memory.policy``).  "auto" asks the
+        planner for the cheapest tier that fits the device budget at
+        first forward.  Default is "layer" — the historical blanket
+        per-decoder-layer ``jax.checkpoint``.  Rebuilds the scan
+        machinery, so the next step retraces."""
+        from ..memory import policy as _mem_policy
+
+        self.model._remat = _mem_policy.normalize(tier)
+        self.model._scan_mach = None
+        return self
 
     def generate(self, input_ids, max_new_tokens=16, use_cache=True,
                  do_sample=False, temperature=1.0, top_k=0, top_p=1.0,
@@ -842,7 +859,8 @@ def llama_pipeline_forward(net, input_ids, n_microbatches, mesh=None,
 
 def _apply_layers_scanned(model, h):
     """cfg.scan_layers: apply the decoder stack as
-    ``lax.scan(jax.checkpoint(layer))`` over a stacked parameter tree.
+    ``lax.scan(checkpoint_wrap(layer, tier))`` over a stacked parameter
+    tree, the tier resolved by the memory policy (default "layer").
 
     The layer-0 Block is the compile template (handle-swap per
     iteration, the pipeline machinery's trick), so the stack traces and
@@ -856,7 +874,7 @@ def _apply_layers_scanned(model, h):
     from ..ops import tensor as tops
     from ..ops.registry import apply_op
 
-    mach = _scan_machinery(model)
+    mach = _scan_machinery(model, _resolve_model_remat(model, h))
     names, shells = mach["names"], mach["shells"]
     per_layer = [ly._collect_params_with_prefix()
                  for ly in model.layers]
@@ -883,13 +901,37 @@ def _layer_template(layers):
     return template, names, shells
 
 
-def _scan_machinery(model):
-    """Cached per-model scan plumbing (identity-stable like
-    :func:`_pipeline_machinery`, so jit caches hit across steps)."""
+def _resolve_model_remat(model, h):
+    """The decoder stack's remat tier: ``set_remat()``'s choice, the
+    planner's pick for "auto" (cheapest tier that fits, sized at the
+    live activation shape), or the historical "layer" default."""
+    from ..memory import policy as _mem_policy
+
+    tier = _mem_policy.normalize(getattr(model, "_remat", "layer"))
+    if tier != "auto":
+        if tier != "none":
+            _mem_policy.record_policy(tier, "forced")
+        return tier
+    import numpy as np
+
+    from .. import parallel
+
+    batch_b = int(np.prod(h.shape)) * np.dtype(h.dtype).itemsize
+    tier, _plan = _mem_policy.auto_tier(
+        model, mesh=parallel.current_mesh(), batch_bytes=batch_b)
+    return tier
+
+
+def _scan_machinery(model, remat="layer"):
+    """Cached per-(model, remat-tier) scan plumbing (identity-stable
+    like :func:`_pipeline_machinery`, so jit caches hit across steps;
+    a tier change rebuilds)."""
     cache = getattr(model, "_scan_mach", None)
-    if cache is not None:
+    # remat is a host-side tier string, never a tracer
+    if cache is not None and cache["remat"] == remat:  # mxlint: allow=T2
         return cache
     from ..gluon.block import _trace_guard
+    from ..memory.policy import checkpoint_wrap
     from ..ndarray import NDArray
 
     template, names, shells = _layer_template(list(model.layers))
@@ -902,11 +944,13 @@ def _scan_machinery(model):
 
     import jax
 
+    wrapped = checkpoint_wrap(apply_one, remat)
+
     def _scan_raw(hr, *stk):
         from jax import lax
 
         def body(carry, sl):
-            return jax.checkpoint(apply_one)(sl, carry), ()
+            return wrapped(sl, carry), ()
 
         out, _ = lax.scan(body, hr, tuple(stk))
         return out
@@ -918,7 +962,7 @@ def _scan_machinery(model):
     fn = jax.jit(_scan_raw)
 
     cache = {"names": names, "shells": shells, "fn": fn,
-             "apply_one": apply_one}
+             "apply_one": apply_one, "remat": remat}
     model._scan_mach = cache
     return cache
 

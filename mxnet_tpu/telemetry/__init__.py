@@ -413,6 +413,14 @@ def step_end(examples=None, **extra):
                         placement["replicated_params"]
             except Exception:
                 pass  # telemetry never raises into training
+        # memory-budget context: remat_policy / predicted_peak_bytes /
+        # offload_bytes, only once mxnet_tpu.memory has been imported
+        mem = sys.modules.get("mxnet_tpu.memory")
+        if mem is not None:
+            try:
+                record.update(mem.telemetry_fields())
+            except Exception:
+                pass  # telemetry never raises into training
         record.update(extra)
         sinks = list(_sinks)
     for s in sinks:

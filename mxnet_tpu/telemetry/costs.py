@@ -69,12 +69,13 @@ class _Artifact:
     __slots__ = ("kind", "key", "flops", "bytes_accessed", "output_bytes",
                  "temp_bytes", "argument_bytes", "alias_bytes",
                  "generated_code_bytes", "executions", "error",
-                 "mesh_shape")
+                 "mesh_shape", "remat")
 
-    def __init__(self, kind, key):
+    def __init__(self, kind, key, remat=None):
         self.kind = kind
         self.key = key
         self.mesh_shape = _current_mesh_shape()
+        self.remat = remat
         self.flops = 0.0
         self.bytes_accessed = 0.0
         self.output_bytes = 0
@@ -99,6 +100,7 @@ class _Artifact:
             "executions": self.executions,
             "error": self.error,
             "mesh_shape": self.mesh_shape,
+            "remat": self.remat,
         }
 
 
@@ -120,12 +122,12 @@ def _current_mesh_shape():
         return None
 
 
-def _analyze(kind, key, jfn, args):
+def _analyze(kind, key, jfn, args, remat=None):
     """lower+compile at the concrete args' avals and harvest the
     analyses.  jax caches lowering/compilation per (fn, avals), so when
     the site just executed the same signature this is cheap; either way
     it is paid once per registry key."""
-    art = _Artifact(kind, key)
+    art = _Artifact(kind, key, remat=remat)
     try:
         compiled = jfn.lower(*args).compile()
     except Exception as e:  # un-lowerable args / backend quirks
@@ -153,7 +155,7 @@ def _analyze(kind, key, jfn, args):
     return art
 
 
-def note(kind, key, jfn, args, attribute=True):
+def note(kind, key, jfn, args, attribute=True, remat=None):
     """Register-or-attribute one execution of a compiled artifact.
 
     ``key`` must be the site's own cache-signature (hashable); ``jfn``
@@ -161,7 +163,9 @@ def note(kind, key, jfn, args, attribute=True):
     arguments (used for avals only — values are never read, so donated
     buffers are safe).  First sighting analyzes; replays attribute the
     stored flops/bytes to the current telemetry step without
-    re-analysis.  ``attribute=False`` registers the artifact in the
+    re-analysis.  ``remat`` stamps the activation-remat tier the site
+    compiled with onto the artifact (the planner's warm path filters
+    registry temps by it).  ``attribute=False`` registers the artifact in the
     registry without counting an execution or attributing flops — for
     wrapper sites (e.g. the Predictor) whose inner compile site already
     attributes per-execution, so dump()/top_artifacts() see the wrapper
@@ -175,7 +179,7 @@ def note(kind, key, jfn, args, attribute=True):
     except TypeError:
         return None
     if art is None:
-        art = _analyze(kind, key, jfn, args)
+        art = _analyze(kind, key, jfn, args, remat=remat)
         with _lock:
             existing = _registry.get(rk)
             if existing is None:

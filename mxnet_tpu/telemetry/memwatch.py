@@ -389,6 +389,17 @@ def write_postmortem(path=None, context="", error=""):
     report["buffers"] = buffers
     report["top_artifacts_by_temp_bytes"] = \
         _costs.top_artifacts(n=10, by="temp_bytes")
+    # prescription: when the memory planner is loaded, re-plan the last
+    # model under the escalation ladder (higher remat tier, host
+    # offload, smaller batch) and name the cheapest fix
+    mem = sys.modules.get("mxnet_tpu.memory")
+    if mem is not None:
+        try:
+            rx = mem.prescribe()
+            if rx is not None:
+                report["prescription"] = rx
+        except Exception:
+            pass  # reporting never masks the original failure
     with open(path, "w", encoding="utf-8") as f:
         json.dump(report, f, indent=2)
     return path
@@ -405,10 +416,21 @@ def annotate_oom(exc, context=""):
         path = write_postmortem(context=context, error=str(exc))
     except Exception:
         return  # never let reporting mask the original failure
+    fix = ""
+    mem = sys.modules.get("mxnet_tpu.memory")
+    if mem is not None:
+        try:
+            rx = mem.planner.last_prescription()
+            rec = rx and rx.get("recommendation")
+            if rec:
+                fix = (f"\ncheapest fix that fits: {rec['change']} "
+                       f"(predicted peak {rec['predicted_peak_gib']} GiB)")
+        except Exception:
+            pass
     raise OOMError(
         f"device allocation failure during {context or 'dispatch'}: {exc}\n"
         f"memwatch post-mortem (ranked live buffers + top compiled "
-        f"artifacts by temp bytes) written to {path}") from exc
+        f"artifacts by temp bytes) written to {path}{fix}") from exc
 
 
 # -- lifecycle ----------------------------------------------------------------

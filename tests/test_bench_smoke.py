@@ -191,6 +191,44 @@ def test_sharded_step_bench_emits_artifact(tmp_path):
         assert all(rec["acceptance"][model].values())
 
 
+def test_remat_ab_bench_emits_artifact(tmp_path):
+    """benchmark/remat_ab.py at toy step counts must emit the REMAT_AB
+    artifact with every tier lane for both models, bit-identical loss
+    trajectories, zero steady-state compile misses, and an auto lane
+    that resolved to a concrete tier — the round-10 evidence that the
+    remat policy engine recomputes without renumbering."""
+    out = tmp_path / "remat_ab.json"
+    env = dict(os.environ)
+    env.update(BENCH_PLATFORM="cpu", BENCH_STEPS="3", BENCH_WARMUP="1",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8",
+               MXT_REMAT_AB_OUT=str(out))
+    env.pop("JAX_PLATFORMS", None)
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "benchmark", "remat_ab.py")],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=600)
+    assert r.returncode == 0, r.stderr[-2000:]
+    rec = json.loads(out.read_text())
+    assert rec["metric"] == "remat_auto_vs_layer_step_ratio"
+    assert rec["value"] > 0
+    for model in ("mlp", "llama_tiny"):
+        by_tier = rec["lanes"][model]
+        assert set(by_tier) == {"none", "dots", "layer", "auto"}
+        ref = by_tier["layer"]["loss_trajectory"]
+        for lane in by_tier.values():
+            assert lane["compile_miss_steady"] == 0
+            assert lane["compile_miss_warmup"] > 0
+            assert lane["loss_trajectory"] == ref
+        # per-layer checkpointing saves strictly fewer residuals to the
+        # backward than saving everything
+        assert by_tier["layer"]["bwd_residual_bytes_max"] < \
+            by_tier["none"]["bwd_residual_bytes_max"]
+        auto = by_tier["auto"]
+        assert auto["resolved_tier"] in ("none", "dots", "layer")
+        assert auto["policy_mode"] == "auto"
+        assert auto["remat_policy_jsonl_field"] == auto["resolved_tier"]
+        assert all(rec["acceptance"][model].values())
+
+
 def test_telemetry_disabled_step_overhead():
     """Telemetry instrumentation rides the trainer/CachedOp/kvstore hot
     path; disabled it must be within noise of the seed path.  Compare

@@ -233,6 +233,34 @@ def test_t8_engine_and_builtin_tables_clean():
     assert vs == [], [v.to_dict() for v in vs]
 
 
+def test_t9_flags_policy_bypass_and_dropped_verdicts():
+    vs = _rule(_analyze("t9_memory.py"), "T9")
+    errors = [v for v in vs if v.severity == "error"]
+    warnings = [v for v in vs if v.severity == "warning"]
+    # hand-rolled remat primitives inside a hybrid block are errors
+    assert any(v.context == "HandRolledBlock.hybrid_forward"
+               and "jax.checkpoint" in v.message for v in errors)
+    assert any(v.context == "HandRolledBlock.remat_forward"
+               and "jax.remat" in v.message for v in errors)
+    # planner verdicts discarded as bare statements are warnings
+    assert len([v for v in warnings
+                if v.context == "dropped_verdicts"]) == 3
+    # the sanctioned checkpoint_wrap route and consumed verdicts stay
+    # quiet
+    assert not any("PolicyRoutedBlock" in v.context for v in vs)
+    assert not any(v.context == "gated_verdicts" for v in vs)
+
+
+def test_t9_clean_on_real_model_and_policy_code():
+    # the policy engine itself (the one sanctioned jax.checkpoint site)
+    # and the models that route remat through it must pass their own rule
+    vs = analyze_paths(
+        ["mxnet_tpu/models/llama.py", "mxnet_tpu/gluon/block.py",
+         "mxnet_tpu/memory/policy.py", "mxnet_tpu/memory/lowering.py"],
+        REPO, rules={"T9"})
+    assert vs == [], [v.to_dict() for v in vs]
+
+
 def test_t6_t7_clean_on_real_donation_sites():
     # the real donating call sites (fused trainer update, K-step fusion,
     # per-param optimizer update, llama decode cache) follow the
@@ -292,7 +320,7 @@ def test_cli_fails_on_seeded_fixtures_with_json():
     assert r.returncode == 1
     payload = json.loads(r.stdout)
     by_rule = payload["summary"]["by_rule"]
-    for rule in ("T1", "T2", "T3", "T4", "T5", "T6", "T7", "T8"):
+    for rule in ("T1", "T2", "T3", "T4", "T5", "T6", "T7", "T8", "T9"):
         assert by_rule.get(rule, 0) > 0, f"{rule} missing from {by_rule}"
 
 
@@ -305,7 +333,8 @@ def test_cli_sarif_format():
     run = sarif["runs"][0]
     assert run["tool"]["driver"]["name"] == "mxlint"
     rule_ids = {rl["id"] for rl in run["tool"]["driver"]["rules"]}
-    assert {"T1", "T2", "T3", "T4", "T5", "T6", "T7", "T8"} <= rule_ids
+    assert {"T1", "T2", "T3", "T4", "T5", "T6", "T7", "T8",
+            "T9"} <= rule_ids
     results = run["results"]
     assert results and all(r_["ruleId"] in rule_ids for r_ in results)
     loc = results[0]["locations"][0]["physicalLocation"]

@@ -1,0 +1,52 @@
+"""T9 fixture: memory-policy bypass in model code.
+
+Positives: hand-rolled ``jax.checkpoint``/``jax.remat`` inside a
+hybrid block (model code — the policy engine's ``checkpoint_wrap`` is
+the one sanctioned site), and planner calls whose verdict is discarded.
+Negatives: ``checkpoint_wrap``-routed remat and planner calls whose
+plan is assigned and gated on.
+"""
+import jax
+
+from mxnet_tpu.memory import planner, policy
+from mxnet_tpu.memory.planner import plan_model
+from mxnet_tpu.memory.policy import auto_tier, checkpoint_wrap
+
+
+class HandRolledBlock:
+    """A gluon-shaped block that bypasses the tier ladder."""
+
+    def hybrid_forward(self, F, x):
+        inner = jax.checkpoint(self._layer)          # T9: bypasses policy
+        return inner(x)
+
+    def remat_forward(self, x):
+        return jax.remat(self._layer)(x)             # T9: bypasses policy
+
+    def _layer(self, x):
+        return x * 2.0
+
+
+class PolicyRoutedBlock:
+    """The sanctioned shape: remat goes through the policy engine."""
+
+    def hybrid_forward(self, F, x):
+        wrapped = checkpoint_wrap(self._layer, "layer")  # clean
+        return wrapped(x)
+
+    def _layer(self, x):
+        return x * 2.0
+
+
+def dropped_verdicts(net, mesh):
+    planner.plan_model(net, mesh=mesh)               # T9: verdict unused
+    plan_model(net, mesh=mesh)                       # T9: verdict unused
+    auto_tier(net, mesh=mesh)                        # T9: tier unused
+
+
+def gated_verdicts(net, mesh):
+    plan = planner.plan_model(net, mesh=mesh)        # clean: assigned
+    if not plan.fits:
+        raise MemoryError(plan.top_buffers)
+    tier, _ = auto_tier(net, mesh=mesh)              # clean: consumed
+    return tier

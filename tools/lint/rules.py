@@ -28,6 +28,12 @@ T8  partition-rule sanity: literal rule tables handed to
     under first-match-wins), or model-axis specs with no terminal
     catch-all — unmatched parameters then silently replicate, which on
     a mesh with a model axis is a memory regression that trains fine.
+T9  memory-policy bypass: hand-rolled ``jax.checkpoint``/``jax.remat``
+    in MODEL code (under ``models/`` or a file defining a
+    ``hybrid_forward`` block) sidesteps the auto-remat tier ladder —
+    use ``memory.policy.checkpoint_wrap`` / ``hybridize(remat=...)``;
+    and planner calls (``plan_model``/``auto_tier``/...) as bare
+    statements discard the fit verdict they exist to produce.
 """
 from __future__ import annotations
 
@@ -48,6 +54,7 @@ RULES = {
     "T6": "use of a buffer after it was donated to a jitted call",
     "T7": "aliased array reaches a donating call (donation aliasing)",
     "T8": "partition-rule sanity (dead rule / silent replicate)",
+    "T9": "memory-policy bypass (hand-rolled remat / dropped verdict)",
 }
 
 # --- T1 ---------------------------------------------------------------------
@@ -376,6 +383,54 @@ def _literal_rule_table(node, src):
     return entries
 
 
+# --- T9 ---------------------------------------------------------------------
+
+#: direct remat primitives — the policy engine's ``checkpoint_wrap`` is
+#: the ONE sanctioned call site for model code (memory/policy.py), so a
+#: dotted call to any of these inside model code bypasses the tier ladder
+_T9_CHECKPOINT_CALLS = {"jax.checkpoint", "jax.remat",
+                        "jax.ad_checkpoint.checkpoint",
+                        "ad_checkpoint.checkpoint"}
+
+#: planner/policy entry points whose RETURN VALUE is the product: a fit
+#: verdict, a prescription, or a selected tier.  Called as a bare
+#: statement, the verdict is discarded and nothing gates on it.
+_T9_PLANNER_FUNCS = {"plan_model", "auto_tier", "plan_from_artifact",
+                     "select_tier", "prescribe"}
+
+#: dotted heads that identify the planner (``planner.plan_model`` /
+#: ``mem.auto_tier``); a bare imported name also counts
+_T9_PLANNER_HEADS = {"planner", "policy", "memory", "mem", "_mem",
+                     "_planner", "_policy", "_mem_planner", "_mem_policy",
+                     "mxnet_tpu"}
+
+
+def _t9_is_model_code(src) -> bool:
+    """Model code = a file under a ``models`` package, or one defining a
+    class with a ``hybrid_forward`` method (a gluon block)."""
+    parts = src.path.replace("\\", "/").split("/")
+    if "models" in parts:
+        return True
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.ClassDef):
+            for item in node.body:
+                if isinstance(item, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)) and \
+                        item.name == "hybrid_forward":
+                    return True
+    return False
+
+
+def _t9_stmt_calls(tree):
+    """ids of Call nodes that ARE a whole expression statement — their
+    value is discarded."""
+    out = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Expr) and isinstance(node.value, ast.Call):
+            out.add(id(node.value))
+    return out
+
+
 # ---------------------------------------------------------------------------
 # Per-file rule driver
 # ---------------------------------------------------------------------------
@@ -402,6 +457,9 @@ class FileChecker:
             self.violations.extend(check_donation(
                 self.src, self.index, enabled=self.enabled))
         t5_taint = self._t5_taint() if self._on("T5") else {}
+        t9_model = _t9_is_model_code(self.src) if self._on("T9") else False
+        t9_stmts = _t9_stmt_calls(self.src.tree) if self._on("T9") \
+            else frozenset()
         for node in ast.walk(self.src.tree):
             hot = self.index.in_traced_region(node)
             if isinstance(node, ast.Call):
@@ -413,6 +471,8 @@ class FileChecker:
                     self._check_t5_mutator_call(node, t5_taint)
                 if self._on("T8"):
                     self._check_t8(node)
+                if self._on("T9"):
+                    self._check_t9(node, t9_model, t9_stmts)
             elif isinstance(node, (ast.If, ast.While, ast.Assert)) and hot:
                 if self._on("T2"):
                     self._check_t2(node)
@@ -566,6 +626,27 @@ class FileChecker:
                        "parameters silently replicate over the mesh — add "
                        "an explicit ('.*', ()) fallback or "
                        "on_unmatched='error'")
+
+    # -- T9 ------------------------------------------------------------------
+    def _check_t9(self, call, model_code, stmt_calls):
+        dotted = dotted_name(call.func)
+        if model_code and dotted in _T9_CHECKPOINT_CALLS:
+            self._emit("T9", SEVERITY_ERROR, call,
+                       f"hand-rolled {dotted}() in model code bypasses "
+                       "the remat policy engine — wrap with "
+                       "memory.policy.checkpoint_wrap (or declare "
+                       "hybridize(remat=...) / set_remat) so the "
+                       "auto-tier ladder stays in control")
+            return
+        name = last_name(call.func)
+        if name in _T9_PLANNER_FUNCS and id(call) in stmt_calls:
+            head = dotted.split(".", 1)[0] if "." in dotted else ""
+            if not head or head in _T9_PLANNER_HEADS:
+                self._emit("T9", SEVERITY_WARNING, call,
+                           f"{name}() called as a bare statement — the "
+                           "returned plan/verdict is discarded; assign "
+                           "it and gate on fits/headroom (or drop the "
+                           "call)")
 
     # -- T5 ------------------------------------------------------------------
     def _t5_taint(self):

@@ -82,135 +82,13 @@ if __name__ == "__main__":
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
-
-def _shell_params(net):
-    """Replace every Parameter's storage with an empty shell handle:
-    tracing swaps tracers into ``._data`` so no real array is needed
-    (the CachedOp handle-swap trick, gluon/block.py _CachedGraph)."""
-    import numpy as np
-
-    from mxnet_tpu.ndarray import NDArray
-
-    params = net._collect_params_with_prefix()
-    shapes, shells = {}, {}
-    for name, p in params.items():
-        shape = tuple(int(s) for s in (p.shape or ()))
-        assert shape and all(s > 0 for s in shape), \
-            f"{name} shape not fully declared: {p.shape}"
-        shapes[name] = shape
-        a = NDArray.__new__(NDArray)
-        a._data = None
-        a._node = None
-        a._oidx = 0
-        a._req_grad = False
-        a._grad = None
-        a._grad_req = "null"
-        p._data = a
-        shells[name] = a
-    n_params = sum(int(np.prod(s)) for s in shapes.values())
-    return params, shapes, shells, n_params
-
-
-LAYER0_PREFIX = "model.layers.0."
-
-
-def _remat_forward(net, shells, p_raws, ids_r, head=True,
-                   no_remat=False, act_sharding=None):
-    """embed -> lax.scan(jax.checkpoint(layer)) -> norm -> head.
-
-    Same math as ``LlamaModel.hybrid_forward`` + ``_lm_head``, shaped
-    the way a production TPU trainer compiles it (r4 memory findings):
-
-    - **scan over stacked layer params** (p_raws carries ONE (L, ...)
-      array per layer parameter; the layer-0 Block is the template,
-      handle-swapped per iteration — the pipeline machinery's trick).
-      A python layer loop gave XLA one copy of every per-layer buffer
-      (collective buffers included): ~1 GiB x L of temp that scan
-      eliminates by construction, and L x faster tracing.
-    - **jax.checkpoint around the scan body**: only the (L, B, T, H)
-      layer-boundary stack survives to the backward.
-    - **one-hot MATMUL embedding lookup**: the transpose of a gather
-      over the vocab-sharded table is a scatter-add that GSPMD lowers
-      by materializing the FULL f32 table per device (measured 2
-      GiB/device on 8B); as a matmul, lookup AND gradient are ordinary
-      sharded contractions.
-    - ``act_sharding`` pins the residual stream (P('dp', None, None))
-      at the scan boundary so GSPMD can't replicate it over dp.
-    """
-    import jax
-    import jax.numpy as jnp
-    from jax import lax
-
-    from mxnet_tpu.ndarray import NDArray
-
-    def pin(x):
-        if act_sharding is not None:
-            return jax.lax.with_sharding_constraint(x, act_sharding)
-        return x
-
-    for name, sh in shells.items():
-        if not name.startswith("model.layers."):
-            sh._data = p_raws[name]
-    table = p_raws["model.embed_tokens.weight"]
-    onehot = jax.nn.one_hot(ids_r, table.shape[0], dtype=table.dtype)
-    h = pin(jnp.einsum("btv,vh->bth", onehot, table))
-
-    template = net.model.layers[0]
-    suffixes = [n[len(LAYER0_PREFIX):] for n in shells
-                if n.startswith(LAYER0_PREFIX)]
-
-    def apply_layer(pslice, hr):
-        for sfx in suffixes:
-            shells[LAYER0_PREFIX + sfx]._data = pslice[sfx]
-        return pin(template(NDArray(hr))._data)
-
-    wrap = (lambda f: f) if no_remat else jax.checkpoint
-
-    def body(hr, pslice):
-        return wrap(apply_layer)(pslice, hr), ()
-
-    stacked = {sfx: p_raws["stacked_layers." + sfx] for sfx in suffixes}
-    h, _ = lax.scan(body, h, stacked)
-
-    h = net.model.norm(NDArray(h))._data
-    if not head:
-        return h
-    if net._cfg.tie_embeddings:
-        return h @ p_raws["model.embed_tokens.weight"].T
-    return net.lm_head(NDArray(h))._data
-
-
-def _cpu_upcast_artifact_bytes(n_layers):
-    """Sum the preallocated-temp slots that are f32 CONVERTS of bf16
-    layer-stacked arrays (shape leading dim == n_layers, producer a
-    convert fusion) in the dumped buffer assignment — the XLA:CPU
-    bf16-dot upcast artifact quantified in the fit verdict.  Returns
-    (bytes, [slot descriptions])."""
-    import glob
-    import re
-
-    files = glob.glob(os.path.join(_DUMP_DIR,
-                                   "*buffer-assignment.txt"))
-    if not files:
-        return 0, []
-    txt = open(max(files, key=os.path.getmtime)).read()
-    m = re.search(r"allocation \d+: size \d+, preallocated-temp:(.*?)"
-                  r"(?=\nallocation |\Z)", txt, re.S)
-    if not m:
-        return 0, []
-    slots = {}
-    for name, sz, off, shape in re.findall(
-            r"value: <\d+ ([\w.\-]+) @0> \(size=(\d+),offset=(\d+)\): "
-            r"(\S+)", m.group(1)):
-        slots.setdefault((int(off), int(sz)), []).append((name, shape))
-    total, picked = 0, []
-    for (off, sz), vals in slots.items():
-        for name, shape in vals:
-            if re.match(rf"f32\[{n_layers},", shape) and "convert" in name:
-                total += sz
-                picked.append(f"{shape} {name} ({sz / 2**20:.0f} MB)")
-                break
-    return total, picked
+# The lowering machinery (shell params, scan-over-stacked-layers remat
+# forward, memory harvest, CPU-upcast correction, verdict construction)
+# lives in the library so the runtime HBM planner shares it; this tool
+# is the CLI that turns it into committed artifacts.
+from mxnet_tpu.memory.lowering import (  # noqa: E402
+    LAYER0_PREFIX, cpu_upcast_artifact_bytes, fit_verdict,
+    harvest_memory, remat_forward, shell_params)
 
 
 def main():
@@ -262,7 +140,7 @@ def main():
     dp = spec["mesh"].get("dp", 1)
     batch = per_chip_batch * dp
 
-    params, shapes, shells, n_params = _shell_params(net)
+    params, shapes, shells, n_params = shell_params(net)
     # the partition ENGINE derives every spec — the same family table
     # Trainer(partition_rules=...) places real arrays with; no specs
     # are hand-rolled in this tool
@@ -296,6 +174,7 @@ def main():
     # SP_* env knobs: memory-shape experiments (debugging what drives
     # XLA's temp_size); the committed artifact uses the defaults.
     no_remat = bool(int(os.environ.get("SP_NO_REMAT", "0")))
+    remat_tier = "none" if no_remat else "layer"
     no_opt = bool(int(os.environ.get("SP_NO_OPT", "0")))
     ce_chunks = int(os.environ.get("SP_CE_CHUNKS", "0"))
 
@@ -313,9 +192,9 @@ def main():
             # chunk the vocab-wide CE over the sequence axis so the
             # (B, T, V) f32 logits never exist whole: per chunk,
             # recompute head-projection + CE under jax.checkpoint
-            h = _remat_forward(net, shells, p_raws, ids_r,
-                               head=False, no_remat=no_remat,
-                               act_sharding=act_sharding)
+            h = remat_forward(net, shells, p_raws, ids_r,
+                              head=False, remat=remat_tier,
+                              act_sharding=act_sharding)
             w = (p_raws["model.embed_tokens.weight"]
                  if net._cfg.tie_embeddings
                  else p_raws["lm_head.weight"])
@@ -331,9 +210,9 @@ def main():
                 total = total + jax.checkpoint(chunk_ce)(
                     h[:, sl], labels_r[:, sl])
             return total / (batch * seq)
-        logits = _remat_forward(net, shells, p_raws, ids_r,
-                                no_remat=no_remat,
-                                act_sharding=act_sharding)
+        logits = remat_forward(net, shells, p_raws, ids_r,
+                               remat=remat_tier,
+                               act_sharding=act_sharding)
         return _ce(logits, labels_r) / (batch * seq)
 
     if no_opt:
@@ -405,76 +284,13 @@ def main():
     collectives = {k: len(re.findall(k, hlo)) for k in
                    ("all-reduce", "collective-permute", "all-gather",
                     "reduce-scatter", "all-to-all")}
-    mem = {}
-    try:
-        ma = compiled.memory_analysis()
-        for k in ("argument_size_in_bytes", "output_size_in_bytes",
-                  "alias_size_in_bytes", "temp_size_in_bytes",
-                  "generated_code_size_in_bytes"):
-            v = getattr(ma, k, None)
-            if v is not None:
-                mem[k] = int(v)
-    except Exception as e:
-        mem["unavailable"] = str(e)
+    mem = harvest_memory(compiled)
 
     cpu_artifact_b, cpu_artifact_slots = (0, []) if _BACKEND == "tpu" \
-        else _cpu_upcast_artifact_bytes(cfg.num_layers)
+        else cpu_upcast_artifact_bytes(cfg.num_layers, _DUMP_DIR)
 
-    verdict = {}
-    if "argument_size_in_bytes" in mem and _BACKEND == "tpu":
-        # REAL XLA:TPU buffer assignment: bf16 dots are native on the
-        # MXU, so the fit claim needs no correction term — and the
-        # STRONGEST signal is that the compile SUCCEEDED at all: the
-        # topology compiler enforces the device's usable HBM budget
-        # (15.75 GiB on v5e) and fails RESOURCE_EXHAUSTED when the
-        # scheduled program exceeds it (observed: llama-1.17B batch-4
-        # with chunked attention, "Used 15.78G of 15.75G hbm").
-        # Reaching this line therefore proves XLA scheduled the step
-        # within budget; the args+temp arithmetic below is a
-        # supplementary upper bound (it ignores donation aliasing).
-        args_b = mem["argument_size_in_bytes"]
-        temp_b = mem.get("temp_size_in_bytes", 0)
-        resident = args_b + temp_b
-        verdict = {
-            "fits_hbm_compiler_enforced": True,
-            "compiler_enforced_budget_gib": 15.75,
-            "resident_bytes_per_device_args_plus_temp": resident,
-            "resident_gib_per_device_upper_bound": round(
-                resident / 2 ** 30, 2),
-            "upper_bound_note": "args+temp, ignores donation aliasing "
-                                "— the compiler's own scheduler fit is "
-                                "the load-bearing verdict",
-        }
-    elif "argument_size_in_bytes" in mem:
-        # resident working set per device: live arguments + XLA temps
-        # (donated outputs alias arguments — alias_size removes the
-        # double count when reported)
-        args_b = mem["argument_size_in_bytes"]
-        temp_b = mem.get("temp_size_in_bytes", 0)
-        resident = args_b + temp_b
-        corrected = resident - cpu_artifact_b
-        verdict = {
-            "resident_bytes_per_device_args_plus_temp": resident,
-            "resident_gib_per_device": round(resident / 2 ** 30, 2),
-            # XLA:CPU lowers every bf16 dot by converting its operands
-            # to f32 and LICM-hoists those converts of scanned weight /
-            # boundary stacks OUT of the loop, materializing full f32
-            # copies of bf16 stacks.  A TPU lowering never does this —
-            # the MXU consumes bf16 natively (minimal repro: scan +
-            # pure-bf16 dot_general shows the same f32[L,...] stacks on
-            # CPU).  The artifact below sums exactly those hoisted
-            # f32-of-bf16-stack slots from the buffer assignment.
-            "cpu_bf16_upcast_artifact_bytes": cpu_artifact_b,
-            "cpu_bf16_upcast_artifact_gib": round(
-                cpu_artifact_b / 2 ** 30, 2),
-            "cpu_bf16_upcast_artifact_slots": cpu_artifact_slots,
-            "resident_gib_corrected_for_cpu_artifact": round(
-                corrected / 2 ** 30, 2),
-            "hbm_budget_gib": 16.0,
-            "fits_16gib_raw_cpu_analysis": bool(
-                resident < 16 * 2 ** 30),
-            "fits_16gib_corrected": bool(corrected < 16 * 2 ** 30),
-        }
+    verdict = fit_verdict(mem, _BACKEND, cpu_artifact_b,
+                          cpu_artifact_slots)
 
     backend_desc = (
         f"{spec['n_devices']}-chip OFFLINE TPU topology "
@@ -505,7 +321,8 @@ def main():
         "per_chip_batch": per_chip_batch,
         "param_dtype": "bfloat16",
         "optimizer": optimizer,
-        "remat": "per-decoder-layer jax.checkpoint",
+        "remat": ("none" if no_remat
+                  else "per-decoder-layer jax.checkpoint"),
         "donated": "params + optimizer state",
         "lower_sec": round(lower_sec, 1),
         "compile_sec": round(compile_sec, 1),
