@@ -25,8 +25,16 @@ queue-wait percentiles, the batch-size distribution, throughput, and
 the predictor's compile-cache stats (signatures must stay within the
 bucket grid's ceiling).
 
+Round 11 adds the GENERATIVE lanes: an r8-vs-r11 A/B (the slot-ledger
+single-loop server vs the paged disaggregated server) swept open-loop
+over a request-rate ladder to saturation.  Per (engine, rate):
+p50/p99 total latency, queue-wait percentiles, ttft, and
+tokens/sec-per-chip; the acceptance block checks queue-wait p99 is
+reduced at the r8 offered rate and the max sustainable rate is higher
+for the paged multi-replica server.
+
 Run: ``JAX_PLATFORMS=cpu python benchmark/serving_latency.py``
-Artifact: SERVING_LATENCY_r08.json (override MXT_SERVING_LATENCY_OUT).
+Artifact: SERVING_LATENCY_r11.json (override MXT_SERVING_LATENCY_OUT).
 """
 from __future__ import annotations
 
@@ -40,6 +48,12 @@ import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
+# the dp-replica lane needs >1 CPU device; force the virtual mesh
+# BEFORE any jax import (all mxnet_tpu imports below are lazy)
+if "host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8")
+
 import numpy as np
 
 REQUESTS = int(os.environ.get("BENCH_SERVING_REQUESTS", 64))
@@ -50,6 +64,19 @@ MAX_LENGTH = int(os.environ.get("BENCH_SERVING_MAX_LEN", 64))
 SEED = int(os.environ.get("BENCH_SERVING_SEED", 0))
 IN_DIM = 8
 HIDDEN = 8
+
+# generative A/B + saturation sweep knobs
+GEN_REQUESTS = int(os.environ.get("BENCH_SERVING_GEN_REQUESTS", 48))
+GEN_RATE = float(os.environ.get("BENCH_SERVING_GEN_RATE", 512.0))
+GEN_RATES = tuple(float(r) for r in os.environ.get(
+    "BENCH_SERVING_GEN_RATES", "64,128,256,512,1024").split(","))
+GEN_MAX_NEW = int(os.environ.get("BENCH_SERVING_GEN_MAX_NEW", 16))
+# saturation criterion: an offered rate is "sustained" while queue-wait
+# p99 stays under this bound (open loop: past saturation the queue —
+# and with it the wait — grows without bound)
+GEN_SAT_QW_MS = float(os.environ.get("BENCH_SERVING_GEN_SAT_QW_MS", 50.0))
+GEN_MAX_LEN = 64
+GEN_SLOTS = 4
 
 
 def _build_predictor(workdir):
@@ -104,7 +131,8 @@ def _lane_summary(recs, wall_s, rejected):
         "queue_wait_ms_mean": round(sum(waits) / max(1, len(waits)), 3),
         "batch_size_dist": dict(sorted(sizes.items(), key=lambda kv:
                                        int(kv[0]))),
-        "buckets_seen": sorted({tuple(r["bucket"]) for r in recs}),
+        "buckets_seen": sorted({tuple(b) if isinstance(b, (list, tuple))
+                                else b for b in (r["bucket"] for r in recs)}),
     }
 
 
@@ -185,6 +213,153 @@ def _open_loop(srv, inputs, rng):
         f.result(timeout=300.0)
 
 
+# --- generative lanes: r8 slot-ledger vs r11 paged/dp, rate ladder ---------
+
+def _gen_workload(n, rng):
+    """Mixed-length prompts spanning the 8/16 prompt buckets."""
+    lens = rng.randint(4, 17, size=n)
+    return [rng.randint(1, 250, size=l).astype(np.int32) for l in lens]
+
+
+def _make_gen_server(net, engine):
+    """engine="slots_r8": the r8 single-loop slot-ledger server on one
+    device.  engine="paged": the paged disaggregated server, dp2 mesh
+    (two single-device replicas) when >=2 devices are available.
+
+    The KV budget is held EQUAL: the ledger reserves ``GEN_SLOTS ×
+    GEN_MAX_LEN`` token-rows; the paged pool gets the same
+    ``num_blocks × block_size`` tokens but — because requests only
+    reserve what they can use — serves 2× the decode slots from it."""
+    import jax
+    from mxnet_tpu import serving
+
+    paged = engine != "slots_r8"
+    cfg = serving.ServerConfig(
+        max_batch=GEN_SLOTS, max_length=GEN_MAX_LEN, min_batch=1,
+        min_length=8, queue_capacity=max(64, GEN_REQUESTS),
+        num_slots=2 * GEN_SLOTS if paged else GEN_SLOTS,
+        max_new_tokens=GEN_MAX_NEW,
+        kv_mode="paged" if paged else "slots", block_size=16,
+        num_blocks=GEN_SLOTS * (GEN_MAX_LEN // 16) if paged else None,
+        batch_window_ms=2.0, summary_every=max(64, GEN_REQUESTS))
+    mesh = None
+    if paged and len(jax.devices()) >= 2:
+        from jax.sharding import Mesh
+        mesh = Mesh(np.array(jax.devices()[:2]), ("dp",))
+    return serving.GenerativeServer(net, cfg, mesh=mesh)
+
+
+def _gen_rate_pass(srv, prompts, rate, rng):
+    """One open-loop pass at ``rate`` req/s over a warm server."""
+    from mxnet_tpu.serving import ServerOverloadedError
+
+    gaps = rng.exponential(1.0 / rate, size=len(prompts))
+    futs, accepted, rejected = [], [], 0
+    t0 = time.perf_counter()
+    for p, gap in zip(prompts, gaps):
+        time.sleep(gap)
+        try:
+            futs.append(srv.submit(p, max_new_tokens=GEN_MAX_NEW))
+            accepted.append(p)
+        except ServerOverloadedError:
+            rejected += 1
+    done = [f.result(timeout=300.0) for f in futs]
+    wall = time.perf_counter() - t0
+    gen_tok = sum(len(d) - len(p) for d, p in zip(done, accepted))
+    return wall, rejected, gen_tok
+
+
+def _warm_grid(srv):
+    """Compile every (batch bucket, length bucket) prefill + scatter
+    signature and the decode step on every replica's engine, using
+    all-sentinel slots/blocks (XLA drops out-of-bounds scatters, so no
+    live KV is touched) — the measured passes never hit a cold
+    compile."""
+    pol = srv.config.policy
+    engines = [rep.engine for rep in srv.replicas] or [srv.engine]
+    for eng in engines:
+        eng.step([])
+        for kb in pol.batch_buckets():
+            for lb in pol.length_buckets():
+                prompts = np.zeros((kb, lb), np.int32)
+                t0s = np.full(kb, lb, np.int32)
+                slots = np.full(kb, eng.num_slots, np.int32)
+                if eng.kv_mode == "slots":
+                    eng.admit(prompts, t0s, slots)
+                else:
+                    toks, rows = eng.prefill_rows(prompts, t0s)
+                    eng.commit_rows(rows, slots, [None] * kb, t0s,
+                                    np.zeros(kb, np.int64))
+
+
+def _run_gen_engine(net, engine, rates):
+    """Build ONE server per engine (so the rate ladder shares its
+    compiles), warm the signature grid on every replica, then sweep."""
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.telemetry.sinks import ListSink
+
+    rng = np.random.RandomState(SEED + 17)
+    prompts = _gen_workload(GEN_REQUESTS, rng)
+    telemetry.enable(memory=False, cost=False)
+    sink = ListSink()
+    telemetry.add_sink(sink)
+    srv = _make_gen_server(net, engine)
+    chips = max(1, len(srv.replicas))
+    out = {"engine": engine, "replicas": chips, "rates": {}}
+    try:
+        _warm_grid(srv)
+        with srv:
+            # one warm request end-to-end per replica (routing, lanes,
+            # demux — all compiles are already grid-warm)
+            warm = [srv.submit(np.arange(1, 9, dtype=np.int32),
+                               max_new_tokens=2) for _ in range(chips)]
+            for f in warm:
+                f.result(timeout=300.0)
+            for rate in rates:
+                sink.records.clear()
+                wall, rejected, gen_tok = _gen_rate_pass(
+                    srv, prompts, rate, rng)
+                recs = [r for r in sink.records
+                        if r.get("record") == "serving.request"]
+                ttft = [r["ttft_ms"] for r in recs
+                        if r.get("ttft_ms") is not None]
+                summary = _lane_summary(recs, wall, rejected)
+                del summary["buckets_seen"]
+                summary.pop("batches", None)
+                qw99 = summary["queue_wait_ms"]["p99"]
+                summary.update({
+                    "offered_rate_req_per_s": rate,
+                    "ttft_ms": _percentiles(ttft),
+                    "tokens_per_s": round(gen_tok / wall, 2),
+                    "tokens_per_s_per_chip": round(gen_tok / wall / chips,
+                                                   2),
+                    "sustained": (summary["completed"] == len(prompts)
+                                  and rejected == 0
+                                  and qw99 is not None
+                                  and qw99 < GEN_SAT_QW_MS),
+                })
+                out["rates"][f"{rate:g}"] = summary
+        stats = srv.stats()
+    finally:
+        telemetry.disable()
+        telemetry.reset()
+    sust = [r for r in rates if out["rates"][f"{r:g}"]["sustained"]]
+    out["max_sustainable_rate_req_per_s"] = max(sust) if sust else None
+    out["decode_steps"] = stats["decode_steps"]
+    out["kv_cache"] = stats["kv_cache"]
+    return out
+
+
+def _gen_sweep():
+    from mxnet_tpu.models.llama import llama_tiny
+
+    net = llama_tiny()
+    net.initialize()
+    rates = sorted(set(GEN_RATES) | {GEN_RATE})
+    return {eng: _run_gen_engine(net, eng, rates)
+            for eng in ("slots_r8", "paged")}
+
+
 def main():
     workdir = tempfile.mkdtemp(prefix="serving_bench_")
     try:
@@ -193,12 +368,19 @@ def main():
                  for lane in ("closed_loop", "open_loop")}
     finally:
         shutil.rmtree(workdir, ignore_errors=True)
+    gen = _gen_sweep()
     from mxnet_tpu import serving
 
     ceiling = len(serving.BucketPolicy(
         max_batch=MAX_BATCH, max_length=MAX_LENGTH,
         min_batch=1, min_length=8).signatures())
     sigs = max(l["cache"]["signatures"] for l in lanes.values())
+
+    ab = f"{GEN_RATE:g}"
+    w_slots = gen["slots_r8"]["rates"][ab]["queue_wait_ms"]["p99"]
+    w_paged = gen["paged"]["rates"][ab]["queue_wait_ms"]["p99"]
+    s_slots = gen["slots_r8"]["max_sustainable_rate_req_per_s"]
+    s_paged = gen["paged"]["max_sustainable_rate_req_per_s"]
     record = {
         "metric": "serving_open_loop_p99_ms",
         "value": lanes["open_loop"]["total_ms"]["p99"],
@@ -209,11 +391,24 @@ def main():
         "bucket_config": {"max_batch": MAX_BATCH, "max_length": MAX_LENGTH,
                           "signature_ceiling": ceiling},
         "lanes": lanes,
+        "generative": {
+            "requests_per_rate": GEN_REQUESTS,
+            "max_new_tokens": GEN_MAX_NEW,
+            "ab_rate_req_per_s": GEN_RATE,
+            "engines": gen,
+        },
         "acceptance": {
             "signatures_within_ceiling": sigs <= ceiling,
             "batched": any(int(k) > 1 for l in lanes.values()
                            for k in l["batch_size_dist"]),
             "no_rejections": all(l["rejected"] == 0 for l in lanes.values()),
+            "gen_queue_wait_p99_reduced_vs_r8": (
+                w_slots is not None and w_paged is not None
+                and w_paged <= w_slots),
+            "gen_max_sustainable_rate_higher": (
+                s_paged is not None
+                and (s_slots is None or s_paged > s_slots
+                     or (s_paged == s_slots == max(GEN_RATES)))),
         },
         "platform": os.environ.get("JAX_PLATFORMS", "default"),
     }
@@ -222,7 +417,7 @@ def main():
     out_path = os.environ.get(
         "MXT_SERVING_LATENCY_OUT",
         os.path.join(os.path.dirname(__file__), "..",
-                     "SERVING_LATENCY_r08.json"))
+                     "SERVING_LATENCY_r11.json"))
     with open(out_path, "w") as f:
         f.write(line + "\n")
 

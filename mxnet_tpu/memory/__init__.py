@@ -21,13 +21,14 @@ path otherwise.  See docs/memory.md.
 """
 from . import lowering, offload, planner, policy
 from .planner import (Plan, budget_bytes, last_plan, plan_from_artifact,
-                      plan_model, prescribe, set_budget)
+                      plan_kv_pool, plan_model, prescribe, set_budget)
 from .policy import TIERS, auto_tier, checkpoint_wrap, select_tier
 
 __all__ = [
     "Plan", "TIERS", "auto_tier", "budget_bytes", "checkpoint_wrap",
     "last_plan", "lowering", "offload", "plan_from_artifact",
-    "plan_model", "planner", "policy", "prescribe", "select_tier",
+    "plan_kv_pool", "plan_model", "planner", "policy", "prescribe",
+    "select_tier",
     "set_budget", "telemetry_fields",
 ]
 

@@ -220,10 +220,38 @@ def _registry_workspace(axes, remat):
         return None
 
 
+def plan_kv_pool(num_layers, num_kv_heads, head_dim, num_blocks,
+                 block_size, dtype=np.float32, mesh=None, rules=None):
+    """Per-device bytes of the serving engine's paged KV block pool:
+    2 (K and V) × layers × ``num_blocks × num_kv_heads × block_size ×
+    head_dim`` × itemsize, sharded the way the serving rule table
+    places the pool (``layers.{i}.kv_pool`` — KV-head axis over ``tp``
+    by default).  This is the serving analog of the allreduce-bytes
+    planning the trainer gets: size the pool BEFORE building the
+    engine, and feed the figure to :func:`plan_model` via
+    ``kv_pool_bytes=`` to get a fit verdict that includes serving
+    state.  Matches ``LlamaServingEngine.kv_pool_bytes()`` exactly."""
+    dtype = np.dtype(dtype)
+    shape = (int(num_blocks), int(num_kv_heads), int(block_size),
+             int(head_dim))
+    div = 1
+    if mesh is not None:
+        from ..parallel import partition as pt
+
+        axes = _mesh_axis_sizes(mesh)
+        specs = pt.as_rules(rules if rules is not None
+                            else "llama_serving").specs(
+            {"layers.0.kv_pool": shape}, mesh)
+        div = _shard_div(specs.get("layers.0.kv_pool"), axes)
+    n_elem = int(np.prod(shape))
+    return 2 * int(num_layers) * _ceil_div(n_elem * dtype.itemsize, div)
+
+
 def plan_model(params, mesh=None, rules=None, optimizer=None,
                batch_bytes=0, remat="none", offload=None,
                activation_hint=None, budget=None, device_kind=None,
-               training=True, use_registry=True, record=True):
+               training=True, use_registry=True, record=True,
+               kv_pool_bytes=0):
     """Analytic per-device peak for a model configuration.
 
     ``params``: a Block / Parameter mapping / ``{name: (shape, dtype)}``.
@@ -232,6 +260,8 @@ def plan_model(params, mesh=None, rules=None, optimizer=None,
     "none" (scaled down the ladder); otherwise a warm costs-registry
     temp figure or a coarse batch-proportional prior is used.
     ``offload="host"`` moves optimizer state + f32 masters off-device.
+    ``kv_pool_bytes``: per-device serving KV pool (from
+    :func:`plan_kv_pool`) held live for the server's lifetime.
     """
     from .policy import normalize
 
@@ -296,13 +326,17 @@ def plan_model(params, mesh=None, rules=None, optimizer=None,
         offload_b = state_b + masters_b
         state_b = masters_b = 0
 
+    kv_b = int(kv_pool_bytes)
     breakdown = {
         "params": params_b, "grads": grads_b,
         "optimizer_state": state_b, "masters": masters_b,
         "batch": batch_b, "activations": act_b,
         "host_offloaded": offload_b,
     }
-    peak = params_b + grads_b + state_b + masters_b + batch_b + act_b
+    if kv_b:
+        breakdown["kv_pool"] = kv_b
+    peak = params_b + grads_b + state_b + masters_b + batch_b + act_b \
+        + kv_b
 
     top = sorted(
         ([{"name": n, "bytes": sum(c.values()), "components": c}
@@ -310,7 +344,9 @@ def plan_model(params, mesh=None, rules=None, optimizer=None,
          + ([{"name": "<batch>", "bytes": batch_b,
               "components": {"batch": batch_b}}] if batch_b else [])
          + ([{"name": "<activations>", "bytes": act_b,
-              "components": {"activations": act_b}}] if act_b else [])),
+              "components": {"activations": act_b}}] if act_b else [])
+         + ([{"name": "<kv_pool>", "bytes": kv_b,
+              "components": {"kv_pool": kv_b}}] if kv_b else [])),
         key=lambda d: -d["bytes"])[:8]
 
     plan = Plan(
@@ -320,6 +356,7 @@ def plan_model(params, mesh=None, rules=None, optimizer=None,
              "optimizer": optimizer, "batch_bytes": int(batch_bytes),
              "activation_hint": activation_hint, "budget": budget,
              "training": training, "device_kind": device_kind,
+             "kv_pool_bytes": kv_b,
              "optimizer_desc": (opt_name, n_state, multi_precision)})
     if record:
         global _last_plan
@@ -394,7 +431,8 @@ def prescribe(plan=None, margin=0.0):
                 batch_bytes=ctx["batch_bytes"],
                 activation_hint=ctx["activation_hint"],
                 budget=ctx["budget"], training=ctx["training"],
-                device_kind=ctx["device_kind"], record=False)
+                device_kind=ctx["device_kind"],
+                kv_pool_bytes=ctx.get("kv_pool_bytes", 0), record=False)
 
     tier_i = TIERS.index(plan.remat) if plan.remat in TIERS else 0
     candidates = []

@@ -584,16 +584,16 @@ class LlamaDecoder:
         x = self._rms(x, w["norm"], cfg.rms_eps)
         return x @ w["head"].T, new_caches
 
-    def _prefill_impl(self, w, ids, t0):
-        """Batched full-sequence prompt pass over PADDED ids (B, Lp) with
-        the true prompt length ``t0`` traced: caches get K/V written at
-        [0:Lp] (pad rows are overwritten by decode steps starting at
-        ``t0``, and the causal mask keeps them invisible to real rows);
-        logits are gathered at row t0-1.  One MXU-friendly forward
-        instead of T0 serialized vector steps, compiled once per padded
-        shape."""
+    def _prefill_rows_impl(self, w, ids, t0):
+        """Batched full-sequence prompt pass over PADDED ids (B, Lp)
+        returning each layer's raw post-RoPE K/V rows ``(B, Hkv, Lp,
+        hd)`` — no max_len cache allocation, so the CALLER picks the
+        storage layout: the offline path pads rows into per-batch
+        max_len caches (:meth:`_prefill_impl`), the paged serving
+        engine scatters them into pool blocks (the prefill→decode KV
+        handoff).  Logits are gathered at each row's true last position
+        (scalar or per-row vector ``t0``)."""
         import jax.numpy as jnp
-        from jax import lax
 
         cfg = self.cfg
         hd = cfg.head_dim
@@ -601,8 +601,7 @@ class LlamaDecoder:
         cos, sin = self._cos[:lp], self._sin[:lp]
         x = w["emb"][ids]                                   # (B, Lp, H)
         causal = jnp.tril(jnp.ones((lp, lp), bool))         # (Q, T)
-        z = jnp.zeros((), jnp.int32)
-        caches = []
+        rows = []
         for L in w["layers"]:
 
             def ctx_fn(h, L=L):
@@ -614,12 +613,7 @@ class LlamaDecoder:
                     .transpose(0, 2, 1, 3)
                 q = _apply_rope(q, cos[None, None], sin[None, None])
                 k = _apply_rope(k, cos[None, None], sin[None, None])
-                shape = (b, cfg.num_kv_heads, self.max_len, hd)
-                kc = lax.dynamic_update_slice(
-                    jnp.zeros(shape, k.dtype), k, (z, z, z, z))
-                vc = lax.dynamic_update_slice(
-                    jnp.zeros(shape, v.dtype), v, (z, z, z, z))
-                caches.append((kc, vc))
+                rows.append((k, v))
                 ctx = self._attend(q, k, v, causal)
                 return ctx.transpose(0, 2, 1, 3) \
                     .reshape(b, lp, cfg.num_heads * hd) @ L["o"].T
@@ -635,7 +629,86 @@ class LlamaDecoder:
             x_last = jnp.take_along_axis(
                 x, (t0v - 1)[:, None, None], axis=1)[:, 0]
         x_last = self._rms(x_last, w["norm"], cfg.rms_eps)
-        return caches, x_last @ w["head"].T
+        return rows, x_last @ w["head"].T
+
+    def _prefill_impl(self, w, ids, t0):
+        """Prompt pass + full-length caches: K/V rows land at [0:Lp] of
+        fresh (B, Hkv, max_len, hd) caches (pad rows are overwritten by
+        decode steps starting at ``t0``, and the causal mask keeps them
+        invisible to real rows).  One MXU-friendly forward instead of
+        T0 serialized vector steps, compiled once per padded shape."""
+        import jax.numpy as jnp
+        from jax import lax
+
+        cfg = self.cfg
+        b = ids.shape[0]
+        rows, logits = self._prefill_rows_impl(w, ids, t0)
+        z = jnp.zeros((), jnp.int32)
+        shape = (b, cfg.num_kv_heads, self.max_len, cfg.head_dim)
+        caches = [
+            (lax.dynamic_update_slice(jnp.zeros(shape, k.dtype), k,
+                                      (z, z, z, z)),
+             lax.dynamic_update_slice(jnp.zeros(shape, v.dtype), v,
+                                      (z, z, z, z)))
+            for k, v in rows]
+        return caches, logits
+
+    def _step_blocks_impl(self, w, pools, tables, ids_t, pos):
+        """Per-slot decode step against a PAGED KV pool: same vector-
+        position continuous-batching contract as
+        :meth:`_step_slots_impl`, but K/V storage is block-granular.
+        ``pools[l]`` is ``(kp, vp)`` each ``(num_blocks, Hkv,
+        block_size, hd)`` shared by every slot; ``tables`` (S, MB)
+        int32 holds each slot's block ids in logical order, vacant
+        entries = ``num_blocks``.  The step scatters each slot's new
+        K/V at ``(tables[s, pos//bs], pos%bs)`` — the sentinel id is
+        out of bounds, so vacant slots' writes DROP — and gathers each
+        slot's logical view ``(S, Hkv, MB*bs, hd)`` through a clamped
+        table; garbage read through clamped sentinel entries sits at
+        positions the causal mask (``t <= pos``) never exposes.  MB is
+        static, so the compute cost matches the slot-ledger step while
+        HBM capacity is the POOL size — bounded by tokens in flight,
+        not max_len × slots."""
+        import jax.numpy as jnp
+
+        cfg = self.cfg
+        hd = cfg.head_dim
+        s = ids_t.shape[0]
+        nb, hkv, bs, _ = pools[0][0].shape
+        mb = tables.shape[1]
+        t = mb * bs
+        pos = jnp.asarray(pos, jnp.int32)
+        cos = self._cos[pos][:, None, None, :]      # (S,1,1,hd/2)
+        sin = self._sin[pos][:, None, None, :]
+        x = w["emb"][ids_t]                         # (S, H)
+        mask = (jnp.arange(t)[None, :]
+                <= pos[:, None])[:, None, None, :]  # (S,1,1,T)
+        blk = jnp.take_along_axis(tables, (pos // bs)[:, None],
+                                  axis=1)[:, 0]     # (S,) physical block
+        off = pos % bs
+        gat = jnp.minimum(tables, nb - 1)           # clamp the sentinel
+        new_pools = []
+        for L, (kp, vp) in zip(w["layers"], pools):
+
+            def ctx_fn(h, L=L, kp=kp, vp=vp):
+                q = (h @ L["q"].T).reshape(s, cfg.num_heads, 1, hd)
+                k = (h @ L["k"].T).reshape(s, cfg.num_kv_heads, 1, hd)
+                v = (h @ L["v"].T).reshape(s, cfg.num_kv_heads, 1, hd)
+                q = _apply_rope(q, cos, sin)
+                k = _apply_rope(k, cos, sin)
+                kp2 = kp.at[blk, :, off].set(k[:, :, 0, :], mode="drop")
+                vp2 = vp.at[blk, :, off].set(v[:, :, 0, :], mode="drop")
+                new_pools.append((kp2, vp2))
+                kc = kp2[gat].transpose(0, 2, 1, 3, 4) \
+                    .reshape(s, hkv, t, hd)
+                vc = vp2[gat].transpose(0, 2, 1, 3, 4) \
+                    .reshape(s, hkv, t, hd)
+                ctx = self._attend(q, kc, vc, mask)
+                return ctx.reshape(s, cfg.num_heads * hd) @ L["o"].T
+
+            x = self._layer(L, x, ctx_fn)
+        x = self._rms(x, w["norm"], cfg.rms_eps)
+        return x @ w["head"].T, new_pools
 
     def logits_at(self, ids):
         """Teacher-forced per-step decode over ``ids`` (B, T) returning

@@ -47,7 +47,8 @@ import re
 from ..base import MXNetError
 
 __all__ = ["PartitionRules", "as_rules", "place_params", "stacked_spec",
-           "LLAMA_RULES", "MIXTRAL_RULES", "FAMILY_RULES",
+           "LLAMA_RULES", "MIXTRAL_RULES", "SERVING_RULES",
+           "FAMILY_RULES",
            "last_placement"]
 
 #: Megatron TP layout for dense llama-family transformers.  Weights are
@@ -75,7 +76,19 @@ MIXTRAL_RULES = (
     (r"(^|[._])down_weight$", ("ep", None, "tp")),
 ) + LLAMA_RULES
 
-FAMILY_RULES = {"llama": LLAMA_RULES, "mixtral": MIXTRAL_RULES}
+#: Serving-side llama table: the training rules plus the KV storage.
+#: The serving engine names its per-layer KV buffers
+#: ``layers.{i}.kv_pool`` — rank 4 either way the engine stores them
+#: (paged ``(num_blocks, Hkv, block, head)`` or slotted ``(slots, Hkv,
+#: max_len, head)``) — and shards the KV-head axis over ``tp``,
+#: matching the column-parallel k/v projections that produce it.  The
+#: rank guard keeps the rule away from every 2-D weight.
+SERVING_RULES = (
+    (r"(^|[._])kv_pool$", (None, "tp", None, None)),
+) + LLAMA_RULES
+
+FAMILY_RULES = {"llama": LLAMA_RULES, "mixtral": MIXTRAL_RULES,
+                "llama_serving": SERVING_RULES}
 
 #: most recent place_params summary — telemetry.step_end folds it into
 #: the per-step JSONL record (mesh_shape / sharded_params /

@@ -13,10 +13,17 @@ surface; this package is the server ON TOP of it — the north star's
 
 Stateless models get dynamic batching (:class:`InferenceServer`);
 llama decode gets TRUE continuous batching (:class:`GenerativeServer`):
-a sliced KV cache (``kv_cache.KVCacheManager`` + one per-slot-position
-compiled step) where requests are admitted into free slots and evicted
-on completion BETWEEN decode steps, so a late request joins an
-in-flight batch without restarting anyone.
+requests are admitted into free decode slots and evicted on completion
+BETWEEN decode steps, so a late request joins an in-flight batch
+without restarting anyone.  Since r11 the generative path is
+mesh-native and disaggregated: ``GenerativeServer(net, mesh=...)``
+places weights tensor-parallel (a ``dp`` axis → independent replicas
+behind one queue, least-loaded routed), K/V lives in a paged block
+pool (``kv_cache.PagedKVCacheManager`` — capacity bounded by tokens in
+flight, not ``max_len × slots``), and prefill/decode run as separate
+lanes with explicit KV handoff (``lanes.py``).  The r8 slot ledger
+(``KVCacheManager``) stays importable behind
+``ServerConfig(kv_mode="slots")`` for A/B.
 
 Quick start::
 
@@ -32,12 +39,17 @@ Quick start::
 from .protocol import (Request, ServerClosedError,     # noqa: F401
                        ServerOverloadedError)
 from .bucketing import BucketPolicy, pad_batch, pow2_bucket  # noqa: F401
-from .kv_cache import KVCacheManager                   # noqa: F401
+from .kv_cache import (BlockAllocator, KVCacheManager,  # noqa: F401
+                       PagedKVCacheManager)
 from .scheduler import BatchScheduler, RequestQueue    # noqa: F401
+from .lanes import (DecodeLane, PrefillLane, Replica,  # noqa: F401
+                    ReplicaDispatcher)
 from .server import (GenerativeServer, InferenceServer,  # noqa: F401
                      ServerConfig)
 
 __all__ = ["Request", "ServerOverloadedError", "ServerClosedError",
            "BucketPolicy", "pow2_bucket", "pad_batch", "KVCacheManager",
+           "PagedKVCacheManager", "BlockAllocator",
            "RequestQueue", "BatchScheduler", "ServerConfig",
-           "InferenceServer", "GenerativeServer"]
+           "InferenceServer", "GenerativeServer",
+           "PrefillLane", "DecodeLane", "Replica", "ReplicaDispatcher"]

@@ -1,21 +1,41 @@
 """Continuous-batching decode engine for the llama generative path.
 
-The engine owns one static-shape KV cache per layer —
-``(num_slots, Hkv, max_len, head_dim)`` — and exactly THREE compiled
-program families, all shape-stable under arbitrary request traffic:
+The engine owns the device half of serving: weights (optionally int8),
+the KV storage, and a fixed family of compiled programs that stay
+shape-stable under arbitrary request traffic.  Two storage modes share
+one surface (``kv_mode=``):
 
-* **step** — ``LlamaDecoder._step_slots_impl`` over all slots at once,
-  every slot at its OWN position (vector ``pos``): one signature, ever.
-  Vacant slots decode garbage at row 0 of their own slot; nobody reads
-  it.
-* **prefill** — the decoder's batched prompt pass at one
-  (admit_bucket, prompt_bucket) shape per bucket pair, with per-row
-  true lengths (vector ``t0``), returning each admitted prompt's first
-  token and its full-length cache rows.
-* **scatter** — writes the prefilled rows into the admitted slot
-  indices of the live cache.  Vacant rows carry slot index
-  ``num_slots``: out-of-bounds scatter indices DROP in XLA, so padding
-  never touches a live slot.
+* **paged** (default since r11) — K/V lives in a shared block pool per
+  layer, ``(num_blocks, Hkv, block_size, head_dim)``; each slot carries
+  a block-table row (vacant entries = ``num_blocks``, the out-of-bounds
+  sentinel XLA's scatter rule DROPS).  Capacity is bounded by tokens in
+  flight, not ``max_len × num_slots``.  Programs: **step**
+  (``LlamaDecoder._step_blocks_impl`` — one signature, ever),
+  **prefill** (``_prefill_rows_impl`` at one (admit_bucket,
+  prompt_bucket) shape per bucket pair, returning RAW K/V rows — no
+  max_len allocation), and **scatter** (pad rows to block chunks and
+  write them at the admitted physical block ids — the prefill→decode KV
+  handoff).
+* **slots** — the r8 ledger layout, one ``(num_slots, Hkv, max_len,
+  head_dim)`` cache per layer, kept behind the pool for A/B
+  (``ServerConfig(kv_mode="slots")``) and the legacy single-loop
+  scheduler.
+
+With ``mesh=`` the engine is mesh-native: every weight (and the KV
+pool) is committed to the mesh via the serving partition-rule table
+(``parallel.partition.SERVING_RULES`` unless ``partition_rules=``
+overrides) — q/k/v/gate/up column-parallel, o/down row-parallel, KV
+head axis sharded over ``tp`` — so the step/prefill/scatter compiles
+are keyed by the mesh their inputs live on: one decode compile per
+engine lifetime per mesh.  A dp axis is NOT this engine's business:
+the server splits a dp×tp mesh into per-replica tp submeshes and runs
+one engine per replica (serving/lanes.py).
+
+Thread discipline: the prefill lane and the decode lane share one
+engine.  ``dev_lock`` serializes every dispatch that MUTATES the KV
+storage (decode step, handoff scatter, slot clears); the prefill
+forward itself runs outside the lock, so a long prompt never stalls
+decode — only its cheap block scatter briefly takes the lock.
 
 Between any two step calls the scheduler may admit new requests
 (prefill + scatter) or evict finished ones — the continuous-batching
@@ -24,10 +44,9 @@ them as per-output-channel symmetric int8 (scale = max|row|/127) and
 dequantizes in-kernel — the weight-only quantization the int8 MXU
 pricing in ``INT8_TOPOLOGY_r05.json`` motivates.
 
-The scheduler half (:class:`GenerativeScheduler`) runs the admit/step/
-evict loop on one background thread, with the same queue, telemetry
-and backpressure contract as the stateless :class:`~.scheduler.
-BatchScheduler`.
+The scheduler half (:class:`GenerativeScheduler`) runs the legacy
+single-thread admit/step/evict loop for the slots mode; the paged path
+is driven by the disaggregated lanes in :mod:`.lanes`.
 """
 from __future__ import annotations
 
@@ -37,6 +56,7 @@ import time
 import numpy as np
 
 from .. import telemetry
+from ..base import MXNetError
 from .bucketing import BucketPolicy, pad_batch
 from .kv_cache import KVCacheManager
 from .protocol import ServerClosedError
@@ -88,50 +108,214 @@ def _dequantize_tree(w):
                 head=dq(w["head"]))
 
 
+def _named_weight_items(w):
+    """(rule-matchable name, getter/setter path) for every leaf of the
+    decoder weight tree — the serving-side analog of Gluon's dotted
+    parameter paths, so ``SERVING_RULES``/``LLAMA_RULES`` patterns match
+    unchanged (``layers.0.q_weight`` hits the column-parallel rule the
+    same way ``...self_attn.q_proj.weight`` does at training time)."""
+    items = []
+    for i, L in enumerate(w["layers"]):
+        for key in L:
+            items.append((f"layers.{i}.{key}_weight", ("layers", i, key)))
+    items.append(("embed_weight", ("emb",)))
+    items.append(("norm_weight", ("norm",)))
+    items.append(("lm_head_weight", ("head",)))
+    return items
+
+
 class LlamaServingEngine:
     """Device-side half of continuous batching for a LlamaForCausalLM."""
 
-    def __init__(self, net, max_len=None, num_slots=4, int8=False):
+    def __init__(self, net, max_len=None, num_slots=4, int8=False,
+                 kv_mode="slots", block_size=16, num_blocks=None,
+                 mesh=None, partition_rules=None, replica_id=0):
         import jax
         import jax.numpy as jnp
         from ..models.llama import LlamaDecoder
 
+        if kv_mode not in ("paged", "slots"):
+            raise MXNetError(f"unknown kv_mode {kv_mode!r}; "
+                             "expected 'paged' or 'slots'")
         self.max_len = int(max_len or net.config.max_seq_len)
         self.num_slots = int(num_slots)
         self.int8 = bool(int8)
+        self.kv_mode = kv_mode
+        self.mesh = mesh
+        self.partition_rules = partition_rules
+        self.replica_id = int(replica_id)
+        self.dev_lock = threading.RLock()
         dec = LlamaDecoder(net, self.max_len)
         self._dec = dec
         w = dec._weights()
         self._w = _quantize_tree(w) if self.int8 else w
         deq = _dequantize_tree if self.int8 else (lambda t: t)
         cfg = net.config
-        shape = (self.num_slots, cfg.num_kv_heads, self.max_len,
-                 cfg.head_dim)
         dt = w["emb"].dtype
-        self._caches = [(jnp.zeros(shape, dt), jnp.zeros(shape, dt))
-                        for _ in range(cfg.num_layers)]
+        if kv_mode == "paged":
+            self.block_size = int(block_size)
+            if self.block_size < 1:
+                raise MXNetError("block_size must be >= 1")
+            #: static block-table width — the step gathers this many
+            #: blocks per slot regardless of actual ownership
+            self.max_blocks = -(-self.max_len // self.block_size)
+            self.num_blocks = int(num_blocks or
+                                  self.num_slots * self.max_blocks)
+            pshape = (self.num_blocks, cfg.num_kv_heads, self.block_size,
+                      cfg.head_dim)
+            self._pool = [(jnp.zeros(pshape, dt), jnp.zeros(pshape, dt))
+                          for _ in range(cfg.num_layers)]
+            self._tables = np.full((self.num_slots, self.max_blocks),
+                                   self.num_blocks, np.int32)
+            self._caches = None
+        else:
+            self.block_size = self.num_blocks = self.max_blocks = None
+            shape = (self.num_slots, cfg.num_kv_heads, self.max_len,
+                     cfg.head_dim)
+            self._caches = [(jnp.zeros(shape, dt), jnp.zeros(shape, dt))
+                            for _ in range(cfg.num_layers)]
+            self._pool = self._tables = None
+        self._place_on_mesh()
         # host mirrors: last emitted token + next write position per slot
         self._last = np.zeros(self.num_slots, np.int32)
         self._pos = np.zeros(self.num_slots, np.int32)
         self.steps = 0
         self._signatures = set()
 
-        def _step_fn(wq, caches, ids, pos):
-            logits, caches = dec._step_slots_impl(deq(wq), caches, ids,
-                                                  pos)
-            return jnp.argmax(logits, axis=-1).astype(jnp.int32), caches
+        if kv_mode == "paged":
 
-        def _prefill_fn(wq, ids, t0):
-            caches, logits = dec._prefill_impl(deq(wq), ids, t0)
-            return jnp.argmax(logits, axis=-1).astype(jnp.int32), caches
+            def _step_fn(wq, pools, tables, ids, pos):
+                logits, pools = dec._step_blocks_impl(deq(wq), pools,
+                                                      tables, ids, pos)
+                return jnp.argmax(logits, axis=-1).astype(jnp.int32), pools
 
-        def _scatter_fn(caches, rows, slots):
-            return [(kc.at[slots].set(nk), vc.at[slots].set(nv))
-                    for (kc, vc), (nk, nv) in zip(caches, rows)]
+            def _prefill_fn(wq, ids, t0):
+                rows, logits = dec._prefill_rows_impl(deq(wq), ids, t0)
+                return jnp.argmax(logits, axis=-1).astype(jnp.int32), rows
+
+            bs = self.block_size
+
+            def _scatter_fn(pools, rows, flat_idx):
+                # rows[l]: (KB, Hkv, Lp, hd) raw prefill K/V; chunk each
+                # row into ceil(Lp/bs) block-sized pieces and write them
+                # at flat_idx (KB*nbp,) physical block ids — sentinel
+                # ids (== num_blocks) drop, covering vacant batch rows
+                # AND chunks past a short prompt's allocation
+                out = []
+                for (kp, vp), (k, v) in zip(pools, rows):
+                    kb, hkv, lp, hd = k.shape
+                    nbp = flat_idx.shape[0] // kb
+                    pad = ((0, 0), (0, 0), (0, nbp * bs - lp), (0, 0))
+
+                    def chunk(a):
+                        return jnp.pad(a, pad) \
+                            .reshape(kb, hkv, nbp, bs, hd) \
+                            .transpose(0, 2, 1, 3, 4) \
+                            .reshape(kb * nbp, hkv, bs, hd)
+
+                    out.append((kp.at[flat_idx].set(chunk(k), mode="drop"),
+                                vp.at[flat_idx].set(chunk(v), mode="drop")))
+                return out
+
+        else:
+
+            def _step_fn(wq, caches, ids, pos):
+                logits, caches = dec._step_slots_impl(deq(wq), caches,
+                                                      ids, pos)
+                return jnp.argmax(logits, axis=-1).astype(jnp.int32), \
+                    caches
+
+            def _prefill_fn(wq, ids, t0):
+                caches, logits = dec._prefill_impl(deq(wq), ids, t0)
+                return jnp.argmax(logits, axis=-1).astype(jnp.int32), \
+                    caches
+
+            def _scatter_fn(caches, rows, slots):
+                return [(kc.at[slots].set(nk), vc.at[slots].set(nv))
+                        for (kc, vc), (nk, nv) in zip(caches, rows)]
 
         self._step = jax.jit(_step_fn, donate_argnums=(1,))
         self._prefill = jax.jit(_prefill_fn)
         self._scatter = jax.jit(_scatter_fn, donate_argnums=(0,))
+
+    # -- mesh placement -------------------------------------------------------
+    def _place_on_mesh(self):
+        """Commit weights + KV storage to ``self.mesh`` per the serving
+        rule table: every leaf gets an explicit NamedSharding (sharded
+        or replicated), so jit infers the device assignment from its
+        inputs and the compiles are mesh-keyed.  int8 leaves shard the
+        q8 rows like the original weight; the per-row scales follow the
+        output dim."""
+        if self.mesh is None:
+            return
+        import jax
+
+        from ..parallel import _named_sharding, _pspec
+        from ..parallel.partition import as_rules
+
+        rules = as_rules(self.partition_rules
+                         if self.partition_rules is not None
+                         else "llama_serving")
+        mesh = self.mesh
+        self._replicated = _named_sharding(mesh, _pspec())
+
+        def put(leaf, spec):
+            return jax.device_put(leaf, _named_sharding(mesh,
+                                                        _pspec(*spec)))
+
+        def leaf_shape(leaf):
+            return leaf["q8"].shape if isinstance(leaf, dict) \
+                else leaf.shape
+
+        items = _named_weight_items(self._w)
+        shapes = {}
+        tree = {"layers": [dict(L) for L in self._w["layers"]],
+                "emb": self._w["emb"], "norm": self._w["norm"],
+                "head": self._w["head"]}
+        for name, path in items:
+            leaf = tree["layers"][path[1]][path[2]] if len(path) == 3 \
+                else tree[path[0]]
+            shapes[name] = leaf_shape(leaf)
+        kv = self._pool if self.kv_mode == "paged" else self._caches
+        for i in range(len(kv)):
+            shapes[f"layers.{i}.kv_pool"] = kv[i][0].shape
+        specs = rules.specs(shapes, mesh)
+        for name, path in items:
+            spec = specs.get(name, ())
+            if len(path) == 3:
+                leaf = tree["layers"][path[1]][path[2]]
+            else:
+                leaf = tree[path[0]]
+            if isinstance(leaf, dict):
+                placed = {"q8": put(leaf["q8"], spec),
+                          "scale": put(leaf["scale"],
+                                       ((spec[0] if spec else None),
+                                        None))}
+            else:
+                placed = put(leaf, spec)
+            if len(path) == 3:
+                tree["layers"][path[1]][path[2]] = placed
+            else:
+                tree[path[0]] = placed
+        self._w = tree
+        placed_kv = []
+        for i, (kb, vb) in enumerate(kv):
+            spec = specs.get(f"layers.{i}.kv_pool", ())
+            placed_kv.append((put(kb, spec), put(vb, spec)))
+        if self.kv_mode == "paged":
+            self._pool = placed_kv
+        else:
+            self._caches = placed_kv
+
+    def _dev(self, a, dtype=np.int32):
+        """Host array → device, committed to the engine's mesh when
+        sharded (replicas may live entirely off the default device)."""
+        import jax
+        import jax.numpy as jnp
+
+        if self.mesh is None:
+            return jnp.asarray(a, dtype)
+        return jax.device_put(np.asarray(a, dtype), self._replicated)
 
     # -- observability --------------------------------------------------------
     def _note(self, key):
@@ -143,67 +327,148 @@ class LlamaServingEngine:
         """Every (program, *bucket) shape this engine has compiled."""
         return sorted(self._signatures)
 
-    # -- transitions ----------------------------------------------------------
+    def kv_pool_bytes(self):
+        """PER-DEVICE bytes of the KV storage (pool or slot caches),
+        summed over layers and both of K/V — the figure the memory
+        planner's ``plan_kv_pool`` predicts pre-build.  On a tp mesh
+        each device holds one shard of the pool's head axis, so this is
+        the single-shard footprint, not the global array size."""
+        def shard_bytes(a):
+            shards = getattr(a, "addressable_shards", None)
+            if shards:
+                return shards[0].data.nbytes
+            return a.nbytes
+
+        kv = self._pool if self.kv_mode == "paged" else self._caches
+        return int(sum(shard_bytes(k) + shard_bytes(v) for k, v in kv))
+
+    # -- transitions (slots mode: legacy single-loop scheduler) ---------------
     def admit(self, prompts_pad, t0s, slots):
         """Prefill ``prompts_pad`` (kb, lp) with true lengths ``t0s``
         (kb,) and scatter the resulting cache rows into ``slots`` (kb,)
         — vacant padding rows carry slot index ``num_slots`` and are
         dropped by XLA's out-of-bounds scatter rule.  Returns each
         row's first generated token (kb,) on host."""
-        import jax.numpy as jnp
-
+        if self.kv_mode != "slots":
+            raise MXNetError("admit() is the slot-ledger path; the paged "
+                             "engine admits via prefill_rows/commit_rows")
         kb, lp = prompts_pad.shape
         self._note(("prefill", kb, lp))
-        toks, rows = self._prefill(self._w, jnp.asarray(prompts_pad),
-                                   jnp.asarray(t0s, jnp.int32))
-        caches = self._caches
-        caches = self._scatter(caches, rows, jnp.asarray(slots, jnp.int32))
-        self._caches = caches
+        toks, rows = self._prefill(self._w, self._dev(prompts_pad),
+                                   self._dev(t0s))
+        with self.dev_lock:
+            self._caches = self._scatter(self._caches, rows,
+                                         self._dev(slots))
         first = _materialize([toks])[0]
-        for i, s in enumerate(slots):
-            if s < self.num_slots:
-                self._last[s] = first[i]
-                self._pos[s] = t0s[i]
+        with self.dev_lock:
+            for i, s in enumerate(slots):
+                if s < self.num_slots:
+                    self._last[s] = first[i]
+                    self._pos[s] = t0s[i]
         return first
 
+    # -- transitions (paged mode: disaggregated lanes) ------------------------
+    def prefill_rows(self, prompts_pad, t0s):
+        """Prefill lane, phase 1: the heavy prompt forward.  Runs
+        WITHOUT the device lock — decode steps interleave freely while
+        a long prompt prefills.  Returns (first-token device array,
+        per-layer raw K/V rows) for :meth:`commit_rows`."""
+        if self.kv_mode != "paged":
+            raise MXNetError("prefill_rows() requires kv_mode='paged'")
+        kb, lp = prompts_pad.shape
+        self._note(("prefill", kb, lp))
+        return self._prefill(self._w, self._dev(prompts_pad),
+                             self._dev(t0s))
+
+    def commit_rows(self, rows, slots, block_lists, t0s, first):
+        """Prefill lane, phase 2: the KV handoff.  Under the device
+        lock (briefly — one scatter dispatch), write the prefilled rows
+        into each admitted request's blocks and install the block
+        tables + decode mirrors, after which the decode lane's next
+        step adopts the slots.  ``first`` is the already-materialized
+        first-token vector (kb,); vacant rows carry slot id
+        ``num_slots`` and sentinel blocks."""
+        import jax.numpy as jnp
+
+        kb = len(slots)
+        lp = rows[0][0].shape[2]
+        nbp = -(-lp // self.block_size)
+        flat = np.full(kb * nbp, self.num_blocks, np.int32)
+        for r, blocks in enumerate(block_lists):
+            if blocks is None:
+                continue
+            take = min(nbp, len(blocks))
+            flat[r * nbp: r * nbp + take] = blocks[:take]
+        with self.dev_lock:
+            self._pool = self._scatter(self._pool, rows, self._dev(flat))
+            for i, s in enumerate(slots):
+                if s < self.num_slots:
+                    row = np.full(self.max_blocks, self.num_blocks,
+                                  np.int32)
+                    blocks = block_lists[i]
+                    row[:len(blocks)] = blocks
+                    self._tables[s] = row
+                    self._last[s] = first[i]
+                    self._pos[s] = t0s[i]
+
+    # -- transitions (both modes) ---------------------------------------------
     def step(self, active):
         """One decode step over ALL slots; returns the (num_slots,)
         next-token vector on host and advances the ``active`` slots'
         mirrors.  Vacant slots run at pos 0 with token 0 — their output
-        is never read and their garbage K/V write stays in their own
-        slot row."""
-        import jax.numpy as jnp
-
+        is never read, and their K/V write lands in their own slot row
+        (slots mode) or is dropped at the sentinel block (paged).  The
+        device lock covers dispatch and mirror updates, NOT the host
+        materialization wait — handoff scatters interleave with the
+        wait."""
         self._note(("step",))
-        caches = self._caches
-        toks, caches = self._step(self._w, caches,
-                                  jnp.asarray(self._last),
-                                  jnp.asarray(self._pos))
-        self._caches = caches
-        self.steps += 1
+        with self.dev_lock:
+            if self.kv_mode == "paged":
+                toks, pool = self._step(
+                    self._w, self._pool, self._dev(self._tables),
+                    self._dev(self._last), self._dev(self._pos))
+                self._pool = pool
+            else:
+                toks, caches = self._step(
+                    self._w, self._caches, self._dev(self._last),
+                    self._dev(self._pos))
+                self._caches = caches
+            self.steps += 1
         out = _materialize([toks])[0]
-        for s in active:
-            self._last[s] = out[s]
-            self._pos[s] += 1
+        with self.dev_lock:
+            for s in active:
+                self._last[s] = out[s]
+                self._pos[s] += 1
         return out
 
     def clear_slot(self, slot):
-        self._last[slot] = 0
-        self._pos[slot] = 0
+        with self.dev_lock:
+            self._last[slot] = 0
+            self._pos[slot] = 0
+            if self._tables is not None:
+                self._tables[slot] = self.num_blocks
 
 
 class GenerativeScheduler:
     """Admit/step/evict loop: continuous batching over the engine.
 
-    Requests carry ``prompt_ids`` + ``max_new_tokens``.  Admission
-    happens between decode steps whenever slots are free — a late
-    request joins the in-flight batch without stopping anyone else's
-    decode (its ``joined_step``/``done_step`` land in the request
-    record, which is how the tier-1 late-join test proves it).
+    This is the LEGACY single-thread loop for the slot-ledger mode
+    (``ServerConfig(kv_mode="slots")``) — one thread interleaves
+    admission (prefill+scatter) with decode steps.  The paged default
+    runs the disaggregated prefill/decode lanes in :mod:`.lanes`
+    instead.  Requests carry ``prompt_ids`` + ``max_new_tokens``.
+    Admission happens between decode steps whenever slots are free — a
+    late request joins the in-flight batch without stopping anyone
+    else's decode (its ``joined_step``/``done_step`` land in the
+    request record, which is how the tier-1 late-join test proves it).
     """
 
     def __init__(self, engine, queue, policy=None, summary_every=16,
                  poll_s=0.02):
+        if engine.kv_mode != "slots":
+            raise MXNetError(
+                "GenerativeScheduler drives the slot-ledger engine; "
+                "paged engines are driven by serving.lanes")
         self.engine = engine
         self.queue = queue
         self.policy = policy or BucketPolicy(

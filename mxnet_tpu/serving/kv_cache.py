@@ -1,46 +1,130 @@
-"""Sliced KV-cache slot accounting for continuous-batching decode.
+"""KV-cache capacity accounting for continuous-batching decode.
 
-The decode engine holds ONE static-shape cache per layer —
-``(num_slots, Hkv, max_len, head_dim)`` — compiled into a single step
-program (``LlamaDecoder._step_slots_impl``).  A "slice" is one slot row
-of that cache.  This manager is the host-side ledger deciding which
-slot each request owns and when the slot returns to the free list:
+Two generations of the same host-side ledger live here:
 
-* ``admit`` — claim a free slot for a request between decode steps
-  (the continuous-batching join point).  Returns None when every slot
-  is busy; the scheduler leaves the request queued.
-* ``advance`` — bump the slot's position after a decode step; reports
-  completion when the token budget is spent.
-* ``evict`` — release the slot (sequence finished or request failed);
-  the slot is immediately reusable by the next admission.
+* :class:`KVCacheManager` — the r8 **slot ledger**: one fixed
+  ``max_len`` cache row per slot, capacity = ``num_slots × max_len``
+  tokens whether or not a request ever uses its worst case.  Kept
+  importable behind the paged pool for A/B (``ServerConfig(
+  kv_mode="slots")``) and for the legacy single-loop scheduler.
+* :class:`PagedKVCacheManager` — the r11 **paged pool**: device K/V
+  lives in fixed-size blocks (``block_size`` tokens each) drawn from a
+  shared :class:`BlockAllocator`; each request owns a *block list*
+  sized to its actual ``prompt_len + max_new_tokens`` budget, so pool
+  capacity is bounded by tokens in flight, not by
+  ``max_len × num_slots``.  A long-prompt + short-prompt mix that the
+  slot ledger could only host with worst-case reservations fits a much
+  smaller pool (the r11 capacity acceptance test admits a mix whose
+  slot-ledger worst case exceeds the pool outright).
 
-Invariants (tier-1 tested): free ∪ active = all slots, free ∩ active =
-∅, a slot is never admitted twice without an evict in between, and
-positions never exceed ``max_len``.  Device-side slot contents are the
-engine's problem — admission's prefill scatter overwrites the whole
-slot row, so stale K/V from the previous tenant is unreachable.
+Both managers expose the same transition surface (``admit`` /
+``advance`` / ``consume`` / ``evict``) plus ``check()`` invariants and
+``stats()`` with fragmentation and peak-token occupancy.  The paged
+manager is touched by TWO lane threads (prefill admits, decode
+advances/evicts — docs/serving.md) and serializes its transitions on an
+internal lock; the slot ledger stays single-threaded under the legacy
+scheduler.
+
+Device-side block contents are the engine's problem: a freshly
+allocated block may hold a previous tenant's K/V, but the per-slot
+causal mask (``t <= pos``) hides every position the current request has
+not yet written, so stale rows are unreachable — the same invariant
+that lets the slot ledger skip zeroing slot rows.
 """
 from __future__ import annotations
 
+import threading
+
 from ..base import MXNetError
 
-__all__ = ["KVCacheManager", "SlotState"]
+__all__ = ["KVCacheManager", "PagedKVCacheManager", "BlockAllocator",
+           "SlotState"]
 
 
 class SlotState:
     """One occupied slot's bookkeeping."""
 
-    __slots__ = ("request_id", "pos", "remaining", "joined_step")
+    __slots__ = ("request_id", "pos", "remaining", "joined_step",
+                 "blocks", "reserved")
 
-    def __init__(self, request_id, pos, remaining, joined_step):
+    def __init__(self, request_id, pos, remaining, joined_step,
+                 blocks=None, reserved=0):
         self.request_id = request_id
         self.pos = pos              # next cache row the step writes
         self.remaining = remaining  # tokens still owed to the request
         self.joined_step = joined_step
+        self.blocks = blocks or []  # paged: block ids, logical order
+        self.reserved = reserved    # paged: token budget behind blocks
+
+
+class BlockAllocator:
+    """Fixed-size KV block pool: ``num_blocks`` blocks of
+    ``block_size`` tokens each, free-list allocation.
+
+    ``alloc`` is all-or-nothing (a request either gets its whole block
+    list or stays queued — no partial reservations to unwind), and
+    ``free`` rejects double-frees and foreign ids, so a block can never
+    be owned by two sequences at once.
+    """
+
+    def __init__(self, num_blocks, block_size):
+        if num_blocks < 1 or block_size < 1:
+            raise MXNetError("num_blocks and block_size must be >= 1")
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        self._free = list(range(self.num_blocks - 1, -1, -1))  # pop()->0
+        self._in_use = set()
+        self._peak_in_use = 0
+
+    @property
+    def free_blocks(self):
+        return len(self._free)
+
+    @property
+    def blocks_in_use(self):
+        return len(self._in_use)
+
+    @property
+    def peak_blocks_in_use(self):
+        return self._peak_in_use
+
+    def alloc(self, n):
+        """Claim ``n`` blocks (ascending ids).  Returns the id list, or
+        None when the pool cannot cover the request (all-or-nothing)."""
+        if n < 0:
+            raise MXNetError(f"cannot allocate {n} blocks")
+        if n > len(self._free):
+            return None
+        blocks = [self._free.pop() for _ in range(n)]
+        self._in_use.update(blocks)
+        self._peak_in_use = max(self._peak_in_use, len(self._in_use))
+        return blocks
+
+    def free(self, blocks):
+        """Return ``blocks`` to the pool; double-free / unknown ids
+        raise (the no-double-assignment invariant's enforcement edge)."""
+        for b in blocks:
+            if b not in self._in_use:
+                raise MXNetError(f"block {b} is not allocated")
+        for b in blocks:
+            self._in_use.discard(b)
+            self._free.append(b)
+
+    def check(self):
+        free = set(self._free)
+        if len(free) != len(self._free):
+            raise MXNetError("duplicate ids on the free list")
+        if free & self._in_use:
+            raise MXNetError(
+                f"blocks both free and in use: {free & self._in_use}")
+        if free | self._in_use != set(range(self.num_blocks)):
+            raise MXNetError("block pool lost track of blocks")
+        return True
 
 
 class KVCacheManager:
-    """Fixed-capacity slot ledger (``num_slots`` concurrent sequences)."""
+    """Fixed-capacity slot ledger (``num_slots`` concurrent sequences),
+    each slot owning a full ``max_len`` cache row."""
 
     def __init__(self, num_slots, max_len):
         if num_slots < 1:
@@ -52,6 +136,7 @@ class KVCacheManager:
         self._admits = 0
         self._evictions = 0
         self._peak_occupancy = 0
+        self._peak_tokens = 0
 
     # -- queries --------------------------------------------------------------
     def free_slots(self):
@@ -64,11 +149,26 @@ class KVCacheManager:
     def state(self, slot):
         return self._active[slot]
 
+    def tokens_in_flight(self):
+        """K/V rows live right now = sum of active write positions."""
+        return sum(st.pos for st in self._active.values())
+
     def stats(self):
+        """Occupancy counters plus the r11 capacity metrics: the slot
+        ledger reserves ``max_len`` rows for every OCCUPIED slot, so its
+        ``fragmentation`` is the fraction of those reservations holding
+        no live token — the number the paged pool exists to shrink."""
+        reserved = len(self._active) * self.max_len
+        live = self.tokens_in_flight()
         return {"admits": self._admits, "evictions": self._evictions,
                 "occupancy": len(self._active),
                 "peak_occupancy": self._peak_occupancy,
-                "num_slots": self.num_slots}
+                "num_slots": self.num_slots,
+                "capacity_tokens": self.num_slots * self.max_len,
+                "tokens_in_flight": int(live),
+                "peak_tokens": int(self._peak_tokens),
+                "fragmentation": round(1.0 - live / reserved, 4)
+                if reserved else 0.0}
 
     # -- transitions ----------------------------------------------------------
     def admit(self, request_id, prompt_len, max_new_tokens, step=0):
@@ -86,6 +186,7 @@ class KVCacheManager:
                                        max_new_tokens, step)
         self._admits += 1
         self._peak_occupancy = max(self._peak_occupancy, len(self._active))
+        self._peak_tokens = max(self._peak_tokens, self.tokens_in_flight())
         return slot
 
     def advance(self, slot):
@@ -96,6 +197,7 @@ class KVCacheManager:
         st.pos += 1
         if st.pos > self.max_len:
             raise MXNetError(f"slot {slot} overran max_len {self.max_len}")
+        self._peak_tokens = max(self._peak_tokens, self.tokens_in_flight())
 
     def consume(self, slot):
         """One output token was emitted for ``slot``'s request.  Returns
@@ -125,3 +227,179 @@ class KVCacheManager:
                 raise MXNetError(f"slot {slot} position {st.pos} out of "
                                  f"range [0, {self.max_len}]")
         return True
+
+
+class PagedKVCacheManager:
+    """Block-pool ledger: slots are still the decode batch rows (the
+    step program's shape), but K/V capacity comes from a shared
+    :class:`BlockAllocator` — a request is admitted only when BOTH a
+    slot and its whole block list (``ceil((prompt + budget) /
+    block_size)`` blocks) are available.  All transitions are
+    lock-serialized: the prefill lane admits while the decode lane
+    advances and evicts."""
+
+    def __init__(self, num_slots, max_len, num_blocks, block_size):
+        if num_slots < 1:
+            raise MXNetError("num_slots must be >= 1")
+        self.num_slots = int(num_slots)
+        self.max_len = int(max_len)
+        self.block_size = int(block_size)
+        self.allocator = BlockAllocator(num_blocks, block_size)
+        self.num_blocks = self.allocator.num_blocks
+        #: static per-slot block-table width: the step program gathers
+        #: this many blocks per slot whatever the request actually owns
+        self.max_blocks = -(-self.max_len // self.block_size)
+        self._free = list(range(self.num_slots - 1, -1, -1))
+        self._active = {}
+        self._admits = 0
+        self._evictions = 0
+        self._peak_occupancy = 0
+        self._peak_tokens = 0
+        self._lock = threading.RLock()
+
+    # -- queries --------------------------------------------------------------
+    def blocks_for(self, prompt_len, max_new_tokens):
+        """Blocks a request needs for its whole lifetime (prompt rows +
+        every decode write), allocated up front at admit so a running
+        sequence can never stall mid-decode on pool exhaustion."""
+        return -(-(prompt_len + max_new_tokens) // self.block_size)
+
+    def can_admit(self, prompt_len, max_new_tokens):
+        with self._lock:
+            return bool(self._free) and \
+                self.blocks_for(prompt_len, max_new_tokens) \
+                <= self.allocator.free_blocks
+
+    def free_slots(self):
+        with self._lock:
+            return len(self._free)
+
+    def active_slots(self):
+        with self._lock:
+            return sorted(self._active)
+
+    def state(self, slot):
+        return self._active[slot]
+
+    def tokens_in_flight(self):
+        with self._lock:
+            return sum(st.pos for st in self._active.values())
+
+    def reserved_tokens(self):
+        with self._lock:
+            return sum(st.reserved for st in self._active.values())
+
+    def stats(self):
+        """Slot counters plus pool metrics.  ``fragmentation`` here is
+        *internal*: the fraction of allocated block capacity not yet
+        holding a live token (tail of each request's last block + the
+        decode budget allocated ahead of the write cursor)."""
+        with self._lock:
+            live = sum(st.pos for st in self._active.values())
+            used = self.allocator.blocks_in_use
+            alloc_cap = used * self.block_size
+            return {
+                "admits": self._admits, "evictions": self._evictions,
+                "occupancy": len(self._active),
+                "peak_occupancy": self._peak_occupancy,
+                "num_slots": self.num_slots,
+                "num_blocks": self.num_blocks,
+                "block_size": self.block_size,
+                "blocks_in_use": used,
+                "peak_blocks_in_use": self.allocator.peak_blocks_in_use,
+                "capacity_tokens": self.num_blocks * self.block_size,
+                "tokens_in_flight": int(live),
+                "peak_tokens": int(self._peak_tokens),
+                "fragmentation": round(1.0 - live / alloc_cap, 4)
+                if alloc_cap else 0.0,
+            }
+
+    # -- transitions ----------------------------------------------------------
+    def admit(self, request_id, prompt_len, max_new_tokens, step=0):
+        """Claim a slot AND the request's full block list.  Returns
+        ``(slot, blocks)`` or None when either is unavailable (the
+        request stays queued)."""
+        if prompt_len + max_new_tokens > self.max_len:
+            raise MXNetError(
+                f"sequence budget {prompt_len}+{max_new_tokens} exceeds "
+                f"cache max_len {self.max_len}")
+        need = self.blocks_for(prompt_len, max_new_tokens)
+        with self._lock:
+            if not self._free:
+                return None
+            blocks = self.allocator.alloc(need)
+            if blocks is None:
+                return None
+            slot = self._free.pop()
+            self._active[slot] = SlotState(
+                request_id, prompt_len, max_new_tokens, step,
+                blocks=blocks, reserved=prompt_len + max_new_tokens)
+            self._admits += 1
+            self._peak_occupancy = max(self._peak_occupancy,
+                                       len(self._active))
+            self._peak_tokens = max(
+                self._peak_tokens,
+                sum(st.pos for st in self._active.values()))
+            return slot, blocks
+
+    def advance(self, slot):
+        with self._lock:
+            st = self._active[slot]
+            st.pos += 1
+            if st.pos > st.reserved:
+                raise MXNetError(
+                    f"slot {slot} overran its reserved {st.reserved} "
+                    "tokens")
+            self._peak_tokens = max(
+                self._peak_tokens,
+                sum(s.pos for s in self._active.values()))
+
+    def consume(self, slot):
+        with self._lock:
+            st = self._active[slot]
+            st.remaining -= 1
+            return st.remaining <= 0
+
+    def evict(self, slot):
+        """Release the slot and return ALL of its blocks to the pool."""
+        with self._lock:
+            if slot not in self._active:
+                raise MXNetError(f"slot {slot} is not active")
+            st = self._active.pop(slot)
+            self.allocator.free(st.blocks)
+            self._free.append(slot)
+            self._evictions += 1
+            return st.blocks
+
+    def check(self):
+        """Slot invariants + block invariants: the active block lists
+        partition the allocator's in-use set (no block in two lists, no
+        leaked allocation), and every list covers its reservation."""
+        with self._lock:
+            free = set(self._free)
+            active = set(self._active)
+            if free & active:
+                raise MXNetError(
+                    f"slots both free and active: {free & active}")
+            if free | active != set(range(self.num_slots)):
+                raise MXNetError("slot ledger lost track of slots")
+            owned = []
+            for slot, st in self._active.items():
+                if not 0 <= st.pos <= st.reserved <= self.max_len:
+                    raise MXNetError(
+                        f"slot {slot} pos {st.pos} / reserved "
+                        f"{st.reserved} out of range")
+                if len(st.blocks) * self.block_size < st.reserved:
+                    raise MXNetError(
+                        f"slot {slot} blocks cover "
+                        f"{len(st.blocks) * self.block_size} < reserved "
+                        f"{st.reserved} tokens")
+                owned.extend(st.blocks)
+            if len(owned) != len(set(owned)):
+                raise MXNetError("a block appears in two block lists")
+            if set(owned) != self.allocator._in_use:
+                raise MXNetError(
+                    "active block lists do not match the allocator's "
+                    "in-use set")
+            self.allocator.check()
+            return True

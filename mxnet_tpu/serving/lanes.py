@@ -1,0 +1,473 @@
+"""Disaggregated prefill/decode execution lanes over paged KV.
+
+`SERVING_LATENCY_r08.json` showed the r8 single-loop server queue-bound
+(queue-wait was 52.7 of 53.7 ms closed-loop p99): one thread interleaves
+compute-bound prompt prefills with latency-bound decode ticks, so every
+long prompt stalls every in-flight decode.  This module splits the two
+phases into lanes with their own scheduler threads and batch policies,
+connected by an explicit KV handoff:
+
+* :class:`PrefillLane` — batch-tolerant.  Pulls the FIFO-head prompt
+  bucket from the replica queue, gated by the paged-KV admission budget
+  (free decode slots, free KV blocks, a cumulative prompt-token ceiling
+  — prefill batches greedily by token count, not request count), admits
+  each request to the :class:`~.kv_cache.PagedKVCacheManager` (which
+  reserves the request's whole block budget up front — no mid-decode
+  allocation stall), runs the prompt forward OUTSIDE the engine's
+  device lock, then commits the raw K/V rows into the admitted blocks
+  (one brief locked scatter) and hands the slot to the decode lane.
+* :class:`DecodeLane` — latency-structured.  Every tick it adopts
+  pending handoffs, then advances *its own* slot set one token.  It
+  never sees a prompt forward: while a long prompt prefills, decode
+  ticks keep dispatching (the device lock covers only the KV-mutating
+  dispatches, not the prefill compute).
+* :class:`Replica` — one engine + manager + lane pair over one (tp)
+  submesh.  A dp mesh axis becomes N independent replicas behind one
+  front queue, routed by :class:`ReplicaDispatcher` to the
+  least-loaded replica (by reserved + queued tokens).
+
+Host-sync discipline: the decode drain and the handoff boundary block
+on device results in :func:`_lane_materialize` ONLY — the lane twin of
+``scheduler._materialize``, exempted by name in tools/lint
+(``MATERIALIZE_DEFS``); syncs anywhere else in the lanes still flag.
+
+Telemetry: requests carry ``replica``/``handoff_ms``/``kv_blocks`` in
+their JSONL records, lanes emit ``serving.prefill`` spans and
+``serving.handoff_ms`` histograms, and the decode tick publishes the
+``serving.kv_blocks_in_use`` gauge (see docs/observability.md).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+
+import numpy as np
+
+from .. import telemetry
+from .bucketing import pad_batch
+from .kv_cache import PagedKVCacheManager
+from .protocol import ServerClosedError
+from .scheduler import RequestQueue
+
+__all__ = ["PrefillLane", "DecodeLane", "Replica", "ReplicaDispatcher"]
+
+
+def _lane_materialize(arrays):
+    """The lanes' designated device→host sync point (first tokens at
+    the prefill→decode handoff, token vectors at each decode tick) —
+    the only def in this module sanctioned for eager syncs by
+    tools/lint's ``MATERIALIZE_DEFS``, mirroring
+    ``scheduler._materialize``."""
+    out = []
+    for a in arrays:
+        if hasattr(a, "asnumpy"):
+            out.append(a.asnumpy())
+        else:
+            out.append(np.asarray(a))
+    return out
+
+
+class _Handoff:
+    """One admitted request crossing the prefill→decode boundary: its
+    KV rows are already scattered into its blocks; the decode lane just
+    adopts the slot."""
+
+    __slots__ = ("req", "slot", "first")
+
+    def __init__(self, req, slot, first):
+        self.req = req
+        self.slot = slot
+        self.first = first
+
+
+class PrefillLane:
+    """Admission + prompt forward + KV commit, one thread per replica."""
+
+    def __init__(self, replica, poll_s=0.02):
+        self.r = replica
+        self.poll_s = float(poll_s)
+        self._stop = threading.Event()
+        self._drain = True
+        self._thread = None
+
+    def start(self):
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop,
+                name=f"mxt-prefill-r{self.r.index}", daemon=True)
+            self._thread.start()
+
+    def request_stop(self, drain=True):
+        self._drain = drain
+        self._stop.set()
+
+    def join(self):
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+
+    def _loop(self):
+        q = self.r.queue
+        while True:
+            if self._stop.is_set():
+                if not self._drain or not len(q):
+                    break
+            if not self._admit_batch() and not self._stop.is_set():
+                if len(q):
+                    # queue non-empty but gated on capacity: wait for an
+                    # eviction to free slots/blocks (wait_for_item would
+                    # return immediately and busy-spin against decode)
+                    self.r.capacity_evt.wait(self.poll_s)
+                    self.r.capacity_evt.clear()
+                else:
+                    q.wait_for_item(self.poll_s)
+
+    def _bucket(self, req):
+        return self.r.policy.length_bucket(len(req.prompt_ids))
+
+    def _admit_batch(self):
+        """One prefill batch: gate → admit → forward (unlocked) →
+        commit (locked) → handoff.  Returns True if anything ran."""
+        r = self.r
+        mgr = r.mgr
+        free_slots = mgr.free_slots()
+        if not free_slots or not len(r.queue):
+            return False
+        free_blocks = mgr.allocator.free_blocks
+        budget = {"n": 0, "blocks": 0, "tokens": 0}
+
+        def accept(req):
+            # the lane's own batch policy: greedy by token count under
+            # the block budget, not a fixed request count
+            need = mgr.blocks_for(len(req.prompt_ids),
+                                  req.max_new_tokens)
+            if budget["n"] >= free_slots:
+                return False
+            if budget["blocks"] + need > free_blocks:
+                return False
+            if budget["tokens"] and (budget["tokens"]
+                                     + len(req.prompt_ids)
+                                     > r.max_prefill_tokens):
+                return False
+            budget["n"] += 1
+            budget["blocks"] += need
+            budget["tokens"] += len(req.prompt_ids)
+            return True
+
+        group = r.queue.take_batch(
+            self._bucket, min(free_slots, r.policy.max_batch), accept)
+        if not group:
+            return False
+        t_start = time.perf_counter()
+        lb = self._bucket(group[0])
+        kb = r.policy.batch_bucket(len(group))
+        eng = r.engine
+        try:
+            prompts = pad_batch([np.asarray(q.prompt_ids, np.int32)
+                                 for q in group], kb, lb)
+            t0s = np.full(kb, len(group[0].prompt_ids), np.int32)
+            slots = np.full(kb, eng.num_slots, np.int32)
+            block_lists = [None] * kb
+            for i, req in enumerate(group):
+                t0s[i] = len(req.prompt_ids)
+                slot, blocks = mgr.admit(req.id, int(t0s[i]),
+                                         req.max_new_tokens,
+                                         step=eng.steps)
+                slots[i] = slot
+                block_lists[i] = blocks
+                req.slot = int(slot)
+                req.kv_blocks = len(blocks)
+                req.replica = r.index
+                req.joined_step = eng.steps
+                req.t_start = t_start
+                req.bucket = (kb, lb)
+                req.batch_size = len(group)
+            with telemetry.span("serving.prefill",
+                                {"lane": "prefill", "replica": r.index,
+                                 "batch": kb, "length": lb}):
+                toks, rows = eng.prefill_rows(prompts, t0s)
+                first = _lane_materialize([toks])[0]
+                eng.commit_rows(rows, slots, block_lists, t0s, first)
+        except Exception as exc:
+            for req in group:
+                if req.slot is not None and req.slot in mgr._active:
+                    mgr.evict(req.slot)
+                    eng.clear_slot(req.slot)
+                req.future.set_exception(exc)
+            r.capacity_evt.set()
+            r.failed += len(group)
+            telemetry.count("serving.failed", len(group))
+            return True
+        t_first = time.perf_counter()
+        for i, req in enumerate(group):
+            req.t_first = t_first
+            if mgr.consume(req.slot):
+                # max_new_tokens == 1: done at prefill, never decodes
+                r.finish(req, [int(first[i])])
+            else:
+                r.decode.hand_off(_Handoff(req, req.slot,
+                                           int(first[i])))
+        telemetry.count("serving.admitted", len(group))
+        return True
+
+
+class DecodeLane:
+    """Slot-set advancement, one thread per replica: adopt handoffs,
+    tick every in-flight slot, evict finished requests (returning their
+    KV blocks to the pool)."""
+
+    def __init__(self, replica, poll_s=0.005):
+        self.r = replica
+        self.poll_s = float(poll_s)
+        self._handoffs = deque()
+        self._hand_lock = threading.Lock()
+        self._seqs = {}       # slot -> (request, [generated tokens])
+        self._wake = threading.Event()   # set on hand_off: adopt now
+        self._stop = threading.Event()
+        self._thread = None
+
+    def hand_off(self, h):
+        with self._hand_lock:
+            self._handoffs.append(h)
+        self._wake.set()
+
+    def pending(self):
+        with self._hand_lock:
+            return len(self._handoffs) + len(self._seqs)
+
+    def start(self):
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop,
+                name=f"mxt-decode-r{self.r.index}", daemon=True)
+            self._thread.start()
+
+    def request_stop(self):
+        self._stop.set()
+
+    def join(self):
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+
+    def _loop(self):
+        while True:
+            self._adopt()
+            if self._seqs:
+                self._tick()
+            elif self._stop.is_set():
+                if not self.pending():
+                    break
+            else:
+                self._wake.wait(self.poll_s)
+                self._wake.clear()
+
+    def _adopt(self):
+        """Pull every pending handoff into this lane's slot set.  The
+        KV rows are already in the request's blocks (the prefill lane
+        committed them before handing off), so adoption is pure
+        bookkeeping — decode only ever advances slots it has adopted,
+        never a slot whose commit is still in flight."""
+        while True:
+            with self._hand_lock:
+                if not self._handoffs:
+                    return
+                h = self._handoffs.popleft()
+            h.req.t_handoff = time.perf_counter()
+            telemetry.hist("serving.handoff_ms",
+                           (h.req.t_handoff - h.req.t_first) * 1e3)
+            self._seqs[h.slot] = (h.req, [h.first])
+
+    def _tick(self):
+        r = self.r
+        active = sorted(self._seqs)
+        try:
+            toks = r.engine.step(active)
+        except Exception as exc:
+            for slot in active:
+                req, _ = self._seqs.pop(slot)
+                r.mgr.evict(slot)
+                r.engine.clear_slot(slot)
+                req.future.set_exception(exc)
+            r.capacity_evt.set()
+            r.failed += len(active)
+            telemetry.count("serving.failed", len(active))
+            return
+        r.batches += 1
+        telemetry.hist("serving.batch_size", len(active))
+        telemetry.gauge("serving.kv_blocks_in_use",
+                        r.mgr.allocator.blocks_in_use)
+        for slot in active:
+            r.mgr.advance(slot)   # the step wrote K/V at slot's pos
+            req, tokens = self._seqs[slot]
+            tokens.append(int(toks[slot]))
+            if r.mgr.consume(slot):
+                del self._seqs[slot]
+                r.finish(req, tokens)
+
+
+class Replica:
+    """One model replica: engine + paged-KV manager + lane pair over
+    one (tp) submesh, fed by a bounded internal queue."""
+
+    def __init__(self, net, policy, index=0, mesh=None,
+                 partition_rules=None, num_slots=4, int8=False,
+                 block_size=16, num_blocks=None, queue_capacity=64,
+                 max_prefill_tokens=None, summary_every=32):
+        from .generative import LlamaServingEngine
+
+        self.index = int(index)
+        self.policy = policy
+        self.engine = LlamaServingEngine(
+            net, max_len=policy.max_length, num_slots=num_slots,
+            int8=int8, kv_mode="paged", block_size=block_size,
+            num_blocks=num_blocks, mesh=mesh,
+            partition_rules=partition_rules, replica_id=self.index)
+        self.mgr = PagedKVCacheManager(
+            num_slots, policy.max_length,
+            num_blocks=self.engine.num_blocks,
+            block_size=self.engine.block_size)
+        self.queue = RequestQueue(queue_capacity)
+        self.max_prefill_tokens = int(max_prefill_tokens or
+                                      policy.max_batch
+                                      * policy.max_length)
+        self.summary_every = int(summary_every)
+        self.prefill = PrefillLane(self)
+        self.decode = DecodeLane(self)
+        self.capacity_evt = threading.Event()  # set on evict: re-admit
+        self.completed = 0
+        self.failed = 0
+        self.batches = 0
+
+    # -- dispatcher-facing ----------------------------------------------------
+    def load(self):
+        """Routing weight: tokens reserved in the KV pool plus tokens
+        waiting in the internal queue."""
+        with self.queue._cond:
+            queued = sum(len(r.prompt_ids) + r.max_new_tokens
+                         for r in self.queue._items)
+        return self.mgr.reserved_tokens() + queued
+
+    def offer(self, req):
+        return self.queue.offer(req)
+
+    # -- lifecycle ------------------------------------------------------------
+    def start(self):
+        self.prefill.start()
+        self.decode.start()
+
+    def stop(self, drain=True):
+        """Drain order matters: prefill first (with decode still live,
+        so draining admissions can wait for blocks decode will free),
+        then decode finishes the in-flight slot set."""
+        self.queue.close()
+        self.prefill.request_stop(drain)
+        self.prefill.join()
+        self.decode.request_stop()
+        self.decode.join()
+        for req in self.queue.take_group(lambda r: 0, 1 << 30):
+            req.future.set_exception(
+                ServerClosedError("server stopped before execution"))
+
+    # -- completion -----------------------------------------------------------
+    def finish(self, req, tokens):
+        self.mgr.evict(req.slot)
+        self.engine.clear_slot(req.slot)
+        self.capacity_evt.set()
+        req.t_done = time.perf_counter()
+        req.done_step = self.engine.steps
+        n = req.max_new_tokens
+        req.future.set_result(np.concatenate(
+            [np.asarray(req.prompt_ids, np.int32),
+             np.asarray(tokens[:n], np.int32)]))
+        self.completed += 1
+        telemetry.count("serving.completed")
+        rec = req.record()
+        rec["lane"] = "decode" if req.t_handoff is not None else "prefill"
+        if rec["queue_wait_ms"] is not None:
+            telemetry.hist("serving.queue_wait_ms", rec["queue_wait_ms"])
+        if rec["total_ms"] is not None:
+            telemetry.hist("serving.total_ms", rec["total_ms"])
+        if rec.get("ttft_ms") is not None:
+            telemetry.hist("serving.ttft_ms", rec["ttft_ms"])
+        telemetry.emit(rec)
+        if self.summary_every and self.completed % self.summary_every == 0:
+            self.emit_summary()
+
+    def emit_summary(self):
+        telemetry.emit({
+            "record": "serving.latency",
+            "replica": self.index,
+            "completed": self.completed,
+            "failed": self.failed,
+            "batches": self.batches,
+            "queue_wait_ms": telemetry.hist_summary("serving.queue_wait_ms"),
+            "total_ms": telemetry.hist_summary("serving.total_ms"),
+            "ttft_ms": telemetry.hist_summary("serving.ttft_ms"),
+            "handoff_ms": telemetry.hist_summary("serving.handoff_ms"),
+            "batch_size": telemetry.hist_summary("serving.batch_size"),
+            "kv_cache": self.mgr.stats(),
+        })
+
+
+class ReplicaDispatcher:
+    """Routes the front queue to the least-loaded replica.
+
+    One thread pops the FIFO head and offers it to the replica with the
+    smallest :meth:`Replica.load` that has internal queue space; if all
+    replica queues are full the head is held (client backpressure
+    already happened at the front queue's bounded ``put``)."""
+
+    def __init__(self, queue, replicas, poll_s=0.005):
+        self.queue = queue
+        self.replicas = list(replicas)
+        self.poll_s = float(poll_s)
+        self._held = None
+        self._stop = threading.Event()
+        self._drain = True
+        self._thread = None
+
+    def start(self):
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._loop,
+                                            name="mxt-dispatch",
+                                            daemon=True)
+            self._thread.start()
+
+    def stop(self, drain=True):
+        self._drain = drain
+        self._stop.set()
+        self.queue.close()
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        leftovers = ([self._held] if self._held is not None else []) \
+            + self.queue.take_group(lambda r: 0, 1 << 30)
+        self._held = None
+        for req in leftovers:
+            if drain:
+                while not self._route(req):
+                    time.sleep(self.poll_s)
+            else:
+                req.future.set_exception(
+                    ServerClosedError("server stopped before execution"))
+
+    def _route(self, req):
+        for rep in sorted(self.replicas, key=lambda r: r.load()):
+            if rep.offer(req):
+                return True
+        return False
+
+    def _loop(self):
+        while not self._stop.is_set():
+            if self._held is None:
+                group = self.queue.take_group(lambda r: 0, 1)
+                if not group:
+                    self.queue.wait_for_item(self.poll_s)
+                    continue
+                self._held = group[0]
+            if self._route(self._held):
+                self._held = None
+            else:
+                time.sleep(self.poll_s)

@@ -59,7 +59,7 @@ class Request:
     __slots__ = ("id", "inputs", "length", "prompt_ids", "max_new_tokens",
                  "future", "t_submit", "t_start", "t_first", "t_done",
                  "batch_size", "bucket", "slot", "joined_step",
-                 "done_step")
+                 "done_step", "replica", "t_handoff", "kv_blocks")
 
     def __init__(self, inputs=None, length=None, prompt_ids=None,
                  max_new_tokens=None):
@@ -78,6 +78,10 @@ class Request:
         self.slot = None
         self.joined_step = None
         self.done_step = None
+        # disaggregated-lane fields (paged path; see docs/observability.md)
+        self.replica = None     # which dp replica served the request
+        self.t_handoff = None   # decode lane adopted the prefilled KV
+        self.kv_blocks = None   # blocks reserved for the request
 
     def record(self, kind="serving.request"):
         """The per-request JSONL record (emitted on completion)."""
@@ -97,4 +101,12 @@ class Request:
             rec["slot"] = self.slot
             rec["joined_step"] = self.joined_step
             rec["done_step"] = self.done_step
+        if self.replica is not None:
+            rec["replica"] = self.replica
+        if self.kv_blocks is not None:
+            rec["kv_blocks"] = self.kv_blocks
+        if self.t_handoff is not None and self.t_first is not None:
+            # prefill→decode KV handoff latency: first token emitted by
+            # the prefill forward → decode lane adopted the slot
+            rec["handoff_ms"] = (self.t_handoff - self.t_first) * 1e3
         return rec

@@ -91,6 +91,19 @@ class RequestQueue:
                 self._cond.wait(timeout)
             return bool(self._items)
 
+    def offer(self, req):
+        """Non-raising ``put``: False when full/closed instead of an
+        exception, and never counted as a rejection — the dispatcher's
+        primitive for routing an ALREADY-accepted request to a replica
+        queue (the client-facing backpressure happened at the front
+        queue's ``put``)."""
+        with self._cond:
+            if self._closed or len(self._items) >= self.capacity:
+                return False
+            self._items.append(req)
+            self._cond.notify_all()
+            return True
+
     def take_group(self, key_fn, max_n):
         """Pop the FIFO head plus every queued request sharing its
         ``key_fn`` value (the length bucket), up to ``max_n``, keeping
@@ -105,6 +118,29 @@ class RequestQueue:
                     taken.append(r)
                 else:
                     rest.append(r)
+            self._items = rest
+            return taken
+
+    def take_batch(self, key_fn, max_n, accept):
+        """Like :meth:`take_group`, but each candidate must also pass
+        ``accept(req)`` — the prefill lane's admission gate (cumulative
+        KV block budget).  Stops at the FIRST head-bucket request the
+        gate refuses, so admission stays FIFO within the bucket instead
+        of starving a big request behind small ones."""
+        with self._cond:
+            if not self._items:
+                return []
+            head_key = key_fn(self._items[0])
+            taken, rest = [], []
+            gate_shut = False
+            for r in self._items:
+                if (not gate_shut and len(taken) < max_n
+                        and key_fn(r) == head_key):
+                    if accept(r):
+                        taken.append(r)
+                        continue
+                    gate_shut = True
+                rest.append(r)
             self._items = rest
             return taken
 

@@ -49,7 +49,8 @@ class ServerConfig:
                  min_length=8, queue_capacity=64, batch_window_ms=2.0,
                  summary_every=32, length_axis=0, output_length_axis=None,
                  num_slots=4, max_new_tokens=32, int8=False,
-                 calib_data=None):
+                 calib_data=None, kv_mode="paged", block_size=16,
+                 num_blocks=None):
         self.policy = BucketPolicy(max_batch=max_batch,
                                    max_length=max_length,
                                    min_batch=min_batch,
@@ -63,6 +64,17 @@ class ServerConfig:
         self.max_new_tokens = int(max_new_tokens)
         self.int8 = bool(int8)
         self.calib_data = calib_data
+        # generative KV storage: "paged" (block pool + disaggregated
+        # prefill/decode lanes, the default) or "slots" (the r8 ledger
+        # + single-loop scheduler, kept for A/B).  ``num_blocks=None``
+        # sizes the pool at ledger parity (num_slots × max_len tokens);
+        # smaller pools bound capacity by tokens in flight instead.
+        if kv_mode not in ("paged", "slots"):
+            raise MXNetError(f"unknown kv_mode {kv_mode!r}; "
+                             "expected 'paged' or 'slots'")
+        self.kv_mode = kv_mode
+        self.block_size = int(block_size)
+        self.num_blocks = num_blocks
 
 
 class _ServerBase:
@@ -186,19 +198,104 @@ class InferenceServer(_ServerBase):
         return out
 
 
-class GenerativeServer(_ServerBase):
-    """Continuous-batching decode server for ``LlamaForCausalLM``."""
+def _split_mesh(mesh, dp_axis="dp"):
+    """One submesh per dp replica: slice ``dp_axis`` off and keep the
+    remaining axes (tp, ...) per slice, so each replica's engine is an
+    ordinary tensor-parallel engine over its own devices.  No mesh →
+    ``[None]`` (single default-device replica); no dp axis → the whole
+    mesh is one replica."""
+    if mesh is None:
+        return [None]
+    if dp_axis not in mesh.axis_names:
+        return [mesh]
+    from jax.sharding import Mesh
 
-    def __init__(self, net, config=None):
+    axis = mesh.axis_names.index(dp_axis)
+    rest = tuple(a for a in mesh.axis_names if a != dp_axis)
+    devs = np.moveaxis(mesh.devices, axis, 0)
+    if not rest:
+        # dp-only mesh: each replica is a single-device tp=1 mesh so
+        # its weights still commit to ITS device, not the default one
+        return [Mesh(np.asarray(devs[i]).reshape(1), ("tp",))
+                for i in range(devs.shape[0])]
+    return [Mesh(devs[i], rest) for i in range(devs.shape[0])]
+
+
+class GenerativeServer(_ServerBase):
+    """Continuous-batching decode server for ``LlamaForCausalLM``.
+
+    Mesh-native: ``mesh=`` places the weights (and the KV pool)
+    tensor-parallel per ``partition_rules=`` (default: the
+    ``"llama_serving"`` family table) exactly like ``Trainer`` does for
+    training; a ``dp`` mesh axis runs one independent replica per dp
+    slice behind this one front queue, routed least-loaded by
+    :class:`~.lanes.ReplicaDispatcher`.  ``config.kv_mode`` selects the
+    paged block-pool storage with disaggregated prefill/decode lanes
+    (default) or the legacy r8 slot ledger + single-loop scheduler
+    (``"slots"``, A/B baseline; single replica only).
+    """
+
+    def __init__(self, net, config=None, mesh=None, partition_rules=None):
         super().__init__(config)
         from .generative import GenerativeScheduler, LlamaServingEngine
+        from .lanes import Replica, ReplicaDispatcher
 
-        self.engine = LlamaServingEngine(
-            net, max_len=self.config.policy.max_length,
-            num_slots=self.config.num_slots, int8=self.config.int8)
-        self._sched = GenerativeScheduler(
-            self.engine, self.queue, policy=self.config.policy,
-            summary_every=self.config.summary_every)
+        cfg = self.config
+        self.mesh = mesh
+        self._replicas = None
+        self._dispatcher = None
+        if cfg.kv_mode == "slots":
+            if mesh is not None and "dp" in mesh.axis_names:
+                raise MXNetError(
+                    "kv_mode='slots' runs the single-loop scheduler; "
+                    "dp replicas need kv_mode='paged'")
+            self.engine = LlamaServingEngine(
+                net, max_len=cfg.policy.max_length,
+                num_slots=cfg.num_slots, int8=cfg.int8,
+                kv_mode="slots", mesh=mesh,
+                partition_rules=partition_rules)
+            self._sched = GenerativeScheduler(
+                self.engine, self.queue, policy=cfg.policy,
+                summary_every=cfg.summary_every)
+            return
+        self._replicas = [
+            Replica(net, cfg.policy, index=i, mesh=sub,
+                    partition_rules=partition_rules,
+                    num_slots=cfg.num_slots, int8=cfg.int8,
+                    block_size=cfg.block_size, num_blocks=cfg.num_blocks,
+                    queue_capacity=cfg.queue_capacity,
+                    summary_every=cfg.summary_every)
+            for i, sub in enumerate(_split_mesh(mesh))]
+        self._dispatcher = ReplicaDispatcher(self.queue, self._replicas)
+        self.engine = self._replicas[0].engine
+        self._sched = None
+
+    @property
+    def replicas(self):
+        return self._replicas or []
+
+    # -- lifecycle ------------------------------------------------------------
+    def start(self):
+        if self._replicas is None:
+            return super().start()
+        for rep in self._replicas:
+            rep.start()
+        self._dispatcher.start()
+        self._running = True
+        return self
+
+    def stop(self, drain=True):
+        if not self._running:
+            return
+        self._running = False
+        if self._replicas is None:
+            self._sched.stop(drain=drain)
+            return
+        # flush the front queue into the replicas first, then drain
+        # each replica (prefill lane before decode lane)
+        self._dispatcher.stop(drain=drain)
+        for rep in self._replicas:
+            rep.stop(drain=drain)
 
     # -- client surface -------------------------------------------------------
     def submit(self, prompt_ids, max_new_tokens=None):
@@ -221,15 +318,41 @@ class GenerativeServer(_ServerBase):
         return self.submit(prompt_ids, max_new_tokens).result(timeout)
 
     def stats(self):
+        if self._replicas is None:
+            out = {
+                "completed": self._sched.completed,
+                "failed": self._sched.failed,
+                "decode_steps": self.engine.steps,
+                "rejected": self.queue.rejected,
+                "pending": len(self.queue),
+                "kv_cache": self._sched.mgr.stats(),
+                "compiled_signatures": self.engine.compiled_signatures(),
+            }
+            telemetry.gauge("serving.kv_occupancy",
+                            out["kv_cache"]["occupancy"])
+            return out
+        reps = self._replicas
         out = {
-            "completed": self._sched.completed,
-            "failed": self._sched.failed,
-            "decode_steps": self.engine.steps,
+            "completed": sum(r.completed for r in reps),
+            "failed": sum(r.failed for r in reps),
+            "decode_steps": sum(r.engine.steps for r in reps),
             "rejected": self.queue.rejected,
-            "pending": len(self.queue),
-            "kv_cache": self._sched.mgr.stats(),
-            "compiled_signatures": self.engine.compiled_signatures(),
+            "pending": len(self.queue) + sum(len(r.queue) for r in reps),
+            "kv_cache": reps[0].mgr.stats(),
+            "compiled_signatures":
+                reps[0].engine.compiled_signatures(),
+            "num_replicas": len(reps),
         }
+        if len(reps) > 1:
+            out["replicas"] = [{
+                "completed": r.completed,
+                "failed": r.failed,
+                "decode_steps": r.engine.steps,
+                "kv_cache": r.mgr.stats(),
+                "compiled_signatures": r.engine.compiled_signatures(),
+            } for r in reps]
         telemetry.gauge("serving.kv_occupancy",
-                        self._sched.mgr.stats()["occupancy"])
+                        sum(r.mgr.stats()["occupancy"] for r in reps))
+        telemetry.gauge("serving.kv_blocks_in_use",
+                        sum(r.mgr.allocator.blocks_in_use for r in reps))
         return out

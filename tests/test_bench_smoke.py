@@ -128,16 +128,19 @@ def test_dryrun_multichip_inprocess_smoke(monkeypatch, capfd):
 
 def test_serving_latency_bench_emits_artifact(tmp_path):
     """benchmark/serving_latency.py at toy load must produce the
-    SERVING_LATENCY artifact with both lanes, percentile blocks, and a
-    passing signature-ceiling acceptance — a silent break loses the
-    round-8 serving numbers."""
+    SERVING_LATENCY artifact with the predictor lanes, the generative
+    r8-vs-paged rate sweep, percentile blocks, and a passing
+    signature-ceiling acceptance — a silent break loses the round-11
+    serving numbers."""
     out = tmp_path / "serving_latency.json"
     env = dict(os.environ)
     env.update(JAX_PLATFORMS="cpu", BENCH_SERVING_REQUESTS="8",
                BENCH_SERVING_CLIENTS="2", BENCH_SERVING_RATE="500",
                BENCH_SERVING_MAX_BATCH="4", BENCH_SERVING_MAX_LEN="16",
+               BENCH_SERVING_GEN_REQUESTS="6", BENCH_SERVING_GEN_RATE="50",
+               BENCH_SERVING_GEN_RATES="50", BENCH_SERVING_GEN_MAX_NEW="4",
                MXT_SERVING_LATENCY_OUT=str(out))
-    env.pop("XLA_FLAGS", None)
+    env.pop("XLA_FLAGS", None)   # the bench forces its own 8-device flag
     r = subprocess.run(
         [sys.executable, os.path.join(REPO, "benchmark",
                                       "serving_latency.py")],
@@ -156,6 +159,23 @@ def test_serving_latency_bench_emits_artifact(tmp_path):
         assert 1 <= ln["cache"]["signatures"] <= \
             rec["bucket_config"]["signature_ceiling"]
     assert rec["acceptance"]["signatures_within_ceiling"]
+    # generative sweep: both engines ran every rung, completed all
+    # requests, and report the saturation verdicts
+    gen = rec["generative"]["engines"]
+    assert gen["slots_r8"]["replicas"] == 1
+    assert gen["paged"]["replicas"] == 2     # dp2 on the virtual mesh
+    for eng in ("slots_r8", "paged"):
+        for s in gen[eng]["rates"].values():
+            assert s["completed"] == 6 and s["rejected"] == 0
+            assert s["total_ms"]["p50"] <= s["total_ms"]["p99"]
+            assert s["ttft_ms"]["p99"] is not None
+            assert s["tokens_per_s_per_chip"] > 0
+            assert isinstance(s["sustained"], bool)
+        assert gen[eng]["kv_cache"]["occupancy"] == 0
+    assert gen["paged"]["decode_steps"] > 0
+    for key in ("gen_queue_wait_p99_reduced_vs_r8",
+                "gen_max_sustainable_rate_higher"):
+        assert key in rec["acceptance"]
 
 
 def test_sharded_step_bench_emits_artifact(tmp_path):

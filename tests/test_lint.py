@@ -88,6 +88,19 @@ def test_t1_serving_materialize_def_is_exempt():
                and v.context == "_hot_materialize" for v in vs)
 
 
+def test_t1_lane_materialize_def_is_exempt():
+    """The lanes' sync point (serving/lanes.py ``_lane_materialize``)
+    gets the same scoped exemption as the scheduler's ``_materialize``
+    — and only the eager half of it."""
+    vs = _rule(_analyze("t1_serving_lanes.py"), "T1")
+    assert not any(v.context == "_lane_materialize" for v in vs)
+    assert not any(v.context == "decode_drain" for v in vs)
+    assert any(v.severity == "warning" and v.context == "leaky_lane_sync"
+               and "asnumpy" in v.message for v in vs)
+    assert any(v.severity == "error"
+               and v.context == "_hot_lane_materialize" for v in vs)
+
+
 def test_t2_flags_control_flow_on_traced_values():
     vs = _rule(_analyze("t2_control_flow.py"), "T2")
     kinds = {(v.context, v.message.split("`")[1]) for v in vs}

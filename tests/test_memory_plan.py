@@ -349,3 +349,29 @@ def test_mixtral_plan_tool_emits_artifact(tmp_path):
     assert rec["recommendation"]["confirmed_by"] == \
         "MIXTRAL_LOWER_TPU_r05.json"
     assert all(rec["acceptance"].values())
+
+
+def test_plan_kv_pool_math_and_tp_division():
+    """The serving KV pool planner: 2 (K and V) × layers × block-pool
+    bytes, divided by tp when the ``llama_serving`` rules shard the
+    pool's head axis — and it predicts the live engine's figure."""
+    import jax
+    from jax.sharding import Mesh
+
+    # llama_tiny geometry: 2 layers, 2 kv heads, head_dim 16
+    b = planner.plan_kv_pool(2, 2, 16, num_blocks=8, block_size=16)
+    assert b == 2 * 2 * (8 * 2 * 16 * 16 * 4)      # 65536, replicated
+    if len(jax.devices()) >= 2:
+        tp2 = Mesh(np.array(jax.devices()[:2]), ("tp",))
+        assert planner.plan_kv_pool(2, 2, 16, num_blocks=8,
+                                    block_size=16, mesh=tp2) == b // 2
+    # plan_model folds it into the breakdown and the peak
+    from mxnet_tpu.models.llama import llama_tiny
+
+    net = llama_tiny()
+    net.initialize()
+    base = memory.plan_model(net, training=False)
+    plan = memory.plan_model(net, training=False, kv_pool_bytes=b)
+    assert plan.breakdown["kv_pool"] == b
+    assert plan.predicted_peak_bytes == base.predicted_peak_bytes + b
+    assert any(t["name"] == "<kv_pool>" for t in plan.top_buffers)

@@ -262,7 +262,9 @@ def test_multi_client_continuous_batching_end_to_end(tmp_path):
 def test_generative_late_join_and_parity():
     """A late request joins the in-flight decode batch (continuous
     batching) and both results match the offline generate() oracle
-    token for token."""
+    token for token.  Runs the legacy slot-ledger A/B path
+    (``kv_mode="slots"``): its single scheduler loop interleaves
+    prefill with decode, so the done_step ordering below is exact."""
     from mxnet_tpu.models.llama import llama_tiny
 
     net = llama_tiny()
@@ -274,7 +276,7 @@ def test_generative_late_join_and_parity():
     p1 = rs.randint(1, 250, size=5)
     p2 = rs.randint(1, 250, size=9)
     cfg = ServerConfig(max_batch=2, max_length=64, min_length=8,
-                       num_slots=2, summary_every=2)
+                       num_slots=2, summary_every=2, kv_mode="slots")
     srv = serving.GenerativeServer(net, cfg)
     try:
         with srv:
@@ -318,6 +320,84 @@ def test_generative_late_join_and_parity():
     assert sums and sums[-1]["ttft_ms"] is not None
 
 
+def test_generative_paged_lanes_late_join_and_parity():
+    """The default (paged KV + disaggregated lanes) path: a late
+    request is prefilled by the prefill lane and handed off to the
+    decode lane WITHOUT stalling the in-flight decode, both results
+    are token-exact vs offline generate(), and the request records
+    carry the lane fields (replica / kv_blocks / handoff_ms)."""
+    from mxnet_tpu.models.llama import llama_tiny
+
+    net = llama_tiny()
+    net.initialize()
+    telemetry.enable(memory=False, cost=False)
+    sink = ListSink()
+    telemetry.add_sink(sink)
+    rs = np.random.RandomState(0)
+    p1 = rs.randint(1, 250, size=5)
+    p2 = rs.randint(1, 250, size=9)
+    cfg = ServerConfig(max_batch=2, max_length=64, min_length=8,
+                       num_slots=2, summary_every=2)
+    srv = serving.GenerativeServer(net, cfg)
+    try:
+        with srv:
+            # warm both prefill buckets so the late join below isn't
+            # skewed by first-compile time (decode does NOT stall for
+            # prefill in the lanes path — that's the point of it)
+            srv.generate(p1, max_new_tokens=2)
+            srv.generate(p2, max_new_tokens=2)
+            base = srv.engine.steps
+            f1 = srv.submit(p1, max_new_tokens=40)
+            deadline = time.time() + 60
+            while srv.engine.steps < base + 2 and time.time() < deadline:
+                time.sleep(0.01)
+            assert srv.engine.steps >= base + 2
+            f2 = srv.submit(p2, max_new_tokens=4)
+            r1 = f1.result(120)
+            r2 = f2.result(120)
+        stats = srv.stats()
+    finally:
+        telemetry.disable()
+
+    o1 = net.generate(nd.array(p1[None]), 40).asnumpy()[0]
+    o2 = net.generate(nd.array(p2[None]), 4).asnumpy()[0]
+    assert np.array_equal(r1, o1)
+    assert np.array_equal(r2, o2)
+
+    recs = [r for r in sink.records if r.get("record") == "serving.request"]
+    assert len(recs) == 4
+    r1rec, r2rec = recs[-2], recs[-1]
+    if r1rec["request_id"] > r2rec["request_id"]:
+        r1rec, r2rec = r2rec, r1rec
+    # the late request joined mid-flight and (its prefill being warm)
+    # finished its 4 tokens long before the 40-token request
+    assert r2rec["joined_step"] >= base + 2
+    assert r2rec["done_step"] < r1rec["done_step"]
+    assert r1rec["ttft_ms"] > 0 and r2rec["ttft_ms"] > 0
+    # lane fields: served by replica 0, KV block budget reserved up
+    # front (5+40 tokens -> 3 blocks of 16), handoff measured
+    for rec in (r1rec, r2rec):
+        assert rec["replica"] == 0
+        assert rec["lane"] == "decode"
+        assert rec["handoff_ms"] >= 0
+    assert r1rec["kv_blocks"] == 3
+    assert r2rec["kv_blocks"] == 1
+    # slots shared concurrently; pool fully returned at drain
+    assert stats["kv_cache"]["peak_occupancy"] == 2
+    assert stats["kv_cache"]["occupancy"] == 0
+    assert stats["kv_cache"]["blocks_in_use"] == 0
+    assert stats["kv_cache"]["peak_blocks_in_use"] >= 4
+    # ONE decode-step signature for the server lifetime, prefill per
+    # prompt bucket
+    sigs = stats["compiled_signatures"]
+    assert sigs.count(("step",)) == 1
+    assert len([s for s in sigs if s[0] == "prefill"]) <= 2
+    # rolling summary carries the handoff percentiles
+    sums = [r for r in sink.records if r.get("record") == "serving.latency"]
+    assert sums and sums[-1]["handoff_ms"] is not None
+    assert sums[-1]["kv_cache"]["block_size"] == 16
+
+
 def test_generative_int8_load_option():
     """int8 weight quantization at load time: the engine decodes and
     honors shapes (no parity claim vs fp32)."""
@@ -339,3 +419,178 @@ def test_generative_int8_load_option():
     assert out.shape == (len(prompt) + 5,)
     assert np.array_equal(out[:len(prompt)], prompt)
     assert (out < net.config.vocab_size).all()
+
+
+# --- paged KV: block allocator + manager invariants --------------------------
+
+def test_block_allocator_invariants():
+    """All-or-nothing allocation, no double-assignment, double-free
+    raises, and a full alloc/free round-trip restores the pool."""
+    from mxnet_tpu.serving import BlockAllocator
+
+    a = BlockAllocator(num_blocks=6, block_size=16)
+    assert a.free_blocks == 6 and a.blocks_in_use == 0
+    b1 = a.alloc(4)
+    b2 = a.alloc(2)
+    assert len(b1) == 4 and len(b2) == 2
+    # no block handed out twice
+    assert len(set(b1) | set(b2)) == 6
+    assert a.free_blocks == 0 and a.blocks_in_use == 6
+    # all-or-nothing: an empty pool refuses, state unchanged
+    assert a.alloc(1) is None
+    assert a.free_blocks == 0
+    a.free(b2)
+    assert a.free_blocks == 2 and a.peak_blocks_in_use == 6
+    with pytest.raises(mx.MXNetError):
+        a.free(b2)                         # double-free
+    a.free(b1)
+    assert a.free_blocks == 6 and a.blocks_in_use == 0
+    a.check()
+    # round-trip: the pool serves the full count again
+    assert len(a.alloc(6)) == 6
+
+
+def test_paged_manager_admit_advance_evict():
+    """Upfront block reservation sized by prompt+budget; advancing past
+    the reservation raises; eviction returns every block."""
+    from mxnet_tpu.serving import PagedKVCacheManager
+
+    mgr = PagedKVCacheManager(num_slots=2, max_len=64, num_blocks=8,
+                              block_size=16)
+    assert mgr.blocks_for(9, 4) == 1       # 13 tokens -> 1 block
+    assert mgr.blocks_for(9, 8) == 2       # 17 tokens -> 2 blocks
+    slot, blocks = mgr.admit("r1", 17, 15)  # 32 tokens -> 2 blocks
+    assert len(blocks) == 2
+    assert mgr.allocator.blocks_in_use == 2
+    for _ in range(15):
+        mgr.advance(slot)
+    with pytest.raises(mx.MXNetError):
+        mgr.advance(slot)                  # past the 32-token reserve
+    mgr.evict(slot)
+    assert mgr.allocator.blocks_in_use == 0
+    mgr.check()
+    st = mgr.stats()
+    assert st["capacity_tokens"] == 8 * 16
+    assert st["peak_tokens"] >= 17
+    assert st["tokens_in_flight"] == 0
+
+
+def test_legacy_ledger_stats_fields():
+    """The r8 slot ledger stays importable for A/B and now reports the
+    same occupancy vocabulary as the paged manager: capacity in tokens,
+    tokens in flight, peak tokens, fragmentation."""
+    mgr = KVCacheManager(num_slots=2, max_len=32)
+    s0 = mgr.stats()
+    assert s0["capacity_tokens"] == 64
+    assert s0["tokens_in_flight"] == 0 and s0["fragmentation"] == 0.0
+    slot = mgr.admit("r1", prompt_len=10, max_new_tokens=4)
+    st = mgr.stats()
+    # the ledger reserves max_len per occupied slot: 10 live tokens out
+    # of a 32-token reservation is mostly fragmentation
+    assert st["tokens_in_flight"] == 10
+    assert st["peak_tokens"] == 10
+    assert st["fragmentation"] == pytest.approx(1 - 10 / 32, abs=1e-4)
+    mgr.evict(slot)
+    assert mgr.stats()["tokens_in_flight"] == 0
+
+
+def test_paged_capacity_beats_ledger():
+    """The acceptance mix: a pool whose worst-case ``slots × max_len``
+    exceeds its token capacity still admits (and correctly serves) all
+    four short requests — the equal-byte ledger holds two."""
+    from mxnet_tpu.models.llama import llama_tiny
+    from mxnet_tpu.serving import PagedKVCacheManager
+
+    # manager level: 8 blocks × 16 = 128 tokens backs FOUR slots whose
+    # worst case is 4 × 64 = 256; the 128-token ledger holds TWO slots
+    mgr = PagedKVCacheManager(num_slots=4, max_len=64, num_blocks=8,
+                              block_size=16)
+    admits = [mgr.admit(i, 9, 4) for i in range(4)]   # 13 tokens each
+    assert all(a is not None for a in admits)
+    assert mgr.stats()["occupancy"] == 4
+    ledger = KVCacheManager(num_slots=2, max_len=64)  # same 128 tokens
+    assert ledger.admit("a", 9, 4) is not None
+    assert ledger.admit("b", 9, 4) is not None
+    assert ledger.admit("c", 9, 4) is None            # full
+    for slot, _ in admits:
+        mgr.evict(slot)
+    assert mgr.allocator.free_blocks == 8
+    mgr.check()
+
+    # server level: the undersized pool serves the same mix token-exact
+    # vs the r8 slots path
+    net = llama_tiny()
+    net.initialize()
+    rs = np.random.RandomState(3)
+    prompts = [rs.randint(1, 250, size=9) for _ in range(4)]
+    oracle_cfg = ServerConfig(max_batch=4, max_length=64, min_length=8,
+                              num_slots=4, kv_mode="slots")
+    with serving.GenerativeServer(net, oracle_cfg) as oracle:
+        want = [oracle.generate(p, max_new_tokens=4) for p in prompts]
+    cfg = ServerConfig(max_batch=4, max_length=64, min_length=8,
+                       num_slots=4, num_blocks=8, block_size=16)
+    srv = serving.GenerativeServer(net, cfg)
+    assert srv.engine.num_blocks == 8
+    with srv:
+        futs = [srv.submit(p, max_new_tokens=4) for p in prompts]
+        got = [f.result(120) for f in futs]
+        stats = srv.stats()
+    for g, w in zip(got, want):
+        assert np.array_equal(g, w)
+    kv = stats["kv_cache"]
+    assert kv["capacity_tokens"] == 128        # < 4 slots × 64 worst case
+    assert kv["admits"] == 4
+    assert kv["peak_occupancy"] >= 3           # served concurrently
+    assert kv["blocks_in_use"] == 0 and kv["occupancy"] == 0
+
+
+def test_generative_server_mesh_dp2_tp2_token_exact():
+    """dp2×tp2 CPU mesh: weights tensor-parallel per replica, two
+    independent replicas behind one queue.  Token-exact vs the
+    single-device r8 slots path, ONE decode compile per replica, both
+    replicas take work, and the engine's pool bytes match the memory
+    planner's ``plan_kv_pool`` on the tp submesh."""
+    import jax
+    from jax.sharding import Mesh
+    from mxnet_tpu.memory import plan_kv_pool
+    from mxnet_tpu.models.llama import llama_tiny
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 devices (dp2×tp2)")
+    net = llama_tiny()
+    net.initialize()
+    rs = np.random.RandomState(5)
+    prompts = [rs.randint(1, 250, size=n) for n in (5, 9, 12, 7)]
+    oracle_cfg = ServerConfig(max_batch=2, max_length=64, min_length=8,
+                              num_slots=2, kv_mode="slots")
+    with serving.GenerativeServer(net, oracle_cfg) as oracle:
+        want = [oracle.generate(p, max_new_tokens=6) for p in prompts]
+
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2), ("dp", "tp"))
+    cfg = ServerConfig(max_batch=2, max_length=64, min_length=8,
+                       num_slots=2, summary_every=4)
+    srv = serving.GenerativeServer(net, cfg, mesh=mesh)
+    with srv:
+        futs = [srv.submit(p, max_new_tokens=6) for p in prompts]
+        got = [f.result(120) for f in futs]
+        stats = srv.stats()
+    for g, w in zip(got, want):
+        assert np.array_equal(g, w)
+
+    assert stats["num_replicas"] == 2
+    # least-loaded routing spread the burst over both replicas
+    per_rep = stats["replicas"]
+    assert len(per_rep) == 2
+    assert all(r["completed"] >= 1 for r in per_rep)
+    assert sum(r["completed"] for r in per_rep) == 4
+    # one decode compile per replica for the whole lifetime
+    for rep in srv.replicas:
+        sigs = rep.engine.compiled_signatures()
+        assert sigs.count(("step",)) == 1
+    # pool placement agrees with the planner on the tp submesh
+    tp_mesh = Mesh(np.array(jax.devices()[:2]), ("tp",))
+    eng = srv.replicas[0].engine
+    assert eng.kv_pool_bytes() == plan_kv_pool(
+        net.config.num_layers, net.config.num_kv_heads,
+        net.config.head_dim, num_blocks=eng.num_blocks,
+        block_size=eng.block_size, mesh=tp_mesh)

@@ -1,0 +1,36 @@
+"""T1 fixture: the disaggregated lanes' materialization def.
+
+serving/lanes.py syncs at ``_lane_materialize`` — the prefill→decode
+handoff (first tokens) and the decode tick drain — mirroring the
+scheduler's ``_materialize``.  Both names are in ``MATERIALIZE_DEFS``:
+eager syncs inside them are sanctioned, syncs anywhere else in the
+lanes still warn, and a traced sync is an error no matter the name.
+"""
+import jax
+
+
+def _lane_materialize(arrays):
+    out = []
+    for a in arrays:
+        out.append(a.asnumpy())       # fine: the lanes' designated sync
+    return out
+
+
+def decode_drain(engine, seqs):
+    toks = _lane_materialize([engine.last_tokens])  # fine: helper call
+    for slot, (req, tokens) in seqs.items():
+        tokens.append(int(toks[0][slot]))
+
+
+def leaky_lane_sync(toks):
+    return toks.asnumpy()             # T1 warning: sync outside the
+                                      # designated lane materialize def
+
+
+def _hot_lane_materialize(pool):
+    # the exemption is eager-only: a traced sync is an error even
+    # inside a def named like the sanctioned one
+    return pool.asnumpy()             # T1 error: traced sync
+
+
+hot_lane_jit = jax.jit(_hot_lane_materialize)

@@ -83,8 +83,10 @@ SYNC_METHODS_ANYWHERE = {"asnumpy", "asscalar", "item",
 #: PR 7 ``ticket.result()`` treatment (intentional eager waits stay
 #: legal) but scoped by enclosing-def name instead of method name.
 #: Inside a TRACED region the error still fires: naming a hot function
-#: ``_materialize`` buys nothing.
-MATERIALIZE_DEFS = {"_materialize"}
+#: ``_materialize`` buys nothing.  ``_lane_materialize`` is the
+#: disaggregated serving lanes' twin (serving/lanes.py): the decode
+#: drain and the prefill→decode handoff sync there, and nowhere else.
+MATERIALIZE_DEFS = {"_materialize", "_lane_materialize"}
 
 #: function-style syncs, matched on dotted name
 SYNC_FUNCS_ANYWHERE = {"jax.device_get"}
