@@ -33,8 +33,16 @@ tokens/sec-per-chip; the acceptance block checks queue-wait p99 is
 reduced at the r8 offered rate and the max sustainable rate is higher
 for the paged multi-replica server.
 
+Round 12 (observability) extends the sweep with TPOT percentiles and
+per-rate goodput against TTFT/TPOT SLO targets
+(``BENCH_SERVING_SLO_TTFT_MS`` / ``BENCH_SERVING_SLO_TPOT_MS``;
+goodput counts rejected requests as misses), and adds the
+**tracing_ab** lane: the same decode workload with request tracing off
+vs on (min-of-repeats per arm), proving the per-decode-step overhead
+of span recording stays under 3%.
+
 Run: ``JAX_PLATFORMS=cpu python benchmark/serving_latency.py``
-Artifact: SERVING_LATENCY_r11.json (override MXT_SERVING_LATENCY_OUT).
+Artifact: SERVING_LATENCY_r12.json (override MXT_SERVING_LATENCY_OUT).
 """
 from __future__ import annotations
 
@@ -78,6 +86,16 @@ GEN_SAT_QW_MS = float(os.environ.get("BENCH_SERVING_GEN_SAT_QW_MS", 50.0))
 GEN_MAX_LEN = 64
 GEN_SLOTS = 4
 
+# r12 observability knobs: SLO targets for the goodput-vs-rate columns
+# (CPU-scale defaults — generous on purpose, the interesting signal is
+# goodput FALLING as the rate ladder saturates, not absolute values)
+# and the tracing A/B lane's shape
+SLO_TTFT_MS = float(os.environ.get("BENCH_SERVING_SLO_TTFT_MS", 500.0))
+SLO_TPOT_MS = float(os.environ.get("BENCH_SERVING_SLO_TPOT_MS", 100.0))
+AB_REQUESTS = int(os.environ.get("BENCH_SERVING_AB_REQUESTS", 8))
+AB_MAX_NEW = int(os.environ.get("BENCH_SERVING_AB_MAX_NEW", 32))
+AB_REPEATS = int(os.environ.get("BENCH_SERVING_AB_REPEATS", 3))
+
 
 def _build_predictor(workdir):
     """Position-wise nnvm chain (FullyConnected flatten=False): padded
@@ -116,6 +134,9 @@ def _percentiles(values, ps=(50, 90, 99)):
 
 
 def _lane_summary(recs, wall_s, rejected):
+    # r12: the stream now carries rejected/errored records too (tagged
+    # status != "ok", total_ms None) — latency math only sees completions
+    recs = [r for r in recs if r.get("status", "ok") == "ok"]
     total = [r["total_ms"] for r in recs]
     waits = [r["queue_wait_ms"] for r in recs]
     sizes = {}
@@ -320,16 +341,31 @@ def _run_gen_engine(net, engine, rates):
                 wall, rejected, gen_tok = _gen_rate_pass(
                     srv, prompts, rate, rng)
                 recs = [r for r in sink.records
-                        if r.get("record") == "serving.request"]
+                        if r.get("record") == "serving.request"
+                        and r.get("status", "ok") == "ok"]
                 ttft = [r["ttft_ms"] for r in recs
                         if r.get("ttft_ms") is not None]
+                tpot = [r["tpot_ms"] for r in recs
+                        if r.get("tpot_ms") is not None]
                 summary = _lane_summary(recs, wall, rejected)
                 del summary["buckets_seen"]
                 summary.pop("batches", None)
                 qw99 = summary["queue_wait_ms"]["p99"]
+                # goodput vs SLO: requests meeting BOTH latency targets
+                # over everything offered (rejections are misses)
+                met = sum(1 for r in recs
+                          if r.get("ttft_ms") is not None
+                          and r["ttft_ms"] <= SLO_TTFT_MS
+                          and (r.get("tpot_ms") is None
+                               or r["tpot_ms"] <= SLO_TPOT_MS))
                 summary.update({
                     "offered_rate_req_per_s": rate,
                     "ttft_ms": _percentiles(ttft),
+                    "tpot_ms": _percentiles(tpot),
+                    "slo": {"ttft_ms": SLO_TTFT_MS,
+                            "tpot_ms": SLO_TPOT_MS},
+                    "slo_met": met,
+                    "goodput_vs_slo": round(met / len(prompts), 4),
                     "tokens_per_s": round(gen_tok / wall, 2),
                     "tokens_per_s_per_chip": round(gen_tok / wall / chips,
                                                    2),
@@ -356,8 +392,83 @@ def _gen_sweep():
     net = llama_tiny()
     net.initialize()
     rates = sorted(set(GEN_RATES) | {GEN_RATE})
-    return {eng: _run_gen_engine(net, eng, rates)
-            for eng in ("slots_r8", "paged")}
+    engines = {eng: _run_gen_engine(net, eng, rates)
+               for eng in ("slots_r8", "paged")}
+    return engines, _tracing_ab(net)
+
+
+# --- tracing on/off A/B: span recording must not tax the decode step --------
+
+def _ab_arm(srv, prompts, traced):
+    """One measured pass: submit the batch, wait, return (decode wall
+    seconds, decode steps taken) — per-step time is the ratio, so queue
+    scheduling noise outside the decode loop cancels."""
+    from mxnet_tpu.telemetry import tracing
+
+    (tracing.enable if traced else tracing.disable)()
+    try:
+        steps0 = sum(rep.engine.steps for rep in srv.replicas) \
+            if srv.replicas else srv.engine.steps
+        t0 = time.perf_counter()
+        futs = [srv.submit(p, max_new_tokens=AB_MAX_NEW) for p in prompts]
+        for f in futs:
+            f.result(timeout=300.0)
+        wall = time.perf_counter() - t0
+        steps1 = sum(rep.engine.steps for rep in srv.replicas) \
+            if srv.replicas else srv.engine.steps
+    finally:
+        tracing.disable()
+        tracing.clear()
+    return wall, steps1 - steps0
+
+
+def _tracing_ab(net):
+    """Decode-step overhead of request tracing: the same single-replica
+    paged workload with tracing off vs on, ``AB_REPEATS`` alternating
+    passes per arm, min-of-repeats per arm (the min is the noise-free
+    estimate on a shared machine).  Telemetry proper stays ON in both
+    arms so the A/B isolates exactly the span-recording delta."""
+    from mxnet_tpu import serving, telemetry
+
+    rng = np.random.RandomState(SEED + 23)
+    prompts = _gen_workload(AB_REQUESTS, rng)
+    cfg = serving.ServerConfig(
+        max_batch=GEN_SLOTS, max_length=GEN_MAX_LEN, min_batch=1,
+        min_length=8, queue_capacity=max(64, AB_REQUESTS),
+        num_slots=GEN_SLOTS, max_new_tokens=AB_MAX_NEW,
+        kv_mode="paged", block_size=16,
+        batch_window_ms=2.0, summary_every=1 << 30)
+    telemetry.enable(memory=False, cost=False)
+    srv = serving.GenerativeServer(net, cfg)
+    arms = {"off": [], "on": []}
+    try:
+        _warm_grid(srv)
+        with srv:
+            warm = [srv.submit(np.arange(1, 9, dtype=np.int32),
+                               max_new_tokens=2) for _ in range(2)]
+            for f in warm:
+                f.result(timeout=300.0)
+            for _ in range(AB_REPEATS):
+                for arm, traced in (("off", False), ("on", True)):
+                    wall, steps = _ab_arm(srv, prompts, traced)
+                    if steps:
+                        arms[arm].append(wall * 1e3 / steps)
+    finally:
+        telemetry.disable()
+        telemetry.reset()
+    off = min(arms["off"])
+    on = min(arms["on"])
+    overhead = (on - off) / off if off else 0.0
+    return {
+        "requests": AB_REQUESTS,
+        "max_new_tokens": AB_MAX_NEW,
+        "repeats": AB_REPEATS,
+        "step_ms_off": round(off, 4),
+        "step_ms_on": round(on, 4),
+        "step_ms_off_all": [round(x, 4) for x in arms["off"]],
+        "step_ms_on_all": [round(x, 4) for x in arms["on"]],
+        "overhead_frac": round(overhead, 4),
+    }
 
 
 def main():
@@ -368,7 +479,7 @@ def main():
                  for lane in ("closed_loop", "open_loop")}
     finally:
         shutil.rmtree(workdir, ignore_errors=True)
-    gen = _gen_sweep()
+    gen, tracing_ab = _gen_sweep()
     from mxnet_tpu import serving
 
     ceiling = len(serving.BucketPolicy(
@@ -397,6 +508,7 @@ def main():
             "ab_rate_req_per_s": GEN_RATE,
             "engines": gen,
         },
+        "tracing_ab": tracing_ab,
         "acceptance": {
             "signatures_within_ceiling": sigs <= ceiling,
             "batched": any(int(k) > 1 for l in lanes.values()
@@ -409,6 +521,8 @@ def main():
                 s_paged is not None
                 and (s_slots is None or s_paged > s_slots
                      or (s_paged == s_slots == max(GEN_RATES)))),
+            "tracing_step_overhead_under_3pct":
+                tracing_ab["overhead_frac"] < 0.03,
         },
         "platform": os.environ.get("JAX_PLATFORMS", "default"),
     }
@@ -417,7 +531,7 @@ def main():
     out_path = os.environ.get(
         "MXT_SERVING_LATENCY_OUT",
         os.path.join(os.path.dirname(__file__), "..",
-                     "SERVING_LATENCY_r11.json"))
+                     "SERVING_LATENCY_r12.json"))
     with open(out_path, "w") as f:
         f.write(line + "\n")
 

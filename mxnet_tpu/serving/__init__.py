@@ -25,6 +25,15 @@ lanes with explicit KV handoff (``lanes.py``).  The r8 slot ledger
 (``KVCacheManager``) stays importable behind
 ``ServerConfig(kv_mode="slots")`` for A/B.
 
+Observability (r12, docs/observability.md): every request can carry a
+span context (``telemetry.tracing``) yielding one connected trace per
+request across the queue → prefill → handoff → decode thread hops;
+``ServerConfig(http_port=0)`` starts a live stdlib-HTTP endpoint
+(``metrics.MetricsServer``) exposing ``/metrics`` (Prometheus text),
+``/healthz`` (lane liveness + KV occupancy) and ``/requests``; and
+``ServerConfig(slo={...})`` turns on per-tenant TTFT/TPOT goodput
+accounting (``metrics.SLOTracker``).
+
 Quick start::
 
     from mxnet_tpu import serving
@@ -46,10 +55,13 @@ from .lanes import (DecodeLane, PrefillLane, Replica,  # noqa: F401
                     ReplicaDispatcher)
 from .server import (GenerativeServer, InferenceServer,  # noqa: F401
                      ServerConfig)
+from .metrics import (MetricsServer, SLOTracker,       # noqa: F401
+                      prometheus_text)
 
 __all__ = ["Request", "ServerOverloadedError", "ServerClosedError",
            "BucketPolicy", "pow2_bucket", "pad_batch", "KVCacheManager",
            "PagedKVCacheManager", "BlockAllocator",
            "RequestQueue", "BatchScheduler", "ServerConfig",
            "InferenceServer", "GenerativeServer",
-           "PrefillLane", "DecodeLane", "Replica", "ReplicaDispatcher"]
+           "PrefillLane", "DecodeLane", "Replica", "ReplicaDispatcher",
+           "MetricsServer", "SLOTracker", "prometheus_text"]

@@ -56,6 +56,7 @@ import time
 import numpy as np
 
 from .. import telemetry
+from ..telemetry import tracing
 from ..base import MXNetError
 from .bucketing import BucketPolicy, pad_batch
 from .kv_cache import KVCacheManager
@@ -464,13 +465,14 @@ class GenerativeScheduler:
     """
 
     def __init__(self, engine, queue, policy=None, summary_every=16,
-                 poll_s=0.02):
+                 poll_s=0.02, slo=None):
         if engine.kv_mode != "slots":
             raise MXNetError(
                 "GenerativeScheduler drives the slot-ledger engine; "
                 "paged engines are driven by serving.lanes")
         self.engine = engine
         self.queue = queue
+        self.slo = slo   # shared SLOTracker (metrics.py) or None
         self.policy = policy or BucketPolicy(
             max_batch=engine.num_slots, max_length=engine.max_len,
             min_batch=1, min_length=8)
@@ -545,6 +547,7 @@ class GenerativeScheduler:
                                       step=self.engine.steps)
                 slots[i] = slot
                 r.slot = int(slot)
+                r.replica = self.engine.replica_id
                 r.joined_step = self.engine.steps
                 r.t_start = t_start
                 r.bucket = (kb, lb)
@@ -554,13 +557,25 @@ class GenerativeScheduler:
             for r in group:
                 if r.slot is not None and r.slot in self.mgr._active:
                     self.mgr.evict(r.slot)
+                r.replica = self.engine.replica_id
                 r.future.set_exception(exc)
-            self.failed += len(group)
-            telemetry.count("serving.failed", len(group))
+                self._fail(r, exc, lane="prefill")
+            tracing.incident("replica_exception",
+                             context={"replica": self.engine.replica_id,
+                                      "lane": "prefill",
+                                      "error": repr(exc)})
             return False
         t_first = time.perf_counter()
+        mates = [r.id for r in group]
         for i, r in enumerate(group):
             r.t_first = t_first
+            if r.trace is not None:
+                r.trace.add("queue", r.t_submit, t_start,
+                            replica=r.replica)
+                r.trace.add("prefill", t_start, t_first,
+                            replica=r.replica, slot=r.slot,
+                            bucket=list(r.bucket),
+                            mates=[m for m in mates if m != r.id])
             self._seqs[r.slot] = (r, [int(first[i])])
             if self.mgr.consume(r.slot):
                 self._finish(r.slot)
@@ -569,6 +584,7 @@ class GenerativeScheduler:
 
     def _decode_step(self):
         active = self.mgr.active_slots()
+        t0 = time.perf_counter()
         try:
             toks = self.engine.step(active)
         except Exception as exc:
@@ -577,15 +593,24 @@ class GenerativeScheduler:
                 self.mgr.evict(slot)
                 self.engine.clear_slot(slot)
                 req.future.set_exception(exc)
-            self.failed += len(active)
-            telemetry.count("serving.failed", len(active))
+                self._fail(req, exc, lane="decode")
+            tracing.incident("replica_exception",
+                             context={"replica": self.engine.replica_id,
+                                      "lane": "decode",
+                                      "error": repr(exc)})
             return
+        t1 = time.perf_counter()
         self.batches += 1
         telemetry.hist("serving.batch_size", len(active))
+        step_idx = self.engine.steps
         for slot in active:
             self.mgr.advance(slot)   # the step wrote K/V at slot's pos
-            _, tokens = self._seqs[slot]
+            req, tokens = self._seqs[slot]
             tokens.append(int(toks[slot]))
+            if req.trace is not None:
+                req.trace.add("decode.step", t0, t1, step=step_idx,
+                              batch=len(active), replica=req.replica,
+                              slot=slot)
             if self.mgr.consume(slot):
                 self._finish(slot)
 
@@ -604,16 +629,47 @@ class GenerativeScheduler:
     def _account(self, req):
         self.completed += 1
         telemetry.count("serving.completed")
-        rec = req.record()
+        telemetry.count(f"serving.completed|replica={req.replica}")
+        rec = req.record(lane="decode")
+        tag = f"|replica={req.replica}"
         if rec["queue_wait_ms"] is not None:
             telemetry.hist("serving.queue_wait_ms", rec["queue_wait_ms"])
+            telemetry.hist("serving.queue_wait_ms" + tag,
+                           rec["queue_wait_ms"])
         if rec["total_ms"] is not None:
             telemetry.hist("serving.total_ms", rec["total_ms"])
+            telemetry.hist("serving.total_ms" + tag, rec["total_ms"])
         if rec.get("ttft_ms") is not None:
             telemetry.hist("serving.ttft_ms", rec["ttft_ms"])
+            telemetry.hist("serving.ttft_ms" + tag, rec["ttft_ms"])
+        if rec.get("tpot_ms") is not None:
+            telemetry.hist("serving.tpot_ms", rec["tpot_ms"])
+            telemetry.hist("serving.tpot_ms" + tag, rec["tpot_ms"])
+        if self.slo is not None:
+            rec["slo_met"] = self.slo.observe(
+                tenant=req.tenant, ttft_ms=rec.get("ttft_ms"),
+                tpot_ms=rec.get("tpot_ms"))
         telemetry.emit(rec)
+        if req.trace is not None:
+            req.trace.event("evict", replica=req.replica, slot=req.slot)
+            tracing.finish(req.trace, status="ok", replica=req.replica,
+                           lane="decode", request_id=req.id)
         if self.summary_every and self.completed % self.summary_every == 0:
             self.emit_summary()
+
+    def _fail(self, req, exc, lane):
+        """Failure-path twin of :meth:`_account`: error record with
+        replica + lane, failed counters, trace seal."""
+        self.failed += 1
+        telemetry.count("serving.failed")
+        telemetry.count(f"serving.failed|replica={req.replica}")
+        req.t_done = time.perf_counter()
+        telemetry.emit(req.record(lane=lane, status="error",
+                                  error=repr(exc)))
+        if req.trace is not None:
+            tracing.finish(req.trace, status="error",
+                           replica=req.replica, lane=lane,
+                           error=repr(exc), request_id=req.id)
 
     def emit_summary(self):
         telemetry.emit({

@@ -160,13 +160,15 @@ class KVCacheManager:
         no live token — the number the paged pool exists to shrink."""
         reserved = len(self._active) * self.max_len
         live = self.tokens_in_flight()
+        cap = self.num_slots * self.max_len
         return {"admits": self._admits, "evictions": self._evictions,
                 "occupancy": len(self._active),
                 "peak_occupancy": self._peak_occupancy,
                 "num_slots": self.num_slots,
-                "capacity_tokens": self.num_slots * self.max_len,
+                "capacity_tokens": cap,
                 "tokens_in_flight": int(live),
                 "peak_tokens": int(self._peak_tokens),
+                "utilization": round(live / cap, 4) if cap else 0.0,
                 "fragmentation": round(1.0 - live / reserved, 4)
                 if reserved else 0.0}
 
@@ -298,6 +300,7 @@ class PagedKVCacheManager:
             live = sum(st.pos for st in self._active.values())
             used = self.allocator.blocks_in_use
             alloc_cap = used * self.block_size
+            cap = self.num_blocks * self.block_size
             return {
                 "admits": self._admits, "evictions": self._evictions,
                 "occupancy": len(self._active),
@@ -307,9 +310,10 @@ class PagedKVCacheManager:
                 "block_size": self.block_size,
                 "blocks_in_use": used,
                 "peak_blocks_in_use": self.allocator.peak_blocks_in_use,
-                "capacity_tokens": self.num_blocks * self.block_size,
+                "capacity_tokens": cap,
                 "tokens_in_flight": int(live),
                 "peak_tokens": int(self._peak_tokens),
+                "utilization": round(live / cap, 4) if cap else 0.0,
                 "fragmentation": round(1.0 - live / alloc_cap, 4)
                 if alloc_cap else 0.0,
             }

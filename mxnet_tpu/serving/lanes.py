@@ -35,6 +35,16 @@ Telemetry: requests carry ``replica``/``handoff_ms``/``kv_blocks`` in
 their JSONL records, lanes emit ``serving.prefill`` spans and
 ``serving.handoff_ms`` histograms, and the decode tick publishes the
 ``serving.kv_blocks_in_use`` gauge (see docs/observability.md).
+
+Tracing (r12): when ``telemetry.tracing`` is on, each request carries
+its span context across the lane threads (``req.trace``): the prefill
+lane records the ``queue`` and ``prefill`` spans at admission, adoption
+records ``handoff``, every decode tick records one ``decode.step`` span
+per traced slot, and :meth:`Replica.finish` seals the trace (``evict``
+event + the root span) — all retroactive from stamps the lanes already
+take, so the decode tick pays one dict append per traced slot.  The
+failure paths emit ``status="error"`` request records tagged with
+replica + lane and trip the flight recorder (``tracing.incident``).
 """
 from __future__ import annotations
 
@@ -45,6 +55,7 @@ from collections import deque
 import numpy as np
 
 from .. import telemetry
+from ..telemetry import tracing
 from .bucketing import pad_batch
 from .kv_cache import PagedKVCacheManager
 from .protocol import ServerClosedError
@@ -106,6 +117,10 @@ class PrefillLane:
         if self._thread is not None:
             self._thread.join()
             self._thread = None
+
+    def alive(self):
+        """Lane-thread liveness (the /healthz signal)."""
+        return self._thread is not None and self._thread.is_alive()
 
     def _loop(self):
         q = self.r.queue
@@ -194,14 +209,29 @@ class PrefillLane:
                 if req.slot is not None and req.slot in mgr._active:
                     mgr.evict(req.slot)
                     eng.clear_slot(req.slot)
+                req.replica = r.index
                 req.future.set_exception(exc)
+                r.fail(req, exc, lane="prefill")
             r.capacity_evt.set()
-            r.failed += len(group)
-            telemetry.count("serving.failed", len(group))
+            tracing.incident("replica_exception",
+                             context={"replica": r.index,
+                                      "lane": "prefill",
+                                      "error": repr(exc)})
             return True
         t_first = time.perf_counter()
+        mates = [req.id for req in group]
         for i, req in enumerate(group):
             req.t_first = t_first
+            if req.trace is not None:
+                # retroactive spans from the stamps above: queue covers
+                # dispatch + bucket dwell, prefill the forward + commit
+                req.trace.add("queue", req.t_submit, t_start,
+                              replica=r.index)
+                req.trace.add("prefill", t_start, t_first,
+                              replica=r.index, slot=req.slot,
+                              kv_blocks=req.kv_blocks,
+                              bucket=list(req.bucket),
+                              mates=[m for m in mates if m != req.id])
             if mgr.consume(req.slot):
                 # max_new_tokens == 1: done at prefill, never decodes
                 r.finish(req, [int(first[i])])
@@ -251,6 +281,27 @@ class DecodeLane:
             self._thread.join()
             self._thread = None
 
+    def alive(self):
+        """Lane-thread liveness (the /healthz signal)."""
+        return self._thread is not None and self._thread.is_alive()
+
+    def snapshot(self):
+        """In-flight view for the /requests table: handoffs not yet
+        adopted + decoding slots, host-side bookkeeping only."""
+        rows = []
+        with self._hand_lock:
+            handoffs = list(self._handoffs)
+            seqs = dict(self._seqs)
+        for h in handoffs:
+            rows.append({"request_id": h.req.id, "state": "handoff",
+                         "slot": h.slot, "replica": self.r.index})
+        for slot, (req, tokens) in seqs.items():
+            rows.append({"request_id": req.id, "state": "decoding",
+                         "slot": slot, "replica": self.r.index,
+                         "tokens_done": len(tokens),
+                         "max_new_tokens": req.max_new_tokens})
+        return rows
+
     def _loop(self):
         while True:
             self._adopt()
@@ -275,13 +326,20 @@ class DecodeLane:
                     return
                 h = self._handoffs.popleft()
             h.req.t_handoff = time.perf_counter()
-            telemetry.hist("serving.handoff_ms",
-                           (h.req.t_handoff - h.req.t_first) * 1e3)
+            hand_ms = (h.req.t_handoff - h.req.t_first) * 1e3
+            telemetry.hist("serving.handoff_ms", hand_ms)
+            telemetry.hist(f"serving.handoff_ms|replica={self.r.index}",
+                           hand_ms)
+            if h.req.trace is not None:
+                h.req.trace.add("handoff", h.req.t_first,
+                                h.req.t_handoff, replica=self.r.index,
+                                slot=h.slot)
             self._seqs[h.slot] = (h.req, [h.first])
 
     def _tick(self):
         r = self.r
         active = sorted(self._seqs)
+        t0 = time.perf_counter()
         try:
             toks = r.engine.step(active)
         except Exception as exc:
@@ -290,18 +348,30 @@ class DecodeLane:
                 r.mgr.evict(slot)
                 r.engine.clear_slot(slot)
                 req.future.set_exception(exc)
+                r.fail(req, exc, lane="decode")
             r.capacity_evt.set()
-            r.failed += len(active)
-            telemetry.count("serving.failed", len(active))
+            tracing.incident("replica_exception",
+                             context={"replica": r.index,
+                                      "lane": "decode",
+                                      "error": repr(exc)})
             return
+        t1 = time.perf_counter()
         r.batches += 1
         telemetry.hist("serving.batch_size", len(active))
         telemetry.gauge("serving.kv_blocks_in_use",
                         r.mgr.allocator.blocks_in_use)
+        step_idx = r.engine.steps
         for slot in active:
             r.mgr.advance(slot)   # the step wrote K/V at slot's pos
             req, tokens = self._seqs[slot]
             tokens.append(int(toks[slot]))
+            if req.trace is not None:
+                # one span per traced slot per tick: the per-request
+                # decode slice (cost: one dict append — the tracing
+                # A/B lane in benchmark/serving_latency.py bounds it)
+                req.trace.add("decode.step", t0, t1, step=step_idx,
+                              batch=len(active), replica=r.index,
+                              slot=slot)
             if r.mgr.consume(slot):
                 del self._seqs[slot]
                 r.finish(req, tokens)
@@ -314,7 +384,7 @@ class Replica:
     def __init__(self, net, policy, index=0, mesh=None,
                  partition_rules=None, num_slots=4, int8=False,
                  block_size=16, num_blocks=None, queue_capacity=64,
-                 max_prefill_tokens=None, summary_every=32):
+                 max_prefill_tokens=None, summary_every=32, slo=None):
         from .generative import LlamaServingEngine
 
         self.index = int(index)
@@ -336,6 +406,7 @@ class Replica:
         self.prefill = PrefillLane(self)
         self.decode = DecodeLane(self)
         self.capacity_evt = threading.Event()  # set on evict: re-admit
+        self.slo = slo   # shared SLOTracker (metrics.py) or None
         self.completed = 0
         self.failed = 0
         self.batches = 0
@@ -383,17 +454,49 @@ class Replica:
              np.asarray(tokens[:n], np.int32)]))
         self.completed += 1
         telemetry.count("serving.completed")
-        rec = req.record()
-        rec["lane"] = "decode" if req.t_handoff is not None else "prefill"
+        telemetry.count(f"serving.completed|replica={self.index}")
+        lane = "decode" if req.t_handoff is not None else "prefill"
+        rec = req.record(lane=lane)
+        tag = f"|replica={self.index}"
         if rec["queue_wait_ms"] is not None:
             telemetry.hist("serving.queue_wait_ms", rec["queue_wait_ms"])
+            telemetry.hist("serving.queue_wait_ms" + tag,
+                           rec["queue_wait_ms"])
         if rec["total_ms"] is not None:
             telemetry.hist("serving.total_ms", rec["total_ms"])
+            telemetry.hist("serving.total_ms" + tag, rec["total_ms"])
         if rec.get("ttft_ms") is not None:
             telemetry.hist("serving.ttft_ms", rec["ttft_ms"])
+            telemetry.hist("serving.ttft_ms" + tag, rec["ttft_ms"])
+        if rec.get("tpot_ms") is not None:
+            telemetry.hist("serving.tpot_ms", rec["tpot_ms"])
+            telemetry.hist("serving.tpot_ms" + tag, rec["tpot_ms"])
+        if self.slo is not None:
+            rec["slo_met"] = self.slo.observe(
+                tenant=req.tenant, ttft_ms=rec.get("ttft_ms"),
+                tpot_ms=rec.get("tpot_ms"))
         telemetry.emit(rec)
+        if req.trace is not None:
+            req.trace.event("evict", replica=self.index, slot=req.slot)
+            tracing.finish(req.trace, status="ok", replica=self.index,
+                           lane=lane, request_id=req.id)
         if self.summary_every and self.completed % self.summary_every == 0:
             self.emit_summary()
+
+    def fail(self, req, exc, lane):
+        """Failure-path accounting: the ``status="error"`` request
+        record (tagged replica + lane — the eviction/rejection paths
+        used to drop both), the failed counters, and the trace seal."""
+        self.failed += 1
+        telemetry.count("serving.failed")
+        telemetry.count(f"serving.failed|replica={self.index}")
+        req.t_done = time.perf_counter()
+        telemetry.emit(req.record(lane=lane, status="error",
+                                  error=repr(exc)))
+        if req.trace is not None:
+            tracing.finish(req.trace, status="error",
+                           replica=self.index, lane=lane,
+                           error=repr(exc), request_id=req.id)
 
     def emit_summary(self):
         telemetry.emit({

@@ -1,0 +1,319 @@
+"""Live metrics endpoint + SLO goodput accounting for the servers.
+
+Three pieces, all host-side (the HTTP thread never touches a device
+buffer — it renders telemetry snapshots and ledger stats that the
+serving threads already maintain):
+
+* :func:`prometheus_text` — the telemetry module's counters, gauges and
+  ``_Reservoir`` histograms rendered as Prometheus text exposition
+  (v0.0.4).  Dotted names sanitize to ``mxt_*`` families
+  (``serving.completed`` → ``mxt_serving_completed_total``); a name of
+  the form ``base|key=value`` carries Prometheus labels, which is how
+  the per-replica latency histograms (``serving.ttft_ms|replica=1``)
+  render as one labelled family.  Histograms become summaries
+  (``quantile="0.5"/"0.9"/"0.99"`` over the rolling window, plus
+  ``_sum``/``_count`` over the all-time stream).
+* :class:`MetricsServer` — a stdlib ``http.server`` daemon thread bound
+  to an owner server, exposing ``/metrics`` (the text above plus the
+  owner's live gauges), ``/healthz`` (per-replica lane liveness, queue
+  depths, KV occupancy/fragmentation; HTTP 503 when degraded) and
+  ``/requests`` (the in-flight request table).  Enabled per-server via
+  ``ServerConfig(http_port=...)`` (0 = ephemeral port, see
+  ``server.metrics_url``) — scrape while the server runs.
+* :class:`SLOTracker` — per-tenant TTFT/TPOT targets with **goodput**
+  (fraction of requests meeting their SLO) over both a rolling window
+  and the all-time stream.  The serving completion paths call
+  :meth:`SLOTracker.observe`; results land in ``server.stats()["slo"]``
+  and as ``mxt_serving_goodput{tenant=...}`` gauges on ``/metrics``.
+
+Schema details in docs/observability.md.
+"""
+from __future__ import annotations
+
+import json
+import re
+import threading
+from collections import deque
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from .. import telemetry
+
+__all__ = ["prometheus_text", "MetricsServer", "SLOTracker"]
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
+
+#: rolling-histogram percentiles exposed as summary quantiles
+_QUANTILES = ((50, "0.5"), (90, "0.9"), (99, "0.99"))
+
+
+def _prom_name(name, prefix="mxt_"):
+    """Dotted telemetry name → Prometheus metric family name."""
+    body = _NAME_RE.sub("_", name)
+    if body and body[0].isdigit():
+        body = "_" + body
+    return prefix + body
+
+
+def _split_labels(name):
+    """``"serving.ttft_ms|replica=0,lane=decode"`` →
+    ``("serving.ttft_ms", {"replica": "0", "lane": "decode"})``."""
+    if "|" not in name:
+        return name, {}
+    base, _, rest = name.partition("|")
+    labels = {}
+    for part in rest.split(","):
+        k, _, v = part.partition("=")
+        if k:
+            labels[k.strip()] = v.strip()
+    return base, labels
+
+
+def _fmt_labels(labels, extra=None):
+    items = dict(labels)
+    if extra:
+        items.update(extra)
+    if not items:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in sorted(items.items()))
+    return "{" + inner + "}"
+
+
+def _fmt_value(v):
+    try:
+        f = float(v)
+    except (TypeError, ValueError):
+        return "0"
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def prometheus_text(extra_gauges=None):
+    """Render the telemetry module's current counters, gauges and
+    histogram summaries (plus ``extra_gauges``, a dotted-name → value
+    dict the caller wants on the same scrape) as Prometheus text."""
+    families = {}   # prom name -> {"type": ..., "samples": [(suffix, labels, value)]}
+
+    def fam(name, mtype):
+        f = families.get(name)
+        if f is None:
+            f = families[name] = {"type": mtype, "samples": []}
+        return f
+
+    for name, value in sorted(telemetry.counters().items()):
+        base, labels = _split_labels(name)
+        fam(_prom_name(base) + "_total", "counter")["samples"].append(
+            ("", labels, value))
+    gauges = dict(telemetry.gauges())
+    if extra_gauges:
+        gauges.update(extra_gauges)
+    for name, value in sorted(gauges.items()):
+        base, labels = _split_labels(name)
+        fam(_prom_name(base), "gauge")["samples"].append(("", labels, value))
+    for name, summ in sorted(telemetry.hists().items()):
+        if summ is None:
+            continue
+        base, labels = _split_labels(name)
+        f = fam(_prom_name(base), "summary")
+        for p, q in _QUANTILES:
+            val = summ.get(f"p{p}")
+            if val is not None:
+                f["samples"].append(("", dict(labels, quantile=q), val))
+        f["samples"].append(("_sum", labels,
+                             summ["mean"] * summ["count"]))
+        f["samples"].append(("_count", labels, summ["count"]))
+    lines = []
+    for name in sorted(families):
+        f = families[name]
+        lines.append(f"# TYPE {name} {f['type']}")
+        for suffix, labels, value in f["samples"]:
+            lines.append(f"{name}{suffix}{_fmt_labels(labels)} "
+                         f"{_fmt_value(value)}")
+    return "\n".join(lines) + "\n"
+
+
+# -- SLO goodput -------------------------------------------------------------
+
+class SLOTracker:
+    """Per-tenant TTFT/TPOT targets and rolling goodput.
+
+    ``targets`` maps tenant name → ``{"ttft_ms": x, "tpot_ms": y}``
+    (either key optional); the ``"default"`` entry covers tenants
+    without their own row.  A flat ``{"ttft_ms": ..}`` dict is accepted
+    as shorthand for ``{"default": ...}``.  ``observe`` is called once
+    per completed request and judges only the metrics the target
+    actually names (a 1-token request has no TPOT; it is not penalized
+    for it)."""
+
+    def __init__(self, targets, window=256):
+        targets = dict(targets or {})
+        if targets and not any(isinstance(v, dict)
+                               for v in targets.values()):
+            targets = {"default": targets}
+        self.targets = targets
+        self.window = int(window)
+        self._lock = threading.Lock()
+        self._tenants = {}  # tenant -> {"window": deque, "met": n, "total": n}
+
+    def target_for(self, tenant=None):
+        """The SLO row applying to ``tenant`` (None when neither the
+        tenant nor ``"default"`` is configured)."""
+        return self.targets.get(tenant or "default",
+                                self.targets.get("default"))
+
+    def observe(self, tenant=None, ttft_ms=None, tpot_ms=None):
+        """Judge one completed request against its tenant's targets.
+        Returns True/False (met / missed), or None when no target
+        applies (nothing is recorded)."""
+        target = self.target_for(tenant)
+        if target is None:
+            return None
+        met, judged = True, False
+        t = target.get("ttft_ms")
+        if t is not None and ttft_ms is not None:
+            judged = True
+            met = met and ttft_ms <= t
+        t = target.get("tpot_ms")
+        if t is not None and tpot_ms is not None:
+            judged = True
+            met = met and tpot_ms <= t
+        if not judged:
+            return None
+        key = tenant or "default"
+        with self._lock:
+            row = self._tenants.get(key)
+            if row is None:
+                row = self._tenants[key] = {
+                    "window": deque(maxlen=self.window),
+                    "met": 0, "total": 0}
+            row["window"].append(1 if met else 0)
+            row["total"] += 1
+            row["met"] += 1 if met else 0
+        return met
+
+    def goodput(self, tenant=None):
+        """Rolling-window goodput fraction for ``tenant`` (None before
+        any observation)."""
+        with self._lock:
+            row = self._tenants.get(tenant or "default")
+            if row is None or not row["window"]:
+                return None
+            return sum(row["window"]) / len(row["window"])
+
+    def snapshot(self):
+        """``stats()``-shaped summary: targets + per-tenant goodput
+        over the rolling window and the all-time stream."""
+        with self._lock:
+            tenants = {
+                t: {
+                    "total": row["total"],
+                    "met": row["met"],
+                    "goodput": row["met"] / row["total"]
+                    if row["total"] else None,
+                    "window": len(row["window"]),
+                    "window_goodput": sum(row["window"]) / len(row["window"])
+                    if row["window"] else None,
+                }
+                for t, row in self._tenants.items()}
+        return {"targets": self.targets, "window": self.window,
+                "tenants": tenants}
+
+
+# -- the HTTP endpoint thread ------------------------------------------------
+
+def _make_handler(ms):
+    class _Handler(BaseHTTPRequestHandler):
+        server_version = "mxt-serving"
+
+        def do_GET(self):
+            path = self.path.split("?", 1)[0]
+            try:
+                if path == "/metrics":
+                    code = 200
+                    ctype = "text/plain; version=0.0.4; charset=utf-8"
+                    body = ms.render_metrics().encode("utf-8")
+                elif path == "/healthz":
+                    health = ms.owner.health()
+                    code = 200 if health.get("status") == "ok" else 503
+                    ctype = "application/json"
+                    body = json.dumps(health, indent=2,
+                                      default=str).encode("utf-8")
+                elif path == "/requests":
+                    code = 200
+                    ctype = "application/json"
+                    body = json.dumps(ms.owner.in_flight(), indent=2,
+                                      default=str).encode("utf-8")
+                else:
+                    code, ctype = 404, "text/plain"
+                    body = b"not found; endpoints: /metrics /healthz " \
+                           b"/requests"
+            except Exception as exc:  # scrape errors never kill serving
+                code, ctype, body = 500, "text/plain", repr(exc).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *args):   # scrapes stay off stderr
+            pass
+
+    return _Handler
+
+
+class MetricsServer:
+    """The per-server scrape endpoint: one ``ThreadingHTTPServer`` on a
+    daemon thread.  ``owner`` is the serving server — it must provide
+    ``health()`` and ``in_flight()`` (both host-side snapshots) and may
+    provide ``metrics_gauges()`` for extra live gauges on ``/metrics``
+    and ``slo`` (an :class:`SLOTracker`) for goodput gauges."""
+
+    def __init__(self, owner, host="127.0.0.1", port=0):
+        self.owner = owner
+        self.host = host
+        self._requested_port = int(port)
+        self._httpd = None
+        self._thread = None
+
+    @property
+    def port(self):
+        """The bound port (resolves ``port=0`` to the ephemeral one)."""
+        return self._httpd.server_address[1] if self._httpd else None
+
+    @property
+    def url(self):
+        return f"http://{self.host}:{self.port}" if self._httpd else None
+
+    def render_metrics(self):
+        extra = {}
+        gauges_fn = getattr(self.owner, "metrics_gauges", None)
+        if gauges_fn is not None:
+            extra.update(gauges_fn())
+        slo = getattr(self.owner, "slo", None)
+        if slo is not None:
+            for tenant, row in slo.snapshot()["tenants"].items():
+                if row["window_goodput"] is not None:
+                    extra[f"serving.goodput|tenant={tenant}"] = \
+                        row["window_goodput"]
+        return prometheus_text(extra_gauges=extra)
+
+    def start(self):
+        if self._httpd is not None:
+            return self
+        self._httpd = ThreadingHTTPServer(
+            (self.host, self._requested_port), _make_handler(self))
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            kwargs={"poll_interval": 0.1},
+            name="mxt-metrics", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        if self._httpd is None:
+            return
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join()
+        self._httpd = self._thread = None

@@ -59,10 +59,11 @@ class Request:
     __slots__ = ("id", "inputs", "length", "prompt_ids", "max_new_tokens",
                  "future", "t_submit", "t_start", "t_first", "t_done",
                  "batch_size", "bucket", "slot", "joined_step",
-                 "done_step", "replica", "t_handoff", "kv_blocks")
+                 "done_step", "replica", "t_handoff", "kv_blocks",
+                 "trace", "tenant")
 
     def __init__(self, inputs=None, length=None, prompt_ids=None,
-                 max_new_tokens=None):
+                 max_new_tokens=None, tenant=None):
         self.id = next(_ids)
         self.inputs = inputs
         self.length = length
@@ -82,12 +83,32 @@ class Request:
         self.replica = None     # which dp replica served the request
         self.t_handoff = None   # decode lane adopted the prefilled KV
         self.kv_blocks = None   # blocks reserved for the request
+        # observability (r12): the request-scoped span context (a
+        # telemetry.tracing.Trace, None while tracing is off — every
+        # serving call site guards on that None) and the SLO tenant
+        self.trace = None
+        self.tenant = tenant
 
-    def record(self, kind="serving.request"):
-        """The per-request JSONL record (emitted on completion)."""
+    def tpot_ms(self):
+        """Time-per-output-token: decode milliseconds per generated
+        token AFTER the first (TTFT owns the first) — None until done,
+        and None for 1-token requests (no decode interval exists)."""
+        if self.t_first is None or self.t_done is None or \
+                not self.max_new_tokens or self.max_new_tokens < 2:
+            return None
+        return (self.t_done - self.t_first) * 1e3 \
+            / (self.max_new_tokens - 1)
+
+    def record(self, kind="serving.request", lane=None, status="ok",
+               error=None):
+        """The per-request JSONL record (emitted on completion, and —
+        with ``status="error"`` — on the failure paths, so rejected or
+        evicted requests still land in the stream with their replica
+        and lane)."""
         rec = {
             "record": kind,
             "request_id": self.id,
+            "status": status,
             "bucket": self.bucket,
             "batch_size": self.batch_size,
             "queue_wait_ms": (self.t_start - self.t_submit) * 1e3
@@ -95,8 +116,19 @@ class Request:
             "total_ms": (self.t_done - self.t_submit) * 1e3
             if self.t_done is not None else None,
         }
+        if lane is not None:
+            rec["lane"] = lane
+        if error is not None:
+            rec["error"] = error
+        if self.tenant is not None:
+            rec["tenant"] = self.tenant
+        if self.trace is not None:
+            rec["trace_id"] = self.trace.trace_id
         if self.t_first is not None:
             rec["ttft_ms"] = (self.t_first - self.t_submit) * 1e3
+        tpot = self.tpot_ms()
+        if tpot is not None:
+            rec["tpot_ms"] = tpot
         if self.slot is not None:
             rec["slot"] = self.slot
             rec["joined_step"] = self.joined_step

@@ -28,6 +28,7 @@ import time
 import numpy as np
 
 from .. import telemetry
+from ..telemetry import tracing
 from .bucketing import pad_batch
 from .protocol import ServerClosedError, ServerOverloadedError
 
@@ -248,7 +249,14 @@ class BatchScheduler:
             self.failed += len(group)
             telemetry.count("serving.failed", len(group))
             for r in group:
+                r.t_done = time.perf_counter()
                 r.future.set_exception(exc)
+                telemetry.emit(r.record(lane="batch", status="error",
+                                        error=repr(exc)))
+                if r.trace is not None:
+                    tracing.finish(r.trace, status="error",
+                                   lane="batch", error=repr(exc),
+                                   request_id=r.id)
             return
         self.batches += 1
         t_done = time.perf_counter()
@@ -256,6 +264,11 @@ class BatchScheduler:
         telemetry.hist("serving.batch_size", len(group))
         for i, r in enumerate(group):
             r.t_done = t_done
+            if r.trace is not None:
+                r.trace.add("queue", r.t_submit, t_start)
+                r.trace.add("batch", t_start, t_done,
+                            bucket=list(r.bucket),
+                            batch=len(group))
             r.future.set_result(self._demux(outs, i, r.length))
             self._account(r)
 
@@ -274,7 +287,10 @@ class BatchScheduler:
         summary every ``summary_every`` completions."""
         self.completed += 1
         telemetry.count("serving.completed")
-        rec = req.record()
+        rec = req.record(lane="batch")
+        if req.trace is not None:
+            tracing.finish(req.trace, status="ok", lane="batch",
+                           request_id=req.id)
         if rec["queue_wait_ms"] is not None:
             telemetry.hist("serving.queue_wait_ms", rec["queue_wait_ms"])
         if rec["total_ms"] is not None:

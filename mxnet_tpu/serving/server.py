@@ -21,12 +21,15 @@ same contract as async-checkpoint tickets).
 """
 from __future__ import annotations
 
+import time
+
 import numpy as np
 
 from .. import telemetry
+from ..telemetry import tracing
 from ..base import MXNetError
 from .bucketing import BucketPolicy
-from .protocol import Request, ServerClosedError
+from .protocol import Request, ServerClosedError, ServerOverloadedError
 from .scheduler import BatchScheduler, RequestQueue
 
 __all__ = ["ServerConfig", "InferenceServer", "GenerativeServer"]
@@ -50,7 +53,8 @@ class ServerConfig:
                  summary_every=32, length_axis=0, output_length_axis=None,
                  num_slots=4, max_new_tokens=32, int8=False,
                  calib_data=None, kv_mode="paged", block_size=16,
-                 num_blocks=None):
+                 num_blocks=None, http_port=None, http_host="127.0.0.1",
+                 slo=None, slo_window=256):
         self.policy = BucketPolicy(max_batch=max_batch,
                                    max_length=max_length,
                                    min_batch=min_batch,
@@ -75,19 +79,39 @@ class ServerConfig:
         self.kv_mode = kv_mode
         self.block_size = int(block_size)
         self.num_blocks = num_blocks
+        # observability (r12): ``http_port`` starts the live metrics
+        # endpoint with the server (0 = ephemeral port, read it back
+        # from ``server.metrics_url``); ``slo`` maps tenant →
+        # {"ttft_ms": x, "tpot_ms": y} targets (a flat dict is the
+        # "default" tenant) for goodput accounting over ``slo_window``
+        # recent requests (docs/observability.md).
+        self.http_port = http_port if http_port is None else int(http_port)
+        self.http_host = str(http_host)
+        self.slo = slo
+        self.slo_window = int(slo_window)
 
 
 class _ServerBase:
-    """start/stop/context-manager scaffolding shared by both servers."""
+    """start/stop/context-manager scaffolding shared by both servers,
+    plus the r12 observability surface: the metrics endpoint lifecycle,
+    the shared SLO tracker, and trace creation at submit."""
 
     def __init__(self, config):
         self.config = config or ServerConfig()
         self.queue = RequestQueue(self.config.queue_capacity)
         self._running = False
+        self._metrics = None
+        self.slo = None
+        if self.config.slo:
+            from .metrics import SLOTracker
+
+            self.slo = SLOTracker(self.config.slo,
+                                  window=self.config.slo_window)
 
     def start(self):
         self._sched.start()
         self._running = True
+        self._start_http()
         return self
 
     def stop(self, drain=True):
@@ -95,6 +119,7 @@ class _ServerBase:
         if not self._running:
             return
         self._running = False
+        self._stop_http()
         self._sched.stop(drain=drain)
 
     def __enter__(self):
@@ -104,10 +129,54 @@ class _ServerBase:
         self.stop()
         return False
 
+    # -- metrics endpoint -----------------------------------------------------
+    def _start_http(self):
+        if self.config.http_port is None or self._metrics is not None:
+            return
+        from .metrics import MetricsServer
+
+        self._metrics = MetricsServer(
+            self, host=self.config.http_host,
+            port=self.config.http_port).start()
+
+    def _stop_http(self):
+        if self._metrics is not None:
+            self._metrics.stop()
+            self._metrics = None
+
+    @property
+    def metrics_url(self):
+        """Base URL of the live endpoint (None when not started)."""
+        return self._metrics.url if self._metrics is not None else None
+
+    def metrics_gauges(self):
+        """Live gauges the /metrics scrape adds on top of the telemetry
+        snapshot (subclasses extend)."""
+        return {"serving.queue_depth": len(self.queue),
+                "serving.rejected_total": self.queue.rejected}
+
+    # -- submission -----------------------------------------------------------
     def _submit(self, req):
         if not self._running:
             raise ServerClosedError("server is not running; call start()")
-        self.queue.put(req)
+        if tracing.is_enabled() and req.trace is None:
+            req.trace = tracing.start_trace(request_id=req.id,
+                                            tenant=req.tenant)
+        try:
+            self.queue.put(req)
+        except ServerOverloadedError as exc:
+            # shed-load accounting: the rejected request still lands in
+            # the JSONL stream (tagged) and trips the flight recorder
+            telemetry.emit(req.record(lane="queue", status="rejected",
+                                      error=repr(exc)))
+            if req.trace is not None:
+                tracing.finish(req.trace, status="rejected", lane="queue",
+                               error=repr(exc), request_id=req.id)
+                req.trace = None
+            tracing.incident("overload_rejection", context={
+                "queue_capacity": self.queue.capacity,
+                "rejected": self.queue.rejected})
+            raise
         return req.future
 
 
@@ -161,7 +230,7 @@ class InferenceServer(_ServerBase):
         return out if isinstance(out, (list, tuple)) else [out]
 
     # -- client surface -------------------------------------------------------
-    def submit(self, inputs, length=None):
+    def submit(self, inputs, length=None, tenant=None):
         """Async: one example's inputs (array, or dict name → array) →
         a Future resolving to the demuxed output(s).  ``length`` is the
         true size of the bucketed axis (defaults to the first input's
@@ -172,12 +241,38 @@ class InferenceServer(_ServerBase):
         if length is None:
             length = inputs[self.input_names[0]] \
                 .shape[self.config.length_axis]
-        req = Request(inputs=inputs, length=int(length))
+        req = Request(inputs=inputs, length=int(length), tenant=tenant)
         return self._submit(req)
 
     def infer(self, inputs, length=None, timeout=60.0):
         """Sync: submit + wait."""
         return self.submit(inputs, length=length).result(timeout)
+
+    def health(self):
+        """The /healthz body: scheduler-thread liveness + queue depth
+        (host-side snapshot, never a device touch)."""
+        alive = self._sched._thread is not None \
+            and self._sched._thread.is_alive()
+        if not self._running:
+            status = "stopped"
+        else:
+            status = "ok" if alive else "degraded"
+        return {"status": status, "running": self._running,
+                "scheduler_alive": alive,
+                "queue_depth": len(self.queue),
+                "rejected": self.queue.rejected}
+
+    def in_flight(self):
+        """The /requests table: currently queued requests."""
+        with self.queue._cond:
+            items = list(self.queue._items)
+        now = time.perf_counter()
+        return [{"request_id": r.id, "state": "queued",
+                 "length": r.length, "tenant": r.tenant,
+                 "trace_id": r.trace.trace_id
+                 if r.trace is not None else None,
+                 "age_ms": round((now - r.t_submit) * 1e3, 3)}
+                for r in items]
 
     def stats(self):
         """Server + compile-cache counters (the bucketing-policy
@@ -195,6 +290,8 @@ class InferenceServer(_ServerBase):
         elif hasattr(self.model, "_cached_op") and \
                 self.model._cached_op is not None:
             out["cache"] = self.model._cached_op.cache_stats()
+        if self.slo is not None:
+            out["slo"] = self.slo.snapshot()
         return out
 
 
@@ -256,7 +353,7 @@ class GenerativeServer(_ServerBase):
                 partition_rules=partition_rules)
             self._sched = GenerativeScheduler(
                 self.engine, self.queue, policy=cfg.policy,
-                summary_every=cfg.summary_every)
+                summary_every=cfg.summary_every, slo=self.slo)
             return
         self._replicas = [
             Replica(net, cfg.policy, index=i, mesh=sub,
@@ -264,7 +361,7 @@ class GenerativeServer(_ServerBase):
                     num_slots=cfg.num_slots, int8=cfg.int8,
                     block_size=cfg.block_size, num_blocks=cfg.num_blocks,
                     queue_capacity=cfg.queue_capacity,
-                    summary_every=cfg.summary_every)
+                    summary_every=cfg.summary_every, slo=self.slo)
             for i, sub in enumerate(_split_mesh(mesh))]
         self._dispatcher = ReplicaDispatcher(self.queue, self._replicas)
         self.engine = self._replicas[0].engine
@@ -282,12 +379,14 @@ class GenerativeServer(_ServerBase):
             rep.start()
         self._dispatcher.start()
         self._running = True
+        self._start_http()
         return self
 
     def stop(self, drain=True):
         if not self._running:
             return
         self._running = False
+        self._stop_http()
         if self._replicas is None:
             self._sched.stop(drain=drain)
             return
@@ -298,9 +397,10 @@ class GenerativeServer(_ServerBase):
             rep.stop(drain=drain)
 
     # -- client surface -------------------------------------------------------
-    def submit(self, prompt_ids, max_new_tokens=None):
+    def submit(self, prompt_ids, max_new_tokens=None, tenant=None):
         """Async: 1-D prompt token ids → Future resolving to the full
-        sequence (prompt + generated), greedy decode."""
+        sequence (prompt + generated), greedy decode.  ``tenant`` keys
+        the request's SLO targets (config.slo)."""
         prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
         n = int(max_new_tokens or self.config.max_new_tokens)
         if n < 1:
@@ -309,13 +409,112 @@ class GenerativeServer(_ServerBase):
             raise MXNetError(
                 f"prompt {len(prompt)} + {n} new tokens exceeds the "
                 f"engine's max_len {self.engine.max_len}")
-        req = Request(prompt_ids=prompt, max_new_tokens=n)
+        req = Request(prompt_ids=prompt, max_new_tokens=n, tenant=tenant)
         req.length = len(prompt)
         return self._submit(req)
 
     def generate(self, prompt_ids, max_new_tokens=None, timeout=120.0):
         """Sync: submit + wait for the full sequence."""
         return self.submit(prompt_ids, max_new_tokens).result(timeout)
+
+    # -- observability surface ------------------------------------------------
+    def health(self):
+        """The /healthz body: per-replica lane liveness, queue depths,
+        and KV occupancy/fragmentation — every number a host-side
+        counter read, never a device touch.  ``status`` is ``"ok"``
+        only when every lane thread is alive."""
+        if self._replicas is None:
+            alive = self._sched._thread is not None \
+                and self._sched._thread.is_alive()
+            kv = self._sched.mgr.stats()
+            if not self._running:
+                status = "stopped"
+            else:
+                status = "ok" if alive else "degraded"
+            return {"status": status, "running": self._running,
+                    "scheduler_alive": alive,
+                    "queue_depth": len(self.queue),
+                    "rejected": self.queue.rejected,
+                    "kv_occupancy": kv["occupancy"],
+                    "kv_utilization": kv["utilization"],
+                    "kv_fragmentation": kv["fragmentation"]}
+        reps = []
+        all_alive = True
+        for r in self._replicas:
+            kv = r.mgr.stats()
+            pa, da = r.prefill.alive(), r.decode.alive()
+            all_alive = all_alive and pa and da
+            reps.append({
+                "replica": r.index,
+                "prefill_alive": pa,
+                "decode_alive": da,
+                "queue_depth": len(r.queue),
+                "in_flight": kv["occupancy"],
+                "failed": r.failed,
+                "kv_utilization": kv["utilization"],
+                "kv_fragmentation": kv["fragmentation"],
+                "kv_blocks_in_use": kv["blocks_in_use"]})
+        if not self._running:
+            status = "stopped"
+        else:
+            status = "ok" if all_alive else "degraded"
+        return {"status": status, "running": self._running,
+                "queue_depth": len(self.queue),
+                "rejected": self.queue.rejected,
+                "replicas": reps}
+
+    def in_flight(self):
+        """The /requests table: every request currently queued (front
+        queue + replica queues) or decoding, with ids the trace stream
+        can be joined on."""
+        now = time.perf_counter()
+
+        def queued(queue, replica=None):
+            with queue._cond:
+                items = list(queue._items)
+            return [{"request_id": r.id, "state": "queued",
+                     "replica": replica, "length": r.length,
+                     "tenant": r.tenant,
+                     "trace_id": r.trace.trace_id
+                     if r.trace is not None else None,
+                     "age_ms": round((now - r.t_submit) * 1e3, 3)}
+                    for r in items]
+
+        rows = queued(self.queue)
+        if self._replicas is None:
+            for slot, (req, tokens) in list(self._sched._seqs.items()):
+                rows.append({"request_id": req.id, "state": "decoding",
+                             "replica": req.replica, "slot": slot,
+                             "tenant": req.tenant,
+                             "trace_id": req.trace.trace_id
+                             if req.trace is not None else None,
+                             "tokens_done": len(tokens),
+                             "max_new_tokens": req.max_new_tokens})
+            return rows
+        for r in self._replicas:
+            rows.extend(queued(r.queue, replica=r.index))
+            rows.extend(r.decode.snapshot())
+        return rows
+
+    def metrics_gauges(self):
+        """Extend the base scrape gauges with live KV-pool state —
+        per replica when there are several."""
+        out = super().metrics_gauges()
+        if self._replicas is None:
+            kv = self._sched.mgr.stats()
+            out["serving.kv_occupancy"] = kv["occupancy"]
+            out["serving.kv_utilization"] = kv["utilization"]
+            out["serving.kv_fragmentation"] = kv["fragmentation"]
+            return out
+        for r in self._replicas:
+            kv = r.mgr.stats()
+            tag = f"|replica={r.index}"
+            out["serving.kv_occupancy" + tag] = kv["occupancy"]
+            out["serving.kv_utilization" + tag] = kv["utilization"]
+            out["serving.kv_fragmentation" + tag] = kv["fragmentation"]
+            out["serving.kv_blocks_in_use" + tag] = kv["blocks_in_use"]
+            out["serving.replica_queue_depth" + tag] = len(r.queue)
+        return out
 
     def stats(self):
         if self._replicas is None:
@@ -330,6 +529,8 @@ class GenerativeServer(_ServerBase):
             }
             telemetry.gauge("serving.kv_occupancy",
                             out["kv_cache"]["occupancy"])
+            if self.slo is not None:
+                out["slo"] = self.slo.snapshot()
             return out
         reps = self._replicas
         out = {
@@ -355,4 +556,6 @@ class GenerativeServer(_ServerBase):
                         sum(r.mgr.stats()["occupancy"] for r in reps))
         telemetry.gauge("serving.kv_blocks_in_use",
                         sum(r.mgr.allocator.blocks_in_use for r in reps))
+        if self.slo is not None:
+            out["slo"] = self.slo.snapshot()
         return out

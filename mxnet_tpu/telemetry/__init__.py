@@ -64,12 +64,13 @@ import time
 from .sinks import JsonlSink, read_jsonl  # noqa: F401  (re-exported)
 from . import costs    # noqa: F401  (compiled-cost registry submodule)
 from . import memwatch  # noqa: F401  (live-buffer ledger submodule)
+from . import tracing  # noqa: F401  (request-scoped tracing submodule)
 
 __all__ = ["enable", "disable", "is_enabled", "span", "count", "gauge",
            "hist", "hist_summary", "hists", "emit",
            "step", "step_begin", "step_end", "counters", "gauges",
            "phases", "reset", "current_span", "JsonlSink", "read_jsonl",
-           "costs", "memwatch"]
+           "costs", "memwatch", "tracing"]
 
 # -- state -------------------------------------------------------------------
 # _enabled is read unlocked on every recorder's fast path; it is only
@@ -457,14 +458,18 @@ def step(examples=None, **extra):
 
 # -- lifecycle ---------------------------------------------------------------
 
-def enable(jsonl_path=None, append=False, memory=True, cost=True):
+def enable(jsonl_path=None, append=False, memory=True, cost=True,
+           trace=False):
     """Turn recording on.  ``jsonl_path`` attaches a structured-log sink
     writing one JSON line per step record (truncates unless ``append``).
     Idempotent: re-enabling resets counters and swaps sinks.  ``memory``
     / ``cost`` also switch on the live-buffer ledger (``memwatch``) and
     the compiled-cost registry (``costs``) — on by default so
     ``MXNET_TELEMETRY=1`` records ``live_bytes``/``model_flops``/``mfu``
-    without further setup."""
+    without further setup.  ``trace=True`` additionally enables
+    request-scoped tracing (``tracing``) — off by default so the
+    serving A/B can hold the telemetry arm fixed; ``MXNET_TRACING=1``
+    switches it on independently."""
     global _enabled
     with _lock:
         _reset_locked()
@@ -478,6 +483,8 @@ def enable(jsonl_path=None, append=False, memory=True, cost=True):
         memwatch.enable()
     if cost:
         costs.enable()
+    if trace:
+        tracing.enable()
 
 
 def disable():
@@ -487,6 +494,7 @@ def disable():
     _enabled = False
     memwatch.disable()
     costs.disable()
+    tracing.disable()
     with _lock:
         for s in _sinks:
             s.close()

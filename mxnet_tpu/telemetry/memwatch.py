@@ -400,6 +400,14 @@ def write_postmortem(path=None, context="", error=""):
                 report["prescription"] = rx
         except Exception:
             pass  # reporting never masks the original failure
+    # the flight recorder joins the post-mortem: the last few completed
+    # request traces show WHAT the server was doing when memory blew
+    tr = sys.modules.get("mxnet_tpu.telemetry.tracing")
+    if tr is not None and tr.is_enabled():
+        try:
+            report["recent_traces"] = tr.recent(8)
+        except Exception:
+            pass
     with open(path, "w", encoding="utf-8") as f:
         json.dump(report, f, indent=2)
     return path

@@ -139,6 +139,8 @@ def test_serving_latency_bench_emits_artifact(tmp_path):
                BENCH_SERVING_MAX_BATCH="4", BENCH_SERVING_MAX_LEN="16",
                BENCH_SERVING_GEN_REQUESTS="6", BENCH_SERVING_GEN_RATE="50",
                BENCH_SERVING_GEN_RATES="50", BENCH_SERVING_GEN_MAX_NEW="4",
+               BENCH_SERVING_AB_REQUESTS="4", BENCH_SERVING_AB_MAX_NEW="8",
+               BENCH_SERVING_AB_REPEATS="2",
                MXT_SERVING_LATENCY_OUT=str(out))
     env.pop("XLA_FLAGS", None)   # the bench forces its own 8-device flag
     r = subprocess.run(
@@ -169,6 +171,10 @@ def test_serving_latency_bench_emits_artifact(tmp_path):
             assert s["completed"] == 6 and s["rejected"] == 0
             assert s["total_ms"]["p50"] <= s["total_ms"]["p99"]
             assert s["ttft_ms"]["p99"] is not None
+            # r12: TPOT percentiles + goodput-vs-SLO per rate rung
+            assert s["tpot_ms"]["p99"] is not None
+            assert 0.0 <= s["goodput_vs_slo"] <= 1.0
+            assert s["slo_met"] <= s["completed"]
             assert s["tokens_per_s_per_chip"] > 0
             assert isinstance(s["sustained"], bool)
         assert gen[eng]["kv_cache"]["occupancy"] == 0
@@ -176,6 +182,12 @@ def test_serving_latency_bench_emits_artifact(tmp_path):
     for key in ("gen_queue_wait_p99_reduced_vs_r8",
                 "gen_max_sustainable_rate_higher"):
         assert key in rec["acceptance"]
+    # r12: the tracing on/off A/B ran and reports a bounded overhead
+    ab = rec["tracing_ab"]
+    assert ab["step_ms_off"] > 0 and ab["step_ms_on"] > 0
+    assert len(ab["step_ms_off_all"]) == len(ab["step_ms_on_all"]) == 2
+    assert isinstance(ab["overhead_frac"], float)
+    assert "tracing_step_overhead_under_3pct" in rec["acceptance"]
 
 
 def test_sharded_step_bench_emits_artifact(tmp_path):

@@ -152,6 +152,18 @@ def test_recording_calls_allowed_in_hot_paths():
     assert any(v.rule == "T4" and v.context == "bad_timed" for v in vs)
 
 
+def test_tracing_calls_allowed_in_hot_paths():
+    vs = _analyze("t6_tracing.py")
+    contexts = {v.context for v in vs}
+    # tracing.incident + the same-module span helper (whose
+    # perf_counter stamp is the point) must NOT flag in the hot tick
+    assert "add_span" not in contexts
+    assert "traced_decode_tick" not in contexts
+    # a real host sync next to the span bookkeeping still flags
+    assert any(v.rule == "T1" and v.context == "bad_synced_tick"
+               for v in vs)
+
+
 def test_memwatch_hooks_allowed_in_hot_paths():
     vs = _analyze("t6_memwatch.py")
     contexts = {v.context for v in vs}

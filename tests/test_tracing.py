@@ -1,0 +1,445 @@
+"""Request-scoped tracing, the live metrics endpoint, and the SLO
+flight recorder (r12): span propagation across the three serving
+threads, /metrics scrape agreement with ``server.stats()``, automatic
+flight dumps on replica failure and overload, goodput math, and the
+near-zero disabled path."""
+import json
+import os
+import sys
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from mxnet_tpu import serving, telemetry
+from mxnet_tpu.serving import ServerConfig, ServerOverloadedError
+from mxnet_tpu.serving.metrics import SLOTracker, prometheus_text
+from mxnet_tpu.telemetry import tracing
+from mxnet_tpu.telemetry.sinks import ListSink
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+
+def _telemetry_on():
+    telemetry.enable(memory=False, cost=False, trace=True)
+    sink = ListSink()
+    telemetry.add_sink(sink)
+    return sink
+
+
+def _telemetry_off():
+    telemetry.disable()
+    telemetry.reset()
+    tracing.clear()
+
+
+# --- unit: the Trace object ------------------------------------------------
+
+def test_trace_structure_and_finish_record():
+    tracing.enable()
+    try:
+        tr = tracing.start_trace(request_id=7, tenant="acme")
+        assert tr is not None and tr.request_id == 7
+        t0 = time.perf_counter()
+        sid = tr.add("queue", t0, t0 + 0.001)
+        tr.add("prefill", t0 + 0.001, t0 + 0.002, parent=sid, replica=0)
+        tr.event("evict", slot=3)
+        with tr.span("extra"):
+            pass
+        rec = tracing.finish(tr, status="ok", lane="decode")
+        assert rec["record"] == "trace" and rec["tenant"] == "acme"
+        spans = rec["spans"]
+        assert [s["name"] for s in spans] == \
+            ["queue", "prefill", "evict", "extra", "request"]
+        root = spans[-1]
+        assert root["id"] == tr.root_id and root["parent"] is None
+        assert root["tags"] == {"lane": "decode"}
+        ids = {s["id"] for s in spans}
+        # connected: every non-root parent resolves, default parent is
+        # the root
+        for s in spans[:-1]:
+            assert s["parent"] in ids
+        by_name = {s["name"]: s for s in spans}
+        assert by_name["queue"]["parent"] == root["id"]
+        assert by_name["prefill"]["parent"] == sid
+        assert by_name["evict"]["dur_ms"] == 0.0
+        # the ring holds it for the flight recorder
+        assert tracing.recent()[-1]["trace_id"] == rec["trace_id"]
+    finally:
+        _telemetry_off()
+
+
+def test_tracing_disabled_is_inert(tmp_path):
+    """The off path: no Trace objects, no ring growth, no incident
+    dumps — the serving call sites all guard on ``req.trace is None``
+    so this is the entire disabled cost."""
+    _telemetry_off()
+    assert tracing.start_trace(request_id=1) is None
+    assert tracing.finish(None) is None
+    assert tracing.incident("overload_rejection") is None
+    assert tracing.recent() == []
+    assert not (tmp_path / "flight.json").exists()
+
+
+# --- unit: SLO goodput math -------------------------------------------------
+
+def test_slo_tracker_goodput_math():
+    s = SLOTracker({"ttft_ms": 100.0, "tpot_ms": 10.0}, window=4)
+    # flat targets land on the "default" tenant
+    assert s.target_for(None) == {"ttft_ms": 100.0, "tpot_ms": 10.0}
+    assert s.observe(ttft_ms=50.0, tpot_ms=5.0) is True
+    assert s.observe(ttft_ms=150.0, tpot_ms=5.0) is False
+    assert s.observe(ttft_ms=50.0, tpot_ms=50.0) is False
+    assert s.goodput() == pytest.approx(1 / 3)
+    # rolling window forgets the old misses
+    for _ in range(4):
+        s.observe(ttft_ms=1.0, tpot_ms=1.0)
+    snap = s.snapshot()["tenants"]["default"]
+    assert snap["window_goodput"] == 1.0
+    assert snap["total"] == 7 and snap["goodput"] == pytest.approx(5 / 7)
+
+    # per-tenant targets + unknown tenant falls back to default
+    m = SLOTracker({"default": {"ttft_ms": 10.0},
+                    "gold": {"ttft_ms": 1.0}})
+    assert m.observe(tenant="gold", ttft_ms=5.0) is False
+    assert m.observe(tenant="bronze", ttft_ms=5.0) is True
+    # a metric the target doesn't name is not judged
+    assert m.observe(tenant="gold", tpot_ms=99.0) is None
+
+
+# --- unit: Prometheus text rendering ---------------------------------------
+
+def test_prometheus_text_labels_and_types():
+    telemetry.enable(memory=False, cost=False)
+    try:
+        telemetry.count("serving.completed", 3)
+        telemetry.count("serving.completed|replica=1", 2)
+        telemetry.hist("serving.ttft_ms|replica=1", 4.0)
+        telemetry.hist("serving.ttft_ms|replica=1", 8.0)
+        txt = prometheus_text(extra_gauges={"serving.queue_depth": 5})
+        lines = txt.strip().splitlines()
+        # exposition format: every non-comment line is  name{labels} value
+        for ln in lines:
+            if ln.startswith("#"):
+                assert ln.startswith("# TYPE mxt_")
+                continue
+            name, value = ln.rsplit(" ", 1)
+            float(value)
+            assert name.startswith("mxt_")
+        assert "mxt_serving_completed_total 3" in lines
+        assert 'mxt_serving_completed_total{replica="1"} 2' in lines
+        assert "mxt_serving_queue_depth 5" in lines
+        assert 'mxt_serving_ttft_ms{quantile="0.5",replica="1"} 4' \
+            in lines
+        assert 'mxt_serving_ttft_ms_count{replica="1"} 2' in lines
+        assert 'mxt_serving_ttft_ms_sum{replica="1"} 12' in lines
+    finally:
+        _telemetry_off()
+
+
+# --- acceptance: one trace across the three lane threads (dp2) --------------
+
+def test_generative_trace_tree_metrics_endpoint_dp2():
+    """THE r12 acceptance path: a dp2 CPU-mesh paged server with
+    tracing on yields one connected span tree per request spanning
+    queue → prefill → handoff → >=2 decode steps across >=2 threads;
+    the live /metrics scrape parses as Prometheus text and agrees with
+    ``server.stats()``; /healthz and /requests respond; and
+    tools/trace_report.py renders the tree + chrome trace."""
+    import jax
+    from jax.sharding import Mesh
+    from mxnet_tpu.models.llama import llama_tiny
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs 2 devices (dp2)")
+    import trace_report
+
+    net = llama_tiny()
+    net.initialize()
+    rs = np.random.RandomState(5)
+    prompts = [rs.randint(1, 250, size=n) for n in (5, 9, 12, 7)]
+    mesh = Mesh(np.array(jax.devices()[:2]), ("dp",))
+    cfg = ServerConfig(max_batch=2, max_length=64, min_length=8,
+                       num_slots=2, summary_every=4, http_port=0,
+                       slo={"acme": {"ttft_ms": 1e6, "tpot_ms": 1e6}})
+    sink = _telemetry_on()
+    try:
+        srv = serving.GenerativeServer(net, cfg, mesh=mesh)
+        with srv:
+            url = srv.metrics_url
+            assert url is not None
+            futs = [srv.submit(p, max_new_tokens=6, tenant="acme")
+                    for p in prompts]
+            for f in futs:
+                f.result(120)
+            mtxt = urllib.request.urlopen(url + "/metrics").read() \
+                .decode()
+            health = json.loads(
+                urllib.request.urlopen(url + "/healthz").read())
+            reqs = json.loads(
+                urllib.request.urlopen(url + "/requests").read())
+            stats = srv.stats()
+        assert srv.metrics_url is None   # endpoint dies with the server
+
+        # -- the span tree ----------------------------------------------------
+        traces = [r for r in sink.records if r.get("record") == "trace"]
+        assert len(traces) == 4
+        for t in traces:
+            assert t["status"] == "ok" and t["tenant"] == "acme"
+            names = [s["name"] for s in t["spans"]]
+            for need in ("queue", "prefill", "handoff", "evict",
+                         "request"):
+                assert need in names
+            assert names.count("decode.step") >= 2
+            assert len({s["thread"] for s in t["spans"]}) >= 2
+            ids = {s["id"] for s in t["spans"]}
+            root = [s for s in t["spans"] if s["parent"] is None]
+            assert len(root) == 1 and root[0]["name"] == "request"
+            for s in t["spans"]:
+                if s["parent"] is not None:
+                    assert s["parent"] in ids   # connected tree
+            pre = next(s for s in t["spans"] if s["name"] == "prefill")
+            assert pre["tags"]["replica"] in (0, 1)
+            assert "slot" in pre["tags"] and "kv_blocks" in pre["tags"]
+            step = next(s for s in t["spans"]
+                        if s["name"] == "decode.step")
+            assert step["tags"]["batch"] >= 1
+
+        # -- request records carry the r12 fields -----------------------------
+        recs = [r for r in sink.records
+                if r.get("record") == "serving.request"]
+        assert len(recs) == 4
+        for r in recs:
+            assert r["status"] == "ok" and r["lane"] == "decode"
+            assert r["replica"] in (0, 1)
+            assert r["trace_id"] in {t["trace_id"] for t in traces}
+            assert r["tpot_ms"] > 0 and r["ttft_ms"] > 0
+            assert r["slo_met"] is True
+        # labeled per-replica histograms exist alongside the global ones
+        hists = telemetry.hists()
+        assert "serving.ttft_ms" in hists and "serving.tpot_ms" in hists
+        assert any(h.startswith("serving.ttft_ms|replica=")
+                   for h in hists)
+
+        # -- /metrics agreement with stats() ----------------------------------
+        lines = [ln for ln in mtxt.splitlines() if ln]
+        for ln in lines:
+            if not ln.startswith("#"):
+                float(ln.rsplit(" ", 1)[1])     # parses as exposition
+        done = next(ln for ln in lines
+                    if ln.startswith("mxt_serving_completed_total "))
+        assert int(float(done.rsplit(" ", 1)[1])) == stats["completed"]
+        assert any(ln.startswith("mxt_serving_kv_occupancy")
+                   for ln in lines)
+        assert any('tenant="acme"' in ln for ln in lines)  # goodput
+
+        # -- /healthz + /requests ---------------------------------------------
+        assert health["status"] == "ok"
+        assert len(health["replicas"]) == 2
+        for rep in health["replicas"]:
+            assert rep["prefill_alive"] and rep["decode_alive"]
+            assert "kv_utilization" in rep
+        assert isinstance(reqs, list)   # likely drained already
+
+        # -- stats slo block ---------------------------------------------------
+        slo = stats["slo"]["tenants"]["acme"]
+        assert slo["total"] == 4 and slo["window_goodput"] == 1.0
+
+        # -- trace_report renders stream + chrome ------------------------------
+        t = traces[0]
+        text = trace_report.render_tree(t)
+        assert t["trace_id"] in text and "decode.step" in text
+        roots = trace_report.build_tree(t)
+        assert len(roots) == 1
+        assert {c["span"]["name"] for c in roots[0]["children"]} >= \
+            {"queue", "prefill", "handoff", "decode.step", "evict"}
+        chrome = trace_report.chrome_trace(traces)
+        evs = [e for e in chrome["traceEvents"] if e["ph"] == "X"]
+        assert len(evs) == sum(len(t["spans"]) for t in traces)
+        assert all(e["dur"] >= 0 and "trace_id" in e["args"]
+                   for e in evs)
+    finally:
+        _telemetry_off()
+
+
+# --- flight recorder: automatic dumps ---------------------------------------
+
+def test_flight_recorder_dump_on_replica_failure(tmp_path,
+                                                 monkeypatch):
+    """An injected prefill exception fails the request (future raises,
+    ``status="error"`` record with replica+lane) and triggers one
+    flight-recorder dump."""
+    from mxnet_tpu.models.llama import llama_tiny
+
+    dump_path = tmp_path / "flight.json"
+    monkeypatch.setenv("MXNET_TRACE_DUMP", str(dump_path))
+    net = llama_tiny()
+    net.initialize()
+    sink = _telemetry_on()
+    try:
+        cfg = ServerConfig(max_batch=2, max_length=64, min_length=8,
+                           num_slots=2, summary_every=1 << 30)
+        srv = serving.GenerativeServer(net, cfg)
+        with srv:
+            # one good request fills the ring so the dump has content
+            srv.generate(np.arange(1, 6, dtype=np.int32),
+                         max_new_tokens=2)
+
+            def boom(*a, **k):
+                raise RuntimeError("injected prefill failure")
+
+            monkeypatch.setattr(srv.replicas[0].engine,
+                                "prefill_rows", boom)
+            fut = srv.submit(np.arange(1, 8, dtype=np.int32),
+                             max_new_tokens=2)
+            with pytest.raises(RuntimeError, match="injected"):
+                fut.result(60)
+        assert dump_path.exists()
+        report = json.loads(dump_path.read_text())
+        assert report["record"] == "flight_recorder"
+        assert report["reason"] == "replica_exception"
+        assert report["context"]["lane"] == "prefill"
+        assert report["context"]["replica"] == 0
+        assert len(report["traces"]) >= 1   # the good request's trace
+        # the failed request still landed in the JSONL stream, tagged
+        errs = [r for r in sink.records
+                if r.get("record") == "serving.request"
+                and r.get("status") == "error"]
+        assert len(errs) == 1
+        assert errs[0]["lane"] == "prefill" and errs[0]["replica"] == 0
+        assert "injected" in errs[0]["error"]
+        # ... and its sealed trace reports the error status
+        bad = [t for t in sink.records if t.get("record") == "trace"
+               and t.get("status") == "error"]
+        assert len(bad) == 1
+        assert srv.replicas[0].failed == 1
+    finally:
+        _telemetry_off()
+
+
+def test_flight_recorder_dump_on_overload(tmp_path, monkeypatch):
+    """Queue-full rejection emits a tagged ``status="rejected"`` record
+    and dumps the flight record (rate-limited: an overload storm writes
+    once)."""
+    dump_path = tmp_path / "flight.json"
+    monkeypatch.setenv("MXNET_TRACE_DUMP", str(dump_path))
+
+    def slow_model(batch):
+        time.sleep(0.2)
+        return [batch["data"]]
+
+    sink = _telemetry_on()
+    try:
+        cfg = ServerConfig(max_batch=1, max_length=16, min_length=8,
+                           queue_capacity=1, batch_window_ms=0.0)
+        srv = serving.InferenceServer(slow_model, cfg)
+        with srv:
+            rejected = 0
+            for _ in range(8):
+                try:
+                    srv.submit(np.zeros((4, 3), np.float32))
+                except ServerOverloadedError:
+                    rejected += 1
+            assert rejected >= 1
+        assert dump_path.exists()
+        report = json.loads(dump_path.read_text())
+        assert report["reason"] == "overload_rejection"
+        assert report["context"]["queue_capacity"] == 1
+        rej = [r for r in sink.records
+               if r.get("record") == "serving.request"
+               and r.get("status") == "rejected"]
+        assert len(rej) == rejected
+        assert all(r["lane"] == "queue" and "trace_id" in r
+                   for r in rej)
+        # rejected traces are sealed with the rejected status
+        sealed = [t for t in sink.records if t.get("record") == "trace"
+                  and t.get("status") == "rejected"]
+        assert len(sealed) == rejected
+        # rate limit: one dump despite several rejections
+        assert telemetry.counters().get("tracing.flight_dump") == 1
+    finally:
+        _telemetry_off()
+
+
+def test_memwatch_postmortem_embeds_recent_traces(tmp_path):
+    """The OOM post-mortem joins the flight recorder: when tracing is
+    on, ``write_postmortem`` embeds the recent completed traces."""
+    from mxnet_tpu.telemetry import memwatch
+
+    tracing.enable()
+    try:
+        tr = tracing.start_trace(request_id=9)
+        tracing.finish(tr, status="ok")
+        path = memwatch.write_postmortem(
+            path=str(tmp_path / "oom.json"), context="test",
+            error="RESOURCE_EXHAUSTED")
+        report = json.loads(open(path).read())
+        assert [t["request_id"] for t in report["recent_traces"]] == [9]
+    finally:
+        _telemetry_off()
+
+
+# --- trace_report CLI --------------------------------------------------------
+
+def test_trace_report_cli_roundtrip(tmp_path):
+    """load_traces reads both a JSONL stream and a flight dump; the CLI
+    selects by trace id and emits tree/chrome formats."""
+    import subprocess
+
+    import trace_report
+
+    tracing.enable()
+    telemetry.enable(memory=False, cost=False)
+    sink = ListSink()
+    telemetry.add_sink(sink)
+    try:
+        for rid in (1, 2):
+            tr = tracing.start_trace(request_id=rid)
+            t0 = time.perf_counter()
+            tr.add("queue", t0, t0 + 0.001)
+            tr.add("decode.step", t0 + 0.001, t0 + 0.002, step=1)
+            tracing.finish(tr, status="ok")
+        stream = tmp_path / "stream.jsonl"
+        with open(stream, "w") as f:
+            for r in sink.records:
+                f.write(json.dumps(r) + "\n")
+        dump = tracing.dump(path=str(tmp_path / "dump.json"),
+                            reason="test")
+
+        got = trace_report.load_traces(str(stream))
+        assert [t["request_id"] for t in got] == [1, 2]
+        from_dump = trace_report.load_traces(dump)
+        assert [t["request_id"] for t in from_dump] == [1, 2]
+        tid = got[0]["trace_id"]
+        assert [t["trace_id"] for t in
+                trace_report.select(got, trace_id=tid)] == [tid]
+        assert [t["request_id"] for t in
+                trace_report.select(got, request_id=2)] == [2]
+
+        out = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "tools", "trace_report.py"),
+             str(stream), "--trace-id", tid],
+            capture_output=True, text=True, timeout=120)
+        assert out.returncode == 0 and tid in out.stdout
+        chrome_out = tmp_path / "chrome.json"
+        out = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "tools", "trace_report.py"),
+             str(stream), "--format", "chrome", "--out",
+             str(chrome_out)],
+            capture_output=True, text=True, timeout=120)
+        assert out.returncode == 0
+        doc = json.loads(chrome_out.read_text())
+        assert {e["ph"] for e in doc["traceEvents"]} == {"M", "X"}
+        # no-match exits 1
+        out = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "tools", "trace_report.py"),
+             str(stream), "--trace-id", "nope"],
+            capture_output=True, text=True, timeout=120)
+        assert out.returncode == 1
+    finally:
+        _telemetry_off()

@@ -1,0 +1,46 @@
+"""T6 fixture: request-tracing span recording in serving hot paths.
+
+The r12 decode tick records retroactive spans (``trace.add``) and the
+failure paths call ``tracing.incident`` — both host-side dict/list work
+behind the ``trace is not None`` guard.  The analyzer must (a) not flag
+``tracing.*`` calls in hot dispatch code, (b) not let hotness leak into
+a same-module span helper (whose perf_counter stamp is the point),
+while (c) still flagging a real host sync sitting next to the span
+bookkeeping.
+"""
+import time
+
+import jax
+import numpy as np
+
+from mxnet_tpu.telemetry import tracing
+
+
+def add_span(trace, t0, step):
+    # same-module recording helper: the perf_counter read (the span's
+    # closing stamp) is host-side by design — hotness must NOT leak in
+    # through the bare-name call in traced_decode_tick below
+    trace.add("decode.step", t0, time.perf_counter(), step=step)
+
+
+def traced_decode_tick(engine, active, trace, t0):
+    out = engine.step(active)
+    if trace is not None:
+        add_span(trace, t0, engine.steps)                # ok: helper
+        tracing.incident("replica_exception",            # ok: tracing.*
+                         context={"step": engine.steps})
+    return out
+
+
+traced_decode_tick_jit = jax.jit(traced_decode_tick, static_argnums=0)
+
+
+def bad_synced_tick(engine, active, trace):
+    out = engine.step(active)
+    host = np.asarray(out)             # T1 error: sync in the hot tick
+    if trace is not None:
+        trace.event("evict", value=host[0])
+    return host
+
+
+bad_synced_tick_jit = jax.jit(bad_synced_tick, static_argnums=0)

@@ -54,6 +54,10 @@ RECORDING_SAFE_CALLEES = {
     # arithmetic and registry bookkeeping — never a device sync, and
     # guarded by one-boolean flags outside traces
     "track", "donated", "adopt", "step_mark", "annotate_oom", "note",
+    # request tracing + SLO accounting (r12, telemetry.tracing /
+    # serving.metrics): retroactive span appends from perf_counter
+    # stamps and rolling goodput counters — host-side by contract
+    "start_trace", "finish", "incident", "add_span", "observe",
 }
 
 _FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)

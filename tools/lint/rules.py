@@ -112,7 +112,14 @@ RECORDING_HEADS = {"telemetry", "profiler", "prof",
                    # telemetry.costs, conventionally imported as _mw /
                    # _costs): ledger and registry updates are host-side
                    # arithmetic behind one-boolean flags — never a sync
-                   "memwatch", "costs", "_mw", "_costs"}
+                   "memwatch", "costs", "_mw", "_costs",
+                   # r12 request tracing + the serving metrics endpoint
+                   # (telemetry.tracing / serving.metrics): span records
+                   # are retroactive dict/list appends from perf_counter
+                   # stamps the lanes already take, and the scrape
+                   # renderer reads telemetry snapshots — host-side by
+                   # contract, never a device sync
+                   "tracing", "_tracing", "metrics"}
 
 
 def _is_recording_call(dotted: str) -> bool:

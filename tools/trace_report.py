@@ -1,0 +1,226 @@
+"""Reconstruct and render per-request span trees from trace records.
+
+The serving tracer (``mxnet_tpu.telemetry.tracing``) emits ONE record
+per completed request — ``{"record": "trace", "trace_id": ..., "spans":
+[...]}`` — into the telemetry JSONL stream, and keeps a bounded ring of
+the most recent ones that the flight recorder dumps on incidents
+(overload rejection, replica exception, OOM).  This tool joins both
+sources back into something a human (or Perfetto) can read:
+
+    # list every trace in a stream / flight dump
+    python tools/trace_report.py telemetry.jsonl --list
+
+    # one request's span tree, ASCII
+    python tools/trace_report.py telemetry.jsonl --trace-id 3f2a-000007
+
+    # ... selected by request id instead
+    python tools/trace_report.py flight_record_1234.json --request-id 42
+
+    # chrome://tracing / Perfetto JSON for every selected trace
+    python tools/trace_report.py telemetry.jsonl --format chrome \
+        --out trace.json
+
+Input may be a telemetry JSONL stream (any mix of records; only
+``record == "trace"`` lines are used) or a flight-recorder dump
+(``{"record": "flight_recorder", "traces": [...]}``).  The functions
+(`load_traces`, `build_tree`, `render_tree`, `chrome_trace`) are
+importable for tests and notebooks.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def load_traces(path):
+    """Every trace record in ``path`` — a telemetry JSONL stream or a
+    flight-recorder dump — in file order."""
+    with open(path, "r", encoding="utf-8") as f:
+        head = f.read(1)
+        f.seek(0)
+        if head == "{":
+            # maybe a single JSON document (flight dump); a JSONL
+            # stream of dicts also starts with "{" so fall back on
+            # parse failure
+            try:
+                doc = json.load(f)
+            except json.JSONDecodeError:
+                f.seek(0)
+            else:
+                if doc.get("record") == "flight_recorder":
+                    return list(doc.get("traces", []))
+                return [doc] if doc.get("record") == "trace" else []
+        out = []
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(rec, dict) and rec.get("record") == "trace":
+                out.append(rec)
+    return out
+
+
+def select(traces, trace_id=None, request_id=None):
+    """Filter by trace id and/or request id (None = keep all)."""
+    out = traces
+    if trace_id is not None:
+        out = [t for t in out if t.get("trace_id") == trace_id]
+    if request_id is not None:
+        out = [t for t in out if t.get("request_id") == int(request_id)]
+    return out
+
+
+def build_tree(trace):
+    """The span forest of one trace record: a list of root nodes, each
+    ``{"span": <span dict>, "children": [...]}`` ordered by start
+    time.  Orphans (parent id never emitted — a lane died mid-request)
+    surface as extra roots rather than vanishing."""
+    spans = trace.get("spans", [])
+    nodes = {s["id"]: {"span": s, "children": []} for s in spans}
+    roots = []
+    for s in sorted(spans, key=lambda s: (s.get("ts", 0.0), s["id"])):
+        parent = s.get("parent")
+        node = nodes[s["id"]]
+        if parent is not None and parent in nodes and parent != s["id"]:
+            nodes[parent]["children"].append(node)
+        else:
+            roots.append(node)
+    return roots
+
+
+def _fmt_tags(span):
+    tags = dict(span.get("tags") or {})
+    thread = span.get("thread")
+    if thread:
+        tags["thread"] = thread
+    if not tags:
+        return ""
+    body = ", ".join(f"{k}={tags[k]}" for k in sorted(tags))
+    return f"  [{body}]"
+
+
+def render_tree(trace, out=None):
+    """ASCII span tree, times relative to the trace's t0."""
+    lines = []
+    t0 = trace.get("t0", 0.0)
+    header = (f"trace {trace.get('trace_id')}  "
+              f"request={trace.get('request_id')}  "
+              f"status={trace.get('status')}  "
+              f"total={trace.get('total_ms', 0.0):.3f}ms")
+    if trace.get("tenant") is not None:
+        header += f"  tenant={trace['tenant']}"
+    lines.append(header)
+
+    def walk(node, prefix, last):
+        s = node["span"]
+        rel_ms = (s.get("ts", t0) - t0) * 1e3
+        stem = "" if prefix is None else prefix + ("`-- " if last
+                                                   else "|-- ")
+        lines.append(f"{stem}{s['name']}  +{rel_ms:.3f}ms "
+                     f"({s.get('dur_ms', 0.0):.3f}ms){_fmt_tags(s)}")
+        kids = node["children"]
+        child_prefix = "" if prefix is None else \
+            prefix + ("    " if last else "|   ")
+        for i, kid in enumerate(kids):
+            walk(kid, child_prefix, i == len(kids) - 1)
+
+    for i, root in enumerate(build_tree(trace)):
+        walk(root, None, i == len(build_tree(trace)) - 1)
+    text = "\n".join(lines)
+    if out is not None:
+        out.write(text + "\n")
+    return text
+
+
+def chrome_trace(traces):
+    """chrome://tracing / Perfetto "trace event" JSON for the selected
+    traces: one pid per trace, one tid per originating thread, complete
+    ("X") events with microsecond timestamps relative to each trace's
+    t0."""
+    events = []
+    for pid, trace in enumerate(traces, start=1):
+        t0 = trace.get("t0", 0.0)
+        tids = {}
+        events.append({"ph": "M", "pid": pid, "tid": 0,
+                       "name": "process_name",
+                       "args": {"name": f"trace "
+                                f"{trace.get('trace_id')} req "
+                                f"{trace.get('request_id')}"}})
+        for s in trace.get("spans", []):
+            thread = s.get("thread") or "main"
+            if thread not in tids:
+                tids[thread] = len(tids) + 1
+                events.append({"ph": "M", "pid": pid,
+                               "tid": tids[thread],
+                               "name": "thread_name",
+                               "args": {"name": thread}})
+            args = dict(s.get("tags") or {})
+            args["trace_id"] = trace.get("trace_id")
+            args["request_id"] = trace.get("request_id")
+            events.append({
+                "ph": "X", "cat": "trace", "name": s["name"],
+                "pid": pid, "tid": tids[thread],
+                "ts": (s.get("ts", t0) - t0) * 1e6,
+                "dur": s.get("dur_ms", 0.0) * 1e3,
+                "args": args,
+            })
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="render serving request traces from a telemetry "
+        "JSONL stream or a flight-recorder dump")
+    ap.add_argument("path", help="telemetry JSONL or flight dump JSON")
+    ap.add_argument("--trace-id", default=None,
+                    help="render only this trace id")
+    ap.add_argument("--request-id", default=None, type=int,
+                    help="render only this request id")
+    ap.add_argument("--list", action="store_true",
+                    help="one summary line per trace, no tree")
+    ap.add_argument("--format", choices=("tree", "chrome"),
+                    default="tree")
+    ap.add_argument("--out", default=None,
+                    help="write here instead of stdout")
+    args = ap.parse_args(argv)
+
+    traces = select(load_traces(args.path), trace_id=args.trace_id,
+                    request_id=args.request_id)
+    if not traces:
+        print("no matching trace records", file=sys.stderr)
+        return 1
+    sink = open(args.out, "w", encoding="utf-8") if args.out \
+        else sys.stdout
+    try:
+        if args.list:
+            for t in traces:
+                print(f"{t.get('trace_id')}  request="
+                      f"{t.get('request_id')}  "
+                      f"status={t.get('status')}  "
+                      f"spans={len(t.get('spans', []))}  "
+                      f"total={t.get('total_ms', 0.0):.3f}ms",
+                      file=sink)
+        elif args.format == "chrome":
+            json.dump(chrome_trace(traces), sink, indent=1)
+            sink.write("\n")
+        else:
+            for t in traces:
+                render_tree(t, out=sink)
+                print(file=sink)
+    finally:
+        if sink is not sys.stdout:
+            sink.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
