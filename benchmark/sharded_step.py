@@ -27,6 +27,15 @@ does)::
 
 Artifact: SHARDED_STEP_r09.json (override MXT_SHARDED_STEP_OUT).
 Acceptance: for each model, dp×tp per-device peak live bytes < dp-only.
+
+``--fleet-overhead`` runs the r13 fleet-observability A/B lane instead:
+the mlp dp8 lane with the fleet layer off / stride 16 / stride 1
+(medians are informational — CPU step times are too noisy to resolve a
+sub-1% delta), plus a microbench of the actual per-step hook
+(``fleet.on_step_record``) whose cost, expressed against the fleet-off
+median step time, is the acceptance number.  Artifact:
+FLEET_OVERHEAD_r13.json (override MXT_FLEET_OVERHEAD_OUT).
+Acceptance: hook cost at stride 16 < 1% of the median step time.
 """
 from __future__ import annotations
 
@@ -159,6 +168,122 @@ def _run_lane(build, mesh_axes):
     return record
 
 
+def _fleet_lane(stride):
+    """Median mlp dp8 step time with the fleet layer off (``stride``
+    None) or exchanging at ``stride``.  Also reports how many fleet
+    exchanges ran and the last exchange's wall cost."""
+    from mxnet_tpu import autograd, gluon, nd, parallel, telemetry
+
+    telemetry.enable()
+    if stride:
+        telemetry.fleet.enable(stride=stride)
+    try:
+        net, rules, batches, step_fn = _build_mlp()
+        mesh = parallel.make_mesh({"dp": 8})
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.01},
+                                partition_rules=rules, mesh=mesh)
+        batches = tuple(parallel.shard_batch(b, mesh) for b in batches)
+        times = []
+        for i in range(WARMUP + STEPS):
+            with telemetry.step(examples=batches[0].shape[0]) as scope:
+                loss = step_fn(net, trainer, batches, autograd)
+                loss.wait_to_read()
+                nd.waitall()
+            if i >= WARMUP:
+                times.append(scope.record["step_ms"])
+        exchange_ms = telemetry.gauges().get("fleet.exchange_ms")
+        record = {
+            "stride": stride or 0,
+            "step_ms_median": round(statistics.median(times), 3),
+            "fleet_exchanges": telemetry.counters().get("fleet.exchange", 0),
+            "last_exchange_ms": round(exchange_ms, 4)
+            if exchange_ms is not None else None,
+        }
+    finally:
+        telemetry.disable()
+        telemetry.fleet.clear()
+        parallel.set_mesh(None)
+        gc.collect()
+    return record
+
+
+def _hook_cost_ms(stride, iters=4096):
+    """Per-step wall cost of ``fleet.on_step_record`` itself — the only
+    code the fleet layer adds to a training step — over ``iters``
+    step-shaped records crossing stride boundaries."""
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.telemetry import fleet
+
+    telemetry.enable()
+    fleet.enable(stride=stride)
+    base = {"step_ms": 5.0, "examples_per_sec": 1000.0,
+            "peak_live_bytes": 1 << 20, "loss": 0.5,
+            "counters": {"trainer.allreduce_wait_ms": 1.0}}
+    try:
+        t0 = time.perf_counter()
+        for i in range(1, iters + 1):
+            rec = dict(base)
+            rec["step"] = i
+            fleet.on_step_record(rec)
+        total_ms = (time.perf_counter() - t0) * 1e3
+    finally:
+        telemetry.disable()
+        fleet.clear()
+    return total_ms / iters
+
+
+def main_fleet_overhead():
+    plat = os.environ.get("BENCH_PLATFORM")
+    if plat:
+        import jax
+
+        jax.config.update("jax_platforms", plat)
+    import jax
+
+    import mxnet_tpu as mx
+
+    n = jax.device_count()
+    if n < 8:
+        raise SystemExit(f"sharded_step needs >= 8 devices, have {n} "
+                         "(set XLA_FLAGS=--xla_force_host_platform_"
+                         "device_count=8)")
+    mx.random.seed(0)
+    t0 = time.time()
+    lanes = {"off": _fleet_lane(None),
+             "stride16": _fleet_lane(16),
+             "stride1": _fleet_lane(1)}
+    hook_ms_16 = _hook_cost_ms(16)
+    hook_ms_1 = _hook_cost_ms(1)
+    off_ms = lanes["off"]["step_ms_median"]
+    overhead_pct = hook_ms_16 / off_ms * 100.0 if off_ms else 0.0
+    record = {
+        "metric": "fleet_overhead_pct_stride16",
+        "value": round(overhead_pct, 4),
+        "unit": "% of fleet-off median step time "
+                "(per-step on_step_record cost at stride 16)",
+        "n_devices": n,
+        "lanes": lanes,
+        "hook_ms_stride16": round(hook_ms_16, 6),
+        "hook_ms_stride1": round(hook_ms_1, 6),
+        "exchange_ms_stride1": lanes["stride1"]["last_exchange_ms"],
+        "acceptance": {"fleet_overhead_under_1pct": overhead_pct < 1.0},
+        "wall_sec": round(time.time() - t0, 1),
+        "platform": os.environ.get("JAX_PLATFORMS", plat or "default"),
+    }
+    line = json.dumps(record, indent=2, default=str)
+    print(line)
+    out_path = os.environ.get(
+        "MXT_FLEET_OVERHEAD_OUT",
+        os.path.join(os.path.dirname(__file__), "..",
+                     "FLEET_OVERHEAD_r13.json"))
+    with open(out_path, "w") as f:
+        f.write(line + "\n")
+    if not record["acceptance"]["fleet_overhead_under_1pct"]:
+        raise SystemExit(f"acceptance failed: fleet hook costs "
+                         f"{overhead_pct:.3f}% of a step (>= 1%)")
+
+
 def main():
     plat = os.environ.get("BENCH_PLATFORM")
     if plat:
@@ -220,4 +345,7 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    if "--fleet-overhead" in sys.argv[1:]:
+        main_fleet_overhead()
+    else:
+        main()

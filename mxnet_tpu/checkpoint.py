@@ -424,6 +424,11 @@ def drain_checkpoint_and_exit(ckpt_dir, step, net, trainer=None, extra=None,
     wait_async()
     save_checkpoint(ckpt_dir, step, net, trainer, extra=extra, keep=keep)
     telemetry.count("trainer.drain_checkpoint")
+    # the training flight recorder captures the drain: the dump shows
+    # what the fleet was doing in the last N steps before the preemption
+    fl = sys.modules.get("mxnet_tpu.telemetry.fleet")
+    if fl is not None and fl.is_enabled():
+        fl.incident("preemption_drain", context={"step": step})
     sys.exit(_trainer_mod.PREEMPTED_EXIT_CODE)
 
 

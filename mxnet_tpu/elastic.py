@@ -25,6 +25,7 @@ semantics.
 from __future__ import annotations
 
 import os
+import sys
 
 import numpy as np
 
@@ -110,10 +111,11 @@ def world_info():
 
     Prefers the live jax process group (after ``parallel.initialize``);
     falls back to the launcher's ``MXT_PROCESS_ID``/``MXT_NUM_PROCESSES``
-    env contract, then to a single-process ``(0, 1)``."""
-    from . import parallel
-
-    if parallel.is_initialized():
+    env contract, then to a single-process ``(0, 1)``.  The parallel
+    module is probed through ``sys.modules`` so telemetry-side callers
+    (``telemetry.fleet.world``) never trigger the jax import."""
+    parallel = sys.modules.get(__package__ + ".parallel")
+    if parallel is not None and parallel.is_initialized():
         import jax
 
         return jax.process_index(), jax.process_count()

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import signal
 import threading
+import time
 
 from ..base import MXNetError
 from .parameter import Parameter, ParameterDict
@@ -390,6 +391,18 @@ class Trainer:
     def _allreduce_grads(self):
         if self._kvstore is None:
             return
+        t0 = time.perf_counter() if telemetry.is_enabled() else None
+        try:
+            self._allreduce_grads_inner()
+        finally:
+            if t0 is not None:
+                # wall time the step spent in gradient aggregation —
+                # the fleet exchange packs this so straggler detection
+                # can split compute skew from allreduce-wait skew
+                telemetry.count("trainer.allreduce_wait_ms",
+                                (time.perf_counter() - t0) * 1e3)
+
+    def _allreduce_grads_inner(self):
         with telemetry.span("trainer.allreduce"):
             reducer = getattr(self._kvstore, "allreduce_grads", None)
             if telemetry.is_enabled() and reducer is None:

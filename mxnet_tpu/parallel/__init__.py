@@ -50,7 +50,8 @@ __all__ = ["initialize", "is_initialized", "make_mesh", "set_mesh",
            "ring_attention", "ulysses_attention", "pipeline_apply",
            "pipeline_train_1f1b", "PartitionRules", "as_rules",
            "place_params", "stacked_spec", "LLAMA_RULES", "MIXTRAL_RULES",
-           "FAMILY_RULES", "last_placement"]
+           "FAMILY_RULES", "last_placement", "process_sum_hostvec",
+           "process_gather_hostvec"]
 
 
 _STATE = threading.local()
@@ -375,6 +376,27 @@ def process_sum_hostvec(vec):
         sharding, vec.reshape(1, -1))
     out = onp.asarray(summed_fn(garr).addressable_data(0))[0]
     return out.reshape(vec.shape)
+
+
+def process_gather_hostvec(vec):
+    """Allgather a host-side 1-D numpy vector across all processes
+    (SPMD: every rank must call this with a same-sized vector); returns
+    a ``(world_size, len(vec))`` numpy matrix whose row r is rank r's
+    vector.  Built as a psum of rank-slotted zeros so it reuses the
+    memoized :func:`_process_psum` collective — no new jit machinery.
+    Single-process returns the one-row matrix with no collective.  The
+    cross-host hop of ``telemetry.fleet``'s stride exchange."""
+    import jax
+    import numpy as onp
+
+    vec = onp.asarray(vec, dtype=onp.float64).ravel()
+    n = jax.process_count()
+    if n == 1:
+        return vec.reshape(1, -1)
+    r = jax.process_index()
+    flat = onp.zeros(n * vec.size, dtype=vec.dtype)
+    flat[r * vec.size:(r + 1) * vec.size] = vec
+    return process_sum_hostvec(flat).reshape(n, vec.size)
 
 
 _PROCESS_PSUM_CACHE = {}

@@ -4,15 +4,12 @@ Three pieces, all host-side (the HTTP thread never touches a device
 buffer — it renders telemetry snapshots and ledger stats that the
 serving threads already maintain):
 
-* :func:`prometheus_text` — the telemetry module's counters, gauges and
-  ``_Reservoir`` histograms rendered as Prometheus text exposition
-  (v0.0.4).  Dotted names sanitize to ``mxt_*`` families
-  (``serving.completed`` → ``mxt_serving_completed_total``); a name of
-  the form ``base|key=value`` carries Prometheus labels, which is how
-  the per-replica latency histograms (``serving.ttft_ms|replica=1``)
-  render as one labelled family.  Histograms become summaries
-  (``quantile="0.5"/"0.9"/"0.99"`` over the rolling window, plus
-  ``_sum``/``_count`` over the all-time stream).
+* :func:`prometheus_text` — the telemetry snapshot rendered as
+  Prometheus text exposition (v0.0.4).  Since r13 the renderer lives in
+  ``telemetry.promtext`` (shared with the training-side
+  ``telemetry.fleet.MetricsEndpoint``) and is re-exported here
+  unchanged: dotted names sanitize to ``mxt_*`` families, ``|key=value``
+  suffixes carry labels, histograms render as summaries.
 * :class:`MetricsServer` — a stdlib ``http.server`` daemon thread bound
   to an owner server, exposing ``/metrics`` (the text above plus the
   owner's live gauges), ``/healthz`` (per-replica lane liveness, queue
@@ -31,105 +28,16 @@ Schema details in docs/observability.md.
 from __future__ import annotations
 
 import json
-import re
 import threading
 from collections import deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-from .. import telemetry
+from ..telemetry.promtext import (  # noqa: F401  (re-exported; hoisted r13)
+    _NAME_RE, _QUANTILES, _fmt_labels, _fmt_value, _prom_name,
+    _split_labels, prometheus_text,
+)
 
 __all__ = ["prometheus_text", "MetricsServer", "SLOTracker"]
-
-_NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
-
-#: rolling-histogram percentiles exposed as summary quantiles
-_QUANTILES = ((50, "0.5"), (90, "0.9"), (99, "0.99"))
-
-
-def _prom_name(name, prefix="mxt_"):
-    """Dotted telemetry name → Prometheus metric family name."""
-    body = _NAME_RE.sub("_", name)
-    if body and body[0].isdigit():
-        body = "_" + body
-    return prefix + body
-
-
-def _split_labels(name):
-    """``"serving.ttft_ms|replica=0,lane=decode"`` →
-    ``("serving.ttft_ms", {"replica": "0", "lane": "decode"})``."""
-    if "|" not in name:
-        return name, {}
-    base, _, rest = name.partition("|")
-    labels = {}
-    for part in rest.split(","):
-        k, _, v = part.partition("=")
-        if k:
-            labels[k.strip()] = v.strip()
-    return base, labels
-
-
-def _fmt_labels(labels, extra=None):
-    items = dict(labels)
-    if extra:
-        items.update(extra)
-    if not items:
-        return ""
-    inner = ",".join(f'{k}="{v}"' for k, v in sorted(items.items()))
-    return "{" + inner + "}"
-
-
-def _fmt_value(v):
-    try:
-        f = float(v)
-    except (TypeError, ValueError):
-        return "0"
-    if f == int(f) and abs(f) < 1e15:
-        return str(int(f))
-    return repr(f)
-
-
-def prometheus_text(extra_gauges=None):
-    """Render the telemetry module's current counters, gauges and
-    histogram summaries (plus ``extra_gauges``, a dotted-name → value
-    dict the caller wants on the same scrape) as Prometheus text."""
-    families = {}   # prom name -> {"type": ..., "samples": [(suffix, labels, value)]}
-
-    def fam(name, mtype):
-        f = families.get(name)
-        if f is None:
-            f = families[name] = {"type": mtype, "samples": []}
-        return f
-
-    for name, value in sorted(telemetry.counters().items()):
-        base, labels = _split_labels(name)
-        fam(_prom_name(base) + "_total", "counter")["samples"].append(
-            ("", labels, value))
-    gauges = dict(telemetry.gauges())
-    if extra_gauges:
-        gauges.update(extra_gauges)
-    for name, value in sorted(gauges.items()):
-        base, labels = _split_labels(name)
-        fam(_prom_name(base), "gauge")["samples"].append(("", labels, value))
-    for name, summ in sorted(telemetry.hists().items()):
-        if summ is None:
-            continue
-        base, labels = _split_labels(name)
-        f = fam(_prom_name(base), "summary")
-        for p, q in _QUANTILES:
-            val = summ.get(f"p{p}")
-            if val is not None:
-                f["samples"].append(("", dict(labels, quantile=q), val))
-        f["samples"].append(("_sum", labels,
-                             summ["mean"] * summ["count"]))
-        f["samples"].append(("_count", labels, summ["count"]))
-    lines = []
-    for name in sorted(families):
-        f = families[name]
-        lines.append(f"# TYPE {name} {f['type']}")
-        for suffix, labels, value in f["samples"]:
-            lines.append(f"{name}{suffix}{_fmt_labels(labels)} "
-                         f"{_fmt_value(value)}")
-    return "\n".join(lines) + "\n"
 
 
 # -- SLO goodput -------------------------------------------------------------

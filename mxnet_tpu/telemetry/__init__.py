@@ -65,12 +65,17 @@ from .sinks import JsonlSink, read_jsonl  # noqa: F401  (re-exported)
 from . import costs    # noqa: F401  (compiled-cost registry submodule)
 from . import memwatch  # noqa: F401  (live-buffer ledger submodule)
 from . import tracing  # noqa: F401  (request-scoped tracing submodule)
+from . import promtext  # noqa: F401  (shared Prometheus text renderer)
+from . import fleet as _fleet_mod  # fleet-wide observability submodule
+# ``enable(fleet=...)`` takes a keyword of the same name, so the module
+# itself travels under the private alias everywhere in this file
+fleet = _fleet_mod
 
 __all__ = ["enable", "disable", "is_enabled", "span", "count", "gauge",
            "hist", "hist_summary", "hists", "emit",
            "step", "step_begin", "step_end", "counters", "gauges",
            "phases", "reset", "current_span", "JsonlSink", "read_jsonl",
-           "costs", "memwatch", "tracing"]
+           "costs", "memwatch", "tracing", "promtext", "fleet"]
 
 # -- state -------------------------------------------------------------------
 # _enabled is read unlocked on every recorder's fast path; it is only
@@ -161,9 +166,20 @@ class _Span:
                     _step_phases.get(self.name, 0.0) + dur
         prof = _active_profiler()
         if prof is not None:
+            args = self.attrs
+            if _fleet_mod._enabled:
+                # rank-aware spans: merged trace timelines can tell the
+                # ranks apart (fleet annotation never raises)
+                try:
+                    r, n = _fleet_mod.world()
+                    args = dict(args) if args else {}
+                    args["rank"] = r
+                    args["world_size"] = n
+                except Exception:
+                    pass
             prof.record_span_event(
                 prof.current_scope_prefix() + self.name, self.t0, dur,
-                cat="telemetry", args=self.attrs)
+                cat="telemetry", args=args)
         return False
 
 
@@ -424,6 +440,12 @@ def step_end(examples=None, **extra):
                 pass  # telemetry never raises into training
         record.update(extra)
         sinks = list(_sinks)
+    if _fleet_mod._enabled:
+        # annotates the record with rank/world_size IN PLACE before the
+        # sinks see it, feeds the flight recorder, runs the watchdog and
+        # (at the stride) the fleet exchange.  Never raises except the
+        # opt-in WatchdogHalt, which surfaces here at a step boundary.
+        _fleet_mod.on_step_record(record)
     for s in sinks:
         s.emit(record)
     return record
@@ -459,7 +481,7 @@ def step(examples=None, **extra):
 # -- lifecycle ---------------------------------------------------------------
 
 def enable(jsonl_path=None, append=False, memory=True, cost=True,
-           trace=False):
+           trace=False, fleet=False):
     """Turn recording on.  ``jsonl_path`` attaches a structured-log sink
     writing one JSON line per step record (truncates unless ``append``).
     Idempotent: re-enabling resets counters and swaps sinks.  ``memory``
@@ -469,7 +491,11 @@ def enable(jsonl_path=None, append=False, memory=True, cost=True,
     without further setup.  ``trace=True`` additionally enables
     request-scoped tracing (``tracing``) — off by default so the
     serving A/B can hold the telemetry arm fixed; ``MXNET_TRACING=1``
-    switches it on independently."""
+    switches it on independently.  ``fleet=True`` enables the
+    fleet-wide layer (rank-aware records, straggler/anomaly watchdog,
+    training flight recorder) with its env-default knobs — call
+    ``telemetry.fleet.enable(...)`` directly for tuned thresholds;
+    ``MXNET_FLEET=1`` switches it on independently."""
     global _enabled
     with _lock:
         _reset_locked()
@@ -485,6 +511,8 @@ def enable(jsonl_path=None, append=False, memory=True, cost=True,
         costs.enable()
     if trace:
         tracing.enable()
+    if fleet:
+        _fleet_mod.enable()
 
 
 def disable():
@@ -495,6 +523,7 @@ def disable():
     memwatch.disable()
     costs.disable()
     tracing.disable()
+    _fleet_mod.disable()
     with _lock:
         for s in _sinks:
             s.close()

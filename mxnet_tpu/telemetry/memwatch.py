@@ -408,6 +408,14 @@ def write_postmortem(path=None, context="", error=""):
             report["recent_traces"] = tr.recent(8)
         except Exception:
             pass
+    # ...and the training flight recorder: the last step records +
+    # anomaly events before the OOM, rank-stamped (telemetry.fleet)
+    fl = sys.modules.get("mxnet_tpu.telemetry.fleet")
+    if fl is not None and fl.is_enabled():
+        try:
+            report["recent_steps"] = fl.recent(16)
+        except Exception:
+            pass
     with open(path, "w", encoding="utf-8") as f:
         json.dump(report, f, indent=2)
     return path

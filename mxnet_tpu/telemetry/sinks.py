@@ -16,6 +16,7 @@ phase spans.
 """
 from __future__ import annotations
 
+import glob as _glob
 import json
 import threading
 
@@ -90,7 +91,33 @@ def read_jsonl(path):
     A truncated FINAL line (the writer died mid-record) is tolerated:
     the complete records are returned with ``.truncated = True`` instead
     of raising ``json.JSONDecodeError``.  Corruption anywhere else in
-    the file still raises — that is data loss, not a crash artifact."""
+    the file still raises — that is data loss, not a crash artifact.
+
+    ``path`` may also be a list/tuple of paths or a glob pattern
+    (``"out/rank*.jsonl"``): each stream is read as above, then the
+    streams are stable-merged sorted by ``(step, rank)`` so per-rank
+    logs from one run interleave into a single fleet-ordered list
+    (records missing either key sort as 0; ``.truncated`` is True when
+    ANY stream was truncated).  A single path returns records in file
+    order, byte-identical to the old behavior."""
+    if isinstance(path, (list, tuple)):
+        paths = list(path)
+    elif any(c in path for c in "*?["):
+        paths = sorted(_glob.glob(path))
+    else:
+        return _read_one(path)
+    merged = JsonlRecords()
+    streams = [_read_one(p) for p in paths]
+    for recs in streams:
+        merged.extend(recs)
+        if recs.truncated:
+            merged.truncated = True
+    merged.sort(key=lambda r: (r.get("step") or 0, r.get("rank") or 0)
+                if isinstance(r, dict) else (0, 0))
+    return merged
+
+
+def _read_one(path):
     records = JsonlRecords()
     with open(path, "r", encoding="utf-8") as f:
         lines = f.readlines()

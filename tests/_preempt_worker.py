@@ -15,6 +15,13 @@ elastic, preemption-safe loop that any rank count can resume.
   MARKER_FILE / MARKER_AFTER_STEP
                 rank 0 touches MARKER_FILE after completing that step
                 (lets a test synchronize its signal with progress)
+  FLEET_JSONL   prefix; enables telemetry + the fleet layer, each rank
+                logging to FLEET_JSONL<rank>.jsonl (append across
+                relaunches); FLEET_STRIDE sets the exchange stride
+  SLOW_RANK / SLOW_SLEEP
+                test hook: that rank sleeps SLOW_SLEEP seconds inside
+                every step's compute phase — the injected straggler the
+                fleet watchdog must name
 
 The loop demonstrates the full robustness contract:
   * data comes from ``mxnet_tpu.elastic`` — a pure function of
@@ -39,11 +46,29 @@ import numpy as np
 
 import mxnet_tpu as mx
 from mxnet_tpu import autograd, checkpoint, elastic, gluon, nd, parallel
+from mxnet_tpu import telemetry
 from mxnet_tpu.gluon import trainer as trainer_mod
 
 trainer_mod.install_preemption_handler()
 parallel.initialize()
 rank, world = jax.process_index(), jax.process_count()
+
+fleet_prefix = os.environ.get("FLEET_JSONL")
+if fleet_prefix:
+    jsonl = f"{fleet_prefix}{rank}.jsonl"
+    # a SIGKILL mid-write can leave a half line at the tail; drop it
+    # before appending or the relaunch would splice two records together
+    if os.path.exists(jsonl):
+        with open(jsonl, "rb") as f:
+            data = f.read()
+        if data and not data.endswith(b"\n"):
+            keep = data[:data.rfind(b"\n") + 1] if b"\n" in data else b""
+            with open(jsonl, "wb") as f:
+                f.write(keep)
+    telemetry.enable(jsonl_path=jsonl, append=True)
+    telemetry.fleet.enable(stride=int(os.environ.get("FLEET_STRIDE", "8")))
+slow_rank = int(os.environ.get("SLOW_RANK", "-1"))
+slow_sleep = float(os.environ.get("SLOW_SLEEP", "0"))
 
 mx.random.seed(42)
 net = gluon.nn.Dense(3, use_bias=True)
@@ -70,15 +95,26 @@ DATA = np.random.RandomState(0).randn(64, 5).astype(np.float32)
 BATCH = 8
 
 for step in range(start, total):
+    telemetry.step_begin()
     idx = elastic.shard_for_step(len(DATA), BATCH, step, world, rank,
                                  seed=5)
     x = nd.array(DATA[idx])
     with autograd.record():
         loss = (net(x) ** 2).sum()
     loss.backward()
+    if rank == slow_rank and slow_sleep:
+        time.sleep(slow_sleep)  # injected compute straggle (test hook)
     trainer.step(BATCH)
+    t_bar = time.perf_counter()
     gloss = parallel.process_sum_hostvec(
         np.asarray([float(loss.asnumpy())], dtype=np.float64))[0]
+    # the gloss psum is this loop's blocking aggregation barrier: count
+    # its wall time as allreduce wait so the fleet exchange can split
+    # compute skew (the straggler) from wait skew (its victims)
+    telemetry.count("trainer.allreduce_wait_ms",
+                    (time.perf_counter() - t_bar) * 1e3)
+    telemetry.step_end(examples=BATCH, loss=float(gloss),
+                       global_step=step)
     if rank == 0:
         if loss_file:
             with open(loss_file, "a") as f:

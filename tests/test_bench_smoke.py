@@ -223,6 +223,39 @@ def test_sharded_step_bench_emits_artifact(tmp_path):
         assert all(rec["acceptance"][model].values())
 
 
+def test_fleet_overhead_bench_emits_artifact(tmp_path):
+    """benchmark/sharded_step.py --fleet-overhead must emit the
+    FLEET_OVERHEAD artifact: the off/stride16/stride1 A/B lanes, the
+    per-step hook microbench, the stride-1 exchange cost, and a passing
+    <1% acceptance — the round-13 evidence that fleet observability is
+    free at the default stride."""
+    out = tmp_path / "fleet_overhead.json"
+    env = dict(os.environ)
+    env.update(BENCH_PLATFORM="cpu", BENCH_STEPS="3", BENCH_WARMUP="1",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8",
+               MXT_FLEET_OVERHEAD_OUT=str(out))
+    env.pop("JAX_PLATFORMS", None)
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "benchmark",
+                                      "sharded_step.py"),
+         "--fleet-overhead"],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=600)
+    assert r.returncode == 0, r.stderr[-2000:]
+    rec = json.loads(out.read_text())
+    assert rec["metric"] == "fleet_overhead_pct_stride16"
+    assert 0 <= rec["value"] < 1.0
+    assert set(rec["lanes"]) == {"off", "stride16", "stride1"}
+    for lane in rec["lanes"].values():
+        assert lane["step_ms_median"] > 0
+    assert rec["lanes"]["off"]["fleet_exchanges"] == 0
+    # stride 1 exchanges every measured step and reports its cost
+    assert rec["lanes"]["stride1"]["fleet_exchanges"] >= 3
+    assert rec["exchange_ms_stride1"] is not None
+    assert rec["hook_ms_stride16"] > 0
+    assert rec["hook_ms_stride1"] >= rec["hook_ms_stride16"] * 0.5
+    assert rec["acceptance"]["fleet_overhead_under_1pct"]
+
+
 def test_remat_ab_bench_emits_artifact(tmp_path):
     """benchmark/remat_ab.py at toy step counts must emit the REMAT_AB
     artifact with every tier lane for both models, bit-identical loss

@@ -164,6 +164,21 @@ def test_tracing_calls_allowed_in_hot_paths():
                for v in vs)
 
 
+def test_fleet_calls_allowed_in_hot_paths():
+    vs = _analyze("t6_fleet.py")
+    contexts = {v.context for v in vs}
+    # fleet.incident + the same-module step hook (whose perf_counter
+    # stamp is the point) must NOT flag in the hot training tick
+    assert "on_step_record" not in contexts
+    assert "traced_train_tick" not in contexts
+    # the stride-allgather def is MATERIALIZE_DEFS-exempt: its eager
+    # asnumpy is the intentional exchange boundary
+    assert "_fleet_exchange" not in contexts
+    # a real host sync in the jitted step body still flags
+    assert any(v.rule == "T1" and v.context == "bad_synced_tick"
+               for v in vs)
+
+
 def test_memwatch_hooks_allowed_in_hot_paths():
     vs = _analyze("t6_memwatch.py")
     contexts = {v.context for v in vs}

@@ -112,12 +112,24 @@ class ChaosMonkey:
 
 def run_chaos(n, cmd, kills=2, mix="mixed", min_delay=1.0, max_delay=4.0,
               seed=0, coordinator="127.0.0.1:12721", max_restarts=8,
-              max_preemptions=64, backoff_base=0.2, backoff_cap=5.0):
+              max_preemptions=64, backoff_base=0.2, backoff_cap=5.0,
+              fleet_dump=None):
     """Run ``cmd`` across ``n`` loopback ranks with chaos injection.
 
     Returns ``(rc, summary_dict)``.  The backoff default is shorter
     than the launcher's production default — chaos runs live in test
-    lanes where wall-clock matters and the coordinator port is local."""
+    lanes where wall-clock matters and the coordinator port is local.
+
+    ``fleet_dump`` (a path template; ``{rank}`` expands per rank) turns
+    on the training flight recorder in every rank via the
+    ``MXNET_FLEET``/``MXNET_FLEET_DUMP`` env contract (the launcher
+    copies the harness env into each rank), and the summary gains
+    ``fleet_dumps``/``fleet_dumps_complete``: whether every KILLED rank
+    left a readable flight-recorder dump behind — the forensics the
+    chaos lane exists to prove out."""
+    if fleet_dump is not None:
+        os.environ["MXNET_FLEET"] = "1"
+        os.environ["MXNET_FLEET_DUMP"] = fleet_dump
     monkey = ChaosMonkey(kills, mix=mix, min_delay=min_delay,
                          max_delay=max_delay, seed=seed)
     stats = {}
@@ -138,6 +150,21 @@ def run_chaos(n, cmd, kills=2, mix="mixed", min_delay=1.0, max_delay=4.0,
         "mix": mix,
         "num_workers": n,
     }
+    if fleet_dump is not None:
+        dumps = {}
+        for inj in monkey.injections:
+            rank = inj["rank"]
+            path = fleet_dump.replace("{rank}", str(rank))
+            ok = False
+            try:
+                with open(path, "r") as f:
+                    ok = json.load(f).get("record") == "flight_recorder"
+            except (OSError, json.JSONDecodeError):
+                ok = False
+            dumps[str(rank)] = path if ok else None
+        summary["fleet_dumps"] = dumps
+        summary["fleet_dumps_complete"] = \
+            bool(dumps) and all(v is not None for v in dumps.values())
     return rc, summary
 
 
@@ -164,6 +191,12 @@ def main(argv=None):
     p.add_argument("--backoff-cap", type=float, default=5.0)
     p.add_argument("--summary", default=None,
                    help="write the JSON summary here instead of stdout")
+    p.add_argument("--fleet-dump", default=None, metavar="TEMPLATE",
+                   help="enable the training flight recorder in every "
+                        "rank (MXNET_FLEET=1) with this dump path "
+                        "template ({rank} expands per rank); the "
+                        "summary then asserts a dump exists for every "
+                        "killed rank")
     p.add_argument("command", nargs=argparse.REMAINDER)
     args = p.parse_args(argv)
     cmd = args.command
@@ -177,7 +210,8 @@ def main(argv=None):
         seed=args.seed, coordinator=args.coordinator,
         max_restarts=args.max_restarts,
         max_preemptions=args.max_preemptions,
-        backoff_base=args.backoff_base, backoff_cap=args.backoff_cap)
+        backoff_base=args.backoff_base, backoff_cap=args.backoff_cap,
+        fleet_dump=args.fleet_dump)
     text = json.dumps(summary, indent=2)
     if args.summary:
         with open(args.summary, "w") as f:

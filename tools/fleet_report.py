@@ -1,0 +1,244 @@
+"""Merge per-rank telemetry streams into one fleet report.
+
+The launcher gives each rank its own JSONL file; the flight recorder
+(``mxnet_tpu.telemetry.fleet``) dumps each rank's last-N ring as a
+single JSON document on drain/halt/exit.  This tool joins either (or a
+mix) back into the pod-scale picture:
+
+    # rank x step heatmap + straggler/anomaly summary, all ranks
+    python tools/fleet_report.py out/rank*.jsonl
+
+    # the same from flight dumps left behind by a chaos kill
+    python tools/fleet_report.py dumps/fd.rank0.json dumps/fd.rank1.json
+
+    # one Perfetto timeline, one track per rank
+    python tools/fleet_report.py out/rank*.jsonl --format chrome \
+        --out fleet.json
+
+Inputs may be telemetry JSONL streams (``record`` mixes of
+``step``-shaped records, ``fleet`` views and ``anomaly`` events) or
+fleet flight-recorder dumps (``{"record": "flight_recorder", "kind":
+"fleet", "records": [...]}``); streams merge by ``(step, rank)`` via
+``telemetry.read_jsonl``.  The functions (`load_records`,
+`heatmap_text`, `chrome_timeline`) are importable for tests.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from mxnet_tpu.telemetry import fleet as _fleet  # noqa: E402
+from mxnet_tpu.telemetry.sinks import read_jsonl  # noqa: E402
+
+
+def _is_flight_dump(path):
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            head = f.read(1)
+            if head != "{":
+                return None
+            f.seek(0)
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    if isinstance(doc, dict) and doc.get("record") == "flight_recorder":
+        return doc
+    return None
+
+
+def load_records(paths):
+    """Every record from ``paths`` (JSONL streams, globs, or fleet
+    flight dumps), merged and sorted by ``(step, rank)``.  Dump-borne
+    records inherit the dump's ``rank`` when they lack their own."""
+    out = []
+    jsonl_paths = []
+    for p in paths:
+        doc = _is_flight_dump(p)
+        if doc is not None:
+            r = doc.get("rank")
+            for rec in doc.get("records", []):
+                if isinstance(rec, dict):
+                    rec.setdefault("rank", r)
+                    out.append(rec)
+        else:
+            jsonl_paths.append(p)
+    if jsonl_paths:
+        out.extend(read_jsonl(jsonl_paths if len(jsonl_paths) > 1
+                              else jsonl_paths[0]))
+    out.sort(key=lambda rec: (rec.get("step") or 0, rec.get("rank") or 0)
+             if isinstance(rec, dict) else (0, 0))
+    return [rec for rec in out if isinstance(rec, dict)]
+
+
+def _partition(records):
+    steps, fleet_views, anomalies = [], [], []
+    for rec in records:
+        kind = rec.get("record")
+        if kind == "fleet":
+            fleet_views.append(rec)
+        elif kind == "anomaly":
+            anomalies.append(rec)
+        elif "step_ms" in rec and "step" in rec:
+            steps.append(rec)
+    return steps, fleet_views, anomalies
+
+
+def heatmap_text(records, metric="compute_ms", threshold=None):
+    """Rank x step text heatmap of ``metric`` over the fleet views,
+    plus a summary NAMING straggler ranks and anomaly windows.
+
+    Each fleet-view step is a column; each rank a row; cells carry the
+    per-rank value with a ``*`` straggler flag (value above
+    ``threshold`` x the column median, default the watchdog's skew
+    threshold)."""
+    if threshold is None:
+        threshold = _fleet.SKEW_THRESHOLD
+    steps, views, anomalies = _partition(records)
+    lines = []
+    # one view record per exchange step suffices (all ranks see the
+    # same gathered matrix; rank 0's copy wins)
+    by_step = {}
+    for v in views:
+        by_step.setdefault(v.get("step"), v)
+    cols = sorted(s for s in by_step if s is not None)
+    flagged_by_rank = {}
+    if cols:
+        world = max(len(by_step[s].get(metric) or []) for s in cols)
+        lines.append("fleet heatmap: %s (* = > %.2fx column median)"
+                     % (metric, threshold))
+        lines.append("step    " + "".join("%12d" % s for s in cols))
+        for r in range(world):
+            cells = []
+            for s in cols:
+                vals = by_step[s].get(metric) or []
+                if r >= len(vals):
+                    cells.append("%12s" % "-")
+                    continue
+                flag = r in _fleet.detect_skew(vals, threshold)
+                if flag:
+                    flagged_by_rank[r] = flagged_by_rank.get(r, 0) + 1
+                cells.append("%11.1f%s" % (float(vals[r]),
+                                           "*" if flag else " "))
+            lines.append("rank %-3d" % r + "".join(cells))
+    else:
+        lines.append("no fleet-view records (was the fleet layer "
+                     "enabled, and did a stride boundary pass?)")
+    lines.append("")
+    if flagged_by_rank:
+        worst = sorted(flagged_by_rank.items(),
+                       key=lambda kv: -kv[1])
+        lines.append("stragglers (by %s skew): " % metric + ", ".join(
+            "rank %d (%d/%d windows)" % (r, n, len(cols))
+            for r, n in worst))
+    else:
+        lines.append("stragglers: none")
+    if anomalies:
+        lines.append("anomalies:")
+        for a in anomalies:
+            who = a.get("culprit", a.get("rank"))
+            detail = {k: v for k, v in a.items()
+                      if k not in ("record", "kind", "step", "rank",
+                                   "world_size", "wall_time", "culprit")}
+            lines.append("  step %-6s %-20s rank %-3s %s"
+                         % (a.get("step"), a.get("kind"), who, detail))
+    else:
+        lines.append("anomalies: none")
+    lines.append("records: %d step, %d fleet view, %d anomaly"
+                 % (len(steps), len(views), len(anomalies)))
+    return "\n".join(lines)
+
+
+def chrome_timeline(records):
+    """chrome://tracing / Perfetto JSON: one track (pid) per rank, one
+    complete ("X") event per step record, instant ("i") events for
+    anomalies.  Timestamps are wall-clock relative to the earliest
+    record so multi-rank streams line up on one timebase."""
+    steps, _views, anomalies = _partition(records)
+    walls = [rec.get("wall_time") for rec in steps + anomalies
+             if rec.get("wall_time") is not None]
+    t0 = min(walls) if walls else 0.0
+    events = []
+    seen_ranks = set()
+
+    def track(rank):
+        if rank not in seen_ranks:
+            seen_ranks.add(rank)
+            events.append({"ph": "M", "pid": rank, "tid": 0,
+                           "name": "process_name",
+                           "args": {"name": "rank %s" % rank}})
+
+    for rec in steps:
+        rank = rec.get("rank") or 0
+        track(rank)
+        dur_ms = float(rec.get("step_ms") or 0.0)
+        ts = (float(rec.get("wall_time") or t0) - t0) * 1e6
+        args = {"step": rec.get("step")}
+        for k in ("examples_per_sec", "peak_live_bytes", "host_sync",
+                  "compile_count", "allreduce_bytes"):
+            if rec.get(k) is not None:
+                args[k] = rec[k]
+        wait = (rec.get("counters") or {}).get("trainer.allreduce_wait_ms")
+        if wait is not None:
+            args["allreduce_wait_ms"] = wait
+        events.append({"ph": "X", "cat": "fleet",
+                       "name": "step %s" % rec.get("step"),
+                       "pid": rank, "tid": 1, "ts": ts,
+                       "dur": dur_ms * 1e3, "args": args})
+    for a in anomalies:
+        rank = a.get("rank") or 0
+        track(rank)
+        ts = (float(a.get("wall_time") or t0) - t0) * 1e6
+        events.append({"ph": "i", "cat": "fleet", "s": "p",
+                       "name": "anomaly:%s" % a.get("kind"),
+                       "pid": rank, "tid": 1, "ts": ts,
+                       "args": {k: v for k, v in a.items()
+                                if k not in ("record", "wall_time")}})
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="merge per-rank telemetry JSONL streams / fleet "
+        "flight dumps into a rank x step heatmap or a Perfetto "
+        "timeline with one track per rank")
+    ap.add_argument("paths", nargs="+", metavar="path",
+                    help="per-rank JSONL files, globs, or fleet "
+                    "flight-recorder dumps")
+    ap.add_argument("--metric", default="compute_ms",
+                    help="fleet-view column for the heatmap "
+                    "(default: compute_ms)")
+    ap.add_argument("--threshold", default=None, type=float,
+                    help="straggler flag threshold (x column median; "
+                    "default: the watchdog's)")
+    ap.add_argument("--format", choices=("text", "chrome"),
+                    default="text")
+    ap.add_argument("--out", default=None,
+                    help="write here instead of stdout")
+    args = ap.parse_args(argv)
+
+    records = load_records(args.paths)
+    if not records:
+        print("no records found", file=sys.stderr)
+        return 1
+    sink = open(args.out, "w", encoding="utf-8") if args.out \
+        else sys.stdout
+    try:
+        if args.format == "chrome":
+            json.dump(chrome_timeline(records), sink, indent=1)
+            sink.write("\n")
+        else:
+            sink.write(heatmap_text(records, metric=args.metric,
+                                    threshold=args.threshold) + "\n")
+    finally:
+        if sink is not sys.stdout:
+            sink.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

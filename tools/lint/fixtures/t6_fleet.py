@@ -1,0 +1,56 @@
+"""T6 fixture: fleet observability hooks in training hot paths.
+
+The r13 fleet layer stamps rank/world onto step records
+(``fleet.on_step_record``), runs watchdog arithmetic
+(``observe_step``/``observe_fleet``) and appends to the flight-recorder
+ring — all host-side behind one boolean.  The analyzer must (a) not
+flag ``fleet.*`` calls in hot step code, (b) not let hotness leak into
+a same-module hook helper through its bare-name call, (c) leave the
+``_fleet_exchange`` def's intentional eager materialize unflagged
+(MATERIALIZE_DEFS — the stride-gated allgather syncs there by design),
+while (d) still flagging a real host sync in a jitted step body.
+"""
+import time
+
+import jax
+import numpy as np
+
+from mxnet_tpu.telemetry import fleet
+
+
+def on_step_record(record, t0):
+    # same-module fleet hook: the perf_counter stamp and dict writes
+    # are host-side by design — hotness must NOT leak in through the
+    # bare-name call in traced_train_tick below
+    record["hook_ms"] = (time.perf_counter() - t0) * 1e3
+    record["rank"] = 0
+
+
+def traced_train_tick(step_fn, batch, record, t0):
+    out = step_fn(batch)
+    if record is not None:
+        on_step_record(record, t0)                    # ok: helper
+        fleet.incident("watchdog_halt",               # ok: fleet.*
+                       context={"step": record["step"]})
+    return out
+
+
+traced_train_tick_jit = jax.jit(traced_train_tick, static_argnums=0)
+
+
+def _fleet_exchange(vec, gathered):
+    # the stride-gated allgather boundary: one intentional eager
+    # device->host materialize per exchange window, never per step —
+    # MATERIALIZE_DEFS exempts the T1 eager warning here
+    return gathered.asnumpy().reshape(-1, vec.size)
+
+
+def bad_synced_tick(step_fn, batch, record):
+    out = step_fn(batch)
+    host = np.asarray(out)          # T1 error: sync in the hot step
+    if record is not None:
+        record["loss"] = host[0]
+    return host
+
+
+bad_synced_tick_jit = jax.jit(bad_synced_tick, static_argnums=0)

@@ -58,6 +58,11 @@ RECORDING_SAFE_CALLEES = {
     # serving.metrics): retroactive span appends from perf_counter
     # stamps and rolling goodput counters — host-side by contract
     "start_trace", "finish", "incident", "add_span", "observe",
+    # fleet observability hooks (r13, telemetry.fleet): rank stamping,
+    # ring appends and watchdog arithmetic behind one-boolean flags;
+    # the stride allgather is isolated in _fleet_exchange
+    # (MATERIALIZE_DEFS) and never rides these entry points' fast path
+    "on_step_record", "observe_step", "observe_fleet",
 }
 
 _FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)

@@ -86,7 +86,11 @@ SYNC_METHODS_ANYWHERE = {"asnumpy", "asscalar", "item",
 #: ``_materialize`` buys nothing.  ``_lane_materialize`` is the
 #: disaggregated serving lanes' twin (serving/lanes.py): the decode
 #: drain and the prefill→decode handoff sync there, and nowhere else.
-MATERIALIZE_DEFS = {"_materialize", "_lane_materialize"}
+#: ``_fleet_exchange`` (telemetry/fleet.py, r13) is the stride-gated
+#: allgather of the packed step-stats vector: an intentional eager
+#: collective+sync at the fleet-exchange boundary, never per-step and
+#: never inside a trace — exempt the same way.
+MATERIALIZE_DEFS = {"_materialize", "_lane_materialize", "_fleet_exchange"}
 
 #: function-style syncs, matched on dotted name
 SYNC_FUNCS_ANYWHERE = {"jax.device_get"}
@@ -119,7 +123,14 @@ RECORDING_HEADS = {"telemetry", "profiler", "prof",
                    # stamps the lanes already take, and the scrape
                    # renderer reads telemetry snapshots — host-side by
                    # contract, never a device sync
-                   "tracing", "_tracing", "metrics"}
+                   "tracing", "_tracing", "metrics",
+                   # r13 fleet observability (telemetry.fleet, aliased
+                   # _fleet_mod in telemetry/__init__; promtext is the
+                   # shared scrape renderer): ring appends, watchdog
+                   # arithmetic and text rendering — host-side; the one
+                   # collective lives in _fleet_exchange (see
+                   # MATERIALIZE_DEFS), stride-gated off the hot path
+                   "fleet", "_fleet", "_fleet_mod", "promtext"}
 
 
 def _is_recording_call(dotted: str) -> bool:

@@ -180,7 +180,13 @@ def main(argv=None):
     ap = argparse.ArgumentParser(
         description="render serving request traces from a telemetry "
         "JSONL stream or a flight-recorder dump")
-    ap.add_argument("path", help="telemetry JSONL or flight dump JSON")
+    ap.add_argument("paths", nargs="+", metavar="path",
+                    help="telemetry JSONL stream(s), a glob (with "
+                    "--merge), or a flight dump JSON")
+    ap.add_argument("--merge", action="store_true",
+                    help="merge multiple per-rank JSONL streams by "
+                    "(step, rank) via telemetry.read_jsonl before "
+                    "selecting traces (implied by >1 path)")
     ap.add_argument("--trace-id", default=None,
                     help="render only this trace id")
     ap.add_argument("--request-id", default=None, type=int,
@@ -193,7 +199,18 @@ def main(argv=None):
                     help="write here instead of stdout")
     args = ap.parse_args(argv)
 
-    traces = select(load_traces(args.path), trace_id=args.trace_id,
+    if args.merge or len(args.paths) > 1:
+        # multi-stream mode rides the merged reader (glob-aware); the
+        # single-path default stays dependency-free
+        from mxnet_tpu.telemetry.sinks import read_jsonl
+
+        merged = read_jsonl(args.paths if len(args.paths) > 1
+                            else args.paths[0])
+        traces = [r for r in merged if isinstance(r, dict) and
+                  r.get("record") == "trace"]
+    else:
+        traces = load_traces(args.paths[0])
+    traces = select(traces, trace_id=args.trace_id,
                     request_id=args.request_id)
     if not traces:
         print("no matching trace records", file=sys.stderr)
