@@ -13,12 +13,28 @@ Writes a synthetic .rec of REAL encoded JPEGs, then measures:
 
 Usage: python benchmark/input_pipeline.py [--images 2048] [--size 224]
 Prints one JSON line per pipeline; "ok" = faster than --target img/s.
+
+``--data-plane`` runs the r14 end-to-end trainer-fed lanes instead:
+the full streaming data plane (ShardedRecordReader → StreamingLoader →
+DevicePrefetcher) feeding a STOCK ``gluon.Trainer`` at CPU-mesh dp8 —
+an image lane (JPEG decode → dense classifier) and a packed-LLM lane
+(variable-length token docs → SequencePacker → llama_tiny with segment
+masks).  Per lane: throughput, ``data_wait_ms`` p50/p99 (steady-state
+p50 ≈ 0 is the prefetch-overlap proof), packing efficiency, and the
+compile-once gate.  Artifact: DATA_PLANE_r14.json (override
+MXT_DATA_PLANE_OUT).  CPU-mesh validation run (exactly what
+``tests/test_bench_smoke.py`` does)::
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    BENCH_PLATFORM=cpu python benchmark/input_pipeline.py --data-plane
 """
 from __future__ import annotations
 
 import argparse
+import gc
 import json
 import os
+import statistics
 import sys
 import tempfile
 import time
@@ -27,6 +43,12 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
+
+STEPS = int(os.environ.get("BENCH_STEPS", "8"))
+WARMUP = int(os.environ.get("BENCH_WARMUP", "2"))
+
+_MISS_COUNTERS = ("trainer.fused_cache_miss", "step_fusion.cache_miss",
+                  "cachedop.cache_miss")
 
 
 def make_recfile(path_prefix, n, size):
@@ -113,5 +135,232 @@ def main(argv=None):
     return results
 
 
+# ---------------------------------------------------------------------------
+# --data-plane: r14 end-to-end trainer-fed lanes (streaming data plane)
+# ---------------------------------------------------------------------------
+
+def _pctl(vals, q):
+    return round(float(np.percentile(np.asarray(vals, dtype=np.float64), q)),
+                 3)
+
+
+def _dp_lane_image(td, mesh):
+    """JPEG ``.rec`` → ShardedRecordReader → StreamingLoader (decode on
+    worker threads, device put overlapped) → dense classifier under a
+    stock dp8 Trainer.  The lane the C++ ImageRecordIter existed for,
+    rebuilt on the streaming plane."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, data, gluon, nd, recordio, telemetry
+
+    size = int(os.environ.get("BENCH_DP_IMG_SIZE", "24"))
+    n_images, batch = 256, 64
+    rec = make_recfile(os.path.join(td, "dp_img"), n_images, size)
+
+    def decode(raw):
+        header, img = recordio.unpack_img(raw)
+        x = img.astype(np.float32).ravel() / 255.0
+        return x, np.int32(int(header.label) % 10)
+
+    feat = size * size * 3
+    net = gluon.nn.HybridSequential()
+    with net.name_scope():
+        net.add(gluon.nn.Dense(128, activation="relu"))
+        net.add(gluon.nn.Dense(10))
+    net.initialize(mx.init.Xavier())
+    net(nd.ones((1, feat)))
+    net.hybridize(static_alloc=True)
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.01},
+                            partition_rules=[(r".*", ())], mesh=mesh)
+    reader = data.ShardedRecordReader(rec, batch_size=batch, seed=0)
+    loader = data.StreamingLoader(reader, transform=decode,
+                                  num_workers=4, prefetch_depth=4,
+                                  mesh=mesh, num_steps=WARMUP + STEPS)
+    trainer.attach_data_prefetcher(loader)
+    waits, times, overlap = [], [], 0
+    miss_warmup = miss_steady = 0
+    try:
+        for i in range(WARMUP + STEPS):
+            with telemetry.step(examples=batch) as scope:
+                imgs, labels = loader.get()
+                with autograd.record():
+                    loss = nd.softmax_cross_entropy(net(imgs),
+                                                    labels).mean()
+                loss.backward()
+                trainer.step(batch)
+                loss.wait_to_read()
+                nd.waitall()
+            misses = sum(scope.record["counters"].get(k, 0)
+                         for k in _MISS_COUNTERS)
+            overlap += scope.record["counters"].get(
+                "data.overlap_dispatch", 0)
+            if i < WARMUP:
+                miss_warmup += misses
+            else:
+                miss_steady += misses
+                waits.append(scope.record["data_wait_ms"])
+                times.append(scope.record["step_ms"])
+    finally:
+        loader.close()
+    med = statistics.median(times)
+    return {
+        "steps": STEPS, "warmup": WARMUP,
+        "global_batch": batch, "image_size": size,
+        "final_loss": float(loss.asscalar()),
+        "step_ms_median": round(med, 3),
+        "images_per_sec": round(batch * 1e3 / med, 1),
+        "data_wait_ms_p50": _pctl(waits, 50),
+        "data_wait_ms_p99": _pctl(waits, 99),
+        "overlap_dispatches": int(overlap),
+        "compile_miss_warmup": miss_warmup,
+        "compile_miss_steady": miss_steady,
+    }
+
+
+def _dp_lane_packed(td, mesh):
+    """Variable-length token docs → SequencePacker → llama_tiny with
+    segment-id masks + ``packed_lm_loss`` under a stock dp8 Trainer.
+    Every batch lands as ONE (B, T) compile signature regardless of the
+    document mix — the compile-once gate below is the proof."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, data, gluon, nd, recordio, telemetry
+    from mxnet_tpu.models import llama
+
+    B, T, docs_per_step, n_docs = 8, 128, 24, 512
+    rs = np.random.RandomState(7)
+    rec = recordio.MXIndexedRecordIO(os.path.join(td, "dp_tok.idx"),
+                                     os.path.join(td, "dp_tok.rec"), "w")
+    for i in range(n_docs):
+        ln = int(rs.randint(32, 97))
+        rec.write_idx(i, rs.randint(1, 256,
+                                    size=ln).astype(np.int32).tobytes())
+    rec.close()
+
+    net = llama.llama_tiny()
+    net.initialize(mx.init.Xavier())
+    ones = np.ones((B, T), dtype=np.int32)
+    net(nd.array(ones), nd.array(ones))
+    net.hybridize(static_alloc=True)
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.01},
+                            partition_rules="llama", mesh=mesh)
+    packer = data.SequencePacker(B, T)
+    reader = data.ShardedRecordReader(os.path.join(td, "dp_tok.rec"),
+                                      batch_size=docs_per_step, seed=3)
+    loader = data.StreamingLoader(
+        reader, packer=packer,
+        tokenize=lambda b: np.frombuffer(b, dtype=np.int32),
+        num_workers=4, prefetch_depth=4, mesh=mesh,
+        num_steps=WARMUP + STEPS)
+    trainer.attach_data_prefetcher(loader)
+    waits, times, overlap = [], [], 0
+    miss_warmup = miss_steady = 0
+    try:
+        for i in range(WARMUP + STEPS):
+            with telemetry.step(examples=B) as scope:
+                pb = loader.get()
+                with autograd.record():
+                    logits = net(pb.tokens, pb.segment_ids)
+                    loss = llama.packed_lm_loss(logits, pb.labels,
+                                                pb.loss_mask)
+                loss.backward()
+                trainer.step(B)
+                loss.wait_to_read()
+                nd.waitall()
+            misses = sum(scope.record["counters"].get(k, 0)
+                         for k in _MISS_COUNTERS)
+            overlap += scope.record["counters"].get(
+                "data.overlap_dispatch", 0)
+            if i < WARMUP:
+                miss_warmup += misses
+            else:
+                miss_steady += misses
+                waits.append(scope.record["data_wait_ms"])
+                times.append(scope.record["step_ms"])
+    finally:
+        stats = loader.packing_stats.as_dict()
+        loader.close()
+    med = statistics.median(times)
+    eff = stats["efficiency"]
+    return {
+        "steps": STEPS, "warmup": WARMUP,
+        "grid": [B, T], "docs_per_step": docs_per_step,
+        "final_loss": float(loss.asscalar()),
+        "step_ms_median": round(med, 3),
+        "packed_tokens_per_sec": round(B * T * eff * 1e3 / med, 1),
+        "data_wait_ms_p50": _pctl(waits, 50),
+        "data_wait_ms_p99": _pctl(waits, 99),
+        "overlap_dispatches": int(overlap),
+        "packing": stats,
+        "compile_miss_warmup": miss_warmup,
+        "compile_miss_steady": miss_steady,
+    }
+
+
+def main_data_plane():
+    plat = os.environ.get("BENCH_PLATFORM")
+    if plat:
+        import jax
+
+        jax.config.update("jax_platforms", plat)
+    import jax
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import parallel, telemetry
+
+    n = jax.device_count()
+    if n < 8:
+        raise SystemExit(f"--data-plane needs >= 8 devices, have {n} "
+                         "(set XLA_FLAGS=--xla_force_host_platform_"
+                         "device_count=8)")
+    mx.random.seed(0)
+    t0 = time.time()
+    lanes = {}
+    with tempfile.TemporaryDirectory() as td:
+        for name, lane in (("image", _dp_lane_image),
+                           ("packed_llm", _dp_lane_packed)):
+            telemetry.enable()
+            try:
+                mesh = parallel.make_mesh({"dp": 8})
+                lanes[name] = lane(td, mesh)
+            finally:
+                telemetry.disable()
+                parallel.set_mesh(None)
+                gc.collect()
+    wait_p50 = max(lane["data_wait_ms_p50"] for lane in lanes.values())
+    acceptance = {
+        # prefetch overlap holds: the trainer never starves on input
+        "data_wait_p50_near_zero": wait_p50 <= 2.0,
+        "packing_efficiency_ge_85":
+            lanes["packed_llm"]["packing"]["efficiency"] >= 0.85,
+        # one (B, T) signature end to end — no per-length recompiles
+        "compile_once": all(lane["compile_miss_steady"] == 0
+                            for lane in lanes.values()),
+    }
+    record = {
+        "metric": "data_plane_data_wait_ms_p50",
+        "value": wait_p50,
+        "unit": "ms blocked on input per step (worst lane, steady p50)",
+        "n_devices": n,
+        "lanes": lanes,
+        "acceptance": acceptance,
+        "wall_sec": round(time.time() - t0, 1),
+        "platform": os.environ.get("JAX_PLATFORMS", plat or "default"),
+    }
+    line = json.dumps(record, indent=2, default=str)
+    print(line)
+    out_path = os.environ.get(
+        "MXT_DATA_PLANE_OUT",
+        os.path.join(os.path.dirname(__file__), "..",
+                     "DATA_PLANE_r14.json"))
+    with open(out_path, "w") as f:
+        f.write(line + "\n")
+    if not all(acceptance.values()):
+        raise SystemExit(f"acceptance failed: {acceptance}")
+
+
 if __name__ == "__main__":
-    main()
+    if "--data-plane" in sys.argv[1:]:
+        main_data_plane()
+    else:
+        main()

@@ -86,6 +86,7 @@ _LAZY = {
     "checkpoint": ".checkpoint",
     "elastic": ".elastic",
     "serving": ".serving",
+    "data": ".data",
 }
 
 

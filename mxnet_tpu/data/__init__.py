@@ -1,0 +1,24 @@
+"""The streaming data plane (r14): sharded elastic readers, overlapped
+host→device prefetch, and sequence packing.
+
+Composition (docs/data.md):
+
+    ShardedRecordReader ──► StreamingLoader ──► DevicePrefetcher ──► step
+        (.rec/.idx,             (decode workers,      (double-buffered
+         elastic draw)           optional packing)     sharded device_put)
+
+Everything is keyed on the global training step: the reader's sample
+draw is a pure function of ``(seed, step)`` through ``mxnet_tpu.
+elastic``, so the checkpointed step fully determines the pipeline
+position at any world size — the same elastic contract the trainer
+already holds, now extended to real record files.
+"""
+from .reader import ShardedRecordReader
+from .packing import (PackedBatch, PackingStats, SequencePacker,
+                      pack_documents)
+from .prefetch import DevicePrefetcher
+from .pipeline import StreamingLoader
+
+__all__ = ["ShardedRecordReader", "StreamingLoader", "DevicePrefetcher",
+           "SequencePacker", "PackedBatch", "PackingStats",
+           "pack_documents"]

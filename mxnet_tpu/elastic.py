@@ -32,7 +32,7 @@ import numpy as np
 from .base import MXNetError
 
 __all__ = ["global_batch_indices", "shard_indices", "shard_for_step",
-           "world_info"]
+           "shard_rows", "world_info"]
 
 
 def _step_rng(seed, step):
@@ -104,6 +104,19 @@ def shard_for_step(dataset_size, batch_size, step, world_size, rank,
         global_batch_indices(dataset_size, batch_size, step, seed=seed,
                              shuffle=shuffle),
         world_size, rank)
+
+
+def shard_rows(num_rows, world_size, rank):
+    """This rank's contiguous row slice of a batch assembled globally.
+
+    The packed-batch analogue of ``shard_indices``: when every rank
+    deterministically builds the same global ``(num_rows, ...)`` batch
+    (e.g. ``data.SequencePacker`` packing a step's global document
+    draw), each rank keeps rows ``shard_rows(num_rows, world, rank)``.
+    Same divisibility contract, same resize invariance — the union of
+    all ranks' rows is the identical global batch at every world size.
+    """
+    return shard_indices(np.arange(int(num_rows)), world_size, rank)
 
 
 def world_info():

@@ -595,6 +595,27 @@ class _AsyncExecutor:
 _EXEC = _AsyncExecutor(_ASYNC_QUEUE_MAX)
 
 
+# observers poked whenever a compute segment is dispatched to the async
+# executor — the data plane's prefetcher uses this to count how often a
+# host->device transfer was genuinely in flight DURING compute dispatch
+# (overlap evidence, docs/data.md).  Callbacks must be cheap and never
+# raise into the dispatch path.
+_dispatch_callbacks = []
+
+
+def register_dispatch_callback(cb):
+    """Register ``cb(reason)`` to run after each async segment dispatch."""
+    if cb not in _dispatch_callbacks:
+        _dispatch_callbacks.append(cb)
+
+
+def unregister_dispatch_callback(cb):
+    try:
+        _dispatch_callbacks.remove(cb)
+    except ValueError:
+        pass
+
+
 def _submit_async(seg, reason):
     """Hand a finalized segment to the executor (blocking when the
     bounded queue is full — backpressure) and track it for drain."""
@@ -607,6 +628,11 @@ def _submit_async(seg, reason):
     if telemetry._enabled:
         telemetry.gauge("engine.async_queue_depth", depth)
     _EXEC.q.put((seg, reason))
+    for cb in tuple(_dispatch_callbacks):
+        try:
+            cb(reason)
+        except Exception:
+            pass
     _TLS.last_async = seg
     inflight = _TLS.inflight
     if len(inflight) >= 4:

@@ -386,6 +386,7 @@ class DataLoader:
             raise MXNetError("process-mode DataLoader supports one active "
                              "iterator at a time")
         buffered = {}
+        failed = False
         try:
             procs, index_q, result_q = self._ensure_pool()
             self._epoch = epoch = getattr(self, "_epoch", 0) + 1
@@ -431,6 +432,16 @@ class DataLoader:
                                  list(batches[submitted])))
                     submitted += 1
                 yield _attach_result(tmpl, metas)
+        except GeneratorExit:
+            # the consumer abandoned the epoch (break / del): keep the
+            # persistent pool alive for the next one
+            raise
+        except BaseException:
+            # a FAILED epoch (worker death, timeout, bad sample) must not
+            # leave orphaned worker processes behind — tear the pool down;
+            # the next iteration respawns it via _ensure_pool()
+            failed = True
+            raise
         finally:
             # free every result this epoch will never consume: buffered
             # ones and whatever already landed in the queue
@@ -445,6 +456,8 @@ class DataLoader:
                 if err is None:
                     _free_metas(metas)
             self._iter_lock.release()
+            if failed:
+                self.close()
 
     def close(self):
         """Shut the worker pool down (also runs at GC), freeing any

@@ -361,6 +361,25 @@ class Trainer:
     def set_learning_rate(self, lr):
         self._optimizer.set_learning_rate(lr)
 
+    def attach_data_prefetcher(self, prefetcher):
+        """Associate a ``data.DevicePrefetcher`` (or a
+        ``data.StreamingLoader`` wrapping one) with this trainer: every
+        ``step()`` samples its buffered-batch depth right after the
+        update dispatch — the moment the NEXT batch's transfer should
+        already be in flight.  A healthy overlapped pipeline holds the
+        ``data.prefetch_depth`` gauge near its configured depth; a
+        starving one sits at 0 (docs/data.md)."""
+        self._data_prefetcher = prefetcher
+
+    def _poke_data_prefetcher(self):
+        p = getattr(self, "_data_prefetcher", None)
+        if p is None:
+            return
+        # StreamingLoader wraps the prefetcher; accept either
+        q = getattr(getattr(p, "_prefetcher", p), "_q", None)
+        if q is not None:
+            telemetry.gauge("data.prefetch_depth", q.qsize())
+
     # -- the step ------------------------------------------------------------
     def step(self, batch_size, ignore_stale_grad=False):
         """Allreduce gradients and apply one optimizer update, scaling
@@ -378,6 +397,7 @@ class Trainer:
             self._allreduce_grads()
             self._update(ignore_stale_grad)
             self._offload_prefetched = {}
+            self._poke_data_prefetcher()
 
     def allreduce_grads(self):
         if not self._kv_initialized:

@@ -32,7 +32,7 @@ __all__ = ["LlamaConfig", "RMSNorm", "LlamaAttention", "LlamaMLP",
            "LlamaDecoder", "llama3_8b", "llama_tiny", "mixtral_8x7b",
            "mixtral_tiny", "shard_llama", "llama_param_pspecs",
            "llama_pipeline_forward", "llama_pipeline_train_step",
-           "LLAMA_CONFIGS"]
+           "packed_lm_loss", "LLAMA_CONFIGS"]
 
 
 class LlamaConfig:
@@ -176,7 +176,7 @@ class LlamaAttention(HybridBlock):
         cos, sin = self._rope_cache[t]
         return jnp.asarray(cos), jnp.asarray(sin)
 
-    def hybrid_forward(self, F, x, **params):
+    def hybrid_forward(self, F, x, segment_ids=None, **params):
         from ..ops.registry import apply_op
 
         cfg = self._cfg
@@ -186,7 +186,7 @@ class LlamaAttention(HybridBlock):
         v = self.v_proj(x)
         cos, sin = self._rope(t)
 
-        def _attend(qr, kr, vr):
+        def _heads(qr, kr, vr):
             import jax.numpy as jnp
 
             hd = cfg.head_dim
@@ -201,6 +201,11 @@ class LlamaAttention(HybridBlock):
             if rep > 1:
                 kh = jnp.repeat(kh, rep, axis=1)
                 vh = jnp.repeat(vh, rep, axis=1)
+            return qh, kh, vh
+
+        def _attend(qr, kr, vr):
+            qh, kh, vh = _heads(qr, kr, vr)
+            hd = cfg.head_dim
             if cfg.attn_mode in ("ring", "ulysses"):
                 from ..parallel import ring as _ring
 
@@ -220,8 +225,53 @@ class LlamaAttention(HybridBlock):
                 out = _sdpa_ref(qh, kh, vh, True, 1.0 / math.sqrt(hd))
             return out.transpose(0, 2, 1, 3).reshape(b, t, -1)
 
-        ctx = apply_op(_attend, q, k, v, name="llama_attention")
+        def _attend_packed(qr, kr, vr, segr):
+            # packed-batch path: causal AND same-segment, the serving
+            # slots' mask shape (LlamaDecoder._attend) applied to
+            # training.  Flash/ring modes have no segment support, so
+            # packing always takes the dense masked sdpa.
+            qh, kh, vh = _heads(qr, kr, vr)
+            out = _sdpa_segmented(qh, kh, vh, segr,
+                                  1.0 / math.sqrt(cfg.head_dim))
+            return out.transpose(0, 2, 1, 3).reshape(b, t, -1)
+
+        if segment_ids is not None:
+            ctx = apply_op(_attend_packed, q, k, v, segment_ids,
+                           name="llama_attention_packed")
+        else:
+            ctx = apply_op(_attend, q, k, v, name="llama_attention")
         return self.o_proj(ctx)
+
+
+def _segment_causal_mask(seg):
+    """(B, T) int segment ids → (B, 1, T, T) bool attention mask:
+    causal AND same-segment, the packed-batch analogue of the per-slot
+    mask the serving step builds in ``LlamaDecoder._attend``.  The
+    diagonal is always legal (``seg[q] == seg[q]``), so no query row is
+    fully masked and the dense softmax stays NaN-free even on padding
+    rows (segment id 0); padding positions only see other padding and
+    their loss is masked out anyway (``data.PackedBatch.loss_mask``)."""
+    import jax.numpy as jnp
+
+    seg = seg.astype(jnp.int32)
+    t = seg.shape[1]
+    same = seg[:, :, None] == seg[:, None, :]
+    causal = jnp.arange(t)[:, None] >= jnp.arange(t)[None, :]
+    return (same & causal[None])[:, None]
+
+
+def _sdpa_segmented(q, k, v, seg, scale):
+    """Dense sdpa with the segment-causal mask — f32 score accumulation
+    like ``_sdpa_ref``/the serving ``_attend``.  q/k/v (B, H, T, D)
+    post-GQA-repeat, seg (B, T) int."""
+    import jax
+    import jax.numpy as jnp
+
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    s = jnp.where(_segment_causal_mask(seg), s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)
 
 
 class LlamaMLP(HybridBlock):
@@ -266,8 +316,11 @@ class LlamaDecoderLayer(HybridBlock):
             else:
                 self.mlp = LlamaMLP(cfg, prefix="mlp_")
 
-    def hybrid_forward(self, F, x):
-        x = x + self.self_attn(self.input_layernorm(x))
+    def hybrid_forward(self, F, x, segment_ids=None):
+        if segment_ids is None:
+            x = x + self.self_attn(self.input_layernorm(x))
+        else:
+            x = x + self.self_attn(self.input_layernorm(x), segment_ids)
         return x + self.mlp(self.post_attention_layernorm(x))
 
 
@@ -285,13 +338,14 @@ class LlamaModel(HybridBlock):
             self.norm = RMSNorm(cfg.hidden_size, cfg.rms_eps,
                                 prefix="norm_")
 
-    def hybrid_forward(self, F, input_ids):
+    def hybrid_forward(self, F, input_ids, segment_ids=None):
         h = self.embed_tokens(input_ids)
         if self._cfg.scan_layers and len(self.layers) > 1:
-            h = _apply_layers_scanned(self, h)
+            h = _apply_layers_scanned(self, h, segment_ids)
         else:
             for layer in self.layers:
-                h = layer(h)
+                h = layer(h) if segment_ids is None \
+                    else layer(h, segment_ids)
         return self.norm(h)
 
 
@@ -312,8 +366,17 @@ class LlamaForCausalLM(HybridBlock):
     def config(self):
         return self._cfg
 
-    def hybrid_forward(self, F, input_ids):
-        h = self.model(input_ids)
+    def hybrid_forward(self, F, input_ids, segment_ids=None):
+        """``segment_ids`` (B, T) int — packed-pretraining mode
+        (``data.SequencePacker``): attention is masked to causal ∧
+        same-segment so packed documents never see each other.  One
+        compile signature either way: the packed batch shape is fixed
+        by the packer, and segment ids ride as a second traced input,
+        not as shape variation."""
+        if segment_ids is None:
+            h = self.model(input_ids)
+        else:
+            h = self.model(input_ids, segment_ids)
         return _lm_head(self, h)
 
     def set_remat(self, tier):
@@ -876,6 +939,29 @@ def _lm_head(net, h):
     return net.lm_head(h)
 
 
+def packed_lm_loss(logits, labels, loss_mask):
+    """Mean next-token cross-entropy over a packed batch, masked to the
+    real targets (``data.PackedBatch``: padding and each document's
+    last position carry ``loss_mask`` 0 — no cross-document
+    prediction).  f32 log-softmax accumulation like
+    ``softmax_cross_entropy``; that op sums over the whole batch, which
+    can't express a per-token mask — hence this dedicated raw op.
+    logits (B, T, V), labels (B, T) int, loss_mask (B, T) float."""
+    from ..ops.registry import apply_op
+
+    def f(lg, lb, m):
+        import jax
+        import jax.numpy as jnp
+
+        lp = jax.nn.log_softmax(lg.astype(jnp.float32), axis=-1)
+        ll = jnp.take_along_axis(
+            lp, lb[..., None].astype(jnp.int32), axis=-1)[..., 0]
+        mf = m.astype(jnp.float32)
+        return -(ll * mf).sum() / jnp.maximum(mf.sum(), 1.0)
+
+    return apply_op(f, logits, labels, loss_mask, name="packed_lm_loss")
+
+
 def llama_pipeline_forward(net, input_ids, n_microbatches, mesh=None,
                            axis_name="pp"):
     """Forward the SAME ``LlamaForCausalLM`` Block over a GPipe pipeline
@@ -930,7 +1016,7 @@ def llama_pipeline_forward(net, input_ids, n_microbatches, mesh=None,
     return _lm_head(net, h_out)
 
 
-def _apply_layers_scanned(model, h):
+def _apply_layers_scanned(model, h, segment_ids=None):
     """cfg.scan_layers: apply the decoder stack as
     ``lax.scan(checkpoint_wrap(layer, tier))`` over a stacked parameter
     tree, the tier resolved by the memory policy (default "layer").
@@ -947,7 +1033,8 @@ def _apply_layers_scanned(model, h):
     from ..ops import tensor as tops
     from ..ops.registry import apply_op
 
-    mach = _scan_machinery(model, _resolve_model_remat(model, h))
+    mach = _scan_machinery(model, _resolve_model_remat(model, h),
+                           with_seg=segment_ids is not None)
     names, shells = mach["names"], mach["shells"]
     per_layer = [ly._collect_params_with_prefix()
                  for ly in model.layers]
@@ -955,6 +1042,9 @@ def _apply_layers_scanned(model, h):
                for n in names]
     saved = [sh._data for sh in shells]
     try:
+        if segment_ids is not None:
+            return apply_op(mach["fn"], h, segment_ids, *stacked,
+                            name="scan_layers_packed")
         return apply_op(mach["fn"], h, *stacked, name="scan_layers")
     finally:
         for sh, s in zip(shells, saved):
@@ -995,13 +1085,15 @@ def _resolve_model_remat(model, h):
     return tier
 
 
-def _scan_machinery(model, remat="layer"):
-    """Cached per-(model, remat-tier) scan plumbing (identity-stable
-    like :func:`_pipeline_machinery`, so jit caches hit across steps;
-    a tier change rebuilds)."""
+def _scan_machinery(model, remat="layer", with_seg=False):
+    """Cached per-(model, remat-tier, packed?) scan plumbing
+    (identity-stable like :func:`_pipeline_machinery`, so jit caches
+    hit across steps; a tier change — or switching between packed and
+    plain batches — rebuilds)."""
     cache = getattr(model, "_scan_mach", None)
     # remat is a host-side tier string, never a tracer
-    if cache is not None and cache["remat"] == remat:  # mxlint: allow=T2
+    if (cache is not None and cache["remat"] == remat  # mxlint: allow=T2
+            and cache["with_seg"] == with_seg):
         return cache
     from ..gluon.block import _trace_guard
     from ..memory.policy import checkpoint_wrap
@@ -1009,24 +1101,44 @@ def _scan_machinery(model, remat="layer"):
 
     template, names, shells = _layer_template(list(model.layers))
 
-    def apply_one(sl, carry):
-        for sh, s in zip(shells, sl):
-            sh._data = s
-        with _trace_guard():  # inline the template body (no nested jit)
-            return template(NDArray(carry))._data
+    if with_seg:
+        # packed path: segment ids are a scan-invariant second input to
+        # every layer (same (B, T) array each iteration — lax.scan
+        # closes over it, only the stacked params are scanned)
+        def apply_one(sl, carry, segr):
+            for sh, s in zip(shells, sl):
+                sh._data = s
+            with _trace_guard():  # inline the template (no nested jit)
+                return template(NDArray(carry), NDArray(segr))._data
+    else:
+        def apply_one(sl, carry):
+            for sh, s in zip(shells, sl):
+                sh._data = s
+            with _trace_guard():  # inline the template (no nested jit)
+                return template(NDArray(carry))._data
 
     import jax
 
     wrapped = checkpoint_wrap(apply_one, remat)
 
-    def _scan_raw(hr, *stk):
-        from jax import lax
+    if with_seg:
+        def _scan_raw(hr, segr, *stk):
+            from jax import lax
 
-        def body(carry, sl):
-            return wrapped(sl, carry), ()
+            def body(carry, sl):
+                return wrapped(sl, carry, segr), ()
 
-        out, _ = lax.scan(body, hr, tuple(stk))
-        return out
+            out, _ = lax.scan(body, hr, tuple(stk))
+            return out
+    else:
+        def _scan_raw(hr, *stk):
+            from jax import lax
+
+            def body(carry, sl):
+                return wrapped(sl, carry), ()
+
+            out, _ = lax.scan(body, hr, tuple(stk))
+            return out
 
     # jit the scan program: (a) eager steps run ONE compiled program
     # instead of a traced-eager loop, and (b) shard_map-based layers
@@ -1035,7 +1147,8 @@ def _scan_machinery(model, remat="layer"):
     fn = jax.jit(_scan_raw)
 
     cache = {"names": names, "shells": shells, "fn": fn,
-             "apply_one": apply_one, "remat": remat}
+             "apply_one": apply_one, "remat": remat,
+             "with_seg": with_seg}
     model._scan_mach = cache
     return cache
 

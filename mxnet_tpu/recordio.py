@@ -158,10 +158,21 @@ class MXIndexedRecordIO(MXRecordIO):
         super().__init__(uri, flag)
         if not self.writable and os.path.isfile(idx_path):
             with open(idx_path) as fin:
-                for line in fin:
-                    parts = line.strip().split("\t")
-                    key = key_type(parts[0])
-                    self.idx[key] = int(parts[1])
+                for lineno, line in enumerate(fin, 1):
+                    stripped = line.strip()
+                    if not stripped:
+                        # tolerate trailing newline / blank lines — im2rec
+                        # and hand-edited indexes both produce them
+                        continue
+                    parts = stripped.split("\t")
+                    try:
+                        key = key_type(parts[0])
+                        offset = int(parts[1])
+                    except (IndexError, ValueError) as exc:
+                        raise MXNetError(
+                            f"corrupt index line {lineno} in "
+                            f"{idx_path!r}: {stripped!r}") from exc
+                    self.idx[key] = offset
                     self.keys.append(key)
 
     def close(self):

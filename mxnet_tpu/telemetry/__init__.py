@@ -391,6 +391,7 @@ def step_end(examples=None, **extra):
             "cachedop_cache_miss": sc.get("cachedop.cache_miss", 0),
             "bulk_flush": sc.get("engine.bulk_flush", 0),
             "bulk_async_wait_ms": sc.get("engine.bulk_async_wait_ms", 0.0),
+            "data_wait_ms": sc.get("data.wait_ms", 0.0),
             "ckpt_saves": sc.get("ckpt.save", 0),
             "ckpt_bytes": sc.get("ckpt.bytes", 0),
             "ckpt_async_overlap_ms": sc.get("ckpt.async_overlap_ms", 0.0),

@@ -1,0 +1,81 @@
+"""Loopback training worker for the data-plane elastic suite.
+
+NOT a test module — ``tests/test_data_plane.py`` launches this under
+``tools/launch.py`` with the ``_preempt_worker.py`` env contract, plus:
+
+  REC_DIR   directory of .rec/.idx shards every rank streams from
+
+The loop is the r14 contract under test: the SAME elastic 2→1→2 resume
+the preemption worker proves, but fed through the REAL streaming data
+plane (ShardedRecordReader → StreamingLoader → DevicePrefetcher) over
+record files instead of an in-memory array — sample order stays a pure
+function of (seed, step), so per-step losses and final params must
+match fixed-size oracles exactly.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.environ["REPO_ROOT"])
+os.environ.pop("XLA_FLAGS", None)
+import jax
+
+jax.config.update("jax_platforms", os.environ.get("JAX_PLATFORMS", "cpu"))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, checkpoint, data, gluon, nd, parallel
+from mxnet_tpu import telemetry
+
+parallel.initialize()
+rank, world = jax.process_index(), jax.process_count()
+
+mx.random.seed(42)
+net = gluon.nn.Dense(3, use_bias=True)
+net.initialize(mx.init.Xavier())
+net(nd.ones((1, 5)))
+trainer = gluon.Trainer(net.collect_params(), "sgd",
+                        {"learning_rate": 0.1, "momentum": 0.9},
+                        kvstore="dist_tpu_sync")
+
+ckpt_dir = os.environ["CKPT_DIR"]
+total = int(os.environ["TOTAL_STEPS"])
+loss_file = os.environ.get("LOSS_FILE")
+BATCH = 8
+
+start, _ = checkpoint.resume(ckpt_dir, net, trainer)
+if start:
+    print(f"rank {rank}: resumed from step {start} (world={world})",
+          flush=True)
+
+# the real data plane: resume = construct at the checkpointed step;
+# there is no loader state to restore (docs/data.md)
+reader = data.ShardedRecordReader(os.environ["REC_DIR"], batch_size=BATCH,
+                                  seed=5)
+loader = data.StreamingLoader(
+    reader, transform=lambda b: np.frombuffer(b, dtype=np.float32),
+    num_workers=2, prefetch_depth=2, start_step=start,
+    num_steps=total - start)
+trainer.attach_data_prefetcher(loader)
+
+for step, x in zip(range(start, total), loader):
+    telemetry.step_begin()
+    with autograd.record():
+        loss = (net(x) ** 2).sum()
+    loss.backward()
+    trainer.step(BATCH)
+    gloss = parallel.process_sum_hostvec(
+        np.asarray([float(loss.asnumpy())], dtype=np.float64))[0]
+    telemetry.step_end(examples=BATCH, loss=float(gloss),
+                       global_step=step)
+    if rank == 0:
+        if loss_file:
+            with open(loss_file, "a") as f:
+                f.write(f"{step} {gloss:.9e}\n")
+        checkpoint.save_checkpoint(ckpt_dir, step + 1, net, trainer)
+
+loader.close()
+np.save(os.environ["OUT_FILE"] + str(rank) + ".npy",
+        np.concatenate([net.weight.data().asnumpy().ravel(),
+                        net.bias.data().asnumpy().ravel()]))
+print(f"rank {rank}: done at step {total}", flush=True)

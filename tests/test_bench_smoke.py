@@ -256,6 +256,41 @@ def test_fleet_overhead_bench_emits_artifact(tmp_path):
     assert rec["acceptance"]["fleet_overhead_under_1pct"]
 
 
+def test_data_plane_bench_emits_artifact(tmp_path):
+    """benchmark/input_pipeline.py --data-plane on the 8-device CPU mesh
+    must emit the DATA_PLANE artifact with both trainer-fed lanes (image
+    + packed LLM), steady-state data_wait_ms p50 ~ 0 (prefetch overlap
+    holds), >= 85% packing efficiency, and zero steady compile misses
+    (ONE (B, T) signature over a mixed-length corpus) — the round-14
+    evidence the streaming data plane keeps a stock Trainer fed."""
+    out = tmp_path / "data_plane.json"
+    env = dict(os.environ)
+    env.update(BENCH_PLATFORM="cpu", BENCH_STEPS="3", BENCH_WARMUP="1",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8",
+               MXT_DATA_PLANE_OUT=str(out))
+    env.pop("JAX_PLATFORMS", None)
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "benchmark",
+                                      "input_pipeline.py"),
+         "--data-plane"],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=600)
+    assert r.returncode == 0, r.stderr[-2000:]
+    rec = json.loads(out.read_text())
+    assert rec["metric"] == "data_plane_data_wait_ms_p50"
+    assert set(rec["lanes"]) == {"image", "packed_llm"}
+    for lane in rec["lanes"].values():
+        assert lane["compile_miss_steady"] == 0
+        assert lane["compile_miss_warmup"] > 0
+        assert lane["data_wait_ms_p50"] <= lane["data_wait_ms_p99"]
+        assert lane["step_ms_median"] > 0
+    assert rec["lanes"]["image"]["images_per_sec"] > 0
+    pk = rec["lanes"]["packed_llm"]
+    assert pk["packed_tokens_per_sec"] > 0
+    assert pk["packing"]["efficiency"] >= 0.85
+    assert pk["packing"]["docs_packed"] > 0
+    assert all(rec["acceptance"].values()), rec["acceptance"]
+
+
 def test_remat_ab_bench_emits_artifact(tmp_path):
     """benchmark/remat_ab.py at toy step counts must emit the REMAT_AB
     artifact with every tier lane for both models, bit-identical loss

@@ -101,6 +101,21 @@ def test_t1_lane_materialize_def_is_exempt():
                and v.context == "_hot_lane_materialize" for v in vs)
 
 
+def test_t1_data_prefetch_def_is_exempt():
+    """The data plane's transfer-thread wait (data/prefetch.py
+    ``_prefetch``) gets the same scoped exemption as serving's
+    ``_materialize`` — eager only."""
+    vs = _rule(_analyze("t1_data_prefetch.py"), "T1")
+    assert not any(v.context == "_prefetch" for v in vs)
+    assert not any(v.context == "loader_loop" for v in vs)
+    assert any(v.severity == "warning" and v.context == "leaky_wait"
+               and "block_until_ready" in v.message for v in vs)
+    assert any(v.severity == "error"
+               and v.context == "bad_traced_prefetch" for v in vs)
+    assert any(v.severity == "error"
+               and v.context == "_hot_prefetch" for v in vs)
+
+
 def test_t2_flags_control_flow_on_traced_values():
     vs = _rule(_analyze("t2_control_flow.py"), "T2")
     kinds = {(v.context, v.message.split("`")[1]) for v in vs}

@@ -1,0 +1,44 @@
+"""T1 fixture: the data plane's prefetch-thread materialize site.
+
+``DevicePrefetcher._prefetch`` (data/prefetch.py) lands each batch on
+device and waits for the transfer on the background thread — the sync
+IS the prefetch.  A def named ``_prefetch`` (MATERIALIZE_DEFS) gets the
+scoped eager exemption; the same sync elsewhere in loader glue still
+warns, and inside a traced region it stays an error regardless.
+"""
+import jax
+
+
+def _prefetch(batches, put):
+    out = []
+    for b in batches:
+        dev = put(b)
+        dev.block_until_ready()       # fine: THE transfer-thread wait
+        out.append(dev)
+    return out
+
+
+def loader_loop(batches, put, q):
+    for dev in _prefetch(batches, put):   # fine: sanctioned helper call
+        q.put(dev)
+
+
+def leaky_wait(dev):
+    return dev.block_until_ready()    # T1 warning: sync outside the
+                                      # designated prefetch def
+
+
+def bad_traced_prefetch(w, x):
+    y = w * x
+    return y.block_until_ready()      # T1 error: sync inside a trace
+
+
+def _hot_prefetch(arrays):
+    # the exemption covers EAGER warnings only: a traced sync is an
+    # error no matter how prefetch-ish the def's name is
+    first = arrays[0]
+    return first.asnumpy()            # T1 error: traced sync
+
+
+bad_traced_jit = jax.jit(bad_traced_prefetch)
+hot_prefetch_jit = jax.jit(_hot_prefetch)

@@ -90,7 +90,13 @@ SYNC_METHODS_ANYWHERE = {"asnumpy", "asscalar", "item",
 #: allgather of the packed step-stats vector: an intentional eager
 #: collective+sync at the fleet-exchange boundary, never per-step and
 #: never inside a trace — exempt the same way.
-MATERIALIZE_DEFS = {"_materialize", "_lane_materialize", "_fleet_exchange"}
+#: ``_prefetch`` (data/prefetch.py, r14) is the data plane's transfer
+#: thread: it device_puts the NEXT batch and ``block_until_ready``s it
+#: so the trainer inherits a landed array instead of a lazy copy — the
+#: sync IS the prefetch, off the consumer thread by construction, never
+#: in a trace.
+MATERIALIZE_DEFS = {"_materialize", "_lane_materialize", "_fleet_exchange",
+                    "_prefetch"}
 
 #: function-style syncs, matched on dotted name
 SYNC_FUNCS_ANYWHERE = {"jax.device_get"}
