@@ -45,6 +45,7 @@ import warnings
 import numpy as np
 
 from .base import MXNetError
+from . import sanitizer as _san
 from . import telemetry
 
 __all__ = ["save_checkpoint", "save_checkpoint_async", "AsyncCheckpointer",
@@ -95,7 +96,7 @@ def _tree_bytes(root):
 # runs them back-to-back; the async path runs snapshot on the caller and
 # write+commit on the writer thread.
 
-_STAGE_LOCK = threading.Lock()
+_STAGE_LOCK = _san.wrap_lock(threading.Lock(), "checkpoint._STAGE_LOCK")
 _STAGE_SEQ = 0
 
 
@@ -275,7 +276,8 @@ class AsyncCheckpointer:
         self._queue = queue.Queue()
         self._pending = []          # tickets not yet known-done
         self._errors = []           # writer errors not yet re-raised
-        self._lock = threading.Lock()
+        self._lock = _san.wrap_lock(
+            threading.Lock(), "checkpoint.AsyncCheckpointer._lock")
         self._thread = None
 
     # -- public surface ------------------------------------------------------
@@ -383,7 +385,8 @@ class AsyncCheckpointer:
 
 
 _DEFAULT_ASYNC = None
-_DEFAULT_ASYNC_LOCK = threading.Lock()
+_DEFAULT_ASYNC_LOCK = _san.wrap_lock(threading.Lock(),
+                                     "checkpoint._DEFAULT_ASYNC_LOCK")
 
 
 def async_checkpointer():

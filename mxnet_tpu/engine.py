@@ -101,6 +101,7 @@ from collections import OrderedDict
 import numpy as np
 
 from .base import MXNetError
+from . import sanitizer as _san
 from . import telemetry
 from .telemetry import costs as _costs
 from .telemetry import memwatch as _mw
@@ -400,14 +401,14 @@ class _Segment:
                     # async caller sees the error at materialization
                     # instead of a silently result-less segment
                     names = ", ".join(op.name or "op" for op in self.ops[:8])
-                    self._fail(MXNetError(
+                    self._fail_locked(MXNetError(
                         f"bulked segment of {len(self.ops)} ops ({names}) "
                         f"failed at flush ({reason}): {e}"))
                 raise
             finally:
                 self._done.set()
 
-    def _fail(self, exc):
+    def _fail_locked(self, exc):
         self.error = exc
         self.ops = ()
         self.ext = ()
@@ -416,8 +417,6 @@ class _Segment:
         return exc
 
     def _execute_locked(self, reason):
-        from . import sanitizer as _san
-
         n_ops = len(self.ops)
         telemetry.count("engine.bulk_flush")
         telemetry.count("engine.bulk_flush." + reason)
@@ -427,14 +426,15 @@ class _Segment:
             # submitted before this one, so on the worker they are done;
             # a caller-side (sync fallback) resolution may block briefly
             telemetry.count("engine.bulk_stitch")
-            _async_stats["stitched_segments"] += 1
+            with _STATS_LOCK:
+                _async_stats["stitched_segments"] += 1
             ext = self.ext
             for i, r in enumerate(ext):
                 if r.__class__ is _StitchRef:
                     src = r.segment
                     src._done.wait()
                     if src.error is not None:
-                        raise self._fail(MXNetError(
+                        raise self._fail_locked(MXNetError(
                             f"bulked segment of {n_ops} ops consumed the "
                             f"output of an upstream stitched segment that "
                             f"failed: {src.error}")) from src.error
@@ -446,7 +446,7 @@ class _Segment:
                 try:
                     _san.check(raw, "bulk segment input")
                 except MXNetError as e:
-                    raise self._fail(e)
+                    raise self._fail_locked(e)
         # liveness pruning: only slots whose placeholder is still
         # referenced (directly by an NDArray, or strongly via a consumer
         # segment's _StitchRef) leave the compiled fn — dead
@@ -483,13 +483,13 @@ class _Segment:
                                 else "engine.bulk_replay"):
                 res = entry.jfn(scalars, *self.ext)
         except MXNetError as e:
-            self._fail(e)
+            self._fail_locked(e)
             raise
         except Exception as e:
             names = ", ".join(op.name or "op" for op in self.ops[:8])
             if _mw._enabled:
                 _mw.annotate_oom(e, context=f"bulk segment flush ({reason})")
-            raise self._fail(MXNetError(
+            raise self._fail_locked(MXNetError(
                 f"bulked segment of {n_ops} ops ({names}{', ...' if n_ops > 8 else ''}) "
                 f"failed at flush ({reason}): {e}")) from e
         finally:
@@ -548,13 +548,18 @@ def _weak_scalar(v):
 _async_stats = {"submitted": 0, "stitched_segments": 0,
                 "stitched_inputs": 0, "max_queue_depth": 0,
                 "wait_ms": 0.0}
+#: guards _async_stats: caller threads bump counters while the async
+#: worker bumps stitched_segments; keep this lock a LEAF (never acquire
+#: another lock under it)
+_STATS_LOCK = _san.wrap_lock(threading.Lock(), "engine._STATS_LOCK")
 
 
 class _AsyncExecutor:
     def __init__(self, maxsize):
         self.q = queue.Queue(maxsize)
         self._thread = None
-        self._lock = threading.Lock()
+        self._lock = _san.wrap_lock(threading.Lock(),
+                                    "engine._AsyncExecutor._lock")
 
     def ensure_thread(self):
         if self._thread is not None and self._thread.is_alive():
@@ -622,9 +627,10 @@ def _submit_async(seg, reason):
     seg.submitted = True
     _EXEC.ensure_thread()
     depth = _EXEC.q.qsize() + 1
-    _async_stats["submitted"] += 1
-    if depth > _async_stats["max_queue_depth"]:
-        _async_stats["max_queue_depth"] = depth
+    with _STATS_LOCK:
+        _async_stats["submitted"] += 1
+        if depth > _async_stats["max_queue_depth"]:
+            _async_stats["max_queue_depth"] = depth
     if telemetry._enabled:
         telemetry.gauge("engine.async_queue_depth", depth)
     _EXEC.q.put((seg, reason))
@@ -652,7 +658,8 @@ def _wait_done(seg):
     t0 = time.perf_counter()
     seg._done.wait()
     ms = (time.perf_counter() - t0) * 1e3
-    _async_stats["wait_ms"] += ms
+    with _STATS_LOCK:
+        _async_stats["wait_ms"] += ms
     if telemetry._enabled:
         telemetry.count("engine.bulk_async_wait_ms", ms)
 
@@ -691,7 +698,8 @@ atexit.register(shutdown_async)
 def async_stats():
     """Counters for the async tier: segments submitted/stitched, the
     max observed queue depth and cumulative caller stall (ms)."""
-    return dict(_async_stats)
+    with _STATS_LOCK:
+        return dict(_async_stats)
 
 
 def _with_cells(fun, lift, values):
@@ -760,7 +768,7 @@ def _build_segment_fn(ops, n_slots, keep=None):
 
 _SEG_CACHE = OrderedDict()
 _SEG_CACHE_MAX = max(1, _env_int("MXT_ENGINE_SEGMENT_CACHE", 256))
-_SEG_LOCK = threading.Lock()
+_SEG_LOCK = _san.wrap_lock(threading.Lock(), "engine._SEG_LOCK")
 _seg_stats = {"hit": 0, "miss": 0}
 
 
@@ -1469,7 +1477,8 @@ def maybe_defer(fun, nd_args, name):
                        in_refs, name), lift, lifted))
     if stitched:
         seg.stitched += stitched
-        _async_stats["stitched_inputs"] += stitched
+        with _STATS_LOCK:
+            _async_stats["stitched_inputs"] += stitched
     # placeholders are created BEFORE the flush below so the liveness
     # scan in _execute_locked always sees this op's outputs as live
     if n_out == 1:

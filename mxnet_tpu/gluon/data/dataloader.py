@@ -541,8 +541,9 @@ class DataLoader:
                     results[i] = out
                     cond.notify_all()
 
-        threads = [threading.Thread(target=worker, daemon=True)
-                   for _ in range(self._num_workers)]
+        threads = [threading.Thread(target=worker, daemon=True,
+                                    name=f"mxt-dataloader-w{i}")
+                   for i in range(self._num_workers)]
         for t in threads:
             t.start()
         try:

@@ -395,7 +395,8 @@ class PrefetchingIter(DataIter):
                     return
                 self._queue.put(batch)
 
-        self._thread = threading.Thread(target=worker, daemon=True)
+        self._thread = threading.Thread(target=worker, daemon=True,
+                                        name="mxt-io-prefetch")
         self._thread.start()
 
     def reset(self):

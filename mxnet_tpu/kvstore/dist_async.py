@@ -417,7 +417,8 @@ def serve_forever(uri, ps=None, background=True, secret=_ENV_SECRET):
     srv.secret = _secret() if secret is _ENV_SECRET else \
         (secret.encode() if isinstance(secret, str) else secret)
     if background:
-        t = threading.Thread(target=srv.serve_forever, daemon=True)
+        t = threading.Thread(target=srv.serve_forever, daemon=True,
+                             name="mxt-ps-server")
         t.start()
     else:
         srv.serve_forever()
@@ -477,7 +478,8 @@ class AsyncPSKVStore:
                 self._sock, self._wire_secret, hello[1:], is_server=False)
         else:
             self._local = PSServer()
-        self._sender = threading.Thread(target=self._drain, daemon=True)
+        self._sender = threading.Thread(target=self._drain, daemon=True,
+                                        name="mxt-ps-sender")
         self._sender.start()
         self._compression = None
 

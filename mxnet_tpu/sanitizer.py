@@ -38,12 +38,16 @@ docs/observability.md.
 from __future__ import annotations
 
 import os
+import threading
 import weakref
 
 from .base import MXNetError
 
 __all__ = ["DonatedBufferError", "is_enabled", "enable", "disable",
-           "donate", "site_of", "check", "reset"]
+           "donate", "site_of", "check", "reset",
+           "wrap_lock", "locks_enabled", "enable_locks", "disable_locks",
+           "reset_locks", "lock_order_edges", "lock_order_violations",
+           "held_blocking_events", "set_trace_hook"]
 
 
 class DonatedBufferError(MXNetError):
@@ -136,3 +140,247 @@ def check(raw, op: str = "read"):
             "param.data()) after the donating call, or .copy() the array "
             "before it.  (Detected by MXNET_SANITIZE_DONATION=1; see "
             "docs/lint.md T6/T7 for the donation contract.)")
+
+
+# ---------------------------------------------------------------------------
+# Lock-order sanitizer (``MXNET_SANITIZE_LOCKS=1``)
+# ---------------------------------------------------------------------------
+# Runtime twin of mxlint's T10/T11 (tools/lint/concurrency.py): the
+# package's named locks are wrapped in :class:`_SanLock`, which — when
+# enabled — records per-thread held-lock stacks, the observed
+# acquisition-order edges (held -> acquired), and held-while-blocking
+# events (acquiring a contended lock while already holding one).  A
+# cycle in the observed edge set is a lock-order violation: two threads
+# took the same locks in opposite orders and a deadlock is one bad
+# schedule away.  The static analyzer computes the same graph from the
+# AST; lock names here match its identities (``engine._SEG_LOCK``,
+# ``lanes.DecodeLane._hand_lock``) so the two graphs union and
+# cross-check (tests/test_race.py).
+#
+# Disabled cost (the default): ``acquire``/``release``/``__enter__``/
+# ``__exit__`` check one module-global boolean and delegate — the
+# telemetry-null-path contract, pinned by the overhead-bound test in
+# tests/test_sanitizer_locks.py.
+#
+# ``set_trace_hook`` exposes the acquire/acquired/released event stream;
+# tools/race.py attaches here to park threads at lock boundaries and
+# drive a chosen interleaving deterministically.
+
+
+def _locks_env_on() -> bool:
+    return os.environ.get("MXNET_SANITIZE_LOCKS", "").strip().lower() \
+        not in ("", "0", "false", "off", "no")
+
+
+#: fast-path flag: read unlocked in every _SanLock method, flipped only
+#: by enable_locks()/disable_locks().
+_locks_enabled = _locks_env_on()
+
+#: guards the registries below (never wrapped itself)
+_locks_lock = threading.Lock()
+
+#: (src name, dst name) -> first-observed site "thread-name"
+_order_edges = {}
+
+#: held-while-blocking events: (held name, wanted name, thread name)
+_blocked_events = []
+
+#: optional callable(event, lock_name) with event in
+#: {"acquire", "acquired", "released"}; called OUTSIDE _locks_lock
+_trace_hook = None
+
+#: per-thread stack of _SanLock names currently held
+_held = threading.local()
+
+
+def _held_stack():
+    stack = getattr(_held, "stack", None)
+    if stack is None:
+        stack = _held.stack = []
+    return stack
+
+
+def locks_enabled() -> bool:
+    return _locks_enabled
+
+
+def enable_locks():
+    """Turn the lock sanitizer on (tests; production uses the env var)."""
+    global _locks_enabled
+    _locks_enabled = True
+
+
+def disable_locks():
+    global _locks_enabled
+    _locks_enabled = False
+
+
+def reset_locks():
+    """Forget every recorded edge/event (keeps the enabled state)."""
+    with _locks_lock:
+        _order_edges.clear()
+        del _blocked_events[:]
+
+
+def set_trace_hook(cb):
+    """Install (or clear, with None) the acquire-event hook.  Returns
+    the previous hook.  Used by tools/race.py to serialize threads at
+    lock boundaries."""
+    global _trace_hook
+    prev = _trace_hook
+    _trace_hook = cb
+    return prev
+
+
+def lock_order_edges():
+    """``{(src, dst): site}`` — every observed held->acquired pair."""
+    with _locks_lock:
+        return dict(_order_edges)
+
+
+def held_blocking_events():
+    """Events where a thread blocked on a contended lock while already
+    holding one — the dynamic half of T11's blocking-under-lock."""
+    with _locks_lock:
+        return list(_blocked_events)
+
+
+def lock_order_violations():
+    """Cycles in the observed acquisition-order graph, as a list of
+    ``[name, name, ...]`` chains (empty == discipline held)."""
+    edges = lock_order_edges()
+    adj = {}
+    for (a, b) in edges:
+        adj.setdefault(a, set()).add(b)
+    cycles = []
+    seen = set()
+    for start in sorted(adj):
+        stack = [(start, (start,))]
+        while stack:
+            node, path = stack.pop()
+            for nxt in sorted(adj.get(node, ())):
+                if nxt == start:
+                    key = frozenset(path)
+                    if key not in seen:
+                        seen.add(key)
+                        cycles.append(list(path) + [start])
+                elif nxt not in path and len(path) < 16:
+                    stack.append((nxt, path + (nxt,)))
+    return cycles
+
+
+class _SanLock:
+    """Instrumentation proxy around a ``threading`` lock/condition.
+
+    Delegates everything to the wrapped primitive; when the sanitizer
+    is enabled, acquisition records order edges against the calling
+    thread's held stack.  ``wait``/``wait_for`` (Condition protocol)
+    pop the lock around the wait — the condition releases it — so the
+    held stack mirrors reality."""
+
+    __slots__ = ("_lock", "name")
+
+    def __init__(self, lock, name):
+        self._lock = lock
+        self.name = name
+
+    # -- instrumented core ---------------------------------------------------
+    def acquire(self, *args, **kwargs):
+        if not _locks_enabled:
+            return self._lock.acquire(*args, **kwargs)
+        return self._acquire_traced(args, kwargs)
+
+    def _acquire_traced(self, args, kwargs):
+        hook = _trace_hook
+        if hook is not None:
+            hook("acquire", self.name)
+        stack = _held_stack()
+        if stack and self._locked():
+            with _locks_lock:
+                _blocked_events.append(
+                    (stack[-1], self.name,
+                     threading.current_thread().name))
+        ok = self._lock.acquire(*args, **kwargs)
+        if ok:
+            if stack:
+                site = threading.current_thread().name
+                with _locks_lock:
+                    for h in stack:
+                        if h != self.name:
+                            _order_edges.setdefault((h, self.name), site)
+            stack.append(self.name)
+            if hook is not None:
+                hook("acquired", self.name)
+        return ok
+
+    def release(self):
+        if not _locks_enabled:
+            return self._lock.release()
+        stack = _held_stack()
+        if self.name in stack:
+            stack.remove(self.name)
+        self._lock.release()
+        hook = _trace_hook
+        if hook is not None:
+            hook("released", self.name)
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def _locked(self):
+        probe = getattr(self._lock, "locked", None)
+        if probe is None:
+            return False
+        try:
+            return bool(probe())
+        except TypeError:
+            return False
+
+    # -- Condition protocol --------------------------------------------------
+    def wait(self, timeout=None):
+        if not _locks_enabled:
+            return self._lock.wait(timeout)
+        stack = _held_stack()
+        popped = self.name in stack
+        if popped:
+            stack.remove(self.name)
+        try:
+            return self._lock.wait(timeout)
+        finally:
+            if popped:
+                stack.append(self.name)
+
+    def wait_for(self, predicate, timeout=None):
+        if not _locks_enabled:
+            return self._lock.wait_for(predicate, timeout)
+        stack = _held_stack()
+        popped = self.name in stack
+        if popped:
+            stack.remove(self.name)
+        try:
+            return self._lock.wait_for(predicate, timeout)
+        finally:
+            if popped:
+                stack.append(self.name)
+
+    def __getattr__(self, attr):
+        # notify/notify_all/locked/_is_owned/... delegate untouched
+        return getattr(self._lock, attr)
+
+    def __repr__(self):
+        return f"<_SanLock {self.name} wrapping {self._lock!r}>"
+
+
+def wrap_lock(lock, name: str):
+    """Wrap a ``threading`` lock/RLock/Condition for the lock
+    sanitizer.  ``name`` must match the static analyzer's identity for
+    the lock — ``module.GLOBAL_NAME`` or ``module.Class.attr`` — so the
+    runtime and static order graphs line up.  The proxy is always
+    returned (construction cost is two slot writes); with the sanitizer
+    disabled every operation is one boolean check plus delegation."""
+    return _SanLock(lock, name)

@@ -176,7 +176,10 @@ class LlamaServingEngine:
             self._caches = [(jnp.zeros(shape, dt), jnp.zeros(shape, dt))
                             for _ in range(cfg.num_layers)]
             self._pool = self._tables = None
-        self._place_on_mesh()
+        with self.dev_lock:
+            # uncontended at construction; taken so the placement
+            # writes to _w/_pool/_caches share the KV mutators' guard
+            self._place_on_mesh_locked()
         # host mirrors: last emitted token + next write position per slot
         self._last = np.zeros(self.num_slots, np.int32)
         self._pos = np.zeros(self.num_slots, np.int32)
@@ -240,13 +243,13 @@ class LlamaServingEngine:
         self._scatter = jax.jit(_scatter_fn, donate_argnums=(0,))
 
     # -- mesh placement -------------------------------------------------------
-    def _place_on_mesh(self):
+    def _place_on_mesh_locked(self):
         """Commit weights + KV storage to ``self.mesh`` per the serving
         rule table: every leaf gets an explicit NamedSharding (sharded
         or replicated), so jit infers the device assignment from its
         inputs and the compiles are mesh-keyed.  int8 leaves shard the
         q8 rows like the original weight; the per-row scales follow the
-        output dim."""
+        output dim.  Caller holds ``dev_lock``."""
         if self.mesh is None:
             return
         import jax
@@ -340,8 +343,10 @@ class LlamaServingEngine:
                 return shards[0].data.nbytes
             return a.nbytes
 
-        kv = self._pool if self.kv_mode == "paged" else self._caches
-        return int(sum(shard_bytes(k) + shard_bytes(v) for k, v in kv))
+        with self.dev_lock:
+            kv = self._pool if self.kv_mode == "paged" else self._caches
+            return int(sum(shard_bytes(k) + shard_bytes(v)
+                           for k, v in kv))
 
     # -- transitions (slots mode: legacy single-loop scheduler) ---------------
     def admit(self, prompts_pad, t0s, slots):

@@ -54,6 +54,7 @@ from collections import deque
 
 import numpy as np
 
+from .. import sanitizer as _san
 from .. import telemetry
 from ..telemetry import tracing
 from .bucketing import pad_batch
@@ -101,6 +102,7 @@ class PrefillLane:
         self._stop = threading.Event()
         self._drain = True
         self._thread = None
+        self.error = None
 
     def start(self):
         if self._thread is None:
@@ -114,15 +116,31 @@ class PrefillLane:
         self._stop.set()
 
     def join(self):
+        """Join the lane thread; a captured lane-machinery error is
+        re-raised here — the lane's materialization point."""
         if self._thread is not None:
             self._thread.join()
             self._thread = None
+        if self.error is not None:
+            raise self.error
 
     def alive(self):
         """Lane-thread liveness (the /healthz signal)."""
         return self._thread is not None and self._thread.is_alive()
 
     def _loop(self):
+        # per-request failures are handled inside _admit_batch; this
+        # catches lane-machinery bugs so the thread never dies silently
+        try:
+            self._run()
+        except Exception as exc:
+            self.error = exc
+            tracing.incident("lane_thread_error",
+                             context={"replica": self.r.index,
+                                      "lane": "prefill",
+                                      "error": repr(exc)})
+
+    def _run(self):
         q = self.r.queue
         while True:
             if self._stop.is_set():
@@ -251,11 +269,13 @@ class DecodeLane:
         self.r = replica
         self.poll_s = float(poll_s)
         self._handoffs = deque()
-        self._hand_lock = threading.Lock()
+        self._hand_lock = _san.wrap_lock(
+            threading.Lock(), "lanes.DecodeLane._hand_lock")
         self._seqs = {}       # slot -> (request, [generated tokens])
         self._wake = threading.Event()   # set on hand_off: adopt now
         self._stop = threading.Event()
         self._thread = None
+        self.error = None
 
     def hand_off(self, h):
         with self._hand_lock:
@@ -277,9 +297,13 @@ class DecodeLane:
         self._stop.set()
 
     def join(self):
+        """Join the lane thread; a captured lane-machinery error is
+        re-raised here — the lane's materialization point."""
         if self._thread is not None:
             self._thread.join()
             self._thread = None
+        if self.error is not None:
+            raise self.error
 
     def alive(self):
         """Lane-thread liveness (the /healthz signal)."""
@@ -303,9 +327,23 @@ class DecodeLane:
         return rows
 
     def _loop(self):
+        # per-request failures are handled inside _tick; this catches
+        # lane-machinery bugs so the thread never dies silently
+        try:
+            self._run()
+        except Exception as exc:
+            self.error = exc
+            tracing.incident("lane_thread_error",
+                             context={"replica": self.r.index,
+                                      "lane": "decode",
+                                      "error": repr(exc)})
+
+    def _run(self):
         while True:
             self._adopt()
-            if self._seqs:
+            with self._hand_lock:
+                busy = bool(self._seqs)
+            if busy:
                 self._tick()
             elif self._stop.is_set():
                 if not self.pending():
@@ -334,17 +372,20 @@ class DecodeLane:
                 h.req.trace.add("handoff", h.req.t_first,
                                 h.req.t_handoff, replica=self.r.index,
                                 slot=h.slot)
-            self._seqs[h.slot] = (h.req, [h.first])
+            with self._hand_lock:
+                self._seqs[h.slot] = (h.req, [h.first])
 
     def _tick(self):
         r = self.r
-        active = sorted(self._seqs)
+        with self._hand_lock:
+            active = sorted(self._seqs)
         t0 = time.perf_counter()
         try:
             toks = r.engine.step(active)
         except Exception as exc:
             for slot in active:
-                req, _ = self._seqs.pop(slot)
+                with self._hand_lock:
+                    req, _ = self._seqs.pop(slot)
                 r.mgr.evict(slot)
                 r.engine.clear_slot(slot)
                 req.future.set_exception(exc)
@@ -363,7 +404,8 @@ class DecodeLane:
         step_idx = r.engine.steps
         for slot in active:
             r.mgr.advance(slot)   # the step wrote K/V at slot's pos
-            req, tokens = self._seqs[slot]
+            with self._hand_lock:
+                req, tokens = self._seqs[slot]
             tokens.append(int(toks[slot]))
             if req.trace is not None:
                 # one span per traced slot per tick: the per-request
@@ -373,7 +415,8 @@ class DecodeLane:
                               batch=len(active), replica=r.index,
                               slot=slot)
             if r.mgr.consume(slot):
-                del self._seqs[slot]
+                with self._hand_lock:
+                    del self._seqs[slot]
                 r.finish(req, tokens)
 
 
@@ -415,9 +458,8 @@ class Replica:
     def load(self):
         """Routing weight: tokens reserved in the KV pool plus tokens
         waiting in the internal queue."""
-        with self.queue._cond:
-            queued = sum(len(r.prompt_ids) + r.max_new_tokens
-                         for r in self.queue._items)
+        queued = self.queue.queued_tokens(
+            lambda r: len(r.prompt_ids) + r.max_new_tokens)
         return self.mgr.reserved_tokens() + queued
 
     def offer(self, req):
@@ -530,6 +572,7 @@ class ReplicaDispatcher:
         self._stop = threading.Event()
         self._drain = True
         self._thread = None
+        self.error = None
 
     def start(self):
         if self._thread is None:
@@ -545,6 +588,8 @@ class ReplicaDispatcher:
         if self._thread is not None:
             self._thread.join()
             self._thread = None
+        if self.error is not None:
+            raise self.error
         leftovers = ([self._held] if self._held is not None else []) \
             + self.queue.take_group(lambda r: 0, 1 << 30)
         self._held = None
@@ -563,6 +608,16 @@ class ReplicaDispatcher:
         return False
 
     def _loop(self):
+        # catches dispatcher bugs so the routing thread never dies
+        # silently; re-raised at stop()
+        try:
+            self._run()
+        except Exception as exc:
+            self.error = exc
+            tracing.incident("dispatcher_thread_error",
+                             context={"error": repr(exc)})
+
+    def _run(self):
         while not self._stop.is_set():
             if self._held is None:
                 group = self.queue.take_group(lambda r: 0, 1)

@@ -27,6 +27,7 @@ import time
 
 import numpy as np
 
+from .. import sanitizer as _san
 from .. import telemetry
 from ..telemetry import tracing
 from .bucketing import pad_batch
@@ -55,7 +56,8 @@ class RequestQueue:
     def __init__(self, capacity=64):
         self.capacity = int(capacity)
         self._items = []
-        self._cond = threading.Condition()
+        self._cond = _san.wrap_lock(threading.Condition(),
+                                    "scheduler.RequestQueue._cond")
         self._closed = False
         self._rejected = 0
 
@@ -65,7 +67,15 @@ class RequestQueue:
 
     @property
     def rejected(self):
-        return self._rejected
+        with self._cond:
+            return self._rejected
+
+    def queued_tokens(self, weigh):
+        """Sum ``weigh(req)`` over the queued requests under the lock —
+        the dispatcher's load probe, so callers never reach into
+        ``_items`` bare."""
+        with self._cond:
+            return sum(weigh(r) for r in self._items)
 
     def put(self, req):
         with self._cond:

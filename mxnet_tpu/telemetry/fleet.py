@@ -51,6 +51,8 @@ import sys
 import threading
 import time
 
+from .. import sanitizer as _sanitizer
+
 from . import promtext
 from .sinks import _json_default
 
@@ -88,9 +90,9 @@ class WatchdogHalt(RuntimeError):
 
 
 _enabled = False
-_lock = threading.Lock()
+_lock = _sanitizer.wrap_lock(threading.Lock(), "fleet._lock")
 _ring = collections.deque(maxlen=RING_CAPACITY)
-_ring_lock = threading.Lock()
+_ring_lock = _sanitizer.wrap_lock(threading.Lock(), "fleet._ring_lock")
 _last_dump = {}      # reason -> monotonic time of last incident dump
 _watchdog = None
 _last_view = None    # most recent fleet-view record
@@ -124,7 +126,8 @@ def world():
     from .. import elastic
     r, n = elastic.world_info()
     if n > 1 or os.environ.get("MXT_NUM_PROCESSES") or _parallel() is not None:
-        _world_cache = (r, n)
+        with _lock:
+            _world_cache = (r, n)
     return r, n
 
 
@@ -404,12 +407,15 @@ def _fleet_exchange(record):
         rows = [vec]
     exchange_ms = (time.perf_counter() - t0) * 1e3
     cols = list(zip(*rows))
-    wd = _watchdog
+    with _lock:
+        # already paying an allgather here; snapshot config consistently
+        wd = _watchdog
+        stride = _stride
     thresh = wd.skew_threshold if wd is not None else SKEW_THRESHOLD
     view = {
         "record": "fleet",
         "step": record.get("step"),
-        "stride": _stride,
+        "stride": stride,
         "rank": r,
         "world_size": len(rows),
         "wall_time": time.time(),
@@ -437,7 +443,11 @@ def _emit_anomaly(anomaly, record):
         tel.count("fleet.anomaly")
         tel.count("fleet.anomaly." + evt["kind"])
         tel.emit(evt)
-    cb = _on_anomaly
+    with _lock:
+        # anomalies are rare; snapshot the callback + halt opt-in
+        # consistently against a concurrent configure()
+        cb = _on_anomaly
+        halt = _halt
     if cb is not None:
         try:
             cb(evt)
@@ -448,7 +458,7 @@ def _emit_anomaly(anomaly, record):
               % (evt["kind"], evt.get("step"), r, n,
                  {k: v for k, v in anomaly.items() if k != "kind"}),
               file=sys.stderr)
-    if _halt:
+    if halt:
         with _lock:
             _halted = True
         incident("watchdog_halt", context={"anomaly": evt})
@@ -459,12 +469,14 @@ def _emit_anomaly(anomaly, record):
 def halt_requested():
     """True once the watchdog has halted this process (surfaced as 503
     on ``/healthz``)."""
-    return _halted
+    with _lock:
+        return _halted
 
 
 def last_view():
     """The most recent fleet-view record, or ``None``."""
-    return _last_view
+    with _lock:
+        return _last_view
 
 
 # -- live /metrics + /healthz for a training rank -----------------------
@@ -495,14 +507,15 @@ class MetricsEndpoint:
                     elif self.path.startswith("/healthz"):
                         r, n = world()
                         view = last_view()
+                        halted = halt_requested()
                         payload = {
-                            "status": "halted" if _halted else "ok",
+                            "status": "halted" if halted else "ok",
                             "rank": r, "world_size": n,
                             "step": view.get("step") if view else None,
                         }
                         body = json.dumps(payload).encode()
                         ctype = "application/json"
-                        code = 503 if _halted else 200
+                        code = 503 if halted else 200
                     else:
                         body, ctype, code = b"not found\n", "text/plain", 404
                 except Exception as e:   # scrape failure is a 500, never a crash

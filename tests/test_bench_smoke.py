@@ -223,6 +223,28 @@ def test_sharded_step_bench_emits_artifact(tmp_path):
         assert all(rec["acceptance"][model].values())
 
 
+def test_race_harness_report_is_green():
+    """python -m tools.race --report: the deterministic-interleaving
+    harness's self-check — every built-in scenario replays
+    bit-identically from its seed, the seeded deadlock is witnessed,
+    and the runtime lock-order graph stays clean."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.race", "--report"],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=300)
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+    report = json.loads(r.stdout)
+    assert report["ok"]
+    by_name = {sc["name"]: sc for sc in report["scenarios"]}
+    assert by_name["points"]["replay_identical"]
+    assert by_name["points"]["seed_changes_schedule"]
+    assert by_name["locks"]["replay_identical"]
+    assert by_name["locks"]["order_violations"] == []
+    assert by_name["deadlock"]["witnessed_at_seed"] is not None
+    assert by_name["deadlock"]["replay_identical"]
+
+
 def test_fleet_overhead_bench_emits_artifact(tmp_path):
     """benchmark/sharded_step.py --fleet-overhead must emit the
     FLEET_OVERHEAD artifact: the off/stride16/stride1 A/B lanes, the

@@ -327,6 +327,68 @@ def test_t6_t7_clean_on_real_donation_sites():
     assert vs == [], [v.to_dict() for v in vs]
 
 
+# --- concurrency tier (T10-T12) ---------------------------------------------
+
+def test_t10_flags_bare_access_to_guarded_state():
+    vs = _rule(_analyze("t10_locks.py"), "T10")
+    errors = {v.context for v in vs if v.severity == "error"}
+    warnings = {v.context for v in vs if v.severity == "warning"}
+    assert "Ledger.drop" in errors          # bare write
+    assert "cache_del" in errors            # bare module-global write
+    assert "Ledger.peek" in warnings        # bare read
+    assert len(vs) == 3
+    # __init__ seeding, the _locked-suffix escape hatch, and the
+    # lock-consistent paths all stay quiet
+    assert not any("__init__" in v.context or "drain_locked" in v.context
+                   or "record" in v.context or "Unthreaded" in v.context
+                   for v in vs)
+
+
+def test_t11_flags_cycles_and_blocking_under_lock():
+    vs = _rule(_analyze("t11_order.py"), "T11")
+    errors = [v for v in vs if v.severity == "error"]
+    warnings = [v for v in vs if v.severity == "warning"]
+    assert len(errors) == 1 and "lock-order cycle" in errors[0].message
+    assert "_LOCK_A" in errors[0].message and "_LOCK_B" in errors[0].message
+    blocked = {v.context for v in warnings}
+    assert blocked == {"blocked_get", "blocked_put", "blocked_result"}
+    # bounded and non-blocking calls under a lock stay quiet
+    assert not any(v.context in ("bounded_get", "nonblocking_put")
+                   for v in vs)
+
+
+def test_t12_flags_thread_lifecycle_hazards():
+    vs = _rule(_analyze("t12_lifecycle.py"), "T12")
+    sev = {v.context: v.severity for v in vs}
+    assert sev.get("unnamed") == "warning"       # no name=
+    assert sev.get("unjoined") == "error"        # non-daemon, never joined
+    assert sev.get("silent_worker") == "warning"  # loop, no try/except
+    assert len(vs) == 3
+    assert not any(v.context in ("good_worker", "good_joined")
+                   for v in vs)
+
+
+def test_concurrency_tier_clean_on_real_threaded_modules():
+    # the instrumented runtime (serving lanes, checkpoint writer, data
+    # plane, parameter server) passes its own tier outright; engine.py
+    # and telemetry/fleet.py carry the few justified fast-path waivers
+    # in the committed baseline instead
+    vs = analyze_paths(
+        ["mxnet_tpu/serving/lanes.py", "mxnet_tpu/serving/scheduler.py",
+         "mxnet_tpu/serving/generative.py", "mxnet_tpu/checkpoint.py",
+         "mxnet_tpu/data/prefetch.py", "mxnet_tpu/io/__init__.py",
+         "mxnet_tpu/kvstore/dist_async.py",
+         "mxnet_tpu/gluon/data/dataloader.py"],
+        REPO, rules={"T10", "T11", "T12"})
+    assert vs == [], [v.to_dict() for v in vs]
+
+
+def test_t11_cross_file_graph_is_acyclic_on_the_tree():
+    vs = analyze_paths(["mxnet_tpu"], REPO, rules={"T11"})
+    cycles = [v for v in vs if "lock-order cycle" in v.message]
+    assert cycles == [], [v.to_dict() for v in cycles]
+
+
 # --- baseline gate ----------------------------------------------------------
 
 def test_baseline_waives_known_and_gates_new(tmp_path):
@@ -375,8 +437,10 @@ def test_cli_fails_on_seeded_fixtures_with_json():
     assert r.returncode == 1
     payload = json.loads(r.stdout)
     by_rule = payload["summary"]["by_rule"]
-    for rule in ("T1", "T2", "T3", "T4", "T5", "T6", "T7", "T8", "T9"):
+    for rule in ("T1", "T2", "T3", "T4", "T5", "T6", "T7", "T8", "T9",
+                 "T10", "T11", "T12"):
         assert by_rule.get(rule, 0) > 0, f"{rule} missing from {by_rule}"
+    assert "cache" in payload["summary"]
 
 
 def test_cli_sarif_format():
@@ -388,8 +452,8 @@ def test_cli_sarif_format():
     run = sarif["runs"][0]
     assert run["tool"]["driver"]["name"] == "mxlint"
     rule_ids = {rl["id"] for rl in run["tool"]["driver"]["rules"]}
-    assert {"T1", "T2", "T3", "T4", "T5", "T6", "T7", "T8",
-            "T9"} <= rule_ids
+    assert {"T1", "T2", "T3", "T4", "T5", "T6", "T7", "T8", "T9",
+            "T10", "T11", "T12"} <= rule_ids
     results = run["results"]
     assert results and all(r_["ruleId"] in rule_ids for r_ in results)
     loc = results[0]["locations"][0]["physicalLocation"]
@@ -411,6 +475,57 @@ def test_cli_sarif_marks_waived_as_unchanged(tmp_path):
     results = json.loads(r.stdout)["runs"][0]["results"]
     assert results
     assert all(r_.get("baselineState") == "unchanged" for r_ in results)
+
+
+# --- per-file analysis cache -------------------------------------------------
+
+def test_cache_hits_on_unchanged_files(tmp_path):
+    from tools.lint.cache import AnalysisCache, analyzer_salt
+
+    path = str(tmp_path / "cache.json")
+    salt = analyzer_salt(None)
+    cold = AnalysisCache(path, salt)
+    vs1 = analyze_paths([FIXTURES], REPO, cache=cold)
+    assert cold.hits == 0 and cold.misses > 0
+    cold.save()
+    warm = AnalysisCache(path, salt)
+    vs2 = analyze_paths([FIXTURES], REPO, cache=warm)
+    assert warm.misses == 0 and warm.hits == cold.misses
+    # cached results are byte-identical, cross-file passes included
+    assert [v.to_dict() for v in vs1] == [v.to_dict() for v in vs2]
+
+
+def test_cache_invalidates_on_content_and_salt_change(tmp_path):
+    from tools.lint.cache import AnalysisCache, analyzer_salt
+
+    f = tmp_path / "mod.py"
+    f.write_text("import numpy as np\n")
+    path = str(tmp_path / "cache.json")
+    salt = analyzer_salt(None)
+    c1 = AnalysisCache(path, salt)
+    analyze_paths([str(f)], str(tmp_path), cache=c1)
+    c1.save()
+    # content change: stale digest misses
+    f.write_text("import numpy as np  # edited\n")
+    c2 = AnalysisCache(path, salt)
+    analyze_paths([str(f)], str(tmp_path), cache=c2)
+    assert c2.hits == 0 and c2.misses == 1
+    # salt change (different rule set): whole cache drops
+    c3 = AnalysisCache(path, analyzer_salt({"T1"}))
+    assert c3._files == {}
+
+
+def test_cli_reports_cache_in_json_and_honors_no_cache():
+    fixture = os.path.join(FIXTURES, "t10_locks.py")
+    r = _run_cli(fixture, "--no-baseline", "--no-registry", "--json")
+    cache1 = json.loads(r.stdout)["summary"]["cache"]
+    r = _run_cli(fixture, "--no-baseline", "--no-registry", "--json")
+    cache2 = json.loads(r.stdout)["summary"]["cache"]
+    assert cache1["hits"] + cache1["misses"] == 1
+    assert cache2 == {"hits": 1, "misses": 0}
+    r = _run_cli(fixture, "--no-baseline", "--no-registry", "--json",
+                 "--no-cache")
+    assert "cache" not in json.loads(r.stdout)["summary"]
 
 
 # --- live registry invariants ----------------------------------------------

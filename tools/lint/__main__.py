@@ -11,6 +11,7 @@ import sys
 
 from .analyzer import analyze_paths
 from .baseline import load_baseline, save_baseline, apply_baseline
+from .cache import AnalysisCache, analyzer_salt
 from .registry_check import run_registry_check
 from .report import render_human, render_json, render_sarif
 from .rules import RULES
@@ -19,6 +20,8 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 "baseline.json")
+DEFAULT_CACHE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             ".cache.json")
 
 
 def main(argv=None):
@@ -50,6 +53,9 @@ def main(argv=None):
     ap.add_argument("--no-registry", action="store_true",
                     help="skip the runtime registry check (T3's dynamic "
                          "half; needs an importable mxnet_tpu)")
+    ap.add_argument("--no-cache", action="store_true",
+                    help="disable the per-file analysis cache "
+                         "(tools/lint/.cache.json, content-hash keyed)")
     args = ap.parse_args(argv)
 
     rules = None
@@ -62,10 +68,16 @@ def main(argv=None):
                      f"known: {sorted(RULES)}")
 
     paths = args.paths or ["mxnet_tpu"]
+    cache = None
+    if not args.no_cache:
+        cache = AnalysisCache(DEFAULT_CACHE, analyzer_salt(rules))
     try:
-        violations = analyze_paths(paths, REPO_ROOT, rules=rules)
+        violations = analyze_paths(paths, REPO_ROOT, rules=rules,
+                                   cache=cache)
     except FileNotFoundError as e:
         ap.error(f"no such path: {e}")
+    if cache is not None:
+        cache.save()
 
     if not args.no_registry and (rules is None or "T3" in rules):
         violations.extend(run_registry_check())
@@ -83,7 +95,9 @@ def main(argv=None):
     fmt = args.format or ("json" if args.as_json else "human")
     out = sys.stdout
     if fmt == "json":
-        render_json(new, waived, stale, out)
+        render_json(new, waived, stale, out,
+                    cache_stats=cache.stats() if cache is not None
+                    else None)
     elif fmt == "sarif":
         render_sarif(new, waived, stale, out)
     else:

@@ -1,11 +1,11 @@
 """mxlint driver: walk files, run per-file rules, finalize cross-file
-T3 checks, and hand the result to the baseline gate."""
+T3/T11 checks, and hand the result to the baseline gate."""
 from __future__ import annotations
 
-import ast
 import os
 
 from .core import Violation, FileSource, SEVERITY_ERROR
+from .concurrency import check_lock_order
 from .rules import FileChecker, check_registrations
 
 #: directories never worth analyzing
@@ -40,17 +40,40 @@ def iter_py_files(paths, root):
             yield c, rel
 
 
-def analyze_paths(paths, root, rules=None):
+def analyze_paths(paths, root, rules=None, cache=None):
     """Run the analyzer over ``paths``.  Returns a sorted violation list.
 
-    ``rules`` is an optional iterable of rule ids ("T1".."T5") limiting
-    which families run; None means all.
+    ``rules`` is an optional iterable of rule ids ("T1".."T12") limiting
+    which families run; None means all.  ``cache`` is an optional
+    ``cache.AnalysisCache``: per-file results are reused when the file's
+    content hash matches, while the cross-file passes (T3 registration
+    consistency, the T11 lock-order graph) always rebuild from the
+    cached facts.
     """
     enabled = set(rules) if rules is not None else None
     violations = []
-    all_regs = []
-    sources = []
+    all_reg_facts = []
+    all_lock_facts = []
     for abspath, relpath in iter_py_files(paths, root):
+        try:
+            with open(abspath, "r", encoding="utf-8") as f:
+                text = f.read()
+        except (OSError, UnicodeDecodeError) as e:
+            violations.append(Violation(
+                rule="E0", severity=SEVERITY_ERROR, path=relpath,
+                line=0, col=0, context="<parse>",
+                message=f"unreadable file: {e}"))
+            continue
+        if cache is not None:
+            from .cache import content_digest
+            digest = content_digest(text)
+            hit = cache.get(relpath, digest)
+            if hit is not None:
+                file_violations, reg_facts, lock_facts = hit
+                violations.extend(file_violations)
+                all_reg_facts.extend(reg_facts)
+                all_lock_facts.append(lock_facts)
+                continue
         try:
             src = FileSource.parse(abspath, relpath)
         except (SyntaxError, UnicodeDecodeError) as e:
@@ -60,10 +83,16 @@ def analyze_paths(paths, root, rules=None):
                 context="<parse>", message=f"unparseable file: {e}"))
             continue
         checker = FileChecker(src, enabled=enabled)
-        violations.extend(checker.run())
-        all_regs.extend(checker.registrations)
-        sources.append(src)
+        file_violations = checker.run()
+        violations.extend(file_violations)
+        all_reg_facts.extend(checker.reg_facts)
+        all_lock_facts.append(checker.lock_facts)
+        if cache is not None:
+            cache.put(relpath, digest, file_violations,
+                      checker.reg_facts, checker.lock_facts)
     if enabled is None or "T3" in enabled:
-        violations.extend(check_registrations(all_regs, sources))
+        violations.extend(check_registrations(all_reg_facts))
+    if enabled is None or "T11" in enabled:
+        violations.extend(check_lock_order(all_lock_facts))
     violations.sort(key=lambda v: (v.path, v.line, v.col, v.rule))
     return violations

@@ -34,7 +34,20 @@ def load_baseline(path):
 
 def save_baseline(path, violations):
     """Write a fresh baseline from the current violation set, keeping a
-    human-auditable sample (rule/path/context/message) per fingerprint."""
+    human-auditable sample (rule/path/context/message) per fingerprint.
+    A waiver's ``why`` line — the written justification the concurrency
+    tier requires for every grandfathered T10–T12 finding — survives
+    regeneration as long as the fingerprint still occurs."""
+    old_why = {}
+    if os.path.exists(path):
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                old = json.load(f)
+            for fp, entry in old.get("waivers", {}).items():
+                if isinstance(entry, dict) and entry.get("why"):
+                    old_why[fp] = entry["why"]
+        except (ValueError, OSError):
+            pass
     grouped = {}
     for v in violations:
         fp = v.fingerprint()
@@ -42,11 +55,15 @@ def save_baseline(path, violations):
             "count": 0, "rule": v.rule, "path": v.path,
             "context": v.context, "message": v.message})
         entry["count"] += 1
+        if fp in old_why:
+            entry["why"] = old_why[fp]
     payload = {
         "version": BASELINE_VERSION,
         "note": ("Grandfathered mxlint violations. Regenerate with "
                  "`python -m tools.lint --update-baseline`; fix debt by "
-                 "deleting entries and fixing the code."),
+                 "deleting entries and fixing the code. Each waiver may "
+                 "carry a `why` justification (required for T10-T12); "
+                 "`why` lines survive regeneration."),
         "waivers": {fp: grouped[fp] for fp in sorted(grouped)},
     }
     with open(path, "w", encoding="utf-8") as f:

@@ -1,0 +1,109 @@
+"""Per-file analysis cache.
+
+mxlint re-runs on every tier-1 invocation; as rule families grow
+(T1→T12) the full-AST sweep is the dominant cost.  Per-file results
+are pure functions of (file content, analyzer source, enabled rules),
+so they cache under a content hash:
+
+  * key: sha1 of the file's bytes;
+  * salt: sha1 over every ``tools/lint/*.py`` source plus the sorted
+    enabled-rule set — any analyzer edit or rule-selection change drops
+    the whole cache (correct by construction, no fine-grained
+    invalidation to get wrong);
+  * value: the file's serialized violations plus the serializable
+    cross-file facts (T3 registration facts, T11 lock-order edges).
+
+The cross-file passes themselves (duplicate registrations, the
+lock-order cycle scan) always re-run — they are cheap graph work over
+the cached facts.  Hit/miss counts surface in ``--json`` as
+``summary.cache``.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+
+CACHE_VERSION = 1
+
+#: violation fields in serialization order (mirrors core.Violation)
+_V_FIELDS = ("rule", "severity", "path", "line", "col", "context",
+             "message", "source")
+
+
+def analyzer_salt(enabled=None):
+    """Hash of the analyzer's own sources + the enabled-rule set."""
+    h = hashlib.sha1()
+    lint_dir = os.path.dirname(os.path.abspath(__file__))
+    for fn in sorted(os.listdir(lint_dir)):
+        if not fn.endswith(".py"):
+            continue
+        h.update(fn.encode("utf-8"))
+        with open(os.path.join(lint_dir, fn), "rb") as f:
+            h.update(f.read())
+    h.update(repr(sorted(enabled) if enabled is not None
+                  else "all").encode("utf-8"))
+    return h.hexdigest()
+
+
+def content_digest(text: str) -> str:
+    return hashlib.sha1(text.encode("utf-8")).hexdigest()
+
+
+class AnalysisCache:
+    """Content-hash-keyed store of per-file analysis results."""
+
+    def __init__(self, path, salt):
+        self.path = path
+        self.salt = salt
+        self.hits = 0
+        self.misses = 0
+        self._dirty = False
+        self._files = {}
+        if path and os.path.exists(path):
+            try:
+                with open(path, "r", encoding="utf-8") as f:
+                    data = json.load(f)
+                if data.get("version") == CACHE_VERSION and \
+                        data.get("salt") == salt:
+                    self._files = data.get("files", {})
+            except (ValueError, OSError):
+                pass  # corrupt/unreadable cache == cold cache
+
+    def get(self, relpath, digest):
+        """(violations, reg_facts, lock_facts) or None on miss."""
+        entry = self._files.get(relpath)
+        if entry is None or entry.get("digest") != digest:
+            self.misses += 1
+            return None
+        self.hits += 1
+        from .core import Violation
+        violations = [Violation(**{k: d[k] for k in _V_FIELDS})
+                      for d in entry["violations"]]
+        return violations, entry["reg_facts"], entry["lock_facts"]
+
+    def put(self, relpath, digest, violations, reg_facts, lock_facts):
+        self._files[relpath] = {
+            "digest": digest,
+            "violations": [{k: getattr(v, k) for k in _V_FIELDS}
+                           for v in violations],
+            "reg_facts": reg_facts,
+            "lock_facts": lock_facts,
+        }
+        self._dirty = True
+
+    def save(self):
+        if not self._dirty or not self.path:
+            return
+        payload = {"version": CACHE_VERSION, "salt": self.salt,
+                   "files": self._files}
+        tmp = self.path + ".tmp"
+        try:
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(payload, f)
+            os.replace(tmp, self.path)
+        except OSError:
+            pass  # read-only checkout: run uncached
+
+    def stats(self):
+        return {"hits": self.hits, "misses": self.misses}

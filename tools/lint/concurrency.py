@@ -1,0 +1,681 @@
+"""Concurrency-safety rule families (T10–T12).
+
+The runtime is genuinely multithreaded — the async engine worker, the
+prefill/decode serving lanes, the data-plane prefetch thread, the async
+checkpoint writer, metrics HTTP servers, the fleet watchdog — and they
+share mutable state.  These families prove the locking discipline in
+review, the same way T6/T7 prove the donation contract:
+
+T10 (guard consistency)
+    Per module, infer the shared-mutable-state map: ``self`` attributes
+    and module globals that are *written* outside ``__init__`` and are
+    accessed at least once under a lock.  Any *other* access to the same
+    state that happens bare (no lock held lexically) is flagged — the
+    ``RequestQueue.rejected``-style bug where writers hold the lock and
+    one reader forgot.  Functions whose name carries a ``_locked``
+    suffix are exempt by convention (the caller holds the lock), as are
+    ``__init__``/``__new__``/``__repr__`` (construction and debug
+    rendering are single-threaded by contract).
+
+T11 (deadlock + blocking-under-lock)
+    Build the static lock-acquisition-order graph across the whole
+    package — an edge A→B for every site that acquires B while holding
+    A (lexical ``with`` nesting and ``.acquire()`` under a held
+    ``with``).  A cycle in the cross-file graph is an error: two
+    threads taking the locks in opposite orders deadlock.  Additionally
+    flag unbounded blocking calls made while a lock is held:
+    ``queue.get()``/``put()`` without a timeout, ``ticket.result()``,
+    ``Condition.wait()`` (on a *different* object than the held lock —
+    ``self._cond.wait()`` inside ``with self._cond:`` is the
+    condition-variable protocol and exempt), and ``thread.join()``.
+
+T12 (thread lifecycle)
+    ``threading.Thread`` sites must follow the package discipline:
+    *named* (``name="mxt-..."`` — ps/the flight recorder/the straggler
+    watchdog attribute threads by name), either ``daemon=True`` or
+    joined somewhere on a shutdown path, and their target loop must
+    capture exceptions for re-raise at a materialization point (the
+    contract ``engine._AsyncExecutor``, the serving lanes and
+    ``data/prefetch.py`` honor) instead of dying silently.
+
+Runtime twin: ``MXNET_SANITIZE_LOCKS=1`` (``mxnet_tpu/sanitizer.py``)
+wraps the package locks to record the *actual* acquisition order and
+held-while-blocking events, and powers the deterministic interleaving
+harness in ``tools/race.py``.  Lock identities here — ``module.NAME``
+for globals, ``module.Class.attr`` for instance locks — match the
+names passed to ``sanitizer.wrap_lock`` so the static and runtime
+graphs can be unioned and cross-checked.  See docs/concurrency.md.
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from .core import (Violation, SEVERITY_ERROR, SEVERITY_WARNING, last_name)
+
+#: threading factories whose result is a lock-like guard
+LOCK_FACTORIES = {"Lock", "RLock", "Condition", "Semaphore",
+                  "BoundedSemaphore"}
+
+#: the sanitizer's instrumentation wrapper — ``wrap_lock(Lock(), name)``
+#: is still a lock declaration
+LOCK_WRAPPERS = {"wrap_lock"}
+
+#: attribute/global names that read as locks even without a visible
+#: declaration (locks handed across objects, e.g. ``q._cond``)
+_LOCKISH_RE = re.compile(r"(?:^|_)(?:lock|mutex|cond)$", re.IGNORECASE)
+
+#: container methods that mutate their receiver (a store for T10)
+_MUTATORS = {"append", "appendleft", "extend", "extendleft", "insert",
+             "add", "remove", "discard", "pop", "popleft", "popitem",
+             "clear", "update", "setdefault", "sort", "reverse"}
+
+#: receiver names that look like a queue for the blocking get/put check
+_QUEUEISH_RE = re.compile(r"(?:^|_)(?:q|queue)$", re.IGNORECASE)
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+#: functions whose accesses never count for T10: construction and debug
+#: rendering are single-threaded, ``*_locked`` helpers run with the
+#: caller's lock held by contract
+_EXEMPT_FUNC_RE = re.compile(
+    r"(?:^__init__$|^__new__$|^__del__$|^__repr__$|_locked$|_locked_)")
+
+
+def module_of(path: str) -> str:
+    """Last dotted-module component of a repo-relative path:
+    ``mxnet_tpu/serving/lanes.py`` -> ``lanes`` (``__init__.py`` ->
+    its package directory name).  Lock identities are scoped by this
+    component so importers (``engine._SEG_LOCK``) and the defining file
+    agree on the name."""
+    parts = path.replace("\\", "/").split("/")
+    leaf = parts[-1][:-3] if parts[-1].endswith(".py") else parts[-1]
+    if leaf == "__init__" and len(parts) > 1:
+        return parts[-2]
+    return leaf
+
+
+def _is_lock_value(value) -> bool:
+    """Is this assignment RHS a lock construction?  Handles bare
+    ``threading.Lock()`` and the sanitizer wrapper
+    ``_san.wrap_lock(threading.Lock(), "name")``."""
+    if not isinstance(value, ast.Call):
+        return False
+    name = last_name(value.func)
+    if name in LOCK_FACTORIES:
+        return True
+    if name in LOCK_WRAPPERS and value.args:
+        return _is_lock_value(value.args[0]) or \
+            isinstance(value.args[0], (ast.Name, ast.Attribute))
+    return False
+
+
+class _Access:
+    """One load/store of a shared-state candidate."""
+
+    __slots__ = ("state", "node", "store", "locks", "func")
+
+    def __init__(self, state, node, store, locks, func):
+        self.state = state      # state id, e.g. "DecodeLane._seqs"
+        self.node = node
+        self.store = store
+        self.locks = locks      # frozenset of lock ids held lexically
+        self.func = func        # enclosing function node
+
+
+class ModuleConcurrency:
+    """Per-file concurrency model: declared locks, thread entry points,
+    shared-state accesses with the lexically-held lock set, and the
+    lock-acquisition facts the cross-file T11 graph is built from."""
+
+    def __init__(self, src, index):
+        self.src = src
+        self.index = index
+        self.mod = module_of(src.path)
+        self.module_locks = {}   # global name -> lock id
+        self.class_locks = {}    # class name -> {attr -> lock id}
+        self.thread_targets = set()   # id(func) run on a thread
+        self.threaded = False    # module spawns/uses any thread at all
+        self.accesses = []       # [_Access]
+        self.acquire_edges = []  # [(src_id, dst_id, node)]
+        self.blocking = []       # [(held lock id, desc, node)]
+        self.thread_sites = []   # [Thread(...) call nodes]
+        self._class_of_func = {}  # id(func) -> enclosing class name or ""
+        self._globals_cache = None
+        self._collect_locks()
+        self._map_classes()
+        self._collect_thread_sites()
+        self._scan_functions()
+
+    # -- declarations --------------------------------------------------------
+    def _collect_locks(self):
+        for node in ast.walk(self.src.tree):
+            if not isinstance(node, ast.Assign) or \
+                    not _is_lock_value(node.value):
+                continue
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    self.module_locks[t.id] = f"{self.mod}.{t.id}"
+                elif isinstance(t, ast.Attribute) and \
+                        isinstance(t.value, ast.Name) and \
+                        t.value.id == "self":
+                    cls = self._enclosing_class(node)
+                    if cls:
+                        self.class_locks.setdefault(cls, {})[t.attr] = \
+                            f"{self.mod}.{cls}.{t.attr}"
+
+    def _enclosing_class(self, node):
+        cur = self.index.parents.get(id(node))
+        while cur is not None:
+            if isinstance(cur, ast.ClassDef):
+                return cur.name
+            cur = self.index.parents.get(id(cur))
+        return ""
+
+    def _map_classes(self):
+        for nodes in self.index.by_name.values():
+            for fn in nodes:
+                if isinstance(fn, _FUNC_NODES):
+                    self._class_of_func[id(fn)] = self._enclosing_class(fn)
+
+    # -- thread entry points -------------------------------------------------
+    def _collect_thread_sites(self):
+        handler_classes = set()
+        for node in ast.walk(self.src.tree):
+            if isinstance(node, ast.ClassDef):
+                for base in node.bases:
+                    if "Handler" in last_name(base):
+                        handler_classes.add(node.name)
+        entries = set()
+        for node in ast.walk(self.src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fname = last_name(node.func)
+            target = None
+            if fname in ("Thread", "Timer"):
+                self.thread_sites.append(node)
+                self.threaded = True
+                for kw in node.keywords:
+                    if kw.arg == "target":
+                        target = kw.value
+                if target is None and fname == "Timer" and \
+                        len(node.args) > 1:
+                    target = node.args[1]
+            elif fname in ("submit", "add_done_callback") and node.args:
+                # executor callbacks run on pool threads
+                self.threaded = True
+                target = node.args[0]
+            if isinstance(target, (ast.Name, ast.Attribute)):
+                for fn in self.index.by_name.get(last_name(target), ()):
+                    if isinstance(fn, _FUNC_NODES):
+                        entries.add(id(fn))
+        # every method of an HTTP handler class runs on a server thread
+        for nodes in self.index.by_name.values():
+            for fn in nodes:
+                if self._class_of_func.get(id(fn)) in handler_classes:
+                    entries.add(id(fn))
+                    self.threaded = True
+        # same-module closure: anything a thread entry calls is on-thread
+        node_by_id = {id(n): n for nodes in self.index.by_name.values()
+                      for n in nodes if isinstance(n, _FUNC_NODES)}
+        work = list(entries)
+        while work:
+            fn = node_by_id.get(work.pop())
+            if fn is None:
+                continue
+            for call in ast.walk(fn):
+                if not isinstance(call, ast.Call):
+                    continue
+                f = call.func
+                callee = None
+                if isinstance(f, ast.Name):
+                    callee = f.id
+                elif isinstance(f, ast.Attribute) and \
+                        isinstance(f.value, ast.Name) and \
+                        f.value.id in ("self", "cls"):
+                    callee = f.attr
+                if callee:
+                    for g in self.index.by_name.get(callee, ()):
+                        if id(g) not in entries and \
+                                isinstance(g, _FUNC_NODES):
+                            entries.add(id(g))
+                            work.append(id(g))
+        self.thread_targets = entries
+
+    # -- lock identity -------------------------------------------------------
+    def lock_id(self, expr, func):
+        """Resolve a ``with``-subject / ``.acquire()`` receiver to a
+        lock id, or None when it is not lock-like.  Unknown-owner locks
+        (``q._cond`` reached through another object) resolve to a
+        ``?``-scoped id: real for held-set purposes, excluded from the
+        cross-file order graph."""
+        if isinstance(expr, ast.Call):
+            # ``with self._lock:`` vs ``with Lock():`` — a direct
+            # construction guards nothing shared
+            return None
+        if isinstance(expr, ast.Name):
+            if expr.id in self.module_locks:
+                return self.module_locks[expr.id]
+            if _LOCKISH_RE.search(expr.id):
+                return f"{self.mod}.?{expr.id}"
+            return None
+        if isinstance(expr, ast.Attribute):
+            base = expr.value
+            if isinstance(base, ast.Name) and base.id == "self":
+                cls = self._class_of_func.get(id(func), "") if func \
+                    is not None else ""
+                attrs = self.class_locks.get(cls, {})
+                if expr.attr in attrs:
+                    return attrs[expr.attr]
+                if _LOCKISH_RE.search(expr.attr):
+                    return f"{self.mod}.{cls}.{expr.attr}"
+                return None
+            if isinstance(base, ast.Name):
+                # module global through an import alias:
+                # ``engine._SEG_LOCK`` — scope by the alias's last
+                # component, which matches the defining module's own id
+                if _LOCKISH_RE.search(expr.attr):
+                    return f"{base.id}.{expr.attr}"
+                return None
+            if _LOCKISH_RE.search(expr.attr):
+                return f"{self.mod}.?.{expr.attr}"
+        return None
+
+    # -- the walk ------------------------------------------------------------
+    def _scan_functions(self):
+        for nodes in self.index.by_name.values():
+            for fn in nodes:
+                if isinstance(fn, _FUNC_NODES) and \
+                        self.index.enclosing_function(fn) is None:
+                    for stmt in fn.body:
+                        self._walk_stmt(stmt, fn, ())
+
+    def _walk_stmt(self, stmt, func, held):
+        """Statement walk tracking the lexically-held lock stack.  Each
+        expression is recorded exactly once, at its owning statement."""
+        if isinstance(stmt, _FUNC_NODES):
+            for b in stmt.body:
+                self._walk_stmt(b, stmt, ())
+            return
+        if isinstance(stmt, ast.ClassDef):
+            for b in stmt.body:
+                self._walk_stmt(b, func, held)
+            return
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            new_held = held
+            for item in stmt.items:
+                self._record_exprs(item.context_expr, func, held)
+                lid = self.lock_id(item.context_expr, func)
+                if lid:
+                    for h in new_held:
+                        if h != lid:
+                            self.acquire_edges.append((h, lid, stmt))
+                    new_held = new_held + (lid,)
+            for b in stmt.body:
+                self._walk_stmt(b, func, new_held)
+            return
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.expr):
+                self._record_exprs(child, func, held)
+            elif isinstance(child, ast.stmt):
+                self._walk_stmt(child, func, held)
+            elif isinstance(child, ast.excepthandler):
+                for b in child.body:
+                    self._walk_stmt(b, func, held)
+            elif type(child).__name__ == "match_case":
+                for b in child.body:
+                    self._walk_stmt(b, func, held)
+
+    def _record_exprs(self, expr, func, held):
+        """Record accesses / acquire-calls / blocking calls in one
+        expression tree (lambda bodies run later — skipped)."""
+        stack = [expr]
+        while stack:
+            n = stack.pop()
+            if isinstance(n, ast.Lambda):
+                continue
+            if isinstance(n, ast.Call):
+                self._record_call(n, func, held)
+            self._record_access(n, func, held)
+            for c in ast.iter_child_nodes(n):
+                stack.append(c)
+
+    def _record_call(self, call, func, held):
+        f = call.func
+        if not isinstance(f, ast.Attribute):
+            return
+        meth = f.attr
+        recv = last_name(f.value) or \
+            (f.value.attr if isinstance(f.value, ast.Attribute) else "")
+        if meth == "acquire":
+            lid = self.lock_id(f.value, func)
+            if lid:
+                for h in held:
+                    if h != lid:
+                        self.acquire_edges.append((h, lid, call))
+            return
+        if not held:
+            return
+        has_timeout = any(kw.arg == "timeout" for kw in call.keywords)
+        nonblocking = any(
+            kw.arg == "block" and isinstance(kw.value, ast.Constant)
+            and kw.value.value is False for kw in call.keywords)
+        if meth in ("get", "put") and not has_timeout and not nonblocking \
+                and _QUEUEISH_RE.search(recv or ""):
+            self.blocking.append(
+                (held[-1], f"{recv}.{meth}() with no timeout", call))
+        elif meth == "result" and not call.args and not has_timeout:
+            self.blocking.append(
+                (held[-1], f"{recv}.result() with no timeout", call))
+        elif meth in ("wait", "wait_for") and not has_timeout:
+            bounded = meth == "wait" and call.args  # wait(t) positional
+            lid = self.lock_id(f.value, func)
+            if not bounded and (lid is None or lid not in held):
+                self.blocking.append(
+                    (held[-1], f"{recv}.{meth}() with no timeout", call))
+        elif meth == "join" and not call.args and not has_timeout and \
+                "thread" in (recv or "").lower():
+            self.blocking.append(
+                (held[-1], f"{recv}.join() with no timeout", call))
+
+    def _record_access(self, n, func, held):
+        state = None
+        if isinstance(n, ast.Attribute) and \
+                isinstance(n.value, ast.Name) and n.value.id == "self":
+            cls = self._class_of_func.get(id(func), "") if func \
+                is not None else ""
+            if not cls or self._is_lock_name(cls, n.attr):
+                return
+            state = f"{cls}.{n.attr}"
+        elif isinstance(n, ast.Name) and n.id in self._globals() and \
+                n.id not in self.module_locks:
+            state = f"{self.mod}.{n.id}"
+        if state is None:
+            return
+        store = isinstance(n.ctx, (ast.Store, ast.Del))
+        parent = self.index.parents.get(id(n))
+        if isinstance(parent, ast.Subscript) and \
+                isinstance(parent.ctx, (ast.Store, ast.Del)):
+            store = True
+        if isinstance(parent, ast.Attribute) and parent.attr in _MUTATORS:
+            store = True
+        if isinstance(parent, ast.AugAssign) and parent.target is n:
+            store = True
+        self.accesses.append(_Access(state, n, store, frozenset(held),
+                                     func))
+
+    def _is_lock_name(self, cls, attr) -> bool:
+        return attr in self.class_locks.get(cls, {}) or \
+            bool(_LOCKISH_RE.search(attr))
+
+    def _globals(self):
+        """Module-scope names assigned to non-def/class values — the
+        candidates for shared module-level state."""
+        if self._globals_cache is None:
+            out = set()
+            for stmt in self.src.tree.body:
+                targets = []
+                if isinstance(stmt, ast.Assign):
+                    targets = stmt.targets
+                elif isinstance(stmt, ast.AnnAssign):
+                    targets = [stmt.target]
+                for t in targets:
+                    if isinstance(t, ast.Name):
+                        out.add(t.id)
+            self._globals_cache = out
+        return self._globals_cache
+
+
+# ---------------------------------------------------------------------------
+# Per-file checks
+# ---------------------------------------------------------------------------
+
+def check_concurrency(src, index, enabled=None):
+    """Run T10 / T11's per-file half / T12 over one file.  Returns
+    ``(violations, lock_facts)`` where ``lock_facts`` is the
+    serializable per-file contribution to the cross-file T11 graph."""
+    model = ModuleConcurrency(src, index)
+    violations = []
+
+    def on(rule):
+        return enabled is None or rule in enabled
+
+    def emit(rule, severity, node, message):
+        line = getattr(node, "lineno", 0)
+        if src.is_suppressed(rule, line):
+            return
+        violations.append(Violation(
+            rule=rule, severity=severity, path=src.path, line=line,
+            col=getattr(node, "col_offset", 0),
+            context=index.qualname_of(node), message=message,
+            source=src.line_text(line)))
+
+    if on("T10"):
+        _check_guards(model, emit)
+    if on("T11"):
+        for held, desc, node in model.blocking:
+            emit("T11", SEVERITY_WARNING, node,
+                 f"unbounded blocking call ({desc}) while holding "
+                 f"`{held}` — a stalled peer turns this into a "
+                 "deadlock; add a timeout or move the wait outside "
+                 "the lock")
+    if on("T12"):
+        _check_lifecycle(model, src, index, emit)
+
+    lock_facts = {
+        "path": src.path,
+        "edges": [{
+            "src": a, "dst": b,
+            "line": getattr(node, "lineno", 0),
+            "col": getattr(node, "col_offset", 0),
+            "context": index.qualname_of(node),
+            "source": src.line_text(getattr(node, "lineno", 0)),
+            "suppressed": src.is_suppressed(
+                "T11", getattr(node, "lineno", 0)),
+        } for a, b, node in model.acquire_edges],
+    }
+    return violations, lock_facts
+
+
+def _check_guards(model, emit):
+    if not model.threaded:
+        return  # nothing in this module runs off the main thread
+    by_state = {}
+    for a in model.accesses:
+        by_state.setdefault(a.state, []).append(a)
+    for state, accs in sorted(by_state.items()):
+        relevant = [a for a in accs if a.func is None or
+                    not _EXEMPT_FUNC_RE.search(
+                        getattr(a.func, "name", "") or "")]
+        locked = [a for a in relevant if a.locks]
+        bare = [a for a in relevant if not a.locks]
+        if not locked or not bare:
+            continue
+        if not any(a.store for a in relevant):
+            continue  # read-only after construction: lock incidental
+        guards = sorted({lid for a in locked for lid in a.locks})
+        for a in bare:
+            kind = "written" if a.store else "read"
+            emit("T10",
+                 SEVERITY_ERROR if a.store else SEVERITY_WARNING,
+                 a.node,
+                 f"`{state}` is {kind} without a lock here but guarded "
+                 f"by {', '.join(f'`{g}`' for g in guards)} elsewhere "
+                 f"({len(locked)} locked access"
+                 f"{'es' if len(locked) != 1 else ''}) — take the lock "
+                 "or waiver with a why")
+
+
+def _check_lifecycle(model, src, index, emit):
+    for call in model.thread_sites:
+        kwargs = {kw.arg: kw.value for kw in call.keywords}
+        is_timer = last_name(call.func) == "Timer"
+        bound = _bound_name(call, index)
+        if "name" not in kwargs and not is_timer:
+            emit("T12", SEVERITY_WARNING, call,
+                 "unnamed thread — pass name=\"mxt-...\" so the flight "
+                 "recorder / straggler watchdog / ps can attribute it")
+        daemon = kwargs.get("daemon")
+        is_daemon = isinstance(daemon, ast.Constant) and \
+            daemon.value is True
+        if not is_daemon and bound is not None:
+            is_daemon = _daemon_assigned(call, bound, index)
+        if not is_daemon:
+            joined = bound is not None and _is_joined(src.tree, bound)
+            if not joined:
+                emit("T12", SEVERITY_ERROR, call,
+                     "non-daemon thread with no join on any shutdown "
+                     "path — it leaks past interpreter exit; pass "
+                     "daemon=True or join it in a close()/stop() path")
+        target = kwargs.get("target")
+        if target is None and is_timer and len(call.args) > 1:
+            target = call.args[1]
+        if isinstance(target, (ast.Name, ast.Attribute)):
+            for fn in index.by_name.get(last_name(target), ()):
+                if not isinstance(fn, _FUNC_NODES):
+                    continue
+                if _has_loop(fn) and not _captures_errors(fn, index):
+                    emit("T12", SEVERITY_WARNING, call,
+                         f"worker `{fn.name}` loops with no exception "
+                         "capture — an error kills the thread silently; "
+                         "capture it and re-raise at a materialization "
+                         "point (the engine/_prefetch/lane contract)")
+
+
+def _bound_name(call, index):
+    """The name/attr a Thread construction is assigned to, or None."""
+    parent = index.parents.get(id(call))
+    if isinstance(parent, ast.Assign):
+        for t in parent.targets:
+            if isinstance(t, ast.Name):
+                return t.id
+            if isinstance(t, ast.Attribute):
+                return t.attr
+    return None
+
+
+def _daemon_assigned(call, bound, index):
+    """``t.daemon = True`` (or ``t.setDaemon(True)``) in the same
+    function as the construction."""
+    fn = index.enclosing_function(call)
+    scope = fn if fn is not None else index.tree
+    for n in ast.walk(scope):
+        if isinstance(n, ast.Assign):
+            for t in n.targets:
+                if isinstance(t, ast.Attribute) and t.attr == "daemon" \
+                        and last_name(t.value) == bound and \
+                        isinstance(n.value, ast.Constant) and \
+                        n.value.value is True:
+                    return True
+        if isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute) \
+                and n.func.attr == "setDaemon" and \
+                last_name(n.func.value) == bound:
+            return True
+    return False
+
+
+def _is_joined(tree, bound):
+    for n in ast.walk(tree):
+        if isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute) \
+                and n.func.attr == "join" and \
+                last_name(n.func.value) == bound:
+            return True
+    return False
+
+
+def _has_loop(fn):
+    return any(isinstance(n, (ast.While, ast.For)) for n in ast.walk(fn))
+
+
+def _captures_errors(fn, index, _depth=0):
+    """The worker (or any same-module function it calls, one hop) has a
+    try/except — the captured-for-re-raise contract."""
+    if any(isinstance(n, ast.Try) for n in ast.walk(fn)):
+        return True
+    if _depth >= 1:
+        return False
+    for call in ast.walk(fn):
+        if not isinstance(call, ast.Call):
+            continue
+        f = call.func
+        callee = None
+        if isinstance(f, ast.Name):
+            callee = f.id
+        elif isinstance(f, ast.Attribute) and \
+                isinstance(f.value, ast.Name) and \
+                f.value.id in ("self", "cls"):
+            callee = f.attr
+        for g in index.by_name.get(callee or "", ()):
+            if isinstance(g, _FUNC_NODES) and \
+                    _captures_errors(g, index, _depth + 1):
+                return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# Cross-file T11 finalization: the package-wide lock-order graph
+# ---------------------------------------------------------------------------
+
+def build_lock_graph(all_lock_facts):
+    """Merge per-file facts into ``{(src, dst): [edge dict, ...]}``,
+    dropping unknown-owner (``?``-scoped) locks — they have no stable
+    cross-file identity."""
+    graph = {}
+    for facts in all_lock_facts:
+        for e in facts.get("edges", ()):
+            if "?" in e["src"] or "?" in e["dst"]:
+                continue
+            graph.setdefault((e["src"], e["dst"]),
+                             []).append(dict(e, path=facts["path"]))
+    return graph
+
+
+def check_lock_order(all_lock_facts):
+    """Error on every cycle in the package-wide acquisition-order
+    graph.  One violation per cycle, attributed to the cycle's
+    lexicographically-first edge site; a cycle is waived only when
+    EVERY participating edge site carries an inline T11 suppression."""
+    graph = build_lock_graph(all_lock_facts)
+    adj = {}
+    for (a, b) in graph:
+        adj.setdefault(a, set()).add(b)
+    violations = []
+    for cyc in _find_cycles(adj):
+        edges = []
+        for i, a in enumerate(cyc):
+            b = cyc[(i + 1) % len(cyc)]
+            edges.extend(graph.get((a, b), ()))
+        if not edges or all(e["suppressed"] for e in edges):
+            continue
+        site = min(edges, key=lambda e: (e["path"], e["line"]))
+        chain = " -> ".join(cyc + (cyc[0],))
+        others = "; ".join(
+            f"{e['src']}->{e['dst']} at {e['path']}:{e['line']}"
+            for e in sorted(edges, key=lambda e: (e["path"], e["line"])))
+        violations.append(Violation(
+            rule="T11", severity=SEVERITY_ERROR, path=site["path"],
+            line=site["line"], col=site["col"], context=site["context"],
+            message=f"lock-order cycle: {chain} — two threads taking "
+                    f"these in opposite orders deadlock ({others})",
+            source=site["source"]))
+    return violations
+
+
+def _find_cycles(adj):
+    """Elementary cycles, deduped by node set, each returned as a tuple
+    rotated to start at its smallest node.  DFS with an explicit stack —
+    fine for lock graphs (tens of nodes)."""
+    cycles = {}
+    for start in sorted(adj):
+        stack = [(start, (start,))]
+        while stack:
+            node, path = stack.pop()
+            for nxt in sorted(adj.get(node, ())):
+                if nxt == start:
+                    key = frozenset(path)
+                    if key not in cycles:
+                        i = path.index(min(path))
+                        cycles[key] = path[i:] + path[:i]
+                elif nxt not in path and len(path) < 16:
+                    stack.append((nxt, path + (nxt,)))
+    return sorted(cycles.values())

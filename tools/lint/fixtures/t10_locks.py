@@ -1,0 +1,69 @@
+"""T10 fixture: shared state accessed bare where it is lock-guarded
+elsewhere in the same module (guard-consistency)."""
+import threading
+
+
+class Ledger:
+    """Mixes locked and bare access to the same attribute."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries = {}
+        self._entries["seed"] = 0     # ok: __init__ is exempt
+
+    def record(self, k, v):
+        with self._lock:
+            self._entries[k] = v      # locked write: establishes guard
+
+    def total(self):
+        with self._lock:
+            return sum(self._entries.values())
+
+    def drop(self, k):
+        self._entries.pop(k, None)    # T10 error: bare write
+
+    def peek(self, k):
+        return self._entries.get(k)   # T10 warning: bare read
+
+    def drain_locked(self):
+        self._entries.clear()         # ok: _locked suffix = caller holds it
+
+    def start(self):
+        t = threading.Thread(target=self.record, args=("x", 1),
+                             name="mxt-ledger")
+        t.daemon = True
+        t.start()
+        t.join()
+
+
+_CACHE = {}
+_CACHE_LOCK = threading.Lock()
+
+
+def cache_put(k, v):
+    with _CACHE_LOCK:
+        _CACHE[k] = v                 # locked write: establishes guard
+
+
+def cache_del(k):
+    del _CACHE[k]                     # T10 error: bare module-global write
+
+
+def spawn():
+    t = threading.Thread(target=cache_put, args=(1, 2), name="mxt-cache")
+    t.daemon = True
+    t.start()
+    t.join()
+
+
+class Unthreaded:
+    """Same shape but the module would be clean without the Thread use
+    above — kept here to pin that T10 only fires in threaded modules."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+
+    def bump(self):
+        with self._lock:
+            self._n += 1

@@ -1,0 +1,51 @@
+"""T11 fixture: lock-order cycle (A->B in one path, B->A in another)
+plus unbounded blocking calls under a held lock."""
+import queue
+import threading
+
+_LOCK_A = threading.Lock()
+_LOCK_B = threading.Lock()
+_jobs_queue = queue.Queue()
+
+
+def forward():
+    with _LOCK_A:
+        with _LOCK_B:                 # edge A->B
+            return 1
+
+
+def backward():
+    with _LOCK_B:
+        with _LOCK_A:                 # edge B->A: closes the T11 cycle
+            return 2
+
+
+def blocked_get():
+    with _LOCK_A:
+        return _jobs_queue.get()      # T11 warning: timeout-less get under lock
+
+
+def blocked_put(item):
+    with _LOCK_B:
+        _jobs_queue.put(item)         # T11 warning: unbounded put under lock
+
+
+def blocked_result(ticket):
+    with _LOCK_A:
+        return ticket.result()        # T11 warning: unbounded wait under lock
+
+
+def bounded_get():
+    with _LOCK_A:
+        return _jobs_queue.get(timeout=1.0)   # ok: bounded
+
+def nonblocking_put(item):
+    with _LOCK_B:
+        _jobs_queue.put(item, block=False)    # ok: non-blocking
+
+
+def spawn():
+    t = threading.Thread(target=forward, name="mxt-order")
+    t.daemon = True
+    t.start()
+    t.join()

@@ -1,0 +1,51 @@
+"""T12 fixture: thread lifecycle — unnamed threads, unjoined
+non-daemon threads, worker loops with no exception capture."""
+import threading
+
+
+def tick():
+    return 1
+
+
+def spin():
+    while True:                       # loop body for the silent-worker case
+        tick()
+
+
+def guarded_spin():
+    try:
+        while True:
+            tick()
+    except Exception:
+        raise
+
+
+def unnamed():
+    t = threading.Thread(target=tick)     # T12 warning: no name=
+    t.daemon = True
+    t.start()
+    t.join()
+
+
+def unjoined():
+    # T12 error: non-daemon, never joined anywhere in this module
+    t2 = threading.Thread(target=tick, name="mxt-leak")
+    t2.start()
+
+
+def silent_worker():
+    # T12 warning: worker loops forever with no exception capture
+    t3 = threading.Thread(target=spin, name="mxt-spin", daemon=True)
+    t3.start()
+
+
+def good_worker():
+    t4 = threading.Thread(target=guarded_spin, name="mxt-good",
+                          daemon=True)   # ok: named, daemon, try/except
+    t4.start()
+
+
+def good_joined():
+    t5 = threading.Thread(target=tick, name="mxt-join")
+    t5.start()
+    t5.join()                         # ok: named and joined

@@ -31,7 +31,7 @@ def render_human(new, waived, stale, out):
                   "debt was fixed; run --update-baseline to prune.\n")
 
 
-def render_json(new, waived, stale, out):
+def render_json(new, waived, stale, out, cache_stats=None):
     payload = {
         "new": [v.to_dict() for v in new],
         "waived": [v.to_dict() for v in waived],
@@ -43,6 +43,8 @@ def render_json(new, waived, stale, out):
             "by_rule": dict(Counter(v.rule for v in new)),
         },
     }
+    if cache_stats is not None:
+        payload["summary"]["cache"] = dict(cache_stats)
     json.dump(payload, out, indent=2)
     out.write("\n")
 

@@ -42,6 +42,7 @@ import re
 
 from .core import (Violation, SEVERITY_ERROR, SEVERITY_WARNING, dotted_name,
                    last_name)
+from .concurrency import check_concurrency
 from .dataflow import check_donation
 from .hotpath import FunctionIndex, function_taint, expr_tainted
 
@@ -55,7 +56,14 @@ RULES = {
     "T7": "aliased array reaches a donating call (donation aliasing)",
     "T8": "partition-rule sanity (dead rule / silent replicate)",
     "T9": "memory-policy bypass (hand-rolled remat / dropped verdict)",
+    "T10": "shared state accessed bare where it is lock-guarded elsewhere",
+    "T11": "lock-order cycle / unbounded blocking call under a held lock",
+    "T12": "thread lifecycle (unnamed / unjoined non-daemon / silent worker)",
 }
+
+#: families whose cross-file halves the analyzer finalizes after the
+#: per-file sweep
+_CONCURRENCY_RULES = frozenset({"T10", "T11", "T12"})
 
 # --- T1 ---------------------------------------------------------------------
 
@@ -471,6 +479,8 @@ class FileChecker:
         self.index = FunctionIndex(src.tree)
         self.violations = []
         self.registrations = []
+        self.reg_facts = []       # serializable T3 facts (cacheable)
+        self.lock_facts = {"path": src.path, "edges": []}  # T11 facts
         self._taint_cache = {}
 
     def _on(self, rule):
@@ -479,6 +489,12 @@ class FileChecker:
     def run(self):
         if self._on("T3"):
             self.registrations = collect_registrations(self.src, self.index)
+            self.reg_facts = [registration_facts(r, self.src, self.index)
+                              for r in self.registrations]
+        if self.enabled is None or (self.enabled & _CONCURRENCY_RULES):
+            conc, self.lock_facts = check_concurrency(
+                self.src, self.index, enabled=self.enabled)
+            self.violations.extend(conc)
         if self._on("T6") or self._on("T7"):
             self.violations.extend(check_donation(
                 self.src, self.index, enabled=self.enabled))
@@ -760,64 +776,79 @@ def _is_host_view(expr) -> bool:
 # Cross-file T3 finalization
 # ---------------------------------------------------------------------------
 
-def check_registrations(all_regs, sources):
-    """Duplicate / docstring / grad-path checks over every static
-    registration collected in the run."""
-    violations = []
-    by_src = {s.path: s for s in sources}
+def registration_facts(reg, src, index):
+    """Reduce a Registration (which carries an AST node) to the
+    serializable facts the cross-file pass needs.  Everything derived
+    from the AST — docstrings, lambda-ness, the nondiff return scan —
+    is computed here, per file, so cached files skip AST work
+    entirely."""
+    fn = reg.func_node
+    is_lambda = isinstance(fn, ast.Lambda)
+    has_doc = bool(ast.get_docstring(fn)) if fn is not None and \
+        not is_lambda else False
+    returns_nondiff = False
+    if fn is not None and not is_lambda and not reg.no_grad:
+        returns_nondiff = any(_all_returns_nondiff(body)
+                              for body in _pure_bodies(fn, index))
+    return {
+        "name": reg.name,
+        "aliases": list(reg.aliases),
+        "no_grad": reg.no_grad,
+        "dynamic": reg.dynamic,
+        "path": reg.path,
+        "line": reg.line,
+        "col": reg.col,
+        "has_func": fn is not None,
+        "is_lambda": is_lambda,
+        "has_doc": has_doc,
+        "returns_nondiff": returns_nondiff,
+        "suppressed": src.is_suppressed("T3", reg.line),
+        "source": src.line_text(reg.line),
+    }
 
-    def emit(reg, message, severity=SEVERITY_ERROR, context=None):
-        src = by_src.get(reg.path)
-        if src is not None and src.is_suppressed("T3", reg.line):
+
+def check_registrations(all_facts):
+    """Duplicate / docstring / grad-path checks over every static
+    registration fact collected in the run (see registration_facts)."""
+    violations = []
+
+    def emit(fact, message, severity=SEVERITY_ERROR, context=None):
+        if fact["suppressed"]:
             return
         violations.append(Violation(
-            rule="T3", severity=severity, path=reg.path, line=reg.line,
-            col=reg.col, context=context or (reg.name or "<dynamic>"),
-            message=message,
-            source=src.line_text(reg.line) if src else ""))
+            rule="T3", severity=severity, path=fact["path"],
+            line=fact["line"], col=fact["col"],
+            context=context or (fact["name"] or "<dynamic>"),
+            message=message, source=fact["source"]))
 
     seen = {}
-    for reg in all_regs:
-        if reg.dynamic or reg.name is None:
+    for fact in all_facts:
+        if fact["dynamic"] or fact["name"] is None:
             continue
-        for name in (reg.name,) + tuple(reg.aliases):
+        for name in (fact["name"],) + tuple(fact["aliases"]):
             prev = seen.get(name)
-            if prev is not None and (prev.path, prev.line) != \
-                    (reg.path, reg.line):
-                emit(reg, f"op name {name!r} already registered at "
-                          f"{prev.path}:{prev.line} — duplicate "
-                          "registration shadows the original",
+            if prev is not None and (prev["path"], prev["line"]) != \
+                    (fact["path"], fact["line"]):
+                emit(fact, f"op name {name!r} already registered at "
+                           f"{prev['path']}:{prev['line']} — duplicate "
+                           "registration shadows the original",
                      context=name)
             else:
-                seen[name] = reg
-        fn = reg.func_node
-        if fn is None:
+                seen[name] = fact
+        if not fact["has_func"]:
             continue
-        if not reg.name.startswith("_"):
-            doc = ast.get_docstring(fn) if not isinstance(fn, ast.Lambda) \
-                else None
-            if isinstance(fn, ast.Lambda):
-                emit(reg, f"op {reg.name!r} is registered as a bare lambda "
-                          "— give it a named, documented wrapper",
+        if not fact["name"].startswith("_"):
+            if fact["is_lambda"]:
+                emit(fact, f"op {fact['name']!r} is registered as a bare "
+                           "lambda — give it a named, documented wrapper",
                      severity=SEVERITY_WARNING)
-            elif not doc:
-                emit(reg, f"op {reg.name!r} has no docstring",
+            elif not fact["has_doc"]:
+                emit(fact, f"op {fact['name']!r} has no docstring",
                      severity=SEVERITY_WARNING)
-        if not reg.no_grad and not isinstance(fn, ast.Lambda):
-            from .hotpath import FunctionIndex as _FI  # local index reuse
-            src = by_src.get(reg.path)
-            index = getattr(src, "_mxlint_index", None)
-            if index is None and src is not None:
-                index = _FI(src.tree)
-                src._mxlint_index = index
-            bodies = _pure_bodies(fn, index) if index is not None else []
-            for body in bodies:
-                if _all_returns_nondiff(body):
-                    emit(reg, f"op {reg.name!r} returns a "
-                              "non-differentiable value but is not "
-                              "marked no_grad=True — mark it (or wire a "
-                              "custom vjp) so autograd skips the vjp "
-                              "trace instead of emitting garbage "
-                              "cotangents")
-                    break
+        if fact["returns_nondiff"]:
+            emit(fact, f"op {fact['name']!r} returns a "
+                       "non-differentiable value but is not marked "
+                       "no_grad=True — mark it (or wire a custom vjp) "
+                       "so autograd skips the vjp trace instead of "
+                       "emitting garbage cotangents")
     return violations

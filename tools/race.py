@@ -1,0 +1,479 @@
+"""Seeded deterministic-interleaving harness for concurrency tests.
+
+``tools/lint`` rules T10-T12 prove lock discipline statically and the
+runtime lock sanitizer (``mxnet_tpu.sanitizer``, ``MXNET_SANITIZE_LOCKS``)
+observes the real acquisition order — this module closes the loop by
+*driving* a chosen interleaving, so a racy handoff can be replayed
+bit-identically from a seed instead of hoping the OS scheduler
+cooperates.
+
+Model (cooperative serialization):
+
+* A :class:`Harness` owns a set of *managed* threads (``spawn``) and a
+  single ``random.Random(seed)``.  At most ONE managed thread runs at a
+  time; every other managed thread is parked inside :func:`point`.
+* The scheduler loop (``run``) waits until every managed thread is
+  parked or done, then grants one of the *ready* parked threads chosen
+  by the seeded rng over their sorted names.  Same seed -> same grant
+  sequence -> the recorded trace replays bit-identically.
+* Lock boundaries park automatically: ``run`` installs the sanitizer's
+  trace hook, so a managed thread acquiring a ``wrap_lock``-wrapped lock
+  parks at ``lock:<name>`` first.  A thread parked on a lock owned by
+  another managed thread is not ready — and if NO thread is ready while
+  some are still parked, the harness raises :class:`DeadlockError` with
+  the park labels and lock owners (a deadlock witnessed, not guessed).
+* Foreign threads (a pool worker, an async writer) can join the managed
+  set for a scoped region via ``with managed("writer"):`` — pair every
+  adoption with an autonomous completion signal (an Event the driving
+  thread waits on) so the managed-set composition at each grant decision
+  stays schedule-independent.
+
+Rules for test authors:
+
+* A managed thread may make a *real* blocking call only if it unblocks
+  autonomously (a foreign thread finishes the work) — never when the
+  unblocking requires granting another managed thread; park instead,
+  or wrap the call in ``with external("label"):`` — the thread leaves
+  the scheduled set for the scope (the scheduler keeps granting others,
+  and waits rather than declaring deadlock while an external call is in
+  flight) and re-parks at ``external:<label>`` on exit.
+* Put a ``point("label")`` between the steps whose interleavings you
+  want explored; vary the seed to explore, pin the seed to regress.
+* ``Harness(seed, park_locks=False)`` disables parking at sanitizer
+  lock boundaries: use it when unmanaged threads take package locks on
+  racy paths (their acquisition COUNT would leak into the trace);
+  determinism then rests on explicit ``point()`` placement alone.
+
+``python -m tools.race --report`` runs the built-in scenarios twice and
+emits a JSON report asserting bit-identical replay (wired into
+tests/test_bench_smoke.py).  Stdlib-only; the sanitizer import is lazy.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import random
+import threading
+import time
+
+__all__ = ["Harness", "DeadlockError", "point", "managed", "external",
+           "active"]
+
+#: the currently-running harness (one at a time per process)
+_ACTIVE = None
+
+
+class DeadlockError(RuntimeError):
+    """No managed thread is ready: every one is parked on a lock owned
+    by another parked thread (or waiting forever)."""
+
+
+def active():
+    """The running :class:`Harness`, or None."""
+    return _ACTIVE
+
+
+def point(label):
+    """Interleaving point: park the calling managed thread until the
+    scheduler grants it.  No-op (one global read) outside a harness or
+    on an unmanaged thread — safe to leave in production-adjacent test
+    helpers."""
+    h = _ACTIVE
+    if h is not None:
+        h.point(label)
+
+
+@contextlib.contextmanager
+def managed(name):
+    """Adopt the calling *foreign* thread into the active harness for
+    the scope, as ``name``; release it on exit.  No-op without an
+    active harness."""
+    h = _ACTIVE
+    if h is None:
+        yield
+        return
+    h._adopt(name)
+    try:
+        yield
+    finally:
+        h._resign(name)
+
+
+@contextlib.contextmanager
+def external(label):
+    """Mark the calling managed thread as *externally blocked* for the
+    scope: the scheduler treats it as settled but never grants it, so a
+    real blocking call whose unblocking needs OTHER managed threads to
+    be granted (a backpressured save, a join) can sit inside.  On exit
+    the thread re-parks at ``external:<label>``.  No-op without an
+    active harness or on an unmanaged thread."""
+    h = _ACTIVE
+    name = getattr(h._local, "name", None) if h is not None else None
+    if name is None:
+        yield
+        return
+    with h._cv:
+        h._external[name] = label
+        h._state[name] = "external"
+        h._labels[name] = "external:" + label
+        h._cv.notify_all()
+    try:
+        yield
+    finally:
+        with h._cv:
+            h._external.pop(name, None)
+        h.point("external:" + label)
+
+
+class Harness:
+    def __init__(self, seed=0, park_locks=True):
+        self.seed = int(seed)
+        self.park_locks = bool(park_locks)
+        self.rng = random.Random(self.seed)
+        #: the replay artifact: ("grant"|"acquired"|"released"|"done",
+        #: thread name, label) — appended only by the single running
+        #: thread / the scheduler, so identical grant sequences produce
+        #: identical traces
+        self.trace = []
+        self._cv = threading.Condition()
+        self._threads = {}          # name -> Thread (spawned only)
+        self._state = {}            # name -> running|parked|done
+        self._labels = {}           # name -> current park label
+        self._grant = None
+        self._external = {}         # name -> label while in external()
+        self._owners = {}           # sanitizer lock name -> (name, depth)
+        self._failures = {}         # name -> exception
+        self._local = threading.local()
+
+    # -- building -------------------------------------------------------------
+    def spawn(self, name, fn, *args, **kwargs):
+        """Register a managed thread; started by :meth:`run`."""
+        if name in self._threads:
+            raise ValueError(f"duplicate managed thread {name!r}")
+        t = threading.Thread(target=self._main, name=f"mxt-race-{name}",
+                             args=(name, fn, args, kwargs), daemon=True)
+        self._threads[name] = t
+        self._state[name] = "running"
+        return self
+
+    def _main(self, name, fn, args, kwargs):
+        self._local.name = name
+        self.point("start")
+        try:
+            fn(*args, **kwargs)
+        except BaseException as e:   # re-raised from run()
+            self._failures[name] = e
+        finally:
+            with self._cv:
+                self._state[name] = "done"
+                self.trace.append(("done", name, ""))
+                self._cv.notify_all()
+
+    # -- managed-thread side --------------------------------------------------
+    def point(self, label):
+        name = getattr(self._local, "name", None)
+        if name is None:
+            return
+        with self._cv:
+            self._state[name] = "parked"
+            self._labels[name] = label
+            self._cv.notify_all()
+            while self._grant != name:
+                self._cv.wait()
+            self._grant = None
+            # a lock park inside an external() scope resumes external
+            self._state[name] = ("external" if name in self._external
+                                 else "running")
+            # the grant, not the park, is the trace event: parks can
+            # race during startup, grants are scheduler-serialized
+            self.trace.append(("grant", name, label))
+            self._cv.notify_all()
+
+    def _adopt(self, name):
+        self._local.name = name
+        with self._cv:
+            if name in self._state and self._state[name] != "done":
+                raise ValueError(f"managed name {name!r} already live")
+            self._state[name] = "running"
+            self._cv.notify_all()
+
+    def _resign(self, name):
+        self._local.name = None
+        with self._cv:
+            self._state.pop(name, None)
+            self._labels.pop(name, None)
+            self._external.pop(name, None)
+            self._cv.notify_all()
+
+    # -- sanitizer integration ------------------------------------------------
+    def _hook(self, event, lockname):
+        name = getattr(self._local, "name", None)
+        if name is None:
+            return                   # foreign thread: not scheduled
+        if event == "acquire":
+            self.point("lock:" + lockname)
+        elif event == "acquired":
+            with self._cv:
+                owner, depth = self._owners.get(lockname, (name, 0))
+                self._owners[lockname] = (name, depth + 1)
+                self.trace.append(("acquired", name, lockname))
+        elif event == "released":
+            with self._cv:
+                owner, depth = self._owners.get(lockname, (name, 1))
+                if depth <= 1:
+                    self._owners.pop(lockname, None)
+                else:
+                    self._owners[lockname] = (owner, depth - 1)
+                self.trace.append(("released", name, lockname))
+
+    # -- scheduler ------------------------------------------------------------
+    def _settled(self):
+        return all(s in ("parked", "done", "external")
+                   for s in self._state.values())
+
+    def _ready(self):
+        out = []
+        for name, state in sorted(self._state.items()):
+            if state != "parked":
+                continue
+            label = self._labels.get(name, "")
+            if label.startswith("lock:"):
+                owner = self._owners.get(label[5:])
+                if owner is not None and owner[0] != name:
+                    continue         # lock held by another managed thread
+            out.append(name)
+        return out
+
+    def _diagnose(self):
+        parked = {n: self._labels.get(n, "?")
+                  for n, s in sorted(self._state.items()) if s == "parked"}
+        owners = {ln: o[0] for ln, o in sorted(self._owners.items())}
+        return f"parked={parked} lock_owners={owners} seed={self.seed}"
+
+    def run(self, timeout=60.0):
+        """Start every spawned thread and drive the seeded schedule to
+        completion.  Returns the trace; raises DeadlockError on a
+        witnessed deadlock and re-raises the first managed-thread
+        exception otherwise."""
+        global _ACTIVE
+        if _ACTIVE is not None:
+            raise RuntimeError("a race harness is already active")
+        _ACTIVE = self
+        prev_hook = prev_enabled = None
+        san = None
+        if self.park_locks:
+            try:
+                from mxnet_tpu import sanitizer as san   # noqa: F811
+            except Exception:
+                pass                 # stdlib-only mode: no lock parking
+        deadline = time.monotonic() + timeout
+        try:
+            if san is not None:
+                prev_enabled = san.locks_enabled()
+                san.enable_locks()
+                prev_hook = san.set_trace_hook(self._hook)
+            for name in sorted(self._threads):
+                self._threads[name].start()
+            with self._cv:
+                while True:
+                    while not self._settled():
+                        if not self._cv.wait(0.2) \
+                                and time.monotonic() > deadline:
+                            raise DeadlockError(
+                                "harness timeout (a managed thread is "
+                                "blocked outside a park point): "
+                                + self._diagnose())
+                    live = [n for n, s in self._state.items()
+                            if s != "done"]
+                    if not live:
+                        break
+                    ready = self._ready()
+                    if not ready and any(
+                            s == "external"
+                            for s in self._state.values()):
+                        # an external call is in flight: wait for it to
+                        # return (and re-park) instead of declaring
+                        # deadlock — its unblocking is autonomous once
+                        # every grantable thread has run
+                        if not self._cv.wait(0.2) \
+                                and time.monotonic() > deadline:
+                            raise DeadlockError(
+                                "external call never returned: "
+                                + self._diagnose())
+                        continue
+                    if not ready:
+                        self.trace.append(
+                            ("deadlock", "", ",".join(sorted(live))))
+                        raise DeadlockError(
+                            "all managed threads parked, none ready: "
+                            + self._diagnose())
+                    pick = ready[self.rng.randrange(len(ready))]
+                    self._grant = pick
+                    self._cv.notify_all()
+                    while self._grant is not None:
+                        if not self._cv.wait(0.2) \
+                                and time.monotonic() > deadline:
+                            raise DeadlockError(
+                                "granted thread never resumed: "
+                                + self._diagnose())
+        finally:
+            if san is not None:
+                san.set_trace_hook(prev_hook)
+                if not prev_enabled:
+                    san.disable_locks()
+            _ACTIVE = None
+        for name in sorted(self._failures):
+            raise self._failures[name]
+        return self.trace
+
+
+# ---------------------------------------------------------------------------
+# Built-in scenarios (--report): the harness's own regression surface
+# ---------------------------------------------------------------------------
+
+def _scenario_points(seed):
+    """Three workers interleaving three labelled steps each."""
+    h = Harness(seed)
+    log = []
+
+    def worker(me):
+        for step in ("load", "compute", "store"):
+            h.point(step)
+            log.append(f"{me}.{step}")
+
+    for w in ("w1", "w2", "w3"):
+        h.spawn(w, worker, w)
+    trace = h.run()
+    return trace, log
+
+
+def _scenario_locks(seed):
+    """Two threads taking two sanitizer-wrapped locks in a consistent
+    order: schedules vary with the seed, the order graph stays acyclic."""
+    from mxnet_tpu import sanitizer as san
+
+    h = Harness(seed)
+    a = san.wrap_lock(threading.Lock(), "race.demo.A")
+    b = san.wrap_lock(threading.Lock(), "race.demo.B")
+    shared = []
+
+    def worker(me):
+        with a:
+            h.point("mid")
+            with b:
+                shared.append(me)
+
+    h.spawn("t1", worker, "t1")
+    h.spawn("t2", worker, "t2")
+    trace = h.run()
+    return trace, shared
+
+
+def _scenario_deadlock(seed):
+    """Opposite lock orders: returns True when the harness *witnessed*
+    the deadlock for this seed (both threads parked on the other's
+    lock), False when the schedule dodged it."""
+    from mxnet_tpu import sanitizer as san
+
+    h = Harness(seed)
+    a = san.wrap_lock(threading.Lock(), "race.dl.A")
+    b = san.wrap_lock(threading.Lock(), "race.dl.B")
+
+    def fwd():
+        with a:
+            h.point("mid")
+            with b:
+                pass
+
+    def bwd():
+        with b:
+            h.point("mid")
+            with a:
+                pass
+
+    h.spawn("fwd", fwd)
+    h.spawn("bwd", bwd)
+    try:
+        h.run(timeout=20.0)
+        return False
+    except DeadlockError:
+        return True
+
+
+def _trace_key(trace):
+    return json.dumps(trace, separators=(",", ":"))
+
+
+def _report(seed):
+    from mxnet_tpu import sanitizer as san
+
+    report = {"seed": seed, "scenarios": [], "ok": True}
+
+    t1, log1 = _scenario_points(seed)
+    t2, log2 = _scenario_points(seed)
+    t3, _ = _scenario_points(seed + 1)
+    report["scenarios"].append({
+        "name": "points",
+        "events": len(t1),
+        "replay_identical": _trace_key(t1) == _trace_key(t2)
+                            and log1 == log2,
+        "seed_changes_schedule": _trace_key(t1) != _trace_key(t3),
+    })
+
+    san.reset_locks()
+    l1, s1 = _scenario_locks(seed)
+    l2, s2 = _scenario_locks(seed)
+    report["scenarios"].append({
+        "name": "locks",
+        "events": len(l1),
+        "replay_identical": _trace_key(l1) == _trace_key(l2)
+                            and s1 == s2,
+        "order_violations": san.lock_order_violations(),
+    })
+
+    san.reset_locks()
+    witnessed = None
+    for s in range(16):
+        if _scenario_deadlock(s):
+            witnessed = s
+            break
+    report["scenarios"].append({
+        "name": "deadlock",
+        "witnessed_at_seed": witnessed,
+        "replay_identical": witnessed is not None
+                            and _scenario_deadlock(witnessed),
+        "runtime_cycle_detected":
+            bool(san.lock_order_violations()) or witnessed is not None,
+    })
+    san.reset_locks()
+
+    report["ok"] = all(sc.get("replay_identical") for sc in
+                       report["scenarios"]) \
+        and not report["scenarios"][1]["order_violations"]
+    return report
+
+
+def main(argv=None):
+    import argparse
+    import sys
+
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.race",
+        description="seeded deterministic-interleaving harness; --report "
+                    "runs the built-in scenarios twice and checks "
+                    "bit-identical replay")
+    ap.add_argument("--report", action="store_true",
+                    help="emit the JSON self-check report")
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args(argv)
+    if not args.report:
+        ap.error("nothing to do (pass --report)")
+    report = _report(args.seed)
+    json.dump(report, sys.stdout, indent=2)
+    sys.stdout.write("\n")
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())
