@@ -36,6 +36,17 @@ sub-1% delta), plus a microbench of the actual per-step hook
 median step time, is the acceptance number.  Artifact:
 FLEET_OVERHEAD_r13.json (override MXT_FLEET_OVERHEAD_OUT).
 Acceptance: hook cost at stride 16 < 1% of the median step time.
+
+``--numerics-overhead`` runs the r17 numerics-tier A/B lane: the
+llama_tiny dp8 lane (the model with stat taps wired through it) with
+numerics off / stats at stride 16 / stats + capture armed (arming must
+be free — it is one flag until a watchdog fires).  Medians are
+informational on CPU; the acceptance number is a microbench of the
+host-side work the tier adds per step (``record_compiled`` queueing +
+the stride-gated ``step_summary`` harvest) against the numerics-off
+median step time.  Artifact: NUMERICS_OVERHEAD_r17.json (override
+MXT_NUMERICS_OVERHEAD_OUT).
+Acceptance: per-step numerics cost at stride 16 < 1% of step time.
 """
 from __future__ import annotations
 
@@ -233,6 +244,148 @@ def _hook_cost_ms(stride, iters=4096):
     return total_ms / iters
 
 
+def _numerics_lane(mode):
+    """Median llama_tiny dp8 step time with the numerics tier off,
+    harvesting stats at stride 16, or stats + the capture hook armed
+    (``mode`` in ``off`` / ``stats`` / ``capture``).  Also reports how
+    many stride harvests landed a ``record["numerics"]`` block."""
+    import tempfile
+
+    from mxnet_tpu import autograd, gluon, nd, parallel, telemetry
+    from mxnet_tpu.telemetry import numerics
+
+    telemetry.enable()
+    if mode != "off":
+        numerics.enable(stride=16)
+    if mode == "capture":
+        numerics.arm_capture(tempfile.mkdtemp(prefix="numerics_bench_"))
+    try:
+        net, rules, batches, step_fn = _build_llama_tiny()
+        mesh = parallel.make_mesh({"dp": 8})
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.01},
+                                partition_rules=rules, mesh=mesh)
+        batches = tuple(parallel.shard_batch(b, mesh) for b in batches)
+        times, blocks = [], 0
+        for i in range(WARMUP + STEPS):
+            with telemetry.step(examples=batches[0].shape[0]) as scope:
+                loss = step_fn(net, trainer, batches, autograd)
+                loss.wait_to_read()
+                nd.waitall()
+            if scope.record.get("numerics") is not None:
+                blocks += 1
+            if i >= WARMUP:
+                times.append(scope.record["step_ms"])
+        # forced boundary harvest: one extra untimed step whose summary
+        # runs at a stride multiple, so short smoke runs still prove the
+        # taps flowed (in a real run stride-16 records carry the blocks)
+        harvested = 0
+        if mode != "off":
+            loss = step_fn(net, trainer, batches, autograd)
+            loss.wait_to_read()
+            nd.waitall()
+            summary = numerics.step_summary(0)
+            harvested = len((summary or {}).get("tensors") or ())
+        record = {
+            "mode": mode,
+            "step_ms_median": round(statistics.median(times), 3),
+            "numerics_blocks": blocks,
+            "harvested_paths": harvested,
+            "capture_armed": numerics.capture_armed(),
+        }
+    finally:
+        telemetry.disable()
+        numerics.clear()
+        parallel.set_mesh(None)
+        gc.collect()
+    return record
+
+
+def _numerics_hook_cost_ms(stride, iters=4096, paths=8):
+    """Per-step wall cost of what the numerics tier adds OUTSIDE the
+    compile: queueing ``paths`` compiled-stat bundles per step
+    (``record_compiled``) plus the stride-gated ``step_summary``
+    harvest (the tier's one host sync) over ``iters`` steps.  The
+    in-compile stat math itself rides the step's XLA program and is
+    covered by the lane medians."""
+    import jax.numpy as jnp
+
+    from mxnet_tpu.telemetry import numerics
+
+    numerics.enable(stride=stride)
+    names = tuple(f"decoder.{i}.out" for i in range(paths))
+    stats = tuple(
+        {k: jnp.float32(1.0) for k in ("l2", "maxabs", "mean")}
+        | {k: jnp.int32(0) for k in ("nan", "inf")}
+        for _ in range(paths))
+    try:
+        t0 = time.perf_counter()
+        for i in range(1, iters + 1):
+            numerics.record_compiled(names, stats)
+            numerics.step_summary(i)
+        total_ms = (time.perf_counter() - t0) * 1e3
+    finally:
+        numerics.clear()
+    return total_ms / iters
+
+
+def main_numerics_overhead():
+    plat = os.environ.get("BENCH_PLATFORM")
+    if plat:
+        import jax
+
+        jax.config.update("jax_platforms", plat)
+    import jax
+
+    import mxnet_tpu as mx
+
+    n = jax.device_count()
+    if n < 8:
+        raise SystemExit(f"sharded_step needs >= 8 devices, have {n} "
+                         "(set XLA_FLAGS=--xla_force_host_platform_"
+                         "device_count=8)")
+    mx.random.seed(0)
+    t0 = time.time()
+    lanes = {"off": _numerics_lane("off"),
+             "stats": _numerics_lane("stats"),
+             "stats_capture_armed": _numerics_lane("capture")}
+    hook_ms_16 = _numerics_hook_cost_ms(16)
+    hook_ms_1 = _numerics_hook_cost_ms(1)
+    off_ms = lanes["off"]["step_ms_median"]
+    overhead_pct = hook_ms_16 / off_ms * 100.0 if off_ms else 0.0
+    record = {
+        "metric": "numerics_overhead_pct_stride16",
+        "value": round(overhead_pct, 4),
+        "unit": "% of numerics-off median step time (per-step "
+                "record_compiled + step_summary cost at stride 16)",
+        "n_devices": n,
+        "lanes": lanes,
+        "hook_ms_stride16": round(hook_ms_16, 6),
+        "hook_ms_stride1": round(hook_ms_1, 6),
+        "acceptance": {
+            "numerics_overhead_under_1pct": overhead_pct < 1.0,
+            "stats_lanes_harvested": all(
+                lanes[k]["harvested_paths"] > 0
+                for k in ("stats", "stats_capture_armed")),
+            "off_lane_clean": lanes["off"]["numerics_blocks"] == 0,
+        },
+        "wall_sec": round(time.time() - t0, 1),
+        "platform": os.environ.get("JAX_PLATFORMS", plat or "default"),
+    }
+    line = json.dumps(record, indent=2, default=str)
+    print(line)
+    out_path = os.environ.get(
+        "MXT_NUMERICS_OVERHEAD_OUT",
+        os.path.join(os.path.dirname(__file__), "..",
+                     "NUMERICS_OVERHEAD_r17.json"))
+    with open(out_path, "w") as f:
+        f.write(line + "\n")
+    bad = {k: v for k, v in record["acceptance"].items() if not v}
+    if bad:
+        raise SystemExit(f"acceptance failed: {bad} "
+                         f"(numerics cost {overhead_pct:.3f}%/step)")
+
+
 def main_fleet_overhead():
     plat = os.environ.get("BENCH_PLATFORM")
     if plat:
@@ -347,5 +500,7 @@ def main():
 if __name__ == "__main__":
     if "--fleet-overhead" in sys.argv[1:]:
         main_fleet_overhead()
+    elif "--numerics-overhead" in sys.argv[1:]:
+        main_numerics_overhead()
     else:
         main()

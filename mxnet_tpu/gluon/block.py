@@ -42,6 +42,7 @@ from .. import autograd as ag
 from .. import telemetry
 from ..telemetry import costs as _costs
 from ..telemetry import memwatch as _mw
+from ..telemetry import numerics as _numerics
 from ..context import Context, current_context
 from ..ndarray import NDArray
 from .parameter import (Parameter, ParameterDict,
@@ -414,10 +415,23 @@ class _CachedGraph:
         self.remat = _mem_policy.normalize(remat)
         self.struct = None
         self.aux_idx = ()
+        # numerics mode is baked at graph-build time (the CachedOp cache
+        # signature keys on it, so each mode keeps one specialization):
+        # taps fired during the trace exit as extra jit outputs, and the
+        # backward grows per-param grad stats inside the same compile
+        self.numerics = _numerics.trace_enabled()
+        self.stat_names = ()
         self._compiled = set()  # dispatch modes that already paid compile
         self._fwd = jax.jit(self._pure)
         self._fwd_rec = jax.jit(self._record_fwd)
-        self._bwd = jax.jit(lambda vjp, cots: vjp(cots))
+        if self.numerics:
+            def _bwd_stats(vjp, cots):
+                p_cots, in_cots = vjp(cots)
+                gstats = tuple(_numerics.stats_of(g) for g in p_cots)
+                return p_cots, in_cots, gstats
+            self._bwd = jax.jit(_bwd_stats)
+        else:
+            self._bwd = jax.jit(lambda vjp, cots: vjp(cots))
 
     # the pure functional body: (param raws, input raws, rng key) ->
     # (output raws, updated-aux raws)
@@ -432,7 +446,18 @@ class _CachedGraph:
             args = [NDArray(r) for r in in_raws]
             with ag._RecordingStateScope(False, self.training), \
                     mxrand.key_provider(key), _trace_guard():
-                out = self.block.forward(*args)
+                # static build-time bool, not a tracer: baked in
+                # __init__ and part of the CachedOp cache signature
+                if self.numerics:  # mxlint: allow=T2
+                    # taps fired by the forward land on this collector
+                    # and leave the trace as side outputs; their paths
+                    # are static metadata saved like ``struct`` below
+                    with _numerics.collecting() as col:
+                        out = self.block.forward(*args)
+                    self.stat_names, stats = col.drain()
+                else:
+                    stats = ()
+                    out = self.block.forward(*args)
             leaves, struct = _tree_flatten_nd(out)
             out_raws = tuple(o._data for o in leaves)
             aux_idx = tuple(i for i, (h, r) in
@@ -441,7 +466,7 @@ class _CachedGraph:
             aux_raws = tuple(handles[i]._data for i in aux_idx)
             self.struct = struct
             self.aux_idx = aux_idx
-            return out_raws, aux_raws
+            return out_raws, aux_raws, stats
         finally:
             for h, s in zip(handles, saved):
                 h._data = s
@@ -455,11 +480,15 @@ class _CachedGraph:
         # recomputes (all of, or the non-dot parts of) the forward
         # instead of holding every intermediate in HBM — the standard
         # TPU trade of FLOPs for memory (enables much larger batches)
-        fn = checkpoint_wrap(lambda p, x: self._pure(p, x, key),
-                             self.remat)
-        outs, vjp, auxs = jax.vjp(fn, list(p_raws), list(in_raws),
-                                  has_aux=True)
-        return outs, auxs, vjp
+        # aux carries (updated aux state, numerics stats): neither is
+        # differentiated, both must exit the recording forward's compile
+        fn = checkpoint_wrap(
+            lambda p, x: (lambda o, a, s: (o, (a, s)))(
+                *self._pure(p, x, key)),
+            self.remat)
+        outs, vjp, (auxs, stats) = jax.vjp(fn, list(p_raws),
+                                           list(in_raws), has_aux=True)
+        return outs, auxs, stats, vjp
 
     def run(self, args):
         from .. import random as mxrand
@@ -485,9 +514,10 @@ class _CachedGraph:
                                 else "cachedop.replay"), \
                     dispatch_platform(platform_of_raws(in_raws + p_raws)):
                 if recording:
-                    outs, auxs, vjp = self._fwd_rec(p_raws, in_raws, key)
+                    outs, auxs, stats, vjp = self._fwd_rec(
+                        p_raws, in_raws, key)
                 else:
-                    outs, auxs = self._fwd(p_raws, in_raws, key)
+                    outs, auxs, stats = self._fwd(p_raws, in_raws, key)
         except Exception as exc:
             if _mw._enabled:
                 _mw.annotate_oom(
@@ -505,16 +535,26 @@ class _CachedGraph:
                         (p_raws, in_raws, key), remat=self.remat)
         for i, raw in zip(self.aux_idx, auxs):
             p_handles[i]._data = raw
+        if self.numerics and stats:
+            # device scalars only — they queue for the stride harvest,
+            # no host transfer happens on the step path
+            _numerics.record_compiled(self.stat_names, stats)
         nd_outs = [NDArray(r) for r in outs]
         if recording:
             bwd = self._bwd
             graph_id = id(self)
             block_name = self.block.name
             remat_tier = self.remat
+            numerics_on = self.numerics
+            grad_paths = tuple("grad." + p.name for p in self.params)
 
             def node_vjp(cots):
                 try:
-                    p_cots, in_cots = bwd(vjp, tuple(cots))
+                    if numerics_on:
+                        p_cots, in_cots, gstats = bwd(vjp, tuple(cots))
+                        _numerics.record_compiled(grad_paths, gstats)
+                    else:
+                        p_cots, in_cots = bwd(vjp, tuple(cots))
                 except Exception as exc:
                     if _mw._enabled:
                         _mw.annotate_oom(
@@ -635,7 +675,7 @@ class CachedOp:
         mesh_sig = None if mesh is None else tuple(mesh.shape.items())
         sig = (tuple((a.shape, str(a.dtype)) for a in args), training, plat,
                tuple((p.shape, str(np.dtype(p.dtype))) for p in params),
-               mesh_sig)
+               mesh_sig, _numerics.signature())
         g = self._graphs.get(sig)
         if g is None:
             # a new (shapes, dtypes, mode, platform) signature: this call

@@ -50,6 +50,7 @@ from .. import sanitizer as _san
 from .. import telemetry
 from ..telemetry import costs as _costs
 from ..telemetry import memwatch as _mw
+from ..telemetry import numerics as _numerics
 from ..base import MXNetError
 from ..ndarray import NDArray
 from .block import _trace_guard
@@ -234,6 +235,9 @@ class FusedTrainStep:
         optzr = self.trainer._optimizer
         k = self.k
         stacked_inputs = self.stacked_inputs
+        # baked at build time; the compile signature keys on it, so each
+        # numerics mode keeps one K-step program
+        numerics_on = _numerics.trace_enabled()
         grad_and_aux = jax.value_and_grad(self._pure_loss, argnums=0,
                                           has_aux=True)
 
@@ -247,16 +251,34 @@ class FusedTrainStep:
             # multi-tensor path (optimizer._fused_param_updates)
             new_w, new_m, new_s = opt._fused_param_updates(
                 optzr, mp_flags, w, m, grads, s, lr_v, wd_v, t)
-            return (new_w, new_m, new_s, new_aux, t + 1, key), loss_sum
+            nstats = tuple(
+                (_numerics.stats_of(g), _numerics.stats_of(nw - ow))
+                for g, nw, ow in zip(grads, new_w, w)) \
+                if numerics_on else ()
+            return ((new_w, new_m, new_s, new_aux, t + 1, key),
+                    (loss_sum, nstats))
+
+        def _reduce_k(st):
+            # per-param stats stacked (K,) by the scan, folded to one
+            # bundle per execution INSIDE the compile: overflow counts
+            # sum over the K inner steps, magnitudes keep the freshest
+            # (l2/mean last, maxabs worst-case)
+            import jax.numpy as jnp
+
+            return {"l2": st["l2"][-1], "maxabs": jnp.max(st["maxabs"]),
+                    "mean": st["mean"][-1], "nan": jnp.sum(st["nan"]),
+                    "inf": jnp.sum(st["inf"])}
 
         def k_steps(w, m, s, aux, t, key, lr_v, wd_v, consts, stacked):
             def body(carry, xr):
                 return one_step(carry, xr, consts, lr_v, wd_v)
 
-            carry, losses = jax.lax.scan(
+            carry, (losses, nstats) = jax.lax.scan(
                 body, (w, m, s, aux, t, key), stacked,
                 length=(None if stacked_inputs else k))
-            return carry[:5], losses
+            nstats = tuple((_reduce_k(g), _reduce_k(u))
+                           for g, u in nstats)
+            return carry[:5], losses, nstats
 
         # donate weights/masters/states/aux: K steps of updates in place
         return jax.jit(k_steps, donate_argnums=(0, 1, 2, 3))
@@ -314,7 +336,8 @@ class FusedTrainStep:
         mesh_sig = None if mesh is None else tuple(mesh.shape.items())
         sig = (type(optzr).__name__, float(optzr.rescale_grad),
                tuple(mp_flags),
-               tuple((b.shape, str(b.dtype)) for b in batch), mesh_sig)
+               tuple((b.shape, str(b.dtype)) for b in batch), mesh_sig,
+               _numerics.signature())
         fn = self._jit_cache.get(sig)
         if fn is None:
             telemetry.count("step_fusion.cache_miss")
@@ -361,9 +384,9 @@ class FusedTrainStep:
             with telemetry.span("step_fusion.compile" if snapshot is not None
                                 else "step_fusion.replay"), \
                     dispatch_platform(platform_of_raws(w_raws)):
-                (new_w, new_m, new_s, new_aux, _new_t), losses = fn(
-                    w_raws, m_raws, s_raws, aux_raws, t_v, key, lr_v,
-                    wd_v, consts, stacked if stacked else None)
+                (new_w, new_m, new_s, new_aux, _new_t), losses, nstats = \
+                    fn(w_raws, m_raws, s_raws, aux_raws, t_v, key, lr_v,
+                       wd_v, consts, stacked if stacked else None)
 
             if _san._enabled:
                 # weights/masters/states/aux were donated at dispatch;
@@ -380,6 +403,15 @@ class FusedTrainStep:
                                                aux_raws))
             opt._commit_param_updates(trainer, self._live, mp_flags,
                                       masters, new_w, new_m, new_s)
+            if nstats:
+                # K-reduced grad/update-delta bundles, still device
+                # scalars — queued for the stride harvest, no host sync
+                names, stats = [], []
+                for i, (gs, us) in zip(self._live, nstats):
+                    pname = trainer._params[i].name
+                    names += ["grad." + pname, "update." + pname]
+                    stats += [gs, us]
+                _numerics.record_compiled(names, stats)
             for i in self._live:
                 optzr._index_update_count[i] = \
                     optzr._index_update_count.get(

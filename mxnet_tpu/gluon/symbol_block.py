@@ -60,7 +60,7 @@ def export_block(block, path, epoch=0):
     key = jax.random.PRNGKey(0)
 
     def infer_fn(p, x, k):
-        outs, _aux = graph._pure(list(p), list(x), k)
+        outs, _aux, _stats = graph._pure(list(p), list(x), k)
         return outs
 
     exported = jax.export.export(jax.jit(infer_fn))(p_raws, in_raws, key)

@@ -25,6 +25,7 @@ from .. import sanitizer as _san
 from .. import telemetry
 from ..telemetry import costs as _costs
 from ..telemetry import memwatch as _mw
+from ..telemetry import numerics as _numerics
 
 __all__ = ["Trainer", "PREEMPTED_EXIT_CODE", "install_preemption_handler",
            "drain_requested", "drain_consensus", "request_drain",
@@ -564,17 +565,29 @@ class Trainer:
         sig = (type(optzr).__name__, float(optzr.rescale_grad),
                tuple(mp_flags),
                tuple((w.shape, str(w.dtype)) for w in weights),
-               tuple(len(s) for s in states), mesh_sig)
+               tuple(len(s) for s in states), mesh_sig,
+               _numerics.signature())
         fn = self._fused_cache.get(sig)
         compiling = fn is None
         if compiling:
             telemetry.count("trainer.fused_cache_miss")
             flags = tuple(mp_flags)
+            # baked at trace time; the signature above keys on it, so
+            # stats-on and stats-off each keep one fused program
+            numerics_on = _numerics.trace_enabled()
 
             def fused(w_raws, m_raws, g_raws, s_raws, lr_v, wd_v, t_v):
-                return opt._fused_param_updates(
+                new_w, new_m, new_s = opt._fused_param_updates(
                     optzr, flags, w_raws, m_raws, g_raws, s_raws,
                     lr_v, wd_v, t_v)
+                # grad + update-delta stats fold into the SAME donated
+                # compile — reading the donated w_raws here is fine, the
+                # trace is functional (donation is a buffer-reuse hint)
+                nstats = tuple(
+                    (_numerics.stats_of(g), _numerics.stats_of(nw - ow))
+                    for g, nw, ow in zip(g_raws, new_w, w_raws)) \
+                    if numerics_on else ()
+                return new_w, new_m, new_s, nstats
 
             # donate weights, masters and states; grads are read-only
             fn = jax.jit(fused, donate_argnums=(0, 1, 3))
@@ -608,8 +621,8 @@ class Trainer:
         try:
             with telemetry.span("trainer.fused_compile" if compiling
                                 else "trainer.fused_update"):
-                new_w, new_m, new_s = fn(w_raws, m_raws, g_raws, s_raws,
-                                         lr_v, wd_v, t_v)
+                new_w, new_m, new_s, nstats = fn(
+                    w_raws, m_raws, g_raws, s_raws, lr_v, wd_v, t_v)
         except Exception as exc:
             if _mw._enabled:
                 _mw.annotate_oom(exc, context="Trainer fused update")
@@ -629,6 +642,15 @@ class Trainer:
                 "multi-tensor update, donate_argnums=(0, 1, 3))")
         opt._commit_param_updates(self, live, mp_flags, masters,
                                   new_w, new_m, new_s)
+        if nstats:
+            # device scalars queued for the stride harvest — no host
+            # transfer on the update path
+            names, stats = [], []
+            for i, (gs, us) in zip(live, nstats):
+                pname = self._params[i].name
+                names += ["grad." + pname, "update." + pname]
+                stats += [gs, us]
+            _numerics.record_compiled(names, stats)
         if self._offload == "host":
             # holders now point at the fresh device results; move the
             # optimizer side back to host for the inter-step window

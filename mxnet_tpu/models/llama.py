@@ -26,6 +26,7 @@ from .. import autograd
 from ..base import MXNetError
 from ..gluon.block import HybridBlock
 from ..gluon import nn
+from ..telemetry import numerics as _numerics
 
 __all__ = ["LlamaConfig", "RMSNorm", "LlamaAttention", "LlamaMLP",
            "LlamaDecoderLayer", "LlamaModel", "LlamaForCausalLM",
@@ -340,13 +341,19 @@ class LlamaModel(HybridBlock):
 
     def hybrid_forward(self, F, input_ids, segment_ids=None):
         h = self.embed_tokens(input_ids)
+        _numerics.tap("embed", h)
         if self._cfg.scan_layers and len(self.layers) > 1:
+            # per-layer stats exit the scan as stacked ys — taps here
+            # would see scan-body tracers; see _scan_machinery
             h = _apply_layers_scanned(self, h, segment_ids)
         else:
-            for layer in self.layers:
+            for i, layer in enumerate(self.layers):
                 h = layer(h) if segment_ids is None \
                     else layer(h, segment_ids)
-        return self.norm(h)
+                _numerics.tap(f"decoder.{i}", h)
+        h = self.norm(h)
+        _numerics.tap("norm", h)
+        return h
 
 
 class LlamaForCausalLM(HybridBlock):
@@ -377,7 +384,9 @@ class LlamaForCausalLM(HybridBlock):
             h = self.model(input_ids)
         else:
             h = self.model(input_ids, segment_ids)
-        return _lm_head(self, h)
+        out = _lm_head(self, h)
+        _numerics.tap("logits", out)
+        return out
 
     def set_remat(self, tier):
         """Set the decoder-stack remat tier ("none" / "dots" / "layer"
@@ -1043,9 +1052,22 @@ def _apply_layers_scanned(model, h, segment_ids=None):
     saved = [sh._data for sh in shells]
     try:
         if segment_ids is not None:
-            return apply_op(mach["fn"], h, segment_ids, *stacked,
-                            name="scan_layers_packed")
-        return apply_op(mach["fn"], h, *stacked, name="scan_layers")
+            res = apply_op(mach["fn"], h, segment_ids, *stacked,
+                           name="scan_layers_packed")
+        else:
+            res = apply_op(mach["fn"], h, *stacked, name="scan_layers")
+        # static build-time bool out of the machinery cache (keyed on
+        # the numerics mode), not a tracer
+        if not mach["numerics"]:  # mxlint: allow=T2
+            return res
+        # unpack the stacked per-layer stat ys (unused downstream, so
+        # autograd feeds them zero cotangents) and queue them for the
+        # stride harvest under decoder.<i> paths
+        out, l2, maxabs, mean, nan, inf = res
+        _numerics.tap_stacked("decoder", {
+            "l2": l2._data, "maxabs": maxabs._data, "mean": mean._data,
+            "nan": nan._data, "inf": inf._data})
+        return out
     finally:
         for sh, s in zip(shells, saved):
             sh._data = s
@@ -1091,9 +1113,11 @@ def _scan_machinery(model, remat="layer", with_seg=False):
     hit across steps; a tier change — or switching between packed and
     plain batches — rebuilds)."""
     cache = getattr(model, "_scan_mach", None)
+    numerics_on = _numerics.trace_enabled()
     # remat is a host-side tier string, never a tracer
     if (cache is not None and cache["remat"] == remat  # mxlint: allow=T2
-            and cache["with_seg"] == with_seg):
+            and cache["with_seg"] == with_seg
+            and cache["numerics"] == numerics_on):
         return cache
     from ..gluon.block import _trace_guard
     from ..memory.policy import checkpoint_wrap
@@ -1121,24 +1145,37 @@ def _scan_machinery(model, remat="layer", with_seg=False):
 
     wrapped = checkpoint_wrap(apply_one, remat)
 
+    # numerics: per-layer output stats ride the scan as stacked ys —
+    # computed inside the same compile, stacked (L,) per stat by
+    # lax.scan itself, and returned flat (apply_op dispatches tuples of
+    # arrays).  Taps inside the body would hand scan tracers to the
+    # collector; the ys are the only legal exit.
+    def _body_ys(new):
+        if not numerics_on:
+            return ()
+        st = _numerics.stats_of(new)
+        return (st["l2"], st["maxabs"], st["mean"], st["nan"], st["inf"])
+
     if with_seg:
         def _scan_raw(hr, segr, *stk):
             from jax import lax
 
             def body(carry, sl):
-                return wrapped(sl, carry, segr), ()
+                new = wrapped(sl, carry, segr)
+                return new, _body_ys(new)
 
-            out, _ = lax.scan(body, hr, tuple(stk))
-            return out
+            out, ys = lax.scan(body, hr, tuple(stk))
+            return (out,) + ys if numerics_on else out
     else:
         def _scan_raw(hr, *stk):
             from jax import lax
 
             def body(carry, sl):
-                return wrapped(sl, carry), ()
+                new = wrapped(sl, carry)
+                return new, _body_ys(new)
 
-            out, _ = lax.scan(body, hr, tuple(stk))
-            return out
+            out, ys = lax.scan(body, hr, tuple(stk))
+            return (out,) + ys if numerics_on else out
 
     # jit the scan program: (a) eager steps run ONE compiled program
     # instead of a traced-eager loop, and (b) shard_map-based layers
@@ -1148,7 +1185,7 @@ def _scan_machinery(model, remat="layer", with_seg=False):
 
     cache = {"names": names, "shells": shells, "fn": fn,
              "apply_one": apply_one, "remat": remat,
-             "with_seg": with_seg}
+             "with_seg": with_seg, "numerics": numerics_on}
     model._scan_mach = cache
     return cache
 

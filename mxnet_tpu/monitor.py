@@ -43,20 +43,25 @@ class Monitor:
         ``monitor_all`` also record inputs (reference
         ``monitor_all`` on executor attaches input arrays too).
 
-        Hybridized blocks replay a compiled graph — child forwards (and so
-        these hooks) only run at trace time; monitor imperatively."""
+        Hybridized blocks replay a compiled graph, so child forwards
+        (and these hooks) only run at trace time.  The hooks therefore
+        ride the numerics tier there: at trace time each monitored
+        output is ``numerics.tap``-ed under a ``monitor.<name>`` path,
+        baking the stat into the compiled graph as a side output that
+        records on EVERY replay; ``toc()`` drains those entries.  The
+        eager path (non-hybridized blocks) keeps the legacy stat_func
+        queue unchanged."""
         if getattr(block, "_active", False):
-            import warnings
+            from .telemetry import numerics as _numerics
 
-            warnings.warn(
-                "Monitor.install on a hybridized block records nothing "
-                "after the first trace; call hybridize(False) while "
-                "monitoring", stacklevel=2)
+            # compiled-path recording needs the tier on, and any graph
+            # traced before these hooks existed must re-trace with them
+            if not _numerics.is_enabled():
+                _numerics.enable()
+            block._clear_cached_op()
 
         def make_hook(name):
             def hook(blk, inputs, outputs):
-                if not self.activated:
-                    return
                 outs = outputs if isinstance(outputs, (list, tuple)) \
                     else (outputs,)
                 for i, o in enumerate(outs):
@@ -84,7 +89,18 @@ class Monitor:
         executor.set_monitor_callback(self._stat)
 
     def _stat(self, name, arr):
-        if not self.activated or not self.re_pattern.match(name):
+        if not self.re_pattern.match(name):
+            return
+        from .telemetry import numerics as _numerics
+
+        if _numerics.is_enabled() \
+                and _numerics._active_collector() is not None:
+            # trace time under a hybridized graph: bake the stat into
+            # the compile (the fixed numerics bundle, not stat_func —
+            # arbitrary host callables cannot run inside a trace)
+            _numerics.tap("monitor." + name, arr)
+            return
+        if not self.activated:
             return
         self.queue.append((self.step, name, self.stat_func(arr)))
 
@@ -95,12 +111,24 @@ class Monitor:
         self.step += 1
 
     def toc(self):
+        from .telemetry import numerics as _numerics
+
+        # drain compiled-path stats every toc — a hybridized graph's
+        # baked taps record on every replay, so off-interval entries
+        # must be consumed (and dropped) to stay bounded
+        compiled = _numerics.consume("monitor.") \
+            if _numerics.is_enabled() else {}
         if not self.activated:
             return []
         self.activated = False
         stats = self._gather_stats([arr for _, _, arr in self.queue])
         res = [(step, name, s)
                for (step, name, _), s in zip(self.queue, stats)]
+        last_step = self.step - 1
+        for path, st in compiled.items():
+            # display the l2 norm — the compiled path records the fixed
+            # numerics bundle; stat_func applies on the eager path only
+            res.append((last_step, path[len("monitor."):], str(st["l2"])))
         if self.sort:
             res.sort(key=lambda t: t[1])
         self.queue = []

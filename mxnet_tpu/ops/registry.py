@@ -197,6 +197,12 @@ def _bind_dispatch_refs():
     return engine
 
 
+# per-op NaN-bisection hook, installed by ``telemetry.numerics.bisect()``
+# for eager divergence replays ONLY — called (name, input raws, output
+# raws) after every dispatch.  One ``is not None`` test on the hot path.
+_bisect_hook = None
+
+
 def _zero_vjp(n_inputs: int):
     """Tape vjp for no_grad ops: all-None cotangents (autograd skips
     accumulation for None, exactly as it does for float0)."""
@@ -278,6 +284,9 @@ def apply_op(fun: Callable, *nd_args, name: str = ""):
         else:
             outs = fun(*raws)
             vjp = None
+    if _bisect_hook is not None:
+        _bisect_hook(name, raws,
+                     outs if isinstance(outs, (tuple, list)) else (outs,))
     if _engine.is_naive():
         # NaiveEngine: synchronous dispatch — device errors surface HERE,
         # at the op that caused them, with this op's name in the stack.

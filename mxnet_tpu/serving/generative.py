@@ -56,6 +56,7 @@ import time
 import numpy as np
 
 from .. import telemetry
+from ..telemetry import numerics as _numerics
 from ..telemetry import tracing
 from ..base import MXNetError
 from .bucketing import BucketPolicy, pad_batch
@@ -186,12 +187,20 @@ class LlamaServingEngine:
         self.steps = 0
         self._signatures = set()
 
+        # decode-step logit stats behind the same gate as the training
+        # tiers — baked at engine construction, so the jitted step keeps
+        # one signature per numerics mode (rebuild the engine to toggle)
+        self._numerics = _numerics.trace_enabled()
+        numerics_on = self._numerics
         if kv_mode == "paged":
 
             def _step_fn(wq, pools, tables, ids, pos):
                 logits, pools = dec._step_blocks_impl(deq(wq), pools,
                                                       tables, ids, pos)
-                return jnp.argmax(logits, axis=-1).astype(jnp.int32), pools
+                tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                if numerics_on:
+                    return tok, pools, _numerics.stats_of(logits)
+                return tok, pools
 
             def _prefill_fn(wq, ids, t0):
                 rows, logits = dec._prefill_rows_impl(deq(wq), ids, t0)
@@ -226,8 +235,10 @@ class LlamaServingEngine:
             def _step_fn(wq, caches, ids, pos):
                 logits, caches = dec._step_slots_impl(deq(wq), caches,
                                                       ids, pos)
-                return jnp.argmax(logits, axis=-1).astype(jnp.int32), \
-                    caches
+                tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                if numerics_on:
+                    return tok, caches, _numerics.stats_of(logits)
+                return tok, caches
 
             def _prefill_fn(wq, ids, t0):
                 caches, logits = dec._prefill_impl(deq(wq), ids, t0)
@@ -428,18 +439,33 @@ class LlamaServingEngine:
         materialization wait — handoff scatters interleave with the
         wait."""
         self._note(("step",))
+        lstats = None
         with self.dev_lock:
             if self.kv_mode == "paged":
-                toks, pool = self._step(
-                    self._w, self._pool, self._dev(self._tables),
-                    self._dev(self._last), self._dev(self._pos))
+                if self._numerics:
+                    toks, pool, lstats = self._step(
+                        self._w, self._pool, self._dev(self._tables),
+                        self._dev(self._last), self._dev(self._pos))
+                else:
+                    toks, pool = self._step(
+                        self._w, self._pool, self._dev(self._tables),
+                        self._dev(self._last), self._dev(self._pos))
                 self._pool = pool
             else:
-                toks, caches = self._step(
-                    self._w, self._caches, self._dev(self._last),
-                    self._dev(self._pos))
+                if self._numerics:
+                    toks, caches, lstats = self._step(
+                        self._w, self._caches, self._dev(self._last),
+                        self._dev(self._pos))
+                else:
+                    toks, caches = self._step(
+                        self._w, self._caches, self._dev(self._last),
+                        self._dev(self._pos))
                 self._caches = caches
             self.steps += 1
+        if lstats is not None:
+            # queue the decode-step logit stats (device scalars) for the
+            # stride harvest, outside the device lock
+            _numerics.record_compiled(("serving.logits",), (lstats,))
         out = _materialize([toks])[0]
         with self.dev_lock:
             for s in active:

@@ -67,15 +67,18 @@ from . import memwatch  # noqa: F401  (live-buffer ledger submodule)
 from . import tracing  # noqa: F401  (request-scoped tracing submodule)
 from . import promtext  # noqa: F401  (shared Prometheus text renderer)
 from . import fleet as _fleet_mod  # fleet-wide observability submodule
-# ``enable(fleet=...)`` takes a keyword of the same name, so the module
-# itself travels under the private alias everywhere in this file
+from . import numerics as _numerics_mod  # in-compile tensor-stats tier
+# ``enable(fleet=...)``/``enable(numerics=...)`` take keywords of the
+# same names, so the modules travel under private aliases in this file
 fleet = _fleet_mod
+numerics = _numerics_mod
 
 __all__ = ["enable", "disable", "is_enabled", "span", "count", "gauge",
            "hist", "hist_summary", "hists", "emit",
            "step", "step_begin", "step_end", "counters", "gauges",
            "phases", "reset", "current_span", "JsonlSink", "read_jsonl",
-           "costs", "memwatch", "tracing", "promtext", "fleet"]
+           "costs", "memwatch", "tracing", "promtext", "fleet",
+           "numerics"]
 
 # -- state -------------------------------------------------------------------
 # _enabled is read unlocked on every recorder's fast path; it is only
@@ -441,6 +444,18 @@ def step_end(examples=None, **extra):
                 pass  # telemetry never raises into training
         record.update(extra)
         sinks = list(_sinks)
+    if _numerics_mod._enabled:
+        # at the numerics stride this is the tier's ONE host sync: the
+        # pending in-compile stats materialize and the summary (tensors,
+        # first_nan provenance, grad_norm) lands on the record BEFORE
+        # the fleet watchdog sees it, so nan attribution rides anomaly
+        # records, the flight recorder, and the stride exchange for free
+        try:
+            _ns = _numerics_mod.step_summary(record.get("step"))
+            if _ns is not None:
+                record["numerics"] = _ns
+        except Exception:
+            pass  # telemetry never raises into training
     if _fleet_mod._enabled:
         # annotates the record with rank/world_size IN PLACE before the
         # sinks see it, feeds the flight recorder, runs the watchdog and
@@ -482,7 +497,7 @@ def step(examples=None, **extra):
 # -- lifecycle ---------------------------------------------------------------
 
 def enable(jsonl_path=None, append=False, memory=True, cost=True,
-           trace=False, fleet=False):
+           trace=False, fleet=False, numerics=False):
     """Turn recording on.  ``jsonl_path`` attaches a structured-log sink
     writing one JSON line per step record (truncates unless ``append``).
     Idempotent: re-enabling resets counters and swaps sinks.  ``memory``
@@ -496,7 +511,11 @@ def enable(jsonl_path=None, append=False, memory=True, cost=True,
     fleet-wide layer (rank-aware records, straggler/anomaly watchdog,
     training flight recorder) with its env-default knobs — call
     ``telemetry.fleet.enable(...)`` directly for tuned thresholds;
-    ``MXNET_FLEET=1`` switches it on independently."""
+    ``MXNET_FLEET=1`` switches it on independently.  ``numerics=True``
+    enables the in-compile tensor-stats tier (per-layer norms, nan/inf
+    provenance on step records) at its env-default stride — call
+    ``telemetry.numerics.enable(stride=...)`` directly for tuning;
+    ``MXNET_NUMERICS=1`` switches it on independently."""
     global _enabled
     with _lock:
         _reset_locked()
@@ -514,6 +533,8 @@ def enable(jsonl_path=None, append=False, memory=True, cost=True,
         tracing.enable()
     if fleet:
         _fleet_mod.enable()
+    if numerics:
+        _numerics_mod.enable()
 
 
 def disable():
@@ -525,6 +546,7 @@ def disable():
     costs.disable()
     tracing.disable()
     _fleet_mod.disable()
+    _numerics_mod.disable()
     with _lock:
         for s in _sinks:
             s.close()

@@ -59,6 +59,7 @@ from .sinks import _json_default
 __all__ = [
     "enable", "disable", "is_enabled", "world", "rank",
     "on_step_record", "detect_skew", "detect_nan", "detect_spike",
+    "growth_streak",
     "Watchdog", "WatchdogHalt", "recent", "clear", "dump", "incident",
     "last_view", "halt_requested", "MetricsEndpoint", "metrics_url",
 ]
@@ -79,6 +80,9 @@ SPIKE_FACTOR = 10.0
 REGRESSION_FACTOR = 2.0
 #: local spike/regression detectors stay quiet until this much history
 MIN_HISTORY = 8
+#: grad-norm explosion = this much growth per observed window, sustained
+#: for ``consecutive`` windows (same streak machinery as stragglers)
+GROWTH_FACTOR = 2.0
 #: per-reason minimum spacing between incident dumps
 DUMP_INTERVAL_S = 5.0
 
@@ -139,14 +143,15 @@ def rank():
 
 def detect_skew(values, threshold=SKEW_THRESHOLD):
     """Indices whose value exceeds ``threshold`` x the median of
-    ``values``. Pure; returns ``[]`` for degenerate input."""
-    vals = [float(v) for v in values]
-    if len(vals) < 2:
+    ``values``. Pure; returns ``[]`` for degenerate input. ``None``
+    entries (gaps in strided records) are skipped, never flagged."""
+    pairs = [(i, float(v)) for i, v in enumerate(values) if v is not None]
+    if len(pairs) < 2:
         return []
-    med = statistics.median(vals)
+    med = statistics.median(v for _, v in pairs)
     if med <= 0.0:
         return []
-    return [i for i, v in enumerate(vals) if v / med > threshold]
+    return [i for i, v in pairs if v / med > threshold]
 
 
 def detect_nan(value):
@@ -161,13 +166,35 @@ def detect_nan(value):
 def detect_spike(value, history, factor=SPIKE_FACTOR,
                  min_history=MIN_HISTORY):
     """True when ``value`` exceeds ``factor`` x the median of
-    ``history``; quiet until ``min_history`` samples exist."""
-    if len(history) < min_history:
+    ``history``; quiet until ``min_history`` samples exist. ``None``
+    gaps (strided records miss metrics off-stride) are tolerated in
+    both the history and the value."""
+    if value is None:
         return False
-    med = statistics.median(history)
+    hist = [float(v) for v in history if v is not None]
+    if len(hist) < min_history:
+        return False
+    med = statistics.median(hist)
     if med <= 0.0:
         return False
     return float(value) / med > factor
+
+
+def growth_streak(history, factor=GROWTH_FACTOR):
+    """Length of the trailing run of consecutive windows in ``history``
+    where each value grew by more than ``factor`` x over its
+    predecessor. Pure; ``None`` gaps break the streak; non-positive
+    predecessors never count as growth."""
+    vals = list(history)
+    streak = 0
+    for prev, cur in zip(reversed(vals[:-1]), reversed(vals[1:])):
+        if prev is None or cur is None:
+            break
+        prev, cur = float(prev), float(cur)
+        if prev <= 0.0 or cur <= factor * prev:
+            break
+        streak += 1
+    return streak
 
 
 class Watchdog:
@@ -186,12 +213,13 @@ class Watchdog:
 
     def __init__(self, skew_threshold=SKEW_THRESHOLD, consecutive=CONSECUTIVE,
                  spike_factor=SPIKE_FACTOR, regression_factor=REGRESSION_FACTOR,
-                 min_history=MIN_HISTORY):
+                 min_history=MIN_HISTORY, growth_factor=GROWTH_FACTOR):
         self.skew_threshold = float(skew_threshold)
         self.consecutive = int(consecutive)
         self.spike_factor = float(spike_factor)
         self.regression_factor = float(regression_factor)
         self.min_history = int(min_history)
+        self.growth_factor = float(growth_factor)
         self._grad_hist = collections.deque(maxlen=64)
         self._step_hist = collections.deque(maxlen=64)
         self._streaks = {}   # (metric, rank) -> consecutive skewed windows
@@ -201,7 +229,22 @@ class Watchdog:
         loss = record.get("loss")
         if loss is not None and detect_nan(loss):
             out.append({"kind": "nan_loss", "value": repr(loss)})
+        num = record.get("numerics") or {}
+        first_nan = num.get("first_nan")
+        if first_nan:
+            # layer-resolved provenance from the in-compile stats tier:
+            # the anomaly names (layer, param path); _emit_anomaly
+            # stamps the rank, completing "rank R, path, step S"
+            out.append({"kind": "nan_tensor",
+                        "path": first_nan.get("path"),
+                        "layer": first_nan.get("layer"),
+                        "nan": first_nan.get("nan"),
+                        "inf": first_nan.get("inf")})
         gn = record.get("grad_norm")
+        if gn is None:
+            # the numerics tier aggregates grad.* l2 at its stride —
+            # feeds the spike/explosion detectors with no extra wiring
+            gn = num.get("grad_norm")
         if gn is not None:
             if detect_nan(gn):
                 out.append({"kind": "nan_grad", "value": repr(gn)})
@@ -213,6 +256,12 @@ class Watchdog:
                                 "median": statistics.median(self._grad_hist),
                                 "factor": self.spike_factor})
                 self._grad_hist.append(gn)
+                streak = growth_streak(self._grad_hist,
+                                       self.growth_factor)
+                if streak >= self.consecutive:
+                    out.append({"kind": "grad_norm_explosion",
+                                "value": gn, "windows": streak,
+                                "factor": self.growth_factor})
         sm = record.get("step_ms")
         if sm is not None and not detect_nan(sm):
             sm = float(sm)
@@ -394,9 +443,14 @@ def _fleet_exchange(record):
     # the straggler is the rank with high COMPUTE and low wait, so the
     # exchange carries compute_ms explicitly
     compute_ms = max(step_ms - wait_ms, 0.0)
+    # nan provenance rides the exchange as a layer index (-1 = clean):
+    # every rank learns WHO diverged and WHERE from one allgather
+    first_nan = (record.get("numerics") or {}).get("first_nan") or {}
+    nan_layer = float(first_nan.get("layer", -1) if first_nan else -1)
     vec = [step_ms, wait_ms, compute_ms,
            float(record.get("peak_live_bytes") or 0.0),
-           float(record.get("examples_per_sec") or 0.0)]
+           float(record.get("examples_per_sec") or 0.0),
+           nan_layer]
     t0 = time.perf_counter()
     rows = None
     pl = _parallel()
@@ -424,6 +478,10 @@ def _fleet_exchange(record):
         "compute_ms": list(cols[2]),
         "peak_live_bytes": list(cols[3]),
         "examples_per_sec": list(cols[4]),
+        # per-rank first-NaN layer indices (-1 = clean); older peers'
+        # 5-column vectors simply omit the column
+        "first_nan_layer": ([int(v) for v in cols[5]]
+                            if len(cols) > 5 else [-1] * len(rows)),
         "exchange_ms": exchange_ms,
     }
     view["stragglers"] = detect_skew(view["compute_ms"], thresh)
@@ -576,7 +634,8 @@ def metrics_url():
 
 def enable(stride=None, ring=None, skew_threshold=None, consecutive=None,
            spike_factor=None, regression_factor=None, min_history=None,
-           on_anomaly=None, halt=None, http_port=None):
+           growth_factor=None, on_anomaly=None, halt=None,
+           http_port=None):
     """Turn the fleet layer on. ``None`` args fall back to
     ``MXNET_FLEET_*`` env knobs, then module defaults. ``on_anomaly``
     replaces the default one-line stderr warning; ``halt=True`` makes
@@ -599,6 +658,8 @@ def enable(stride=None, ring=None, skew_threshold=None, consecutive=None,
         regression_factor = REGRESSION_FACTOR
     if min_history is None:
         min_history = MIN_HISTORY
+    if growth_factor is None:
+        growth_factor = float(env.get("MXNET_FLEET_GROWTH", GROWTH_FACTOR))
     if halt is None:
         halt = env.get("MXNET_FLEET_HALT", "0") == "1"
     with _lock:
@@ -609,7 +670,8 @@ def enable(stride=None, ring=None, skew_threshold=None, consecutive=None,
                              consecutive=consecutive,
                              spike_factor=spike_factor,
                              regression_factor=regression_factor,
-                             min_history=min_history)
+                             min_history=min_history,
+                             growth_factor=growth_factor)
     with _ring_lock:
         if int(ring) != _ring.maxlen:
             _ring = collections.deque(_ring, maxlen=int(ring))

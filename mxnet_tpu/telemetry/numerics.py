@@ -1,0 +1,534 @@
+"""In-compile tensor-statistics tier: layer-resolved numerics telemetry.
+
+Every other telemetry tier here watches the step from the outside —
+spans, counters, memory ledgers.  This one watches the *inside* of the
+compiled step: per-layer / per-param l2 norm, max-abs, mean, and nan/inf
+counts, computed as part of the step's own XLA program and returned as a
+small side-output tree.  No ``jax.debug``, no per-tensor host syncs —
+the stats ride the step outputs as device scalars and cross to the host
+in ONE ``jax.device_get`` every ``stride`` steps.
+
+The tier honors the house telemetry contract:
+
+* **one-boolean disabled path** — ``tap()`` is a single ``if not
+  _enabled: return`` when off; nothing allocates, nothing locks.
+* **compile-once** — enabling/disabling numerics changes the compile
+  signature (``signature()`` is a key in every step cache), so each mode
+  keeps exactly one signature and toggling never poisons a cache.
+* **never raises into training** — a failed stat drops that stat, not
+  the step.
+* **host work only at the stride boundary** — non-stride steps drop
+  their pending device stats without a sync.
+
+Three layers of machinery live here:
+
+1. *Taps* (``tap``/``tap_stacked``/``stats_of``): called from model and
+   trainer code.  Inside a trace a tap appends to the active
+   ``collecting()`` scope so the stats become jit outputs; eagerly it
+   queues device scalars directly.
+2. *Harvest* (``step_summary``): called from ``telemetry.step_end`` —
+   materializes the pending stats at the stride, derives ``first_nan``
+   provenance (path + layer) and an aggregate ``grad_norm``, and mirrors
+   into live profiler counter tracks.
+3. *Forensics* (``capture_step``/``bisect``): snapshot a flagged step's
+   (inputs, params, rng) through the async checkpointer, then replay it
+   eagerly with a per-op NaN bisection hook to name the first failing
+   op.  Replay is the one place host syncs are the point.
+"""
+
+import json
+import os
+import threading
+import time
+from contextlib import contextmanager
+
+import sys
+
+__all__ = [
+    "enable", "disable", "is_enabled", "clear", "signature",
+    "stats_of", "tap", "tap_stacked", "collecting",
+    "record_compiled", "record_stacked", "step_summary", "consume",
+    "arm_capture", "capture_step", "load_capture", "bisect",
+    "layer_of", "DEFAULT_STRIDE",
+]
+
+DEFAULT_STRIDE = 16
+#: pending-entry cap — bounds device-scalar queue growth if step_summary
+#: is never drained (e.g. numerics on, telemetry off)
+PENDING_CAP = 4096
+
+_enabled = False
+_stride = DEFAULT_STRIDE
+_step_seq = 0          # fallback step counter when records carry none
+_pending = []          # [(path, stats, stacked?)] — device-side until stride
+_lock = threading.Lock()
+_tls = threading.local()
+
+_capture_dir = None
+_capture_armed = False
+
+
+# --- enable / disable --------------------------------------------------------
+
+def enable(stride=None, capture_dir=None):
+    """Turn the tier on.  ``stride``: materialize/emit every N steps
+    (env ``MXNET_NUMERICS_STRIDE``, default 16).  ``capture_dir``: arm
+    the divergence capture hook (see :func:`arm_capture`).
+
+    Taps compiled while the tier was off stay off for those traces —
+    ``signature()`` participates in the step compile keys, so the next
+    dispatch retraces with stats baked in (one signature per mode)."""
+    global _enabled, _stride
+    if stride is None:
+        stride = int(os.environ.get("MXNET_NUMERICS_STRIDE", DEFAULT_STRIDE))
+    _stride = max(1, int(stride))
+    if capture_dir:
+        arm_capture(capture_dir)
+    _enabled = True
+
+
+def disable():
+    global _enabled
+    _enabled = False
+
+
+def is_enabled():
+    return _enabled
+
+
+def clear():
+    """Reset all state (tests).  Leaves the tier disabled."""
+    global _enabled, _stride, _step_seq, _capture_dir, _capture_armed
+    _enabled = False
+    _stride = DEFAULT_STRIDE
+    _step_seq = 0
+    _capture_dir = None
+    _capture_armed = False
+    with _lock:
+        del _pending[:]
+    _tls.stack = []
+
+
+def signature():
+    """Compile-signature token: every step cache (CachedOp, fused step,
+    fused trainer update, serving engines) keys on this so stats-on and
+    stats-off each keep exactly one signature."""
+    return _enabled
+
+
+#: alias with trace-time-snapshot semantics spelled out: call at graph
+#: *build* time and bake the result into the trace's static structure
+trace_enabled = is_enabled
+
+
+# --- stats -------------------------------------------------------------------
+
+def stats_of(raw):
+    """Per-tensor stat bundle as device scalars: ``{"l2", "maxabs",
+    "mean"}`` float32, ``{"nan", "inf"}`` int32.  Pure jnp math — safe
+    under trace, safe eagerly; no host transfer happens here."""
+    import jax.numpy as jnp
+
+    x = raw if hasattr(raw, "dtype") else jnp.asarray(raw)
+    if jnp.issubdtype(x.dtype, jnp.complexfloating):
+        xf = jnp.abs(x).astype(jnp.float32)
+        nan = jnp.sum(jnp.isnan(x)).astype(jnp.int32)
+        inf = jnp.sum(jnp.isinf(x)).astype(jnp.int32)
+    elif jnp.issubdtype(x.dtype, jnp.floating):
+        xf = x.astype(jnp.float32)
+        nan = jnp.sum(jnp.isnan(x)).astype(jnp.int32)
+        inf = jnp.sum(jnp.isinf(x)).astype(jnp.int32)
+    else:  # int/bool tensors can't hold nan/inf
+        xf = x.astype(jnp.float32)
+        nan = jnp.zeros((), jnp.int32)
+        inf = jnp.zeros((), jnp.int32)
+    zero = jnp.zeros((), jnp.float32)
+    has = bool(x.size)  # static shape — fine at trace time
+    return {
+        "l2": jnp.sqrt(jnp.sum(xf * xf)) if has else zero,
+        "maxabs": jnp.max(jnp.abs(xf)) if has else zero,
+        "mean": jnp.mean(xf) if has else zero,
+        "nan": nan,
+        "inf": inf,
+    }
+
+
+def layer_of(path):
+    """First integer component of a dotted stat path, or -1.
+    ``decoder.7.ffn`` → 7; ``grad.decoder.3.attn.wq`` → 3."""
+    for part in str(path).split("."):
+        if part.isdigit():
+            return int(part)
+    return -1
+
+
+# --- collector (trace scope) -------------------------------------------------
+
+class _Collector:
+    """Accumulates taps fired while a traced function runs.  ``names``
+    is host-side static metadata (saved as a trace side effect, like
+    CachedOp's ``struct``); ``stats`` is the device/tracer half that
+    must leave the trace as jit outputs."""
+
+    __slots__ = ("names", "stats")
+
+    def __init__(self):
+        self.names = []
+        self.stats = []
+
+    def drain(self):
+        """Return ``(names, stats_tuple)`` — the stats tuple is a plain
+        pytree (tuple of dicts of scalars), safe to return from jit."""
+        names, stats = self.names, tuple(self.stats)
+        self.names, self.stats = [], []
+        return names, stats
+
+
+@contextmanager
+def collecting():
+    """Scope a traced region so taps inside it land on a collector
+    instead of the eager queue.  Re-entrant; innermost scope wins."""
+    stack = getattr(_tls, "stack", None)
+    if stack is None:
+        stack = _tls.stack = []
+    col = _Collector()
+    stack.append(col)
+    try:
+        yield col
+    finally:
+        stack.pop()
+
+
+def _active_collector():
+    stack = getattr(_tls, "stack", None)
+    return stack[-1] if stack else None
+
+
+def _is_tracer(raw):
+    jax = sys.modules.get("jax")
+    return jax is not None and isinstance(raw, jax.core.Tracer)
+
+
+def _push(entry):
+    with _lock:
+        if len(_pending) < PENDING_CAP:
+            _pending.append(entry)
+
+
+# --- taps --------------------------------------------------------------------
+
+def tap(name, x):
+    """Record stats for one tensor.  ``x``: NDArray or raw array.
+    Disabled path is one boolean test.  Inside an active
+    :func:`collecting` scope the stats become trace outputs; eagerly
+    they queue as device scalars.  A tracer seen with no collector is
+    dropped (stats could not leave that trace without leaking)."""
+    if not _enabled:
+        return
+    raw = getattr(x, "_data", x)
+    if raw is None:
+        return
+    col = _active_collector()
+    try:
+        if col is not None:
+            st = stats_of(raw)
+            col.names.append(str(name))
+            col.stats.append(st)
+        elif not _is_tracer(raw):
+            _push((str(name), stats_of(raw), False))
+    except Exception:  # never raises into training
+        pass
+
+
+def tap_stacked(name, stats):
+    """Record a stacked stat bundle — each value shaped ``(L, ...)``
+    with leading layer axis (the scanned-decoder path).  ``stats`` is a
+    dict with the :func:`stats_of` keys."""
+    if not _enabled:
+        return
+    col = _active_collector()
+    try:
+        if col is not None:
+            col.names.append("+" + str(name))  # '+' marks stacked
+            col.stats.append(dict(stats))
+        elif not any(_is_tracer(v) for v in stats.values()):
+            _push((str(name), dict(stats), True))
+    except Exception:
+        pass
+
+
+def record_compiled(names, stats):
+    """Queue stats that exited a compiled call as side outputs.
+    ``names`` from the trace-time collector, ``stats`` the matching
+    jit-output tuple.  Names prefixed ``+`` (see :func:`tap_stacked`)
+    re-enter as stacked entries.
+
+    When an *outer* collector is active (a compiled graph dispatched
+    inside a bigger trace) the entries forward to it — they must leave
+    the outer compile as its side outputs.  Tracer stats with no outer
+    collector are dropped: queuing them would leak the trace."""
+    if not _enabled or not names:
+        return
+    col = _active_collector()
+    if col is not None:
+        for n, s in zip(names, stats):
+            col.names.append(n)
+            col.stats.append(s)
+        return
+    for n, s in zip(names, stats):
+        leaves = s.values() if isinstance(s, dict) else (s,)
+        if any(_is_tracer(v) for v in leaves):
+            continue
+        if n.startswith("+"):
+            _push((n[1:], s, True))
+        else:
+            _push((n, s, False))
+
+
+def record_stacked(name, stats):
+    """Queue one stacked entry directly (already concrete or device)."""
+    if not _enabled:
+        return
+    _push((str(name), dict(stats), True))
+
+
+# --- harvest -----------------------------------------------------------------
+
+def _materialize(entries):
+    """The ONE host sync of the tier: fetch every pending device stat in
+    a single transfer.  Name is deliberate — mxlint's MATERIALIZE_DEFS
+    sanctions this def as an intentional exchange boundary."""
+    import jax
+    return jax.device_get([e[1] for e in entries])  # mxlint: allow=T1
+
+
+def _expand(entries, fetched):
+    """(path, stats, stacked) × host values → ordered {path: stats}
+    with stacked entries fanned out to ``path.<i>`` per layer."""
+    tensors = {}
+    for (path, _, stacked), host in zip(entries, fetched):
+        if stacked:
+            try:
+                n = len(next(iter(host.values())))
+            except (StopIteration, TypeError):
+                continue
+            for i in range(n):
+                tensors[f"{path}.{i}"] = {
+                    k: (int(v[i]) if k in ("nan", "inf") else float(v[i]))
+                    for k, v in host.items()}
+        else:
+            tensors[path] = {
+                k: (int(v) if k in ("nan", "inf") else float(v))
+                for k, v in host.items()}
+    return tensors
+
+
+def step_summary(step=None):
+    """Materialize pending stats if ``step`` hits the stride; called
+    from ``telemetry.step_end`` (and usable standalone).  Returns the
+    summary dict attached to step records as ``record["numerics"]`` or
+    None off-stride.  Off-stride steps drop their pending device stats
+    without a host sync."""
+    global _step_seq
+    if not _enabled:
+        return None
+    if step is None:
+        step = _step_seq
+    _step_seq = int(step) + 1
+    with _lock:
+        entries = list(_pending)
+        del _pending[:]
+    if int(step) % _stride != 0 or not entries:
+        return None
+    try:
+        fetched = _materialize(entries)
+    except Exception:  # never raises into training
+        return None
+    tensors = _expand(entries, fetched)
+    first_nan = None
+    for path, st in tensors.items():  # insertion order == forward order
+        if st["nan"] or st["inf"]:
+            first_nan = {"path": path, "layer": layer_of(path),
+                         "nan": st["nan"], "inf": st["inf"]}
+            break
+    grad_sq = [st["l2"] ** 2 for p, st in tensors.items()
+               if p.startswith("grad.")]
+    summary = {
+        "stride": _stride,
+        "tensors": tensors,
+        "first_nan": first_nan,
+        "grad_norm": (sum(grad_sq) ** 0.5) if grad_sq else None,
+    }
+    _mirror_profiler(step, tensors)
+    return summary
+
+
+def _mirror_profiler(step, tensors):
+    """Mirror per-path stats into live Perfetto counter tracks when a
+    profiler session is running (module probed, never imported)."""
+    prof = sys.modules.get("mxnet_tpu.profiler")
+    if prof is None or getattr(prof, "_state", None) != "run":
+        return
+    try:
+        for path, st in tensors.items():
+            prof.record_counter_event(
+                "numerics/" + path,
+                {"l2": st["l2"], "overflow": st["nan"] + st["inf"]})
+    except Exception:
+        pass
+
+
+def consume(prefix):
+    """Pop pending entries whose path starts with ``prefix`` and return
+    them materialized as ``{path: stats}`` (host floats).  Used by
+    ``Monitor.toc`` to drain its own taps without waiting for the
+    stride."""
+    with _lock:
+        mine = [e for e in _pending if e[0].startswith(prefix)]
+        _pending[:] = [e for e in _pending if not e[0].startswith(prefix)]
+    if not mine:
+        return {}
+    try:
+        fetched = _materialize(mine)
+    except Exception:
+        return {}
+    return _expand(mine, fetched)
+
+
+# --- divergence capture / replay --------------------------------------------
+
+def arm_capture(out_dir):
+    """Arm the capture hook: the next :func:`capture_step` with no
+    explicit dir writes under ``out_dir``.  One-shot — capturing
+    disarms, so a wedged run can't flood the disk."""
+    global _capture_dir, _capture_armed
+    _capture_dir = str(out_dir)
+    _capture_armed = True
+
+
+def capture_armed():
+    return _capture_armed
+
+
+def capture_step(net, inputs, rng_key=None, step=0, out_dir=None,
+                 reason="flagged", builder=None, builder_kwargs=None):
+    """Snapshot a flagged step for eager replay: inputs as ``.npz``,
+    params/rng through the **async checkpointer** (training continues
+    while the device→host copy drains), and a ``capture.json`` sidecar
+    naming the ``builder`` (``"module:function"``) that can rebuild the
+    net for ``tools/numerics_report.py --replay``.
+
+    Returns the capture directory, or None when nothing is armed and no
+    ``out_dir`` was given.  Never raises into training."""
+    global _capture_armed
+    out_dir = out_dir or (_capture_dir if _capture_armed else None)
+    if out_dir is None:
+        return None
+    try:
+        import numpy as np
+
+        from .. import checkpoint as _ckpt
+
+        step = int(step)
+        cdir = os.path.join(str(out_dir), f"capture-{step}")
+        os.makedirs(cdir, exist_ok=True)
+        arrs = {}
+        for i, a in enumerate(inputs):
+            raw = getattr(a, "_data", a)
+            arrs[f"input{i}"] = np.asarray(raw)
+        np.savez(os.path.join(cdir, "inputs.npz"), **arrs)
+        meta = {
+            "record": "numerics_capture",
+            "step": step,
+            "reason": str(reason),
+            "builder": builder,
+            "builder_kwargs": builder_kwargs or {},
+            "inputs": sorted(arrs, key=lambda k: int(k[5:])),
+            "rng_key": ([int(v) for v in np.asarray(rng_key).ravel()]
+                        if rng_key is not None else None),
+            "time": time.time(),
+        }
+        # params ride the async checkpointer into the capture dir; the
+        # manifest's extra block marks it as forensics, not a resume point
+        _ckpt.save_checkpoint_async(
+            cdir, step, net,
+            extra={"numerics_capture": {"reason": str(reason)}})
+        with open(os.path.join(cdir, "capture.json"), "w") as f:
+            json.dump(meta, f, indent=2, sort_keys=True)
+        _capture_armed = False
+        return cdir
+    except Exception:  # never raises into training
+        return None
+
+
+def load_capture(cdir):
+    """Read a capture dir back: ``(meta, inputs)`` with inputs as host
+    numpy arrays in their original positional order."""
+    import numpy as np
+
+    with open(os.path.join(cdir, "capture.json")) as f:
+        meta = json.load(f)
+    with np.load(os.path.join(cdir, "inputs.npz")) as z:
+        inputs = [np.asarray(z[k]) for k in meta["inputs"]]
+    return meta, inputs
+
+
+class BisectResult:
+    """Outcome of a :func:`bisect` replay.  ``ops`` is the per-op
+    journal in dispatch order; ``first`` names the first op whose inputs
+    were clean but whose outputs went nan/inf — the poisoned op."""
+
+    def __init__(self):
+        self.ops = []
+        self.first = None
+
+
+@contextmanager
+def bisect():
+    """Install a per-op NaN bisection hook on the op registry for an
+    eager replay.  Every ``apply_op`` dispatch is journaled with
+    inputs-clean/outputs-clean verdicts; the first clean→poisoned
+    transition is recorded as ``result.first``.
+
+    Forensics only: each op check is a host sync.  Never use in a
+    training loop — this is the eager half of the tier, for
+    ``numerics_report --replay``."""
+    import numpy as np
+
+    from ..ops import registry as _registry
+
+    res = BisectResult()
+
+    def _bad(a):
+        if _is_tracer(a):
+            return False
+        try:
+            arr = np.asarray(a)
+        except Exception:
+            return False
+        if arr.dtype.kind not in "fc":
+            return False
+        return bool(np.isnan(arr).any() or np.isinf(arr).any())
+
+    def hook(name, raws, outs):
+        try:
+            in_bad = any(_bad(r) for r in raws)
+            out_bad = any(_bad(o) for o in outs)
+            res.ops.append({"op": name or "<anonymous>",
+                            "inputs_bad": in_bad, "outputs_bad": out_bad})
+            if res.first is None and out_bad and not in_bad:
+                res.first = {"op": name or "<anonymous>",
+                             "index": len(res.ops) - 1}
+        except Exception:
+            pass
+
+    prev = _registry._bisect_hook
+    _registry._bisect_hook = hook
+    try:
+        yield res
+    finally:
+        _registry._bisect_hook = prev
+
+
+if os.environ.get("MXNET_NUMERICS", "0") == "1":
+    enable()

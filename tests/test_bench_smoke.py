@@ -278,6 +278,43 @@ def test_fleet_overhead_bench_emits_artifact(tmp_path):
     assert rec["acceptance"]["fleet_overhead_under_1pct"]
 
 
+def test_numerics_overhead_bench_emits_artifact(tmp_path):
+    """benchmark/sharded_step.py --numerics-overhead must emit the
+    NUMERICS_OVERHEAD artifact: the off / stats / stats+capture-armed
+    A/B lanes over llama_tiny (the tapped model), the per-step
+    record_compiled+step_summary microbench, and a passing <1%
+    acceptance at stride 16 — the round-17 evidence that in-compile
+    tensor stats are free at the default stride."""
+    out = tmp_path / "numerics_overhead.json"
+    env = dict(os.environ)
+    env.update(BENCH_PLATFORM="cpu", BENCH_STEPS="3", BENCH_WARMUP="1",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8",
+               MXT_NUMERICS_OVERHEAD_OUT=str(out))
+    env.pop("JAX_PLATFORMS", None)
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "benchmark",
+                                      "sharded_step.py"),
+         "--numerics-overhead"],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=600)
+    assert r.returncode == 0, r.stderr[-2000:]
+    rec = json.loads(out.read_text())
+    assert rec["metric"] == "numerics_overhead_pct_stride16"
+    assert 0 <= rec["value"] < 1.0
+    assert set(rec["lanes"]) == {"off", "stats", "stats_capture_armed"}
+    for lane in rec["lanes"].values():
+        assert lane["step_ms_median"] > 0
+    # the off lane must not harvest anything; the stats lanes must
+    # actually land per-path stat bundles (taps + grad/update stats)
+    assert rec["lanes"]["off"]["harvested_paths"] == 0
+    assert rec["lanes"]["stats"]["harvested_paths"] > 0
+    assert rec["lanes"]["stats_capture_armed"]["harvested_paths"] > 0
+    assert rec["lanes"]["stats_capture_armed"]["capture_armed"]
+    assert rec["hook_ms_stride16"] > 0
+    # stride 1 materializes every step; stride 16 must not cost more
+    assert rec["hook_ms_stride1"] >= rec["hook_ms_stride16"] * 0.5
+    assert rec["acceptance"]["numerics_overhead_under_1pct"]
+
+
 def test_data_plane_bench_emits_artifact(tmp_path):
     """benchmark/input_pipeline.py --data-plane on the 8-device CPU mesh
     must emit the DATA_PLANE artifact with both trainer-fed lanes (image

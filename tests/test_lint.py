@@ -194,6 +194,20 @@ def test_fleet_calls_allowed_in_hot_paths():
                for v in vs)
 
 
+def test_numerics_taps_allowed_in_hot_paths():
+    vs = _analyze("t6_numerics.py")
+    contexts = {v.context for v in vs}
+    # numerics.tap / stats_of / record_compiled and the same-module tap
+    # helper are pure in-trace stat math — must NOT flag in a jitted step
+    assert "_tap_activations" not in contexts
+    assert "traced_step" not in contexts
+    # the tier's stride-boundary fetch is MATERIALIZE_DEFS-exempt
+    assert "_materialize" not in contexts
+    # a real host sync next to a tap still flags
+    assert any(v.rule == "T1" and v.context == "bad_stat_tick"
+               for v in vs)
+
+
 def test_memwatch_hooks_allowed_in_hot_paths():
     vs = _analyze("t6_memwatch.py")
     contexts = {v.context for v in vs}

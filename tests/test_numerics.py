@@ -1,0 +1,478 @@
+"""Numerics observability (ISSUE 17): the in-compile tensor-stats tier,
+NaN/Inf provenance through the fleet watchdog, and divergence forensics.
+
+Coverage map:
+  * tap/step_summary basics: stat bundle schema, first_nan provenance
+    (path + layer), grad-norm aggregation, stride gating;
+  * disabled-path guard: 10k taps with the tier off stay under the
+    house overhead bound and queue nothing;
+  * compile-once: a hybridized net + fused trainer update with stats
+    enabled keeps exactly one compile signature (replays are 0-compile
+    steps), and toggling the tier off/on re-uses both cached programs;
+  * CachedOp backward: per-param ``grad.<name>`` stats exit the same
+    donated compile;
+  * the scanned decoder: stacked per-layer stats exit ``lax.scan`` as
+    ys and fan out to ``decoder.<i>`` paths;
+  * the acceptance lane: NaN injected into decoder layer 1 on a dp2
+    CPU mesh is attributed by the watchdog anomaly record AND the
+    flight dump as (layer-1 path, rank), and rides the fleet view's
+    ``first_nan_layer`` column;
+  * watchdog math: ``growth_streak`` as a pure function, the
+    ``grad_norm_explosion`` detector, ``None``-gap tolerance in the
+    spike/skew detectors;
+  * capture -> replay roundtrip: ``capture_step`` snapshots through the
+    async checkpointer, ``numerics_report --replay`` names the first
+    poisoned op;
+  * report schema: JSONL numerics blocks render to the heatmap and to
+    Perfetto counter ("C") tracks;
+  * Monitor regression: ``install()`` on a hybridized block records
+    rows via the numerics tier (the old "records nothing" warning is
+    gone), the eager path is unchanged.
+"""
+import json
+import math
+import os
+import sys
+import time
+import warnings
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, checkpoint, gluon, nd, parallel, telemetry
+from mxnet_tpu.models import llama
+from mxnet_tpu.monitor import Monitor
+from mxnet_tpu.telemetry import fleet, numerics
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+
+def _numerics_report():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import numerics_report
+    return numerics_report
+
+
+@pytest.fixture(autouse=True)
+def _clean_numerics():
+    telemetry.disable()
+    telemetry.reset()
+    fleet.clear()
+    numerics.clear()
+    yield
+    telemetry.disable()
+    telemetry.reset()
+    fleet.clear()
+    numerics.clear()
+    parallel.set_mesh(None)
+
+
+# --- tap / step_summary basics ----------------------------------------------
+
+def test_tap_step_summary_schema_and_first_nan_provenance():
+    numerics.enable(stride=1)
+    clean = nd.ones((4, 4))
+    bad = nd.array(np.array([[1.0, float("nan")], [2.0, 3.0]]))
+    numerics.tap("embed", clean)
+    numerics.tap("decoder.1.ffn", bad)
+    numerics.tap("grad.decoder.1.ffn.w", nd.ones((2,)) * 3.0)
+    numerics.tap("grad.head.w", nd.ones((2,)) * 4.0)
+    summary = numerics.step_summary(0)
+    assert summary["stride"] == 1
+    tensors = summary["tensors"]
+    assert set(tensors) == {"embed", "decoder.1.ffn",
+                            "grad.decoder.1.ffn.w", "grad.head.w"}
+    for st in tensors.values():
+        assert set(st) == {"l2", "maxabs", "mean", "nan", "inf"}
+        assert isinstance(st["l2"], float)
+        assert isinstance(st["nan"], int)
+    assert tensors["embed"]["l2"] == pytest.approx(4.0)
+    assert tensors["embed"]["nan"] == 0
+    assert tensors["decoder.1.ffn"]["nan"] == 1
+    # first nan names the first poisoned path IN FORWARD ORDER + layer
+    assert summary["first_nan"] == {"path": "decoder.1.ffn", "layer": 1,
+                                    "nan": 1, "inf": 0}
+    # grad_norm is the l2 of all grad.* bundles: sqrt(18 + 32)
+    assert summary["grad_norm"] == pytest.approx(math.sqrt(
+        tensors["grad.decoder.1.ffn.w"]["l2"] ** 2
+        + tensors["grad.head.w"]["l2"] ** 2))
+
+
+def test_stride_gates_the_host_sync_and_drops_offstride():
+    numerics.enable(stride=4)
+    for step in range(1, 4):
+        numerics.tap("x", nd.ones((2,)))
+        assert numerics.step_summary(step) is None
+    assert numerics._pending == []  # off-stride steps drop, not queue
+    numerics.tap("x", nd.ones((2,)))
+    summary = numerics.step_summary(4)
+    assert summary is not None and "x" in summary["tensors"]
+
+
+def test_layer_of_path_parsing():
+    assert numerics.layer_of("decoder.7.ffn") == 7
+    assert numerics.layer_of("grad.decoder.3.attn.wq") == 3
+    assert numerics.layer_of("embed") == -1
+    assert numerics.layer_of("logits") == -1
+
+
+def test_disabled_tap_overhead_bounded():
+    # the PR 2 contract: the disabled path is one boolean test — 10k
+    # taps must be effectively free and must queue nothing
+    x = nd.ones((8, 8))
+    t0 = time.perf_counter()
+    for _ in range(10_000):
+        numerics.tap("layer", x)
+        numerics.step_summary()
+    elapsed = time.perf_counter() - t0
+    assert elapsed < 0.5, f"disabled numerics cost {elapsed:.3f}s"
+    assert numerics._pending == []
+
+
+# --- compile-once: one signature per mode ------------------------------------
+
+def _mlp():
+    net = gluon.nn.HybridSequential()
+    with net.name_scope():
+        net.add(gluon.nn.Dense(8, activation="relu"))
+        net.add(gluon.nn.Dense(4))
+    net.initialize(mx.init.Xavier())
+    net(nd.ones((2, 8)))
+    net.hybridize()
+    return net
+
+
+def test_stats_enabled_keeps_one_compile_signature():
+    numerics.enable(stride=1)
+    telemetry.enable()
+    net = _mlp()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.01})
+    x, y = nd.ones((2, 8)), nd.ones((2, 4))
+
+    def one_step():
+        with telemetry.step(examples=2) as scope:
+            with autograd.record():
+                loss = ((net(x) - y) ** 2).mean()
+            loss.backward()
+            trainer.step(2)
+        return scope.record
+
+    first = one_step()
+    assert first["compile_count"] > 0  # the one stats-on trace
+    kinds = set()
+    for _ in range(3):
+        rec = one_step()
+        # replays: zero compiles with stats still flowing every step
+        assert rec["compile_count"] == 0, rec["counters"]
+        tensors = rec["numerics"]["tensors"]
+        kinds |= {p.split(".", 1)[0] for p in tensors}
+    assert {"grad", "update"} <= kinds
+    # toggling the tier re-uses BOTH cached signatures: off retraces
+    # once into its own cache entry, on replays the original compile
+    numerics.disable()
+    assert one_step()["compile_count"] > 0
+    assert one_step()["compile_count"] == 0
+    numerics.enable(stride=1)
+    rec = one_step()
+    assert rec["compile_count"] == 0, rec["counters"]
+    assert rec["numerics"] is not None
+
+
+def test_cachedop_backward_records_per_param_grad_stats():
+    numerics.enable(stride=1)
+    net = _mlp()
+    with autograd.record():
+        out = net(nd.ones((2, 8)))
+    out.backward()
+    summary = numerics.step_summary(0)
+    grads = {p for p in summary["tensors"] if p.startswith("grad.")}
+    names = {p.name for p in net.collect_params().values()}
+    assert grads == {"grad." + n for n in names}
+
+
+# --- model taps: plain and scanned decoder paths -----------------------------
+
+def test_llama_plain_path_taps_every_layer():
+    numerics.enable(stride=1)
+    net = llama.llama_tiny()
+    net.initialize(mx.init.Xavier())
+    net(nd.array(np.ones((1, 8), dtype="int32")))
+    paths = set(numerics.step_summary(0)["tensors"])
+    n_layers = len(net.model.layers)
+    expected = {"embed", "norm", "logits"} | {
+        f"decoder.{i}" for i in range(n_layers)}
+    assert expected <= paths
+
+
+def test_llama_scanned_path_fans_out_stacked_layer_stats():
+    numerics.enable(stride=1)
+    net = llama.llama_tiny(scan_layers=True)
+    net.initialize(mx.init.Xavier())
+    net(nd.array(np.ones((1, 8), dtype="int32")))
+    summary = numerics.step_summary(0)
+    n_layers = len(net.model.layers)
+    # the scan emits ONE stacked bundle; the harvest fans it out
+    for i in range(n_layers):
+        assert f"decoder.{i}" in summary["tensors"]
+
+
+# --- the acceptance lane: dp2 mesh NaN injection ------------------------------
+
+def test_nan_injected_at_layer1_attributed_with_rank_on_dp2_mesh(tmp_path):
+    telemetry.enable()
+    fleet.enable(stride=1)
+    numerics.enable(stride=1)
+    mesh = parallel.make_mesh({"dp": 2})
+    net = llama.llama_tiny()
+    net.initialize(mx.init.Xavier())
+    # poison one weight of decoder layer 1 (layer-k param path) BEFORE
+    # mesh placement, so the nan rides the placed copy onto both ranks
+    victim = next(iter(net.model.layers[1].collect_params().values()))
+    host = np.array(victim.data().asnumpy())
+    host.flat[0] = float("nan")
+    victim.set_data(nd.array(host))
+    gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 0.01},
+                  partition_rules="llama", mesh=mesh)
+    ids = parallel.shard_batch(
+        nd.array(np.ones((2, 8), dtype="int32")), mesh)
+    with telemetry.step(examples=2):
+        net(ids)
+    anomalies = [r for r in fleet.recent()
+                 if r.get("record") == "anomaly"
+                 and r.get("kind") == "nan_tensor"]
+    assert anomalies, [r.get("kind") for r in fleet.recent()]
+    evt = anomalies[-1]
+    # the anomaly names (layer-1 path, rank): embed and decoder.0 are
+    # clean, decoder.1 is the first poisoned tap in forward order
+    assert evt["path"] == "decoder.1"
+    assert evt["layer"] == 1
+    assert evt["rank"] == 0
+    assert evt["nan"] > 0
+    assert telemetry.counters().get("fleet.anomaly.nan_tensor", 0) >= 1
+    # provenance rides the stride exchange: every rank learns the layer
+    view = fleet.last_view()
+    assert view["first_nan_layer"] == [1]
+    # ... and the flight dump carries the same attribution
+    dump_path = fleet.dump(str(tmp_path / "fd.json"), reason="test")
+    with open(dump_path) as f:
+        doc = json.load(f)
+    dumped = [r for r in doc["records"]
+              if r.get("record") == "anomaly"
+              and r.get("kind") == "nan_tensor"]
+    assert dumped and dumped[-1]["path"] == "decoder.1"
+    assert dumped[-1]["layer"] == 1 and dumped[-1]["rank"] == 0
+
+
+# --- watchdog math (pure functions) ------------------------------------------
+
+def test_growth_streak_pure_math():
+    assert fleet.growth_streak([1.0, 3.0, 7.0, 20.0], 2.0) == 3
+    assert fleet.growth_streak([10.0, 3.0, 7.0, 20.0], 2.0) == 2
+    # None gaps (strided records) break the streak
+    assert fleet.growth_streak([1.0, 3.0, None, 20.0], 2.0) == 0
+    assert fleet.growth_streak([1.0, None, 3.0, 20.0], 2.0) == 1
+    # degenerate inputs are quiet
+    assert fleet.growth_streak([], 2.0) == 0
+    assert fleet.growth_streak([5.0], 2.0) == 0
+    # non-positive predecessors never count as growth
+    assert fleet.growth_streak([-1.0, 5.0], 2.0) == 0
+    assert fleet.growth_streak([0.0, 5.0], 2.0) == 0
+
+
+def test_watchdog_grad_norm_explosion_after_k_windows():
+    wd = fleet.Watchdog(consecutive=3, growth_factor=2.0,
+                        min_history=100)  # spike detector stays quiet
+    fired = []
+    for gn in (1.0, 3.0, 9.0):
+        fired += [a for a in wd.observe_step({"grad_norm": gn})
+                  if a["kind"] == "grad_norm_explosion"]
+    assert fired == []  # streak is 2 after three samples
+    fired = [a for a in wd.observe_step({"grad_norm": 27.0})
+             if a["kind"] == "grad_norm_explosion"]
+    assert fired and fired[0]["windows"] == 3
+    assert fired[0]["factor"] == 2.0
+
+
+def test_watchdog_explosion_reads_numerics_grad_norm_fallback():
+    wd = fleet.Watchdog(consecutive=2, growth_factor=2.0,
+                        min_history=100)
+    out = []
+    for gn in (1.0, 3.0, 9.0):
+        out += wd.observe_step({"numerics": {"grad_norm": gn,
+                                             "first_nan": None}})
+    assert any(a["kind"] == "grad_norm_explosion" for a in out)
+
+
+def test_spike_and_skew_detectors_tolerate_none_gaps():
+    hist = [5.0, None, 5.0, 5.0, None, 5.0]
+    assert fleet.detect_spike(100.0, hist, factor=3.0, min_history=4)
+    assert not fleet.detect_spike(6.0, hist, factor=3.0, min_history=4)
+    assert not fleet.detect_spike(None, hist, factor=3.0, min_history=4)
+    assert fleet.detect_skew([10.0, None, 40.0, 10.0], 1.5) == [2]
+    assert fleet.detect_skew([None, None, None], 1.5) == []
+
+
+# --- capture -> replay forensics ---------------------------------------------
+
+class _PoisonNet(gluon.HybridBlock):
+    """Dense -> log: negative activations poison the log, so the
+    bisection must name ``log`` (not the dense) as the first bad op."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.dense = gluon.nn.Dense(4)
+
+    def hybrid_forward(self, F, x):
+        return F.log(self.dense(x))
+
+
+def build_poison_net():
+    return _PoisonNet()
+
+
+def test_capture_replay_names_first_poisoned_op(tmp_path):
+    net = build_poison_net()
+    net.initialize(mx.init.Xavier())
+    x = nd.array(np.linspace(-3.0, 3.0, 12,
+                             dtype=np.float32).reshape(2, 6))
+    net(x)
+    # deterministic poison: all-ones weights make row sums, and row 0
+    # of x sums negative -> log(neg) = nan at the log, not the dense
+    wp = net.collect_params()[net.dense.weight.name]
+    wp.set_data(nd.ones(wp.shape))
+    numerics.arm_capture(str(tmp_path))
+    assert numerics.capture_armed()
+    cdir = numerics.capture_step(
+        net, [x], step=42, reason="grad_spike",
+        builder="test_numerics:build_poison_net")
+    assert cdir == str(tmp_path / "capture-42")
+    assert not numerics.capture_armed()  # one-shot disarm
+    checkpoint.wait_async()
+
+    # sidecar schema + params landed through the async checkpointer
+    with open(os.path.join(cdir, "capture.json")) as f:
+        meta = json.load(f)
+    assert meta["record"] == "numerics_capture"
+    assert meta["step"] == 42 and meta["reason"] == "grad_spike"
+    assert meta["builder"] == "test_numerics:build_poison_net"
+    assert meta["inputs"] == ["input0"]
+    ckpt = checkpoint.latest_checkpoint(cdir)
+    assert ckpt is not None
+    with open(os.path.join(ckpt, "manifest.json")) as f:
+        manifest = json.load(f)
+    assert manifest["extra"]["numerics_capture"]["reason"] == "grad_spike"
+
+    meta2, inputs = numerics.load_capture(cdir)
+    assert meta2 == meta
+    np.testing.assert_array_equal(inputs[0], x.asnumpy())
+
+    lines, res = _numerics_report().replay(cdir)
+    assert res.first is not None
+    assert res.first["op"] == "log"
+    journal = res.ops[res.first["index"]]
+    assert journal["outputs_bad"] and not journal["inputs_bad"]
+    assert any("first failing op: log" in ln for ln in lines)
+
+
+def test_capture_unarmed_is_a_noop():
+    net = build_poison_net()
+    net.initialize(mx.init.Xavier())
+    x = nd.ones((1, 6))
+    net(x)
+    assert numerics.capture_step(net, [x], step=1) is None
+
+
+# --- report schema: JSONL heatmap + Perfetto counters ------------------------
+
+def test_report_renders_real_jsonl_numerics_blocks(tmp_path):
+    jsonl = str(tmp_path / "rank0.jsonl")
+    telemetry.enable(jsonl_path=jsonl)
+    numerics.enable(stride=1)
+    net = llama.llama_tiny()
+    net.initialize(mx.init.Xavier())
+    ids = nd.array(np.ones((2, 8), dtype="int32"))
+    for _ in range(3):
+        with telemetry.step(examples=2):
+            net(ids)
+    telemetry.disable()
+
+    nr = _numerics_report()
+    records = nr.load_records([jsonl])
+    rows = nr.numerics_rows(records)
+    assert rows, "JSONL step records must carry numerics blocks"
+    for _step, rank, _path, st in rows:
+        assert rank == 0
+        assert set(st) == {"l2", "maxabs", "mean", "nan", "inf"}
+    text = nr.heatmap_text(records)
+    assert "numerics heatmap: l2" in text
+    assert "overflow: none" in text
+    doc = nr.chrome_counters(records)
+    events = doc["traceEvents"]
+    assert events and all(e["ph"] == "C" for e in events)
+    assert all(e["name"].startswith("numerics/") for e in events)
+    tracked = {e["name"] for e in events}
+    assert any(n != "numerics/grad_norm" for n in tracked)
+    for e in events:
+        if e["name"] != "numerics/grad_norm":
+            assert set(e["args"]) == {"l2", "overflow"}
+
+
+def test_report_heatmap_flags_overflow_cells():
+    nr = _numerics_report()
+    records = [
+        {"step": 16, "rank": 0, "step_ms": 1.0,
+         "numerics": {"stride": 16, "grad_norm": 2.0, "first_nan": None,
+                      "tensors": {"embed": {"l2": 1.0, "maxabs": 1.0,
+                                            "mean": 0.1, "nan": 0,
+                                            "inf": 0}}}},
+        {"step": 32, "rank": 0, "step_ms": 1.0,
+         "numerics": {"stride": 16, "grad_norm": None,
+                      "first_nan": {"path": "decoder.1", "layer": 1,
+                                    "nan": 4, "inf": 0},
+                      "tensors": {"decoder.1": {"l2": 9.0, "maxabs": 9.0,
+                                                "mean": 0.0, "nan": 4,
+                                                "inf": 0}}}},
+    ]
+    text = nr.heatmap_text(records)
+    assert "9!" in text
+    assert "first overflow: step 32 path decoder.1 (layer 1" in text
+    assert "first_nan decoder.1 (layer 1)" in text
+    doc = nr.chrome_counters(records)
+    by_name = {}
+    for e in doc["traceEvents"]:
+        by_name.setdefault(e["name"], []).append(e)
+    assert by_name["numerics/decoder.1"][0]["args"]["overflow"] == 4.0
+    assert by_name["numerics/grad_norm"][0]["args"]["grad_norm"] == 2.0
+
+
+# --- Monitor on the numerics tier --------------------------------------------
+
+def test_monitor_records_on_hybridized_block():
+    net = _mlp()
+    net(nd.ones((2, 8)))  # traced BEFORE install: hooks must retrace
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")  # the old warning is gone
+        mon = Monitor(interval=1).install(net)
+    mon.tic()
+    net(nd.ones((2, 8)))
+    rows = mon.toc()
+    assert rows, "hybridized Monitor.install must record rows"
+    names = {name for _step, name, _stat in rows}
+    assert any(n.endswith("_output") for n in names)
+    for _step, _name, stat in rows:
+        assert float(stat) > 0.0  # l2 of a live activation
+    mon.uninstall()
+
+
+def test_monitor_eager_path_unchanged():
+    net = _mlp()
+    net.hybridize(False)
+    mon = Monitor(interval=1).install(net)
+    mon.tic()
+    net(nd.ones((2, 8)))
+    rows = mon.toc()
+    assert len(rows) >= 2  # one per Dense child
+    mon.uninstall()

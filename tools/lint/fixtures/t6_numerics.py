@@ -1,0 +1,54 @@
+"""T6 fixture: numerics stat taps in traced training hot paths.
+
+The r17 numerics tier bakes per-tensor stat bundles (l2/maxabs/mean/
+nan/inf) into the step compile as side outputs — pure jnp math, no
+``jax.debug``, no host transfer on any tap path.  The analyzer must
+(a) not flag ``numerics.*`` / ``_numerics.*`` taps inside jitted step
+bodies, (b) not let hotness leak into a same-module tap helper through
+its bare-name call, (c) leave the tier's ``_materialize`` def's
+intentional stride-boundary device_get unflagged (MATERIALIZE_DEFS),
+while (d) still flagging a real host sync smuggled into a traced
+region next to a tap.
+"""
+import jax
+import numpy as np
+
+from mxnet_tpu.telemetry import numerics
+from mxnet_tpu.telemetry import numerics as _numerics
+
+
+def _tap_activations(name, x):
+    # same-module tap helper: pure device-scalar stat math routed to the
+    # active trace collector — hotness must NOT leak in through the
+    # bare-name call in traced_step below
+    _numerics.tap(name, x)
+    return x
+
+
+def traced_step(params, batch):
+    h = batch @ params["w"]
+    _tap_activations("hidden", h)                 # ok: helper
+    numerics.tap("hidden_direct", h)              # ok: numerics.*
+    st = _numerics.stats_of(h)                    # ok: pure jnp math
+    _numerics.record_compiled(("hidden",), (st,))  # ok: queues scalars
+    return h.sum()
+
+
+traced_step_jit = jax.jit(traced_step)
+
+
+def _materialize(entries):
+    # the tier's ONE host sync: stride-gated fetch of every pending
+    # device stat in a single transfer — MATERIALIZE_DEFS exempts the
+    # T1 eager warning here
+    return [e[1].asnumpy() for e in entries]
+
+
+def bad_stat_tick(params, batch):
+    h = batch @ params["w"]
+    numerics.tap("hidden", h)
+    host = np.asarray(h)            # T1 error: sync in the traced step
+    return host.sum()
+
+
+bad_stat_tick_jit = jax.jit(bad_stat_tick)

@@ -63,6 +63,12 @@ RECORDING_SAFE_CALLEES = {
     # the stride allgather is isolated in _fleet_exchange
     # (MATERIALIZE_DEFS) and never rides these entry points' fast path
     "on_step_record", "observe_step", "observe_fleet",
+    # numerics tier taps (r17, telemetry.numerics): pure jnp stat math
+    # emitted as trace side outputs — no host transfer on any tap path;
+    # the single stride-gated sync is numerics._materialize
+    # (MATERIALIZE_DEFS), and record_compiled only queues device scalars
+    "tap", "tap_stacked", "stats_of", "record_compiled",
+    "record_stacked", "step_summary",
 }
 
 _FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)

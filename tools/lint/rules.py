@@ -144,7 +144,15 @@ RECORDING_HEADS = {"telemetry", "profiler", "prof",
                    # arithmetic and text rendering — host-side; the one
                    # collective lives in _fleet_exchange (see
                    # MATERIALIZE_DEFS), stride-gated off the hot path
-                   "fleet", "_fleet", "_fleet_mod", "promtext"}
+                   "fleet", "_fleet", "_fleet_mod", "promtext",
+                   # r17 numerics tier (telemetry.numerics, conventionally
+                   # imported as _numerics): taps are pure jnp stat math
+                   # that rides the trace as side outputs — never
+                   # jax.debug, never a host sync; the one materialize is
+                   # stride-gated inside numerics._materialize
+                   # (MATERIALIZE_DEFS) and the forensic replay half never
+                   # runs in training code
+                   "numerics", "_numerics"}
 
 
 def _is_recording_call(dotted: str) -> bool:

@@ -140,7 +140,7 @@ def _compose_step(net, loss_raw, opt, batch_for_rescale, key,
             full = list(p_raws)
             for j, i in enumerate(diff_idx):
                 full[i] = diff_raws[j]
-            outs, auxs = graph._pure(full, in_raws, key)
+            outs, auxs, _stats = graph._pure(full, in_raws, key)
             return loss_raw(outs, label_raw), auxs
 
         fn = jax.checkpoint(loss_of) if remat else loss_of

@@ -1,0 +1,274 @@
+"""Render numerics telemetry into per-layer timelines, or replay a
+captured divergence with per-op NaN bisection.
+
+The numerics tier (``mxnet_tpu.telemetry.numerics``) attaches a
+``"numerics"`` block to step records at each stride boundary: per-path
+tensor stats (l2 / maxabs / mean / nan / inf), ``first_nan`` provenance
+and an aggregate ``grad_norm``.  This tool turns those blocks — from
+telemetry JSONL streams or fleet flight-recorder dumps — back into the
+training-dynamics picture:
+
+    # per-layer l2-norm timeline ('!' marks nan/inf overflow cells)
+    python tools/numerics_report.py out/rank*.jsonl
+
+    # Perfetto counter tracks, one per stat path
+    python tools/numerics_report.py out/rank0.jsonl --format chrome \
+        --out numerics.json
+
+    # replay a flagged step eagerly and name the first poisoned op
+    python tools/numerics_report.py --replay dumps/capture-1920
+
+Replay rebuilds the net from the capture's ``builder``
+(``"module:function"`` + kwargs), restores params through the
+checkpointer, feeds the snapshotted inputs eagerly under
+``numerics.bisect()``, and prints the first op whose inputs were clean
+but whose outputs went nan/inf.  The functions (`numerics_rows`,
+`heatmap_text`, `chrome_counters`, `replay`) are importable for tests.
+"""
+from __future__ import annotations
+
+import argparse
+import importlib
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from fleet_report import load_records  # noqa: E402
+
+
+def numerics_rows(records):
+    """``[(step, rank, path, stats), ...]`` flattened from every step
+    record carrying a ``"numerics"`` block, in (step, rank) order."""
+    rows = []
+    for rec in records:
+        num = rec.get("numerics")
+        if not isinstance(num, dict):
+            continue
+        step = rec.get("step")
+        rank = rec.get("rank") or 0
+        for path, st in (num.get("tensors") or {}).items():
+            rows.append((step, rank, path, st))
+    return rows
+
+
+def _columns(rows):
+    steps = sorted({s for s, _, _, _ in rows if s is not None})
+    paths = sorted({p for _, _, p, _ in rows})
+    return steps, paths
+
+
+def heatmap_text(records, metric="l2"):
+    """Path x step text heatmap of ``metric`` over the numerics blocks.
+    Cells carrying any nan/inf are flagged ``!``; the summary names the
+    earliest overflow (step, path, layer) and any watchdog/first_nan
+    provenance found in the stream."""
+    rows = numerics_rows(records)
+    lines = []
+    if not rows:
+        lines.append("no numerics blocks (was the numerics tier "
+                     "enabled, and did a stride boundary pass?)")
+        return "\n".join(lines)
+    steps, paths = _columns(rows)
+    cell = {(s, p): st for s, _, p, st in rows}
+    lines.append("numerics heatmap: %s (! = nan/inf in cell)" % metric)
+    lines.append("step" + " " * 28 + "".join("%12d" % s for s in steps))
+    for p in paths:
+        cells = []
+        for s in steps:
+            st = cell.get((s, p))
+            if st is None:
+                cells.append("%12s" % "-")
+                continue
+            bad = (st.get("nan") or 0) + (st.get("inf") or 0)
+            cells.append("%11.3g%s" % (float(st.get(metric) or 0.0),
+                                       "!" if bad else " "))
+        lines.append("%-32s" % p[:32] + "".join(cells))
+    lines.append("")
+    overflow = sorted((s, p, st) for s, _, p, st in rows
+                      if (st.get("nan") or 0) + (st.get("inf") or 0))
+    if overflow:
+        s, p, st = overflow[0]
+        from mxnet_tpu.telemetry.numerics import layer_of
+        lines.append("first overflow: step %s path %s (layer %d, "
+                     "nan=%s inf=%s)" % (s, p, layer_of(p),
+                                         st.get("nan"), st.get("inf")))
+    else:
+        lines.append("overflow: none")
+    # surface first_nan provenance + nan_tensor anomalies when present
+    for rec in records:
+        fn = (rec.get("numerics") or {}).get("first_nan") \
+            if isinstance(rec.get("numerics"), dict) else None
+        if fn:
+            lines.append("  step %-6s rank %-3s first_nan %s (layer %s)"
+                         % (rec.get("step"), rec.get("rank") or 0,
+                            fn.get("path"), fn.get("layer")))
+        if rec.get("record") == "anomaly" \
+                and rec.get("kind") in ("nan_tensor",
+                                        "grad_norm_explosion"):
+            lines.append("  step %-6s rank %-3s anomaly %s %s"
+                         % (rec.get("step"), rec.get("rank") or 0,
+                            rec.get("kind"),
+                            {k: rec[k] for k in ("path", "layer",
+                                                 "grad_norm")
+                             if rec.get(k) is not None}))
+    return "\n".join(lines)
+
+
+def chrome_counters(records):
+    """chrome://tracing / Perfetto JSON: one counter ("C") track per
+    stat path with ``l2`` and ``overflow`` series — the offline twin of
+    the live ``profiler.record_counter_event`` mirror.  Timestamps are
+    wall-clock relative to the earliest record (step index as a
+    fallback timebase when records carry no wall time)."""
+    walls = [rec.get("wall_time") for rec in records
+             if isinstance(rec, dict) and rec.get("wall_time") is not None]
+    t0 = min(walls) if walls else 0.0
+    events = []
+    for rec in records:
+        num = rec.get("numerics")
+        if not isinstance(num, dict):
+            continue
+        rank = rec.get("rank") or 0
+        wall = rec.get("wall_time")
+        ts = ((float(wall) - t0) * 1e6 if wall is not None
+              else float(rec.get("step") or 0) * 1e3)
+        for path, st in (num.get("tensors") or {}).items():
+            events.append({
+                "ph": "C", "cat": "numerics",
+                "name": "numerics/" + path,
+                "pid": rank, "tid": 0, "ts": ts,
+                "args": {"l2": float(st.get("l2") or 0.0),
+                         "overflow": float((st.get("nan") or 0)
+                                           + (st.get("inf") or 0))}})
+        if num.get("grad_norm") is not None:
+            events.append({
+                "ph": "C", "cat": "numerics", "name": "numerics/grad_norm",
+                "pid": rank, "tid": 0, "ts": ts,
+                "args": {"grad_norm": float(num["grad_norm"])}})
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def _build_net(meta):
+    builder = meta.get("builder")
+    if not builder or ":" not in builder:
+        raise SystemExit(
+            "capture has no usable builder (%r); re-capture with "
+            "builder='module:function'" % (builder,))
+    mod_name, fn_name = builder.split(":", 1)
+    fn = getattr(importlib.import_module(mod_name), fn_name)
+    return fn(**(meta.get("builder_kwargs") or {}))
+
+
+def replay(capture_dir, max_journal=12):
+    """Re-run a captured step eagerly under the per-op NaN bisection
+    hook.  Returns ``(lines, result)`` — report text plus the raw
+    ``BisectResult`` — so tests can assert on ``result.first``."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import checkpoint as _ckpt
+    from mxnet_tpu.telemetry import numerics
+
+    meta, inputs = numerics.load_capture(capture_dir)
+    net = _build_net(meta)
+    if hasattr(net, "hybridize"):
+        net.hybridize(False)  # eager replay — per-op dispatch, no jit
+    try:
+        net.initialize()  # deferred; checkpoint set_data supplies shapes
+    except Exception:
+        pass
+    step, extra = _ckpt.resume(capture_dir, net)
+    lines = ["replaying %s: step %s (%s), %d input(s), params from "
+             "checkpoint step %s"
+             % (capture_dir, meta.get("step"), meta.get("reason"),
+                len(inputs), step)]
+    if extra.get("numerics_capture"):
+        lines.append("  capture reason: %s"
+                     % extra["numerics_capture"].get("reason"))
+    if meta.get("rng_key") is not None:
+        from mxnet_tpu import random as mx_random
+        import jax
+
+        mx_random._STATE.key = jax.numpy.asarray(
+            np.asarray(meta["rng_key"], dtype=np.uint32))
+    args = [mx.nd.array(a) for a in inputs]
+    with numerics.bisect() as res:
+        out = net(*args)
+    bad_out = any((np.isnan(np.asarray(getattr(o, "_data", o))).any()
+                   or np.isinf(np.asarray(getattr(o, "_data", o))).any())
+                  for o in (out if isinstance(out, (tuple, list))
+                            else (out,))
+                  if np.asarray(getattr(o, "_data", o)).dtype.kind == "f")
+    if res.first is not None:
+        i = res.first["index"]
+        lines.append("first failing op: %s (dispatch #%d of %d)"
+                     % (res.first["op"], i, len(res.ops)))
+        lo = max(0, i - max_journal // 2)
+        lines.append("op journal around the poisoned op:")
+        for j, op in enumerate(res.ops[lo:lo + max_journal], start=lo):
+            mark = " <-- first poisoned" if j == i else ""
+            lines.append("  #%-4d %-28s inputs_bad=%-5s outputs_bad=%s%s"
+                         % (j, op["op"], op["inputs_bad"],
+                            op["outputs_bad"], mark))
+    elif bad_out:
+        lines.append("outputs are nan/inf but no clean->poisoned op "
+                     "transition was seen (inputs or params already "
+                     "poisoned at capture time)")
+    else:
+        lines.append("replay is clean: %d ops dispatched, no nan/inf "
+                     "anywhere (divergence did not reproduce eagerly — "
+                     "suspect non-determinism or compiled-only numerics)"
+                     % len(res.ops))
+    return lines, res
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="render numerics telemetry (per-layer norm/overflow "
+        "timelines) from JSONL streams / flight dumps, or replay a "
+        "captured divergence with per-op NaN bisection")
+    ap.add_argument("paths", nargs="*", metavar="path",
+                    help="telemetry JSONL files, globs, or fleet "
+                    "flight-recorder dumps")
+    ap.add_argument("--metric", default="l2",
+                    choices=("l2", "maxabs", "mean"),
+                    help="stat for the heatmap cells (default: l2)")
+    ap.add_argument("--format", choices=("text", "chrome"),
+                    default="text")
+    ap.add_argument("--out", default=None,
+                    help="write here instead of stdout")
+    ap.add_argument("--replay", default=None, metavar="CAPTURE_DIR",
+                    help="replay a numerics.capture_step() snapshot "
+                    "eagerly and name the first poisoned op")
+    args = ap.parse_args(argv)
+
+    if args.replay:
+        lines, res = replay(args.replay)
+        print("\n".join(lines))
+        return 0 if res.first is None else 2
+    if not args.paths:
+        ap.error("give JSONL/dump paths, or --replay CAPTURE_DIR")
+    records = load_records(args.paths)
+    if not records:
+        print("no records found", file=sys.stderr)
+        return 1
+    sink = open(args.out, "w", encoding="utf-8") if args.out \
+        else sys.stdout
+    try:
+        if args.format == "chrome":
+            json.dump(chrome_counters(records), sink, indent=1)
+            sink.write("\n")
+        else:
+            sink.write(heatmap_text(records, metric=args.metric) + "\n")
+    finally:
+        if sink is not sys.stdout:
+            sink.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -66,8 +66,8 @@ def main():
         graph = _CachedGraph(net, params, training=False)
 
         def fwd(p_raws, ids):
-            outs, _ = graph._pure(p_raws, (ids,),
-                                  jax.random.PRNGKey(0))
+            outs, _, _ = graph._pure(p_raws, (ids,),
+                                     jax.random.PRNGKey(0))
             return outs[0]
 
         abs_p = tuple(
