@@ -1,0 +1,108 @@
+"""Shared compile-once acceptance gate for benchmark lanes.
+
+Every bench lane asserts the same invariant the retrace sanitizer
+(``mxnet_tpu.telemetry.retrace``) enforces at runtime and mxlint
+T13–T15 enforce statically: after warmup, a hot path replays cached
+programs — zero steady-state compile misses, and a serving lane's
+signature count stays inside its bucket-policy ceiling.  Before this
+module each lane re-implemented the assert by hand
+(``all(p["compile_miss_steady"] == 0 ...)``); they now share one
+checker so the failure message, the nested-lane walk and the ceiling
+semantics are uniform.
+
+``check_compile_once(stats)`` walks an arbitrarily nested dict of lane
+records and returns the list of problems; ``assert_compile_once``
+raises ``SystemExit`` on any.  ``SteadyMissProbe`` covers lanes that
+have no per-step counter plumbing: snapshot cache stats after warmup,
+diff after the timed window.
+"""
+from __future__ import annotations
+
+#: keys that carry a steady-state compile-miss count (must be 0)
+MISS_KEYS = ("compile_miss_steady", "miss_steady", "steady_misses")
+
+#: keys inside a cache-stats dict that count compiles/misses (used by
+#: SteadyMissProbe deltas, not by the zero-check walk — total miss
+#: counts legitimately include warmup compiles)
+_PROBE_MISS_KEYS = ("miss", "misses")
+
+
+def check_compile_once(stats, ceiling=None, _path=""):
+    """Walk ``stats`` (a lane record, or a nested dict/list of them)
+    and collect compile-once violations:
+
+    - any ``compile_miss_steady``-style count > 0;
+    - when ``ceiling`` is given, any ``signatures`` count > ceiling.
+
+    Returns a list of human-readable problem strings (empty = gate
+    passes)."""
+    problems = []
+    if isinstance(stats, dict):
+        for key in MISS_KEYS:
+            v = stats.get(key)
+            if isinstance(v, (int, float)) and v > 0:
+                problems.append(
+                    f"{_path or '<root>'}: {key}={int(v)} "
+                    "(steady-state recompile)")
+        sigs = stats.get("signatures")
+        if ceiling is not None and isinstance(sigs, (int, float)) \
+                and sigs > ceiling:
+            problems.append(
+                f"{_path or '<root>'}: signatures={int(sigs)} exceeds "
+                f"ceiling {ceiling}")
+        for k, v in stats.items():
+            if isinstance(v, (dict, list, tuple)):
+                problems.extend(check_compile_once(
+                    v, ceiling=ceiling,
+                    _path=f"{_path}.{k}" if _path else str(k)))
+    elif isinstance(stats, (list, tuple)):
+        for i, v in enumerate(stats):
+            if isinstance(v, (dict, list, tuple)):
+                problems.extend(check_compile_once(
+                    v, ceiling=ceiling, _path=f"{_path}[{i}]"))
+    return problems
+
+
+def compile_once_ok(stats, ceiling=None):
+    """Boolean form for acceptance dicts."""
+    return not check_compile_once(stats, ceiling=ceiling)
+
+
+def assert_compile_once(stats, label="", ceiling=None):
+    """Hard gate: ``SystemExit`` naming every violation when the lane
+    compiled in steady state (or blew its signature ceiling).  Returns
+    True so callers can embed the result in an acceptance dict."""
+    problems = check_compile_once(stats, ceiling=ceiling)
+    if problems:
+        where = f" [{label}]" if label else ""
+        raise SystemExit(
+            "compile-once gate failed%s: %s" % (where, "; ".join(problems)))
+    return True
+
+
+class SteadyMissProbe:
+    """Steady-state miss delta for lanes without per-step counters.
+
+    Construct AFTER warmup with any number of zero-arg cache-stats
+    callables (e.g. ``engine.segment_cache_stats``,
+    ``cached_op.cache_stats``); each must return a dict whose
+    ``miss``/``misses`` entries count compiles.  ``steady()`` returns
+    ``{"compile_miss_steady": <new misses since construction>}`` —
+    feed it straight to :func:`assert_compile_once`."""
+
+    def __init__(self, *stat_fns):
+        self._fns = [fn for fn in stat_fns if fn is not None]
+        self._base = self._count()
+
+    def _count(self):
+        total = 0
+        for fn in self._fns:
+            stats = fn() or {}
+            for key in _PROBE_MISS_KEYS:
+                v = stats.get(key)
+                if isinstance(v, (int, float)):
+                    total += int(v)
+        return total
+
+    def steady(self):
+        return {"compile_miss_steady": self._count() - self._base}

@@ -113,6 +113,8 @@ def bench_chain():
     def hybrid_iter():
         hybrid(x).wait_to_read()
 
+    from _compile_gate import SteadyMissProbe, assert_compile_once
+
     out = {}
     ref = _chain_body(x).asnumpy()
     for mode, it, use_async in (
@@ -125,7 +127,23 @@ def bench_chain():
         try:
             for _ in range(WARMUP):
                 it()
+            # runtime twin of the probe below: reset scopes the warmup
+            # declaration to THIS mode's steady state (lanes legitimately
+            # differ in shape mix), so under MXNET_SANITIZE_RETRACE any
+            # signature churn inside the timed window is a violation
+            from mxnet_tpu.telemetry import retrace as _retrace
+            if _retrace.is_enabled():
+                _retrace.reset()
+                _retrace.warm()
+            cop = getattr(hybrid, "_cached_op", None)
+            probe = SteadyMissProbe(
+                engine.segment_cache_stats,
+                cop.cache_stats if cop is not None else None)
             best = _time_windows(it, CHAIN_ITERS, REPEATS)
+            # the timed windows replay warmed caches: any new segment or
+            # CachedOp compile here is the dispatch-path retrace bug this
+            # bench exists to catch
+            assert_compile_once(probe.steady(), label=f"chain64:{mode}")
         finally:
             engine.set_async_enabled(prev)
         out[mode] = best / (CHAIN_ITERS * CHAIN_OPS) * 1e6  # µs/op
@@ -205,7 +223,15 @@ def bench_mlp_sgd():
         try:
             for _ in range(WARMUP):
                 it()
+            from mxnet_tpu.telemetry import retrace as _retrace
+            if _retrace.is_enabled():
+                _retrace.reset()
+                _retrace.warm()
+            from _compile_gate import SteadyMissProbe, assert_compile_once
+
+            probe = SteadyMissProbe(engine.segment_cache_stats)
             best = _time_windows(it, MLP_ITERS, REPEATS)
+            assert_compile_once(probe.steady(), label=f"mlp_sgd:{mode}")
         finally:
             engine.set_async_enabled(prev)
         out[mode] = best / MLP_ITERS * 1e3  # ms/step

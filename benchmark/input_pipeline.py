@@ -328,14 +328,15 @@ def main_data_plane():
                 parallel.set_mesh(None)
                 gc.collect()
     wait_p50 = max(lane["data_wait_ms_p50"] for lane in lanes.values())
+    from _compile_gate import compile_once_ok
+
     acceptance = {
         # prefetch overlap holds: the trainer never starves on input
         "data_wait_p50_near_zero": wait_p50 <= 2.0,
         "packing_efficiency_ge_85":
             lanes["packed_llm"]["packing"]["efficiency"] >= 0.85,
         # one (B, T) signature end to end — no per-length recompiles
-        "compile_once": all(lane["compile_miss_steady"] == 0
-                            for lane in lanes.values()),
+        "compile_once": compile_once_ok(lanes),
     }
     record = {
         "metric": "data_plane_data_wait_ms_p50",

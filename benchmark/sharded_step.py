@@ -461,11 +461,12 @@ def main():
             "dp8": _run_lane(build, {"dp": 8}),
             "dp4xtp2": _run_lane(build, {"dp": 4, "tp": 2}),
         }
+    from _compile_gate import compile_once_ok
+
     acceptance = {}
     for model, pair in lanes.items():
         acceptance[model] = {
-            "compile_once": all(p["compile_miss_steady"] == 0
-                                for p in pair.values()),
+            "compile_once": compile_once_ok(pair),
             "tp_shards_params": pair["dp4xtp2"]["placement"]
             ["sharded_params"] > 0,
             "tp_peak_below_dp_only": pair["dp4xtp2"]["per_device_peak_max"]

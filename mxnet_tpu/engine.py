@@ -105,6 +105,7 @@ from . import sanitizer as _san
 from . import telemetry
 from .telemetry import costs as _costs
 from .telemetry import memwatch as _mw
+from .telemetry import retrace as _retrace
 
 __all__ = ["engine_type", "set_engine_type", "is_naive", "bulk",
            "set_bulk_size", "bulk_size", "set_bulk_enabled", "bulk_enabled",
@@ -465,6 +466,22 @@ class _Segment:
                keep)
         entry = _cache_lookup(key)
         if entry is None:
+            if _retrace._enabled and len(self.ops) > 1:
+                # registered compile site, keyed per op sequence: a new
+                # bulked segment program is fine, but a post-warmup
+                # second signature for the SAME op sequence (diverging
+                # external avals / liveness) is a retrace — e.g. an
+                # unlifted float turning weak scalars back into baked
+                # constants.  Single-op segments are the eager op
+                # library: one compile per aval set is its design, and
+                # interned call-site keys deliberately conflate contexts
+                # (layers sharing an op), so they are not compile-once
+                # sites
+                _retrace.observe(
+                    "engine_bulk", hash(key[0]),
+                    {"ext": key[1], "keep": keep},
+                    site="mxnet_tpu.engine:_Segment._execute_locked "
+                         f"({len(self.ops)} ops)")
             entry = _CompiledSegment(
                 _build_segment_fn(self.ops, self.slots, keep))
             _cache_insert(key, entry)
@@ -475,7 +492,8 @@ class _Segment:
             # cost registry shares the segment-cache key, so a replayed
             # segment attributes its flops without re-analysis
             _costs.note("engine_bulk", key, entry.jfn,
-                        (scalars,) + tuple(self.ext))
+                        (scalars,) + tuple(self.ext),
+                        site="mxnet_tpu.engine:_Segment._execute_locked")
         prev_flushing = _TLS.flushing
         _TLS.flushing = True
         try:
@@ -966,6 +984,14 @@ class _Variant:
 #: so each distinct cells snapshot gets its own site, matched in order.
 _SITE_CACHE = {}
 _SITES_PER_CODE = 8
+
+#: reviewed signature budget (mxlint T15): the segment cache compiles one
+#: program per (op sequence, arg avals, platform) key, so steady state is
+#: one signature per distinct hot call site — growth past that is the
+#: retrace bug the runtime sanitizer (telemetry.retrace) flags
+__compile_signatures__ = {
+    "engine_bulk": "1 per segment key (op sequence x arg avals x platform)",
+}
 _intern_stats = {"hit": 0, "miss": 0}
 
 #: types whose == is cheap and total — used for closure-cell revalidation

@@ -43,6 +43,7 @@ from .. import telemetry
 from ..telemetry import costs as _costs
 from ..telemetry import memwatch as _mw
 from ..telemetry import numerics as _numerics
+from ..telemetry import retrace as _retrace
 from ..context import Context, current_context
 from ..ndarray import NDArray
 from .parameter import (Parameter, ParameterDict,
@@ -118,6 +119,16 @@ class _BlockScope:
 # ---------------------------------------------------------------------------
 
 _TRACE = threading.local()
+
+#: reviewed signature budget (mxlint T15): a CachedOp compiles one graph
+#: per (input avals, training flag, platform, params version, mesh,
+#: numerics mode); bucketed serving bounds the aval axis via BucketPolicy
+__compile_signatures__ = {
+    "cachedop": "1 per (input avals, training, platform, params, mesh, "
+                "numerics) per CachedOp",
+    "cachedop_bwd": "1 per compiled forward signature that is "
+                    "differentiated",
+}
 
 
 def _is_tracing():
@@ -526,13 +537,28 @@ class _CachedGraph:
         if first:
             self._compiled.add(mode)
             telemetry.count("cachedop.compile")
+            if _retrace._enabled and recording:
+                # the backward program is built per graph; key the bwd
+                # site by the owning block so a post-warmup second
+                # specialization (new param schema, remat tier or
+                # numerics mode) is named as a bwd retrace too
+                _retrace.observe(
+                    "cachedop_bwd", id(self.block),
+                    {"params": tuple((tuple(p.shape),
+                                      str(np.dtype(p.dtype)))
+                                     for p in self.params),
+                     "training": self.training, "remat": self.remat,
+                     "numerics": self.numerics},
+                    site="mxnet_tpu.gluon.block:_CachedGraph.run "
+                         f"({self.block.name}, bwd)")
         if _costs._enabled:
             # keyed per compiled specialization (graph identity + dispatch
             # mode — graphs are one per CachedOp signature), so replays hit
             # the registry without re-analysis
             _costs.note("cachedop", (id(self), mode),
                         self._fwd_rec if recording else self._fwd,
-                        (p_raws, in_raws, key), remat=self.remat)
+                        (p_raws, in_raws, key), remat=self.remat,
+                        site="mxnet_tpu.gluon.block:CachedOp.__call__")
         for i, raw in zip(self.aux_idx, auxs):
             p_handles[i]._data = raw
         if self.numerics and stats:
@@ -563,7 +589,9 @@ class _CachedGraph:
                     raise
                 if _costs._enabled:
                     _costs.note("cachedop_bwd", (graph_id, "bwd"), bwd,
-                                (vjp, tuple(cots)), remat=remat_tier)
+                                (vjp, tuple(cots)), remat=remat_tier,
+                                site="mxnet_tpu.gluon.block:"
+                                     "_CachedGraph.run")
                 return tuple(p_cots) + tuple(in_cots)
 
             node = ag.Node(node_vjp, list(p_handles) + list(args),
@@ -684,6 +712,14 @@ class CachedOp:
             # unstable signature, e.g. unpadded dynamic batch sizes)
             telemetry.count("cachedop.cache_miss")
             self._misses += 1
+            if _retrace._enabled:
+                # registered compile site: a post-warmup second signature
+                # here is a retrace (raises/warns per sanitizer mode)
+                _retrace.observe(
+                    "cachedop", id(self),
+                    _retrace.cachedop_components(sig),
+                    site="mxnet_tpu.gluon.block:CachedOp.__call__ "
+                         f"({self.block.name})")
             with telemetry.span("cachedop.build"):
                 tier = self._resolve_remat(params, args, mesh, training)
                 g = _CachedGraph(self.block, params, training, remat=tier)

@@ -51,11 +51,19 @@ from .. import telemetry
 from ..telemetry import costs as _costs
 from ..telemetry import memwatch as _mw
 from ..telemetry import numerics as _numerics
+from ..telemetry import retrace as _retrace
 from ..base import MXNetError
 from ..ndarray import NDArray
 from .block import _trace_guard
 
 __all__ = ["FusedTrainStep"]
+
+#: reviewed signature budget (mxlint T15): one fused program per
+#: (batch avals, param set, optimizer config, k) — a FusedTrainStep is
+#: built once per training setup and replayed, so steady state is 1
+__compile_signatures__ = {
+    "step_fusion": "1 per (batch avals, param set, optimizer, k_steps)",
+}
 
 
 def _mem_policy_tier():
@@ -341,6 +349,17 @@ class FusedTrainStep:
         fn = self._jit_cache.get(sig)
         if fn is None:
             telemetry.count("step_fusion.cache_miss")
+            if _retrace._enabled:
+                # registered compile site: named components so a
+                # post-warmup retrace says exactly what diverged
+                # (closure attrs like rescale_grad included)
+                _retrace.observe(
+                    "step_fusion", id(self),
+                    {"optimizer": sig[0], "rescale_grad": sig[1],
+                     "mp_flags": sig[2], "batch": sig[3], "mesh": sig[4],
+                     "numerics": sig[5]},
+                    site="mxnet_tpu.gluon.step_fusion:"
+                         "FusedTrainStep.__call__")
             with telemetry.span("step_fusion.build"):
                 fn = self._build(tuple(mp_flags))
             self._jit_cache[sig] = fn
@@ -371,7 +390,9 @@ class FusedTrainStep:
             _costs.note("step_fusion", (id(self), sig), fn,
                         (w_raws, m_raws, s_raws, aux_raws, t_v, key, lr_v,
                          wd_v, consts, stacked if stacked else None),
-                        remat=pol)
+                        remat=pol,
+                        site="mxnet_tpu.gluon.step_fusion:"
+                             "FusedTrainStep.__call__")
         try:
             # publish the operands' platform so platform-conditional ops
             # (pallas flash) route correctly inside the fused trace even

@@ -26,6 +26,7 @@ from .. import telemetry
 from ..telemetry import costs as _costs
 from ..telemetry import memwatch as _mw
 from ..telemetry import numerics as _numerics
+from ..telemetry import retrace as _retrace
 
 __all__ = ["Trainer", "PREEMPTED_EXIT_CODE", "install_preemption_handler",
            "drain_requested", "drain_consensus", "request_drain",
@@ -47,6 +48,16 @@ __all__ = ["Trainer", "PREEMPTED_EXIT_CODE", "install_preemption_handler",
 #: and maps it to a backoff relaunch that does NOT consume the crash
 #: restart budget.
 PREEMPTED_EXIT_CODE = 75
+
+#: reviewed signature budget (mxlint T15): the fused update compiles one
+#: program per (optimizer type, rescale_grad, mixed-precision flags,
+#: weight avals, state widths, mesh, numerics mode); a varying
+#: ``step(batch_size)`` varies rescale_grad and retraces — hold the batch
+#: size steady or rescale outside the step
+__compile_signatures__ = {
+    "trainer_fused": "1 per (optimizer, rescale_grad, mp flags, weight "
+                     "avals, state widths, mesh, numerics)",
+}
 
 _DRAIN = threading.Event()
 
@@ -571,6 +582,18 @@ class Trainer:
         compiling = fn is None
         if compiling:
             telemetry.count("trainer.fused_cache_miss")
+            if _retrace._enabled:
+                # registered compile site: a post-warmup second fused
+                # signature (new weight schema, optimizer closure attr,
+                # mesh or numerics mode) is a retrace
+                _retrace.observe(
+                    "trainer_fused", id(self),
+                    {"optimizer": sig[0], "rescale_grad": sig[1],
+                     "mp_flags": sig[2], "weights": sig[3],
+                     "state_widths": sig[4], "mesh": sig[5],
+                     "numerics": sig[6]},
+                    site="mxnet_tpu.gluon.trainer:"
+                         "Trainer._try_fused_update")
             flags = tuple(mp_flags)
             # baked at trace time; the signature above keys on it, so
             # stats-on and stats-off each keep one fused program
@@ -615,7 +638,9 @@ class Trainer:
             # registered BEFORE the donating dispatch (lower() reads avals
             # only); keyed by the fused-jit cache signature so replays hit
             _costs.note("trainer_fused", (id(self), sig), fn,
-                        (w_raws, m_raws, g_raws, s_raws, lr_v, wd_v, t_v))
+                        (w_raws, m_raws, g_raws, s_raws, lr_v, wd_v, t_v),
+                        site="mxnet_tpu.gluon.trainer:"
+                             "Trainer._try_fused_update")
         # first dispatch per signature pays trace+compile synchronously;
         # replays are a single async dispatch
         try:

@@ -27,6 +27,13 @@ __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "CSVIter",
            "LibSVMIter", "ResizeIter", "PrefetchingIter",
            "ImageRecordIter", "MNISTIter"]
 
+#: reviewed signature budget (mxlint T15): the jitted numeric-finish
+#: kernel compiles once per (batch avals, dtype) of the pipeline's
+#: output spec — fixed at iterator construction, so steady state is 1
+__compile_signatures__ = {
+    "io_numeric_finish": "1 per (batch avals, dtype) per iterator",
+}
+
 
 class DataDesc(namedtuple("DataDesc", ["name", "shape"])):
     """Shape/type descriptor (reference ``mx.io.DataDesc``)."""

@@ -23,6 +23,14 @@ import re
 #: "Used 15.78G of 15.75G hbm" RESOURCE_EXHAUSTED on overflow).
 TPU_BUDGET_GIB = 15.75
 
+#: reviewed signature budget (mxlint T15): checkpoint_wrap adds no
+#: signatures of its own — the remat-wrapped callable compiles under the
+#: wrapped site's budget, one program per (layer avals, remat policy)
+__compile_signatures__ = {
+    "remat_forward": "1 per (wrapped layer avals, remat policy); "
+                     "tracks the wrapped site's budget",
+}
+
 LAYER0_PREFIX = "model.layers.0."
 
 

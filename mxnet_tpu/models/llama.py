@@ -76,6 +76,13 @@ class LlamaConfig:
             raise MXNetError("num_kv_heads must evenly divide num_heads")
         self.head_dim = hidden_size // num_heads
 
+#: reviewed signature budget (mxlint T15): the scanned-layer machinery
+#: compiles one stacked-layer program per (model config, batch avals,
+#: remat policy) — layer homogeneity is the point of the scan, so the
+#: per-layer axis contributes no signatures
+__compile_signatures__ = {
+    "llama_scan": "1 per (model config, batch avals, remat policy)",
+}
 
 LLAMA_CONFIGS = {
     "llama3_8b": dict(hidden_size=4096, intermediate_size=14336,

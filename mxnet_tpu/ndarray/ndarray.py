@@ -374,6 +374,17 @@ class NDArray:
                 return _sp.dispatch_binary(canon, jf, other, self)
             return _sp.dispatch_binary(canon, jf, self, other)
         c = other
+        if type(c) is int and name not in ("pow", "rpow") and \
+                np.dtype(self._raw.dtype).kind == "f":
+            # a python int baked into the deferred closure is keyed by
+            # VALUE — one compiled segment per distinct constant (the
+            # ``x / batch_size`` retrace trap); as a float the engine
+            # lifts it to a runtime scalar and every value replays one
+            # segment.  Exact for float arrays (same weak promotion);
+            # pow is excluded: integer exponents lower to repeated
+            # multiplication, float ones to exp/log whose negative-base
+            # results differ.
+            c = float(c)
 
         if reflected:
             return apply_op(lambda a: jf(c, a), self, name=name)

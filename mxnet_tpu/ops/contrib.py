@@ -461,6 +461,59 @@ def roi_pooling(data, rois, pooled_size, spatial_scale=1.0, **kwargs):
 _export(roi_pooling, aliases=("ROIPooling",))
 
 
+def _proposal_image(cp, bp, info, banchors, base, a, pre_n, post_n,
+                    threshold, min_size):
+    """Single-image RPN proposal kernel, vmapped over the batch by
+    ``proposal``.  Module-level (stable identity) with every config
+    value an explicit argument, so the per-call closure the op wrapper
+    builds is hashable and the engine replays ONE compiled segment
+    across calls instead of re-tracing each one; ``base``/``threshold``
+    are plain floats in that closure and get lifted to runtime scalars
+    rather than baked in."""
+    banchors = jnp.asarray(banchors, jnp.float32)  # (A, 4)
+    h, w = cp.shape[1], cp.shape[2]
+    shift_x = jnp.arange(w, dtype=jnp.float32) * base
+    shift_y = jnp.arange(h, dtype=jnp.float32) * base
+    sy, sx = jnp.meshgrid(shift_y, shift_x, indexing="ij")
+    shifts = jnp.stack([sx, sy, sx, sy], axis=-1)  # (H, W, 4)
+    anchors = (shifts[:, :, None, :] + banchors[None, None]
+               ).reshape(-1, 4)  # (H*W*A, 4)
+    scores = cp[a:].transpose(1, 2, 0).reshape(-1)  # fg scores
+    deltas = bp.transpose(1, 2, 0).reshape(-1, 4)
+    ax = (anchors[:, 0] + anchors[:, 2]) / 2
+    ay = (anchors[:, 1] + anchors[:, 3]) / 2
+    aw = anchors[:, 2] - anchors[:, 0] + 1
+    ah = anchors[:, 3] - anchors[:, 1] + 1
+    cx_ = deltas[:, 0] * aw + ax
+    cy_ = deltas[:, 1] * ah + ay
+    pw_ = jnp.exp(jnp.clip(deltas[:, 2], -10, 10)) * aw
+    ph_ = jnp.exp(jnp.clip(deltas[:, 3], -10, 10)) * ah
+    x1 = jnp.clip(cx_ - (pw_ - 1) / 2, 0, info[1] - 1)
+    y1 = jnp.clip(cy_ - (ph_ - 1) / 2, 0, info[0] - 1)
+    x2 = jnp.clip(cx_ + (pw_ - 1) / 2, 0, info[1] - 1)
+    y2 = jnp.clip(cy_ + (ph_ - 1) / 2, 0, info[0] - 1)
+    msz = min_size * info[2]
+    valid = ((x2 - x1 + 1 >= msz) & (y2 - y1 + 1 >= msz))
+    n = scores.shape[0]
+    pre = min(pre_n, n) if pre_n > 0 else n
+    order = jnp.argsort(jnp.where(valid, -scores, jnp.inf))[:pre]
+    boxes = jnp.stack([x1, y1, x2, y2], axis=-1)[order]
+    sc = scores[order]
+    vs = valid[order]
+    keep = _nms_keep(boxes, sc, vs, jnp.zeros((pre,)), threshold, True)
+    comp = jnp.argsort(~keep, stable=True)[:post_n]
+    out_boxes = jnp.where(keep[comp][:, None], boxes[comp], 0.0)
+    out_sc = jnp.where(keep[comp], sc[comp], 0.0)
+    # fixed-shape contract: always exactly post_n rows per image
+    deficit = post_n - out_boxes.shape[0]
+    if deficit > 0:
+        out_boxes = jnp.concatenate(
+            [out_boxes, jnp.zeros((deficit, 4), out_boxes.dtype)])
+        out_sc = jnp.concatenate(
+            [out_sc, jnp.zeros((deficit,), out_sc.dtype)])
+    return out_boxes, out_sc
+
+
 def proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
              rpn_post_nms_top_n=300, threshold=0.7, rpn_min_size=16,
              scales=(4, 8, 16, 32), ratios=(0.5, 1, 2), feature_stride=16,
@@ -474,7 +527,10 @@ def proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
     a = len(scales) * len(ratios)
     base = float(feature_stride)
 
-    # base anchors centered on (stride-1)/2 — standard RPN enumeration
+    # base anchors centered on (stride-1)/2 — standard RPN enumeration.
+    # Kept as a nested float tuple: the deferred-dispatch closure below
+    # must stay hashable for the engine to key its segment, and a tuple
+    # constant-folds into the trace exactly like the array it becomes.
     banchors = []
     cx = cy = (base - 1) / 2
     for r in ratios:
@@ -483,55 +539,18 @@ def proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
         hs = np.round(ws * r)
         for s in scales:
             w, h = ws * s, hs * s
-            banchors.append([cx - (w - 1) / 2, cy - (h - 1) / 2,
-                             cx + (w - 1) / 2, cy + (h - 1) / 2])
-    banchors = jnp.asarray(banchors, jnp.float32)  # (A, 4)
-
-    def _one(cp, bp, info):
-        h, w = cp.shape[1], cp.shape[2]
-        shift_x = jnp.arange(w, dtype=jnp.float32) * base
-        shift_y = jnp.arange(h, dtype=jnp.float32) * base
-        sy, sx = jnp.meshgrid(shift_y, shift_x, indexing="ij")
-        shifts = jnp.stack([sx, sy, sx, sy], axis=-1)  # (H, W, 4)
-        anchors = (shifts[:, :, None, :] + banchors[None, None]
-                   ).reshape(-1, 4)  # (H*W*A, 4)
-        scores = cp[a:].transpose(1, 2, 0).reshape(-1)  # fg scores
-        deltas = bp.transpose(1, 2, 0).reshape(-1, 4)
-        ax = (anchors[:, 0] + anchors[:, 2]) / 2
-        ay = (anchors[:, 1] + anchors[:, 3]) / 2
-        aw = anchors[:, 2] - anchors[:, 0] + 1
-        ah = anchors[:, 3] - anchors[:, 1] + 1
-        cx_ = deltas[:, 0] * aw + ax
-        cy_ = deltas[:, 1] * ah + ay
-        pw_ = jnp.exp(jnp.clip(deltas[:, 2], -10, 10)) * aw
-        ph_ = jnp.exp(jnp.clip(deltas[:, 3], -10, 10)) * ah
-        x1 = jnp.clip(cx_ - (pw_ - 1) / 2, 0, info[1] - 1)
-        y1 = jnp.clip(cy_ - (ph_ - 1) / 2, 0, info[0] - 1)
-        x2 = jnp.clip(cx_ + (pw_ - 1) / 2, 0, info[1] - 1)
-        y2 = jnp.clip(cy_ + (ph_ - 1) / 2, 0, info[0] - 1)
-        msz = rpn_min_size * info[2]
-        valid = ((x2 - x1 + 1 >= msz) & (y2 - y1 + 1 >= msz))
-        n = scores.shape[0]
-        pre = min(rpn_pre_nms_top_n, n) if rpn_pre_nms_top_n > 0 else n
-        order = jnp.argsort(jnp.where(valid, -scores, jnp.inf))[:pre]
-        boxes = jnp.stack([x1, y1, x2, y2], axis=-1)[order]
-        sc = scores[order]
-        vs = valid[order]
-        keep = _nms_keep(boxes, sc, vs, jnp.zeros((pre,)), threshold, True)
-        comp = jnp.argsort(~keep, stable=True)[:rpn_post_nms_top_n]
-        out_boxes = jnp.where(keep[comp][:, None], boxes[comp], 0.0)
-        out_sc = jnp.where(keep[comp], sc[comp], 0.0)
-        # fixed-shape contract: always exactly post_n rows per image
-        deficit = rpn_post_nms_top_n - out_boxes.shape[0]
-        if deficit > 0:
-            out_boxes = jnp.concatenate(
-                [out_boxes, jnp.zeros((deficit, 4), out_boxes.dtype)])
-            out_sc = jnp.concatenate(
-                [out_sc, jnp.zeros((deficit,), out_sc.dtype)])
-        return out_boxes, out_sc
+            banchors.append((float(cx - (w - 1) / 2),
+                             float(cy - (h - 1) / 2),
+                             float(cx + (w - 1) / 2),
+                             float(cy + (h - 1) / 2)))
+    banchors = tuple(banchors)
 
     def _f(cp, bp, info):
-        boxes, sc = jax.vmap(_one)(cp, bp, info)
+        boxes, sc = jax.vmap(
+            lambda c, b_, i_: _proposal_image(
+                c, b_, i_, banchors, base, a, rpn_pre_nms_top_n,
+                rpn_post_nms_top_n, threshold, rpn_min_size))(
+            cp, bp, info)
         b = cp.shape[0]
         bidx = jnp.repeat(jnp.arange(b, dtype=jnp.float32),
                           boxes.shape[1])[:, None]

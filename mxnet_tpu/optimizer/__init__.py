@@ -31,6 +31,15 @@ __all__ = ["Optimizer", "SGD", "NAG", "Adam", "AdamW", "LAMB", "RMSProp",
            "AdaGrad", "AdaDelta", "Ftrl", "Signum", "SignSGD", "LARS",
            "create", "register", "Test", "Updater", "get_updater"]
 
+#: reviewed signature budget (mxlint T15): the per-param jitted update
+#: compiles one program per (optimizer type, precision path, weight
+#: shape, dtype) — parameter count does not grow signatures, distinct
+#: shapes do
+__compile_signatures__ = {
+    "optimizer_update": "2 per (optimizer, weight shape, dtype): "
+                        "sp + mp paths",
+}
+
 #: donation-sanitizer site tag for the per-param jitted update
 _PER_PARAM_SITE = ("Optimizer._update_impl (mxnet_tpu/optimizer, %s "
                    "per-param update, donate_argnums=(0, 2))")
@@ -242,7 +251,8 @@ class Optimizer:
                 _costs.note(
                     "optimizer_update",
                     (id(self), "mp", weight.shape, str(weight.dtype)),
-                    step, (master._data, grad._data, states, lr, wd, t))
+                    step, (master._data, grad._data, states, lr, wd, t),
+                    site="mxnet_tpu.optimizer:Optimizer.update")
             new_w, new_states = step(master._data, grad._data, states,
                                      lr, wd, t)
             if _san._enabled:
@@ -264,7 +274,8 @@ class Optimizer:
                 _costs.note(
                     "optimizer_update",
                     (id(self), "sp", weight.shape, str(weight.dtype)),
-                    step, (weight._data, grad._data, states, lr, wd, t))
+                    step, (weight._data, grad._data, states, lr, wd, t),
+                    site="mxnet_tpu.optimizer:Optimizer.update")
             new_w, new_states = step(weight._data, grad._data, states,
                                      lr, wd, t)
             if _san._enabled:

@@ -401,6 +401,13 @@ def process_gather_hostvec(vec):
 
 _PROCESS_PSUM_CACHE = {}
 
+#: reviewed signature budget (mxlint T15): the cached process-psum
+#: program compiles once per (mesh, vector length) — the cache above is
+#: keyed exactly on that, so steady state is its size
+__compile_signatures__ = {
+    "process_psum": "1 per (mesh, hostvec length)",
+}
+
 
 def _process_psum(n):
     """(mesh, jitted psum) over a one-device-per-process 'dp' axis,

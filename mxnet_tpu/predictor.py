@@ -31,6 +31,13 @@ from . import ndarray as nd
 
 __all__ = ["Predictor", "create"]
 
+#: reviewed signature budget (mxlint T15): forward compiles one CachedOp
+#: graph per input-shape bucket; with a BucketPolicy attached the ceiling
+#: is len(policy.signatures()), and the serving bench gates on it
+__compile_signatures__ = {
+    "predictor": "len(BucketPolicy.signatures()) per model",
+}
+
 
 class Predictor:
     """Bound inference session (reference ``MXPredCreate``).
@@ -261,6 +268,15 @@ class Predictor:
             return
         self._seen_signatures = n
         telemetry.count("predictor.compile")
+        from .telemetry import retrace as _retrace
+
+        if _retrace._enabled and cop._graphs:
+            # registered compile site: the newest CachedOp cache key is
+            # the signature this forward just compiled
+            _retrace.observe(
+                "predictor", id(self),
+                _retrace.cachedop_components(next(reversed(cop._graphs))),
+                site="mxnet_tpu.predictor:Predictor.forward")
         if _costs._enabled and cop._graphs:
             # dict is insertion-ordered: the newest graph is the one this
             # forward just compiled
@@ -272,7 +288,8 @@ class Predictor:
                 in_raws = [a._data for a in args]
                 _costs.note("predictor", (id(self), n), g._fwd,
                             (p_raws, in_raws, jax.random.PRNGKey(0)),
-                            attribute=False)
+                            attribute=False,
+                            site="mxnet_tpu.predictor:Predictor.forward")
             except Exception:
                 pass  # registry entries are best-effort observability
 

@@ -47,7 +47,23 @@ __all__ = ["DonatedBufferError", "is_enabled", "enable", "disable",
            "donate", "site_of", "check", "reset",
            "wrap_lock", "locks_enabled", "enable_locks", "disable_locks",
            "reset_locks", "lock_order_edges", "lock_order_violations",
-           "held_blocking_events", "set_trace_hook"]
+           "held_blocking_events", "set_trace_hook",
+           "retrace", "RetraceError"]
+
+
+def __getattr__(name):
+    # the recompile sanitizer (MXNET_SANITIZE_RETRACE) lives with the
+    # other one-boolean-null-path tiers in telemetry/retrace.py;
+    # re-exported here so every runtime sanitizer is reachable from one
+    # module.  Resolved lazily: telemetry.fleet wraps its lock through
+    # THIS module at import time, so an eager import would be circular.
+    if name == "retrace":
+        from .telemetry import retrace as _retrace
+        return _retrace
+    if name == "RetraceError":
+        from .telemetry.retrace import RetraceError as _err
+        return _err
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
 class DonatedBufferError(MXNetError):

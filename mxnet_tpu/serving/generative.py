@@ -57,6 +57,7 @@ import numpy as np
 
 from .. import telemetry
 from ..telemetry import numerics as _numerics
+from ..telemetry import retrace as _retrace
 from ..telemetry import tracing
 from ..base import MXNetError
 from .bucketing import BucketPolicy, pad_batch
@@ -65,6 +66,14 @@ from .protocol import ServerClosedError
 from .scheduler import _materialize
 
 __all__ = ["LlamaServingEngine", "GenerativeScheduler"]
+
+#: reviewed signature budget (mxlint T15): one decode-step program per
+#: (batch bucket, cache length bucket) plus one prefill program per
+#: prompt bucket — the bucket tables are fixed at engine construction
+__compile_signatures__ = {
+    "serving_step": "1 per (batch bucket, cache bucket); prefill adds "
+                    "1 per prompt bucket",
+}
 
 #: matmul weights that the int8 option quantizes (per-output-channel);
 #: embeddings and the RMSNorm scales stay in the load dtype
@@ -337,6 +346,15 @@ class LlamaServingEngine:
         if key not in self._signatures:
             self._signatures.add(key)
             telemetry.count("serving.engine_compile")
+            if _retrace._enabled:
+                # registered compile site, one per program (prefill keys
+                # per bucket; a post-warmup unwarmed bucket is a retrace)
+                comps = {"batch": key[1], "prompt_len": key[2]} \
+                    if len(key) == 3 else {"program": key[0]}
+                _retrace.observe(
+                    "serving_" + str(key[0]), id(self), comps,
+                    site="mxnet_tpu.serving.generative:"
+                         "LlamaServingEngine (%s)" % (key[0],))
 
     def compiled_signatures(self):
         """Every (program, *bucket) shape this engine has compiled."""

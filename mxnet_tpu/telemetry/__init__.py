@@ -68,17 +68,19 @@ from . import tracing  # noqa: F401  (request-scoped tracing submodule)
 from . import promtext  # noqa: F401  (shared Prometheus text renderer)
 from . import fleet as _fleet_mod  # fleet-wide observability submodule
 from . import numerics as _numerics_mod  # in-compile tensor-stats tier
+from . import retrace as _retrace_mod  # recompile sanitizer (r18)
 # ``enable(fleet=...)``/``enable(numerics=...)`` take keywords of the
 # same names, so the modules travel under private aliases in this file
 fleet = _fleet_mod
 numerics = _numerics_mod
+retrace = _retrace_mod
 
 __all__ = ["enable", "disable", "is_enabled", "span", "count", "gauge",
            "hist", "hist_summary", "hists", "emit",
            "step", "step_begin", "step_end", "counters", "gauges",
            "phases", "reset", "current_span", "JsonlSink", "read_jsonl",
            "costs", "memwatch", "tracing", "promtext", "fleet",
-           "numerics"]
+           "numerics", "retrace"]
 
 # -- state -------------------------------------------------------------------
 # _enabled is read unlocked on every recorder's fast path; it is only
@@ -462,6 +464,10 @@ def step_end(examples=None, **extra):
         # (at the stride) the fleet exchange.  Never raises except the
         # opt-in WatchdogHalt, which surfaces here at a step boundary.
         _fleet_mod.on_step_record(record)
+    if _retrace_mod._enabled:
+        # counts steps toward a declared warmup_steps warmup — pure
+        # counter arithmetic, never a sync
+        _retrace_mod.on_step()
     for s in sinks:
         s.emit(record)
     return record
@@ -497,7 +503,7 @@ def step(examples=None, **extra):
 # -- lifecycle ---------------------------------------------------------------
 
 def enable(jsonl_path=None, append=False, memory=True, cost=True,
-           trace=False, fleet=False, numerics=False):
+           trace=False, fleet=False, numerics=False, retrace=False):
     """Turn recording on.  ``jsonl_path`` attaches a structured-log sink
     writing one JSON line per step record (truncates unless ``append``).
     Idempotent: re-enabling resets counters and swaps sinks.  ``memory``
@@ -515,7 +521,11 @@ def enable(jsonl_path=None, append=False, memory=True, cost=True,
     enables the in-compile tensor-stats tier (per-layer norms, nan/inf
     provenance on step records) at its env-default stride — call
     ``telemetry.numerics.enable(stride=...)`` directly for tuning;
-    ``MXNET_NUMERICS=1`` switches it on independently."""
+    ``MXNET_NUMERICS=1`` switches it on independently.
+    ``retrace=True`` (or ``"warn"``/``"raise"``) enables the recompile
+    sanitizer in that mode — call ``telemetry.retrace.enable(...)``
+    directly for a warmup-step budget; ``MXNET_SANITIZE_RETRACE=1``
+    switches it on independently."""
     global _enabled
     with _lock:
         _reset_locked()
@@ -535,6 +545,9 @@ def enable(jsonl_path=None, append=False, memory=True, cost=True,
         _fleet_mod.enable()
     if numerics:
         _numerics_mod.enable()
+    if retrace:
+        _retrace_mod.enable(mode=retrace if isinstance(retrace, str)
+                            else "warn")
 
 
 def disable():

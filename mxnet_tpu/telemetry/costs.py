@@ -69,13 +69,14 @@ class _Artifact:
     __slots__ = ("kind", "key", "flops", "bytes_accessed", "output_bytes",
                  "temp_bytes", "argument_bytes", "alias_bytes",
                  "generated_code_bytes", "executions", "error",
-                 "mesh_shape", "remat")
+                 "mesh_shape", "remat", "site")
 
-    def __init__(self, kind, key, remat=None):
+    def __init__(self, kind, key, remat=None, site=None):
         self.kind = kind
         self.key = key
         self.mesh_shape = _current_mesh_shape()
         self.remat = remat
+        self.site = site
         self.flops = 0.0
         self.bytes_accessed = 0.0
         self.output_bytes = 0
@@ -101,6 +102,7 @@ class _Artifact:
             "error": self.error,
             "mesh_shape": self.mesh_shape,
             "remat": self.remat,
+            "site": self.site,
         }
 
 
@@ -122,12 +124,12 @@ def _current_mesh_shape():
         return None
 
 
-def _analyze(kind, key, jfn, args, remat=None):
+def _analyze(kind, key, jfn, args, remat=None, site=None):
     """lower+compile at the concrete args' avals and harvest the
     analyses.  jax caches lowering/compilation per (fn, avals), so when
     the site just executed the same signature this is cheap; either way
     it is paid once per registry key."""
-    art = _Artifact(kind, key, remat=remat)
+    art = _Artifact(kind, key, remat=remat, site=site)
     try:
         compiled = jfn.lower(*args).compile()
     except Exception as e:  # un-lowerable args / backend quirks
@@ -155,7 +157,7 @@ def _analyze(kind, key, jfn, args, remat=None):
     return art
 
 
-def note(kind, key, jfn, args, attribute=True, remat=None):
+def note(kind, key, jfn, args, attribute=True, remat=None, site=None):
     """Register-or-attribute one execution of a compiled artifact.
 
     ``key`` must be the site's own cache-signature (hashable); ``jfn``
@@ -169,8 +171,14 @@ def note(kind, key, jfn, args, attribute=True, remat=None):
     registry without counting an execution or attributing flops — for
     wrapper sites (e.g. the Predictor) whose inner compile site already
     attributes per-execution, so dump()/top_artifacts() see the wrapper
-    kind but model_flops is not double-counted.  Returns the registry
-    entry (None when disabled or the key is unhashable)."""
+    kind but model_flops is not double-counted.  ``site`` stamps the
+    module-qualified compile-site identity (e.g.
+    ``"mxnet_tpu.engine:_Segment._execute_locked"``) onto the artifact so
+    registry dumps join against retrace-sanitizer records and the
+    T15 signature-budget lint; omit it and the field stays None
+    (pre-existing dumps without the field still parse — consumers
+    ``.get("site")``).  Returns the registry entry (None when disabled
+    or the key is unhashable)."""
     if not _enabled:
         return None
     rk = (kind, key)
@@ -179,7 +187,7 @@ def note(kind, key, jfn, args, attribute=True, remat=None):
     except TypeError:
         return None
     if art is None:
-        art = _analyze(kind, key, jfn, args, remat=remat)
+        art = _analyze(kind, key, jfn, args, remat=remat, site=site)
         with _lock:
             existing = _registry.get(rk)
             if existing is None:

@@ -223,6 +223,32 @@ def test_sharded_step_bench_emits_artifact(tmp_path):
         assert all(rec["acceptance"][model].values())
 
 
+@pytest.mark.slow
+def test_dispatch_bench_retrace_sanitized_lane(tmp_path):
+    """benchmark/dispatch_overhead.py under MXNET_SANITIZE_RETRACE=raise:
+    every compile site is observed, each mode declares warmup over
+    before its timed window, and the run completes — i.e. zero
+    post-warmup retraces anywhere on the dispatch paths, enforced by the
+    runtime sanitizer on top of the shared compile gates."""
+    out = tmp_path / "dispatch.json"
+    env = dict(os.environ)
+    env.update(JAX_PLATFORMS="cpu", BENCH_CHAIN_ITERS="2",
+               BENCH_MLP_ITERS="2", BENCH_REPEATS="1",
+               MXNET_SANITIZE_RETRACE="raise",
+               BENCH_DISPATCH_OUT=str(out))
+    env.pop("XLA_FLAGS", None)
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "benchmark",
+                                      "dispatch_overhead.py")],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=600)
+    assert "RetraceError" not in r.stderr, r.stderr[-2000:]
+    assert r.returncode == 0, r.stderr[-2000:]
+    rec = json.loads(out.read_text())
+    # the shared gate ran on every mode: caches report zero steady misses
+    assert rec["segment_cache"]["miss"] > 0       # warmup compiles exist
+    assert rec["chain64_usec_per_op"]["hybridized"] > 0
+
+
 def test_race_harness_report_is_green():
     """python -m tools.race --report: the deterministic-interleaving
     harness's self-check — every built-in scenario replays

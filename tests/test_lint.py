@@ -382,6 +382,77 @@ def test_t12_flags_thread_lifecycle_hazards():
                    for v in vs)
 
 
+def test_t13_flags_retrace_hazards():
+    vs = _rule(_analyze("t13_retrace.py"), "T13")
+    sev = {v.context: v.severity for v in vs}
+    # a. baked python scalar in a traced closure
+    assert sev.get("make_scaled_step.step") == "error"
+    assert any(v.context == "make_scaled_step.step" and "scale" in v.message
+               and "float(optzr.rescale_grad)" in v.message for v in vs)
+    # b. shape / ndim branches inside hybrid_forward (one of each)
+    pad = [v for v in vs if v.context == "PadBlock.hybrid_forward"]
+    assert {m.split(" on ")[1].split(" ")[0] for m in
+            (v.message for v in pad)} == {"shape", "ndim"}
+    assert all(v.severity == "warning" for v in pad)
+    # c./d. formatted-string and dict-ordered compile keys
+    assert sev.get("formatted_key") == "warning"
+    assert sev.get("attr_key") == "warning"
+    # e. engine-lifted float cells are exempt, int cells are not
+    assert sev.get("scalar_op_int_capture.<lambda>") == "error"
+    assert len(vs) == 6
+    # negatives: keyed bake, runtime-arg lift, canonical keys, float lift
+    for ok in ("make_keyed_step", "make_lifted_step", "tuple_key",
+               "attr_key_sorted", "scalar_op_lifted"):
+        assert not any(ok in v.context for v in vs), ok
+
+
+def test_t14_flags_compile_site_churn():
+    vs = _rule(_analyze("t14_compile_sites.py"), "T14")
+    msg = {v.context: v.message for v in vs}
+    assert "constructed and immediately invoked" in msg["per_call_jit"]
+    assert "inside a loop" in msg["per_item_grid"]
+    assert "hybridize" in msg["Stack.rewrap"]
+    assert all(v.severity == "error" for v in vs)
+    assert len(vs) == 3
+    # negatives: sanctioned build defs, __init__ grids, warm* helpers
+    assert "_build_grid" not in msg
+    assert "Stack.__init__" not in msg
+    assert "Stack.warm_modes" not in msg
+
+
+def test_t15_budget_declaration_checks():
+    vs = _rule(_analyze("t15_budget.py"), "T15")
+    msgs = [v.message for v in vs]
+    assert any("'unbudgeted' is registered" in m and "missing" in m
+               for m in msgs)
+    assert any("'stale_kind'" in m and "never registers" in m
+               for m in msgs)
+    assert any("'bad_budget' must be a positive int" in m for m in msgs)
+    # the well-formed formula entry raises nothing
+    assert not any("fused_step" in m for m in msgs)
+    assert len(vs) == 3
+
+    # a missing declaration on a site-owning module is an error...
+    vs = _rule(_analyze("t15_budget_missing.py"), "T15")
+    assert [v.severity for v in vs] == ["error"]
+    assert "no __compile_signatures__" in vs[0].message
+    # ...and the inline one-site annotation form satisfies it
+    assert _rule(_analyze("t15_budget_inline.py"), "T15") == []
+
+
+def test_compile_tier_clean_on_real_compile_owners():
+    # every module that stores a jit or registers a costs kind now either
+    # declares its __compile_signatures__ budget or carries a reviewed
+    # waiver; the five remaining T13s are waived with whys in baseline
+    vs = analyze_paths(
+        ["mxnet_tpu/engine.py", "mxnet_tpu/gluon/block.py",
+         "mxnet_tpu/gluon/step_fusion.py", "mxnet_tpu/gluon/trainer.py",
+         "mxnet_tpu/optimizer/__init__.py", "mxnet_tpu/predictor.py",
+         "mxnet_tpu/serving/generative.py", "mxnet_tpu/io/__init__.py"],
+        REPO, rules={"T14", "T15"})
+    assert vs == [], [v.to_dict() for v in vs]
+
+
 def test_concurrency_tier_clean_on_real_threaded_modules():
     # the instrumented runtime (serving lanes, checkpoint writer, data
     # plane, parameter server) passes its own tier outright; engine.py
@@ -452,7 +523,7 @@ def test_cli_fails_on_seeded_fixtures_with_json():
     payload = json.loads(r.stdout)
     by_rule = payload["summary"]["by_rule"]
     for rule in ("T1", "T2", "T3", "T4", "T5", "T6", "T7", "T8", "T9",
-                 "T10", "T11", "T12"):
+                 "T10", "T11", "T12", "T13", "T14", "T15"):
         assert by_rule.get(rule, 0) > 0, f"{rule} missing from {by_rule}"
     assert "cache" in payload["summary"]
 
@@ -467,7 +538,7 @@ def test_cli_sarif_format():
     assert run["tool"]["driver"]["name"] == "mxlint"
     rule_ids = {rl["id"] for rl in run["tool"]["driver"]["rules"]}
     assert {"T1", "T2", "T3", "T4", "T5", "T6", "T7", "T8", "T9",
-            "T10", "T11", "T12"} <= rule_ids
+            "T10", "T11", "T12", "T13", "T14", "T15"} <= rule_ids
     results = run["results"]
     assert results and all(r_["ruleId"] in rule_ids for r_ in results)
     loc = results[0]["locations"][0]["physicalLocation"]
@@ -489,6 +560,17 @@ def test_cli_sarif_marks_waived_as_unchanged(tmp_path):
     results = json.loads(r.stdout)["runs"][0]["results"]
     assert results
     assert all(r_.get("baselineState") == "unchanged" for r_ in results)
+
+
+def test_cli_changed_mode():
+    # no changed .py files under a docs-only root: clean no-op exit
+    r = _run_cli("--changed", "HEAD", "docs")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "no changed .py files" in r.stdout
+    # a partial file set cannot regenerate the full-tree baseline
+    r = _run_cli("--changed", "HEAD", "--update-baseline")
+    assert r.returncode == 2
+    assert "full tree" in r.stderr
 
 
 # --- per-file analysis cache -------------------------------------------------

@@ -1,0 +1,493 @@
+"""Runtime recompile sanitizer: warmup semantics, warn/raise modes,
+structural signature diffing, the pinned injected-retrace vectors at the
+instrumented compile sites (CachedOp aval divergence, trainer fused
+closure attr), env wiring, the dp2 CPU-mesh serving lane staying
+violation-free under raise, the disabled-path cost bound, and the
+provenance reporter + site-stamped cost registry."""
+import json
+import os
+import subprocess
+import sys
+import time
+import warnings
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd as ag
+from mxnet_tpu import gluon, nd, serving, telemetry
+from mxnet_tpu.telemetry import retrace
+from mxnet_tpu.telemetry.sinks import ListSink
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_retrace():
+    retrace.disable()
+    retrace.reset()
+    yield
+    retrace.disable()
+    retrace.reset()
+    telemetry.disable()
+    telemetry.reset()
+
+
+# --- structural differ -------------------------------------------------------
+
+def test_diff_names_the_exact_aval_field():
+    old = {"args": (((2, 8), "float32", False),), "mesh": None}
+    new = {"args": (((3, 8), "float32", False),), "mesh": None}
+    assert retrace.diff_components(old, new) == \
+        ["args[0].shape: (2, 8) -> (3, 8)"]
+    new = {"args": (((2, 8), "bfloat16", False),), "mesh": None}
+    assert retrace.diff_components(old, new) == \
+        ["args[0].dtype: 'float32' -> 'bfloat16'"]
+    new = {"args": (((2, 8), "float32", True),), "mesh": None}
+    assert retrace.diff_components(old, new) == \
+        ["args[0].weak_type: False -> True"]
+
+
+def test_diff_scalar_attrs_and_absent_keys():
+    d = retrace.diff_components({"rescale_grad": 1.0, "mesh": None},
+                                {"rescale_grad": 0.5, "mesh": "dp2"})
+    assert "rescale_grad: 1.0 -> 0.5" in d
+    assert "mesh: None -> 'dp2'" in d
+    d = retrace.diff_components({"a": 1}, {"a": 1, "b": 2})
+    assert d == ["b: <absent> -> 2"]
+
+
+def test_diff_canonicalizes_json_round_trip():
+    # JSONL round-trips turn tuples into lists; the differ must see them
+    # as structurally equal
+    old = {"args": (((2, 8), "float32", False),)}
+    new = {"args": [[[2, 8], "float32", False]]}
+    assert retrace.diff_components(old, new) == []
+
+
+def test_cachedop_components_decomposition():
+    sig = ((((2, 8), "float32", False),), True, "cpu", (), None, "n0")
+    comps = retrace.cachedop_components(sig)
+    assert comps == {"args": sig[0], "training": True, "platform": "cpu",
+                     "params": (), "mesh": None, "numerics": "n0"}
+    assert retrace.cachedop_components("odd") == {"signature": "odd"}
+
+
+# --- warmup semantics --------------------------------------------------------
+
+def test_first_signature_is_never_a_violation():
+    retrace.enable("raise")
+    retrace.warm()
+    assert retrace.observe("k", 1, {"a": 1}, site="s") is None
+    assert retrace.violations() == []
+    assert retrace.sites() == {("k", 1): 1}
+
+
+def test_prewarm_signatures_are_baselines():
+    retrace.enable("raise")
+    retrace.observe("k", 1, {"a": 1}, site="s")
+    retrace.observe("k", 1, {"a": 2}, site="s")   # pre-warm: silent
+    assert retrace.violations() == []
+    retrace.warm()
+    with pytest.raises(retrace.RetraceError):
+        retrace.observe("k", 1, {"a": 3}, site="s")
+    assert len(retrace.violations()) == 1
+
+
+def test_replayed_signature_is_not_new():
+    retrace.enable("raise")
+    retrace.warm()
+    retrace.observe("k", 1, {"a": 1}, site="s")
+    # a concurrent miss racing a replay re-observes the same components
+    assert retrace.observe("k", 1, {"a": 1}, site="s") is None
+    assert retrace.sites() == {("k", 1): 1}
+    assert retrace.violations() == []
+
+
+def test_violation_diffs_against_nearest_prior_signature():
+    retrace.enable("warn")
+    retrace.observe("k", 1, {"a": 1, "b": 1}, site="s")
+    retrace.observe("k", 1, {"a": 9, "b": 9}, site="s")
+    retrace.warm()
+    with pytest.warns(RuntimeWarning):
+        retrace.observe("k", 1, {"a": 1, "b": 2}, site="s")
+    (v,) = retrace.violations()
+    # one field away from signature #0, two away from #1
+    assert v["against"]["signature_index"] == 0
+    assert v["diff"] == ["b: 1 -> 2"]
+    assert v["signature_index"] == 2
+
+
+def test_warn_mode_warns_raise_mode_raises():
+    retrace.enable("warn")
+    retrace.warm()
+    retrace.observe("k", 1, {"a": 1}, site="mod:site")
+    with pytest.warns(RuntimeWarning, match="retrace at mod:site"):
+        retrace.observe("k", 1, {"a": 2}, site="mod:site")
+    retrace.enable("raise")
+    with pytest.raises(retrace.RetraceError) as ei:
+        retrace.observe("k", 1, {"a": 3}, site="mod:site")
+    msg = str(ei.value)
+    assert "retrace at mod:site" in msg
+    assert "a: " in msg and "-> 3" in msg
+    assert "test_retrace.py" in msg         # python provenance both ways
+    assert "diverged from signature #" in msg
+
+
+def test_warmup_steps_counted_at_telemetry_step_boundaries():
+    retrace.enable("warn", warmup_steps=2)
+    telemetry.enable()
+    assert not retrace.is_warm()
+    with telemetry.step():
+        pass
+    assert not retrace.is_warm()
+    with telemetry.step():
+        pass
+    assert retrace.is_warm()
+
+
+def test_reset_keeps_mode_but_forgets_history():
+    retrace.enable("raise")
+    retrace.warm()
+    retrace.observe("k", 1, {"a": 1}, site="s")
+    retrace.reset()
+    assert retrace.is_enabled() and not retrace.is_warm()
+    assert retrace.sites() == {}
+    # the same site starts over: first signature, no violation
+    retrace.warm()
+    assert retrace.observe("k", 1, {"a": 2}, site="s") is None
+
+
+# --- injected retraces at the instrumented sites (pinned vectors) -----------
+
+def test_injected_cachedop_retrace_names_site_and_aval():
+    """The acceptance vector: an injected batch-shape change after
+    warmup raises a RetraceError naming the CachedOp compile site AND
+    the exact diverging aval component."""
+    retrace.enable("raise")
+    net = gluon.nn.Dense(4)
+    net.initialize()
+    net.hybridize()
+    net(nd.ones((2, 8))).wait_to_read()       # baseline signature
+    retrace.warm()
+    net(nd.ones((2, 8))).wait_to_read()       # replay: no new compile
+    with pytest.raises(retrace.RetraceError) as ei:
+        net(nd.ones((3, 8)))
+    msg = str(ei.value)
+    assert "mxnet_tpu.gluon.block:CachedOp.__call__" in msg
+    assert "args[0].shape: (2, 8) -> (3, 8)" in msg
+    assert "test_retrace.py" in msg
+    (v,) = retrace.violations()
+    assert v["kind"] == "cachedop"
+    assert v["diff"] == ["args[0].shape: (2, 8) -> (3, 8)"]
+
+
+def test_injected_trainer_closure_attr_retrace():
+    """The closure-attr vector: a changed batch size silently rewrites
+    ``optimizer.rescale_grad`` — the fused update retraces and the error
+    names that exact attribute with both values."""
+    retrace.enable("raise")
+    net = gluon.nn.Dense(4)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    x = nd.ones((2, 8))
+
+    def one_step(batch_size):
+        with ag.record():
+            loss = (net(x) ** 2).mean()
+        loss.backward()
+        trainer.step(batch_size)
+
+    one_step(2)                               # rescale_grad = 0.5
+    retrace.warm()
+    one_step(2)                               # replay
+    with pytest.raises(retrace.RetraceError) as ei:
+        one_step(4)                           # rescale_grad -> 0.25
+    msg = str(ei.value)
+    assert "Trainer._try_fused_update" in msg
+    assert "rescale_grad: 0.5 -> 0.25" in msg
+
+
+def test_trainer_e2e_lane_raise_clean():
+    """MXNET_SANITIZE_RETRACE=raise trainer lane: a well-bucketed
+    training loop (constant batch schema) runs post-warmup with ZERO
+    retraces — warmup declared by step count at telemetry boundaries."""
+    retrace.enable("raise", warmup_steps=2)
+    telemetry.enable()
+    mx.random.seed(0)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(16, activation="relu"), gluon.nn.Dense(4))
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 1e-2})
+    rs = np.random.RandomState(0)
+    xb = nd.array(rs.randn(8, 8).astype(np.float32))
+    yb = nd.array(rs.randn(8, 4).astype(np.float32))
+    for i in range(5):
+        with telemetry.step():
+            with ag.record():
+                loss = ((net(xb) - yb) ** 2).mean()
+            loss.backward()
+            trainer.step(8)
+            loss.wait_to_read()
+        assert retrace.is_warm() == (i >= 1)
+    assert retrace.violations() == []
+    counts = retrace.sites()
+    assert any(k == "cachedop" for k, _ in counts)
+    assert all(n == 1 for n in counts.values())
+
+
+@pytest.mark.slow
+def test_serving_dp2_mesh_lane_violation_free():
+    """dp2 CPU-mesh generative serving under raise mode: after the
+    bucket-warming requests, a steady stream of same-bucket requests
+    compiles nothing new on either replica."""
+    import jax
+    from jax.sharding import Mesh
+    from mxnet_tpu.models.llama import llama_tiny
+    from mxnet_tpu.serving import ServerConfig
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs 2 devices (dp2)")
+    retrace.enable("raise")
+    net = llama_tiny()
+    net.initialize()
+    mesh = Mesh(np.array(jax.devices()[:2]), ("dp",))
+    cfg = ServerConfig(max_batch=2, max_length=64, min_length=8,
+                       num_slots=2)
+    rs = np.random.RandomState(7)
+    sizes = (5, 9, 5, 9)
+    prompts = [rs.randint(1, 250, size=n) for n in sizes]
+    with serving.GenerativeServer(net, cfg, mesh=mesh) as srv:
+        # warmup: touch both prompt-length buckets on the routed replica
+        for p in prompts[:2]:
+            srv.generate(p, max_new_tokens=4)
+        retrace.warm()
+        # steady state: same buckets — a first compile on the OTHER
+        # replica is a first signature (new program), never a retrace
+        futs = [srv.submit(p, max_new_tokens=4) for p in prompts[2:]]
+        for f in futs:
+            f.result(120)
+    assert retrace.violations() == []
+    assert any(k.startswith("serving_") for k, _ in retrace.sites())
+
+
+# --- null path ---------------------------------------------------------------
+
+def test_disabled_observe_is_inert_and_cheap():
+    assert not retrace.is_enabled()
+    assert retrace.observe("k", 1, {"a": 1}, site="s") is None
+    assert retrace.sites() == {}
+    # the instrumented pattern at every site: one module attribute load
+    # behind an already-rare miss branch — 10k iterations must be
+    # unmeasurable next to any real dispatch
+    t0 = time.perf_counter()
+    for _ in range(10_000):
+        if retrace._enabled:        # pragma: no cover - disabled path
+            retrace.observe("k", 1, {"a": 1}, site="s")
+    dt = time.perf_counter() - t0
+    assert dt < 0.25, f"disabled retrace guard cost {dt:.3f}s / 10k"
+
+
+def test_history_and_violation_caps():
+    retrace.enable("warn")
+    retrace.warm()
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        for i in range(300):
+            retrace.observe("k", 1, {"a": i}, site="s")
+    assert retrace.sites()[("k", 1)] <= 64
+    assert len(retrace.violations()) <= 256
+
+
+# --- env wiring --------------------------------------------------------------
+
+def test_env_raise_mode_wires_through_subprocess():
+    code = (
+        "from mxnet_tpu.telemetry import retrace\n"
+        "assert retrace.is_enabled()\n"
+        "assert retrace._mode == 'raise'\n"
+        "assert retrace._warmup_steps == 3\n"
+        "retrace.warm()\n"
+        "retrace.observe('k', 1, {'a': 1}, site='env.site')\n"
+        "retrace.observe('k', 1, {'a': 2}, site='env.site')\n"
+    )
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               MXNET_SANITIZE_RETRACE="raise",
+               MXNET_SANITIZE_RETRACE_WARMUP="3")
+    r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       text=True, env=env, cwd=REPO, timeout=120)
+    assert r.returncode != 0
+    assert "RetraceError" in r.stderr
+    assert "retrace at env.site" in r.stderr
+    assert "a: 1 -> 2" in r.stderr
+
+
+def test_env_warn_and_off_modes_wire_through_subprocess():
+    code = (
+        "import os, warnings\n"
+        "from mxnet_tpu.telemetry import retrace\n"
+        "mode = os.environ.get('MXNET_SANITIZE_RETRACE', '')\n"
+        "if mode == 'warn':\n"
+        "    assert retrace.is_enabled() and retrace._mode == 'warn'\n"
+        "    retrace.warm()\n"
+        "    retrace.observe('k', 1, {'a': 1}, site='env.site')\n"
+        "    with warnings.catch_warnings(record=True) as w:\n"
+        "        warnings.simplefilter('always')\n"
+        "        retrace.observe('k', 1, {'a': 2}, site='env.site')\n"
+        "    assert len(w) == 1 and 'a: 1 -> 2' in str(w[0].message)\n"
+        "    assert len(retrace.violations()) == 1\n"
+        "else:\n"
+        "    assert not retrace.is_enabled()\n"
+        "    assert retrace.observe('k', 1, {'a': 1}) is None\n"
+        "print('OK')\n"
+    )
+    for mode in ("warn", "off"):
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   MXNET_SANITIZE_RETRACE=mode)
+        r = subprocess.run([sys.executable, "-c", code],
+                           capture_output=True, text=True, env=env,
+                           cwd=REPO, timeout=120)
+        assert r.returncode == 0, (mode, r.stderr[-2000:])
+        assert "OK" in r.stdout
+
+
+def test_telemetry_enable_retrace_flag():
+    telemetry.enable(retrace="raise")
+    assert retrace.is_enabled() and retrace._mode == "raise"
+
+
+# --- observability: JSONL records + flight recorder + reporter ---------------
+
+def _seed_records():
+    """One baseline and one violation through the real emit path;
+    returns the retrace records the sink saw."""
+    telemetry.enable()
+    sink = ListSink()
+    telemetry.add_sink(sink)
+    retrace.enable("warn")
+    retrace.warm()
+    retrace.observe("k", 7, {"x": ((2, 8), "float32", False), "m": None},
+                    site="mod:site")
+    with pytest.warns(RuntimeWarning):
+        retrace.observe("k", 7,
+                        {"x": ((3, 8), "float32", False), "m": None},
+                        site="mod:site")
+    return [r for r in sink.records if r.get("record") == "retrace"]
+
+
+def test_jsonl_records_schema():
+    recs = _seed_records()
+    assert [r["action"] for r in recs] == ["baseline", "warn"]
+    base, viol = recs
+    for r in recs:
+        assert r["site"] == "mod:site" and r["kind"] == "k"
+        assert r["instance"] == 7
+        assert isinstance(r["where"], str) and "step" in r
+        assert isinstance(r["components"], dict)
+    assert base["signature_index"] == 0 and "diff" not in base
+    assert viol["signature_index"] == 1
+    assert viol["diff"] == ["x.shape: (2, 8) -> (3, 8)"]
+    assert viol["against"]["signature_index"] == 0
+    # components are JSON-clean (lists, not reprs of tuples)
+    json.dumps(recs)
+
+
+def test_violations_feed_the_flight_recorder(tmp_path, monkeypatch):
+    dump = str(tmp_path / "flight.json")
+    monkeypatch.setenv("MXNET_FLEET_DUMP", dump)
+    telemetry.enable()
+    telemetry.fleet.clear()
+    telemetry.fleet.enable()
+    try:
+        retrace.enable("warn")
+        retrace.warm()
+        retrace.observe("k", 1, {"a": 1}, site="mod:site")
+        with pytest.warns(RuntimeWarning):
+            retrace.observe("k", 1, {"a": 2}, site="mod:site")
+    finally:
+        telemetry.fleet.disable()
+        telemetry.fleet.clear()
+    assert os.path.exists(dump)
+    doc = json.loads(open(dump).read())
+    assert doc["reason"] == "retrace"
+    assert doc["context"]["record"] == "retrace"
+    assert doc["context"]["diff"] == ["a: 1 -> 2"]
+
+
+def test_retrace_report_timeline_and_diff(tmp_path, capsys):
+    from tools import retrace_report
+
+    recs = _seed_records()
+    path = tmp_path / "telemetry.jsonl"
+    with open(path, "w") as f:
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+        f.write('{"record": "step", "step": 1}\n')   # mixed stream is fine
+
+    assert retrace_report.main([str(path)]) == 0
+    out = capsys.readouterr().out
+    assert "mod:site" in out
+    assert "! sig #1" in out and "baseline" in out
+    assert "x.shape: (2, 8) -> (3, 8)" in out
+
+    assert retrace_report.main([str(path), "--site", "mod",
+                               "--diff", "0", "1"]) == 0
+    out = capsys.readouterr().out
+    assert "sig #0 -> sig #1" in out
+    assert "x.shape: (2, 8) -> (3, 8)" in out
+
+    # --violations filters baseline-only sites out entirely
+    clean = tmp_path / "clean.jsonl"
+    with open(clean, "w") as f:
+        f.write(json.dumps(dict(recs[0], action="baseline")) + "\n")
+    assert retrace_report.main([str(clean), "--violations"]) == 1
+
+
+def test_retrace_report_reads_flight_dump(tmp_path):
+    from tools.retrace_report import load_records
+
+    ctx = {"record": "retrace", "action": "warn", "site": "mod:site",
+           "kind": "k", "instance": 1, "where": "w", "step": 3,
+           "signature_index": 1, "components": {"a": 2},
+           "diff": ["a: 1 -> 2"],
+           "against": {"signature_index": 0, "where": "w", "step": 1}}
+    dump = tmp_path / "flight.json"
+    dump.write_text(json.dumps({"record": "flight_recorder",
+                                "reason": "retrace", "context": ctx,
+                                "records": []}))
+    assert load_records(str(dump)) == [ctx]
+
+
+# --- cost registry site field ------------------------------------------------
+
+def test_costs_registry_carries_site_and_old_dumps_parse(tmp_path):
+    from mxnet_tpu.telemetry import costs
+    from tools.bytes_breakdown import registry_breakdown
+
+    telemetry.enable()
+    net = gluon.nn.Dense(4)
+    net.initialize()
+    net.hybridize()
+    net(nd.ones((2, 8))).wait_to_read()
+    art = [a for a in costs.snapshot() if a["kind"] == "cachedop"][0]
+    assert art["site"] == "mxnet_tpu.gluon.block:CachedOp.__call__"
+
+    path = str(tmp_path / "COSTS.json")
+    costs.dump(path)
+    payload = json.loads(open(path).read())
+    bd = registry_breakdown(payload, top=5)
+    assert bd["top"][0]["site"]
+
+    # a pre-site registry dump (older writer) must keep parsing, the
+    # site column reading None
+    for e in payload["entries"]:
+        e.pop("site", None)
+    old = tmp_path / "OLD_COSTS.json"
+    old.write_text(json.dumps(payload))
+    bd = registry_breakdown(json.loads(old.read_text()), top=5)
+    assert bd["n_artifacts"] >= 1
+    assert all(r["site"] is None for r in bd["top"])

@@ -138,6 +138,9 @@ def registry_breakdown(payload, top=30):
     for e in payload.get("entries", []):
         rows.append({
             "kind": e["kind"],
+            # compile-site identity (newer dumps; absent in pre-site
+            # registry files, which must keep parsing)
+            "site": e.get("site"),
             "key": e.get("key", "")[:80],
             "bytes": float(e.get("bytes_accessed", 0.0) or 0.0),
             "output_bytes": e.get("output_bytes", 0),

@@ -24,6 +24,45 @@ DEFAULT_CACHE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                              ".cache.json")
 
 
+def _changed_paths(ref, roots, ap):
+    """``--changed`` file set: .py files ``git diff --name-only REF``
+    reports plus untracked ones, restricted to ``roots`` and still
+    present on disk.  Returns repo-root-relative paths (the same spelling
+    directory discovery produces, so cache keys and baseline
+    fingerprints match a full run's)."""
+    import subprocess
+
+    def _git(*cmd):
+        try:
+            proc = subprocess.run(
+                ["git", *cmd], cwd=REPO_ROOT, capture_output=True,
+                text=True, timeout=30)
+        except (OSError, subprocess.TimeoutExpired) as e:
+            ap.error(f"--changed needs git: {e}")
+        if proc.returncode != 0:
+            ap.error(f"git {' '.join(cmd)} failed: "
+                     f"{proc.stderr.strip() or proc.returncode}")
+        return [ln for ln in proc.stdout.split("\0") if ln]
+
+    names = set(_git("diff", "--name-only", "-z", ref, "--"))
+    names.update(_git("ls-files", "--others", "--exclude-standard", "-z"))
+    prefixes = []
+    for root in roots:
+        rel = os.path.relpath(
+            os.path.abspath(os.path.join(REPO_ROOT, root)), REPO_ROOT)
+        prefixes.append(rel.rstrip(os.sep))
+    out = []
+    for name in sorted(names):
+        if not name.endswith(".py"):
+            continue
+        if not any(name == p or name.startswith(p + "/")
+                   for p in prefixes):
+            continue
+        if os.path.isfile(os.path.join(REPO_ROOT, name)):
+            out.append(name)
+    return out
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         prog="python -m tools.lint",
@@ -50,6 +89,13 @@ def main(argv=None):
     ap.add_argument("--rules", default=None, metavar="T1,T2,...",
                     help="comma-separated rule families to run "
                          "(default: all)")
+    ap.add_argument("--changed", nargs="?", const="HEAD", default=None,
+                    metavar="REF",
+                    help="analyze only .py files changed vs git REF "
+                         "(default HEAD) plus untracked ones, restricted "
+                         "to the given paths; reuses the content-hash "
+                         "cache.  Cross-file checks (T3/T11 finalization) "
+                         "see only the changed set")
     ap.add_argument("--no-registry", action="store_true",
                     help="skip the runtime registry check (T3's dynamic "
                          "half; needs an importable mxnet_tpu)")
@@ -68,6 +114,12 @@ def main(argv=None):
                      f"known: {sorted(RULES)}")
 
     paths = args.paths or ["mxnet_tpu"]
+    if args.changed is not None:
+        paths = _changed_paths(args.changed, paths, ap)
+        if not paths:
+            print("mxlint: no changed .py files under the requested "
+                  "paths; nothing to analyze")
+            return 0
     cache = None
     if not args.no_cache:
         cache = AnalysisCache(DEFAULT_CACHE, analyzer_salt(rules))
@@ -83,6 +135,9 @@ def main(argv=None):
         violations.extend(run_registry_check())
 
     if args.update_baseline:
+        if args.changed is not None:
+            ap.error("--update-baseline needs the full tree; a --changed "
+                     "run would drop every out-of-set waiver")
         save_baseline(args.baseline, violations)
         rel = os.path.relpath(args.baseline, REPO_ROOT)
         print(f"mxlint: baseline rewritten with {len(violations)} "
@@ -91,6 +146,10 @@ def main(argv=None):
 
     baseline = {} if args.no_baseline else load_baseline(args.baseline)
     new, waived, stale = apply_baseline(violations, baseline)
+    if args.changed is not None:
+        # a partial file set cannot see most waived violations, so every
+        # out-of-set waiver would be misreported as fixed debt
+        stale = []
 
     fmt = args.format or ("json" if args.as_json else "human")
     out = sys.stdout

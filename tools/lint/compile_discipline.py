@@ -1,0 +1,556 @@
+"""Compile-discipline tier: retrace hazards and signature budgets.
+
+The whole runtime is built on the compile-once invariant: every hot
+path (CachedOp forward/backward, bulked engine segments, FusedTrainStep,
+the trainer's fused update, serving's bucket grid) traces+compiles once
+per signature and replays forever.  A retrace regression is invisible
+until a benchmark happens to assert a compile count — these families
+stop the hazard from being *written*:
+
+T13 retrace-hazard — code shapes that silently multiply signatures:
+    a. a python scalar produced by ``float()``/``int()`` in an enclosing
+       scope and captured by a traced closure instead of being lifted to
+       a runtime argument or keyed into the compile signature (the PR 4
+       float-lift rule, now enforced);
+    b. ``if``/``while`` on ``.shape``/``.dtype``/``.size``/``.item()``
+       inside a ``hybrid_forward`` — legal (shapes are static under
+       trace) but every distinct value compiles a fresh program;
+    c. compile-cache keys built from f-strings / ``.format()`` / ``%``
+       formatting — float formatting folds distinct values into
+       unstable text and hides what actually diverged;
+    d. ``tuple(kwargs.items())`` (unsorted) feeding a compile key —
+       dict insertion order differs per call site, so identical
+       configurations produce distinct signatures.
+
+T14 compile-site discipline — fresh callables and unbounded entries:
+    a. ``jax.jit`` / ``checkpoint_wrap`` / ``CachedOp`` / ``Predictor``
+       construction (or ``.hybridize()``) inside a loop — one fresh
+       callable per iteration is a guaranteed cache miss per iteration
+       (exempt inside ``__init__`` / ``_build*`` / ``warm*`` bodies,
+       where a bounded one-time grid build is the sanctioned pattern);
+    b. ``jax.jit(f)(args)`` — constructing and immediately invoking a
+       jit discards the compiled callable, so every call re-traces;
+    c. a public serving entry point that dispatches a jit-bound
+       callable on caller-shaped input in a module with no
+       ``BucketPolicy`` in sight: an unbounded signature space.
+
+T15 signature-budget declaration — modules that own a compile site must
+    declare ``__compile_signatures__`` (a dict mapping costs-registry
+    kinds to an expected-signature budget: an int or a short formula
+    string) or carry an inline ``# mxlint: signatures=...`` annotation.
+    The declared kinds are cross-checked against the kinds the module
+    actually registers via ``costs.note(...)`` so signature growth shows
+    up as a reviewed diff to the budget, not silent drift.
+
+Like the concurrency tier, everything here is per-file; there is no
+cross-file finalization pass, so results cache cleanly per content hash.
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from .core import (Violation, SEVERITY_ERROR, SEVERITY_WARNING,
+                   dotted_name, last_name)
+
+#: assignment targets that mark a value as a compile-cache key
+_SIG_NAME_RE = re.compile(r"(?:^|_)(?:sig|key|signature)s?$")
+
+#: inline alternative to ``__compile_signatures__`` for one-site helpers
+_INLINE_BUDGET_RE = re.compile(r"#\s*mxlint:\s*signatures\s*[=:]")
+
+#: branch-test attributes that are static under trace but key the compile
+_SHAPE_ATTRS = {"shape", "ndim", "size", "dtype", "itemsize"}
+
+#: callables whose construction IS a compile site
+_JIT_LAST = {"jit"}
+_WRAP_LAST = {"checkpoint_wrap"}
+_CTOR_NAMES = {"CachedOp", "Predictor"}
+
+#: enclosing-def names where building a bounded grid of callables in a
+#: loop is the sanctioned one-time pattern (serving's warm grid, module
+#: construction, ``Block.hybridize``'s recursive descent over children);
+#: everything else pays one compile per loop iteration
+_LOOP_EXEMPT_PREFIXES = ("__init__", "_build", "build_", "warm", "_warm",
+                         "hybridize")
+
+_LOOP_NODES = (ast.For, ast.While, ast.AsyncFor)
+_COMP_NODES = (ast.ListComp, ast.SetComp, ast.DictComp, ast.GeneratorExp)
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+def _is_jit_ctor(call) -> bool:
+    """Is ``call`` the construction of a compiled callable?"""
+    name = last_name(call.func)
+    if name in _JIT_LAST:
+        dotted = dotted_name(call.func)
+        head = dotted.split(".", 1)[0]
+        return head in ("jax", "jit") or "jax" in dotted
+    if name in _WRAP_LAST:
+        return True
+    if name in _CTOR_NAMES and isinstance(call.func, (ast.Name,
+                                                      ast.Attribute)):
+        return True
+    return False
+
+
+def _is_costs_note(call) -> bool:
+    if last_name(call.func) != "note":
+        return False
+    dotted = dotted_name(call.func)
+    head = dotted.split(".", 1)[0]
+    return head in ("costs", "_costs") or ".costs." in dotted
+
+
+def _sig_assign_targets(node):
+    """Names assigned by ``node`` that look like compile-key bindings."""
+    targets = []
+    if isinstance(node, ast.Assign):
+        targets = node.targets
+    elif isinstance(node, (ast.AnnAssign, ast.AugAssign)) and \
+            getattr(node, "value", None) is not None:
+        targets = [node.target]
+    out = []
+    for t in targets:
+        for n in ast.walk(t):
+            if isinstance(n, ast.Name) and _SIG_NAME_RE.search(n.id):
+                out.append(n.id)
+    return out
+
+
+def _formatted_string_nodes(expr):
+    """JoinedStr / ``"...".format(...)`` / ``"..." % ...`` inside expr."""
+    out = []
+    for n in ast.walk(expr):
+        if isinstance(n, ast.JoinedStr) and any(
+                isinstance(v, ast.FormattedValue) for v in n.values):
+            out.append(n)
+        elif isinstance(n, ast.Call) and \
+                isinstance(n.func, ast.Attribute) and \
+                n.func.attr == "format":
+            out.append(n)
+        elif isinstance(n, ast.BinOp) and isinstance(n.op, ast.Mod) and \
+                isinstance(n.left, (ast.Constant, ast.JoinedStr)) and \
+                isinstance(getattr(n.left, "value", None), str):
+            out.append(n)
+    return out
+
+
+def _assigned_names(func_node) -> set:
+    """Local names bound by plain assignment in ``func_node`` (its own
+    body only — nested defs are separate scopes)."""
+    out = set()
+    for node in _walk_own(func_node):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                for n in ast.walk(t):
+                    if isinstance(n, ast.Name):
+                        out.add(n.id)
+        elif isinstance(node, (ast.AnnAssign, ast.AugAssign, ast.NamedExpr)):
+            t = node.target
+            if isinstance(t, ast.Name):
+                out.add(t.id)
+        elif isinstance(node, (ast.For, ast.AsyncFor)):
+            for n in ast.walk(node.target):
+                if isinstance(n, ast.Name):
+                    out.add(n.id)
+        elif isinstance(node, ast.withitem) and node.optional_vars:
+            for n in ast.walk(node.optional_vars):
+                if isinstance(n, ast.Name):
+                    out.add(n.id)
+    return out
+
+
+def _walk_own(func_node):
+    """Walk ``func_node``'s body without descending into nested defs."""
+    stack = list(ast.iter_child_nodes(func_node))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, _FUNC_NODES):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _param_names(func_node) -> set:
+    args = func_node.args
+    names = {a.arg for a in (list(args.posonlyargs) + list(args.args) +
+                             list(args.kwonlyargs))}
+    if args.vararg is not None:
+        names.add(args.vararg.arg)
+    if args.kwarg is not None:
+        names.add(args.kwarg.arg)
+    return names
+
+
+def check_compile_discipline(src, index, enabled=None):
+    """Per-file T13/T14/T15 sweep.  Returns a list of Violations."""
+    violations = []
+
+    def on(rule):
+        return enabled is None or rule in enabled
+
+    def emit(rule, severity, node, message):
+        line = getattr(node, "lineno", 0)
+        if src.is_suppressed(rule, line):
+            return
+        violations.append(Violation(
+            rule=rule, severity=severity, path=src.path, line=line,
+            col=getattr(node, "col_offset", 0),
+            context=index.qualname_of(node), message=message,
+            source=src.line_text(line)))
+
+    if on("T13"):
+        _check_t13_scalar_capture(src, index, emit)
+        _check_t13_shape_branches(src, index, emit)
+        _check_t13_formatted_keys(src, index, emit)
+        _check_t13_dict_order_keys(src, index, emit)
+    if on("T14"):
+        _check_t14(src, index, emit)
+    if on("T15"):
+        _check_t15(src, index, emit)
+    return violations
+
+
+# --- T13a: python scalars baked into traced closures ------------------------
+
+def _is_engine_lifted(index, fn):
+    """A callable handed DIRECTLY to ``apply_op`` dispatches through the
+    engine, whose ``_fun_key`` lifts top-level float closure cells to
+    runtime scalar arguments (values stay out of the segment key) — the
+    baked-scalar hazard T13a targets does not apply to float cells
+    there.  Int cells are NOT lifted (they are structural more often
+    than not), so the caller still reports those."""
+    parent = index.parents.get(id(fn))
+    if not isinstance(parent, ast.Call) or fn not in parent.args:
+        return False
+    callee = parent.func
+    name = callee.id if isinstance(callee, ast.Name) else (
+        callee.attr if isinstance(callee, ast.Attribute) else None)
+    return name == "apply_op"
+
+
+def _check_t13_scalar_capture(src, index, emit):
+    for nodes in index.by_name.values():
+        for fn in nodes:
+            if id(fn) not in index.hot:
+                continue
+            parent = index.enclosing_function(fn)
+            if parent is None or isinstance(parent, ast.Lambda):
+                continue
+            params = _param_names(fn)
+            own = _assigned_names(fn)
+            # scalar conversions bound in the enclosing scope
+            scalar_defs = {}       # name -> assignment node
+            keyed = set()          # names that also reach a sig/key tuple
+            for node in _walk_own(parent):
+                if isinstance(node, ast.Assign) and \
+                        isinstance(node.value, ast.Call) and \
+                        isinstance(node.value.func, ast.Name) and \
+                        node.value.func.id in ("float", "int") and \
+                        node.value.args and \
+                        not isinstance(node.value.args[0], ast.Constant):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            scalar_defs[t.id] = node
+                if _sig_assign_targets(node):
+                    for n in ast.walk(node.value if hasattr(node, "value")
+                                      and node.value is not None else node):
+                        if isinstance(n, ast.Name):
+                            keyed.add(n.id)
+            if not scalar_defs:
+                continue
+            for node in _walk_own(fn):
+                if not (isinstance(node, ast.Name) and
+                        isinstance(node.ctx, ast.Load)):
+                    continue
+                v = node.id
+                if v in params or v in own or v in keyed or \
+                        v not in scalar_defs:
+                    continue
+                if scalar_defs[v].value.func.id == "float" and \
+                        _is_engine_lifted(index, fn):
+                    keyed.add(v)
+                    continue
+                emit("T13", SEVERITY_ERROR, node,
+                     f"python scalar '{v}' ({ast.unparse(scalar_defs[v].value)[:40]}) "
+                     f"is captured by traced '{getattr(fn, 'name', '<lambda>')}' "
+                     "and baked in at trace time — lift it to a runtime "
+                     "argument (weak-typed scalar) or key the compile "
+                     "cache on it")
+                keyed.add(v)  # one report per captured name
+
+
+# --- T13b: shape/dtype/item branches in hybridized forwards -----------------
+
+def _in_hybrid_forward(index, node) -> bool:
+    cur = index.enclosing_function(node)
+    while cur is not None:
+        if getattr(cur, "name", None) == "hybrid_forward":
+            return True
+        cur = index.enclosing_function(cur)
+    return False
+
+
+def _branch_hazard(test):
+    """(kind, detail) if the branch test reads shape/dtype/.item()."""
+    for n in ast.walk(test):
+        if isinstance(n, ast.Attribute) and n.attr in _SHAPE_ATTRS:
+            return n.attr, ast.unparse(n)[:40]
+        if isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute) \
+                and n.func.attr in ("item", "asscalar"):
+            return n.func.attr + "()", ast.unparse(n)[:40]
+    return None
+
+
+def _check_t13_shape_branches(src, index, emit):
+    for node in ast.walk(src.tree):
+        if not isinstance(node, (ast.If, ast.While)):
+            continue
+        if not _in_hybrid_forward(index, node):
+            continue
+        hazard = _branch_hazard(node.test)
+        if hazard is None:
+            continue
+        what, detail = hazard
+        kw = "while" if isinstance(node, ast.While) else "if"
+        emit("T13", SEVERITY_WARNING, node,
+             f"{kw} on {what} ({detail}) inside hybrid_forward: every "
+             "distinct value traces a fresh program — hoist the check to "
+             "construction time or bucket the input upstream")
+
+
+# --- T13c: formatted strings feeding compile keys ---------------------------
+
+def _check_t13_formatted_keys(src, index, emit):
+    for node in ast.walk(src.tree):
+        value = None
+        if isinstance(node, (ast.Assign, ast.AnnAssign)) and \
+                getattr(node, "value", None) is not None and \
+                _sig_assign_targets(node):
+            value = node.value
+        elif isinstance(node, ast.Call) and _is_costs_note(node) and \
+                len(node.args) >= 2:
+            value = node.args[1]
+        if value is None:
+            continue
+        for fmt in _formatted_string_nodes(value):
+            emit("T13", SEVERITY_WARNING, fmt,
+                 "compile key built from a formatted string — float "
+                 "formatting folds distinct values into unstable text and "
+                 "the retrace differ cannot name what changed; key on the "
+                 "raw component tuple instead")
+
+
+# --- T13d: dict-iteration order feeding compile keys ------------------------
+
+def _check_t13_dict_order_keys(src, index, emit):
+    for node in ast.walk(src.tree):
+        if not (isinstance(node, (ast.Assign, ast.AnnAssign)) and
+                getattr(node, "value", None) is not None and
+                _sig_assign_targets(node)):
+            continue
+        fn = index.enclosing_function(node)
+        kwarg = None
+        if fn is not None and not isinstance(fn, ast.Lambda) and \
+                fn.args.kwarg is not None:
+            kwarg = fn.args.kwarg.arg
+        for call in ast.walk(node.value):
+            if not (isinstance(call, ast.Call) and
+                    isinstance(call.func, ast.Name) and
+                    call.func.id == "tuple" and len(call.args) == 1):
+                continue
+            inner = call.args[0]
+            if not (isinstance(inner, ast.Call) and
+                    isinstance(inner.func, ast.Attribute) and
+                    inner.func.attr in ("items", "keys", "values")):
+                continue
+            base = inner.func.value
+            base_name = base.id if isinstance(base, ast.Name) else ""
+            if kwarg is not None and base_name == kwarg or \
+                    base_name in ("kwargs", "kw", "attrs"):
+                emit("T13", SEVERITY_WARNING, call,
+                     f"tuple({base_name}.{inner.func.attr}()) feeds a "
+                     "compile key in dict insertion order — identical "
+                     "configurations from different call sites produce "
+                     "distinct signatures; sort the items first")
+
+
+# --- T14: compile-site construction discipline ------------------------------
+
+def _enclosing_loop(index, node):
+    cur = index.parents.get(id(node))
+    while cur is not None:
+        if isinstance(cur, _LOOP_NODES + _COMP_NODES):
+            return cur
+        if isinstance(cur, _FUNC_NODES):
+            return None  # a def inside the loop is a fresh scope: the
+            # construct runs when the def runs, not per loop iteration
+        cur = index.parents.get(id(cur))
+    return None
+
+
+def _loop_exempt(index, node) -> bool:
+    fn = index.enclosing_function(node)
+    name = getattr(fn, "name", "") if fn is not None else ""
+    return any(name.startswith(p) for p in _LOOP_EXEMPT_PREFIXES)
+
+
+def _check_t14(src, index, emit):
+    jit_attrs = set()   # self-attribute names bound to jitted callables
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.Assign) and \
+                isinstance(node.value, ast.Call) and \
+                _is_jit_ctor(node.value):
+            for t in node.targets:
+                if isinstance(t, ast.Attribute) and \
+                        isinstance(t.value, ast.Name) and \
+                        t.value.id == "self":
+                    jit_attrs.add(t.attr)
+
+    for node in ast.walk(src.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        # T14b: jax.jit(f)(args) — construct-and-discard
+        if isinstance(node.func, ast.Call) and _is_jit_ctor(node.func):
+            emit("T14", SEVERITY_ERROR, node,
+                 "jit constructed and immediately invoked — the compile "
+                 "cache keys on callable identity, so every call here "
+                 "re-traces; construct once, store it, and reuse")
+            continue
+        # T14a: construction inside a loop
+        is_ctor = _is_jit_ctor(node) or (
+            isinstance(node.func, ast.Attribute) and
+            node.func.attr == "hybridize")
+        if is_ctor and _enclosing_loop(index, node) is not None and \
+                not _loop_exempt(index, node):
+            what = last_name(node.func)
+            emit("T14", SEVERITY_ERROR, node,
+                 f"{what}(...) constructed inside a loop — a fresh "
+                 "callable per iteration is a guaranteed compile miss "
+                 "per iteration; hoist the construction out of the loop "
+                 "(one-time grid builds belong in __init__/_build*/warm*)")
+
+    # T14c: unbounded serving entry points
+    if "serving" not in src.path or not jit_attrs:
+        return
+    if "BucketPolicy" in src.text or "bucket_for" in src.text:
+        return
+    seen_defs = set()
+    for node in ast.walk(src.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if node.name.startswith("_") or id(node) in seen_defs:
+            continue
+        params = _param_names(node) - {"self", "cls"}
+        if not params:
+            continue
+        for call in _walk_own(node):
+            if not (isinstance(call, ast.Call) and
+                    isinstance(call.func, ast.Attribute) and
+                    isinstance(call.func.value, ast.Name) and
+                    call.func.value.id == "self" and
+                    call.func.attr in jit_attrs):
+                continue
+            feeds = any(isinstance(n, ast.Name) and n.id in params
+                        for a in call.args for n in ast.walk(a))
+            if not feeds:
+                continue
+            seen_defs.add(id(node))
+            emit("T14", SEVERITY_WARNING, node,
+                 f"public entry '{node.name}' dispatches jitted "
+                 f"'self.{call.func.attr}' on caller-shaped input and no "
+                 "BucketPolicy bounds the signature space in this module "
+                 "— pad/bucket upstream or waive with the enforcing "
+                 "policy named")
+            break
+
+
+# --- T15: signature-budget declaration --------------------------------------
+
+def _module_budget(src):
+    """The module-level ``__compile_signatures__`` dict node, or None."""
+    for node in src.tree.body:
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and \
+                        t.id == "__compile_signatures__":
+                    return node
+    return None
+
+
+def _owns_compile_site(src):
+    """(owner_node, registered_kinds) — the first stored-jit/ctor node
+    proving this module owns a compile site, plus every string-literal
+    kind the module registers with ``costs.note``."""
+    owner = None
+    kinds = set()
+    # only *stored* jits count as owned sites; jit(f)(x) is T14's
+    # problem and a bare expression statement owns nothing
+    stored = set()
+    for node in ast.walk(src.tree):
+        if isinstance(node, (ast.Assign, ast.AnnAssign, ast.Return)) and \
+                getattr(node, "value", None) is not None:
+            stored.add(id(node.value))
+    for node in ast.walk(src.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if _is_costs_note(node):
+            if node.args and isinstance(node.args[0], ast.Constant) and \
+                    isinstance(node.args[0].value, str):
+                kinds.add(node.args[0].value)
+            if owner is None:
+                owner = node
+        elif _is_jit_ctor(node) and id(node) in stored and owner is None:
+            owner = node
+    return owner, kinds
+
+
+def _check_t15(src, index, emit):
+    owner, kinds = _owns_compile_site(src)
+    budget = _module_budget(src)
+    if owner is None and budget is None:
+        return
+    has_inline = bool(_INLINE_BUDGET_RE.search(src.text))
+    if owner is not None and budget is None and not has_inline:
+        emit("T15", SEVERITY_ERROR, owner,
+             "module owns a compile site but declares no "
+             "__compile_signatures__ budget — declare a dict mapping "
+             "each costs-registry kind to its expected signature count "
+             "(int) or growth formula (str) so signature growth is a "
+             "reviewed diff")
+        return
+    if budget is None:
+        return
+    if not isinstance(budget.value, ast.Dict):
+        emit("T15", SEVERITY_ERROR, budget,
+             "__compile_signatures__ must be a dict literal of "
+             "{registry kind: budget}")
+        return
+    declared = {}
+    for k, v in zip(budget.value.keys, budget.value.values):
+        if not (isinstance(k, ast.Constant) and isinstance(k.value, str)):
+            emit("T15", SEVERITY_ERROR, k or budget,
+                 "__compile_signatures__ keys must be string literals "
+                 "naming costs-registry kinds")
+            continue
+        declared[k.value] = v
+        ok_value = isinstance(v, ast.Constant) and (
+            (isinstance(v.value, int) and not isinstance(v.value, bool)
+             and v.value > 0) or
+            (isinstance(v.value, str) and v.value.strip()))
+        if not ok_value:
+            emit("T15", SEVERITY_ERROR, v,
+                 f"budget for kind '{k.value}' must be a positive int or "
+                 "a non-empty formula string")
+    for kind in sorted(kinds - set(declared)):
+        emit("T15", SEVERITY_ERROR, budget,
+             f"registry kind '{kind}' is registered in this module but "
+             "missing from __compile_signatures__ — add it with its "
+             "expected signature budget")
+    if kinds:
+        for kind in sorted(set(declared) - kinds):
+            emit("T15", SEVERITY_WARNING, budget,
+                 f"__compile_signatures__ declares kind '{kind}' that "
+                 "this module never registers with costs.note — stale "
+                 "entry or typo")

@@ -1,0 +1,19 @@
+"""T14c fixture (path carries ``serving``): a public entry point that
+dispatches a jit-bound callable on caller-shaped input in a module where
+nothing bounds the signature grid — an unbounded signature space."""
+import jax
+
+__compile_signatures__ = {}
+
+
+class MiniEngine:
+    def __init__(self, fn):
+        self._step = jax.jit(fn)
+
+    def generate(self, prompts):
+        return self._step(prompts)    # T14 warning: caller-shaped input,
+        # nothing bounds the (batch, len) grid in this module
+
+    def _drain(self, prompts):
+        return self._step(prompts)    # ok: private helper — the public
+        # surface is where the budget is enforced

@@ -1,0 +1,93 @@
+"""T13 fixture: retrace hazards — baked scalars, shape branches in
+hybridized forwards, formatted / dict-ordered compile keys."""
+# mxlint: signatures=1 per helper (keeps T15 out of this T13 fixture)
+import jax
+
+
+# -- a. python scalar captured by a traced closure ---------------------------
+
+def make_scaled_step(optzr):
+    scale = float(optzr.rescale_grad)
+
+    def step(x):
+        return x * scale              # T13 error: baked at trace time
+
+    return jax.jit(step)
+
+
+def make_keyed_step(optzr, cache):
+    scale = float(optzr.rescale_grad)
+    sig = ("step", scale)             # ok: the bake is keyed — a new
+    fn = cache.get(sig)               # scale compiles a new entry instead
+    if fn is None:                    # of silently retracing the old one
+
+        def step(x):
+            return x * scale
+
+        fn = jax.jit(step)
+        cache[sig] = fn
+    return fn
+
+
+def make_lifted_step():
+    def step(x, scale):               # ok: scale is a runtime argument
+        return x * scale
+
+    return jax.jit(step)
+
+
+# -- b. shape/dtype branches inside hybrid_forward ---------------------------
+
+class PadBlock:
+    def __init__(self, multiple, pad):
+        self._multiple = multiple
+        self._pad = pad
+
+    def hybrid_forward(self, F, x):
+        if x.shape[1] % self._multiple:   # T13 warning: per-shape retrace
+            x = F.pad(x, ((0, 0), (0, 1)))
+        while x.ndim > 2:                 # T13 warning: per-rank retrace
+            x = F.squeeze(x, axis=0)
+        if self._pad:                     # ok: config dispatch, static
+            x = x + 1
+        return x
+
+
+# -- c. formatted strings feeding compile keys -------------------------------
+
+def formatted_key(lr, wd):
+    sig = f"lr={lr:.3f}/wd={wd}"      # T13 warning: float -> text key
+    return sig
+
+
+def tuple_key(lr, wd):
+    sig = ("sgd", lr, wd)             # ok: raw component tuple
+    return sig
+
+
+# -- d. dict-iteration order feeding compile keys ----------------------------
+
+def attr_key(**kwargs):
+    key = tuple(kwargs.items())       # T13 warning: insertion-ordered
+    return key
+
+
+def attr_key_sorted(**kwargs):
+    key = tuple(sorted(kwargs.items()))   # ok: canonical order
+    return key
+
+
+# -- e. engine-lifted float cells (apply_op dispatch) ------------------------
+
+def scalar_op_lifted(apply_op, fn, data, scalar):
+    s = float(scalar)
+    # ok: handed straight to apply_op — the engine lifts float cells to
+    # runtime scalar args, the value never joins the segment key
+    return apply_op(lambda x: fn(x, s), data, name="op")
+
+
+def scalar_op_int_capture(apply_op, fn, data, scalar):
+    n = int(scalar)
+    # T13 error: int cells are NOT lifted — keyed by value, one compile
+    # per distinct constant
+    return apply_op(lambda x: fn(x, n), data, name="op")

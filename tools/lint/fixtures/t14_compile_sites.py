@@ -1,0 +1,38 @@
+"""T14 fixture: compile-site discipline — fresh callables per call or
+per loop iteration (guaranteed cache misses)."""
+# mxlint: signatures=1 per helper (keeps T15 out of this T14 fixture)
+import jax
+
+
+def per_call_jit(fn, x):
+    return jax.jit(fn)(x)             # T14 error: construct-and-discard
+
+
+def per_item_grid(fns, xs):
+    out = []
+    for f, x in zip(fns, xs):
+        step = jax.jit(f)             # T14 error: fresh callable per
+        out.append(step(x))           # iteration = compile miss per item
+    return out
+
+
+def _build_grid(fns):
+    compiled = []
+    for f in fns:
+        compiled.append(jax.jit(f))   # ok: sanctioned one-time build def
+    return compiled
+
+
+class Stack:
+    def __init__(self, blocks):
+        self._blocks = blocks
+        for b in blocks:
+            b.hybridize()             # ok: __init__ builds the grid once
+
+    def rewrap(self):
+        for b in self._blocks:
+            b.hybridize()             # T14 error: re-hybridize per call
+
+    def warm_modes(self, modes):
+        for m in modes:
+            self._blocks[0].hybridize(remat=m)   # ok: warm* is exempt

@@ -1,0 +1,24 @@
+"""T15 fixture: a declared budget with a missing kind, a stale kind and
+an invalid budget value."""
+import jax
+
+from mxnet_tpu.telemetry import costs as _costs
+
+__compile_signatures__ = {
+    "fused_step": "1 per (batch schema x mesh x numerics mode)",  # ok
+    "stale_kind": 2,              # T15 warning: never registered here
+    "bad_budget": 0,              # T15 error: must be positive / formula
+}
+
+
+class Runner:
+    def __init__(self, fn):
+        self._fn = jax.jit(fn)
+
+    def run(self, batch):
+        out = self._fn(batch)
+        _costs.note("fused_step", ("k",), self._fn, (batch,))
+        _costs.note("bad_budget", ("k",), self._fn, (batch,))
+        # T15 error: registered below but missing from the declaration
+        _costs.note("unbudgeted", ("k",), self._fn, (batch,))
+        return out

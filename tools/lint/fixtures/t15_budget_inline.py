@@ -1,0 +1,8 @@
+"""T15 fixture: the inline-annotation form — a one-site helper whose
+budget rides a comment instead of a module dict."""
+import jax
+
+
+def make_step(fn):
+    # mxlint: signatures=1 (single static schema, rebuilt on reload only)
+    return jax.jit(fn)

@@ -1,0 +1,11 @@
+"""T15 fixture: module owns a compile site (stored jit) but declares no
+signature budget at all."""
+import jax
+
+
+class Undeclared:
+    def __init__(self, fn):
+        self._fn = jax.jit(fn)    # T15 error: no __compile_signatures__
+
+    def run(self, x):
+        return self._fn(x)

@@ -42,6 +42,7 @@ import re
 
 from .core import (Violation, SEVERITY_ERROR, SEVERITY_WARNING, dotted_name,
                    last_name)
+from .compile_discipline import check_compile_discipline
 from .concurrency import check_concurrency
 from .dataflow import check_donation
 from .hotpath import FunctionIndex, function_taint, expr_tainted
@@ -59,11 +60,18 @@ RULES = {
     "T10": "shared state accessed bare where it is lock-guarded elsewhere",
     "T11": "lock-order cycle / unbounded blocking call under a held lock",
     "T12": "thread lifecycle (unnamed / unjoined non-daemon / silent worker)",
+    "T13": "retrace hazard (baked scalar / shape branch / unstable key)",
+    "T14": "compile-site discipline (fresh callable / unbounded entry)",
+    "T15": "signature budget (__compile_signatures__) missing or stale",
 }
 
 #: families whose cross-file halves the analyzer finalizes after the
 #: per-file sweep
 _CONCURRENCY_RULES = frozenset({"T10", "T11", "T12"})
+
+#: compile-discipline tier (tools/lint/compile_discipline.py) — fully
+#: per-file, so per-content-hash caching holds with no cross-file facts
+_COMPILE_RULES = frozenset({"T13", "T14", "T15"})
 
 # --- T1 ---------------------------------------------------------------------
 
@@ -152,7 +160,12 @@ RECORDING_HEADS = {"telemetry", "profiler", "prof",
                    # stride-gated inside numerics._materialize
                    # (MATERIALIZE_DEFS) and the forensic replay half never
                    # runs in training code
-                   "numerics", "_numerics"}
+                   "numerics", "_numerics",
+                   # r18 recompile sanitizer (telemetry.retrace): observe
+                   # hooks ride compile-miss branches only — structural
+                   # bookkeeping behind one boolean, never a device sync,
+                   # and replays never reach them
+                   "retrace", "_retrace"}
 
 
 def _is_recording_call(dotted: str) -> bool:
@@ -503,6 +516,9 @@ class FileChecker:
             conc, self.lock_facts = check_concurrency(
                 self.src, self.index, enabled=self.enabled)
             self.violations.extend(conc)
+        if self.enabled is None or (self.enabled & _COMPILE_RULES):
+            self.violations.extend(check_compile_discipline(
+                self.src, self.index, enabled=self.enabled))
         if self._on("T6") or self._on("T7"):
             self.violations.extend(check_donation(
                 self.src, self.index, enabled=self.enabled))

@@ -1,0 +1,197 @@
+"""Render compile/retrace provenance from retrace-sanitizer records.
+
+The runtime recompile sanitizer (``mxnet_tpu.telemetry.retrace``) emits
+ONE ``{"record": "retrace", ...}`` line per new compile at a registered
+site — action ``"baseline"`` for warmup/first-signature compiles,
+``"warn"``/``"raise"`` for post-warmup retraces, each violation
+carrying the structural ``diff`` against its nearest prior signature
+and the Python ``where`` both compiles were triggered from.  This tool
+joins those records back into per-site timelines a human can read:
+
+    # every site's signature timeline (violations flagged)
+    python tools/retrace_report.py telemetry.jsonl
+
+    # one site only (substring match on the site identity)
+    python tools/retrace_report.py telemetry.jsonl --site trainer
+
+    # violations only, with full diffs
+    python tools/retrace_report.py telemetry.jsonl --violations
+
+    # re-diff two observed signatures of one site by index
+    python tools/retrace_report.py telemetry.jsonl \
+        --site cachedop --diff 0 2
+
+The ``--diff`` path reuses the sanitizer's own structural differ
+(``retrace.diff_components``), whose canonicalizer tolerates the
+JSON round-trip (tuples come back as lists).  Input may be a telemetry
+JSONL stream (any record mix; only ``record == "retrace"`` lines are
+used) or a flight-recorder dump whose incidents carry retrace
+contexts.  ``load_records`` / ``timelines`` / ``render_site`` are
+importable for tests.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from mxnet_tpu.telemetry import retrace as _retrace
+
+
+def load_records(path):
+    """Every retrace record in ``path`` — a telemetry JSONL stream or a
+    flight-recorder dump (incident contexts) — in file order."""
+    with open(path, "r", encoding="utf-8") as f:
+        head = f.read(1)
+        f.seek(0)
+        if head == "{":
+            try:
+                doc = json.load(f)
+            except json.JSONDecodeError:
+                f.seek(0)
+            else:
+                if doc.get("record") == "flight_recorder":
+                    # one incident dump = one triggering context
+                    ctx = doc.get("context")
+                    return [ctx] if isinstance(ctx, dict) and \
+                        ctx.get("record") == "retrace" else []
+                return [doc] if doc.get("record") == "retrace" else []
+        out = []
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(rec, dict) and rec.get("record") == "retrace":
+                out.append(rec)
+    return out
+
+
+def timelines(records, site=None):
+    """Group records into per-site timelines, file order preserved:
+    ``{site_identity: [record, ...]}``.  ``site`` filters by substring
+    on the site identity.  Sites observed under several live instances
+    (id(self) reuse across runs) keep one timeline per instance."""
+    out = {}
+    for rec in records:
+        ident = rec.get("site") or rec.get("kind") or "?"
+        if site is not None and site.lower() not in ident.lower():
+            continue
+        inst = rec.get("instance")
+        out.setdefault((ident, inst), []).append(rec)
+    # collapse the instance discriminator when a site has only one
+    merged = {}
+    singles = {}
+    for (ident, inst), recs in out.items():
+        singles.setdefault(ident, []).append(inst)
+    for (ident, inst), recs in out.items():
+        label = ident if len(singles[ident]) == 1 \
+            else f"{ident} #{inst}"
+        merged[label] = recs
+    return merged
+
+
+def _fmt_components(comps, limit=100):
+    text = ", ".join(f"{k}={comps[k]!r}" for k in sorted(comps))
+    return text if len(text) <= limit else text[:limit] + "..."
+
+
+def render_site(label, recs, show_components=False):
+    """ASCII timeline for one site: one line per compile, violations
+    flagged with the per-component diff indented under them."""
+    lines = [label]
+    for rec in recs:
+        action = rec.get("action", "?")
+        mark = " " if action == "baseline" else "!"
+        lines.append(
+            "  %s sig #%-2s step %-4s %-8s %s"
+            % (mark, rec.get("signature_index", "?"),
+               rec.get("step", "?"), action, rec.get("where", "?")))
+        if show_components and isinstance(rec.get("components"), dict):
+            lines.append("      " + _fmt_components(rec["components"]))
+        against = rec.get("against")
+        if against:
+            lines.append("      vs sig #%s [%s]:"
+                         % (against.get("signature_index", "?"),
+                            against.get("where", "?")))
+        for d in rec.get("diff") or []:
+            lines.append("        " + d)
+    return "\n".join(lines)
+
+
+def diff_by_index(recs, i, j):
+    """Re-diff two observed signatures of one site's timeline using the
+    sanitizer's structural differ (JSON lists canonicalize to tuples,
+    so round-tripped avals still diff field-by-field)."""
+    try:
+        a, b = recs[i], recs[j]
+    except IndexError:
+        raise SystemExit(
+            f"site has {len(recs)} signatures; --diff wants {i} and {j}")
+    return _retrace.diff_components(a.get("components") or {},
+                                    b.get("components") or {})
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="per-site compile/retrace timelines from telemetry "
+                    "JSONL")
+    ap.add_argument("path", help="telemetry JSONL stream or "
+                                 "flight-recorder dump")
+    ap.add_argument("--site", default=None,
+                    help="case-insensitive substring filter on the "
+                         "site identity")
+    ap.add_argument("--violations", action="store_true",
+                    help="only sites with post-warmup retraces")
+    ap.add_argument("--components", action="store_true",
+                    help="print each signature's full components")
+    ap.add_argument("--diff", nargs=2, type=int, metavar=("I", "J"),
+                    help="diff signature #I against #J of the selected "
+                         "site (requires --site matching exactly one)")
+    args = ap.parse_args(argv)
+
+    records = load_records(args.path)
+    if not records:
+        print(f"no retrace records in {args.path!r}", file=sys.stderr)
+        return 1
+    lanes = timelines(records, site=args.site)
+
+    if args.diff is not None:
+        if len(lanes) != 1:
+            print("--diff needs --site selecting exactly one site; "
+                  f"matched {len(lanes)}: {sorted(lanes)}",
+                  file=sys.stderr)
+            return 1
+        ((label, recs),) = lanes.items()
+        i, j = args.diff
+        diff = diff_by_index(recs, i, j)
+        print(f"{label}: sig #{i} -> sig #{j}")
+        for d in diff or ["<structurally equal>"]:
+            print("  " + d)
+        return 0
+
+    shown = 0
+    for label in sorted(lanes):
+        recs = lanes[label]
+        if args.violations and not any(
+                r.get("action") != "baseline" for r in recs):
+            continue
+        print(render_site(label, recs, show_components=args.components))
+        shown += 1
+    if shown == 0:
+        print("no matching sites"
+              + (" with violations" if args.violations else ""),
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
