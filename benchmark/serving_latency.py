@@ -41,8 +41,17 @@ goodput counts rejected requests as misses), and adds the
 vs on (min-of-repeats per arm), proving the per-decode-step overhead
 of span recording stays under 3%.
 
+Round 19 adds the **spec_radix** 2x2 A/B: speculative decoding (same-
+net draft, ``BENCH_SERVING_SPEC_K`` proposals per verify) × the radix
+prefix cache, over a shared-system-prompt workload submitted
+sequentially so all four arms decode the identical greedy stream.
+Per arm: target-forwards-per-generated-token (from the request
+records' joined/done step counters), prefilled-token and prefill-ms
+totals, accept rate, and the compile gate (signature-count delta of a
+sanitizer-watched measured pass must be zero).
+
 Run: ``JAX_PLATFORMS=cpu python benchmark/serving_latency.py``
-Artifact: SERVING_LATENCY_r12.json (override MXT_SERVING_LATENCY_OUT).
+Artifact: SERVING_LATENCY_r19.json (override MXT_SERVING_LATENCY_OUT).
 """
 from __future__ import annotations
 
@@ -95,6 +104,16 @@ SLO_TPOT_MS = float(os.environ.get("BENCH_SERVING_SLO_TPOT_MS", 100.0))
 AB_REQUESTS = int(os.environ.get("BENCH_SERVING_AB_REQUESTS", 8))
 AB_MAX_NEW = int(os.environ.get("BENCH_SERVING_AB_MAX_NEW", 32))
 AB_REPEATS = int(os.environ.get("BENCH_SERVING_AB_REPEATS", 3))
+
+# r19 speed-multiplier knobs: the speculative × radix 2x2 A/B over a
+# shared-system-prompt workload (chat/RAG shape: one long shared prefix
+# + a short per-request tail), submitted sequentially so every lane
+# decodes the identical token stream
+SPEC_REQUESTS = int(os.environ.get("BENCH_SERVING_SPEC_REQUESTS", 8))
+SPEC_K = int(os.environ.get("BENCH_SERVING_SPEC_K", 3))
+SPEC_MAX_NEW = int(os.environ.get("BENCH_SERVING_SPEC_MAX_NEW", 16))
+SPEC_PREFIX = int(os.environ.get("BENCH_SERVING_SPEC_PREFIX", 160))
+SPEC_MAX_LEN = int(os.environ.get("BENCH_SERVING_SPEC_MAX_LEN", 256))
 
 
 def _build_predictor(workdir):
@@ -471,6 +490,117 @@ def _tracing_ab(net):
     }
 
 
+# --- r19: speculative decoding × radix prefix cache 2x2 A/B -----------------
+
+def _spec_workload(rng):
+    """Shared system prompt + short per-request tails (the workload the
+    radix cache exists for)."""
+    prefix = rng.randint(1, 250, size=SPEC_PREFIX).astype(np.int32)
+    tails = [rng.randint(1, 250, size=int(n)).astype(np.int32)
+             for n in rng.randint(3, 8, size=SPEC_REQUESTS)]
+    return [np.concatenate([prefix, t]) for t in tails]
+
+
+def _spec_radix_lane(net, prompts, spec, radix):
+    """One arm of the 2x2: sequential closed-loop submission (batch
+    bucket pinned at 1, so all four arms decode the same determinstic
+    greedy stream), a full warm pass (compiles every signature AND
+    pre-populates the radix trie), then a measured pass under the
+    retrace sanitizer with the compile gate = signature-count delta."""
+    from mxnet_tpu import serving, telemetry
+    from mxnet_tpu.telemetry import retrace
+    from mxnet_tpu.telemetry.sinks import ListSink
+
+    cfg = serving.ServerConfig(
+        max_batch=1, max_length=SPEC_MAX_LEN, min_batch=1, min_length=8,
+        queue_capacity=max(64, SPEC_REQUESTS), num_slots=2,
+        max_new_tokens=SPEC_MAX_NEW, kv_mode="paged", block_size=16,
+        batch_window_ms=0.5, summary_every=1 << 30,
+        draft_net=net if spec else None, spec_k=SPEC_K,
+        radix_cache=radix)
+    telemetry.enable(memory=False, cost=False)
+    sink = ListSink()
+    telemetry.add_sink(sink)
+    retrace.enable(mode="warn")
+    srv = serving.GenerativeServer(net, cfg)
+    rep = srv.replicas[0]
+    try:
+        with srv:
+            for p in prompts:                      # warm pass
+                srv.generate(p, max_new_tokens=SPEC_MAX_NEW,
+                             timeout=300.0)
+            retrace.warm()
+            sigs0 = len(rep.engine.compiled_signatures()) + (
+                len(rep.draft.compiled_signatures()) if spec else 0)
+            sink.records.clear()
+            t0 = time.perf_counter()
+            outs = [srv.generate(p, max_new_tokens=SPEC_MAX_NEW,
+                                 timeout=300.0) for p in prompts]
+            wall = time.perf_counter() - t0
+            sigs1 = len(rep.engine.compiled_signatures()) + (
+                len(rep.draft.compiled_signatures()) if spec else 0)
+            stats = srv.stats()
+        violations = retrace.violations()
+    finally:
+        retrace.disable()
+        retrace.reset()
+        telemetry.disable()
+        telemetry.reset()
+    recs = sorted((r for r in sink.records
+                   if r.get("record") == "serving.request"
+                   and r.get("status", "ok") == "ok"),
+                  key=lambda r: r["request_id"])
+    assert len(recs) == len(prompts)
+    prefill_ms = [r["prefill_ms"] for r in recs]
+    hit = [r.get("prefix_hit_tokens", 0) or 0 for r in recs]
+    prefilled = [len(p) - h for p, h in zip(prompts, hit)]
+    # target dispatches while decoding (verify counts as one step), per
+    # generated token — the speculation claim's numerator
+    fwd = [(r["done_step"] - r["joined_step"]) / SPEC_MAX_NEW
+           for r in recs]
+    out = {
+        "speculative": bool(spec), "radix_cache": bool(radix),
+        "requests": len(prompts), "wall_s": round(wall, 4),
+        "ttft_ms": _percentiles([r["ttft_ms"] for r in recs]),
+        "total_ms": _percentiles([r["total_ms"] for r in recs]),
+        "prefill_ms_total": round(sum(prefill_ms), 3),
+        "prefilled_tokens": int(sum(prefilled)),
+        "prefix_hit_tokens": int(sum(hit)),
+        "target_forwards_per_token": round(sum(fwd) / len(fwd), 4),
+        "compile_sig_delta": sigs1 - sigs0,
+        "retrace_violations": len(violations),
+        "kv_cache": {k: stats["kv_cache"][k] for k in
+                     ("shared_blocks", "peak_shared_blocks",
+                      "blocks_in_use")},
+    }
+    if spec:
+        out["accept_rate"] = stats["speculative"]["accept_rate"]
+        out["spec_k"] = stats["speculative"]["k"]
+    if radix:
+        out["radix"] = stats["radix_cache"]
+    return out, [list(map(int, o)) for o in outs]
+
+
+def _spec_radix_sweep():
+    from mxnet_tpu.models.llama import llama_tiny
+
+    net = llama_tiny(max_seq_len=max(SPEC_MAX_LEN, 128))
+    net.initialize()
+    rng = np.random.RandomState(SEED + 31)
+    prompts = _spec_workload(rng)
+    lanes, tokens = {}, {}
+    for spec in (False, True):
+        for radix in (False, True):
+            name = (("spec" if spec else "base")
+                    + ("+radix" if radix else ""))
+            lanes[name], tokens[name] = _spec_radix_lane(
+                net, prompts, spec, radix)
+    ref = tokens["base"]
+    lanes["token_equal_across_arms"] = all(t == ref
+                                           for t in tokens.values())
+    return lanes
+
+
 def main():
     workdir = tempfile.mkdtemp(prefix="serving_bench_")
     try:
@@ -480,6 +610,7 @@ def main():
     finally:
         shutil.rmtree(workdir, ignore_errors=True)
     gen, tracing_ab = _gen_sweep()
+    spec_radix = _spec_radix_sweep()
     from mxnet_tpu import serving
 
     from _compile_gate import compile_once_ok
@@ -510,6 +641,7 @@ def main():
             "engines": gen,
         },
         "tracing_ab": tracing_ab,
+        "spec_radix": spec_radix,
         "acceptance": {
             "signatures_within_ceiling": compile_once_ok(lanes,
                                                          ceiling=ceiling),
@@ -525,6 +657,23 @@ def main():
                      or (s_paged == s_slots == max(GEN_RATES)))),
             "tracing_step_overhead_under_3pct":
                 tracing_ab["overhead_frac"] < 0.03,
+            # r19 speed multipliers (all four arms decode the identical
+            # greedy stream — the A/B measures speed, never tokens)
+            "spec_radix_token_equal":
+                spec_radix["token_equal_across_arms"],
+            "spec_forwards_per_token_under_half": (
+                spec_radix["spec"]["target_forwards_per_token"] < 0.5
+                and spec_radix["spec"]["accept_rate"] >= 0.7),
+            "radix_prefilled_tokens_reduced_2x": (
+                spec_radix["base"]["prefilled_tokens"]
+                >= 2 * spec_radix["base+radix"]["prefilled_tokens"]),
+            "radix_prefill_ms_reduced_2x": (
+                spec_radix["base"]["prefill_ms_total"]
+                >= 2 * spec_radix["base+radix"]["prefill_ms_total"]),
+            "spec_radix_compile_once": all(
+                spec_radix[arm]["compile_sig_delta"] == 0
+                and spec_radix[arm]["retrace_violations"] == 0
+                for arm in ("base", "spec", "base+radix", "spec+radix")),
         },
         "platform": os.environ.get("JAX_PLATFORMS", "default"),
     }
@@ -533,7 +682,7 @@ def main():
     out_path = os.environ.get(
         "MXT_SERVING_LATENCY_OUT",
         os.path.join(os.path.dirname(__file__), "..",
-                     "SERVING_LATENCY_r12.json"))
+                     "SERVING_LATENCY_r19.json"))
     with open(out_path, "w") as f:
         f.write(line + "\n")
 

@@ -789,6 +789,139 @@ class LlamaDecoder:
         x = self._rms(x, w["norm"], cfg.rms_eps)
         return x @ w["head"].T, new_pools
 
+    def _verify_blocks_impl(self, w, pools, tables, toks, pos0):
+        """Speculative VERIFY forward against the paged pool: a widened
+        :meth:`_step_blocks_impl` that advances every slot K = k+1
+        candidate positions in ONE dispatch.  ``toks`` (S, K) int32 is
+        ``[last_committed, draft_1 .. draft_k]`` per slot; ``pos0``
+        (S,) is each slot's committed write cursor, so window column j
+        carries absolute position ``pos0[s] + j``.  Returns greedy
+        argmax over the (S, K, V) logits — column j is the target
+        model's next-token choice AFTER consuming ``toks[s, :j+1]``,
+        exactly what the acceptance rule compares drafts against.
+
+        K/V for all K window tokens scatter into the slots' own blocks
+        at their absolute positions (``mode="drop"`` on the sentinel
+        id, and ids past ``max_len`` are forced to the sentinel, so
+        vacant slots and over-budget columns write nothing).  Rejected
+        columns need no cleanup: their rows sit beyond the rolled-back
+        cursor where the causal mask (``t <= pos``) never exposes them,
+        and the next verify window overwrites them in place — the
+        stale-row invariant, now doing rollback duty.  The causal mask
+        here is per-COLUMN (``t <= pos0[s] + j``), so draft_j attends
+        the in-window K/V of draft_1..j-1 it was conditioned on."""
+        import jax.numpy as jnp
+
+        cfg = self.cfg
+        hd = cfg.head_dim
+        s, kk = toks.shape
+        nb, hkv, bs, _ = pools[0][0].shape
+        mb = tables.shape[1]
+        t = mb * bs
+        pos0 = jnp.asarray(pos0, jnp.int32)
+        pw = pos0[:, None] + jnp.arange(kk, dtype=jnp.int32)[None, :]
+        cos = self._cos[pw][:, None]                # (S,1,K,hd/2)
+        sin = self._sin[pw][:, None]
+        x = w["emb"][toks]                          # (S, K, H)
+        mask = (jnp.arange(t)[None, None, :]
+                <= pw[:, :, None])[:, None]         # (S,1,K,T)
+        blk = jnp.take_along_axis(tables,
+                                  jnp.minimum(pw // bs, mb - 1), axis=1)
+        # columns past max_len have no legal row: force the sentinel so
+        # the scatter drops instead of wrapping into a clamped block
+        blk = jnp.where(pw < jnp.int32(self.max_len), blk, nb)  # (S,K)
+        off = pw % bs
+        gat = jnp.minimum(tables, nb - 1)
+        new_pools = []
+        for L, (kp, vp) in zip(w["layers"], pools):
+
+            def ctx_fn(h, L=L, kp=kp, vp=vp):
+                q = (h @ L["q"].T).reshape(s, kk, cfg.num_heads, hd) \
+                    .transpose(0, 2, 1, 3)
+                k = (h @ L["k"].T).reshape(s, kk, cfg.num_kv_heads, hd) \
+                    .transpose(0, 2, 1, 3)
+                v = (h @ L["v"].T).reshape(s, kk, cfg.num_kv_heads, hd) \
+                    .transpose(0, 2, 1, 3)
+                q = _apply_rope(q, cos, sin)
+                k = _apply_rope(k, cos, sin)
+                # scatter indices (S,K) pair with update (S,K,Hkv,hd)
+                kp2 = kp.at[blk, :, off].set(
+                    k.transpose(0, 2, 1, 3), mode="drop")
+                vp2 = vp.at[blk, :, off].set(
+                    v.transpose(0, 2, 1, 3), mode="drop")
+                new_pools.append((kp2, vp2))
+                kc = kp2[gat].transpose(0, 2, 1, 3, 4) \
+                    .reshape(s, hkv, t, hd)
+                vc = vp2[gat].transpose(0, 2, 1, 3, 4) \
+                    .reshape(s, hkv, t, hd)
+                ctx = self._attend(q, kc, vc, mask)     # (S,H,K,hd)
+                return ctx.transpose(0, 2, 1, 3) \
+                    .reshape(s, kk, cfg.num_heads * hd) @ L["o"].T
+
+            x = self._layer(L, x, ctx_fn)
+        x = self._rms(x, w["norm"], cfg.rms_eps)
+        return x @ w["head"].T, new_pools               # (S, K, V)
+
+    def _prefill_suffix_impl(self, w, prefix_kv, ids, t0, s0):
+        """Prompt-SUFFIX prefill attending a reused prefix: the radix
+        prefix cache supplies each row's leading ``s0[b]`` tokens of
+        K/V (``prefix_kv[l] = (K, V)`` each (B, Hkv, Lpre, hd), dense
+        copies gathered from shared pool blocks, sentinel-padded past
+        ``s0[b]``), and only the novel suffix ``ids`` (B, Ls) runs
+        through the transformer.  Suffix row j sits at absolute
+        position ``s0[b] + j`` (RoPE + mask), attends every real prefix
+        column (``t < s0[b]``) plus the suffix causally — bit-identical
+        attention to a full prefill, at suffix-sized projection/MLP
+        cost.  Returns the suffix rows' post-RoPE K/V (for the pool
+        scatter into the request's PRIVATE blocks) and logits at each
+        row's true last suffix position ``t0[b] - 1``.  Rows with no
+        cache hit run with ``s0[b] = 0``: every prefix column masked,
+        plain prefill semantics."""
+        import jax.numpy as jnp
+
+        cfg = self.cfg
+        hd = cfg.head_dim
+        b, ls = ids.shape
+        lpre = prefix_kv[0][0].shape[2]
+        s0 = jnp.asarray(s0, jnp.int32)
+        pw = s0[:, None] + jnp.arange(ls, dtype=jnp.int32)[None, :]
+        pw = jnp.minimum(pw, jnp.int32(self.max_len - 1))
+        cos = self._cos[pw][:, None]                # (B,1,Ls,hd/2)
+        sin = self._sin[pw][:, None]
+        x = w["emb"][ids]                           # (B, Ls, H)
+        mask_pre = (jnp.arange(lpre)[None, None, None, :]
+                    < s0[:, None, None, None])      # (B,1,1,Lpre)
+        mask_pre = jnp.broadcast_to(mask_pre, (b, 1, ls, lpre))
+        mask_suf = jnp.broadcast_to(
+            jnp.tril(jnp.ones((ls, ls), bool))[None, None],
+            (b, 1, ls, ls))
+        mask = jnp.concatenate([mask_pre, mask_suf], axis=-1)
+        rows = []
+        for L, (pk, pv) in zip(w["layers"], prefix_kv):
+
+            def ctx_fn(h, L=L, pk=pk, pv=pv):
+                q = (h @ L["q"].T).reshape(b, ls, cfg.num_heads, hd) \
+                    .transpose(0, 2, 1, 3)
+                k = (h @ L["k"].T).reshape(b, ls, cfg.num_kv_heads, hd) \
+                    .transpose(0, 2, 1, 3)
+                v = (h @ L["v"].T).reshape(b, ls, cfg.num_kv_heads, hd) \
+                    .transpose(0, 2, 1, 3)
+                q = _apply_rope(q, cos, sin)
+                k = _apply_rope(k, cos, sin)
+                rows.append((k, v))
+                kc = jnp.concatenate([pk, k], axis=2)
+                vc = jnp.concatenate([pv, v], axis=2)
+                ctx = self._attend(q, kc, vc, mask)
+                return ctx.transpose(0, 2, 1, 3) \
+                    .reshape(b, ls, cfg.num_heads * hd) @ L["o"].T
+
+            x = self._layer(L, x, ctx_fn)
+        t0v = jnp.asarray(t0, jnp.int32)
+        x_last = jnp.take_along_axis(
+            x, (t0v - 1)[:, None, None], axis=1)[:, 0]
+        x_last = self._rms(x_last, w["norm"], cfg.rms_eps)
+        return rows, x_last @ w["head"].T
+
     def logits_at(self, ids):
         """Teacher-forced per-step decode over ``ids`` (B, T) returning
         logits at every position (B, T, V) — the parity-test surface for

@@ -34,6 +34,16 @@ request across the queue → prefill → handoff → decode thread hops;
 ``ServerConfig(slo={...})`` turns on per-tenant TTFT/TPOT goodput
 accounting (``metrics.SLOTracker``).
 
+Speed multipliers (r19, paged only): ``ServerConfig(draft_net=...)``
+turns on greedy speculative decoding — a small draft llama proposes
+``spec_k`` tokens per slot, the target scores the whole window in ONE
+batched multi-position forward, and rejected suffixes roll back via
+``PagedKVCacheManager.truncate`` (token-exact vs. plain decode by
+construction).  ``ServerConfig(radix_cache=True)`` adds the radix
+prefix cache (``radix.RadixPrefixCache``): block-aligned prompt
+prefixes map to refcounted paged blocks, so requests sharing a system
+prompt prefill only their novel suffix.
+
 Quick start::
 
     from mxnet_tpu import serving
@@ -50,6 +60,7 @@ from .protocol import (Request, ServerClosedError,     # noqa: F401
 from .bucketing import BucketPolicy, pad_batch, pow2_bucket  # noqa: F401
 from .kv_cache import (BlockAllocator, KVCacheManager,  # noqa: F401
                        PagedKVCacheManager)
+from .radix import RadixPrefixCache                    # noqa: F401
 from .scheduler import BatchScheduler, RequestQueue    # noqa: F401
 from .lanes import (DecodeLane, PrefillLane, Replica,  # noqa: F401
                     ReplicaDispatcher)
@@ -60,7 +71,7 @@ from .metrics import (MetricsServer, SLOTracker,       # noqa: F401
 
 __all__ = ["Request", "ServerOverloadedError", "ServerClosedError",
            "BucketPolicy", "pow2_bucket", "pad_batch", "KVCacheManager",
-           "PagedKVCacheManager", "BlockAllocator",
+           "PagedKVCacheManager", "BlockAllocator", "RadixPrefixCache",
            "RequestQueue", "BatchScheduler", "ServerConfig",
            "InferenceServer", "GenerativeServer",
            "PrefillLane", "DecodeLane", "Replica", "ReplicaDispatcher",

@@ -73,6 +73,12 @@ __all__ = ["LlamaServingEngine", "GenerativeScheduler"]
 __compile_signatures__ = {
     "serving_step": "1 per (batch bucket, cache bucket); prefill adds "
                     "1 per prompt bucket",
+    "serving_verify": "1 per engine — the k-token speculative verify "
+                      "window (num_slots, spec_k+1) is shape-static",
+    "serving_gather": "1 per (batch bucket, prefix bucket) — dense "
+                      "prefix copy for suffix prefill",
+    "serving_prefill_sfx": "1 per (batch bucket, prefix bucket, suffix "
+                           "bucket) — radix-hit suffix prefill",
 }
 
 #: matmul weights that the int8 option quantizes (per-output-channel);
@@ -140,7 +146,8 @@ class LlamaServingEngine:
 
     def __init__(self, net, max_len=None, num_slots=4, int8=False,
                  kv_mode="slots", block_size=16, num_blocks=None,
-                 mesh=None, partition_rules=None, replica_id=0):
+                 mesh=None, partition_rules=None, replica_id=0,
+                 spec_k=0):
         import jax
         import jax.numpy as jnp
         from ..models.llama import LlamaDecoder
@@ -148,6 +155,10 @@ class LlamaServingEngine:
         if kv_mode not in ("paged", "slots"):
             raise MXNetError(f"unknown kv_mode {kv_mode!r}; "
                              "expected 'paged' or 'slots'")
+        self.spec_k = int(spec_k)
+        if self.spec_k and kv_mode != "paged":
+            raise MXNetError("speculative verify (spec_k > 0) requires "
+                             "kv_mode='paged'")
         self.max_len = int(max_len or net.config.max_seq_len)
         self.num_slots = int(num_slots)
         self.int8 = bool(int8)
@@ -215,6 +226,41 @@ class LlamaServingEngine:
                 rows, logits = dec._prefill_rows_impl(deq(wq), ids, t0)
                 return jnp.argmax(logits, axis=-1).astype(jnp.int32), rows
 
+            def _verify_fn(wq, pools, tables, toks, pos0):
+                logits, pools = dec._verify_blocks_impl(
+                    deq(wq), pools, tables, toks, pos0)
+                tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                if numerics_on:
+                    return tok, pools, _numerics.stats_of(logits)
+                return tok, pools
+
+            nb_total = self.num_blocks
+
+            def _gather_fn(pools, rows_idx):
+                # rows_idx (KB, NBP) int32 physical block ids in logical
+                # order, sentinel-padded — dense per-row prefix K/V
+                # copies (KB, Hkv, NBP*bs, hd) for the suffix prefill;
+                # sentinel entries clamp to garbage rows the suffix
+                # mask (t < s0) never exposes
+                kb_, nbp_ = rows_idx.shape
+                g = jnp.minimum(rows_idx, nb_total - 1)
+                out = []
+                for kp, vp in pools:
+                    out.append((
+                        kp[g].transpose(0, 2, 1, 3, 4)
+                        .reshape(kb_, kp.shape[1], nbp_ * self.block_size,
+                                 kp.shape[3]),
+                        vp[g].transpose(0, 2, 1, 3, 4)
+                        .reshape(kb_, vp.shape[1], nbp_ * self.block_size,
+                                 vp.shape[3])))
+                return out
+
+            def _prefill_sfx_fn(wq, pre_kv, ids, t0, s0):
+                rows, logits = dec._prefill_suffix_impl(
+                    deq(wq), pre_kv, ids, t0, s0)
+                return jnp.argmax(logits, axis=-1).astype(jnp.int32), \
+                    rows
+
             bs = self.block_size
 
             def _scatter_fn(pools, rows, flat_idx):
@@ -261,6 +307,12 @@ class LlamaServingEngine:
         self._step = jax.jit(_step_fn, donate_argnums=(1,))
         self._prefill = jax.jit(_prefill_fn)
         self._scatter = jax.jit(_scatter_fn, donate_argnums=(0,))
+        if kv_mode == "paged":
+            self._verify = jax.jit(_verify_fn, donate_argnums=(1,))
+            self._gather = jax.jit(_gather_fn)
+            self._prefill_sfx = jax.jit(_prefill_sfx_fn)
+        else:
+            self._verify = self._gather = self._prefill_sfx = None
 
     # -- mesh placement -------------------------------------------------------
     def _place_on_mesh_locked(self):
@@ -349,8 +401,13 @@ class LlamaServingEngine:
             if _retrace._enabled:
                 # registered compile site, one per program (prefill keys
                 # per bucket; a post-warmup unwarmed bucket is a retrace)
-                comps = {"batch": key[1], "prompt_len": key[2]} \
-                    if len(key) == 3 else {"program": key[0]}
+                if len(key) == 4:
+                    comps = {"batch": key[1], "prefix_len": key[2],
+                             "suffix_len": key[3]}
+                elif len(key) == 3:
+                    comps = {"batch": key[1], "prompt_len": key[2]}
+                else:
+                    comps = {"program": key[0]}
                 _retrace.observe(
                     "serving_" + str(key[0]), id(self), comps,
                     site="mxnet_tpu.serving.generative:"
@@ -415,14 +472,21 @@ class LlamaServingEngine:
         return self._prefill(self._w, self._dev(prompts_pad),
                              self._dev(t0s))
 
-    def commit_rows(self, rows, slots, block_lists, t0s, first):
+    def commit_rows(self, rows, slots, block_lists, t0s, first,
+                    skip_blocks=None):
         """Prefill lane, phase 2: the KV handoff.  Under the device
         lock (briefly — one scatter dispatch), write the prefilled rows
         into each admitted request's blocks and install the block
         tables + decode mirrors, after which the decode lane's next
         step adopts the slots.  ``first`` is the already-materialized
         first-token vector (kb,); vacant rows carry slot id
-        ``num_slots`` and sentinel blocks."""
+        ``num_slots`` and sentinel blocks.
+
+        ``skip_blocks`` (r19 radix path): per-row count of leading
+        SHARED prefix blocks already holding K/V — ``rows`` then only
+        carry the novel suffix, the scatter targets the block list past
+        the shared prefix, and ``t0s`` stays the FULL prompt length
+        (the decode cursor).  Shared blocks are never written."""
         import jax.numpy as jnp
 
         kb = len(slots)
@@ -432,8 +496,10 @@ class LlamaServingEngine:
         for r, blocks in enumerate(block_lists):
             if blocks is None:
                 continue
-            take = min(nbp, len(blocks))
-            flat[r * nbp: r * nbp + take] = blocks[:take]
+            skip = 0 if skip_blocks is None else int(skip_blocks[r])
+            tail = blocks[skip:]
+            take = min(nbp, len(tail))
+            flat[r * nbp: r * nbp + take] = tail[:take]
         with self.dev_lock:
             self._pool = self._scatter(self._pool, rows, self._dev(flat))
             for i, s in enumerate(slots):
@@ -445,6 +511,38 @@ class LlamaServingEngine:
                     self._tables[s] = row
                     self._last[s] = first[i]
                     self._pos[s] = t0s[i]
+
+    def gather_prefix(self, rows_idx):
+        """Radix-hit prefill, phase 0: dense per-request copies of the
+        shared prefix blocks' K/V, ``rows_idx`` (kb, nbp) physical ids
+        sentinel-padded.  Dispatch runs UNDER the device lock — the
+        decode step donates the pool buffer, so an unlocked read could
+        alias a donated buffer mid-step; the returned copies are fresh
+        arrays, safe to consume outside the lock."""
+        if self.kv_mode != "paged":
+            raise MXNetError("gather_prefix() requires kv_mode='paged'")
+        kb, nbp = rows_idx.shape
+        self._note(("gather", kb, nbp * self.block_size))
+        with self.dev_lock:
+            return self._gather(self._pool, self._dev(rows_idx))
+
+    def prefill_suffix(self, prefix_kv, prompts_pad, t0s, s0s):
+        """Radix-hit prefill, phase 1: the novel-suffix forward against
+        the gathered prefix K/V.  Like :meth:`prefill_rows` this runs
+        WITHOUT the device lock (``prefix_kv`` is a private copy).
+        ``prompts_pad`` (kb, ls) carries only suffix tokens, ``t0s``
+        their true suffix lengths, ``s0s`` each row's reused prefix
+        length (block-aligned; 0 = no hit).  Returns (first-token
+        device array, suffix K/V rows) for
+        :meth:`commit_rows(..., skip_blocks=)`."""
+        if self.kv_mode != "paged":
+            raise MXNetError("prefill_suffix() requires kv_mode='paged'")
+        kb, ls = prompts_pad.shape
+        lpre = prefix_kv[0][0].shape[2]
+        self._note(("prefill_sfx", kb, lpre, ls))
+        return self._prefill_sfx(self._w, prefix_kv,
+                                 self._dev(prompts_pad),
+                                 self._dev(t0s), self._dev(s0s))
 
     # -- transitions (both modes) ---------------------------------------------
     def step(self, active):
@@ -490,6 +588,59 @@ class LlamaServingEngine:
                 self._last[s] = out[s]
                 self._pos[s] += 1
         return out
+
+    def verify(self, drafts):
+        """Speculative decode: ONE multi-position target forward over
+        the window ``[last_committed, draft_1..draft_k]`` per slot.
+        ``drafts`` is (num_slots, k) int32 (vacant rows are ignored —
+        their writes drop at the sentinel).  Returns the (num_slots,
+        k+1) greedy verdict matrix on host: column j is the target's
+        next token after consuming the window's first j+1 tokens.
+
+        Unlike :meth:`step` the mirrors are NOT advanced here — the
+        decode lane computes each slot's accepted length, rolls the
+        manager back via ``truncate``, and commits the mirrors with
+        :meth:`set_mirror`.  The window's K/V lands in the pool
+        optimistically; rejected columns stay beyond the rolled-back
+        cursor (masked) until the next window overwrites them."""
+        if self._verify is None:
+            raise MXNetError("verify() requires kv_mode='paged'")
+        self._note(("verify",))
+        lstats = None
+        with self.dev_lock:
+            toks_mat = np.concatenate(
+                [self._last[:, None], np.asarray(drafts, np.int32)],
+                axis=1)
+            if self._numerics:
+                out, pool, lstats = self._verify(
+                    self._w, self._pool, self._dev(self._tables),
+                    self._dev(toks_mat), self._dev(self._pos))
+            else:
+                out, pool = self._verify(
+                    self._w, self._pool, self._dev(self._tables),
+                    self._dev(toks_mat), self._dev(self._pos))
+            self._pool = pool
+            self.steps += 1
+        if lstats is not None:
+            _numerics.record_compiled(("serving.logits",), (lstats,))
+        return _materialize([out])[0]
+
+    def last_tokens(self):
+        """Snapshot of the per-slot last-committed-token mirror."""
+        with self.dev_lock:
+            return self._last.copy()
+
+    def positions(self):
+        """Snapshot of the per-slot committed write cursors."""
+        with self.dev_lock:
+            return self._pos.copy()
+
+    def set_mirror(self, slot, last, pos):
+        """Commit a slot's decode mirror (speculative acceptance, or
+        aligning a draft engine's cursor with the target's)."""
+        with self.dev_lock:
+            self._last[slot] = int(last)
+            self._pos[slot] = int(pos)
 
     def clear_slot(self, slot):
         with self.dev_lock:

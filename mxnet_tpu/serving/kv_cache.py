@@ -63,8 +63,17 @@ class BlockAllocator:
 
     ``alloc`` is all-or-nothing (a request either gets its whole block
     list or stays queued — no partial reservations to unwind), and
-    ``free`` rejects double-frees and foreign ids, so a block can never
-    be owned by two sequences at once.
+    ``free`` rejects double-frees and foreign ids.
+
+    Since r19 every allocated block carries a **refcount**: ``alloc``
+    hands out blocks at refcount 1, ``share`` grants an additional
+    holder (the radix prefix cache, or a request reusing a cached
+    prefix), and ``release`` drops one reference — the block returns to
+    the free list only at refcount 0.  ``free`` is ``release`` under
+    its historical name, so single-holder callers behave exactly as
+    before (including the double-free guard).  Shared blocks are
+    strictly read-shared: only *full prompt-prefix* blocks are ever
+    shared, and no decode or verify write targets a row inside them.
     """
 
     def __init__(self, num_blocks, block_size):
@@ -74,7 +83,9 @@ class BlockAllocator:
         self.block_size = int(block_size)
         self._free = list(range(self.num_blocks - 1, -1, -1))  # pop()->0
         self._in_use = set()
+        self._refs = {}             # block id -> holder count (>= 1)
         self._peak_in_use = 0
+        self._peak_shared = 0
 
     @property
     def free_blocks(self):
@@ -88,27 +99,64 @@ class BlockAllocator:
     def peak_blocks_in_use(self):
         return self._peak_in_use
 
+    @property
+    def shared_blocks(self):
+        """Blocks currently held by more than one owner."""
+        return sum(1 for c in self._refs.values() if c > 1)
+
+    @property
+    def peak_shared_blocks(self):
+        return self._peak_shared
+
+    def refcount(self, block):
+        """Holder count for ``block`` (0 when free)."""
+        return self._refs.get(block, 0)
+
     def alloc(self, n):
-        """Claim ``n`` blocks (ascending ids).  Returns the id list, or
-        None when the pool cannot cover the request (all-or-nothing)."""
+        """Claim ``n`` blocks (ascending ids) at refcount 1.  Returns
+        the id list, or None when the pool cannot cover the request
+        (all-or-nothing)."""
         if n < 0:
             raise MXNetError(f"cannot allocate {n} blocks")
         if n > len(self._free):
             return None
         blocks = [self._free.pop() for _ in range(n)]
         self._in_use.update(blocks)
+        for b in blocks:
+            self._refs[b] = 1
         self._peak_in_use = max(self._peak_in_use, len(self._in_use))
         return blocks
 
-    def free(self, blocks):
-        """Return ``blocks`` to the pool; double-free / unknown ids
-        raise (the no-double-assignment invariant's enforcement edge)."""
+    def share(self, blocks):
+        """Grant one additional reference to each of ``blocks``.  Every
+        block must already be allocated — sharing a free block would
+        resurrect contents the pool no longer guarantees."""
+        for b in blocks:
+            if b not in self._in_use:
+                raise MXNetError(f"cannot share free block {b}")
+        for b in blocks:
+            self._refs[b] += 1
+        self._peak_shared = max(self._peak_shared, self.shared_blocks)
+
+    def release(self, blocks):
+        """Drop one reference from each of ``blocks``; a block returns
+        to the free list only when its last holder lets go.  Unknown /
+        already-free ids raise (the no-double-assignment invariant's
+        enforcement edge, unchanged from the pre-refcount ``free``)."""
         for b in blocks:
             if b not in self._in_use:
                 raise MXNetError(f"block {b} is not allocated")
         for b in blocks:
-            self._in_use.discard(b)
-            self._free.append(b)
+            self._refs[b] -= 1
+            if self._refs[b] == 0:
+                del self._refs[b]
+                self._in_use.discard(b)
+                self._free.append(b)
+
+    def free(self, blocks):
+        """Historical name for :meth:`release` (identical semantics for
+        refcount-1 blocks, which is every block before r19)."""
+        self.release(blocks)
 
     def check(self):
         free = set(self._free)
@@ -119,6 +167,12 @@ class BlockAllocator:
                 f"blocks both free and in use: {free & self._in_use}")
         if free | self._in_use != set(range(self.num_blocks)):
             raise MXNetError("block pool lost track of blocks")
+        if set(self._refs) != self._in_use:
+            raise MXNetError("refcount table does not match the in-use "
+                             "set")
+        bad = [b for b, c in self._refs.items() if c < 1]
+        if bad:
+            raise MXNetError(f"allocated blocks with refcount < 1: {bad}")
         return True
 
 
@@ -258,6 +312,11 @@ class PagedKVCacheManager:
         self._peak_occupancy = 0
         self._peak_tokens = 0
         self._lock = threading.RLock()
+        #: optional :class:`~mxnet_tpu.serving.radix.RadixPrefixCache`
+        #: holding its own references on cached prefix blocks; consulted
+        #: by ``check()`` so the refcount invariant covers cache-held
+        #: blocks too.
+        self.prefix_cache = None
 
     # -- queries --------------------------------------------------------------
     def blocks_for(self, prompt_len, max_new_tokens):
@@ -287,9 +346,24 @@ class PagedKVCacheManager:
         with self._lock:
             return sum(st.pos for st in self._active.values())
 
+    def _holders(self):
+        """block id -> number of active block lists containing it
+        (callers hold the lock)."""
+        holders = {}
+        for st in self._active.values():
+            for b in st.blocks:
+                holders[b] = holders.get(b, 0) + 1
+        return holders
+
     def reserved_tokens(self):
+        """Token capacity reserved by active requests, counting each
+        shared prefix block's capacity ONCE — the pool only spends one
+        block however many requests read it."""
         with self._lock:
-            return sum(st.reserved for st in self._active.values())
+            total = sum(st.reserved for st in self._active.values())
+            over = sum((c - 1) * self.block_size
+                       for c in self._holders().values() if c > 1)
+            return total - over
 
     def stats(self):
         """Slot counters plus pool metrics.  ``fragmentation`` here is
@@ -298,6 +372,12 @@ class PagedKVCacheManager:
         decode budget allocated ahead of the write cursor)."""
         with self._lock:
             live = sum(st.pos for st in self._active.values())
+            # shared prefix blocks store their rows ONCE however many
+            # slots read them: subtract the duplicate holders' share so
+            # utilization / fragmentation describe physical rows.
+            over = sum((c - 1) * self.block_size
+                       for c in self._holders().values() if c > 1)
+            live_unique = live - over
             used = self.allocator.blocks_in_use
             alloc_cap = used * self.block_size
             cap = self.num_blocks * self.block_size
@@ -310,30 +390,51 @@ class PagedKVCacheManager:
                 "block_size": self.block_size,
                 "blocks_in_use": used,
                 "peak_blocks_in_use": self.allocator.peak_blocks_in_use,
+                "shared_blocks": self.allocator.shared_blocks,
+                "peak_shared_blocks": self.allocator.peak_shared_blocks,
                 "capacity_tokens": cap,
-                "tokens_in_flight": int(live),
+                "tokens_in_flight": int(live_unique),
+                "reserved_tokens": int(self.reserved_tokens()),
                 "peak_tokens": int(self._peak_tokens),
-                "utilization": round(live / cap, 4) if cap else 0.0,
-                "fragmentation": round(1.0 - live / alloc_cap, 4)
+                "utilization": round(live_unique / cap, 4) if cap
+                else 0.0,
+                "fragmentation": round(1.0 - live_unique / alloc_cap, 4)
                 if alloc_cap else 0.0,
             }
 
     # -- transitions ----------------------------------------------------------
-    def admit(self, request_id, prompt_len, max_new_tokens, step=0):
+    def admit(self, request_id, prompt_len, max_new_tokens, step=0,
+              shared_blocks=None):
         """Claim a slot AND the request's full block list.  Returns
         ``(slot, blocks)`` or None when either is unavailable (the
-        request stays queued)."""
+        request stays queued).
+
+        ``shared_blocks`` (r19): already-allocated prefix blocks the
+        request will read instead of prefilling — the radix cache's
+        lookup result, in logical order, covering whole leading blocks
+        of the prompt.  They are ``share()``d (the request's own
+        reference) and only the remainder of the block list is freshly
+        allocated; on admit failure no references are taken."""
         if prompt_len + max_new_tokens > self.max_len:
             raise MXNetError(
                 f"sequence budget {prompt_len}+{max_new_tokens} exceeds "
                 f"cache max_len {self.max_len}")
-        need = self.blocks_for(prompt_len, max_new_tokens)
+        shared = list(shared_blocks) if shared_blocks else []
+        need = self.blocks_for(prompt_len, max_new_tokens) - len(shared)
+        if need < 0:
+            raise MXNetError(
+                f"{len(shared)} shared prefix blocks exceed the "
+                f"request's {self.blocks_for(prompt_len, max_new_tokens)}"
+                "-block budget")
         with self._lock:
             if not self._free:
                 return None
-            blocks = self.allocator.alloc(need)
-            if blocks is None:
+            fresh = self.allocator.alloc(need)
+            if fresh is None:
                 return None
+            if shared:
+                self.allocator.share(shared)
+            blocks = shared + fresh
             slot = self._free.pop()
             self._active[slot] = SlotState(
                 request_id, prompt_len, max_new_tokens, step,
@@ -358,6 +459,51 @@ class PagedKVCacheManager:
                 self._peak_tokens,
                 sum(s.pos for s in self._active.values()))
 
+    def advance_n(self, slot, n):
+        """``n`` decode/verify writes landed for ``slot`` in one
+        dispatch (the k-token verify forward): bump the cursor by ``n``.
+        The caller rolls back any rejected suffix with
+        :meth:`truncate`."""
+        if n < 0:
+            raise MXNetError(f"cannot advance by {n}")
+        with self._lock:
+            st = self._active[slot]
+            st.pos += int(n)
+            if st.pos > st.reserved:
+                raise MXNetError(
+                    f"slot {slot} overran its reserved {st.reserved} "
+                    "tokens")
+            self._peak_tokens = max(
+                self._peak_tokens,
+                sum(s.pos for s in self._active.values()))
+
+    def truncate(self, slot, pos):
+        """Roll ``slot``'s write cursor back to ``pos`` (speculative
+        rejection, or an early stop releasing unused budget).  The
+        reservation shrinks to what the sequence can still need
+        (``pos + remaining``) and whole blocks past the new reservation
+        return to the pool; returns the released block ids.
+
+        No device-side cleanup happens: rejected rows sit beyond the
+        causal mask (``t <= pos``) until the next verify/decode write
+        overwrites them — the same stale-row invariant that lets a
+        fresh block skip zeroing."""
+        with self._lock:
+            st = self._active[slot]
+            if not 0 <= pos <= st.pos:
+                raise MXNetError(
+                    f"truncate target {pos} outside [0, {st.pos}] for "
+                    f"slot {slot}")
+            st.pos = int(pos)
+            st.reserved = min(st.reserved,
+                              st.pos + max(int(st.remaining), 0))
+            need = max(-(-st.reserved // self.block_size), 0)
+            released = st.blocks[need:]
+            if released:
+                st.blocks = st.blocks[:need]
+                self.allocator.release(released)
+            return released
+
     def consume(self, slot):
         with self._lock:
             st = self._active[slot]
@@ -365,20 +511,25 @@ class PagedKVCacheManager:
             return st.remaining <= 0
 
     def evict(self, slot):
-        """Release the slot and return ALL of its blocks to the pool."""
+        """Release the slot and drop the request's reference on every
+        block it held; blocks shared with the radix cache or another
+        request stay allocated for the remaining holders."""
         with self._lock:
             if slot not in self._active:
                 raise MXNetError(f"slot {slot} is not active")
             st = self._active.pop(slot)
-            self.allocator.free(st.blocks)
+            self.allocator.release(st.blocks)
             self._free.append(slot)
             self._evictions += 1
             return st.blocks
 
     def check(self):
-        """Slot invariants + block invariants: the active block lists
-        partition the allocator's in-use set (no block in two lists, no
-        leaked allocation), and every list covers its reservation."""
+        """Slot invariants + block invariants.  Since r19 block lists
+        may overlap on shared prefix blocks, so the partition check
+        becomes a refcount check: every allocated block's holder count
+        must equal the number of active block lists containing it plus
+        one if the radix prefix cache holds it, and the union of all
+        holders must cover the allocator's in-use set exactly."""
         with self._lock:
             free = set(self._free)
             active = set(self._active)
@@ -387,7 +538,6 @@ class PagedKVCacheManager:
                     f"slots both free and active: {free & active}")
             if free | active != set(range(self.num_slots)):
                 raise MXNetError("slot ledger lost track of slots")
-            owned = []
             for slot, st in self._active.items():
                 if not 0 <= st.pos <= st.reserved <= self.max_len:
                     raise MXNetError(
@@ -398,12 +548,24 @@ class PagedKVCacheManager:
                         f"slot {slot} blocks cover "
                         f"{len(st.blocks) * self.block_size} < reserved "
                         f"{st.reserved} tokens")
-                owned.extend(st.blocks)
-            if len(owned) != len(set(owned)):
-                raise MXNetError("a block appears in two block lists")
-            if set(owned) != self.allocator._in_use:
+                if len(st.blocks) != len(set(st.blocks)):
+                    raise MXNetError(
+                        f"slot {slot} lists a block twice")
+            holders = self._holders()
+            cached = (self.prefix_cache.block_refs()
+                      if self.prefix_cache is not None else {})
+            union = set(holders) | set(cached)
+            if union != self.allocator._in_use:
                 raise MXNetError(
-                    "active block lists do not match the allocator's "
-                    "in-use set")
+                    "active block lists + cached prefixes do not match "
+                    "the allocator's in-use set")
+            for b in union:
+                want = holders.get(b, 0) + cached.get(b, 0)
+                have = self.allocator.refcount(b)
+                if have != want:
+                    raise MXNetError(
+                        f"block {b} refcount {have} != {want} holders "
+                        f"({holders.get(b, 0)} slots + "
+                        f"{cached.get(b, 0)} cached)")
             self.allocator.check()
             return True

@@ -157,7 +157,15 @@ class PrefillLane:
                     q.wait_for_item(self.poll_s)
 
     def _bucket(self, req):
-        return self.r.policy.length_bucket(len(req.prompt_ids))
+        """Prompt-length bucket — of the NOVEL SUFFIX when the radix
+        prefix cache is on (the prefill program only sees the suffix;
+        ``match_len`` is non-mutating, so bucketing probes don't churn
+        LRU state).  The prefill thread is the trie's only mutator, so
+        the probe here and the real lookup in ``_admit_batch`` agree."""
+        plen = len(req.prompt_ids)
+        if self.r.radix is not None:
+            plen -= self.r.radix.match_len(req.prompt_ids)
+        return self.r.policy.length_bucket(plen)
 
     def _admit_batch(self):
         """One prefill batch: gate → admit → forward (unlocked) →
@@ -172,9 +180,13 @@ class PrefillLane:
 
         def accept(req):
             # the lane's own batch policy: greedy by token count under
-            # the block budget, not a fixed request count
+            # the block budget, not a fixed request count (a radix hit
+            # shrinks the fresh-block need by the shared prefix)
             need = mgr.blocks_for(len(req.prompt_ids),
                                   req.max_new_tokens)
+            if r.radix is not None:
+                need -= r.radix.match_len(req.prompt_ids) \
+                    // mgr.block_size
             if budget["n"] >= free_slots:
                 return False
             if budget["blocks"] + need > free_blocks:
@@ -196,21 +208,54 @@ class PrefillLane:
         lb = self._bucket(group[0])
         kb = r.policy.batch_bucket(len(group))
         eng = r.engine
+        rx = r.radix
         try:
-            prompts = pad_batch([np.asarray(q.prompt_ids, np.int32)
-                                 for q in group], kb, lb)
+            if rx is not None:
+                # real lookup (bumps LRU, counts hits); no references
+                # are taken until admit() shares under the manager lock
+                t_rx0 = time.perf_counter()
+                matched, shared = [], []
+                for req in group:
+                    m, blks = rx.lookup(req.prompt_ids)
+                    matched.append(m)
+                    shared.append(blks)
+                t_rx1 = time.perf_counter()
+                hits = sum(1 for m in matched if m)
+                telemetry.count("serving.radix_hits", hits)
+                telemetry.count("serving.radix_misses",
+                                len(group) - hits)
+                if any(matched):
+                    telemetry.count("serving.radix_hit_tokens",
+                                    sum(matched))
+            else:
+                t_rx0 = t_rx1 = t_start
+                matched = [0] * len(group)
+                shared = [None] * len(group)
+            prompts = pad_batch(
+                [np.asarray(q.prompt_ids[matched[i]:], np.int32)
+                 for i, q in enumerate(group)], kb, lb)
             t0s = np.full(kb, len(group[0].prompt_ids), np.int32)
+            t0s_suf = np.full(
+                kb, len(group[0].prompt_ids) - matched[0], np.int32)
+            s0s = np.zeros(kb, np.int32)
+            skip = np.zeros(kb, np.int32)
             slots = np.full(kb, eng.num_slots, np.int32)
             block_lists = [None] * kb
             for i, req in enumerate(group):
                 t0s[i] = len(req.prompt_ids)
+                t0s_suf[i] = t0s[i] - matched[i]
+                s0s[i] = matched[i]
+                skip[i] = matched[i] // mgr.block_size
                 slot, blocks = mgr.admit(req.id, int(t0s[i]),
                                          req.max_new_tokens,
-                                         step=eng.steps)
+                                         step=eng.steps,
+                                         shared_blocks=shared[i] or None)
                 slots[i] = slot
                 block_lists[i] = blocks
                 req.slot = int(slot)
                 req.kv_blocks = len(blocks)
+                if rx is not None:
+                    req.prefix_hit_tokens = matched[i]
                 req.replica = r.index
                 req.joined_step = eng.steps
                 req.t_start = t_start
@@ -219,9 +264,45 @@ class PrefillLane:
             with telemetry.span("serving.prefill",
                                 {"lane": "prefill", "replica": r.index,
                                  "batch": kb, "length": lb}):
-                toks, rows = eng.prefill_rows(prompts, t0s)
+                if rx is not None and any(matched):
+                    # radix-hit path: dense prefix copies (locked
+                    # gather) feed the suffix-only forward (unlocked);
+                    # the commit scatters ONLY the suffix rows into the
+                    # request's private blocks past the shared prefix
+                    pre_lb = r.policy.length_bucket(max(matched))
+                    nbp_pre = -(-pre_lb // mgr.block_size)
+                    rows_idx = np.full((kb, nbp_pre), eng.num_blocks,
+                                       np.int32)
+                    for i in range(len(group)):
+                        rows_idx[i, :skip[i]] = \
+                            block_lists[i][:skip[i]]
+                    pre_kv = eng.gather_prefix(rows_idx)
+                    toks, rows = eng.prefill_suffix(pre_kv, prompts,
+                                                    t0s_suf, s0s)
+                else:
+                    toks, rows = eng.prefill_rows(prompts, t0s_suf)
                 first = _lane_materialize([toks])[0]
-                eng.commit_rows(rows, slots, block_lists, t0s, first)
+                eng.commit_rows(rows, slots, block_lists, t0s, first,
+                                skip_blocks=skip)
+            if rx is not None:
+                # register the full prompt blocks (device-ordered after
+                # the commit scatter) so later requests share them
+                for i, req in enumerate(group):
+                    rx.insert(req.prompt_ids, block_lists[i])
+            if r.draft is not None:
+                # the draft engine prefills the FULL prompt into its
+                # own slot caches, then aligns its mirror with the
+                # target's first token (draft.admit picked its own)
+                lbf = r.policy.length_bucket(
+                    max(len(q.prompt_ids) for q in group))
+                fulls = pad_batch([np.asarray(q.prompt_ids, np.int32)
+                                   for q in group], kb, lbf)
+                r.draft.admit(fulls, t0s, slots)
+                for i in range(len(group)):
+                    s = int(slots[i])
+                    if s < eng.num_slots:
+                        r.draft.set_mirror(s, int(first[i]),
+                                           int(t0s[i]))
         except Exception as exc:
             for req in group:
                 if req.slot is not None and req.slot in mgr._active:
@@ -240,11 +321,22 @@ class PrefillLane:
         mates = [req.id for req in group]
         for i, req in enumerate(group):
             req.t_first = t_first
+            if rx is not None and matched[i] and t0s_suf[i] > 0:
+                # prefill cost scales ~linearly in prompt tokens, so
+                # the saved share is the reused fraction scaled onto
+                # the measured suffix prefill (a documented estimate)
+                pf_ms = (t_first - t_rx1) * 1e3
+                req.prefill_saved_ms = pf_ms * matched[i] \
+                    / int(t0s_suf[i])
             if req.trace is not None:
                 # retroactive spans from the stamps above: queue covers
                 # dispatch + bucket dwell, prefill the forward + commit
                 req.trace.add("queue", req.t_submit, t_start,
                               replica=r.index)
+                if rx is not None:
+                    req.trace.add("radix_lookup", t_rx0, t_rx1,
+                                  replica=r.index,
+                                  hit_tokens=matched[i])
                 req.trace.add("prefill", t_start, t_first,
                               replica=r.index, slot=req.slot,
                               kv_blocks=req.kv_blocks,
@@ -339,12 +431,13 @@ class DecodeLane:
                                       "error": repr(exc)})
 
     def _run(self):
+        spec = self.r.spec_k > 0 and self.r.draft is not None
         while True:
             self._adopt()
             with self._hand_lock:
                 busy = bool(self._seqs)
             if busy:
-                self._tick()
+                self._tick_spec() if spec else self._tick()
             elif self._stop.is_set():
                 if not self.pending():
                     break
@@ -419,6 +512,102 @@ class DecodeLane:
                     del self._seqs[slot]
                 r.finish(req, tokens)
 
+    def _tick_spec(self):
+        """Speculative tick: k sequential DRAFT steps propose a window,
+        ONE target verify scores all k+1 positions, and greedy
+        token-exact acceptance commits the matched prefix plus (below
+        full acceptance) the target's correction token — bit-identical
+        output to plain decode (every emitted token is a target argmax
+        given previously emitted tokens), at one target forward per
+        up-to-k tokens.
+
+        Rollback is host-side only: the manager's cursor advances by
+        the full window then truncates to the accepted position; the
+        rejected rows' K/V sits masked in the pool until the next
+        window overwrites it (kv_cache.truncate's stale-row
+        contract)."""
+        r = self.r
+        k = r.spec_k
+        with self._hand_lock:
+            active = sorted(self._seqs)
+        t0 = time.perf_counter()
+        proposals = np.zeros((r.engine.num_slots, k), np.int32)
+        try:
+            for j in range(k):
+                # draft mirrors auto-advance, so step j+1 is
+                # conditioned on the draft's own proposal j
+                proposals[:, j] = r.draft.step(active)
+            t_draft = time.perf_counter()
+            pos0 = r.engine.positions()
+            out = r.engine.verify(proposals)
+        except Exception as exc:
+            for slot in active:
+                with self._hand_lock:
+                    req, _ = self._seqs.pop(slot)
+                r.mgr.evict(slot)
+                r.engine.clear_slot(slot)
+                r.draft.clear_slot(slot)
+                req.future.set_exception(exc)
+                r.fail(req, exc, lane="decode")
+            r.capacity_evt.set()
+            tracing.incident("replica_exception",
+                             context={"replica": r.index,
+                                      "lane": "decode",
+                                      "error": repr(exc)})
+            return
+        t1 = time.perf_counter()
+        r.batches += 1
+        telemetry.hist("serving.batch_size", len(active))
+        telemetry.gauge("serving.kv_blocks_in_use",
+                        r.mgr.allocator.blocks_in_use)
+        step_idx = r.engine.steps
+        for slot in active:
+            d, g = proposals[slot], out[slot]
+            m = 0
+            while m < k and d[m] == g[m]:
+                m += 1
+            st = r.mgr.state(slot)
+            # accepted = matched drafts + the target's own next token,
+            # capped at k (on full acceptance the bonus token is NOT
+            # taken: the draft's cache only holds rows for [last,
+            # d1..d_{k-1}], so emitting g_{k+1} would leave the draft a
+            # KV row short and poison every later proposal) and clamped
+            # to the tokens still owed (never over-emit)
+            acc = min(m + 1, k, int(st.remaining))
+            adv = min(k + 1, int(st.reserved) - int(st.pos))
+            r.mgr.advance_n(slot, adv)
+            r.mgr.truncate(slot, int(pos0[slot]) + acc)
+            last = int(g[acc - 1])
+            r.engine.set_mirror(slot, last, int(pos0[slot]) + acc)
+            r.draft.set_mirror(slot, last, int(pos0[slot]) + acc)
+            with self._hand_lock:
+                req, tokens = self._seqs[slot]
+            tokens.extend(int(t) for t in g[:acc])
+            got = min(m, acc)
+            req.draft_tokens += k
+            req.accepted_tokens += got
+            r.draft_tokens += k
+            r.accepted_tokens += got
+            telemetry.count("serving.accepted_tokens", got)
+            if req.trace is not None:
+                req.trace.add("draft", t0, t_draft, step=step_idx,
+                              k=k, replica=r.index, slot=slot)
+                req.trace.add("verify", t_draft, t1, step=step_idx,
+                              accepted=acc, replica=r.index, slot=slot)
+            done = False
+            for _ in range(acc):
+                if r.mgr.consume(slot):
+                    done = True
+            if done:
+                with self._hand_lock:
+                    del self._seqs[slot]
+                r.finish(req, tokens)
+        telemetry.count("serving.draft_tokens", k * len(active))
+        if r.draft_tokens:
+            telemetry.gauge("serving.accept_rate",
+                            round(r.accepted_tokens
+                                  / r.draft_tokens, 4))
+
 
 class Replica:
     """One model replica: engine + paged-KV manager + lane pair over
@@ -427,20 +616,47 @@ class Replica:
     def __init__(self, net, policy, index=0, mesh=None,
                  partition_rules=None, num_slots=4, int8=False,
                  block_size=16, num_blocks=None, queue_capacity=64,
-                 max_prefill_tokens=None, summary_every=32, slo=None):
+                 max_prefill_tokens=None, summary_every=32, slo=None,
+                 draft_net=None, spec_k=0, radix_cache=False,
+                 prefix_cache_tokens=None):
         from .generative import LlamaServingEngine
 
         self.index = int(index)
         self.policy = policy
+        self.spec_k = int(spec_k) if draft_net is not None else 0
         self.engine = LlamaServingEngine(
             net, max_len=policy.max_length, num_slots=num_slots,
             int8=int8, kv_mode="paged", block_size=block_size,
             num_blocks=num_blocks, mesh=mesh,
-            partition_rules=partition_rules, replica_id=self.index)
+            partition_rules=partition_rules, replica_id=self.index,
+            spec_k=self.spec_k)
+        self.draft = None
+        if self.spec_k > 0:
+            # the draft runs the r8 slot-ledger engine: fixed per-slot
+            # cache rows, no block bookkeeping to keep consistent with
+            # the target's pool — its k sequential steps are cheap by
+            # model size, not by storage cleverness
+            self.draft = LlamaServingEngine(
+                draft_net, max_len=policy.max_length,
+                num_slots=num_slots, int8=int8, kv_mode="slots",
+                mesh=mesh, partition_rules=partition_rules,
+                replica_id=self.index)
         self.mgr = PagedKVCacheManager(
             num_slots, policy.max_length,
             num_blocks=self.engine.num_blocks,
             block_size=self.engine.block_size)
+        self.radix = None
+        if radix_cache:
+            from .radix import RadixPrefixCache
+            cap = int(prefix_cache_tokens
+                      if prefix_cache_tokens is not None
+                      else self.engine.num_blocks
+                      * self.engine.block_size // 2)
+            self.radix = RadixPrefixCache(self.mgr.allocator,
+                                          self.engine.block_size, cap)
+            self.mgr.prefix_cache = self.radix
+        self.draft_tokens = 0
+        self.accepted_tokens = 0
         self.queue = RequestQueue(queue_capacity)
         self.max_prefill_tokens = int(max_prefill_tokens or
                                       policy.max_batch
@@ -487,6 +703,8 @@ class Replica:
     def finish(self, req, tokens):
         self.mgr.evict(req.slot)
         self.engine.clear_slot(req.slot)
+        if self.draft is not None:
+            self.draft.clear_slot(req.slot)
         self.capacity_evt.set()
         req.t_done = time.perf_counter()
         req.done_step = self.engine.steps
@@ -541,7 +759,7 @@ class Replica:
                            error=repr(exc), request_id=req.id)
 
     def emit_summary(self):
-        telemetry.emit({
+        rec = {
             "record": "serving.latency",
             "replica": self.index,
             "completed": self.completed,
@@ -553,7 +771,19 @@ class Replica:
             "handoff_ms": telemetry.hist_summary("serving.handoff_ms"),
             "batch_size": telemetry.hist_summary("serving.batch_size"),
             "kv_cache": self.mgr.stats(),
-        })
+        }
+        if self.draft is not None:
+            rec["speculative"] = {
+                "k": self.spec_k,
+                "draft_tokens": self.draft_tokens,
+                "accepted_tokens": self.accepted_tokens,
+                "accept_rate": round(self.accepted_tokens
+                                     / self.draft_tokens, 4)
+                if self.draft_tokens else None,
+            }
+        if self.radix is not None:
+            rec["radix_cache"] = self.radix.stats()
+        telemetry.emit(rec)
 
 
 class ReplicaDispatcher:

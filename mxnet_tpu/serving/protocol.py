@@ -60,7 +60,8 @@ class Request:
                  "future", "t_submit", "t_start", "t_first", "t_done",
                  "batch_size", "bucket", "slot", "joined_step",
                  "done_step", "replica", "t_handoff", "kv_blocks",
-                 "trace", "tenant")
+                 "trace", "tenant", "draft_tokens", "accepted_tokens",
+                 "prefix_hit_tokens", "prefill_saved_ms")
 
     def __init__(self, inputs=None, length=None, prompt_ids=None,
                  max_new_tokens=None, tenant=None):
@@ -88,6 +89,11 @@ class Request:
         # serving call site guards on that None) and the SLO tenant
         self.trace = None
         self.tenant = tenant
+        # speculative decoding + radix prefix cache (r19)
+        self.draft_tokens = 0        # draft proposals scored for us
+        self.accepted_tokens = 0     # proposals the target agreed with
+        self.prefix_hit_tokens = None  # prompt tokens reused from cache
+        self.prefill_saved_ms = None   # estimated prefill ms not spent
 
     def tpot_ms(self):
         """Time-per-output-token: decode milliseconds per generated
@@ -141,4 +147,17 @@ class Request:
             # prefill→decode KV handoff latency: first token emitted by
             # the prefill forward → decode lane adopted the slot
             rec["handoff_ms"] = (self.t_handoff - self.t_first) * 1e3
+        if self.t_first is not None and self.t_start is not None:
+            # prompt-processing wall time (dequeue → first token): the
+            # figure the radix prefix cache exists to shrink
+            rec["prefill_ms"] = (self.t_first - self.t_start) * 1e3
+        if self.draft_tokens:
+            rec["draft_tokens"] = self.draft_tokens
+            rec["accepted_tokens"] = self.accepted_tokens
+            rec["accept_rate"] = round(self.accepted_tokens
+                                       / self.draft_tokens, 4)
+        if self.prefix_hit_tokens is not None:
+            rec["prefix_hit_tokens"] = self.prefix_hit_tokens
+        if self.prefill_saved_ms is not None:
+            rec["prefill_saved_ms"] = round(self.prefill_saved_ms, 3)
         return rec

@@ -54,7 +54,8 @@ class ServerConfig:
                  num_slots=4, max_new_tokens=32, int8=False,
                  calib_data=None, kv_mode="paged", block_size=16,
                  num_blocks=None, http_port=None, http_host="127.0.0.1",
-                 slo=None, slo_window=256):
+                 slo=None, slo_window=256, draft_net=None, spec_k=3,
+                 radix_cache=False, prefix_cache_tokens=None):
         self.policy = BucketPolicy(max_batch=max_batch,
                                    max_length=max_length,
                                    min_batch=min_batch,
@@ -89,6 +90,16 @@ class ServerConfig:
         self.http_host = str(http_host)
         self.slo = slo
         self.slo_window = int(slo_window)
+        # speculative decoding + radix prefix cache (r19, paged only):
+        # ``draft_net`` switches speculation on (the small proposer
+        # model; ``spec_k`` proposals per slot per verify), and
+        # ``radix_cache`` turns on prompt-prefix KV reuse with an LRU
+        # budget of ``prefix_cache_tokens`` (None = half the pool).
+        self.draft_net = draft_net
+        self.spec_k = int(spec_k)
+        self.radix_cache = bool(radix_cache)
+        self.prefix_cache_tokens = prefix_cache_tokens \
+            if prefix_cache_tokens is None else int(prefix_cache_tokens)
 
 
 class _ServerBase:
@@ -346,6 +357,10 @@ class GenerativeServer(_ServerBase):
                 raise MXNetError(
                     "kv_mode='slots' runs the single-loop scheduler; "
                     "dp replicas need kv_mode='paged'")
+            if cfg.draft_net is not None or cfg.radix_cache:
+                raise MXNetError(
+                    "speculative decoding and the radix prefix cache "
+                    "require kv_mode='paged'")
             self.engine = LlamaServingEngine(
                 net, max_len=cfg.policy.max_length,
                 num_slots=cfg.num_slots, int8=cfg.int8,
@@ -361,7 +376,10 @@ class GenerativeServer(_ServerBase):
                     num_slots=cfg.num_slots, int8=cfg.int8,
                     block_size=cfg.block_size, num_blocks=cfg.num_blocks,
                     queue_capacity=cfg.queue_capacity,
-                    summary_every=cfg.summary_every, slo=self.slo)
+                    summary_every=cfg.summary_every, slo=self.slo,
+                    draft_net=cfg.draft_net, spec_k=cfg.spec_k,
+                    radix_cache=cfg.radix_cache,
+                    prefix_cache_tokens=cfg.prefix_cache_tokens)
             for i, sub in enumerate(_split_mesh(mesh))]
         self._dispatcher = ReplicaDispatcher(self.queue, self._replicas)
         self.engine = self._replicas[0].engine
@@ -506,6 +524,7 @@ class GenerativeServer(_ServerBase):
             out["serving.kv_utilization"] = kv["utilization"]
             out["serving.kv_fragmentation"] = kv["fragmentation"]
             return out
+        drafted = accepted = 0
         for r in self._replicas:
             kv = r.mgr.stats()
             tag = f"|replica={r.index}"
@@ -514,6 +533,21 @@ class GenerativeServer(_ServerBase):
             out["serving.kv_fragmentation" + tag] = kv["fragmentation"]
             out["serving.kv_blocks_in_use" + tag] = kv["blocks_in_use"]
             out["serving.replica_queue_depth" + tag] = len(r.queue)
+            if r.spec_k:
+                drafted += r.draft_tokens
+                accepted += r.accepted_tokens
+                if r.draft_tokens:
+                    out["serving.accept_rate" + tag] = round(
+                        r.accepted_tokens / r.draft_tokens, 4)
+            if r.radix is not None:
+                rx = r.radix.stats()
+                out["serving.radix_hits" + tag] = rx["hits"]
+                out["serving.radix_hit_tokens" + tag] = rx["hit_tokens"]
+                out["serving.radix_evictions" + tag] = rx["evictions"]
+                out["serving.radix_cached_tokens" + tag] = \
+                    rx["cached_tokens"]
+        if drafted:
+            out["serving.accept_rate"] = round(accepted / drafted, 4)
         return out
 
     def stats(self):
@@ -552,6 +586,25 @@ class GenerativeServer(_ServerBase):
                 "kv_cache": r.mgr.stats(),
                 "compiled_signatures": r.engine.compiled_signatures(),
             } for r in reps]
+        if any(r.spec_k for r in reps):
+            drafted = sum(r.draft_tokens for r in reps)
+            accepted = sum(r.accepted_tokens for r in reps)
+            out["speculative"] = {
+                "k": max(r.spec_k for r in reps),
+                "draft_tokens": drafted,
+                "accepted_tokens": accepted,
+                "accept_rate": round(accepted / drafted, 4)
+                if drafted else None,
+            }
+            if drafted:
+                telemetry.gauge("serving.accept_rate",
+                                out["speculative"]["accept_rate"])
+        if any(r.radix is not None for r in reps):
+            rx = [r.radix.stats() for r in reps if r.radix is not None]
+            out["radix_cache"] = {
+                k: sum(s[k] for s in rx)
+                for k in ("hits", "misses", "hit_tokens", "evictions",
+                          "inserted_blocks", "cached_tokens")}
         telemetry.gauge("serving.kv_occupancy",
                         sum(r.mgr.stats()["occupancy"] for r in reps))
         telemetry.gauge("serving.kv_blocks_in_use",

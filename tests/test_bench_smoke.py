@@ -141,6 +141,9 @@ def test_serving_latency_bench_emits_artifact(tmp_path):
                BENCH_SERVING_GEN_RATES="50", BENCH_SERVING_GEN_MAX_NEW="4",
                BENCH_SERVING_AB_REQUESTS="4", BENCH_SERVING_AB_MAX_NEW="8",
                BENCH_SERVING_AB_REPEATS="2",
+               BENCH_SERVING_SPEC_REQUESTS="3", BENCH_SERVING_SPEC_K="3",
+               BENCH_SERVING_SPEC_MAX_NEW="6", BENCH_SERVING_SPEC_PREFIX="48",
+               BENCH_SERVING_SPEC_MAX_LEN="128",
                MXT_SERVING_LATENCY_OUT=str(out))
     env.pop("XLA_FLAGS", None)   # the bench forces its own 8-device flag
     r = subprocess.run(
@@ -188,6 +191,31 @@ def test_serving_latency_bench_emits_artifact(tmp_path):
     assert len(ab["step_ms_off_all"]) == len(ab["step_ms_on_all"]) == 2
     assert isinstance(ab["overhead_frac"], float)
     assert "tracing_step_overhead_under_3pct" in rec["acceptance"]
+    # r19: the spec × radix 2x2 sweep ran, stayed token-exact and
+    # compile-clean, and the robust gates hold even at toy knobs (the
+    # wall-clock prefill-ms ratio is asserted only at default scale)
+    arms = rec["spec_radix"]
+    assert set(arms) >= {"base", "base+radix", "spec", "spec+radix"}
+    assert arms["token_equal_across_arms"] is True
+    for name in ("base", "base+radix", "spec", "spec+radix"):
+        arm = arms[name]
+        assert arm["requests"] == 3
+        assert arm["compile_sig_delta"] == 0
+        assert arm["retrace_violations"] == 0
+        # at drain only radix-cache-held blocks may remain live
+        expect_blocks = (arm["radix"]["cached_tokens"] // 16
+                         if "radix" in arm else 0)
+        assert arm["kv_cache"]["blocks_in_use"] == expect_blocks
+    assert arms["spec"]["target_forwards_per_token"] < 0.5
+    assert arms["spec"]["accept_rate"] >= 0.7
+    assert arms["base"]["prefilled_tokens"] >= \
+        2 * arms["base+radix"]["prefilled_tokens"]
+    assert arms["base+radix"]["prefix_hit_tokens"] > 0
+    for key in ("spec_radix_token_equal",
+                "spec_forwards_per_token_under_half",
+                "radix_prefilled_tokens_reduced_2x",
+                "spec_radix_compile_once"):
+        assert rec["acceptance"][key], key
 
 
 def test_sharded_step_bench_emits_artifact(tmp_path):
