@@ -1,0 +1,275 @@
+"""Perf-regression ledger (r20): a normalized schema over the round
+artifacts the repo already commits.
+
+Every bench round leaves a ``FAMILY_rNN.json`` at the repo root —
+43 of them by r19 — each with a top-level ``metric``/``value``/``unit``
+headline and (since r06) an ``acceptance`` block of boolean gates.
+They were written for humans reading one round at a time; nothing
+machine-checked that r20 didn't quietly lose what r11 won.  This
+module normalizes the corpus so ``tools/perf_gate.py`` can:
+
+* ``--check NEW.json`` — compare a fresh artifact against the
+  committed baseline manifest with noise-aware thresholds (per-metric
+  direction + relative tolerance, min-of-repeats when the artifact
+  carries a ``value_all`` repeat list) and fail on any acceptance flag
+  that flipped true→false;
+* ``--trend`` — the r1→r19 trajectory per family.
+
+Why a committed manifest instead of naive round-over-round diffs: the
+artifacts were measured on whatever machine ran the round, and a toy
+CPU environment legitimately swings headline numbers (SERVING_LATENCY
+p99: 25.1 ms in r12, 189.8 ms in r19 — a heavier benchmark, not a
+slower server).  ``benchmark/PERF_BASELINE.json`` pins, per family,
+the reference value/direction/tolerance *reviewed at commit time*
+(regenerate with ``perf_gate --update-baseline`` and re-review the
+diff like a lockfile); ``--check`` is then "did THIS change regress
+the family beyond its noise band", not "is r19 slower than r12".
+
+Pure stdlib — no jax, no repo imports — so the gate runs anywhere.
+"""
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+
+#: FAMILY_rNN.json — family is the SCREAMING_SNAKE prefix, NN the round
+_NAME_RE = re.compile(r"^([A-Z0-9_]+?)_r(\d{2,})\.json$")
+
+#: default relative noise band for metric comparisons; the committed
+#: corpus was measured on heterogeneous toy hosts, so the default is
+#: wide — per-family overrides in SPEC tighten where the metric is a
+#: ratio/pct that should be stable
+DEFAULT_TOLERANCE = 0.25
+
+#: substrings that mark a metric as lower-is-better; anything else
+#: defaults to higher-is-better (throughputs, bandwidths, ratios-up)
+_LOWER_HINTS = ("_ms", "_usec", "_us", "_sec", "latency", "overhead",
+                "_wait", "_p50", "_p90", "_p99", "peak", "_gib",
+                "_bytes", "dispatch")
+
+#: per-family overrides: direction and/or tolerance where the name
+#: heuristic or the wide default is wrong.  ratio metrics compare two
+#: lanes of the SAME run, so they are stable across hosts and get a
+#: tight band; overhead percentages likewise.
+SPEC = {
+    "CKPT_OVERHEAD": {"tolerance": 0.5},
+    "FLEET_OVERHEAD": {"tolerance": 1.0},
+    "NUMERICS_OVERHEAD": {"tolerance": 1.0},
+    "REMAT_AB": {"direction": "lower", "tolerance": 0.15},
+    "SHARDED_STEP": {"direction": "lower", "tolerance": 0.15},
+    "MIXTRAL_PLAN": {"direction": "lower", "tolerance": 0.05},
+    # open-loop p99 swings with the host; the acceptance flags carry
+    # the real regression signal for serving rounds
+    "SERVING_LATENCY": {"tolerance": 3.0},
+    "ALLREDUCE_CPU_MESH": {"direction": "higher"},
+    "DATA_PLANE": {"tolerance": 2.0},
+    "DISPATCH_OVERHEAD": {"tolerance": 1.0},
+}
+
+
+def parse_name(filename):
+    """``SERVING_LATENCY_r19.json`` → ``("SERVING_LATENCY", 19)``;
+    ``None`` for files outside the artifact naming scheme."""
+    m = _NAME_RE.match(os.path.basename(filename))
+    if m is None:
+        return None
+    return m.group(1), int(m.group(2))
+
+
+def metric_direction(metric, family=None):
+    """``"lower"`` or ``"higher"``: which way the metric improves.
+    Family overrides in :data:`SPEC` win over the name heuristic."""
+    ov = SPEC.get(family or "", {}).get("direction")
+    if ov is not None:
+        return ov
+    name = (metric or "").lower()
+    if any(h in name for h in _LOWER_HINTS):
+        return "lower"
+    return "higher"
+
+
+def family_tolerance(family):
+    return float(SPEC.get(family, {}).get("tolerance",
+                                          DEFAULT_TOLERANCE))
+
+
+def flatten_acceptance(block, prefix=""):
+    """Bool leaves of a (possibly one-level-nested) acceptance dict,
+    keyed ``outer.inner``.  Non-bool leaves are ignored — only flags
+    participate in the true→false gate."""
+    out = {}
+    if not isinstance(block, dict):
+        return out
+    for k, v in block.items():
+        key = f"{prefix}{k}"
+        if isinstance(v, bool):
+            out[key] = v
+        elif isinstance(v, dict):
+            out.update(flatten_acceptance(v, key + "."))
+    return out
+
+
+def normalize(path):
+    """One artifact file → the ledger row::
+
+        {family, round, path, metric, value, unit, direction,
+         tolerance, acceptance: {flat_name: bool}}
+
+    ``value`` honors min-of-repeats: if the artifact carries a
+    ``value_all`` list (repeat measurements of the headline), the
+    best-of is used — min for lower-is-better, max for higher — the
+    same noise discipline the A/B lanes already apply.
+    """
+    parsed = parse_name(path)
+    if parsed is None:
+        raise ValueError(f"not a round artifact name: {path}")
+    family, rnd = parsed
+    with open(path) as f:
+        doc = json.load(f)
+    metric = doc.get("metric")
+    value = doc.get("value")
+    direction = metric_direction(metric, family)
+    repeats = doc.get("value_all")
+    if isinstance(repeats, (list, tuple)) and repeats:
+        value = (min(repeats) if direction == "lower" else max(repeats))
+    return {
+        "family": family,
+        "round": rnd,
+        "path": os.path.basename(path),
+        "metric": metric,
+        "value": value,
+        "unit": doc.get("unit"),
+        "direction": direction,
+        "tolerance": family_tolerance(family),
+        "acceptance": flatten_acceptance(doc.get("acceptance")),
+    }
+
+
+def scan(root):
+    """Every committed round artifact under ``root`` (non-recursive),
+    normalized and sorted by (family, round)."""
+    rows = []
+    for path in glob.glob(os.path.join(root, "*.json")):
+        if parse_name(path) is None:
+            continue
+        rows.append(normalize(path))
+    rows.sort(key=lambda r: (r["family"], r["round"]))
+    return rows
+
+
+def build_baseline(rows):
+    """The manifest: per family, the LATEST round is the reference.
+    Families whose latest artifact has neither a headline value nor
+    acceptance flags still appear (with nulls) so ``--check`` can say
+    "no baseline for this family" apart from "family unknown"."""
+    fams = {}
+    for r in rows:
+        cur = fams.get(r["family"])
+        if cur is None or r["round"] > cur["round"]:
+            fams[r["family"]] = r
+    return {
+        "schema": "mxnet-tpu-perf-baseline/1",
+        "families": {
+            f: {
+                "round": r["round"],
+                "path": r["path"],
+                "metric": r["metric"],
+                "value": r["value"],
+                "unit": r["unit"],
+                "direction": r["direction"],
+                "tolerance": r["tolerance"],
+                "acceptance": r["acceptance"],
+            } for f, r in sorted(fams.items())
+        },
+    }
+
+
+def load_baseline(path):
+    with open(path) as f:
+        doc = json.load(f)
+    if doc.get("schema") != "mxnet-tpu-perf-baseline/1":
+        raise ValueError(f"unrecognized baseline schema in {path}")
+    return doc
+
+
+def check(row, baseline):
+    """Failures (possibly empty) for one normalized artifact row
+    against the manifest.  Two gate kinds:
+
+    * **metric**: the headline moved beyond ``tolerance`` in the bad
+      direction (improvements and in-band noise pass);
+    * **acceptance**: a flag the baseline held true is now false, or
+      disappeared (a silently dropped gate is a regression too).
+
+    New flags / new families never fail — the ledger gates what was
+    won, it does not veto new work.
+    """
+    fams = baseline.get("families", {})
+    base = fams.get(row["family"])
+    problems = []
+    if base is None:
+        return problems        # new family: nothing to regress against
+    bv, nv = base.get("value"), row.get("value")
+    if bv is not None and nv is not None and bv != 0:
+        tol = float(base.get("tolerance", DEFAULT_TOLERANCE))
+        direction = base.get("direction", row["direction"])
+        delta = (nv - bv) / abs(bv)
+        regressed = (delta > tol if direction == "lower"
+                     else -delta > tol)
+        if regressed:
+            problems.append({
+                "kind": "metric",
+                "family": row["family"],
+                "metric": base.get("metric"),
+                "baseline": bv,
+                "new": nv,
+                "delta_frac": round(delta, 4),
+                "tolerance": tol,
+                "direction": direction,
+            })
+    new_acc = row.get("acceptance") or {}
+    for flag, held in (base.get("acceptance") or {}).items():
+        if not held:
+            continue           # baseline already failing: not a gate
+        if new_acc.get(flag) is not True:
+            problems.append({
+                "kind": "acceptance",
+                "family": row["family"],
+                "flag": flag,
+                "baseline": True,
+                "new": new_acc.get(flag, "missing"),
+            })
+    return problems
+
+
+def trend(rows):
+    """Per-family trajectory: every round's headline in order, with
+    the improvement sign resolved through the family direction."""
+    fams = {}
+    for r in rows:
+        fams.setdefault(r["family"], []).append(r)
+    out = []
+    for family in sorted(fams):
+        seq = sorted(fams[family], key=lambda r: r["round"])
+        points = [(r["round"], r["value"]) for r in seq]
+        valued = [(rnd, v) for rnd, v in points if v is not None]
+        direction = seq[-1]["direction"]
+        entry = {
+            "family": family,
+            "metric": seq[-1]["metric"],
+            "unit": seq[-1]["unit"],
+            "direction": direction,
+            "rounds": points,
+            "latest": valued[-1][1] if valued else None,
+        }
+        if len(valued) >= 2:
+            first, last = valued[0][1], valued[-1][1]
+            if first:
+                delta = (last - first) / abs(first)
+                entry["delta_frac"] = round(delta, 4)
+                entry["improved"] = (delta < 0 if direction == "lower"
+                                     else delta > 0)
+        out.append(entry)
+    return out

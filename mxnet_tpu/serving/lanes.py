@@ -56,6 +56,7 @@ import numpy as np
 
 from .. import sanitizer as _san
 from .. import telemetry
+from ..telemetry import capacity
 from ..telemetry import tracing
 from .bucketing import pad_batch
 from .kv_cache import PagedKVCacheManager
@@ -318,6 +319,9 @@ class PrefillLane:
                                       "error": repr(exc)})
             return True
         t_first = time.perf_counter()
+        # retroactive prefill duty-cycle interval from the stamps the
+        # lane already took (same contract as the trace spans below)
+        capacity.lane_busy(r.index, "prefill", t_start, t_first)
         mates = [req.id for req in group]
         for i, req in enumerate(group):
             req.t_first = t_first
@@ -494,6 +498,17 @@ class DecodeLane:
         telemetry.hist("serving.batch_size", len(active))
         telemetry.gauge("serving.kv_blocks_in_use",
                         r.mgr.allocator.blocks_in_use)
+        # retroactive capacity accounting from the stamps above: the
+        # busy interval, batch occupancy, and pool pressure per tick.
+        # Gated on is_enabled() so the argument expressions impose no
+        # attribute contract (or cost) on duck-typed engines/managers
+        # when capacity accounting is off.
+        if capacity.is_enabled():
+            capacity.note_tick(r.index, len(active),
+                               getattr(r.engine, "num_slots", len(active)),
+                               t0, t1)
+            capacity.note_kv(r.index, r.mgr.allocator.free_blocks,
+                             r.mgr.num_blocks)
         step_idx = r.engine.steps
         for slot in active:
             r.mgr.advance(slot)   # the step wrote K/V at slot's pos
@@ -560,6 +575,13 @@ class DecodeLane:
         telemetry.hist("serving.batch_size", len(active))
         telemetry.gauge("serving.kv_blocks_in_use",
                         r.mgr.allocator.blocks_in_use)
+        if capacity.is_enabled():
+            capacity.note_tick(r.index, len(active),
+                               getattr(r.engine, "num_slots", len(active)),
+                               t0, t1)
+            capacity.note_kv(r.index, r.mgr.allocator.free_blocks,
+                             r.mgr.num_blocks)
+        accepted_this_tick = 0
         step_idx = r.engine.steps
         for slot in active:
             d, g = proposals[slot], out[slot]
@@ -588,6 +610,7 @@ class DecodeLane:
             req.accepted_tokens += got
             r.draft_tokens += k
             r.accepted_tokens += got
+            accepted_this_tick += got
             telemetry.count("serving.accepted_tokens", got)
             if req.trace is not None:
                 req.trace.add("draft", t0, t_draft, step=step_idx,
@@ -603,6 +626,7 @@ class DecodeLane:
                     del self._seqs[slot]
                 r.finish(req, tokens)
         telemetry.count("serving.draft_tokens", k * len(active))
+        capacity.note_spec(r.index, k * len(active), accepted_this_tick)
         if r.draft_tokens:
             telemetry.gauge("serving.accept_rate",
                             round(r.accepted_tokens
@@ -679,7 +703,12 @@ class Replica:
         return self.mgr.reserved_tokens() + queued
 
     def offer(self, req):
-        return self.queue.offer(req)
+        ok = self.queue.offer(req)
+        if ok:
+            # accepted offers only: a shed request never joins the
+            # arrival process the λ estimator models
+            capacity.note_arrival(self.index, t=req.t_submit)
+        return ok
 
     # -- lifecycle ------------------------------------------------------------
     def start(self):
@@ -715,6 +744,7 @@ class Replica:
         self.completed += 1
         telemetry.count("serving.completed")
         telemetry.count(f"serving.completed|replica={self.index}")
+        capacity.note_completion(self.index, t=req.t_done)
         lane = "decode" if req.t_handoff is not None else "prefill"
         rec = req.record(lane=lane)
         tag = f"|replica={self.index}"
@@ -772,6 +802,16 @@ class Replica:
             "batch_size": telemetry.hist_summary("serving.batch_size"),
             "kv_cache": self.mgr.stats(),
         }
+        # the summary path already paid for stats(): feed the pool's
+        # fragmentation figure to the capacity trend estimator here
+        capacity.note_kv(self.index,
+                         self.mgr.allocator.free_blocks,
+                         self.mgr.num_blocks,
+                         fragmentation=rec["kv_cache"].get(
+                             "fragmentation"))
+        cap_view = capacity.snapshot(self.index)
+        if cap_view is not None:
+            rec["capacity"] = cap_view
         if self.draft is not None:
             rec["speculative"] = {
                 "k": self.spec_k,

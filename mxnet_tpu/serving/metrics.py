@@ -13,7 +13,9 @@ serving threads already maintain):
 * :class:`MetricsServer` — a stdlib ``http.server`` daemon thread bound
   to an owner server, exposing ``/metrics`` (the text above plus the
   owner's live gauges), ``/healthz`` (per-replica lane liveness, queue
-  depths, KV occupancy/fragmentation; HTTP 503 when degraded) and
+  depths, KV occupancy/fragmentation; HTTP 503 when degraded, but
+  ``saturated`` — all lanes alive, capacity ρ past threshold — stays
+  HTTP 200) and
   ``/requests`` (the in-flight request table).  Enabled per-server via
   ``ServerConfig(http_port=...)`` (0 = ephemeral port, see
   ``server.metrics_url``) — scrape while the server runs.
@@ -142,7 +144,12 @@ def _make_handler(ms):
                     body = ms.render_metrics().encode("utf-8")
                 elif path == "/healthz":
                     health = ms.owner.health()
-                    code = 200 if health.get("status") == "ok" else 503
+                    # "saturated" is degraded-but-alive: lanes are all
+                    # serving, capacity ρ is just past threshold — a
+                    # 503 here would make the orchestrator restart a
+                    # busy replica and shed the very capacity it needs
+                    code = (200 if health.get("status")
+                            in ("ok", "saturated") else 503)
                     ctype = "application/json"
                     body = json.dumps(health, indent=2,
                                       default=str).encode("utf-8")

@@ -26,6 +26,7 @@ import time
 import numpy as np
 
 from .. import telemetry
+from ..telemetry import capacity
 from ..telemetry import tracing
 from ..base import MXNetError
 from .bucketing import BucketPolicy
@@ -393,6 +394,9 @@ class GenerativeServer(_ServerBase):
     def start(self):
         if self._replicas is None:
             return super().start()
+        # fresh ledgers per server lifetime: replica indices restart at
+        # 0, so a previous server's estimators must not leak in
+        capacity.reset()
         for rep in self._replicas:
             rep.start()
         self._dispatcher.start()
@@ -458,11 +462,12 @@ class GenerativeServer(_ServerBase):
                     "kv_fragmentation": kv["fragmentation"]}
         reps = []
         all_alive = True
+        any_saturated = False
         for r in self._replicas:
             kv = r.mgr.stats()
             pa, da = r.prefill.alive(), r.decode.alive()
             all_alive = all_alive and pa and da
-            reps.append({
+            row = {
                 "replica": r.index,
                 "prefill_alive": pa,
                 "decode_alive": da,
@@ -471,11 +476,26 @@ class GenerativeServer(_ServerBase):
                 "failed": r.failed,
                 "kv_utilization": kv["utilization"],
                 "kv_fragmentation": kv["fragmentation"],
-                "kv_blocks_in_use": kv["blocks_in_use"]})
+                "kv_blocks_in_use": kv["blocks_in_use"]}
+            cap = capacity.snapshot(r.index)
+            if cap is not None:
+                row["saturated"] = cap["saturated"]
+                row["rho"] = cap["rho"]
+                row["headroom_rps"] = cap["headroom_rps"]
+                any_saturated = any_saturated or cap["saturated"]
+            reps.append(row)
         if not self._running:
             status = "stopped"
+        elif not all_alive:
+            status = "degraded"
+        elif any_saturated:
+            # degraded-but-alive: every lane is serving, but ρ sits
+            # above threshold — still HTTP 200 (a readiness probe must
+            # not kill a replica for being busy; the control plane
+            # reads headroom, not liveness)
+            status = "saturated"
         else:
-            status = "ok" if all_alive else "degraded"
+            status = "ok"
         return {"status": status, "running": self._running,
                 "queue_depth": len(self.queue),
                 "rejected": self.queue.rejected,
@@ -546,8 +566,32 @@ class GenerativeServer(_ServerBase):
                 out["serving.radix_evictions" + tag] = rx["evictions"]
                 out["serving.radix_cached_tokens" + tag] = \
                     rx["cached_tokens"]
+            cap = capacity.snapshot(r.index)
+            if cap is not None:
+                out["serving.utilization" + tag] = cap["utilization"]
+                out["serving.kv_free_frac" + tag] = cap["kv_free_frac"]
+                if cap["rho"] is not None:
+                    out["serving.rho" + tag] = cap["rho"]
+                if cap["headroom_rps"] is not None:
+                    out["serving.headroom_rps" + tag] = \
+                        cap["headroom_rps"]
         if drafted:
             out["serving.accept_rate"] = round(accepted / drafted, 4)
+        if capacity.is_enabled():
+            # fleet-level rollup: worst ρ (the replica closest to the
+            # knee governs admission) and total spare request rate
+            rhos = [v for k, v in out.items()
+                    if k.startswith("serving.rho|")]
+            heads = [v for k, v in out.items()
+                     if k.startswith("serving.headroom_rps|")]
+            utils = [v for k, v in out.items()
+                     if k.startswith("serving.utilization|")]
+            if rhos:
+                out["serving.rho"] = max(rhos)
+            if heads:
+                out["serving.headroom_rps"] = round(sum(heads), 4)
+            if utils:
+                out["serving.utilization"] = max(utils)
         return out
 
     def stats(self):
@@ -609,6 +653,8 @@ class GenerativeServer(_ServerBase):
                         sum(r.mgr.stats()["occupancy"] for r in reps))
         telemetry.gauge("serving.kv_blocks_in_use",
                         sum(r.mgr.allocator.blocks_in_use for r in reps))
+        if capacity.is_enabled():
+            out["capacity"] = [capacity.snapshot(r.index) for r in reps]
         if self.slo is not None:
             out["slo"] = self.slo.snapshot()
         return out

@@ -69,11 +69,13 @@ from . import promtext  # noqa: F401  (shared Prometheus text renderer)
 from . import fleet as _fleet_mod  # fleet-wide observability submodule
 from . import numerics as _numerics_mod  # in-compile tensor-stats tier
 from . import retrace as _retrace_mod  # recompile sanitizer (r18)
+from . import capacity as _capacity_mod  # duty-cycle/saturation (r20)
 # ``enable(fleet=...)``/``enable(numerics=...)`` take keywords of the
 # same names, so the modules travel under private aliases in this file
 fleet = _fleet_mod
 numerics = _numerics_mod
 retrace = _retrace_mod
+capacity = _capacity_mod
 
 __all__ = ["enable", "disable", "is_enabled", "span", "count", "gauge",
            "hist", "hist_summary", "hists", "emit",
@@ -295,7 +297,17 @@ def hist(name, value, cap=HIST_CAPACITY):
 def hist_summary(name, percentiles=(50, 90, 99)):
     """Percentile summary of histogram ``name`` over its rolling window:
     ``{count, window, mean, min, max, p50, p90, p99}`` (None when the
-    histogram has no observations)."""
+    histogram has no observations).
+
+    Percentiles are **nearest-rank** on the sorted window — exact order
+    statistics, no interpolation: ``pK = vals[ceil(K·n/100) − 1]``
+    (0-clamped).  The window edges are therefore pinned, which the
+    capacity/saturation summaries rely on: at ``n == 1`` every
+    percentile IS the single observation, and at ``n == 2`` p50 is the
+    smaller value while p90/p99 are the larger — p99 never invents a
+    value above the observed max (``tests/test_telemetry.py`` pins
+    both cases; ``benchmark/serving_latency.py`` uses the identical
+    formula so offline artifacts and live summaries agree)."""
     with _lock:
         r = _hists.get(name)
         return r.summary(percentiles) if r is not None else None
@@ -503,7 +515,8 @@ def step(examples=None, **extra):
 # -- lifecycle ---------------------------------------------------------------
 
 def enable(jsonl_path=None, append=False, memory=True, cost=True,
-           trace=False, fleet=False, numerics=False, retrace=False):
+           trace=False, fleet=False, numerics=False, retrace=False,
+           capacity=False):
     """Turn recording on.  ``jsonl_path`` attaches a structured-log sink
     writing one JSON line per step record (truncates unless ``append``).
     Idempotent: re-enabling resets counters and swaps sinks.  ``memory``
@@ -525,7 +538,11 @@ def enable(jsonl_path=None, append=False, memory=True, cost=True,
     ``retrace=True`` (or ``"warn"``/``"raise"``) enables the recompile
     sanitizer in that mode — call ``telemetry.retrace.enable(...)``
     directly for a warmup-step budget; ``MXNET_SANITIZE_RETRACE=1``
-    switches it on independently."""
+    switches it on independently.  ``capacity=True`` enables serving
+    capacity accounting (lane duty cycle, λ/μ/ρ, headroom, saturation
+    watch) at its env-default knobs — call
+    ``telemetry.capacity.enable(...)`` directly for thresholds;
+    ``MXNET_CAPACITY=1`` switches it on independently."""
     global _enabled
     with _lock:
         _reset_locked()
@@ -548,6 +565,8 @@ def enable(jsonl_path=None, append=False, memory=True, cost=True,
     if retrace:
         _retrace_mod.enable(mode=retrace if isinstance(retrace, str)
                             else "warn")
+    if capacity:
+        _capacity_mod.enable()
 
 
 def disable():
@@ -560,6 +579,7 @@ def disable():
     tracing.disable()
     _fleet_mod.disable()
     _numerics_mod.disable()
+    _capacity_mod.disable()
     with _lock:
         for s in _sinks:
             s.close()

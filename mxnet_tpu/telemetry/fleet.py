@@ -447,10 +447,15 @@ def _fleet_exchange(record):
     # every rank learns WHO diverged and WHERE from one allgather
     first_nan = (record.get("numerics") or {}).get("first_nan") or {}
     nan_layer = float(first_nan.get("layer", -1) if first_nan else -1)
+    # duty cycle (compute_ms / step_ms) rides as a 7th float: the
+    # fleet's MFU proxy, so one allgather also answers "which rank is
+    # spending its step on something other than compute"
+    from . import capacity as _cap
+    duty = _cap.duty_cycle(compute_ms, step_ms)
     vec = [step_ms, wait_ms, compute_ms,
            float(record.get("peak_live_bytes") or 0.0),
            float(record.get("examples_per_sec") or 0.0),
-           nan_layer]
+           nan_layer, duty]
     t0 = time.perf_counter()
     rows = None
     pl = _parallel()
@@ -482,6 +487,10 @@ def _fleet_exchange(record):
         # 5-column vectors simply omit the column
         "first_nan_layer": ([int(v) for v in cols[5]]
                             if len(cols) > 5 else [-1] * len(rows)),
+        # per-rank duty cycle (compute_ms / step_ms in [0, 1]); rows
+        # gathered from older 6-column peers render as 0.0 (unknown)
+        "duty_cycle": ([round(float(v), 4) for v in cols[6]]
+                       if len(cols) > 6 else [0.0] * len(rows)),
         "exchange_ms": exchange_ms,
     }
     view["stragglers"] = detect_skew(view["compute_ms"], thresh)

@@ -144,6 +144,9 @@ def test_serving_latency_bench_emits_artifact(tmp_path):
                BENCH_SERVING_SPEC_REQUESTS="3", BENCH_SERVING_SPEC_K="3",
                BENCH_SERVING_SPEC_MAX_NEW="6", BENCH_SERVING_SPEC_PREFIX="48",
                BENCH_SERVING_SPEC_MAX_LEN="128",
+               BENCH_SERVING_CAP_BURST="12",
+               BENCH_SERVING_CAP_AB_REQUESTS="4",
+               BENCH_SERVING_CAP_AB_REPEATS="2",
                MXT_SERVING_LATENCY_OUT=str(out))
     env.pop("XLA_FLAGS", None)   # the bench forces its own 8-device flag
     r = subprocess.run(
@@ -197,6 +200,25 @@ def test_serving_latency_bench_emits_artifact(tmp_path):
     arms = rec["spec_radix"]
     assert set(arms) >= {"base", "base+radix", "spec", "spec+radix"}
     assert arms["token_equal_across_arms"] is True
+    # r20: the capacity lanes ran — the A/B has both arms, the burst
+    # lane reached a verdict, the paged sweep carries live λ/μ/ρ reads
+    # and the agreement block names its measurement rung (the TRUTH of
+    # the gates is asserted at default scale, committed in the r20
+    # artifact — toy knobs only prove the lanes execute end to end)
+    cab = rec["capacity_ab"]
+    assert cab["step_ms_off"] > 0 and cab["step_ms_on"] > 0
+    assert len(cab["step_ms_off_all"]) == len(cab["step_ms_on_all"]) == 2
+    burst = rec["saturation_burst"]
+    assert isinstance(burst["saturation_precedes_breach"], bool)
+    assert burst["saturation_events"] >= 0
+    for s in gen["paged"]["rates"].values():
+        assert "capacity" in s and "predicted_max_rate_rps" in s["capacity"]
+    agree = rec["capacity_agreement"]
+    assert agree["measured_at_rate"] in agree["rate_grid"]
+    for key in ("capacity_live_prediction_within_one_step",
+                "saturation_precedes_queue_wait_breach",
+                "capacity_overhead_under_1pct"):
+        assert key in rec["acceptance"]
     for name in ("base", "base+radix", "spec", "spec+radix"):
         arm = arms[name]
         assert arm["requests"] == 3
@@ -536,3 +558,77 @@ def test_graft_entry_compiles():
                        timeout=900)
     assert r.returncode == 0, r.stderr[-2000:]
     assert "ENTRY_OK" in r.stdout
+
+
+# --- perf gate: the regression ledger over committed artifacts ---------------
+
+PERF_GATE = os.path.join(REPO, "tools", "perf_gate.py")
+
+
+def _gate(*args):
+    return subprocess.run([sys.executable, PERF_GATE, *args],
+                          capture_output=True, text=True, timeout=120)
+
+
+def test_perf_gate_committed_artifacts_pass():
+    """Every family's latest committed FAMILY_rNN.json must clear the
+    committed benchmark/PERF_BASELINE.json manifest — the ledger's
+    standing acceptance claim."""
+    r = _gate("--check-all")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "perf_gate: clean" in r.stdout
+
+
+def test_perf_gate_trend_reports_every_family():
+    r = _gate("--trend", "--json")
+    assert r.returncode == 0, r.stderr
+    entries = json.loads(r.stdout)
+    fams = {e["family"] for e in entries}
+    assert {"SERVING_LATENCY", "FLEET_OVERHEAD", "BENCH"} <= fams
+    sl = next(e for e in entries if e["family"] == "SERVING_LATENCY")
+    assert sl["direction"] == "lower"
+    assert [rnd for rnd, _ in sl["rounds"]] == sorted(
+        rnd for rnd, _ in sl["rounds"])
+
+
+def test_perf_gate_fails_injected_regression(tmp_path):
+    """Toy corpus: a 2x latency regression (and separately a flipped
+    acceptance flag) must fail the gate; an in-noise wobble passes."""
+    base = {"metric": "toy_latency_ms", "value": 10.0, "unit": "ms",
+            "acceptance": {"compile_once": True}}
+    (tmp_path / "TOY_LATENCY_r01.json").write_text(json.dumps(base))
+    manifest = str(tmp_path / "PERF_BASELINE.json")
+    r = _gate("--update-baseline", "--root", str(tmp_path),
+              "--baseline", manifest)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+    # in-noise wobble (+10% on a 25% band): passes
+    ok = dict(base, value=11.0)
+    p_ok = tmp_path / "TOY_LATENCY_r02.json"
+    p_ok.write_text(json.dumps(ok))
+    r = _gate("--check", str(p_ok), "--baseline", manifest)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+    # 2x latency: fails on the metric gate
+    slow = dict(base, value=20.0)
+    p_slow = tmp_path / "TOY_LATENCY_r03.json"
+    p_slow.write_text(json.dumps(slow))
+    r = _gate("--check", str(p_slow), "--baseline", manifest)
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "REGRESSION" in r.stdout and "toy_latency_ms" in r.stdout
+
+    # lost acceptance flag: fails even with the metric flat
+    lost = dict(base, acceptance={"compile_once": False})
+    p_lost = tmp_path / "TOY_LATENCY_r04.json"
+    p_lost.write_text(json.dumps(lost))
+    r = _gate("--check", str(p_lost), "--baseline", manifest)
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "compile_once" in r.stdout
+
+    # min-of-repeats: a noisy repeat list whose BEST value is in-band
+    # passes (the gate compares best-of, not worst-of)
+    noisy = dict(base, value=30.0, value_all=[30.0, 10.4, 14.0])
+    p_noisy = tmp_path / "TOY_LATENCY_r05.json"
+    p_noisy.write_text(json.dumps(noisy))
+    r = _gate("--check", str(p_noisy), "--baseline", manifest)
+    assert r.returncode == 0, r.stdout + r.stderr

@@ -155,6 +155,47 @@ def test_fleet_disabled_overhead_bounded():
     assert time.perf_counter() - t0 < 0.5
 
 
+def test_exchange_tolerates_six_column_peers(monkeypatch):
+    """Rows gathered from pre-r20 peers carry six floats (no
+    duty_cycle); the view renders their duty cycle as 0.0 (unknown)
+    instead of crashing or misaligning columns — the same back-compat
+    contract the r17 first_nan_layer bump established."""
+    import types
+
+    # a fake 2-rank gather that STRIPS the 7th float, as an old peer's
+    # packed vector would
+    def gather(vec):
+        return [list(vec)[:6], list(vec)[:6]]
+
+    fake_pl = types.SimpleNamespace(process_gather_hostvec=gather)
+    # patch the indirection point, not sys.modules: injecting a fake
+    # mxnet_tpu.parallel would also flip world()'s cache-enable check
+    monkeypatch.setattr(fleet, "_parallel", lambda: fake_pl)
+    monkeypatch.setattr(fleet, "world", lambda: (0, 2))
+    view = fleet._fleet_exchange(
+        {"step": 7, "step_ms": 10.0,
+         "counters": {"trainer.allreduce_wait_ms": 2.0}})
+    assert view["world_size"] == 2
+    assert view["duty_cycle"] == [0.0, 0.0]
+    assert view["first_nan_layer"] == [-1, -1]
+    assert view["compute_ms"] == [8.0, 8.0]
+
+
+def test_exchange_seven_column_rows_carry_duty_cycle(monkeypatch):
+    import types
+
+    def gather(vec):
+        return [list(vec), list(vec)]
+
+    fake_pl = types.SimpleNamespace(process_gather_hostvec=gather)
+    monkeypatch.setattr(fleet, "_parallel", lambda: fake_pl)
+    monkeypatch.setattr(fleet, "world", lambda: (1, 2))
+    view = fleet._fleet_exchange(
+        {"step": 9, "step_ms": 10.0,
+         "counters": {"trainer.allreduce_wait_ms": 2.0}})
+    assert view["duty_cycle"] == [pytest.approx(0.8)] * 2
+
+
 def test_telemetry_on_fleet_off_leaves_records_unstamped():
     telemetry.enable()
     sink = ListSink()
@@ -185,11 +226,14 @@ def test_step_records_gain_rank_and_views_emit_at_stride():
     v = views[-1]
     assert v["world_size"] == 1 and v["stride"] == 2
     for col in ("step_ms", "allreduce_wait_ms", "compute_ms",
-                "peak_live_bytes", "examples_per_sec"):
+                "peak_live_bytes", "examples_per_sec", "duty_cycle"):
         assert len(v[col]) == 1, col
     assert v["allreduce_wait_ms"] == [2.0]
     assert v["compute_ms"][0] == pytest.approx(
         max(v["step_ms"][0] - 2.0, 0.0))
+    # r20: the 7th exchanged float is compute_ms / step_ms in [0, 1]
+    assert v["duty_cycle"][0] == pytest.approx(
+        v["compute_ms"][0] / v["step_ms"][0], abs=1e-3)
     assert v["stragglers"] == []
     assert telemetry.counters()["fleet.exchange"] == 2
     assert fleet.last_view()["step"] == 4

@@ -194,6 +194,19 @@ def test_fleet_calls_allowed_in_hot_paths():
                for v in vs)
 
 
+def test_capacity_hooks_allowed_in_hot_paths():
+    vs = _analyze("t6_capacity.py")
+    contexts = {v.context for v in vs}
+    # capacity.note_* / lane_busy + the same-module hook helper (whose
+    # perf_counter fallback is part of the contract) must NOT flag in
+    # the hot decode tick
+    assert "note_tick" not in contexts
+    assert "traced_decode_tick" not in contexts
+    # a real host sync in the jitted tick body still flags
+    assert any(v.rule == "T1" and v.context == "bad_synced_tick"
+               for v in vs)
+
+
 def test_numerics_taps_allowed_in_hot_paths():
     vs = _analyze("t6_numerics.py")
     contexts = {v.context for v in vs}

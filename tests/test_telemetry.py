@@ -132,6 +132,42 @@ def test_counter_aggregation_cumulative_vs_per_step():
     assert sink.records == [r1, r2]
 
 
+def test_hist_summary_nearest_rank_pinned_at_tiny_windows():
+    """Nearest-rank percentiles are exact order statistics, so the edge
+    cases are pinned (r20 — capacity summaries lean on these): n == 1
+    makes every percentile the single observation; n == 2 puts p50 on
+    the smaller value and p90/p99 on the larger.  No interpolation
+    means p99 can never exceed the observed max."""
+    telemetry.enable()
+    telemetry.hist("q", 7.5)
+    s = telemetry.hist_summary("q")
+    assert s["count"] == 1 and s["window"] == 1
+    assert s["p50"] == s["p90"] == s["p99"] == 7.5
+    assert s["min"] == s["max"] == s["mean"] == 7.5
+
+    telemetry.hist("q", 2.5)  # window is now [2.5, 7.5]
+    s = telemetry.hist_summary("q")
+    assert s["window"] == 2
+    # ceil(50*2/100) - 1 = 0 -> smaller; ceil(90*2/100) - 1 = 1 -> larger
+    assert s["p50"] == 2.5
+    assert s["p90"] == 7.5 and s["p99"] == 7.5
+    assert s["p99"] <= s["max"]
+
+
+def test_hist_summary_nearest_rank_matches_formula():
+    telemetry.enable()
+    vals = [5.0, 1.0, 4.0, 2.0, 3.0]
+    for v in vals:
+        telemetry.hist("lat", v)
+    s = telemetry.hist_summary("lat", percentiles=(50, 90, 99))
+    ordered = sorted(vals)
+    n = len(ordered)
+    for p in (50, 90, 99):
+        rank = max(0, min(n - 1, -(-p * n // 100) - 1))
+        assert s["p%d" % p] == ordered[rank]
+    assert s["p50"] == 3.0 and s["p90"] == 5.0
+
+
 def test_span_thread_safety():
     telemetry.enable()
     errs = []

@@ -129,6 +129,16 @@ def heatmap_text(records, metric="compute_ms", threshold=None):
         lines.append("no fleet-view records (was the fleet layer "
                      "enabled, and did a stride boundary pass?)")
     lines.append("")
+    util = {}
+    for s in cols:
+        for r, v in enumerate(by_step[s].get("duty_cycle") or []):
+            util.setdefault(r, []).append(float(v))
+    # all-zero columns come from pre-r20 peers that never packed the
+    # 7th float — "unknown", not "idle"
+    if any(any(vs) for vs in util.values()):
+        lines.append("utilization (mean duty cycle): " + ", ".join(
+            "rank %d %.1f%%" % (r, 100.0 * sum(vs) / len(vs))
+            for r, vs in sorted(util.items())))
     if flagged_by_rank:
         worst = sorted(flagged_by_rank.items(),
                        key=lambda kv: -kv[1])

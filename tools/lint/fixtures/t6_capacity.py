@@ -1,0 +1,49 @@
+"""T6 fixture: capacity-accounting hooks in serving hot paths.
+
+The r20 capacity layer turns stamps the lanes already take into
+duty-cycle ledgers and λ/μ estimators (``capacity.note_tick`` /
+``note_arrival`` / ``lane_busy`` ...) — host-side float ops behind one
+boolean.  The analyzer must (a) not flag ``capacity.*`` calls in hot
+decode-tick code, (b) not let hotness leak into a same-module hook
+helper through its bare-name call, (c) keep tolerating the recording
+heads alongside real work in a jitted body, while (d) still flagging
+a genuine host sync in that same body.
+"""
+import time
+
+import jax
+import numpy as np
+
+from mxnet_tpu.telemetry import capacity
+
+
+def note_tick(index, active, capacity_slots, t0, t1):
+    # same-module capacity hook: retroactive interval append from the
+    # stamps the lane already took (falling back to its own clock read,
+    # like the real hook) — hotness must NOT leak in through the
+    # bare-name call in traced_decode_tick below
+    t_end = time.perf_counter() if t1 is None else t1
+    _ = (t_end - t0, active / capacity_slots, index)
+
+
+def traced_decode_tick(step_fn, batch, index, t0, t1):
+    out = step_fn(batch)
+    note_tick(index, 4, 8, t0, t1)                  # ok: helper
+    capacity.note_tick(index, 4, 8, t0, t1)         # ok: capacity.*
+    capacity.note_kv(index, 60, 100)                # ok: capacity.*
+    capacity.lane_busy(index, "prefill", t0, t1)    # ok: capacity.*
+    return out
+
+
+traced_decode_tick_jit = jax.jit(traced_decode_tick, static_argnums=0)
+
+
+def bad_synced_tick(step_fn, batch, record):
+    out = step_fn(batch)
+    host = np.asarray(out)          # T1 error: sync in the hot step
+    if record is not None:
+        record["loss"] = host[0]
+    return host
+
+
+bad_synced_tick_jit = jax.jit(bad_synced_tick, static_argnums=0)

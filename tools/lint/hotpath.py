@@ -69,6 +69,13 @@ RECORDING_SAFE_CALLEES = {
     # (MATERIALIZE_DEFS), and record_compiled only queues device scalars
     "tap", "tap_stacked", "stats_of", "record_compiled",
     "record_stacked", "step_summary",
+    # capacity accounting hooks (r20, telemetry.capacity): retroactive
+    # interval-ledger / EWMA appends from stamps the serving lanes
+    # already take — one boolean disabled, float ops under one lock
+    # enabled, never a clock read of their own beyond the stamps
+    # handed in, never a device touch
+    "note_arrival", "note_completion", "note_tick", "note_spec",
+    "note_kv", "lane_busy",
 }
 
 _FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)

@@ -165,7 +165,14 @@ RECORDING_HEADS = {"telemetry", "profiler", "prof",
                    # hooks ride compile-miss branches only — structural
                    # bookkeeping behind one boolean, never a device sync,
                    # and replays never reach them
-                   "retrace", "_retrace"}
+                   "retrace", "_retrace",
+                   # r20 capacity accounting (telemetry.capacity, aliased
+                   # _capacity_mod in telemetry/__init__): note hooks are
+                   # retroactive interval/EWMA appends from perf_counter
+                   # stamps the serving lanes already take — one boolean
+                   # disabled, a few float ops under one lock enabled,
+                   # never a device touch
+                   "capacity", "_capacity", "_capacity_mod"}
 
 
 def _is_recording_call(dotted: str) -> bool:

@@ -1,0 +1,175 @@
+"""Perf-regression gate over the committed round artifacts.
+
+The repo commits a ``FAMILY_rNN.json`` artifact per bench round;
+``benchmark/ledger.py`` normalizes them and
+``benchmark/PERF_BASELINE.json`` pins, per family, the reference
+headline value (direction + noise tolerance) and the acceptance flags
+the round won.  This CLI is the enforcement end:
+
+    # gate a fresh artifact against the committed baseline
+    python tools/perf_gate.py --check SERVING_LATENCY_r20.json
+
+    # re-verify every committed artifact still clears the manifest
+    python tools/perf_gate.py --check-all
+
+    # the r1 -> r19 trajectory, one line per family
+    python tools/perf_gate.py --trend
+
+    # regenerate the manifest after a reviewed perf change
+    python tools/perf_gate.py --update-baseline
+
+``--check`` exits 1 on any regression: a headline metric moved beyond
+the family's tolerance in the bad direction (min-of-repeats when the
+artifact carries ``value_all``), or an acceptance flag the baseline
+held true is now false/missing.  New families and new flags pass —
+the gate protects what earlier rounds won, it does not veto new work.
+
+``tests/test_bench_smoke.py`` runs ``--check`` on a toy baseline and
+asserts an injected 2x latency regression fails; CI-style use is
+``--check NEW.json`` right after a bench run, before committing the
+artifact.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from benchmark import ledger  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_BASELINE = os.path.join(REPO, "benchmark", "PERF_BASELINE.json")
+
+
+def _fmt_problem(p):
+    if p["kind"] == "metric":
+        arrow = "above" if p["direction"] == "lower" else "below"
+        return ("REGRESSION %s: %s %s -> %s (%+.1f%%, %s baseline "
+                "beyond %.0f%% tolerance)"
+                % (p["family"], p["metric"], p["baseline"], p["new"],
+                   100 * p["delta_frac"], arrow, 100 * p["tolerance"]))
+    return ("REGRESSION %s: acceptance flag %r was true at baseline, "
+            "now %s" % (p["family"], p["flag"], p["new"]))
+
+
+def cmd_check(paths, baseline_path, root):
+    base = ledger.load_baseline(baseline_path)
+    failures = []
+    for path in paths:
+        row = ledger.normalize(path)
+        probs = ledger.check(row, base)
+        status = "FAIL" if probs else "ok"
+        print("%-4s %s (family %s, round r%02d)"
+              % (status, os.path.basename(path), row["family"],
+                 row["round"]))
+        for p in probs:
+            print("  " + _fmt_problem(p))
+        failures.extend(probs)
+    if failures:
+        print("perf_gate: %d regression(s)" % len(failures))
+        return 1
+    print("perf_gate: clean")
+    return 0
+
+
+def cmd_check_all(baseline_path, root):
+    rows = ledger.scan(root)
+    if not rows:
+        print("no round artifacts under %s" % root, file=sys.stderr)
+        return 1
+    # only the baseline round of each family is gate-relevant: older
+    # rounds are history the trend view covers, not current claims
+    base = ledger.load_baseline(baseline_path)
+    latest = {}
+    for r in rows:
+        cur = latest.get(r["family"])
+        if cur is None or r["round"] > cur["round"]:
+            latest[r["family"]] = r
+    paths = [os.path.join(root, r["path"])
+             for _, r in sorted(latest.items())]
+    return cmd_check(paths, baseline_path, root)
+
+
+def cmd_trend(root, as_json=False):
+    rows = ledger.scan(root)
+    entries = ledger.trend(rows)
+    if as_json:
+        json.dump(entries, sys.stdout, indent=1)
+        sys.stdout.write("\n")
+        return 0
+    print("%-22s %-38s %-6s %s" % ("family", "metric", "dir",
+                                   "rounds (round:value)"))
+    for e in entries:
+        pts = " ".join(
+            "r%02d:%s" % (rnd, ("%g" % v) if v is not None else "-")
+            for rnd, v in e["rounds"])
+        mark = ""
+        if "improved" in e:
+            mark = " [%s %+.1f%%]" % (
+                "improved" if e["improved"] else "regressed",
+                100 * e["delta_frac"])
+        print("%-22s %-38s %-6s %s%s"
+              % (e["family"], e["metric"] or "-", e["direction"],
+                 pts, mark))
+    return 0
+
+
+def cmd_update_baseline(baseline_path, root):
+    rows = ledger.scan(root)
+    if not rows:
+        print("no round artifacts under %s" % root, file=sys.stderr)
+        return 1
+    manifest = ledger.build_baseline(rows)
+    with open(baseline_path, "w", encoding="utf-8") as f:
+        json.dump(manifest, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print("wrote %s (%d families from %d artifacts)"
+          % (baseline_path, len(manifest["families"]), len(rows)))
+    return 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="noise-aware perf-regression gate over the "
+        "committed FAMILY_rNN.json bench artifacts")
+    ap.add_argument("--check", nargs="+", metavar="ARTIFACT.json",
+                    help="gate these artifacts against the baseline "
+                    "manifest (exit 1 on regression)")
+    ap.add_argument("--check-all", action="store_true",
+                    help="gate every family's latest committed "
+                    "artifact (the manifest must be clean vs itself)")
+    ap.add_argument("--trend", action="store_true",
+                    help="print the per-family round-over-round "
+                    "trajectory")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="regenerate the manifest from the committed "
+                    "artifacts (review the diff like a lockfile)")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="manifest path (default: "
+                    "benchmark/PERF_BASELINE.json)")
+    ap.add_argument("--root", default=REPO,
+                    help="directory holding the *_rNN.json artifacts "
+                    "(default: the repo root)")
+    ap.add_argument("--json", action="store_true",
+                    help="with --trend: emit JSON instead of the "
+                    "table")
+    args = ap.parse_args(argv)
+
+    if args.update_baseline:
+        return cmd_update_baseline(args.baseline, args.root)
+    if args.trend:
+        return cmd_trend(args.root, as_json=args.json)
+    if args.check_all:
+        return cmd_check_all(args.baseline, args.root)
+    if args.check:
+        return cmd_check(args.check, args.baseline, args.root)
+    ap.print_help()
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())
